@@ -1,0 +1,25 @@
+"""harmony_tpu — a TPU-native multi-tenant elastic training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of snuspl/harmony (surveyed in
+SURVEY.md): elastic sharded model tables (parameter-server push/pull realized as
+gather / scatter-add with XLA collectives over a device mesh), a
+pull->compute->push Trainer API with bounded-staleness mini-batch control, a
+long-running JobServer that carves one TPU mesh among concurrent jobs with
+globally coordinated phase scheduling, plan-driven live re-sharding,
+two-stage checkpoint/restore, and a metrics->optimizer feedback loop.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+  L0  parallel/   device mesh + submesh carving        (ref: REEF evaluators)
+  L1  runtime/    transport + messaging                (ref: NCS/Wake TCP)
+  L2  table/      elastic sharded tables               (ref: services/et)
+  L3  plan/       reconfiguration plan engine          (ref: et/plan)
+  L4  jobserver/  long-running master + scheduling     (ref: jobserver)
+  L5  dolphin/    PS training framework; pregel/ graph (ref: dolphin, pregel)
+  L6  apps/       MLR, NMF, LDA, Lasso, GBT, ...       (ref: mlapps, graphapps)
+  X1  ops/        Pallas kernels / XLA math            (ref: Breeze+BLAS JNI)
+  X2  data/       input splits + loaders               (ref: common/dataloader)
+  X3  metrics/    metrics, tracing                     (ref: et/metric, dolphin/metric)
+"""
+
+__version__ = "0.1.0"

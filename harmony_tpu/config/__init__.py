@@ -1,0 +1,29 @@
+from harmony_tpu.config.base import (
+    ConfigBase,
+    config,
+    register_config,
+    resolve_symbol,
+    symbol_name,
+)
+from harmony_tpu.config.params import (
+    ExecutorConfig,
+    JobConfig,
+    RemoteAccessConfig,
+    TableConfig,
+    TaskletConfig,
+    TrainerParams,
+)
+
+__all__ = [
+    "ConfigBase",
+    "config",
+    "register_config",
+    "resolve_symbol",
+    "symbol_name",
+    "ExecutorConfig",
+    "JobConfig",
+    "RemoteAccessConfig",
+    "TableConfig",
+    "TaskletConfig",
+    "TrainerParams",
+]

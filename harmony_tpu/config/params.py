@@ -1,0 +1,122 @@
+"""Concrete config schemas: table / executor / tasklet / job.
+
+Mirrors the reference's typed builders — TableConfiguration.java:36-214,
+ExecutorConfiguration.java:26-72, RemoteAccessConfiguration, TaskletConfiguration,
+and the Dolphin job parameter set (dolphin/DolphinParameters.java) — rebuilt as
+serializable dataclasses (see config/base.py for the Tang analogy).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import field
+from typing import Any, Dict, List, Optional, Tuple
+
+from harmony_tpu.config.base import ConfigBase, config
+
+# Reference default: NumTotalBlocks def 1024
+# (services/et/.../configuration/parameters/NumTotalBlocks.java).
+DEFAULT_NUM_BLOCKS = 1024
+
+
+@config
+class TableConfig(ConfigBase):
+    """Schema of one elastic table (ref: TableConfiguration.java:36-214).
+
+    The reference stores opaque K/V pairs with codecs; on TPU values are typed
+    arrays so the schema carries value shape/dtype instead of codec classes.
+    ``is_ordered`` selects range vs hash partitioning exactly as the
+    reference's ``IsOrderedTable`` does (TableConfiguration.java:42-45).
+    """
+
+    table_id: str
+    capacity: int                      # number of addressable keys [0, capacity)
+    value_shape: Tuple[int, ...] = ()  # per-key value shape; () = scalar
+    dtype: str = "float32"
+    num_blocks: int = DEFAULT_NUM_BLOCKS
+    is_ordered: bool = True            # range partitioner; False = hash
+    is_mutable: bool = True
+    update_fn: str = "add"             # name in table.update registry
+    # Optional bulk-load source (ref: FilePath / BulkDataLoader binding).
+    input_path: Optional[str] = None
+    parser: Optional[str] = None       # dotted path of DataParser
+
+    def __post_init__(self) -> None:
+        if self.capacity <= 0:
+            raise ValueError("capacity must be positive")
+        if self.num_blocks <= 0:
+            raise ValueError("num_blocks must be positive")
+        if self.num_blocks > self.capacity:
+            # Clamp HERE (not in the partitioner) so the config is the single
+            # source of truth for block count — BlockManager, checkpoints and
+            # storage must all agree on block ids.
+            object.__setattr__(self, "num_blocks", self.capacity)
+        if isinstance(self.value_shape, list):
+            object.__setattr__(self, "value_shape", tuple(self.value_shape))
+
+
+@config
+class RemoteAccessConfig(ConfigBase):
+    """Host-side op-queue knobs (ref: RemoteAccessConfiguration: CommQueueSize,
+    NumCommThreads). On TPU the data plane is XLA collectives, but the host
+    control plane still runs queued ops for sparse/irregular access."""
+
+    num_comm_threads: int = 4
+    queue_size: int = 1024
+
+
+@config
+class ExecutorConfig(ConfigBase):
+    """Per-executor resources (ref: ExecutorConfiguration.java:26-72 and the
+    README operating point: 5 executors x 128 MB x 1 core). An "executor" here
+    is one device (chip) slot of the pod mesh plus its host-side runtime."""
+
+    num_devices: int = 1
+    remote_access: RemoteAccessConfig = field(default_factory=RemoteAccessConfig)
+    # TaskUnit slots per executor (ref: LocalTaskUnitScheduler.java:36-37).
+    cpu_slots: int = 1
+    net_slots: int = 2
+
+
+@config
+class TaskletConfig(ConfigBase):
+    """One unit of computation submitted to an executor
+    (ref: TaskletConfiguration; Tasklet.java:24-36)."""
+
+    tasklet_id: str
+    tasklet_class: str            # dotted path, resolved at start
+    user_params: Dict[str, Any] = field(default_factory=dict)
+
+
+@config
+class TrainerParams(ConfigBase):
+    """Dolphin hyper-parameter block (ref: DolphinParameters.java:26-195).
+
+    ``num_mini_batches`` plays the role of NumWorkerBlocks: an epoch is
+    partitioned into exactly this many batches (= input-table blocks per
+    worker in the reference, ETTrainingDataProvider.java:38-75).
+    """
+
+    num_epochs: int = 1
+    num_mini_batches: int = 10
+    clock_slack: int = 0              # SSP staleness bound; 0 = BSP
+    step_size: float = 0.1
+    decay_rate: float = 0.9
+    decay_period: int = 5
+    num_trainer_threads: int = 1
+    model_cache_enabled: bool = False
+    app_params: Dict[str, Any] = field(default_factory=dict)
+
+
+@config
+class JobConfig(ConfigBase):
+    """A full job submission (ref: the serialized conf DolphinJobLauncher
+    assembles and ships over TCP; jobserver/DolphinJobLauncher.java)."""
+
+    job_id: str
+    app_type: str                      # "dolphin" | "pregel"
+    trainer: Optional[str] = None      # dotted path of Trainer subclass
+    update_fn: str = "add"
+    tables: List[TableConfig] = field(default_factory=list)
+    params: TrainerParams = field(default_factory=TrainerParams)
+    num_workers: int = 0               # 0 = all executors (ref SchedulerImpl: all)
+    user: Dict[str, Any] = field(default_factory=dict)

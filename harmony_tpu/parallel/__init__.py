@@ -1,0 +1,9 @@
+from harmony_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    DevicePool,
+    build_mesh,
+    local_devices,
+)
+
+__all__ = ["DATA_AXIS", "MODEL_AXIS", "DevicePool", "build_mesh", "local_devices"]

@@ -1,0 +1,132 @@
+"""Device mesh construction and carving.
+
+This is the TPU analogue of the reference's resource layer: where Harmony
+acquires a pool of N homogeneous REEF evaluators once at startup and shares
+them among all jobs (ref: jobserver/driver/ResourcePool.java:39-106,
+services/evalmanager/api/EvaluatorManager.java:39-73), the TPU build owns the
+pod's device list and hands out *mesh slices* to jobs. An "executor" maps to
+one device plus its host-side runtime state.
+
+Axis convention:
+  * ``data``  — batch (data-parallel) axis; gradients are summed across it.
+  * ``model`` — table-shard axis; table blocks live along it (the analogue of
+    block->server-executor placement, BlockManager.java:30-40).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def local_devices(n: Optional[int] = None) -> List[jax.Device]:
+    """First ``n`` JAX devices (all if n is None)."""
+    devs = list(jax.devices())
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(f"requested {n} devices, have {len(devs)}")
+        devs = devs[:n]
+    return devs
+
+
+def build_mesh(
+    devices: Sequence[jax.Device],
+    data: Optional[int] = None,
+    model: Optional[int] = None,
+) -> Mesh:
+    """Build a 2-D (data, model) mesh over ``devices``.
+
+    Defaults: all devices on the data axis (model axis size 1) — the pure
+    data-parallel shape. Either axis size may be given; the other is derived.
+    """
+    n = len(devices)
+    if data is None and model is None:
+        data, model = n, 1
+    elif data is None:
+        assert model is not None
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    elif model is None:
+        if n % data:
+            raise ValueError(f"{n} devices not divisible by data={data}")
+        model = n // data
+    if data * model != n:
+        raise ValueError(f"data*model={data * model} != num devices {n}")
+    arr = np.asarray(devices, dtype=object).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+class DevicePool:
+    """Thread-safe pool of devices carved into per-job slices.
+
+    The scheduling analogue of ResourcePool + EvaluatorManager: jobs request
+    ``n`` devices and get a contiguous slice; releasing returns them. The
+    default JobServer scheduler can also grant *all* devices to every job
+    (multi-tenant overlap, ref: SchedulerImpl.java:28-66) — overlap is
+    tracked so the TaskUnit scheduler knows which jobs share chips.
+    """
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None) -> None:
+        self._devices: List[jax.Device] = list(devices or jax.devices())
+        self._lock = threading.Lock()
+        self._leases: Dict[str, List[jax.Device]] = {}
+        self._exclusive: Dict[str, bool] = {}
+
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self._devices)
+
+    def __len__(self) -> int:
+        return len(self._devices)
+
+    def lease_all(self, job_id: str) -> List[jax.Device]:
+        """Grant every device (shared; may overlap other leases)."""
+        with self._lock:
+            devs = list(self._devices)
+            self._leases[job_id] = devs
+            self._exclusive[job_id] = False
+            return devs
+
+    def lease(self, job_id: str, n: int) -> List[jax.Device]:
+        """Grant ``n`` exclusive devices (no overlap with other *exclusive*
+        leases; shared lease_all leases coexist with anything)."""
+        with self._lock:
+            taken = {
+                d
+                for j, ds in self._leases.items()
+                if self._exclusive.get(j)
+                for d in ds
+            }
+            free = [d for d in self._devices if d not in taken]
+            if len(free) < n:
+                raise RuntimeError(f"need {n} devices, only {len(free)} free")
+            devs = free[:n]
+            self._leases[job_id] = devs
+            self._exclusive[job_id] = True
+            return devs
+
+    def release(self, job_id: str) -> None:
+        with self._lock:
+            self._leases.pop(job_id, None)
+            self._exclusive.pop(job_id, None)
+
+    def lease_of(self, job_id: str) -> List[jax.Device]:
+        with self._lock:
+            return list(self._leases.get(job_id, []))
+
+    def overlapping_jobs(self, job_id: str) -> List[str]:
+        """Jobs whose leases share at least one device with ``job_id``'s."""
+        with self._lock:
+            mine = set(self._leases.get(job_id, []))
+            return [
+                j
+                for j, ds in self._leases.items()
+                if j != job_id and mine.intersection(ds)
+            ]

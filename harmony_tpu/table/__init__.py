@@ -1,0 +1,16 @@
+from harmony_tpu.table.update import UpdateFunction, get_update_fn, register_update_fn
+from harmony_tpu.table.partition import BlockPartitioner, HashPartitioner, RangePartitioner
+from harmony_tpu.table.ownership import BlockManager
+from harmony_tpu.table.table import DenseTable, TableSpec
+
+__all__ = [
+    "UpdateFunction",
+    "get_update_fn",
+    "register_update_fn",
+    "BlockPartitioner",
+    "HashPartitioner",
+    "RangePartitioner",
+    "BlockManager",
+    "DenseTable",
+    "TableSpec",
+]

@@ -1,0 +1,127 @@
+"""Authoritative block -> executor ownership map.
+
+The reference keeps a driver-side ``BlockManager`` with the authoritative
+per-table block->executor map and even initial partitioning
+(driver/impl/BlockManager.java:30-40), an executor-side ``OwnershipCache``
+(evaluator/impl/OwnershipCache.java:51-318), and a ``SubscriptionManager``
+broadcasting ownership updates (driver/impl/SubscriptionManager.java:29-35).
+
+In the single-controller TPU build there is one process that both owns the
+map and launches device computations, so the cache/broadcast split collapses:
+this BlockManager *is* the authority, and "broadcast" is invoking registered
+listeners (which update table layouts / metric counters). The per-block
+read-write locking that protects accesses racing with migration
+(OwnershipCache.resolveExecutorWithLock, 140-153) maps to the table-level
+migration latch in DenseTable.reshard: accessors are host-serialized against
+layout flips, while on-device steps always run against an immutable snapshot
+array (functional state), which is what makes in-flight steps safe by
+construction.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Sequence
+
+OwnershipListener = Callable[[str, List[int]], None]  # (table_id, block_to_executor)
+
+
+class BlockManager:
+    """Per-table block ownership with even initial partitioning."""
+
+    def __init__(self, table_id: str, num_blocks: int, executors: Sequence[str]) -> None:
+        if not executors:
+            raise ValueError("need at least one executor")
+        self.table_id = table_id
+        self.num_blocks = num_blocks
+        self._lock = threading.RLock()
+        self._executors: List[str] = list(executors)
+        # Even round-robin partitioning over associated executors
+        # (ref: BlockManager even initial partitioning).
+        self._owner: List[int] = [b % len(executors) for b in range(num_blocks)]
+        self._listeners: List[OwnershipListener] = []
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def executors(self) -> List[str]:
+        with self._lock:
+            return list(self._executors)
+
+    def owner_of(self, block_id: int) -> str:
+        with self._lock:
+            return self._executors[self._owner[block_id]]
+
+    def blocks_of(self, executor: str) -> List[int]:
+        with self._lock:
+            idx = self._executors.index(executor)
+            return [b for b, o in enumerate(self._owner) if o == idx]
+
+    def block_counts(self) -> Dict[str, int]:
+        with self._lock:
+            counts = {e: 0 for e in self._executors}
+            for o in self._owner:
+                counts[self._executors[o]] += 1
+            return counts
+
+    def ownership_vector(self) -> List[int]:
+        with self._lock:
+            return list(self._owner)
+
+    # -- mutation --------------------------------------------------------
+
+    def subscribe(self, listener: OwnershipListener) -> None:
+        """Register an ownership-update listener (ref: SubscriptionManager)."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    def _notify_locked(self) -> None:
+        """Fire listeners with a consistent snapshot. Must be called with the
+        lock held so concurrent mutators can't interleave stale snapshots out
+        of order (listeners may re-enter the manager: RLock)."""
+        snapshot = list(self._owner)
+        for l in list(self._listeners):
+            l(self.table_id, snapshot)
+
+    def associate(self, executor: str) -> None:
+        """Add an executor as a potential owner (no blocks moved yet)."""
+        with self._lock:
+            if executor in self._executors:
+                raise ValueError(f"{executor} already associated")
+            self._executors.append(executor)
+
+    def unassociate(self, executor: str) -> None:
+        """Remove an executor; it must no longer own blocks."""
+        with self._lock:
+            idx = self._executors.index(executor)
+            if any(o == idx for o in self._owner):
+                raise ValueError(f"{executor} still owns blocks")
+            self._executors.pop(idx)
+            self._owner = [o - 1 if o > idx else o for o in self._owner]
+            self._notify_locked()
+
+    def move(self, src: str, dst: str, num_blocks: int) -> List[int]:
+        """Reassign ``num_blocks`` blocks src -> dst; returns moved block ids
+        (ref: AllocatedTable.moveBlocks -> MigrationManager)."""
+        with self._lock:
+            si = self._executors.index(src)
+            di = self._executors.index(dst)
+            owned = [b for b, o in enumerate(self._owner) if o == si]
+            if len(owned) < num_blocks:
+                raise ValueError(
+                    f"{src} owns only {len(owned)} blocks, asked to move {num_blocks}"
+                )
+            moved = owned[:num_blocks]
+            for b in moved:
+                self._owner[b] = di
+            self._notify_locked()
+        return moved
+
+    def rebalance(self, executors: Sequence[str]) -> None:
+        """Repartition all blocks evenly over ``executors`` (used when the
+        executor set changes wholesale, e.g. mesh grow/shrink)."""
+        if not executors:
+            raise ValueError("need at least one executor")
+        with self._lock:
+            self._executors = list(executors)
+            self._owner = [b % len(executors) for b in range(self.num_blocks)]
+            self._notify_locked()

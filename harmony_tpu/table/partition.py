@@ -1,0 +1,70 @@
+"""Key -> (block, offset) partitioning, jit-traceable.
+
+The reference partitions keys to blocks with a hash partitioner for unordered
+tables and a range partitioner for ordered ones (ref: evaluator/impl/
+HashBasedBlockPartitioner.java, OrderingBasedBlockPartitioner.java, selected
+by ``IsOrderedTable``, TableConfiguration.java:42-45). Block id is the unit of
+placement and migration.
+
+On TPU the partitioner must additionally be a *pure index computation* usable
+inside jit: every key maps to a (block, offset) pair addressing the dense
+block-major storage ``[num_blocks, block_size, ...]``.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+class BlockPartitioner:
+    """key -> (block_id, offset) over a fixed key space [0, capacity)."""
+
+    def __init__(self, capacity: int, num_blocks: int) -> None:
+        if num_blocks > capacity:
+            raise ValueError(
+                f"num_blocks={num_blocks} > capacity={capacity}; "
+                "TableConfig clamps this — construct partitioners from a config"
+            )
+        self.capacity = capacity
+        self.num_blocks = num_blocks
+        # ceil-div: last block may be partially used; storage pads to uniform
+        # block_size so shapes stay static.
+        self.block_size = -(-capacity // num_blocks)
+
+    def locate(self, keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def key_of(self, blocks: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+        """Inverse of :meth:`locate` (needed to init storage cells by key)."""
+        raise NotImplementedError
+
+
+class RangePartitioner(BlockPartitioner):
+    """Contiguous key ranges per block (ordered tables): block = key // bs.
+
+    Keeps adjacent keys in one block, so a contiguous pull is a contiguous
+    slice — the layout that makes full-model pulls a plain all-gather.
+    """
+
+    def locate(self, keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        keys = jnp.asarray(keys, dtype=jnp.int32)
+        return keys // self.block_size, keys % self.block_size
+
+    def key_of(self, blocks: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+        return blocks * self.block_size + offsets
+
+
+class HashPartitioner(BlockPartitioner):
+    """Interleaved placement (unordered tables): block = key % num_blocks.
+
+    Spreads a hot contiguous key range across all blocks/owners, the same
+    load-spreading role as the reference's hash partitioner.
+    """
+
+    def locate(self, keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        keys = jnp.asarray(keys, dtype=jnp.int32)
+        return keys % self.num_blocks, keys // self.num_blocks
+
+    def key_of(self, blocks: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+        return offsets * self.num_blocks + blocks

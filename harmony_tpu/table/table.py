@@ -1,0 +1,318 @@
+"""DenseTable — the elastic sharded model table, TPU-first.
+
+This is the rebuild of the reference's Elastic Table (services/et): the
+parameter-server role is played entirely by the table (SURVEY.md §1: servers
+run a do-nothing tasklet while the table's UpdateFunction applies pushes,
+dolphin/core/server/ServerTasklet.java:29-41). Capabilities reproduced:
+
+  * key space partitioned into ``num_blocks`` blocks, hash- or range-based
+    (ref: TableImpl routing, evaluator/impl/TableImpl.java:109-143);
+  * pull = getOrInit/multiGetOrInit, push = update/multiUpdate with
+    server-side UpdateFunction semantics (ref: ETModelAccessor.java:60-146);
+  * live re-sharding across a changed executor/device set (ref:
+    MigrationExecutor.java) — here an XLA resharding ``jax.device_put`` onto
+    a new mesh, with a host-side latch standing in for the per-block
+    ownership read-locks (OwnershipCache.java:140-153);
+  * per-block export/import for two-stage checkpointing (ref:
+    ChkpManagerSlave.java:50-63).
+
+Architecture (deliberately NOT a translation):
+
+  Storage is ONE dense jax array ``[num_blocks, block_size, *value_shape]``
+  sharded over the mesh's "model" axis with NamedSharding (block axis ==
+  placement axis, so a block maps to a chip the way a reference block maps to
+  a server executor). Replication across the "data" axis gives every
+  data-parallel worker a local copy to pull from; pushes are XLA scatters
+  whose cross-shard traffic XLA lowers to collectives over ICI instead of
+  per-key RPCs (SURVEY.md §5.8 TPU-native equivalent).
+
+  All device state is functional: ops take the array, return a new array.
+  The host-side DenseTable object serializes commits; in-flight jitted steps
+  always see an immutable snapshot, which is what makes accesses racing with
+  migration safe by construction (the role of the reference's retry/redirect
+  protocol, RemoteAccessOpSender.java:132-163).
+"""
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.parallel.mesh import MODEL_AXIS
+from harmony_tpu.table.partition import (
+    BlockPartitioner,
+    HashPartitioner,
+    RangePartitioner,
+)
+from harmony_tpu.table.update import UpdateFunction, get_update_fn
+
+
+class TableSpec:
+    """Static description of a table + its pure on-device ops.
+
+    Separating the pure functions from the stateful host object lets trainers
+    inline ``pull``/``push`` into their own jitted train step (the fast path)
+    while DenseTable uses the same functions for its host-level API.
+    """
+
+    def __init__(self, config: TableConfig, update_fn: Optional[UpdateFunction] = None):
+        self.config = config
+        self.update_fn = update_fn or get_update_fn(config.update_fn)
+        part_cls = RangePartitioner if config.is_ordered else HashPartitioner
+        self.partitioner: BlockPartitioner = part_cls(config.capacity, config.num_blocks)
+        self.value_shape: Tuple[int, ...] = tuple(config.value_shape)
+        self.dtype = jnp.dtype(config.dtype)
+
+    @property
+    def table_id(self) -> str:
+        return self.config.table_id
+
+    @property
+    def num_blocks(self) -> int:
+        return self.partitioner.num_blocks
+
+    @property
+    def block_size(self) -> int:
+        return self.partitioner.block_size
+
+    @property
+    def storage_shape(self) -> Tuple[int, ...]:
+        return (self.num_blocks, self.block_size, *self.value_shape)
+
+    # -- pure ops (safe inside any jit) ---------------------------------
+
+    def init_array(self) -> jnp.ndarray:
+        """Materialize initial storage via the update fn's ``init(key)``
+        (getOrInit semantics: every key starts at its init value)."""
+        b = jnp.arange(self.num_blocks, dtype=jnp.int32)[:, None]
+        o = jnp.arange(self.block_size, dtype=jnp.int32)[None, :]
+        keys = self.partitioner.key_of(b, o).reshape(-1)
+        vals = jax.vmap(self.update_fn.init)(keys)
+        vals = jnp.broadcast_to(
+            vals.reshape(vals.shape[0], *([1] * len(self.value_shape))),
+            (keys.shape[0], *self.value_shape),
+        ) if vals.ndim == 1 and self.value_shape else vals
+        return vals.astype(self.dtype).reshape(self.storage_shape)
+
+    def pull(self, arr: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:
+        """multiGetOrInit: gather values for ``keys`` -> [n, *value_shape]."""
+        b, o = self.partitioner.locate(keys)
+        return arr[b, o]
+
+    def pull_all(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Whole table as ``[capacity, *value_shape]`` in key order (the
+        "pull the full model" fast path; only meaningful for range tables)."""
+        flat = arr.reshape(self.num_blocks * self.block_size, *self.value_shape)
+        if isinstance(self.partitioner, RangePartitioner):
+            return flat[: self.config.capacity]
+        keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
+        return self.pull(arr, keys)
+
+    def push(self, arr: jnp.ndarray, keys: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+        """multiUpdate: fold ``deltas`` into the table (one XLA scatter;
+        duplicate keys fold per the update fn's scatter_mode)."""
+        b, o = self.partitioner.locate(keys)
+        ref = arr.at[b, o]
+        mode = self.update_fn.scatter_mode
+        if mode == "add":
+            return ref.add(deltas.astype(arr.dtype))
+        if mode == "min":
+            return ref.min(deltas.astype(arr.dtype))
+        if mode == "max":
+            return ref.max(deltas.astype(arr.dtype))
+        if mode == "set":
+            return ref.set(deltas.astype(arr.dtype))
+        raise ValueError(f"unknown scatter_mode {mode!r}")
+
+    def write_all(self, arr: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
+        """Overwrite the whole table from ``[capacity, *value_shape]`` in key
+        order (push_all for assign-style bulk updates / restores)."""
+        pad = self.num_blocks * self.block_size - self.config.capacity
+        if isinstance(self.partitioner, RangePartitioner):
+            flat = jnp.concatenate(
+                [values, jnp.zeros((pad, *self.value_shape), values.dtype)]
+            ) if pad else values
+            return flat.reshape(self.storage_shape).astype(self.dtype)
+        keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
+        b, o = self.partitioner.locate(keys)
+        return arr.at[b, o].set(values.astype(self.dtype))
+
+
+class DenseTable:
+    """Host-side handle: stateful commits, sharding, re-sharding, checkpoint.
+
+    Mirrors the union of the reference's ``Table`` (evaluator/api/Table.java:
+    46-221, the op surface) and ``AllocatedTable`` (driver/api/
+    AllocatedTable.java:38-154, the master-side lifecycle handle) — one
+    object, because single-controller JAX has no evaluator/driver split.
+    """
+
+    def __init__(self, spec: TableSpec, mesh: Mesh, arr: Optional[jax.Array] = None):
+        self.spec = spec
+        self._lock = threading.RLock()
+        self._mesh = mesh
+        self._sharding = self._make_sharding(mesh)
+        if arr is None:
+            arr = jax.jit(spec.init_array, out_shardings=self._sharding)()
+        else:
+            arr = jax.device_put(arr, self._sharding)
+        self._arr: jax.Array = arr
+        self._jit_cache: Dict[str, Callable] = {}
+
+    # -- layout ----------------------------------------------------------
+
+    def _make_sharding(self, mesh: Mesh) -> NamedSharding:
+        model = mesh.shape.get(MODEL_AXIS, 1)
+        if self.spec.num_blocks % max(model, 1) == 0 and MODEL_AXIS in mesh.axis_names:
+            return NamedSharding(mesh, P(MODEL_AXIS))
+        # Fallback: replicate (tiny tables / indivisible block counts).
+        return NamedSharding(mesh, P())
+
+    @property
+    def mesh(self) -> Mesh:
+        with self._lock:
+            return self._mesh
+
+    @property
+    def sharding(self) -> NamedSharding:
+        with self._lock:
+            return self._sharding
+
+    @property
+    def array(self) -> jax.Array:
+        """Immutable snapshot of current storage (safe to close over in jit)."""
+        with self._lock:
+            return self._arr
+
+    def commit(self, new_arr: jax.Array) -> None:
+        """Install the post-step storage (the trainer fast path: a jitted
+        train step returns the updated table array; committing it is the
+        moment the push becomes visible, like the reference's server-side
+        update application).
+
+        If a reshard happened while the step was in flight, the step's result
+        still carries the OLD layout — re-home it so the table never holds
+        devices that were released back to the pool.
+        """
+        with self._lock:
+            if new_arr.sharding != self._sharding:
+                new_arr = jax.device_put(new_arr, self._sharding)
+            self._arr = new_arr
+
+    # -- op surface (host-level; parity with Table.java) ----------------
+
+    def _jitted(self, name: str, fn: Callable) -> Callable:
+        with self._lock:
+            if name not in self._jit_cache:
+                self._jit_cache[name] = jax.jit(fn)
+            return self._jit_cache[name]
+
+    def multi_get(self, keys: Sequence[int]) -> np.ndarray:
+        k = jnp.asarray(keys, dtype=jnp.int32)
+        return np.asarray(self._jitted("pull", self.spec.pull)(self.array, k))
+
+    def get(self, key: int) -> np.ndarray:
+        return self.multi_get([key])[0]
+
+    # getOrInit == get: storage is eagerly init'ed per key (see
+    # TableSpec.init_array), so absent keys already hold init values.
+    get_or_init = get
+    multi_get_or_init = multi_get
+
+    def multi_update(self, keys: Sequence[int], deltas: np.ndarray) -> None:
+        k = jnp.asarray(keys, dtype=jnp.int32)
+        d = jnp.asarray(deltas)
+        with self._lock:
+            self._arr = self._jitted("push", self.spec.push)(self._arr, k, d)
+
+    def update(self, key: int, delta: np.ndarray) -> None:
+        self.multi_update([key], jnp.asarray(delta)[None])
+
+    # Fire-and-forget variants: jax dispatch is already async; parity alias
+    # (ref: Table.updateNoReply / multiUpdateNoReply).
+    update_no_reply = update
+    multi_update_no_reply = multi_update
+
+    def put(self, key: int, value: np.ndarray) -> np.ndarray:
+        """Set, returning the previous value (ref: Table.put returns old).
+        Read-old and write-new happen under one lock acquisition so a racing
+        update can't fall between them."""
+        k = jnp.asarray([key], dtype=jnp.int32)
+        v = jnp.asarray(value)[None]
+
+        def _put(a, kk, vv):
+            b, o = self.spec.partitioner.locate(kk)
+            return a[b, o], a.at[b, o].set(vv.astype(a.dtype))
+
+        put_fn = self._jitted("put", _put)
+        with self._lock:
+            old, self._arr = put_fn(self._arr, k, v)
+        return np.asarray(old)[0]
+
+    def remove(self, key: int) -> np.ndarray:
+        """Reset a key to its init value, returning the removed value."""
+        init_v = jax.vmap(self.spec.update_fn.init)(jnp.asarray([key], jnp.int32))
+        init_v = jnp.broadcast_to(
+            init_v.reshape(1, *([1] * len(self.spec.value_shape))),
+            (1, *self.spec.value_shape),
+        ) if init_v.ndim == 1 and self.spec.value_shape else init_v
+        return self.put(key, np.asarray(init_v[0]))
+
+    def pull_array(self) -> jax.Array:
+        """Full table in key order (device array; stays sharded until used)."""
+        return self._jitted("pull_all", self.spec.pull_all)(self.array)
+
+    # -- re-sharding (the migration path) --------------------------------
+
+    def reshard(self, new_mesh: Mesh) -> None:
+        """Move the table onto a new mesh (executor add/remove / mesh carve).
+
+        The reference's ownership-first migration (MigrationExecutor.java:
+        163-253) exists to keep per-key RPCs correct while blocks move. Here
+        the whole move is one XLA resharding: under the lock we (1) flip the
+        layout ("ownership first"), (2) device_put — XLA moves bytes over
+        ICI, (3) release the lock (the access latch). Host accessors block
+        for the duration; in-flight jitted steps run on the pre-move snapshot
+        and their commit lands on the new layout via sharding constraint at
+        next dispatch.
+        """
+        with self._lock:
+            self._mesh = new_mesh
+            self._sharding = self._make_sharding(new_mesh)
+            self._arr = jax.device_put(self._arr, self._sharding)
+            self._jit_cache.clear()
+
+    # -- per-block IO (checkpoint path) ----------------------------------
+
+    def export_blocks(self, block_ids: Optional[Sequence[int]] = None) -> Dict[int, np.ndarray]:
+        """Materialize blocks to host memory (ref: ChkpManagerSlave writes
+        local blocks to per-block files, evaluator/impl/ChkpManagerSlave.java)."""
+        arr = self.array
+        ids = range(self.spec.num_blocks) if block_ids is None else block_ids
+        return {int(b): np.asarray(arr[int(b)]) for b in ids}
+
+    def import_blocks(self, blocks: Dict[int, np.ndarray]) -> None:
+        """Install block payloads (restore path; tolerates any topology —
+        data is re-inserted through normal table writes like the reference's
+        restore, ChkpManagerMaster.java:49-61)."""
+        if not blocks:
+            return
+        ids = jnp.asarray(sorted(blocks), dtype=jnp.int32)
+        payload = jnp.asarray(np.stack([blocks[int(b)] for b in sorted(blocks)]))
+        set_blocks = self._jitted(
+            "import_blocks", lambda a, i, p: a.at[i].set(p.astype(a.dtype))
+        )
+        with self._lock:
+            self._arr = set_blocks(self._arr, ids, payload)
+
+    def drop(self) -> None:
+        """Release storage (ref: AllocatedTable.drop)."""
+        with self._lock:
+            self._arr.delete()
+            self._jit_cache.clear()

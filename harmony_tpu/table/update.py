@@ -1,0 +1,100 @@
+"""Server-side update semantics as jittable reducers.
+
+The reference lets each table bind an ``UpdateFunction`` with
+``initValue(key)`` / ``updateValue(old, delta)`` applied at the owner
+executor on every push (ref: services/et/.../evaluator/api/UpdateFunction.java;
+applied in RemoteAccessOpHandler.java:204-211). On TPU the same semantics must
+stay on-device inside the jitted step (SURVEY.md §7.3), so an update function
+here is three pure jax-traceable pieces:
+
+  * ``init(key) -> value``        — value for a key never written
+    (getOrInit semantics, Table.java getOrInit).
+  * ``combine(d1, d2) -> d``      — fold two deltas destined for the same key
+    into one. Needed because a scatter with duplicate keys must pre-combine;
+    the reference applies duplicates sequentially, which for its apps is
+    always an associative fold (vector add).
+  * ``apply(old, d) -> new``      — the reference's ``updateValue``.
+
+All three are vmapped/scattered by DenseTable; they must be shape-polymorphic
+over the value shape.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateFunction:
+    name: str
+    init: Callable[[jnp.ndarray], jnp.ndarray]        # key (int32 scalar) -> value
+    combine: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+    apply: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+    # How a batched push folds into the table on-device. XLA scatter natively
+    # handles duplicate indices for these modes, so the whole push is ONE
+    # scatter op (no host-side duplicate pre-combining needed):
+    #   "add" -> at[].add, "min" -> at[].min, "max" -> at[].max,
+    #   "set" -> at[].set (duplicate order unspecified, like concurrent puts).
+    scatter_mode: str = "add"
+
+
+_REGISTRY: Dict[str, UpdateFunction] = {}
+
+
+def register_update_fn(fn: UpdateFunction) -> UpdateFunction:
+    _REGISTRY[fn.name] = fn
+    return fn
+
+
+def get_update_fn(name: str) -> UpdateFunction:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown update fn {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+# The workhorse: push = accumulate deltas (all Dolphin apps use vector add,
+# e.g. AddVectorET's updateFunction and NMF/MLR gradient pushes).
+register_update_fn(
+    UpdateFunction(
+        name="add",
+        init=lambda key: jnp.zeros(()),  # shape fixed up by the table's init broadcast
+        combine=jnp.add,
+        apply=jnp.add,
+    )
+)
+
+# Overwrite semantics (put-like update; used by local-model tables).
+register_update_fn(
+    UpdateFunction(
+        name="assign",
+        init=lambda key: jnp.zeros(()),
+        combine=lambda d1, d2: d2,
+        apply=lambda old, d: d,
+        scatter_mode="set",
+    )
+)
+
+# Min/max folds (used by graph apps, e.g. shortest path relaxations).
+register_update_fn(
+    UpdateFunction(
+        name="min",
+        init=lambda key: jnp.array(jnp.inf),
+        combine=jnp.minimum,
+        apply=jnp.minimum,
+        scatter_mode="min",
+    )
+)
+register_update_fn(
+    UpdateFunction(
+        name="max",
+        init=lambda key: jnp.array(-jnp.inf),
+        combine=jnp.maximum,
+        apply=jnp.maximum,
+        scatter_mode="max",
+    )
+)

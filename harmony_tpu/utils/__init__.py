@@ -1,0 +1,4 @@
+from harmony_tpu.utils.dag import DAG, CyclicDependencyError
+from harmony_tpu.utils.statemachine import StateMachine, IllegalTransitionError
+
+__all__ = ["DAG", "CyclicDependencyError", "StateMachine", "IllegalTransitionError"]

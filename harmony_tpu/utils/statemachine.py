@@ -1,0 +1,65 @@
+"""Declarative finite state machine for component lifecycles.
+
+Capability parity with the reference's ``utils/StateMachine.java`` (304 LoC),
+which drives driver/worker lifecycles (e.g. JobServerDriver NOT_INIT/INIT/
+CLOSED, WorkerStateManager INIT/RUN/CLEANUP). Thread-safe; supports waiting
+for a state.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+
+class IllegalTransitionError(Exception):
+    pass
+
+
+class StateMachine:
+    def __init__(
+        self,
+        states: Iterable[str],
+        transitions: Iterable[Tuple[str, str]],
+        initial: str,
+    ) -> None:
+        self._states: Set[str] = set(states)
+        if initial not in self._states:
+            raise ValueError(f"unknown initial state {initial!r}")
+        self._transitions: Dict[str, Set[str]] = {}
+        for src, dst in transitions:
+            if src not in self._states or dst not in self._states:
+                raise ValueError(f"transition {src!r}->{dst!r} uses unknown state")
+            self._transitions.setdefault(src, set()).add(dst)
+        self._state = initial
+        self._cond = threading.Condition()
+
+    @property
+    def state(self) -> str:
+        with self._cond:
+            return self._state
+
+    def is_state(self, state: str) -> bool:
+        return self.state == state
+
+    def transition(self, dst: str) -> None:
+        with self._cond:
+            if dst not in self._transitions.get(self._state, ()):  # pragma: no branch
+                raise IllegalTransitionError(f"{self._state!r} -> {dst!r} not allowed")
+            self._state = dst
+            self._cond.notify_all()
+
+    def compare_and_transition(self, expected: str, dst: str) -> bool:
+        """Transition only if currently in ``expected``; returns success."""
+        with self._cond:
+            if self._state != expected:
+                return False
+            if dst not in self._transitions.get(self._state, ()):
+                raise IllegalTransitionError(f"{self._state!r} -> {dst!r} not allowed")
+            self._state = dst
+            self._cond.notify_all()
+            return True
+
+    def wait_for(self, state: str, timeout: Optional[float] = None) -> bool:
+        """Block until the machine reaches ``state``; returns False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._state == state, timeout=timeout)

@@ -1,0 +1,49 @@
+"""Test environment: simulate an 8-device TPU mesh on CPU.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-"executor"
+protocol tests in one process. Here the fake cluster is XLA's virtual CPU
+device feature — 8 devices in one process — so every sharding/collective
+path runs exactly as it would on an 8-chip slice.
+
+Must run before anything imports jax.
+"""
+import os
+
+# Force CPU even if the ambient environment points JAX at real TPU hardware:
+# the test suite needs a *multi*-device mesh, and the dev box has one chip.
+# jax may already be imported by sitecustomize, so the env-var route is not
+# enough — set both the env (for fresh interpreters the tests spawn) and the
+# live config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def mesh8(devices):
+    from harmony_tpu.parallel import build_mesh
+
+    return build_mesh(devices, data=2, model=4)
+
+
+@pytest.fixture()
+def mesh_dp(devices):
+    from harmony_tpu.parallel import build_mesh
+
+    return build_mesh(devices, data=8, model=1)

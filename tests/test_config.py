@@ -1,0 +1,48 @@
+"""Config system round-trip tests (the Tang serialize/ship/re-inject
+analogue; ref: AvroConfigurationSerializer usage in ETDolphinLauncher)."""
+from harmony_tpu.config import (
+    ConfigBase,
+    JobConfig,
+    TableConfig,
+    TrainerParams,
+    resolve_symbol,
+    symbol_name,
+)
+
+
+def test_table_config_roundtrip():
+    tc = TableConfig(
+        table_id="model",
+        capacity=7840,
+        value_shape=(10,),
+        num_blocks=64,
+        is_ordered=True,
+        update_fn="add",
+    )
+    back = ConfigBase.from_json(tc.to_json())
+    assert back == tc
+    assert back.value_shape == (10,)
+
+
+def test_job_config_nested_roundtrip():
+    jc = JobConfig(
+        job_id="mlr-0",
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        tables=[
+            TableConfig(table_id="model", capacity=100, value_shape=(4,), num_blocks=8),
+            TableConfig(table_id="input", capacity=1000, num_blocks=16, is_ordered=False),
+        ],
+        params=TrainerParams(num_epochs=3, num_mini_batches=5, clock_slack=2),
+    )
+    back = ConfigBase.from_json(jc.to_json())
+    assert back == jc
+    assert back.tables[1].is_ordered is False
+    assert back.params.clock_slack == 2
+
+
+def test_symbol_roundtrip():
+    import harmony_tpu.table.update as mod
+
+    path = symbol_name(mod.get_update_fn)
+    assert resolve_symbol(path) is mod.get_update_fn

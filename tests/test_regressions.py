@@ -1,0 +1,89 @@
+"""Regression tests for review findings on the v0 core."""
+import numpy as np
+import pytest
+
+from harmony_tpu.config import ConfigBase, JobConfig, TableConfig
+from harmony_tpu.parallel import DevicePool, build_mesh
+from harmony_tpu.table import BlockManager, DenseTable, TableSpec
+from harmony_tpu.utils import DAG
+
+
+def test_dag_remove_non_root_detaches_in_edges():
+    d = DAG()
+    d.add_vertex("a")
+    d.add_vertex("b")
+    d.add_edge("a", "b")
+    d.remove("b")  # non-root removal (op cancellation)
+    assert d.remove("a") == []  # must not KeyError on stale edge
+    assert len(d) == 0
+
+
+def test_device_pool_shared_lease_does_not_starve(devices):
+    pool = DevicePool(devices)
+    pool.lease_all("shared-job")
+    devs = pool.lease("excl-job", 2)  # must coexist with the shared lease
+    assert len(devs) == 2
+    assert pool.overlapping_jobs("excl-job") == ["shared-job"]
+    with pytest.raises(RuntimeError):
+        pool.lease("excl-job-2", 7)  # only 6 exclusive-free remain
+
+
+def test_block_manager_oversized_move_leaves_state_intact():
+    bm = BlockManager("t", 8, ["e0", "e1"])
+    before = bm.ownership_vector()
+    with pytest.raises(ValueError):
+        bm.move("e0", "e1", 5)  # e0 owns only 4
+    assert bm.ownership_vector() == before
+
+
+def test_config_user_dict_with_type_key_roundtrips():
+    jc = JobConfig(
+        job_id="j",
+        app_type="dolphin",
+        user={"_type": "TableConfig", "payload": [1, 2]},
+    )
+    back = ConfigBase.from_json(jc.to_json())
+    assert back.user == {"_type": "TableConfig", "payload": [1, 2]}
+    assert isinstance(back.user, dict)
+
+
+def test_num_blocks_clamped_in_config():
+    cfg = TableConfig(table_id="t", capacity=100)  # default blocks 1024 > 100
+    assert cfg.num_blocks == 100
+    spec = TableSpec(cfg)
+    assert spec.num_blocks == cfg.num_blocks  # config is source of truth
+
+
+def test_commit_rehomes_stale_sharding(devices):
+    mesh_a = build_mesh(devices[:4], data=1, model=4)
+    t = DenseTable(TableSpec(TableConfig(table_id="t", capacity=16, num_blocks=8)), mesh_a)
+    stale = t.array  # snapshot on mesh_a
+    mesh_b = build_mesh(devices[4:8], data=1, model=4)
+    t.reshard(mesh_b)
+    t.commit(stale + 1.0)  # in-flight step result carries mesh_a devices
+    used = {d for s in t.array.addressable_shards for d in [s.device]}
+    assert used <= set(devices[4:8]), "commit left data on released devices"
+    np.testing.assert_allclose(np.asarray(t.pull_array()), np.ones(16))
+
+
+def test_put_atomic_under_concurrency(devices):
+    import threading
+
+    mesh = build_mesh(devices[:4], data=1, model=4)
+    t = DenseTable(TableSpec(TableConfig(table_id="t", capacity=4, num_blocks=4)), mesh)
+    n_threads, n_iter = 4, 20
+    returned = []
+
+    def putter(tid):
+        for i in range(n_iter):
+            old = t.put(0, np.asarray(1.0, np.float32))
+            returned.append(float(old))
+
+    ths = [threading.Thread(target=putter, args=(i,)) for i in range(n_threads)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    # Every put sets 1.0; olds are 0.0 (first) then 1.0 — no torn values.
+    assert set(returned) <= {0.0, 1.0}
+    assert float(t.get(0)) == 1.0

@@ -1,0 +1,74 @@
+"""DAG + StateMachine unit tests (ref test analogues: DAGImplTest, state
+machine usage in WorkerStateManagerTest)."""
+import pytest
+
+from harmony_tpu.utils import DAG, CyclicDependencyError, IllegalTransitionError, StateMachine
+
+
+class TestDAG:
+    def test_ready_and_release(self):
+        d = DAG()
+        for v in "abcd":
+            d.add_vertex(v)
+        d.add_edge("a", "b")
+        d.add_edge("a", "c")
+        d.add_edge("b", "d")
+        d.add_edge("c", "d")
+        assert d.roots() == ["a"]
+        released = d.remove("a")
+        assert sorted(released) == ["b", "c"]
+        assert sorted(d.roots()) == ["b", "c"]
+        assert d.remove("b") == []  # d still blocked by c
+        assert d.remove("c") == ["d"]
+
+    def test_cycle_rejected(self):
+        d = DAG()
+        d.add_vertex(1)
+        d.add_vertex(2)
+        d.add_edge(1, 2)
+        with pytest.raises(CyclicDependencyError):
+            d.add_edge(2, 1)
+
+    def test_topological_order(self):
+        d = DAG()
+        for v in range(5):
+            d.add_vertex(v)
+        d.add_edge(0, 2)
+        d.add_edge(1, 2)
+        d.add_edge(2, 3)
+        d.add_edge(2, 4)
+        order = d.topological_order()
+        assert order.index(2) > order.index(0)
+        assert order.index(2) > order.index(1)
+        assert order.index(3) > order.index(2)
+        assert len(order) == 5
+
+
+class TestStateMachine:
+    def make(self):
+        return StateMachine(
+            states=["INIT", "RUN", "CLEANUP"],
+            transitions=[("INIT", "RUN"), ("RUN", "CLEANUP")],
+            initial="INIT",
+        )
+
+    def test_transitions(self):
+        sm = self.make()
+        assert sm.state == "INIT"
+        sm.transition("RUN")
+        assert sm.is_state("RUN")
+        with pytest.raises(IllegalTransitionError):
+            sm.transition("INIT")
+
+    def test_compare_and_transition(self):
+        sm = self.make()
+        assert not sm.compare_and_transition("RUN", "CLEANUP")
+        assert sm.compare_and_transition("INIT", "RUN")
+
+    def test_wait_for(self):
+        import threading
+
+        sm = self.make()
+        t = threading.Timer(0.05, lambda: sm.transition("RUN"))
+        t.start()
+        assert sm.wait_for("RUN", timeout=2.0)
