@@ -1,0 +1,3 @@
+"""ML applications — parity targets from the reference's mlapps/ and
+examples/ trees (SURVEY.md §2.7): MLR, NMF, LDA, Lasso, GBT and the
+AddInteger/AddVector correctness apps, plus new TPU-era additions."""

@@ -1,0 +1,80 @@
+"""AddVector / AddInteger — exact-sum correctness apps.
+
+Parity with the reference's validator apps (examples/addvector/
+AddVectorTrainer.java, examples/addinteger/AddIntegerTrainer.java and the
+ET-level ValidatorTask): every example contributes a fixed delta to every
+model key; at job end the expected value of each key is exactly
+
+    total_examples_processed * delta
+
+summed across ALL workers — which is precisely what validates that no push
+is lost or double-applied, including across live migrations (these apps are
+what OwnershipFirstMigrationTest trains while forcing re-sharding).
+
+The per-example contribution is realized as a sum over the (data-sharded)
+batch axis so the cross-worker aggregation goes through the same XLA
+reduction path real gradient pushes use.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.dolphin.trainer import Trainer
+
+
+class AddVectorTrainer(Trainer):
+    pull_mode = "all"
+
+    def __init__(self, num_keys: int, vector_dim: int, delta: float = 1.0) -> None:
+        self.num_keys = num_keys
+        self.vector_dim = vector_dim
+        self.delta = delta
+
+    def model_table_config(self, table_id: str = "addvector-model") -> TableConfig:
+        return TableConfig(
+            table_id=table_id,
+            capacity=self.num_keys,
+            value_shape=(self.vector_dim,),
+            num_blocks=min(self.num_keys, 16),
+            update_fn="add",
+        )
+
+    def compute(
+        self,
+        model: jnp.ndarray,
+        batch: Tuple[jnp.ndarray, ...],
+        hyper: Dict[str, jnp.ndarray],
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        (marks,) = batch  # [B] of 1.0 per example
+        count = jnp.sum(marks)  # contraction over sharded batch -> reduction
+        delta = jnp.ones_like(model) * (count * self.delta)
+        return delta, {"pushed": count}
+
+    def expected_value(self, total_examples: int) -> float:
+        return total_examples * self.delta
+
+
+class AddIntegerTrainer(AddVectorTrainer):
+    """Scalar-valued variant (ref: AddIntegerTrainer; the ET example runs
+    2 servers / 2 workers / 128 updates and asserts the exact total)."""
+
+    def __init__(self, num_keys: int, delta: float = 1.0) -> None:
+        super().__init__(num_keys, vector_dim=0, delta=delta)
+
+    def model_table_config(self, table_id: str = "addint-model") -> TableConfig:
+        return TableConfig(
+            table_id=table_id,
+            capacity=self.num_keys,
+            value_shape=(),
+            num_blocks=min(self.num_keys, 16),
+            update_fn="add",
+        )
+
+
+def make_marks(n: int) -> Tuple[np.ndarray]:
+    """The input set: one 1.0 mark per example."""
+    return (np.ones(n, np.float32),)

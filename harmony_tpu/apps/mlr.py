@@ -1,0 +1,124 @@
+"""Multinomial logistic regression — the benchmark flagship.
+
+Capability parity with the reference's MLR app (mlapps/mlr/MLRTrainer.java,
+522 LoC: softmax regression with the model stored as numClasses x
+featuresPerPartition vectors in the model table; submit_mlr.sh's example
+scale is 10 classes x 784 features, 392 features/partition).
+
+Model layout here is identical at the table level: key = class_idx *
+num_partitions + partition_idx, value = one feature partition of that class's
+weight row. The whole-model pull reshapes to the [C, D] weight matrix; the
+compute is one fused softmax-CE step on the MXU; the push folds -lr * grad
+back into the table.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.config.params import TableConfig, TrainerParams
+from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+
+
+class MLRTrainer(Trainer):
+    pull_mode = "all"
+
+    def __init__(
+        self,
+        num_classes: int,
+        num_features: int,
+        features_per_partition: int,
+        step_size: float = 0.1,
+        decay_rate: float = 0.9,
+        decay_period: int = 5,
+    ) -> None:
+        if num_features % features_per_partition:
+            raise ValueError("num_features must divide into partitions")
+        self.num_classes = num_classes
+        self.num_features = num_features
+        self.fpp = features_per_partition
+        self.num_partitions = num_features // features_per_partition
+        self.step_size = step_size
+        self.decay_rate = decay_rate
+        self.decay_period = decay_period
+        self._lr = step_size
+
+    # -- table schema ----------------------------------------------------
+
+    def model_table_config(self, table_id: str = "mlr-model", num_blocks: int = 0) -> TableConfig:
+        cap = self.num_classes * self.num_partitions
+        return TableConfig(
+            table_id=table_id,
+            capacity=cap,
+            value_shape=(self.fpp,),
+            num_blocks=num_blocks or min(cap, 64),
+            is_ordered=True,
+            update_fn="add",
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
+        # Step-size decay (ref: MLRTrainer decay via DecayRate/DecayPeriod
+        # DolphinParameters). Reaches the compiled step via hyperparams().
+        if self.decay_period and (epoch_idx + 1) % self.decay_period == 0:
+            self._lr *= self.decay_rate
+
+    def hyperparams(self) -> Dict[str, float]:
+        return {"lr": self._lr}
+
+    # -- pure compute -----------------------------------------------------
+
+    def _weights(self, model: jnp.ndarray) -> jnp.ndarray:
+        """[capacity, fpp] table rows -> [C, D] weight matrix."""
+        return model.reshape(self.num_classes, self.num_features)
+
+    def compute(
+        self,
+        model: jnp.ndarray,
+        batch: Tuple[jnp.ndarray, jnp.ndarray],
+        hyper: Dict[str, jnp.ndarray],
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        x, y = batch  # x: [B, D] float, y: [B] int
+        w = self._weights(model)
+        x = x.astype(jnp.float32)
+        logits = x @ w.T                                   # [B, C] (MXU)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=logits.dtype)
+        loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        # grad wrt w: contraction over the (data-sharded) batch axis — XLA
+        # inserts the cross-chip reduction here (the "push aggregation").
+        probs = jnp.exp(logp)
+        grad_w = (probs - onehot).T @ x / x.shape[0]       # [C, D]
+        delta = (-hyper["lr"] * grad_w).reshape(model.shape)
+        return delta, {"loss": loss, "accuracy": acc}
+
+    def evaluate(
+        self, model: jnp.ndarray, batch: Tuple[jnp.ndarray, jnp.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        x, y = batch
+        w = self._weights(model)
+        logits = x.astype(jnp.float32) @ w.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=logits.dtype)
+        return {
+            "loss": -jnp.mean(jnp.sum(onehot * logp, axis=-1)),
+            "accuracy": jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32)),
+        }
+
+
+def make_synthetic(
+    n: int, num_features: int, num_classes: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Linearly-separable-ish synthetic set (the reference ships sample_mlr
+    data files; we generate at the same shapes)."""
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(size=(num_classes, num_features)).astype(np.float32)
+    x = rng.normal(size=(n, num_features)).astype(np.float32)
+    logits = x @ true_w.T + 0.1 * rng.normal(size=(n, num_classes))
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return x, y

@@ -1,0 +1,12 @@
+from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+from harmony_tpu.dolphin.data import TrainingDataProvider
+from harmony_tpu.dolphin.accessor import ModelAccessor
+from harmony_tpu.dolphin.worker import WorkerTasklet
+
+__all__ = [
+    "Trainer",
+    "TrainerContext",
+    "TrainingDataProvider",
+    "ModelAccessor",
+    "WorkerTasklet",
+]

@@ -1,0 +1,52 @@
+"""ModelAccessor — push/pull facade with phase timing.
+
+Parity with the reference's ModelAccessor / ETModelAccessor (dolphin/core/
+worker/ModelAccessor.java:29-77, ETModelAccessor.java:43-157): pull =
+getOrInit against the model table, push = update, with pull/push tracers
+feeding metrics (totalPullTimeSec/totalPushTimeSec, the numbers BASELINE.md
+says become all-gather / reduce-scatter time on TPU).
+
+Used by the host-driven (irregular/sparse) path. The dense SPMD fast path
+fuses pull+push into the jitted step (see worker.py) and charges the whole
+step to COMP — the accessor still reports zeros for pull/push then, matching
+how a fused step genuinely has no separable phases.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from harmony_tpu.metrics.tracer import Tracer
+from harmony_tpu.table.table import DenseTable
+
+
+class ModelAccessor:
+    def __init__(self, table: DenseTable) -> None:
+        self._table = table
+        self.pull_tracer = Tracer()
+        self.push_tracer = Tracer()
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        self.pull_tracer.start()
+        vals = self._table.multi_get_or_init(keys)
+        self.pull_tracer.record(len(keys), block_on=None)
+        return vals
+
+    def pull_all(self) -> np.ndarray:
+        self.pull_tracer.start()
+        arr = self._table.pull_array()
+        out = np.asarray(arr)
+        self.pull_tracer.record(out.shape[0], block_on=None)
+        return out
+
+    def push(self, keys: Sequence[int], deltas: np.ndarray) -> None:
+        self.push_tracer.start()
+        self._table.multi_update(keys, deltas)
+        self.push_tracer.record(len(keys))
+
+    def get_and_reset_times(self) -> tuple:
+        pull, push = self.pull_tracer.total_sec, self.push_tracer.total_sec
+        self.pull_tracer.reset()
+        self.push_tracer.reset()
+        return pull, push

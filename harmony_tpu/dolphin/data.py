@@ -1,0 +1,63 @@
+"""Training data provisioning: epoch = local partition, mini-batch = block.
+
+Parity with ETTrainingDataProvider (dolphin/core/worker/
+ETTrainingDataProvider.java:38-75): an epoch iterates the worker's local
+partition of the input table; one mini-batch is one block; the number of
+blocks per worker (NumWorkerBlocks) fixes the batch count.
+
+TPU-first realization: the input set is host numpy arrays (features/labels),
+pre-split into ``num_mini_batches`` equal blocks. In SPMD mode a "batch" is
+the *global* batch for one step — the framework shards it over the mesh data
+axis, so each chip (the analogue of one worker) sees its local slice, exactly
+like each reference worker seeing its local input blocks.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class TrainingDataProvider:
+    """Splits an in-memory dataset into per-epoch mini-batches."""
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        num_mini_batches: int,
+        shuffle_each_epoch: bool = False,
+        seed: int = 0,
+    ) -> None:
+        if not arrays:
+            raise ValueError("need at least one data array")
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all data arrays must share leading dim")
+        if num_mini_batches <= 0 or num_mini_batches > n:
+            raise ValueError(f"bad num_mini_batches={num_mini_batches} for n={n}")
+        # Trim to an equal split so every batch has a static shape (XLA
+        # recompiles on shape change; the reference tolerated ragged blocks,
+        # we deliberately don't).
+        self.batch_size = n // num_mini_batches
+        self.num_mini_batches = num_mini_batches
+        self._arrays = [a[: self.batch_size * num_mini_batches] for a in arrays]
+        self._shuffle = shuffle_each_epoch
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def num_examples(self) -> int:
+        return self.batch_size * self.num_mini_batches
+
+    @property
+    def is_shuffling(self) -> bool:
+        return self._shuffle
+
+    def epoch_batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield ``num_mini_batches`` tuples of per-batch arrays."""
+        idx = np.arange(self.num_examples)
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        for b in range(self.num_mini_batches):
+            sl = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            yield tuple(a[sl] for a in self._arrays)

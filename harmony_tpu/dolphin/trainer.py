@@ -1,0 +1,104 @@
+"""The Trainer SPI — the user-facing training contract.
+
+Capability parity with the reference's 4-phase Trainer API
+(dolphin/core/worker/Trainer.java:44-92):
+
+  reference                      harmony_tpu
+  ---------                      -----------
+  initGlobalSettings()           init_global_settings(ctx)
+  setMiniBatchData(data)         (framework passes the batch to compute)
+  pullModel(data)                pull mode: "all" or pull_keys(batch)
+  localCompute(data)             compute(model, batch) -> (delta, metrics)
+  pushUpdate()                   (framework pushes compute's delta)
+  onEpochFinished(epoch)         on_epoch_finished(ctx, epoch)
+  evaluateModel(in, test, table) evaluate(model, batch) -> metrics
+  cleanup()                      cleanup(ctx)
+
+TPU-first difference, and why the shape is not a translation: the reference
+runs pull/compute/push as three host-driven RPC phases. Here ``compute`` is a
+*pure jax function* so the framework can fuse PULL (gather/all-gather), COMP
+(MXU math), and PUSH (scatter / reduction) into ONE jitted, SPMD-sharded
+step — XLA inserts the cross-chip collectives that replace the reference's
+per-key RPCs. Phase identities survive (they are still announced to the
+TaskUnit scheduler for multi-job interleaving) but the hot loop is a single
+compiled program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.config.params import TrainerParams
+
+
+@dataclasses.dataclass
+class TrainerContext:
+    """What a trainer sees of the framework: its tables and hyper-params.
+
+    ``model_table`` is the PS table (the reference's model table on server
+    executors); ``local_table`` the optional worker-local table (ref:
+    DolphinJobEntity local-model table, e.g. NMF's L-matrix rows)."""
+
+    params: TrainerParams
+    model_table: Any = None          # DenseTable
+    local_table: Any = None          # DenseTable or None
+    worker_id: str = "worker-0"
+    num_workers: int = 1
+
+
+class Trainer:
+    """Base class; apps override the pure parts.
+
+    ``pull_mode`` selects the PULL realization:
+      * "all"  — the whole model is pulled each batch (MLR/Lasso/NMF-R style
+        whole-table pull; realized as all-gather of the sharded table).
+        ``compute`` receives ``model`` of shape [capacity, *value_shape].
+      * "keys" — ``pull_keys(batch)`` names the rows needed (sparse apps);
+        ``compute`` receives the gathered rows.
+    """
+
+    pull_mode: str = "all"
+
+    # -- lifecycle (host side) ------------------------------------------
+
+    def init_global_settings(self, ctx: TrainerContext) -> None:
+        """One-time setup before the first epoch (may push initial model
+        values into the table)."""
+
+    def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
+        """Per-epoch hook (host side; may adjust step size etc.)."""
+
+    def cleanup(self, ctx: TrainerContext) -> None:
+        """Final hook after the last epoch."""
+
+    # -- pure parts (traced into the fused step) ------------------------
+
+    def hyperparams(self) -> Dict[str, float]:
+        """Host-side hyper-parameters passed INTO the jitted step each epoch
+        (learning rate etc.). Values reach ``compute`` as traced scalars, so
+        per-epoch changes (decay in on_epoch_finished) take effect without
+        recompiling — a baked-in Python float would be a trace-time constant
+        and silently never decay."""
+        return {}
+
+    def pull_keys(self, batch: Any) -> jnp.ndarray:
+        """keys to pull for this batch (pull_mode == "keys" only)."""
+        raise NotImplementedError
+
+    def compute(
+        self, model: jnp.ndarray, batch: Any, hyper: Dict[str, jnp.ndarray]
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """The mini-batch computation. Returns ``(delta, metrics)`` where
+        ``delta`` matches ``model``'s shape and is folded into the table via
+        the table's update function (push). Must be jax-traceable.
+        ``hyper`` carries the values from :meth:`hyperparams`."""
+        raise NotImplementedError
+
+    def evaluate(
+        self, model: jnp.ndarray, batch: Any
+    ) -> Dict[str, jnp.ndarray]:
+        """Model evaluation on held-out data (ref: evaluateModel)."""
+        raise NotImplementedError

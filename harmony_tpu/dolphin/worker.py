@@ -1,0 +1,291 @@
+"""WorkerTasklet — the training hot loop.
+
+Parity with the reference's WorkerTasklet (dolphin/core/worker/
+WorkerTasklet.java:96-168): per epoch, per mini-batch the phases
+
+    SYNC  (mini-batch barrier, SSP gate)     -> barrier object
+    PULL  (model pull)                        \
+    COMP  (trainer local compute)              > ONE fused jitted SPMD step
+    PUSH  (push updates)                      /
+
+with per-batch and per-epoch metrics (WorkerTasklet.java:194-229).
+
+TPU-first: the three data phases compile into a single XLA program over the
+job's mesh — pull is the all-gather of the model-axis-sharded table, compute
+is MXU math over the data-axis-sharded batch, push is the delta fold whose
+batch-axis contraction XLA lowers to a cross-chip reduction. When no host
+decision is needed between batches, the WHOLE epoch further fuses into one
+``lax.scan`` dispatch (removes per-step host round-trips — measured 7x
+throughput on a remote-attached chip).
+
+Steps are dispatched through ``DenseTable.apply_step`` so buffer donation
+stays invisible to concurrent host accessors, and hyper-parameters enter the
+step as arguments so per-epoch decay reaches the compiled program.
+
+Phase boundaries still exist for scheduling: each batch announces its
+TaskUnits to the (optional) TaskUnit scheduler so concurrent jobs interleave
+compute-heavy and network-heavy spans (ref: LocalTaskUnitScheduler.java:
+83-102) — in fused mode the whole step is announced as COMP.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from harmony_tpu.dolphin.data import TrainingDataProvider
+from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, MetricCollector
+from harmony_tpu.parallel.mesh import DATA_AXIS
+
+
+class WorkerTasklet:
+    """Drives the training loop for one job over its mesh slice."""
+
+    def __init__(
+        self,
+        job_id: str,
+        ctx: TrainerContext,
+        trainer: Trainer,
+        data: TrainingDataProvider,
+        mesh: Mesh,
+        collector: Optional[MetricCollector] = None,
+        batch_barrier: Optional[Callable[[int], bool]] = None,
+        taskunit: Optional[Any] = None,
+        epoch_callback: Optional[Callable[[int], None]] = None,
+        starting_epoch: int = 0,
+    ) -> None:
+        self.job_id = job_id
+        self.ctx = ctx
+        self.trainer = trainer
+        self.data = data
+        self.mesh = mesh
+        self.collector = collector or MetricCollector()
+        # batch_barrier(batch_idx) -> stop_flag (ref: MiniBatchBarrier.await
+        # returning the master's stop decision, MiniBatchBarrier.java:28-60).
+        self.batch_barrier = batch_barrier
+        self.taskunit = taskunit
+        self.epoch_callback = epoch_callback
+        self.starting_epoch = starting_epoch  # resume (ref: StartingEpochIdx)
+        self._step = None
+        self._epoch_fn = None
+        self._eval_fn = None
+        self._step_sharding = None
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # Keep device-resident copies of batches across epochs (kills the
+        # per-epoch H2D re-transfer; only valid when batches are stable).
+        self.cache_device_batches = not data.is_shuffling
+        self._batch_cache: Dict[int, Any] = {}
+        self._stacked_cache = None
+
+    # -- step construction ----------------------------------------------
+
+    def _step_core(self):
+        """The fused PULL/COMP/PUSH body shared by per-batch and per-epoch
+        compilation. ``hyper`` is a dict of scalars (lr etc.) passed fresh
+        each dispatch so host-side decay is honored."""
+        spec = self.ctx.model_table.spec
+        trainer = self.trainer
+        if trainer.pull_mode == "all":
+
+            def _step(arr, batch, hyper):
+                model = spec.pull_all(arr)                         # PULL
+                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
+                return spec.push_all(arr, delta), metrics          # PUSH
+
+        else:
+
+            def _step(arr, batch, hyper):
+                keys = trainer.pull_keys(batch)
+                model = spec.pull(arr, keys)                       # PULL
+                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
+                return spec.push(arr, keys, delta), metrics        # PUSH
+
+        return _step
+
+    def _build_step(self) -> None:
+        table = self.ctx.model_table
+        step = self._step_core()
+        self._step = jax.jit(step, out_shardings=(table.sharding, None), donate_argnums=0)
+        if self._use_fused_epoch():
+
+            def _epoch(arr, stacked, hyper):
+                return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
+
+            self._epoch_fn = jax.jit(
+                _epoch, out_shardings=(table.sharding, None), donate_argnums=0
+            )
+        self._eval_fn = jax.jit(self.trainer.evaluate)
+        self._step_sharding = table.sharding
+        self._batch_sharding = NamedSharding(table.mesh, P(DATA_AXIS))
+        self._batch_cache.clear()   # cached batches live on the old mesh
+        self._stacked_cache = None
+
+    def _use_fused_epoch(self) -> bool:
+        """Whole-epoch compilation is only correct with no between-batch host
+        decisions: no SSP gate, no TaskUnit interleaving, stable batches."""
+        return (
+            self.batch_barrier is None
+            and self.taskunit is None
+            and not self.data.is_shuffling
+        )
+
+    def _maybe_rebuild(self) -> None:
+        """Live re-sharding: if the table's layout changed since compile
+        (plan-driven migration), rebuild so out_shardings/donation target the
+        new mesh instead of pinning results to released devices."""
+        if self.ctx.model_table.sharding != self._step_sharding:
+            self._build_step()
+
+    def _shard_batch(self, batch: Tuple[np.ndarray, ...]):
+        return tuple(jax.device_put(a, self._batch_sharding) for a in batch)
+
+    def _hyper(self) -> Dict[str, jnp.ndarray]:
+        return {k: jnp.asarray(v) for k, v in self.trainer.hyperparams().items()}
+
+    # -- the loop --------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        ctx, params = self.ctx, self.ctx.params
+        self.trainer.init_global_settings(ctx)
+        self._build_step()
+        stop = False
+        global_batch_idx = 0
+        epoch_losses: List[float] = []
+        for epoch in range(self.starting_epoch, params.num_epochs):
+            epoch_t0 = time.perf_counter()
+            if self._use_fused_epoch():
+                epoch_examples, last_metrics = self._run_fused_epoch(epoch)
+                global_batch_idx += self.data.num_mini_batches
+            else:
+                epoch_examples, last_metrics, global_batch_idx, stop = (
+                    self._run_batched_epoch(epoch, global_batch_idx)
+                )
+            if epoch_examples == 0 and stop:
+                break  # stopped before any batch: not an epoch at all
+            self._finish_epoch(epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses)
+            if stop:
+                break
+        self.trainer.cleanup(ctx)
+        return {
+            "job_id": self.job_id,
+            "epochs_run": len(epoch_losses),
+            "losses": epoch_losses,
+            "stopped_early": stop,
+        }
+
+    def _run_batched_epoch(
+        self, epoch: int, global_batch_idx: int
+    ) -> Tuple[int, Dict[str, float], int, bool]:
+        """Per-batch dispatch with SYNC gate + TaskUnit announcement."""
+        table = self.ctx.model_table
+        epoch_examples = 0
+        last_metrics: Dict[str, float] = {}
+        stop = False
+        for batch_idx, batch in enumerate(self.data.epoch_batches()):
+            if self.batch_barrier is not None:  # SYNC TaskUnit
+                stop = self.batch_barrier(global_batch_idx)
+                if stop:
+                    break
+            self._maybe_rebuild()
+            t0 = time.perf_counter()
+            with self._taskunit_scope("COMP"):
+                if self.cache_device_batches:
+                    batch_dev = self._batch_cache.get(batch_idx)
+                    if batch_dev is None:
+                        batch_dev = self._shard_batch(batch)
+                        self._batch_cache[batch_idx] = batch_dev
+                else:
+                    batch_dev = self._shard_batch(batch)
+                metrics = table.apply_step(self._step, batch_dev, self._hyper())
+                jax.block_until_ready(table.array)
+            dt = time.perf_counter() - t0
+            n = batch[0].shape[0]
+            epoch_examples += n
+            global_batch_idx += 1
+            last_metrics = {k: float(v) for k, v in metrics.items()}
+            self.collector.add(
+                BatchMetrics(
+                    job_id=self.job_id,
+                    worker_id=self.ctx.worker_id,
+                    epoch_idx=epoch,
+                    batch_idx=batch_idx,
+                    num_examples=n,
+                    batch_time_sec=dt,
+                    comp_time_sec=dt,
+                    loss=last_metrics.get("loss", 0.0),
+                )
+            )
+        return epoch_examples, last_metrics, global_batch_idx, stop
+
+    def _run_fused_epoch(self, epoch: int) -> Tuple[int, Dict[str, float]]:
+        """One dispatch for the whole epoch (see _build_step)."""
+        table = self.ctx.model_table
+        self._maybe_rebuild()
+        if self._stacked_cache is None:
+            batches = list(self.data.epoch_batches())
+            stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
+            self._stacked_cache = tuple(
+                jax.device_put(np.stack([b[i] for b in batches]), stacked_sharding)
+                for i in range(len(batches[0]))
+            )
+        t0 = time.perf_counter()
+        stacked_metrics = table.apply_step(
+            self._epoch_fn, self._stacked_cache, self._hyper()
+        )
+        jax.block_until_ready(table.array)
+        dt = time.perf_counter() - t0
+        nb = self.data.num_mini_batches
+        host_metrics = {k: np.asarray(v) for k, v in stacked_metrics.items()}
+        for b in range(nb):
+            self.collector.add(
+                BatchMetrics(
+                    job_id=self.job_id,
+                    worker_id=self.ctx.worker_id,
+                    epoch_idx=epoch,
+                    batch_idx=b,
+                    num_examples=self.data.batch_size,
+                    batch_time_sec=dt / nb,
+                    comp_time_sec=dt / nb,
+                    loss=float(host_metrics.get("loss", np.zeros(nb))[b]),
+                )
+            )
+        last = {k: float(v[-1]) for k, v in host_metrics.items()}
+        return self.data.num_examples, last
+
+    def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses):
+        self.collector.add(
+            EpochMetrics(
+                job_id=self.job_id,
+                worker_id=self.ctx.worker_id,
+                epoch_idx=epoch,
+                num_examples=epoch_examples,
+                epoch_time_sec=time.perf_counter() - epoch_t0,
+                loss=last_metrics.get("loss", 0.0),
+            )
+        )
+        epoch_losses.append(last_metrics.get("loss", 0.0))
+        self.trainer.on_epoch_finished(self.ctx, epoch)
+        if self.epoch_callback is not None:
+            self.epoch_callback(epoch)
+        self.collector.flush()
+
+    def _taskunit_scope(self, kind: str):
+        if self.taskunit is None:
+            return contextlib.nullcontext()
+        return self.taskunit.scope(kind)
+
+    # -- evaluation (ref: ModelEvaluator over checkpointed models) -------
+
+    def evaluate(self, batch: Tuple[np.ndarray, ...]) -> Dict[str, float]:
+        table = self.ctx.model_table
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(self.trainer.evaluate)
+        model = table.pull_array()
+        metrics = self._eval_fn(model, self._shard_batch(batch))
+        return {k: float(v) for k, v in metrics.items()}

@@ -1,0 +1,17 @@
+from harmony_tpu.metrics.tracer import Tracer
+from harmony_tpu.metrics.collector import (
+    BatchMetrics,
+    EpochMetrics,
+    MetricCollector,
+    ServerMetrics,
+)
+from harmony_tpu.metrics.manager import MetricManager
+
+__all__ = [
+    "Tracer",
+    "BatchMetrics",
+    "EpochMetrics",
+    "ServerMetrics",
+    "MetricCollector",
+    "MetricManager",
+]

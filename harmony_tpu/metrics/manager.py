@@ -1,0 +1,82 @@
+"""Driver-side metric store feeding the optimizer and dashboards.
+
+Parity with the reference's Dolphin MetricManager (dolphin/metric/
+MetricManager.java:30-90): validates and stores worker/server metrics keyed
+by epoch/batch windows, supports pause/resume around reconfigurations (so
+migration-skewed samples don't feed the optimizer), and exposes aggregates.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, ServerMetrics
+
+
+class MetricManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._collecting = False
+        self._batch: Dict[str, List[BatchMetrics]] = defaultdict(list)
+        self._epoch: Dict[str, List[EpochMetrics]] = defaultdict(list)
+        self._server: Dict[str, List[ServerMetrics]] = defaultdict(list)
+
+    # -- lifecycle (ref: pause/resume around reconfig) -------------------
+
+    def start_collection(self) -> None:
+        with self._lock:
+            self._collecting = True
+
+    def stop_collection(self) -> None:
+        with self._lock:
+            self._collecting = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._batch.clear()
+            self._epoch.clear()
+            self._server.clear()
+
+    # -- ingest ----------------------------------------------------------
+
+    def on_metric(self, record: Any) -> None:
+        with self._lock:
+            if not self._collecting:
+                return
+            if isinstance(record, BatchMetrics):
+                self._batch[record.worker_id].append(record)
+            elif isinstance(record, EpochMetrics):
+                self._epoch[record.worker_id].append(record)
+            elif isinstance(record, ServerMetrics):
+                self._server[record.executor_id].append(record)
+            # dict custom metrics are accepted but unindexed
+
+    # -- queries (optimizer inputs) --------------------------------------
+
+    def worker_batch_metrics(self, worker_id: Optional[str] = None) -> List[BatchMetrics]:
+        with self._lock:
+            if worker_id is not None:
+                return list(self._batch.get(worker_id, []))
+            return [m for ms in self._batch.values() for m in ms]
+
+    def server_metrics(self) -> List[ServerMetrics]:
+        with self._lock:
+            return [m for ms in self._server.values() for m in ms]
+
+    def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
+        """Aggregate samples/sec across workers (the BASELINE north-star
+        metric: reference BatchMetrics.dataProcessingRate summed)."""
+        with self._lock:
+            per_worker: Dict[str, List[BatchMetrics]] = defaultdict(list)
+            for w, ms in self._batch.items():
+                for m in ms:
+                    if job_id is None or m.job_id == job_id:
+                        per_worker[w].append(m)
+        total = 0.0
+        for ms in per_worker.values():
+            t = sum(m.batch_time_sec for m in ms)
+            n = sum(m.num_examples for m in ms)
+            if t > 0:
+                total += n / t
+        return total

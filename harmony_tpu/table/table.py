@@ -130,15 +130,41 @@ class TableSpec:
             return ref.set(deltas.astype(arr.dtype))
         raise ValueError(f"unknown scatter_mode {mode!r}")
 
+    def _pad_to_storage(self, values: jnp.ndarray, dtype) -> jnp.ndarray:
+        """[capacity, *vshape] in key order -> storage layout (range tables
+        only: pad the tail block, reshape to [num_blocks, block_size, ...])."""
+        pad = self.num_blocks * self.block_size - self.config.capacity
+        v = values.astype(dtype)
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad, *self.value_shape), dtype)])
+        return v.reshape(self.storage_shape)
+
+    def push_all(self, arr: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+        """Dense full-model push: fold a ``[capacity, *value_shape]`` delta
+        into every key (the whole-model pushUpdate fast path — one fused
+        XLA add instead of a scatter; cross-shard reduction of data-parallel
+        contributions is inserted by XLA where the delta computation
+        contracts over the batch axis)."""
+        mode = self.update_fn.scatter_mode
+        if isinstance(self.partitioner, RangePartitioner):
+            if mode == "set":
+                return self.write_all(arr, deltas)
+            d = self._pad_to_storage(deltas, arr.dtype)
+            if mode == "add":
+                return arr + d
+            if mode == "min":
+                return jnp.minimum(arr, d)
+            if mode == "max":
+                return jnp.maximum(arr, d)
+            raise ValueError(f"unknown scatter_mode {mode!r}")
+        keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
+        return self.push(arr, keys, deltas)
+
     def write_all(self, arr: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
         """Overwrite the whole table from ``[capacity, *value_shape]`` in key
-        order (push_all for assign-style bulk updates / restores)."""
-        pad = self.num_blocks * self.block_size - self.config.capacity
+        order (bulk set for restores / assign-style updates)."""
         if isinstance(self.partitioner, RangePartitioner):
-            flat = jnp.concatenate(
-                [values, jnp.zeros((pad, *self.value_shape), values.dtype)]
-            ) if pad else values
-            return flat.reshape(self.storage_shape).astype(self.dtype)
+            return self._pad_to_storage(values, self.dtype)
         keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
         b, o = self.partitioner.locate(keys)
         return arr.at[b, o].set(values.astype(self.dtype))
@@ -204,6 +230,24 @@ class DenseTable:
             if new_arr.sharding != self._sharding:
                 new_arr = jax.device_put(new_arr, self._sharding)
             self._arr = new_arr
+
+    def apply_step(self, step_fn, *extra):
+        """Dispatch a functional step ``step_fn(arr, *extra) -> (new_arr, aux)``
+        and commit its result atomically w.r.t. every other table accessor.
+
+        This is the ONLY safe way to run a step that *donates* the storage
+        buffer: dispatch and commit happen under the table lock, so no host
+        accessor (checkpoint export, multi_get, a concurrent update) can
+        observe the window where the live buffer is donated-but-not-replaced.
+        Dispatch is async — the lock is held for microseconds, not for the
+        device computation.
+        """
+        with self._lock:
+            new_arr, aux = step_fn(self._arr, *extra)
+            if new_arr.sharding != self._sharding:
+                new_arr = jax.device_put(new_arr, self._sharding)
+            self._arr = new_arr
+        return aux
 
     # -- op surface (host-level; parity with Table.java) ----------------
 

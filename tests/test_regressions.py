@@ -87,3 +87,48 @@ def test_put_atomic_under_concurrency(devices):
     # Every put sets 1.0; olds are 0.0 (first) then 1.0 — no torn values.
     assert set(returned) <= {0.0, 1.0}
     assert float(t.get(0)) == 1.0
+
+
+def test_lr_decay_reaches_compiled_step(devices):
+    """Per-epoch decay must change the traced step's behavior (hyper args)."""
+    from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+    from harmony_tpu.parallel import build_mesh
+
+    mesh = build_mesh(devices[:4], data=2, model=2)
+    x, y = make_synthetic(64, 8, 2, seed=0)
+    # decay to zero after epoch 1: epochs >=2 must not change the model at all
+    tr = MLRTrainer(2, 8, 4, step_size=0.5, decay_rate=0.0, decay_period=1)
+    table = DenseTable(TableSpec(tr.model_table_config()), mesh)
+    ctx = TrainerContext(params=TrainerParams(num_epochs=3, num_mini_batches=2), model_table=table)
+    snapshots = []
+    w = WorkerTasklet(
+        "j", ctx, tr, TrainingDataProvider([x, y], 2), mesh,
+        epoch_callback=lambda e: snapshots.append(np.asarray(table.pull_array())),
+    )
+    w.run()
+    assert not np.allclose(snapshots[0], 0.0)           # epoch 0 trained
+    np.testing.assert_array_equal(snapshots[1], snapshots[2])  # lr==0 afterwards
+
+
+def test_stop_before_first_batch_emits_no_epoch(devices):
+    from harmony_tpu.apps.addvector import AddVectorTrainer, make_marks
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+    from harmony_tpu.parallel import build_mesh
+
+    mesh = build_mesh(devices[:4])
+    tr = AddVectorTrainer(num_keys=4, vector_dim=2)
+    table = DenseTable(TableSpec(tr.model_table_config()), mesh)
+    ctx = TrainerContext(params=TrainerParams(num_epochs=3, num_mini_batches=2), model_table=table)
+    epochs_seen = []
+    w = WorkerTasklet(
+        "j", ctx, tr, TrainingDataProvider(list(make_marks(32)), 2), mesh,
+        batch_barrier=lambda i: i >= 2,  # stop exactly at epoch-1 start
+        epoch_callback=epochs_seen.append,
+    )
+    result = w.run()
+    assert result["epochs_run"] == 1          # only epoch 0 completed
+    assert epochs_seen == [0]                 # no callback for the dead epoch
+    assert not any(l == 0.0 and i > 0 for i, l in enumerate(result["losses"]))
