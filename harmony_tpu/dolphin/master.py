@@ -1,0 +1,169 @@
+"""Dolphin master-side control: SSP gate, lifecycle barriers, progress.
+
+Rebuilds the reference's master components (SURVEY.md §2.6):
+
+  * MiniBatchController  — SSP bounded staleness: each worker announces
+    every mini-batch start; any worker more than ``clock_slack`` batches
+    ahead of the globally slowest is blocked; a global batch budget
+    (num_epochs x num_mini_batches per worker) triggers a broadcast stop
+    (ref: dolphin/core/master/MiniBatchController.java:28-118).
+  * WorkerStateManager   — barrier for the worker lifecycle INIT->RUN->
+    CLEANUP driven by sync messages, released by broadcast
+    (ref: core/master/WorkerStateManager.java:40-95).
+  * BatchProgressTracker — per-worker batch index for job-level progress
+    and the starting epoch on restart
+    (ref: core/master/BatchProgressTracker.java).
+
+These are in-process (condition variables instead of avro SyncMsg /
+MiniBatchSyncMsg round-trips): the single-controller TPU runtime has master
+and workers in one process, so "messages" are method calls; the method
+surface mirrors the message vocabulary so a multi-host transport can slot in
+behind the same API.
+
+Clock-slack = 0 degrades to BSP; the SPMD fused path is the slack-0 fast
+lane where the barrier is the lockstep collective itself.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+
+class BatchProgressTracker:
+    """Tracks per-worker mini-batch progress (max batch index seen)."""
+
+    def __init__(self, num_mini_batches_per_epoch: int) -> None:
+        self._nb = num_mini_batches_per_epoch
+        self._lock = threading.Lock()
+        self._progress: Dict[str, int] = {}
+
+    def on_batch(self, worker_id: str, global_batch_idx: int) -> None:
+        with self._lock:
+            cur = self._progress.get(worker_id, -1)
+            if global_batch_idx > cur:
+                self._progress[worker_id] = global_batch_idx
+
+    def global_min_batch(self) -> int:
+        with self._lock:
+            return min(self._progress.values()) if self._progress else 0
+
+    def starting_epoch(self) -> int:
+        """Epoch a restarted worker should resume from (ref: StartingEpochIdx
+        fed by the tracker, DolphinMaster.java:116)."""
+        return self.global_min_batch() // self._nb
+
+
+class MiniBatchController:
+    """SSP gate + global batch budget.
+
+    Workers call :meth:`on_sync` at each batch start (the MiniBatchSyncMsg).
+    The call blocks while the caller is more than ``clock_slack`` batches
+    ahead of the slowest registered worker, and returns ``True`` when the
+    job's batch budget is exhausted (the MiniBatchControlMsg stop
+    broadcast).
+    """
+
+    def __init__(
+        self,
+        clock_slack: int,
+        batches_per_worker: int,
+        tracker: Optional[BatchProgressTracker] = None,
+    ) -> None:
+        self.clock_slack = clock_slack
+        self.batches_per_worker = batches_per_worker
+        self._cond = threading.Condition()
+        self._progress: Dict[str, int] = {}
+        self._stopped = False
+        self._tracker = tracker
+
+    # -- membership (elasticity adjusts this; ref: WorkerStateManager
+    # keeping barrier counts consistent across reconfigurations) ---------
+
+    def register_worker(self, worker_id: str) -> None:
+        with self._cond:
+            self._progress.setdefault(worker_id, 0)
+            self._cond.notify_all()
+
+    def deregister_worker(self, worker_id: str) -> None:
+        """A finished/removed worker must not gate the others."""
+        with self._cond:
+            self._progress.pop(worker_id, None)
+            self._cond.notify_all()
+
+    def request_stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    @property
+    def stopped(self) -> bool:
+        with self._cond:
+            return self._stopped
+
+    # -- the gate --------------------------------------------------------
+
+    def on_sync(self, worker_id: str, batch_idx: int) -> bool:
+        """Announce batch start; block per SSP; return stop flag."""
+        with self._cond:
+            if worker_id not in self._progress:
+                self._progress[worker_id] = 0
+            self._progress[worker_id] = batch_idx
+            if self._tracker is not None:
+                self._tracker.on_batch(worker_id, batch_idx)
+            self._cond.notify_all()
+            if batch_idx >= self.batches_per_worker:
+                self._stopped = True
+                self._cond.notify_all()
+                return True
+            while (
+                not self._stopped
+                and self._progress
+                and batch_idx > min(self._progress.values()) + self.clock_slack
+            ):
+                self._cond.wait()
+            return self._stopped
+
+    def make_barrier(self, worker_id: str) -> Callable[[int], bool]:
+        """Worker-side MiniBatchBarrier bound to this controller (ref:
+        core/worker/MiniBatchBarrier.java:28-60) — plugs into
+        WorkerTasklet(batch_barrier=...)."""
+        self.register_worker(worker_id)
+        return lambda batch_idx: self.on_sync(worker_id, batch_idx)
+
+
+class WorkerStateManager:
+    """Lifecycle barrier: all workers must reach a state before any proceeds.
+
+    Worker side calls :meth:`await_barrier(worker_id, state)` (the SyncMsg);
+    when every registered worker has arrived, the master releases all (the
+    broadcast release). States progress INIT -> RUN -> CLEANUP.
+    """
+
+    STATES = ("INIT", "RUN", "CLEANUP")
+
+    def __init__(self, worker_ids: List[str]) -> None:
+        self._cond = threading.Condition()
+        self._workers: Set[str] = set(worker_ids)
+        self._arrived: Dict[str, Set[str]] = {s: set() for s in self.STATES}
+        self._released: Set[str] = set()
+
+    def update_workers(self, worker_ids: List[str]) -> None:
+        """Reconfiguration: adjust the barrier membership (ref:
+        ETTaskRunner.updateExecutorEntry keeping barrier counts right)."""
+        with self._cond:
+            self._workers = set(worker_ids)
+            self._maybe_release_locked()
+
+    def await_barrier(self, worker_id: str, state: str, timeout: Optional[float] = None) -> bool:
+        if state not in self.STATES:
+            raise ValueError(f"unknown state {state!r}")
+        with self._cond:
+            self._arrived[state].add(worker_id)
+            self._maybe_release_locked()
+            return self._cond.wait_for(lambda: state in self._released, timeout=timeout)
+
+    def _maybe_release_locked(self) -> None:
+        for s in self.STATES:
+            if s not in self._released and self._workers and self._workers <= self._arrived[s]:
+                self._released.add(s)
+                self._cond.notify_all()

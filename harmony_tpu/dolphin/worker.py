@@ -110,6 +110,13 @@ class WorkerTasklet:
 
     def _build_step(self) -> None:
         table = self.ctx.model_table
+        data_ax = table.mesh.shape.get(DATA_AXIS, 1)
+        if self.data.batch_size % max(data_ax, 1):
+            raise ValueError(
+                f"mini-batch size {self.data.batch_size} not divisible by the "
+                f"mesh data axis ({data_ax}); pick num_mini_batches so that "
+                "each batch splits evenly across data-parallel shards"
+            )
         step = self._step_core()
         self._step = jax.jit(step, out_shardings=(table.sharding, None), donate_argnums=0)
         if self._use_fused_epoch():
@@ -203,7 +210,9 @@ class WorkerTasklet:
                 else:
                     batch_dev = self._shard_batch(batch)
                 metrics = table.apply_step(self._step, batch_dev, self._hyper())
-                jax.block_until_ready(table.array)
+                # Block on the step's own outputs (metrics), never on a table
+                # snapshot another worker's donating step could invalidate.
+                jax.block_until_ready(metrics)
             dt = time.perf_counter() - t0
             n = batch[0].shape[0]
             epoch_examples += n
@@ -238,7 +247,7 @@ class WorkerTasklet:
         stacked_metrics = table.apply_step(
             self._epoch_fn, self._stacked_cache, self._hyper()
         )
-        jax.block_until_ready(table.array)
+        jax.block_until_ready(stacked_metrics)
         dt = time.perf_counter() - t0
         nb = self.data.num_mini_batches
         host_metrics = {k: np.asarray(v) for k, v in stacked_metrics.items()}

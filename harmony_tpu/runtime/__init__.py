@@ -1,0 +1,3 @@
+from harmony_tpu.runtime.master import ETMaster, Executor, TableHandle
+
+__all__ = ["ETMaster", "Executor", "TableHandle"]

@@ -1,0 +1,223 @@
+"""ETMaster — driver-side executor and table lifecycle.
+
+Rebuilds the reference's driver-side ET API (SURVEY.md §2.2):
+
+  * ``ETMaster.add_executors(n)`` / ``create_table(conf, associators)``
+    (ref: driver/api/ETMaster.java:34-83),
+  * ``Executor`` — the AllocatedExecutor handle (an executor here is one
+    device slot of the pod plus host-side state; allocation leases from the
+    DevicePool the way the reference's ExecutorManager asks the
+    EvaluatorManager for containers),
+  * ``TableHandle`` — the AllocatedTable handle: associate/unassociate,
+    move_blocks, drop (ref: driver/api/AllocatedTable.java:38-154), married
+    to the per-table BlockManager (authoritative ownership) and the
+    physical DenseTable.
+
+Physical realization of ownership on TPU: the dense storage is one array
+sharded over the mesh built from the table's *owning* executors. Ownership
+changes (associate+move / drain+unassociate) re-materialize the array on the
+new mesh — one XLA resharding transfer instead of the reference's per-block
+ownership-then-data message protocol (MigrationExecutor.java:107-253). The
+BlockManager still tracks logical per-block ownership: it is what plans,
+metrics and checkpoint manifests reason about, and uneven logical ownership
+is physically realized at the balanced-mesh granularity (blocks % executors
+padding rides the existing replicated fallback).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from harmony_tpu.config.params import ExecutorConfig, TableConfig
+from harmony_tpu.parallel.mesh import DevicePool, build_mesh
+from harmony_tpu.table.ownership import BlockManager
+from harmony_tpu.table.table import DenseTable, TableSpec
+
+
+def _mesh_over(devices: Sequence[jax.Device], data_axis: int):
+    """(data, model) mesh over ONE device set: collocation means the same
+    devices appear on both axes as a factorization (each chip holds a model
+    shard AND computes a data shard — the analogue of servers==workers==all
+    executors, DolphinJobEntity.java:76-121), never as duplicates. Falls
+    back to pure model-parallel when the count doesn't factor."""
+    n = len(devices)
+    if data_axis > 1 and n % data_axis == 0:
+        return build_mesh(devices, data=data_axis)
+    return build_mesh(devices, data=1)
+
+
+class Executor:
+    """AllocatedExecutor: one device slot + host-side runtime state."""
+
+    _counter = itertools.count()
+
+    def __init__(self, executor_id: str, device: jax.Device) -> None:
+        self.id = executor_id
+        self.device = device
+        self.closed = False
+
+    def __repr__(self) -> str:
+        return f"Executor({self.id}, {self.device})"
+
+
+class TableHandle:
+    """Master-side handle pairing logical ownership with physical storage."""
+
+    def __init__(self, master: "ETMaster", table: DenseTable, bm: BlockManager) -> None:
+        self._master = master
+        self.table = table
+        self.block_manager = bm
+
+    @property
+    def table_id(self) -> str:
+        return self.table.spec.table_id
+
+    # -- ownership ops (the AllocatedTable surface) ----------------------
+
+    def associate(self, executor_id: str) -> None:
+        """Add an executor as potential owner; no data moves yet (ref:
+        AllocatedTable.associate)."""
+        self.block_manager.associate(executor_id)
+
+    def unassociate(self, executor_id: str) -> None:
+        """Remove an executor (must own no blocks); physically reshards off
+        its device (ref: AllocatedTable.unassociate + sync protocol)."""
+        self.block_manager.unassociate(executor_id)
+        self._reshard_to_owners()
+
+    def move_blocks(self, src: str, dst: str, num_blocks: int) -> List[int]:
+        """Logical block move + physical resharding when the owning executor
+        set changes (ref: AllocatedTable.moveBlocks -> MigrationManager).
+
+        Ownership-first semantics: the BlockManager map flips before the
+        bytes move (reads routed by the new map block on the table lock for
+        the duration of the device_put — the reference's access latch)."""
+        moved = self.block_manager.move(src, dst, num_blocks)
+        self._reshard_to_owners()
+        return moved
+
+    def rebalance(self, executor_ids: Sequence[str]) -> None:
+        """Even repartition across ``executor_ids`` + physical resharding."""
+        self.block_manager.rebalance(list(executor_ids))
+        self._reshard_to_owners()
+
+    def drop(self) -> None:
+        self._master._drop_table(self.table_id)
+
+    # -- physical layout -------------------------------------------------
+
+    def owning_executors(self) -> List[str]:
+        counts = self.block_manager.block_counts()
+        return [e for e in self.block_manager.executors if counts.get(e, 0) > 0]
+
+    def _reshard_to_owners(self) -> None:
+        owners = self.owning_executors()
+        devices = [self._master.executor(e).device for e in owners]
+        data_ax = self._master.data_axis_of(self.table_id)
+        self.table.reshard(_mesh_over(devices, data_ax))
+
+
+class ETMaster:
+    """Owns executors (device slots) and tables."""
+
+    def __init__(self, pool: Optional[DevicePool] = None) -> None:
+        self._pool = pool or DevicePool()
+        self._lock = threading.RLock()
+        self._executors: Dict[str, Executor] = {}
+        self._tables: Dict[str, TableHandle] = {}
+        self._data_axis: Dict[str, int] = {}
+
+    # -- executors -------------------------------------------------------
+
+    def add_executors(self, num: int, conf: Optional[ExecutorConfig] = None) -> List[Executor]:
+        """Allocate ``num`` executors (ref: ETMaster.addExecutors). Each
+        leases one device from the pool; device reuse across executors is
+        allowed (multi-tenant overlap) via shared leases."""
+        out = []
+        with self._lock:
+            try:
+                for _ in range(num):
+                    eid = f"executor-{next(Executor._counter)}"
+                    devs = self._pool.lease(eid, 1)
+                    ex = Executor(eid, devs[0])
+                    self._executors[eid] = ex
+                    out.append(ex)
+            except RuntimeError:
+                # All-or-nothing (ref: EvaluatorManager fulfills whole request
+                # plans): roll back partial allocations before re-raising.
+                for ex in out:
+                    self._executors.pop(ex.id, None)
+                    self._pool.release(ex.id)
+                raise RuntimeError(
+                    f"cannot allocate {num} executors: pool exhausted"
+                ) from None
+        return out
+
+    def remove_executor(self, executor_id: str) -> None:
+        """Close an executor and return its device to the pool (ref:
+        AllocatedExecutor.close). Tables must have drained it first."""
+        with self._lock:
+            ex = self._executors.pop(executor_id)
+            for h in self._tables.values():
+                if executor_id in h.block_manager.executors:
+                    raise RuntimeError(
+                        f"{executor_id} still associated with {h.table_id}"
+                    )
+            ex.closed = True
+            self._pool.release(executor_id)
+
+    def executor(self, executor_id: str) -> Executor:
+        with self._lock:
+            return self._executors[executor_id]
+
+    def executor_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._executors)
+
+    # -- tables ----------------------------------------------------------
+
+    def create_table(
+        self,
+        config: TableConfig,
+        associators: Sequence[str],
+        data_axis: int = 1,
+    ) -> TableHandle:
+        """Create a table owned evenly by ``associators`` (ref:
+        ETMaster.createTable). ``data_axis`` sizes the mesh's data dimension
+        for the job using this table (collocated PS: same devices appear on
+        both axes)."""
+        with self._lock:
+            if config.table_id in self._tables:
+                raise ValueError(f"table {config.table_id} exists")
+            if not associators:
+                raise ValueError("need at least one associator")
+            devices = [self._executors[e].device for e in associators]
+            mesh = _mesh_over(devices, data_axis)
+            table = DenseTable(TableSpec(config), mesh)
+            bm = BlockManager(config.table_id, TableSpec(config).num_blocks, associators)
+            handle = TableHandle(self, table, bm)
+            self._tables[config.table_id] = handle
+            self._data_axis[config.table_id] = data_axis
+            return handle
+
+    def get_table(self, table_id: str) -> TableHandle:
+        with self._lock:
+            return self._tables[table_id]
+
+    def table_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._tables)
+
+    def data_axis_of(self, table_id: str) -> int:
+        with self._lock:
+            return self._data_axis.get(table_id, 1)
+
+    def _drop_table(self, table_id: str) -> None:
+        with self._lock:
+            handle = self._tables.pop(table_id, None)
+            self._data_axis.pop(table_id, None)
+        if handle is not None:
+            handle.table.drop()

@@ -74,6 +74,11 @@ class BlockManager:
         with self._lock:
             self._listeners.append(listener)
 
+    def unsubscribe(self, listener: OwnershipListener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
     def _notify_locked(self) -> None:
         """Fire listeners with a consistent snapshot. Must be called with the
         lock held so concurrent mutators can't interleave stale snapshots out

@@ -212,7 +212,16 @@ class DenseTable:
 
     @property
     def array(self) -> jax.Array:
-        """Immutable snapshot of current storage (safe to close over in jit)."""
+        """Snapshot of current storage.
+
+        CAUTION: if any writer uses a *donating* step (apply_step with a
+        donate_argnums jit), this handle may be invalidated the moment such a
+        step dispatches — dereferencing it afterwards raises "Array has been
+        deleted" on hardware that honors donation. Host-side readers must not
+        hold this across writer activity; use the table's read methods
+        (multi_get / pull_array / export_blocks), which dispatch their device
+        ops *under the table lock* and hand back freshly-produced arrays that
+        no later donation can invalidate."""
         with self._lock:
             return self._arr
 
@@ -244,9 +253,7 @@ class DenseTable:
         """
         with self._lock:
             new_arr, aux = step_fn(self._arr, *extra)
-            if new_arr.sharding != self._sharding:
-                new_arr = jax.device_put(new_arr, self._sharding)
-            self._arr = new_arr
+            self.commit(new_arr)  # RLock: re-homes if resharded mid-flight
         return aux
 
     # -- op surface (host-level; parity with Table.java) ----------------
@@ -259,7 +266,9 @@ class DenseTable:
 
     def multi_get(self, keys: Sequence[int]) -> np.ndarray:
         k = jnp.asarray(keys, dtype=jnp.int32)
-        return np.asarray(self._jitted("pull", self.spec.pull)(self.array, k))
+        with self._lock:  # dispatch under lock: see `array` docstring
+            out = self._jitted("pull", self.spec.pull)(self._arr, k)
+        return np.asarray(out)
 
     def get(self, key: int) -> np.ndarray:
         return self.multi_get([key])[0]
@@ -310,7 +319,8 @@ class DenseTable:
 
     def pull_array(self) -> jax.Array:
         """Full table in key order (device array; stays sharded until used)."""
-        return self._jitted("pull_all", self.spec.pull_all)(self.array)
+        with self._lock:  # dispatch under lock: see `array` docstring
+            return self._jitted("pull_all", self.spec.pull_all)(self._arr)
 
     # -- re-sharding (the migration path) --------------------------------
 
@@ -337,9 +347,11 @@ class DenseTable:
     def export_blocks(self, block_ids: Optional[Sequence[int]] = None) -> Dict[int, np.ndarray]:
         """Materialize blocks to host memory (ref: ChkpManagerSlave writes
         local blocks to per-block files, evaluator/impl/ChkpManagerSlave.java)."""
-        arr = self.array
-        ids = range(self.spec.num_blocks) if block_ids is None else block_ids
-        return {int(b): np.asarray(arr[int(b)]) for b in ids}
+        ids = list(range(self.spec.num_blocks)) if block_ids is None else list(block_ids)
+        with self._lock:  # dispatch the per-block gathers under the lock so a
+            # concurrent donating step can't invalidate the source buffer
+            parts = {int(b): self._arr[int(b)] for b in ids}
+        return {b: np.asarray(a) for b, a in parts.items()}
 
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> None:
         """Install block payloads (restore path; tolerates any topology —

@@ -1,0 +1,179 @@
+"""Master-side control tests: SSP gate, budget stop, lifecycle barrier.
+
+Analogues of the reference's WorkerStateManagerTest and the
+MiniBatchController behavior (SSP ClockSlack blocking + budget broadcast).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu.dolphin.master import (
+    BatchProgressTracker,
+    MiniBatchController,
+    WorkerStateManager,
+)
+
+
+class TestMiniBatchController:
+    def test_slack_blocks_fast_worker(self):
+        c = MiniBatchController(clock_slack=2, batches_per_worker=100)
+        c.register_worker("fast")
+        c.register_worker("slow")
+        events = []
+
+        def fast():
+            for i in range(6):
+                c.on_sync("fast", i)
+                events.append(("fast", i, time.perf_counter()))
+
+        t = threading.Thread(target=fast)
+        t.start()
+        time.sleep(0.2)
+        # fast must be blocked at batch 3 (0 + slack 2 < 3).
+        fast_batches = [e[1] for e in events if e[0] == "fast"]
+        assert max(fast_batches) == 2, fast_batches
+        for i in range(6):
+            c.on_sync("slow", i)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert max(e[1] for e in events) == 5
+
+    def test_slack_zero_is_bsp(self):
+        c = MiniBatchController(clock_slack=0, batches_per_worker=10)
+        c.register_worker("a")
+        c.register_worker("b")
+        done = []
+
+        def run_a():
+            c.on_sync("a", 0)
+            c.on_sync("a", 1)  # must block until b syncs batch 1... 0
+            done.append("a1")
+
+        t = threading.Thread(target=run_a)
+        t.start()
+        time.sleep(0.1)
+        assert done == []
+        c.on_sync("b", 0)
+        c.on_sync("b", 1)
+        t.join(timeout=5)
+        assert done == ["a1"]
+
+    def test_budget_stop_broadcast(self):
+        c = MiniBatchController(clock_slack=10, batches_per_worker=3)
+        c.register_worker("a")
+        c.register_worker("b")
+        assert not c.on_sync("a", 0)
+        assert not c.on_sync("a", 1)
+        assert not c.on_sync("a", 2)
+        assert c.on_sync("a", 3)          # budget hit -> stop
+        assert c.on_sync("b", 1)          # other worker sees broadcast stop
+        assert c.stopped
+
+    def test_deregister_unblocks(self):
+        c = MiniBatchController(clock_slack=0, batches_per_worker=100)
+        c.register_worker("a")
+        c.register_worker("dead")
+        result = []
+
+        def run_a():
+            c.on_sync("a", 1)
+            result.append("released")
+
+        t = threading.Thread(target=run_a)
+        t.start()
+        time.sleep(0.1)
+        assert result == []
+        c.deregister_worker("dead")       # finished worker must not gate
+        t.join(timeout=5)
+        assert result == ["released"]
+
+    def test_tracker_starting_epoch(self):
+        tr = BatchProgressTracker(num_mini_batches_per_epoch=4)
+        c = MiniBatchController(clock_slack=8, batches_per_worker=100, tracker=tr)
+        for i in range(9):
+            c.on_sync("w0", i)
+        for i in range(6):
+            c.on_sync("w1", i)
+        assert tr.global_min_batch() == 5
+        assert tr.starting_epoch() == 1   # min 5 // 4
+
+
+class TestWorkerStateManager:
+    def test_barrier_releases_when_all_arrive(self):
+        m = WorkerStateManager(["w0", "w1"])
+        order = []
+
+        def worker(wid, delay):
+            time.sleep(delay)
+            assert m.await_barrier(wid, "INIT", timeout=5)
+            order.append(wid)
+
+        ts = [
+            threading.Thread(target=worker, args=("w0", 0.0)),
+            threading.Thread(target=worker, args=("w1", 0.15)),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=5)
+        assert sorted(order) == ["w0", "w1"]
+
+    def test_membership_shrink_releases(self):
+        m = WorkerStateManager(["w0", "w1", "w2"])
+        released = []
+
+        def worker(wid):
+            assert m.await_barrier(wid, "RUN", timeout=5)
+            released.append(wid)
+
+        ts = [threading.Thread(target=worker, args=(w,)) for w in ["w0", "w1"]]
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        assert released == []
+        m.update_workers(["w0", "w1"])    # w2 removed by reconfiguration
+        for t in ts:
+            t.join(timeout=5)
+        assert sorted(released) == ["w0", "w1"]
+
+
+class TestSSPTraining:
+    def test_two_async_workers_exact_sums(self, mesh8):
+        """Two async worker threads, each on half the data, sharing one model
+        table under an SSP gate — the multi-worker analogue of the AddVector
+        validator: no push lost, final value exact."""
+        from harmony_tpu.apps.addvector import AddVectorTrainer, make_marks
+        from harmony_tpu.config.params import TrainerParams
+        from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+        from harmony_tpu.table import DenseTable, TableSpec
+
+        n_per_worker, epochs, nb = 64, 2, 4
+        trainer = AddVectorTrainer(num_keys=8, vector_dim=2, delta=1.0)
+        table = DenseTable(TableSpec(trainer.model_table_config()), mesh8)
+        ctrl = MiniBatchController(clock_slack=1, batches_per_worker=epochs * nb)
+        results = {}
+
+        def run_worker(wid):
+            params = TrainerParams(num_epochs=epochs, num_mini_batches=nb)
+            ctx = TrainerContext(params=params, model_table=table, worker_id=wid)
+            w = WorkerTasklet(
+                "ssp-job",
+                ctx,
+                AddVectorTrainer(num_keys=8, vector_dim=2, delta=1.0),
+                TrainingDataProvider(list(make_marks(n_per_worker)), nb),
+                mesh8,
+                batch_barrier=ctrl.make_barrier(wid),
+            )
+            results[wid] = w.run()
+            ctrl.deregister_worker(wid)
+
+        ts = [threading.Thread(target=run_worker, args=(f"w{i}",)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        vals = np.asarray(table.pull_array())
+        # Both workers processed all their batches: 2 workers x 128 examples.
+        np.testing.assert_allclose(vals, np.full((8, 2), 2 * n_per_worker * epochs))

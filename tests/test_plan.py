@@ -1,0 +1,138 @@
+"""Plan engine + ETMaster tests (analogues of PlanExecutorTest /
+SampleOptimizersTest wiring at the ET level)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.parallel import DevicePool
+from harmony_tpu.plan import (
+    AllocateOp,
+    AssociateOp,
+    DeallocateOp,
+    ETPlan,
+    MoveOp,
+    PlanExecutor,
+    UnassociateOp,
+)
+from harmony_tpu.plan.ops import Op, PlanContext
+from harmony_tpu.runtime import ETMaster
+
+
+@pytest.fixture()
+def master(devices):
+    return ETMaster(DevicePool(devices))
+
+
+def table_cfg(tid="t", capacity=64, blocks=16):
+    return TableConfig(table_id=tid, capacity=capacity, value_shape=(2,), num_blocks=blocks)
+
+
+class TestETMaster:
+    def test_add_executors_and_create_table(self, master):
+        exs = master.add_executors(4)
+        assert len(exs) == 4
+        h = master.create_table(table_cfg(), [e.id for e in exs])
+        assert h.block_manager.block_counts() == {e.id: 4 for e in exs}
+        assert {s.data.shape for s in h.table.array.addressable_shards} == {(4, 4, 2)}
+
+    def test_grow_shrink_cycle(self, master):
+        exs = master.add_executors(2)
+        h = master.create_table(table_cfg(), [e.id for e in exs])
+        h.table.multi_update(list(range(64)), np.ones((64, 2), np.float32))
+        # grow: allocate, associate, move half from each old owner
+        (new,) = master.add_executors(1)
+        h.associate(new.id)
+        h.move_blocks(exs[0].id, new.id, 4)
+        assert h.block_manager.block_counts()[new.id] == 4
+        np.testing.assert_allclose(np.asarray(h.table.pull_array()), np.ones((64, 2)))
+        # shrink: drain new executor and remove it
+        h.move_blocks(new.id, exs[1].id, 4)
+        h.unassociate(new.id)
+        master.remove_executor(new.id)
+        assert new.id not in master.executor_ids()
+        np.testing.assert_allclose(np.asarray(h.table.pull_array()), np.ones((64, 2)))
+
+    def test_remove_executor_guards_association(self, master):
+        exs = master.add_executors(2)
+        master.create_table(table_cfg(), [e.id for e in exs])
+        with pytest.raises(RuntimeError):
+            master.remove_executor(exs[0].id)
+
+
+class TestPlanExecutor:
+    def test_add_server_plan(self, master):
+        """The AddOneServer sample plan: allocate -> associate -> move."""
+        exs = master.add_executors(2)
+        h = master.create_table(table_cfg(), [e.id for e in exs])
+        h.table.multi_update(list(range(64)), np.full((64, 2), 3.0, np.float32))
+        plan = ETPlan()
+        alloc = plan.add_op(AllocateOp("v0"))
+        assoc = plan.add_op(AssociateOp("t", "v0"), depends_on=[alloc])
+        plan.add_op(MoveOp("t", exs[0].id, "v0", 4), depends_on=[assoc])
+        result = PlanExecutor(master).execute(plan)
+        assert result.success, result.error
+        assert len(result.executed) == 3
+        counts = h.block_manager.block_counts()
+        assert sum(counts.values()) == 16 and len(counts) == 3
+        np.testing.assert_allclose(np.asarray(h.table.pull_array()), np.full((64, 2), 3.0))
+
+    def test_delete_server_plan(self, master):
+        exs = master.add_executors(3)
+        h = master.create_table(table_cfg(tid="t2", blocks=12), [e.id for e in exs])
+        victim = exs[2].id
+        plan = ETPlan()
+        mv = plan.add_op(MoveOp("t2", victim, exs[0].id, 4))
+        un = plan.add_op(UnassociateOp("t2", victim), depends_on=[mv])
+        plan.add_op(DeallocateOp(victim), depends_on=[un])
+        result = PlanExecutor(master).execute(plan)
+        assert result.success, result.error
+        assert victim not in master.executor_ids()
+        assert victim not in h.block_manager.executors
+
+    def test_parallel_execution_and_dependencies(self, master):
+        """Independent ops run concurrently; dependents strictly after."""
+        order = []
+        lock = threading.Lock()
+        gate = threading.Barrier(2, timeout=5)
+
+        class ProbeOp(Op):
+            def __init__(self, name, barrier=None):
+                super().__init__()
+                self.name = name
+                self.barrier = barrier
+
+            def execute(self, ctx):
+                if self.barrier is not None:
+                    self.barrier.wait()  # proves a & b overlap in time
+                with lock:
+                    order.append(self.name)
+
+        plan = ETPlan()
+        a = plan.add_op(ProbeOp("a", gate))
+        b = plan.add_op(ProbeOp("b", gate))
+        plan.add_op(ProbeOp("c"), depends_on=[a, b])
+        result = PlanExecutor(master).execute(plan)
+        assert result.success
+        assert set(order[:2]) == {"a", "b"} and order[2] == "c"
+
+    def test_failure_aborts_dependents(self, master):
+        ran = []
+
+        class FailOp(Op):
+            def execute(self, ctx):
+                raise RuntimeError("boom")
+
+        class MarkOp(Op):
+            def execute(self, ctx):
+                ran.append(1)
+
+        plan = ETPlan()
+        f = plan.add_op(FailOp())
+        plan.add_op(MarkOp(), depends_on=[f])
+        result = PlanExecutor(master).execute(plan)
+        assert not result.success
+        assert isinstance(result.error, RuntimeError)
+        assert ran == []

@@ -132,3 +132,30 @@ def test_stop_before_first_batch_emits_no_epoch(devices):
     assert result["epochs_run"] == 1          # only epoch 0 completed
     assert epochs_seen == [0]                 # no callback for the dead epoch
     assert not any(l == 0.0 and i > 0 for i, l in enumerate(result["losses"]))
+
+
+def test_add_executors_all_or_nothing(devices):
+    from harmony_tpu.runtime import ETMaster
+
+    master = ETMaster(DevicePool(devices))  # 8 devices
+    master.add_executors(3)
+    with pytest.raises(RuntimeError):
+        master.add_executors(20)
+    assert len(master.executor_ids()) == 3  # no partial allocation left
+    assert len(master.add_executors(5)) == 5  # the 5 free devices still leasable
+
+
+def test_indivisible_batch_clear_error(devices):
+    from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+    from harmony_tpu.parallel import build_mesh
+
+    mesh = build_mesh(devices)  # data axis = 8
+    x, y = make_synthetic(100, 8, 2)  # 100/4 = 25, not divisible by 8
+    tr = MLRTrainer(2, 8, 4)
+    table = DenseTable(TableSpec(tr.model_table_config()), mesh)
+    ctx = TrainerContext(params=TrainerParams(num_epochs=1, num_mini_batches=4), model_table=table)
+    w = WorkerTasklet("j", ctx, tr, TrainingDataProvider([x, y], 4), mesh)
+    with pytest.raises(ValueError, match="not divisible by the.*data axis"):
+        w.run()
