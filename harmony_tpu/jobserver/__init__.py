@@ -1,0 +1,16 @@
+from harmony_tpu.jobserver.scheduler import FifoExclusiveScheduler, JobScheduler, ShareAllScheduler
+from harmony_tpu.jobserver.entity import DolphinJobEntity, JobEntity
+from harmony_tpu.jobserver.server import JobServer
+from harmony_tpu.jobserver.client import CommandSender, submit_job, shutdown_server
+
+__all__ = [
+    "JobScheduler",
+    "ShareAllScheduler",
+    "FifoExclusiveScheduler",
+    "JobEntity",
+    "DolphinJobEntity",
+    "JobServer",
+    "CommandSender",
+    "submit_job",
+    "shutdown_server",
+]

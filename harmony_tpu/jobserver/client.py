@@ -1,0 +1,59 @@
+"""Client-side job submission over the TCP command endpoint.
+
+Parity with the reference's CommandSender (jobserver/client/
+CommandSender.java:49-80): app submit tools connect to the long-running
+server by localhost TCP and send SUBMIT with the serialized job config, or
+SHUTDOWN. The wire format is one newline-terminated JSON object each way
+(the reference used a delimiter-framed Tang-serialized string; same idea,
+JSON instead of avro/Tang).
+"""
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Dict
+
+from harmony_tpu.config.params import JobConfig
+
+
+class CommandSender:
+    def __init__(self, port: int, host: str = "127.0.0.1", timeout: float = 60.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _roundtrip(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
+            s.sendall((json.dumps(payload) + "\n").encode())
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        if not data.strip():
+            raise RuntimeError(
+                f"empty reply from job server at {self.host}:{self.port} "
+                "(connection closed without a response)"
+            )
+        return json.loads(data.decode())
+
+    def send_job_submit_command(self, config: JobConfig) -> Dict[str, Any]:
+        return self._roundtrip({"command": "SUBMIT", "conf": config.to_dict()})
+
+    def send_status_command(self) -> Dict[str, Any]:
+        return self._roundtrip({"command": "STATUS"})
+
+    def send_shutdown_command(self) -> Dict[str, Any]:
+        return self._roundtrip({"command": "SHUTDOWN"})
+
+
+def submit_job(config: JobConfig, port: int) -> Dict[str, Any]:
+    reply = CommandSender(port).send_job_submit_command(config)
+    if not reply.get("ok"):
+        raise RuntimeError(f"submit failed: {reply.get('error')}")
+    return reply
+
+
+def shutdown_server(port: int) -> None:
+    CommandSender(port).send_shutdown_command()

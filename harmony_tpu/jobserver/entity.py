@@ -1,0 +1,228 @@
+"""JobEntity — app-type abstraction between the JobServer and frameworks.
+
+Parity with the reference's JobEntity/JobMaster pair (jobserver/driver/
+JobEntity.java, JobMaster.java): each app type implements table/executor
+setup plus a run loop. DolphinJobEntity mirrors the reference's
+(dolphin/jobserver/DolphinJobEntity.java:40-168): model table created on the
+job's executors ("servers"), input provisioned to workers, PS-collocation
+only (servers == workers == all granted executors), and input-table reuse
+across jobs when the table id matches.
+
+The trainer and its data come from the serializable JobConfig: dotted-path
+symbols (config.base.resolve_symbol) stand in for Tang's
+bind-implementation-by-class-name.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from harmony_tpu.config.base import resolve_symbol
+from harmony_tpu.config.params import JobConfig, TrainerParams
+from harmony_tpu.dolphin.data import TrainingDataProvider
+from harmony_tpu.dolphin.master import (
+    BatchProgressTracker,
+    MiniBatchController,
+    WorkerStateManager,
+)
+from harmony_tpu.dolphin.trainer import TrainerContext
+from harmony_tpu.dolphin.worker import WorkerTasklet
+from harmony_tpu.metrics.collector import MetricCollector
+from harmony_tpu.runtime.master import ETMaster, TableHandle
+from harmony_tpu.runtime.taskunit import (
+    GlobalTaskUnitScheduler,
+    LocalTaskUnitScheduler,
+    TaskUnitClient,
+)
+
+
+class JobEntity:
+    """SPI: one instance per submitted job."""
+
+    def __init__(self, config: JobConfig) -> None:
+        self.config = config
+
+    def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
+        raise NotImplementedError
+
+    def run(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        raise NotImplementedError
+
+
+class DolphinJobEntity(JobEntity):
+    def __init__(
+        self,
+        config: JobConfig,
+        global_taskunit: Optional[GlobalTaskUnitScheduler] = None,
+        local_taskunit: Optional[LocalTaskUnitScheduler] = None,
+        metric_sink=None,
+    ) -> None:
+        super().__init__(config)
+        self._global_tu = global_taskunit
+        self._local_tu = local_taskunit
+        self._metric_sink = metric_sink
+        self._master: Optional[ETMaster] = None
+        self._handle: Optional[TableHandle] = None
+        self._owns_model_table = True
+        self._workers: List[WorkerTasklet] = []
+        self._ctrl: Optional[MiniBatchController] = None
+        self.progress: Optional[BatchProgressTracker] = None
+
+    # -- setup -----------------------------------------------------------
+
+    def _make_trainer(self):
+        if not self.config.trainer:
+            raise ValueError(f"job {self.config.job_id}: no trainer configured")
+        cls = resolve_symbol(self.config.trainer)
+        return cls(**self.config.params.app_params)
+
+    def _make_data(self) -> List[np.ndarray]:
+        user = self.config.user
+        if "data_fn" not in user:
+            raise ValueError(f"job {self.config.job_id}: user.data_fn missing")
+        fn = resolve_symbol(user["data_fn"])
+        out = fn(**user.get("data_args", {}))
+        return [np.asarray(a) for a in (out if isinstance(out, (tuple, list)) else (out,))]
+
+    def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
+        self._master = master
+        cfg = self.config
+        trainer = self._make_trainer()
+        data_axis = max(1, cfg.user.get("data_axis", 1))
+        if cfg.tables:
+            # Explicit table id => shared-table semantics: reuse if it exists
+            # (the reference reuses same-id tables across jobs,
+            # DolphinJobEntity.java:76-121 — deliberately shared state).
+            self._handle, created = master.get_or_create_table(
+                cfg.tables[0], executor_ids, data_axis
+            )
+            self._owns_model_table = created
+        else:
+            # Trainer-default schema => PRIVATE model table: namespace by job
+            # id so two concurrent jobs of the same app never collide on the
+            # trainer's fixed default id (e.g. two MLR jobs both saying
+            # "mlr-model").
+            table_cfg = trainer.model_table_config()
+            table_cfg = table_cfg.replace(
+                table_id=f"{cfg.job_id}:{table_cfg.table_id}"
+            )
+            self._handle = master.create_table(table_cfg, executor_ids, data_axis)
+            self._owns_model_table = True
+        self._trainer_factory = lambda: (
+            resolve_symbol(cfg.trainer)(**cfg.params.app_params)
+        )
+        self._executor_ids = list(executor_ids)
+        self._data_arrays = self._make_data()
+
+    # -- run (the DolphinMaster.start analogue) --------------------------
+
+    def run(self) -> Dict[str, Any]:
+        cfg = self.config
+        params: TrainerParams = cfg.params
+        num_workers = cfg.num_workers or 1
+        nb = params.num_mini_batches
+        self.progress = BatchProgressTracker(nb)
+        self._ctrl = (
+            MiniBatchController(
+                params.clock_slack, params.num_epochs * nb, tracker=self.progress
+            )
+            if num_workers > 1
+            else None
+        )
+        wsm = WorkerStateManager([f"{cfg.job_id}/w{i}" for i in range(num_workers)])
+        if self._global_tu is not None:
+            self._global_tu.on_job_start(
+                cfg.job_id, [f"{cfg.job_id}/w{i}" for i in range(num_workers)]
+            )
+        n = self._data_arrays[0].shape[0]
+        if n < num_workers * nb:
+            raise ValueError(
+                f"job {cfg.job_id}: {n} examples cannot feed {num_workers} "
+                f"workers x {nb} mini-batches"
+            )
+        per = n // num_workers
+        results: Dict[str, Any] = {}
+        errors: List[BaseException] = []
+
+        def run_worker(idx: int) -> None:
+            wid = f"{cfg.job_id}/w{idx}"
+            try:
+                wsm.await_barrier(wid, "INIT")
+                # Last worker takes the remainder so no example is dropped.
+                hi = (idx + 1) * per if idx < num_workers - 1 else n
+                sl = slice(idx * per, hi)
+                data = TrainingDataProvider([a[sl] for a in self._data_arrays], nb)
+                ctx = TrainerContext(
+                    params=params,
+                    model_table=self._handle.table,
+                    worker_id=wid,
+                    num_workers=num_workers,
+                )
+                taskunit = (
+                    TaskUnitClient(cfg.job_id, wid, self._global_tu, self._local_tu)
+                    if self._global_tu is not None and self._local_tu is not None
+                    else None
+                )
+                worker = WorkerTasklet(
+                    cfg.job_id,
+                    ctx,
+                    self._trainer_factory(),
+                    data,
+                    self._handle.table.mesh,
+                    collector=MetricCollector(sink=self._metric_sink),
+                    batch_barrier=(
+                        self._ctrl.make_barrier(wid) if self._ctrl is not None else None
+                    ),
+                    taskunit=taskunit,
+                )
+                self._workers.append(worker)
+                results[wid] = worker.run()
+            except BaseException as e:  # noqa: BLE001 - reported to dispatcher
+                errors.append(e)
+            finally:
+                if self._ctrl is not None:
+                    self._ctrl.deregister_worker(wid)
+                if self._global_tu is not None:
+                    # Shrink the TaskUnit quorum, or surviving workers
+                    # deadlock waiting for this one's phase announcements.
+                    self._global_tu.on_executor_done(cfg.job_id, wid)
+                wsm.await_barrier(wid, "CLEANUP", timeout=60)
+
+        threads = [
+            threading.Thread(target=run_worker, args=(i,), name=f"{cfg.job_id}-w{i}")
+            for i in range(num_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self._global_tu is not None:
+            self._global_tu.on_job_finish(cfg.job_id)
+        if errors:
+            raise errors[0]
+        return {"job_id": cfg.job_id, "workers": results}
+
+    # -- teardown --------------------------------------------------------
+
+    def cleanup(self) -> None:
+        """Drop job-owned tables (ref: JobDispatcher drops tables at job
+        end; shared/reused tables survive)."""
+        if self._owns_model_table and self._handle is not None:
+            self._handle.drop()
+        self._handle = None
+
+    @property
+    def table_handle(self) -> Optional[TableHandle]:
+        return self._handle
+
+
+def build_entity(config: JobConfig, **kwargs) -> JobEntity:
+    """App-type dispatch (ref: JobEntity.getJobEntity app-type switch)."""
+    if config.app_type == "dolphin":
+        return DolphinJobEntity(config, **kwargs)
+    raise ValueError(f"unknown app_type {config.app_type!r}")

@@ -1,0 +1,92 @@
+"""Pluggable global job scheduling.
+
+Parity with the reference's JobScheduler SPI (jobserver/driver/
+JobScheduler.java: onJobArrival / onJobFinish / onResourceChange, pluggable
+via the -scheduler flag, bin/start_jobserver.sh:21) and its default
+SchedulerImpl, which runs every job immediately on ALL executors —
+multi-tenant overlap on the shared pool (SchedulerImpl.java:28-66).
+
+Also ships a FIFO-exclusive policy (jobs get the whole pool one at a time)
+as the second built-in, mirroring how the reference's pluggability was
+actually used.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+from harmony_tpu.config.params import JobConfig
+
+# Callback the server provides: actually launch the job on these executors.
+LaunchFn = Callable[[JobConfig, List[str]], None]
+
+
+class JobScheduler:
+    """SPI. Implementations decide when a job runs and on which executors."""
+
+    def bind(self, executor_ids: List[str], launch: LaunchFn) -> None:
+        self._executors = list(executor_ids)
+        self._launch = launch
+
+    def on_job_arrival(self, config: JobConfig) -> None:
+        raise NotImplementedError
+
+    def on_job_finish(self, job_id: str) -> None:
+        raise NotImplementedError
+
+    def on_resource_change(self, executor_ids: List[str]) -> None:
+        self._executors = list(executor_ids)
+
+
+class ShareAllScheduler(JobScheduler):
+    """Default: every job starts immediately on ALL executors (the
+    reference's SchedulerImpl multi-tenant overlap)."""
+
+    def on_job_arrival(self, config: JobConfig) -> None:
+        self._launch(config, list(self._executors))
+
+    def on_job_finish(self, job_id: str) -> None:
+        pass
+
+
+class FifoExclusiveScheduler(JobScheduler):
+    """One job at a time on the whole pool; arrivals queue."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queue: Deque[JobConfig] = deque()
+        self._running: Optional[str] = None
+
+    def on_job_arrival(self, config: JobConfig) -> None:
+        with self._lock:
+            if self._running is not None:
+                self._queue.append(config)
+                return
+            self._running = config.job_id
+        self._launch(config, list(self._executors))
+
+    def on_job_finish(self, job_id: str) -> None:
+        nxt = None
+        with self._lock:
+            if self._running == job_id:
+                self._running = None
+                if self._queue:
+                    nxt = self._queue.popleft()
+                    self._running = nxt.job_id
+        if nxt is not None:
+            self._launch(nxt, list(self._executors))
+
+
+_SCHEDULERS: Dict[str, type] = {
+    "share_all": ShareAllScheduler,
+    "fifo": FifoExclusiveScheduler,
+}
+
+
+def make_scheduler(name: str) -> JobScheduler:
+    """Scheduler-by-name (the -scheduler flag analogue)."""
+    try:
+        return _SCHEDULERS[name]()
+    except KeyError:
+        raise KeyError(f"unknown scheduler {name!r}; have {sorted(_SCHEDULERS)}") from None

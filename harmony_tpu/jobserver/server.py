@@ -1,0 +1,237 @@
+"""JobServer — the long-running multi-tenant master.
+
+Parity with the reference's jobserver (SURVEY.md §2.5):
+
+  * lifecycle state machine NOT_INIT -> INIT -> CLOSED
+    (ref: JobServerDriver.java:56-305),
+  * ResourcePool: acquire N homogeneous executors from the ETMaster once at
+    startup; all jobs share them (ref: ResourcePool.java:39-106),
+  * submit handling: deserialize the job config, build the JobEntity, hand
+    to the pluggable JobScheduler (ref: submit handling
+    JobServerDriver.java:239-257),
+  * JobDispatcher: per job — setup tables -> register -> TaskUnit
+    on_job_start -> run -> drop tables -> deregister -> scheduler
+    on_job_finish (ref: JobDispatcher.java:55-87),
+  * graceful shutdown waits for running jobs (ref: shutdown 178-214),
+  * a TCP command endpoint on localhost accepting SUBMIT/SHUTDOWN
+    (ref: CommandSender/Listener socket protocol, client/CommandSender.java:
+    49-80) — see client.py for the wire format.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from harmony_tpu.config.base import ConfigBase
+from harmony_tpu.config.params import JobConfig
+from harmony_tpu.jobserver.entity import JobEntity, build_entity
+from harmony_tpu.jobserver.scheduler import JobScheduler, ShareAllScheduler, make_scheduler
+from harmony_tpu.metrics.manager import MetricManager
+from harmony_tpu.parallel.mesh import DevicePool
+from harmony_tpu.runtime.master import ETMaster
+from harmony_tpu.runtime.taskunit import GlobalTaskUnitScheduler, LocalTaskUnitScheduler
+from harmony_tpu.utils.statemachine import StateMachine
+
+
+class JobResult:
+    def __init__(self) -> None:
+        self.future: "Future[Dict[str, Any]]" = Future()
+
+
+class JobServer:
+    def __init__(
+        self,
+        num_executors: int,
+        scheduler: Optional[JobScheduler | str] = None,
+        device_pool: Optional[DevicePool] = None,
+        cpu_slots: int = 1,
+        net_slots: int = 2,
+    ) -> None:
+        if isinstance(scheduler, str):
+            scheduler = make_scheduler(scheduler)  # the -scheduler flag analogue
+        self._state = StateMachine(
+            states=["NOT_INIT", "INIT", "CLOSING", "CLOSED"],
+            transitions=[
+                ("NOT_INIT", "INIT"),
+                ("INIT", "CLOSING"),
+                ("CLOSING", "CLOSED"),
+            ],
+            initial="NOT_INIT",
+        )
+        self.master = ETMaster(device_pool)
+        self.metrics = MetricManager()
+        self.metrics.start_collection()
+        self.global_taskunit = GlobalTaskUnitScheduler()
+        self.local_taskunit = LocalTaskUnitScheduler(cpu_slots, net_slots)
+        self._scheduler = scheduler or ShareAllScheduler()
+        self._num_executors = num_executors
+        self._jobs: Dict[str, JobResult] = {}
+        self._entities: Dict[str, JobEntity] = {}
+        self._lock = threading.Lock()
+        self._tcp_thread: Optional[threading.Thread] = None
+        self._tcp_sock: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Acquire the executor pool; become ready for submissions."""
+        executors = self.master.add_executors(self._num_executors)
+        self._scheduler.bind([e.id for e in executors], self._launch)
+        self._state.transition("INIT")
+
+    def shutdown(self, timeout: Optional[float] = 300.0) -> None:
+        """Graceful: stop accepting, drain running jobs, close (ref:
+        shutdown waits for jobs then runs deferred work,
+        JobServerDriver.java:178-214).
+
+        The accept-gate flips FIRST (INIT -> CLOSING) so nothing can slip in
+        while we drain — then the drain loop re-snapshots until no job is
+        left, covering jobs that were mid-submit when shutdown began."""
+        if not self._state.compare_and_transition("INIT", "CLOSING"):
+            self._state.wait_for("CLOSED", timeout=timeout)
+            return
+        self._stop_tcp()
+        while True:
+            with self._lock:
+                pending = [r for r in self._jobs.values() if not r.future.done()]
+            if not pending:
+                break
+            for jr in pending:
+                try:
+                    jr.future.result(timeout=timeout)
+                except Exception:
+                    pass  # job failures are visible via their futures
+        self._state.transition("CLOSED")
+
+    @property
+    def state(self) -> str:
+        return self._state.state
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, config: JobConfig) -> "Future[Dict[str, Any]]":
+        """SUBMIT: schedule a job; returns a future for its result."""
+        if not self._state.is_state("INIT"):
+            raise RuntimeError(f"server not accepting jobs (state={self.state})")
+        with self._lock:
+            existing = self._jobs.get(config.job_id)
+            if existing is not None and not existing.future.done():
+                raise ValueError(f"duplicate job id {config.job_id} (still running)")
+            if len(self._jobs) > 1024:  # bound registry growth on long-lived servers
+                for jid in [j for j, r in self._jobs.items() if r.future.done()]:
+                    del self._jobs[jid]
+            jr = JobResult()
+            self._jobs[config.job_id] = jr
+        self._scheduler.on_job_arrival(config)
+        return jr.future
+
+    def _launch(self, config: JobConfig, executor_ids: List[str]) -> None:
+        """Scheduler-chosen launch: dispatch the job on a thread (the
+        JobDispatcher.executeJob flow)."""
+        t = threading.Thread(
+            target=self._dispatch, args=(config, executor_ids), name=f"dispatch-{config.job_id}"
+        )
+        t.daemon = True
+        t.start()
+
+    def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
+        jr = self._jobs[config.job_id]
+        entity = build_entity(
+            config,
+            global_taskunit=self.global_taskunit,
+            local_taskunit=self.local_taskunit,
+            metric_sink=self.metrics.on_metric,
+        )
+        with self._lock:
+            self._entities[config.job_id] = entity
+        try:
+            entity.setup(self.master, executor_ids)
+            result = entity.run()
+            entity.cleanup()
+            jr.future.set_result(result)
+        except BaseException as e:  # noqa: BLE001 - delivered via future
+            try:
+                entity.cleanup()
+            except Exception:
+                pass
+            jr.future.set_exception(e)
+        finally:
+            with self._lock:
+                self._entities.pop(config.job_id, None)
+            self._scheduler.on_job_finish(config.job_id)
+
+    def running_jobs(self) -> List[str]:
+        with self._lock:
+            return [j for j, r in self._jobs.items() if not r.future.done()]
+
+    # -- TCP command endpoint (ref: CommandListener) ---------------------
+
+    def serve_tcp(self, port: int = 0) -> int:
+        """Listen on localhost; returns the bound port. Wire format: one JSON
+        object per connection: {"command": "SUBMIT", "conf": <JobConfig>} or
+        {"command": "SHUTDOWN"}; reply is one JSON object."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("127.0.0.1", port))
+        sock.listen(16)
+        self._tcp_sock = sock
+        self.port = sock.getsockname()[1]
+
+        def loop() -> None:
+            while True:
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return  # socket closed
+                threading.Thread(
+                    target=self._handle_conn, args=(conn,), daemon=True
+                ).start()
+
+        self._tcp_thread = threading.Thread(target=loop, daemon=True, name="jobserver-tcp")
+        self._tcp_thread.start()
+        return self.port
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        # The error reply MUST go out before `with conn` closes the socket —
+        # sending after close silently drops it and the client sees bare EOF.
+        with conn:
+            try:
+                data = b""
+                conn.settimeout(30)
+                while not data.endswith(b"\n"):
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                msg = json.loads(data.decode())
+                cmd = msg.get("command")
+                if cmd == "SUBMIT":
+                    config = ConfigBase.from_dict(msg["conf"])
+                    self.submit(config)
+                    reply = {"ok": True, "job_id": config.job_id}
+                elif cmd == "STATUS":
+                    reply = {"ok": True, "state": self.state, "running": self.running_jobs()}
+                elif cmd == "SHUTDOWN":
+                    threading.Thread(target=self.shutdown, daemon=True).start()
+                    reply = {"ok": True}
+                else:
+                    reply = {"ok": False, "error": f"unknown command {cmd!r}"}
+            except Exception as e:  # noqa: BLE001 - reported to the client
+                reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                conn.sendall((json.dumps(reply) + "\n").encode())
+            except OSError:
+                pass  # client went away; nothing to tell it
+
+    def _stop_tcp(self) -> None:
+        if self._tcp_sock is not None:
+            try:
+                self._tcp_sock.close()
+            except OSError:
+                pass
+            self._tcp_sock = None

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -202,6 +202,19 @@ class ETMaster:
             self._tables[config.table_id] = handle
             self._data_axis[config.table_id] = data_axis
             return handle
+
+    def get_or_create_table(
+        self,
+        config: TableConfig,
+        associators: Sequence[str],
+        data_axis: int = 1,
+    ) -> Tuple[TableHandle, bool]:
+        """Atomic check-then-create (two jobs racing to share one table id
+        must not both create it). Returns (handle, created)."""
+        with self._lock:
+            if config.table_id in self._tables:
+                return self._tables[config.table_id], False
+            return self.create_table(config, associators, data_axis), True
 
     def get_table(self, table_id: str) -> TableHandle:
         with self._lock:

@@ -1,0 +1,181 @@
+"""TaskUnit scheduling — Harmony's core multi-tenancy mechanism, rebuilt.
+
+The reference interleaves concurrent jobs on shared executors by slicing
+tasklet work into TaskUnits typed by the resource they saturate:
+
+  * local side: per-executor semaphores — 1 CPU slot, 2 NET slots; a tasklet
+    declares each phase (PULL=NET, COMP=CPU, PUSH=NET, SYNC=VOID) and blocks
+    until granted (ref: LocalTaskUnitScheduler.java:33-145; slot counts at
+    36-37),
+  * global side: the driver collects TaskUnitWaitMsg from every executor of
+    a job and, once ALL of them wait, broadcasts TaskUnitReadyMsg — yielding
+    one global order of TaskUnits across jobs so phases interleave
+    identically on every executor (ref: GlobalTaskUnitScheduler.java:29-92).
+
+TPU mapping: an "executor" is a worker thread driving jitted steps over the
+job's mesh slice; CPU slots gate device-compute-heavy units (fused steps),
+NET slots gate collective/transfer-heavy units (host-driven pulls/pushes,
+resharding). The wait/ready protocol is method calls on the in-process
+global scheduler; the API mirrors the message vocabulary so a multi-host
+control plane can sit behind it.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+# Unit kinds and which slot pool they consume (VOID consumes nothing —
+# barrier/sync phases, ref TaskUnitInfo ResourceType VOID).
+CPU = "CPU"
+NET = "NET"
+VOID = "VOID"
+
+# Phase -> resource typing (ref: WorkerTasklet declares PULL=NET, COMP=CPU,
+# PUSH=NET, SYNC=VOID when wrapping each phase in a TaskUnit).
+PHASE_RESOURCE = {
+    "PULL": NET,
+    "COMP": CPU,
+    "PUSH": NET,
+    "SYNC": VOID,
+    CPU: CPU,
+    NET: NET,
+    VOID: VOID,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskUnitInfo:
+    """Identity of one schedulable unit (ref: evaluator/impl/TaskUnitInfo)."""
+
+    job_id: str
+    executor_id: str
+    kind: str
+    seq: int  # per-(job, executor) monotonically increasing phase counter
+
+
+class GlobalTaskUnitScheduler:
+    """Driver-side: one global grant order across concurrent jobs."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._job_executors: Dict[str, Set[str]] = {}
+        # (job_id, seq, kind) -> executors currently waiting
+        self._waiting: Dict[Tuple[str, int, str], Set[str]] = {}
+        self._granted: Set[Tuple[str, int, str]] = set()
+        self._grant_log: List[Tuple[str, int, str]] = []
+
+    def on_job_start(self, job_id: str, executor_ids: List[str]) -> None:
+        with self._cond:
+            self._job_executors[job_id] = set(executor_ids)
+
+    def on_job_finish(self, job_id: str) -> None:
+        with self._cond:
+            self._job_executors.pop(job_id, None)
+            for key in [k for k in self._waiting if k[0] == job_id]:
+                del self._waiting[key]
+            for key in [k for k in self._granted if k[0] == job_id]:
+                self._granted.discard(key)
+            self._cond.notify_all()
+
+    def update_job_executors(self, job_id: str, executor_ids: List[str]) -> None:
+        """Reconfiguration adjusts the wait quorum."""
+        with self._cond:
+            self._job_executors[job_id] = set(executor_ids)
+            self._maybe_grant_locked()
+
+    def on_executor_done(self, job_id: str, executor_id: str) -> None:
+        """A worker that stopped (finished, early-stopped, or crashed) must
+        leave the quorum, or every surviving worker of the job deadlocks in
+        wait_ready forever (the analogue of the reference keeping barrier
+        counts consistent when executors leave)."""
+        with self._cond:
+            quorum = self._job_executors.get(job_id)
+            if quorum is not None:
+                quorum.discard(executor_id)
+            for waiters in self._waiting.values():
+                waiters.discard(executor_id)
+            self._maybe_grant_locked()
+
+    def wait_ready(self, unit: TaskUnitInfo, timeout: Optional[float] = None) -> bool:
+        """TaskUnitWaitMsg: block until the whole job's quorum waits on this
+        seq and the grant is broadcast (TaskUnitReadyMsg)."""
+        key = (unit.job_id, unit.seq, unit.kind)
+        with self._cond:
+            if unit.job_id not in self._job_executors:
+                return True  # job not registered: scheduling disabled for it
+            self._waiting.setdefault(key, set()).add(unit.executor_id)
+            self._maybe_grant_locked()
+            ok = self._cond.wait_for(lambda: key in self._granted, timeout=timeout)
+            return ok
+
+    def _maybe_grant_locked(self) -> None:
+        for key, waiters in list(self._waiting.items()):
+            job = key[0]
+            quorum = self._job_executors.get(job)
+            if quorum is not None and waiters and quorum <= waiters:
+                del self._waiting[key]
+                self._granted.add(key)
+                self._grant_log.append(key)
+                self._cond.notify_all()
+
+    def grant_order(self) -> List[Tuple[str, int, str]]:
+        """The single global TaskUnit order (for tests/metrics)."""
+        with self._cond:
+            return list(self._grant_log)
+
+
+class LocalTaskUnitScheduler:
+    """Executor-side slot gate (1 CPU / 2 NET by default)."""
+
+    def __init__(self, cpu_slots: int = 1, net_slots: int = 2) -> None:
+        self._sems = {
+            CPU: threading.BoundedSemaphore(cpu_slots),
+            NET: threading.BoundedSemaphore(net_slots),
+        }
+
+    def acquire(self, kind: str) -> None:
+        if kind != VOID:
+            self._sems[kind].acquire()
+
+    def release(self, kind: str) -> None:
+        if kind != VOID:
+            self._sems[kind].release()
+
+
+class TaskUnitClient:
+    """Per-(job, executor) handle workers use to wrap phases.
+
+    ``scope(kind)`` = waitSchedule: ask the global scheduler (quorum +
+    broadcast), then take the local slot; exit releases it
+    (ref: LocalTaskUnitScheduler.waitSchedule 83-102 + onTaskUnitFinished).
+    Plugs into WorkerTasklet(taskunit=...).
+    """
+
+    def __init__(
+        self,
+        job_id: str,
+        executor_id: str,
+        global_sched: GlobalTaskUnitScheduler,
+        local_sched: LocalTaskUnitScheduler,
+    ) -> None:
+        self.job_id = job_id
+        self.executor_id = executor_id
+        self._global = global_sched
+        self._local = local_sched
+        self._seq = itertools.count()
+
+    @contextlib.contextmanager
+    def scope(self, phase: str):
+        """Accepts a phase name (PULL/COMP/PUSH/SYNC) or a raw resource kind."""
+        kind = PHASE_RESOURCE[phase]
+        unit = TaskUnitInfo(self.job_id, self.executor_id, kind, next(self._seq))
+        self._global.wait_ready(unit)
+        self._local.acquire(kind)
+        try:
+            yield
+        finally:
+            self._local.release(kind)

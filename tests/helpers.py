@@ -1,0 +1,14 @@
+"""Importable helpers for jobserver tests (resolve_symbol needs real
+module-level symbols, mirroring how users ship trainer classes)."""
+from __future__ import annotations
+
+from harmony_tpu.apps.addvector import AddVectorTrainer
+
+
+class CrashOnW0Trainer(AddVectorTrainer):
+    """Fails during init on worker w0 only — exercises uneven worker death
+    (the surviving workers must not deadlock in the TaskUnit quorum)."""
+
+    def init_global_settings(self, ctx) -> None:
+        if ctx.worker_id.endswith("/w0"):
+            raise RuntimeError("synthetic failure on w0")

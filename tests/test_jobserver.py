@@ -1,0 +1,278 @@
+"""JobServer multi-tenancy + TaskUnit scheduling tests.
+
+Analogues of the reference's jobserver behavior: submit over the command
+channel, run-everywhere scheduling, concurrent jobs interleaved by the
+global TaskUnit order, graceful shutdown.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import JobConfig, TrainerParams
+from harmony_tpu.jobserver import (
+    FifoExclusiveScheduler,
+    JobServer,
+    ShareAllScheduler,
+    submit_job,
+)
+from harmony_tpu.jobserver.client import CommandSender
+from harmony_tpu.parallel import DevicePool
+from harmony_tpu.runtime.taskunit import (
+    CPU,
+    NET,
+    VOID,
+    GlobalTaskUnitScheduler,
+    LocalTaskUnitScheduler,
+    TaskUnitClient,
+    TaskUnitInfo,
+)
+
+
+def mlr_job(job_id="mlr", n=256, epochs=3, workers=1, slack=0):
+    return JobConfig(
+        job_id=job_id,
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs,
+            num_mini_batches=4,
+            clock_slack=slack,
+            app_params={
+                "num_classes": 4,
+                "num_features": 16,
+                "features_per_partition": 4,
+                "step_size": 0.5,
+            },
+        ),
+        num_workers=workers,
+        user={
+            "data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+            "data_args": {"n": n, "num_features": 16, "num_classes": 4, "seed": 7},
+        },
+    )
+
+
+def addvector_job(job_id="addv", n=128, epochs=2, workers=2, slack=1):
+    return JobConfig(
+        job_id=job_id,
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.addvector:AddVectorTrainer",
+        params=TrainerParams(
+            num_epochs=epochs,
+            num_mini_batches=4,
+            clock_slack=slack,
+            app_params={"num_keys": 8, "vector_dim": 2, "delta": 1.0},
+        ),
+        num_workers=workers,
+        user={
+            "data_fn": "harmony_tpu.apps.addvector:make_marks",
+            "data_args": {"n": n},
+        },
+    )
+
+
+class TestTaskUnits:
+    def test_quorum_grant_and_global_order(self):
+        g = GlobalTaskUnitScheduler()
+        g.on_job_start("j", ["e0", "e1"])
+        granted = []
+
+        def worker(eid):
+            g.wait_ready(TaskUnitInfo("j", eid, CPU, 0), timeout=5)
+            granted.append(eid)
+
+        t0 = threading.Thread(target=worker, args=("e0",))
+        t0.start()
+        time.sleep(0.1)
+        assert granted == []  # quorum incomplete: e0 must wait for e1
+        t1 = threading.Thread(target=worker, args=("e1",))
+        t1.start()
+        t0.join(timeout=5)
+        t1.join(timeout=5)
+        assert sorted(granted) == ["e0", "e1"]
+        assert g.grant_order() == [("j", 0, CPU)]
+
+    def test_unregistered_job_passes_through(self):
+        g = GlobalTaskUnitScheduler()
+        assert g.wait_ready(TaskUnitInfo("ghost", "e", CPU, 0), timeout=1)
+
+    def test_local_slots_bound_concurrency(self):
+        local = LocalTaskUnitScheduler(cpu_slots=1, net_slots=2)
+        running = {"CPU": 0, "max": 0}
+        lock = threading.Lock()
+
+        def use(kind):
+            local.acquire(kind)
+            with lock:
+                running["CPU"] += 1
+                running["max"] = max(running["max"], running["CPU"])
+            time.sleep(0.05)
+            with lock:
+                running["CPU"] -= 1
+            local.release(kind)
+
+        ts = [threading.Thread(target=use, args=(CPU,)) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert running["max"] == 1  # one CPU slot
+
+    def test_client_scope_sequences(self):
+        g = GlobalTaskUnitScheduler()
+        local = LocalTaskUnitScheduler()
+        g.on_job_start("j", ["e0"])
+        c = TaskUnitClient("j", "e0", g, local)
+        with c.scope(CPU):
+            pass
+        with c.scope(NET):
+            pass
+        assert [k for (_, _, k) in g.grant_order()] == [CPU, NET]
+
+
+class TestJobServer:
+    def test_single_job_end_to_end(self, devices):
+        server = JobServer(4, device_pool=DevicePool(devices[:4]))
+        server.start()
+        fut = server.submit(mlr_job())
+        result = fut.result(timeout=120)
+        assert "mlr/w0" in result["workers"]
+        losses = result["workers"]["mlr/w0"]["losses"]
+        assert losses[-1] < losses[0]
+        server.shutdown()
+        assert server.state == "CLOSED"
+        # job-owned table dropped at cleanup
+        assert server.master.table_ids() == []
+
+    def test_concurrent_multitenant_jobs(self, devices):
+        """MLR + AddVector concurrently on the SAME executors (ShareAll),
+        TaskUnit-scheduled; both finish correct."""
+        server = JobServer(4, device_pool=DevicePool(devices[:4]))
+        server.start()
+        f1 = server.submit(mlr_job(workers=2, slack=1, epochs=2))
+        f2 = server.submit(addvector_job(workers=2, slack=1))
+        r1 = f1.result(timeout=180)
+        r2 = f2.result(timeout=180)
+        assert len(r1["workers"]) == 2 and len(r2["workers"]) == 2
+        grants = server.global_taskunit.grant_order()
+        jobs_in_order = {j for (j, _, _) in grants}
+        assert jobs_in_order == {"mlr", "addv"}  # both flowed through one order
+        server.shutdown()
+
+    def test_addvector_exact_with_multitenancy(self, devices):
+        """Exact final table contents, validated via the shared-table path:
+        pre-creating the table under the explicit id means the job reuses it
+        (not owns it), so it survives job cleanup for inspection."""
+        from harmony_tpu.config.params import TableConfig
+
+        server = JobServer(4, device_pool=DevicePool(devices[:4]))
+        server.start()
+        n, epochs, workers = 128, 2, 2
+        shared_cfg = TableConfig(
+            table_id="shared-addv", capacity=8, value_shape=(2,), num_blocks=8
+        )
+        server.master.create_table(shared_cfg, server.master.executor_ids())
+        job = addvector_job(n=n, epochs=epochs, workers=workers)
+        job = job.replace(tables=[shared_cfg])
+        server.submit(job).result(timeout=120)
+        vals = np.asarray(server.master.get_table("shared-addv").table.pull_array())
+        np.testing.assert_allclose(vals, np.full((8, 2), n * epochs))
+        server.shutdown()
+
+    def test_two_same_app_jobs_do_not_share_model(self, devices):
+        """Two concurrent MLR jobs with trainer-default table ids must get
+        PRIVATE (job-namespaced) model tables."""
+        server = JobServer(4, device_pool=DevicePool(devices[:4]))
+        server.start()
+        seen_tables = set()
+        f1 = server.submit(mlr_job("dup-app-a", epochs=2))
+        f2 = server.submit(mlr_job("dup-app-b", epochs=2))
+        deadline = time.time() + 60
+        while time.time() < deadline and (not f1.done() or not f2.done()):
+            seen_tables.update(server.master.table_ids())
+            time.sleep(0.01)
+        f1.result(timeout=60)
+        f2.result(timeout=60)
+        assert "dup-app-a:mlr-model" in seen_tables
+        assert "dup-app-b:mlr-model" in seen_tables
+        server.shutdown()
+
+    def test_worker_crash_does_not_deadlock_taskunits(self, devices):
+        """w0 dies during init; w1 must finish (quorum shrinks) and the job
+        future must resolve with the error instead of hanging."""
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        job = addvector_job("crashy", workers=2)
+        job = job.replace(trainer="tests.helpers:CrashOnW0Trainer")
+        fut = server.submit(job)
+        with pytest.raises(RuntimeError, match="synthetic failure"):
+            fut.result(timeout=60)
+        server.shutdown(timeout=60)
+        assert server.state == "CLOSED"
+
+    def test_resubmit_after_completion_allowed(self, devices):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        server.submit(mlr_job("again", epochs=1)).result(timeout=120)
+        server.submit(mlr_job("again", epochs=1)).result(timeout=120)
+        server.shutdown()
+
+    def test_fifo_scheduler_serializes(self, devices):
+        server = JobServer(
+            4, scheduler=FifoExclusiveScheduler(), device_pool=DevicePool(devices[:4])
+        )
+        server.start()
+        seen = []
+        orig_launch = server._launch
+
+        def tracking_launch(cfg, execs):
+            seen.append((cfg.job_id, time.perf_counter()))
+            orig_launch(cfg, execs)
+
+        server._scheduler._launch = tracking_launch
+        f1 = server.submit(mlr_job("fifo-a", epochs=2))
+        f2 = server.submit(mlr_job("fifo-b", epochs=1))
+        f1.result(timeout=120)
+        f2.result(timeout=120)
+        assert [s[0] for s in seen] == ["fifo-a", "fifo-b"]
+        server.shutdown()
+
+    def test_duplicate_job_id_rejected(self, devices):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        f = server.submit(mlr_job("dup", epochs=1))
+        with pytest.raises(ValueError):
+            server.submit(mlr_job("dup"))
+        f.result(timeout=120)
+        server.shutdown()
+
+
+class TestTcpControlPlane:
+    def test_submit_status_shutdown_over_tcp(self, devices):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        sender = CommandSender(port)
+        reply = submit_job(mlr_job("tcp-job", epochs=1), port)
+        assert reply["job_id"] == "tcp-job"
+        status = sender.send_status_command()
+        assert status["ok"] and status["state"] == "INIT"
+        # wait for the job then shut down over the wire
+        deadline = time.time() + 120
+        while server.running_jobs() and time.time() < deadline:
+            time.sleep(0.1)
+        assert sender.send_shutdown_command()["ok"]
+        deadline = time.time() + 30
+        while server.state != "CLOSED" and time.time() < deadline:
+            time.sleep(0.05)
+        assert server.state == "CLOSED"
+
+    def test_bad_command_gets_error_reply(self, devices):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        reply = CommandSender(port)._roundtrip({"command": "NOPE"})
+        assert not reply["ok"] and "unknown command" in reply["error"]
+        server.shutdown()
