@@ -1,0 +1,3 @@
+from harmony_tpu.checkpoint.manager import CheckpointManager, CheckpointInfo
+
+__all__ = ["CheckpointManager", "CheckpointInfo"]

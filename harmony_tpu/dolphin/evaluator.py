@@ -1,0 +1,79 @@
+"""Model checkpoint chaining + offline evaluation replay.
+
+Parity with the reference's ModelChkpManager (dolphin/core/master/
+ModelChkpManager.java:40-80: chain model-table checkpoints during training,
+restore them between evaluation rounds) and ModelEvaluator /
+ModelEvaluationTasklet (dolphin/core/worker/ModelEvaluator.java: offline
+evaluation over checkpointed model tables + test data, run at job end or
+deferred to server shutdown — DolphinMaster.evaluate()).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from harmony_tpu.checkpoint.manager import CheckpointManager
+from harmony_tpu.dolphin.trainer import Trainer
+from harmony_tpu.runtime.master import ETMaster, TableHandle
+
+
+class ModelChkpManager:
+    """Chains per-epoch snapshots of the model table during training."""
+
+    def __init__(
+        self,
+        chkp_manager: CheckpointManager,
+        handle: TableHandle,
+        period: int = 1,
+        commit: bool = True,
+    ) -> None:
+        self._mgr = chkp_manager
+        self._handle = handle
+        self._period = max(1, period)
+        self._commit = commit
+        self.chkp_ids: List[str] = []
+
+    def on_epoch(self, epoch_idx: int) -> Optional[str]:
+        """Epoch hook: snapshot every ``period`` epochs. Plugs into
+        WorkerTasklet(epoch_callback=...)."""
+        if (epoch_idx + 1) % self._period:
+            return None
+        cid = self._mgr.checkpoint(self._handle, commit=self._commit)
+        self.chkp_ids.append(cid)
+        return cid
+
+
+class ModelEvaluator:
+    """Replays checkpoints against a trainer's evaluate() on test data.
+
+    The reference restores each chained checkpoint into a fresh table and
+    runs ModelEvaluationTasklet over it; here each checkpoint restores into
+    a temporary table on the given executors, evaluates, and drops.
+    """
+
+    def __init__(self, master: ETMaster, chkp_manager: CheckpointManager) -> None:
+        self._master = master
+        self._mgr = chkp_manager
+
+    def evaluate_checkpoints(
+        self,
+        chkp_ids: List[str],
+        trainer: Trainer,
+        test_batch: Tuple[np.ndarray, ...],
+        executor_ids: List[str],
+    ) -> List[Dict[str, float]]:
+        eval_fn = jax.jit(trainer.evaluate)
+        out: List[Dict[str, float]] = []
+        for i, cid in enumerate(chkp_ids):
+            handle = self._mgr.restore(
+                self._master, cid, executor_ids, table_id=f"__eval__:{cid}"
+            )
+            try:
+                model = handle.table.pull_array()
+                metrics = eval_fn(model, tuple(map(np.asarray, test_batch)))
+                out.append({k: float(v) for k, v in metrics.items()})
+            finally:
+                handle.drop()
+        return out

@@ -92,7 +92,6 @@ class DolphinJobEntity(JobEntity):
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
         self._master = master
         cfg = self.config
-        trainer = self._make_trainer()
         data_axis = max(1, cfg.user.get("data_axis", 1))
         if cfg.tables:
             # Explicit table id => shared-table semantics: reuse if it exists
@@ -107,7 +106,7 @@ class DolphinJobEntity(JobEntity):
             # id so two concurrent jobs of the same app never collide on the
             # trainer's fixed default id (e.g. two MLR jobs both saying
             # "mlr-model").
-            table_cfg = trainer.model_table_config()
+            table_cfg = self._make_trainer().model_table_config()
             table_cfg = table_cfg.replace(
                 table_id=f"{cfg.job_id}:{table_cfg.table_id}"
             )
@@ -124,7 +123,9 @@ class DolphinJobEntity(JobEntity):
     def run(self) -> Dict[str, Any]:
         cfg = self.config
         params: TrainerParams = cfg.params
-        num_workers = cfg.num_workers or 1
+        # num_workers == 0 means "one worker per granted executor" (the
+        # documented 'all executors' default, ref SchedulerImpl runs on all).
+        num_workers = cfg.num_workers or len(self._executor_ids)
         nb = params.num_mini_batches
         self.progress = BatchProgressTracker(nb)
         self._ctrl = (

@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
-import traceback
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -89,23 +89,30 @@ class JobServer:
         shutdown waits for jobs then runs deferred work,
         JobServerDriver.java:178-214).
 
-        The accept-gate flips FIRST (INIT -> CLOSING) so nothing can slip in
-        while we drain — then the drain loop re-snapshots until no job is
-        left, covering jobs that were mid-submit when shutdown began."""
-        if not self._state.compare_and_transition("INIT", "CLOSING"):
+        The accept-gate flips FIRST (INIT -> CLOSING, under the registry
+        lock so no mid-submit job can slip past it) — then the drain loop
+        re-snapshots until no job is left. ``timeout`` bounds the WHOLE
+        drain: a wedged job cannot hold shutdown hostage; the server closes
+        and the stragglers stay visible through their futures."""
+        with self._lock:
+            initiated = self._state.compare_and_transition("INIT", "CLOSING")
+        if not initiated:
             self._state.wait_for("CLOSED", timeout=timeout)
             return
         self._stop_tcp()
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._lock:
                 pending = [r for r in self._jobs.values() if not r.future.done()]
             if not pending:
                 break
-            for jr in pending:
-                try:
-                    jr.future.result(timeout=timeout)
-                except Exception:
-                    pass  # job failures are visible via their futures
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break  # timed out: close anyway, leave stragglers observable
+            try:
+                pending[0].future.result(timeout=remaining)
+            except Exception:
+                pass  # failures/timeouts are visible via the futures
         self._state.transition("CLOSED")
 
     @property
@@ -116,9 +123,12 @@ class JobServer:
 
     def submit(self, config: JobConfig) -> "Future[Dict[str, Any]]":
         """SUBMIT: schedule a job; returns a future for its result."""
-        if not self._state.is_state("INIT"):
-            raise RuntimeError(f"server not accepting jobs (state={self.state})")
         with self._lock:
+            # State checked under the registry lock: shutdown's INIT->CLOSING
+            # flip holds the same lock, so a submit can't interleave between
+            # the check and registration and launch after the drain.
+            if not self._state.is_state("INIT"):
+                raise RuntimeError(f"server not accepting jobs (state={self.state})")
             existing = self._jobs.get(config.job_id)
             if existing is not None and not existing.future.done():
                 raise ValueError(f"duplicate job id {config.job_id} (still running)")
@@ -141,24 +151,29 @@ class JobServer:
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
         jr = self._jobs[config.job_id]
-        entity = build_entity(
-            config,
-            global_taskunit=self.global_taskunit,
-            local_taskunit=self.local_taskunit,
-            metric_sink=self.metrics.on_metric,
-        )
-        with self._lock:
-            self._entities[config.job_id] = entity
+        entity = None
         try:
+            # build_entity inside the try: an unknown app_type or bad config
+            # must resolve the future (else callers hang) and must still run
+            # scheduler.on_job_finish (else FIFO wedges permanently).
+            entity = build_entity(
+                config,
+                global_taskunit=self.global_taskunit,
+                local_taskunit=self.local_taskunit,
+                metric_sink=self.metrics.on_metric,
+            )
+            with self._lock:
+                self._entities[config.job_id] = entity
             entity.setup(self.master, executor_ids)
             result = entity.run()
             entity.cleanup()
             jr.future.set_result(result)
         except BaseException as e:  # noqa: BLE001 - delivered via future
-            try:
-                entity.cleanup()
-            except Exception:
-                pass
+            if entity is not None:
+                try:
+                    entity.cleanup()
+                except Exception:
+                    pass
             jr.future.set_exception(e)
         finally:
             with self._lock:

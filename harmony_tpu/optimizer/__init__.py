@@ -1,0 +1,21 @@
+from harmony_tpu.optimizer.api import DolphinPlan, Optimizer, TransferStep
+from harmony_tpu.optimizer.compiler import PlanCompiler
+from harmony_tpu.optimizer.homogeneous import HomogeneousOptimizer
+from harmony_tpu.optimizer.sample import (
+    AddOneServerOptimizer,
+    DeleteOneServerOptimizer,
+    EmptyPlanOptimizer,
+)
+from harmony_tpu.optimizer.orchestrator import OptimizationOrchestrator
+
+__all__ = [
+    "Optimizer",
+    "DolphinPlan",
+    "TransferStep",
+    "PlanCompiler",
+    "HomogeneousOptimizer",
+    "AddOneServerOptimizer",
+    "DeleteOneServerOptimizer",
+    "EmptyPlanOptimizer",
+    "OptimizationOrchestrator",
+]

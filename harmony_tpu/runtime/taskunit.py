@@ -25,7 +25,7 @@ import contextlib
 import dataclasses
 import itertools
 import threading
-from collections import OrderedDict
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 # Unit kinds and which slot pool they consume (VOID consumes nothing —
@@ -66,7 +66,9 @@ class GlobalTaskUnitScheduler:
         # (job_id, seq, kind) -> executors currently waiting
         self._waiting: Dict[Tuple[str, int, str], Set[str]] = {}
         self._granted: Set[Tuple[str, int, str]] = set()
-        self._grant_log: List[Tuple[str, int, str]] = []
+        # Bounded: a long-lived server grants one entry per phase per batch
+        # forever; keep a recent window for tests/metrics, not full history.
+        self._grant_log: "OrderedDict | deque" = deque(maxlen=100_000)
 
     def on_job_start(self, job_id: str, executor_ids: List[str]) -> None:
         with self._cond:

@@ -12,3 +12,13 @@ class CrashOnW0Trainer(AddVectorTrainer):
     def init_global_settings(self, ctx) -> None:
         if ctx.worker_id.endswith("/w0"):
             raise RuntimeError("synthetic failure on w0")
+
+
+def slow_data(n: int = 32):
+    """Blocks long enough to wedge a job past any test shutdown timeout."""
+    import time
+
+    import numpy as np
+
+    time.sleep(15)
+    return (np.ones(n, np.float32),)

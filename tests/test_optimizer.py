@@ -1,0 +1,183 @@
+"""Optimizer layer tests: cost model decisions, plan compilation, the
+orchestrated metrics->plan->reshard loop under live training.
+
+Analogues of SampleOptimizersTest / PlanCompilerTest plus the orchestrator
+integration the reference exercises via forced reconfiguration.
+"""
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TableConfig, TrainerParams
+from harmony_tpu.metrics.collector import BatchMetrics
+from harmony_tpu.metrics.manager import MetricManager
+from harmony_tpu.optimizer import (
+    AddOneServerOptimizer,
+    DeleteOneServerOptimizer,
+    DolphinPlan,
+    EmptyPlanOptimizer,
+    HomogeneousOptimizer,
+    OptimizationOrchestrator,
+    PlanCompiler,
+    TransferStep,
+)
+from harmony_tpu.optimizer.api import EvaluatorParams
+from harmony_tpu.parallel import DevicePool
+from harmony_tpu.plan import PlanExecutor
+from harmony_tpu.runtime import ETMaster
+
+
+def params_with(comp_sec, comm_sec, counts, table_id="t"):
+    wm = [
+        BatchMetrics(
+            comp_time_sec=comp_sec,
+            pull_time_sec=comm_sec / 2,
+            push_time_sec=comm_sec / 2,
+            batch_time_sec=comp_sec + comm_sec,
+            num_examples=100,
+        )
+        for _ in range(4)
+    ]
+    return EvaluatorParams(worker_metrics=wm, table_id=table_id, block_counts=counts)
+
+
+class TestHomogeneousCostModel:
+    def test_compute_dominated_grows(self):
+        opt = HomogeneousOptimizer()
+        # heavy compute, no comm: more executors always predicted faster
+        p = params_with(1.0, 0.0, {"e0": 8, "e1": 8})
+        plan = opt.optimize(p, num_available_evaluators=4)
+        assert plan.evaluators_to_add and not plan.evaluators_to_delete
+        assert sum(t.num_blocks for t in plan.transfer_steps) > 0
+
+    def test_comm_dominated_shrinks(self):
+        opt = HomogeneousOptimizer()
+        # tiny compute, heavy comm: one owner is optimal
+        p = params_with(0.001, 1.0, {"e0": 8, "e1": 8})
+        plan = opt.optimize(p, num_available_evaluators=4)
+        assert plan.evaluators_to_delete == ["e0"] or plan.evaluators_to_delete == ["e1"]
+        # drain step precedes the delete
+        assert plan.transfer_steps and plan.transfer_steps[0].num_blocks == 8
+
+    def test_no_metrics_no_plan(self):
+        opt = HomogeneousOptimizer()
+        p = EvaluatorParams(block_counts={"e0": 8})
+        assert opt.optimize(p, 8).empty
+
+    def test_small_gain_suppressed(self):
+        opt = HomogeneousOptimizer(min_gain=0.5)
+        p = params_with(1.0, 0.9, {"e0": 8, "e1": 8})
+        assert opt.optimize(p, 3).empty
+
+
+class TestPlanCompiler:
+    def test_add_with_transfer_ordering(self, devices):
+        master = ETMaster(DevicePool(devices))
+        exs = master.add_executors(2)
+        cfg = TableConfig(table_id="pc", capacity=32, value_shape=(), num_blocks=8)
+        h = master.create_table(cfg, [e.id for e in exs])
+        dplan = DolphinPlan(
+            evaluators_to_add=["v0"],
+            transfer_steps=[TransferStep("pc", exs[0].id, "v0", 2)],
+        )
+        plan = PlanCompiler().compile(dplan, "pc")
+        assert plan.num_ops == 3  # allocate, associate, move
+        result = PlanExecutor(master).execute(plan)
+        assert result.success, result.error
+        assert len(h.block_manager.executors) == 3
+
+    def test_delete_orders_drain_first(self, devices):
+        master = ETMaster(DevicePool(devices))
+        exs = master.add_executors(3)
+        cfg = TableConfig(table_id="pc2", capacity=32, value_shape=(), num_blocks=9)
+        h = master.create_table(cfg, [e.id for e in exs])
+        victim = exs[2].id
+        dplan = DolphinPlan(
+            evaluators_to_delete=[victim],
+            transfer_steps=[TransferStep("pc2", victim, exs[0].id, 3)],
+        )
+        plan = PlanCompiler().compile(dplan, "pc2")
+        result = PlanExecutor(master).execute(plan)
+        assert result.success, result.error
+        assert victim not in master.executor_ids()
+
+
+class TestSampleOptimizers:
+    def test_add_one_fires_once(self):
+        opt = AddOneServerOptimizer(max_times=1)
+        p = params_with(1.0, 0.1, {"e0": 8, "e1": 4})
+        plan = opt.optimize(p, 3)  # total capacity 3 > 2 current owners
+        assert len(plan.evaluators_to_add) == 1
+        assert plan.transfer_steps[0].src == "e0"  # largest donor
+        assert opt.optimize(p, 3).empty  # spent
+
+    def test_add_one_respects_capacity_total(self):
+        opt = AddOneServerOptimizer()
+        p = params_with(1.0, 0.1, {"e0": 8, "e1": 4})
+        # total == current owners: pool exhausted, must not plan an add
+        assert opt.optimize(p, 2).empty
+
+    def test_delete_one_picks_smallest(self):
+        opt = DeleteOneServerOptimizer()
+        p = params_with(1.0, 0.1, {"e0": 8, "e1": 2})
+        plan = opt.optimize(p, 0)
+        assert plan.evaluators_to_delete == ["e1"]
+        assert plan.transfer_steps[0] == TransferStep("t", "e1", "e0", 2)
+
+
+class TestOrchestrator:
+    def test_full_loop_under_training(self, devices):
+        """Metrics -> AddOneServer plan -> live reshard while AddVector
+        trains; exact sums preserved and the reconfig is logged."""
+        from harmony_tpu.apps.addvector import AddVectorTrainer, make_marks
+        from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+        from harmony_tpu.metrics.collector import MetricCollector
+
+        master = ETMaster(DevicePool(devices[:4]))
+        exs = master.add_executors(2)
+        trainer = AddVectorTrainer(num_keys=16, vector_dim=2, delta=1.0)
+        handle = master.create_table(trainer.model_table_config(), [e.id for e in exs])
+        metrics = MetricManager()
+        metrics.start_collection()
+        orch = OptimizationOrchestrator(
+            master,
+            handle,
+            AddOneServerOptimizer(max_times=1),
+            metrics,
+            available_fn=lambda: 3,  # total: 2 owners + 1 free
+        )
+        n, epochs, nb = 128, 6, 4
+        worker = WorkerTasklet(
+            "orch-job",
+            TrainerContext(
+                params=TrainerParams(num_epochs=epochs, num_mini_batches=nb),
+                model_table=handle.table,
+            ),
+            trainer,
+            TrainingDataProvider(list(make_marks(n)), nb),
+            handle.table.mesh,
+            collector=MetricCollector(sink=metrics.on_metric),
+            epoch_callback=lambda e: orch.run_once() if e == 2 else None,
+        )
+        worker.run()
+        assert len(orch.reconfig_log) == 1 and orch.reconfig_log[0].success
+        assert len(handle.owning_executors()) == 3
+        np.testing.assert_allclose(
+            np.asarray(handle.table.pull_array()),
+            np.full((16, 2), trainer.expected_value(n * epochs)),
+        )
+
+    def test_periodic_thread_start_stop(self, devices):
+        master = ETMaster(DevicePool(devices[:2]))
+        exs = master.add_executors(1)
+        cfg = TableConfig(table_id="orch-t", capacity=8, value_shape=(), num_blocks=8)
+        handle = master.create_table(cfg, [e.id for e in exs])
+        metrics = MetricManager()
+        orch = OptimizationOrchestrator(
+            master, handle, EmptyPlanOptimizer(), metrics, period_sec=0.05
+        )
+        orch.start()
+        import time
+
+        time.sleep(0.3)
+        orch.stop()
+        assert orch.reconfig_log == []  # empty plans never execute

@@ -159,3 +159,40 @@ def test_indivisible_batch_clear_error(devices):
     w = WorkerTasklet("j", ctx, tr, TrainingDataProvider([x, y], 4), mesh)
     with pytest.raises(ValueError, match="not divisible by the.*data axis"):
         w.run()
+
+
+def test_unknown_app_type_resolves_future(devices):
+    """A bad submission must fail the future, not hang it (and must not wedge
+    the FIFO scheduler)."""
+    from harmony_tpu.config.params import JobConfig
+    from harmony_tpu.jobserver import FifoExclusiveScheduler, JobServer
+
+    server = JobServer(2, scheduler=FifoExclusiveScheduler(), device_pool=DevicePool(devices[:2]))
+    server.start()
+    fut = server.submit(JobConfig(job_id="bad", app_type="pregel-nope"))
+    with pytest.raises(ValueError, match="unknown app_type"):
+        fut.result(timeout=30)
+    # FIFO must have released the slot: a good job still runs
+    from tests.test_jobserver import mlr_job
+
+    server.submit(mlr_job("after-bad", epochs=1)).result(timeout=120)
+    server.shutdown()
+
+
+def test_shutdown_timeout_bounds_wedged_job(devices):
+    """shutdown(timeout=...) must return bounded even with a wedged job."""
+    import time as _time
+
+    from harmony_tpu.jobserver import JobServer
+    from tests.test_jobserver import addvector_job
+
+    server = JobServer(2, device_pool=DevicePool(devices[:2]))
+    server.start()
+    job = addvector_job("wedged", workers=1)
+    job = job.replace(user={"data_fn": "tests.helpers:slow_data", "data_args": {}})
+    server.submit(job)
+    _time.sleep(0.2)
+    t0 = _time.monotonic()
+    server.shutdown(timeout=2.0)
+    assert _time.monotonic() - t0 < 30
+    assert server.state == "CLOSED"
