@@ -1,0 +1,92 @@
+"""Lasso regression by stochastic coordinate descent.
+
+Capability parity with the reference's Lasso app (mlapps/lasso/
+LassoTrainer.java:40-48): per mini-batch, pull the whole model, run the
+"shooting" coordinate-descent sweep against the batch rows, push the weight
+deltas. The reference's per-coordinate Java loop becomes a ``lax.scan`` over
+coordinates (exact same math — soft-thresholded exact minimization with an
+incrementally maintained residual — but compiler-friendly), and mini-batches
+rotate through the data so successive sweeps see fresh rows (stochastic CD).
+
+Data: batch = (x [B, D], y [B]).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.dolphin.trainer import Trainer
+
+
+class LassoTrainer(Trainer):
+    pull_mode = "all"
+
+    def __init__(self, num_features: int, lam: float = 0.1) -> None:
+        self.num_features = num_features
+        self.lam = lam
+
+    def model_table_config(self, table_id: str = "lasso-model") -> TableConfig:
+        return TableConfig(
+            table_id=table_id,
+            capacity=self.num_features,
+            value_shape=(),
+            num_blocks=min(self.num_features, 64),
+            update_fn="add",
+        )
+
+    def compute(
+        self,
+        model: jnp.ndarray,  # w [D]
+        batch: Tuple[jnp.ndarray, jnp.ndarray],
+        hyper: Dict[str, jnp.ndarray],
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        x, y = batch[0].astype(jnp.float32), batch[1]
+        n = x.shape[0]
+        resid0 = y - x @ model
+
+        # The shooting sweep: exact sequential coordinate minimization over
+        # ALL coordinates on this batch, residual maintained incrementally.
+        def body(carry, j):
+            w, resid = carry
+            xj = jnp.take(x, j, axis=1)                 # [B]
+            wj = jnp.take(w, j)
+            zj = xj @ xj + 1e-12
+            rho = xj @ resid + zj * wj
+            wj_new = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - self.lam * n, 0.0) / zj
+            resid = resid - xj * (wj_new - wj)
+            return (w.at[j].set(wj_new), resid), None
+
+        coords = jnp.arange(self.num_features, dtype=jnp.int32)
+        (w_new, resid), _ = jax.lax.scan(body, (model, resid0), coords)
+        delta = w_new - model
+        loss = jnp.mean(resid**2) / 2 + self.lam * jnp.sum(jnp.abs(w_new))
+        return delta, {"loss": loss, "nnz": jnp.sum(jnp.abs(w_new) > 1e-6)}
+
+    def evaluate(self, model, batch) -> Dict[str, jnp.ndarray]:
+        x, y = batch[0], batch[1]
+        resid = y - x.astype(jnp.float32) @ model
+        return {
+            "loss": jnp.mean(resid**2) / 2 + self.lam * jnp.sum(jnp.abs(model)),
+            "mse": jnp.mean(resid**2),
+        }
+
+
+def make_synthetic(
+    n: int,
+    num_features: int,
+    nnz: int = 8,
+    noise: float = 0.01,
+    seed: int = 0,
+):
+    """Sparse ground truth regression problem."""
+    rng = np.random.default_rng(seed)
+    w_true = np.zeros(num_features, np.float32)
+    idx = rng.choice(num_features, nnz, replace=False)
+    w_true[idx] = rng.normal(size=nnz).astype(np.float32)
+    x = rng.normal(size=(n, num_features)).astype(np.float32)
+    y = x @ w_true + noise * rng.normal(size=n).astype(np.float32)
+    return x, y.astype(np.float32), w_true

@@ -121,20 +121,28 @@ class CheckpointManager:
         return chkp_id
 
     def commit(self, chkp_id: str) -> None:
-        """Stage 2: move temp -> durable (ref: commit on executor close;
-        atomic via rename)."""
+        """Stage 2: move temp -> durable (ref: commit on executor close).
+
+        Crash-safe across filesystems: the data is first copied to a
+        ``.staging`` directory INSIDE the durable root, then renamed into
+        place (same-FS rename = atomic), then the temp copy is removed. A
+        crash mid-copy leaves only a .staging orphan — the real id never
+        resolves to a partial directory, and the temp copy stays restorable.
+        """
         src = os.path.join(self.temp_root, chkp_id)
         dst = os.path.join(self.commit_root, chkp_id)
         if not os.path.isdir(src):
             raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
         info = self._load_manifest(src)
         info.committed = True
-        with open(os.path.join(src, "manifest.json"), "w") as f:
+        staging = dst + ".staging"
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)  # leftover from a crashed commit
+        shutil.copytree(src, staging)
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
             f.write(info.to_json())
-        # shutil.move, not os.rename: temp and durable roots are MEANT to be
-        # different filesystems (executor-local vs durable) where rename
-        # fails with EXDEV.
-        shutil.move(src, dst)
+        os.rename(staging, dst)
+        shutil.rmtree(src)
 
     # -- read path -------------------------------------------------------
 
@@ -157,8 +165,15 @@ class CheckpointManager:
 
     def list_checkpoints(self) -> List[str]:
         out = set(os.listdir(self.commit_root)) | set(os.listdir(self.temp_root))
-        return sorted(d for d in out if os.path.isdir(os.path.join(self.commit_root, d))
-                      or os.path.isdir(os.path.join(self.temp_root, d)))
+        return sorted(
+            d
+            for d in out
+            if not d.endswith(".staging")
+            and (
+                os.path.isdir(os.path.join(self.commit_root, d))
+                or os.path.isdir(os.path.join(self.temp_root, d))
+            )
+        )
 
     def restore(
         self,

@@ -61,6 +61,11 @@ class Trainer:
     """
 
     pull_mode: str = "all"
+    # True: the job also carries a worker-local table (ref: DolphinJobEntity
+    # optional local-model table, e.g. NMF's L-matrix rows); the fused step
+    # then threads BOTH table arrays functionally and ``compute_with_local``
+    # is used instead of ``compute``.
+    uses_local_table: bool = False
 
     # -- lifecycle (host side) ------------------------------------------
 
@@ -95,6 +100,23 @@ class Trainer:
         ``delta`` matches ``model``'s shape and is folded into the table via
         the table's update function (push). Must be jax-traceable.
         ``hyper`` carries the values from :meth:`hyperparams`."""
+        raise NotImplementedError
+
+    def compute_with_local(
+        self,
+        model: jnp.ndarray,
+        local: jnp.ndarray,
+        batch: Any,
+        hyper: Dict[str, jnp.ndarray],
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """Variant for uses_local_table trainers: returns
+        ``(model_delta, new_local_array, metrics)`` — the model delta folds
+        through the PS table's update fn; the local array is replaced
+        wholesale (worker-private state needs no update-fn semantics)."""
+        raise NotImplementedError
+
+    def local_table_config(self):
+        """Schema of the worker-local table (uses_local_table only)."""
         raise NotImplementedError
 
     def evaluate(

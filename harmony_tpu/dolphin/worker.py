@@ -59,6 +59,8 @@ class WorkerTasklet:
         taskunit: Optional[Any] = None,
         epoch_callback: Optional[Callable[[int], None]] = None,
         starting_epoch: int = 0,
+        global_init: bool = True,
+        post_init_barrier: Optional[Callable[[], None]] = None,
     ) -> None:
         self.job_id = job_id
         self.ctx = ctx
@@ -72,6 +74,12 @@ class WorkerTasklet:
         self.taskunit = taskunit
         self.epoch_callback = epoch_callback
         self.starting_epoch = starting_epoch  # resume (ref: StartingEpochIdx)
+        # Multi-worker jobs: exactly ONE worker (the chief) may run the
+        # trainer's global init — it writes shared tables, and N identical
+        # additive inits would accumulate N-fold (ref: initGlobalSettings is
+        # a per-JOB setup). post_init_barrier makes the others wait for it.
+        self.global_init = global_init
+        self.post_init_barrier = post_init_barrier
         self._step = None
         self._epoch_fn = None
         self._eval_fn = None
@@ -91,6 +99,21 @@ class WorkerTasklet:
         each dispatch so host-side decay is honored."""
         spec = self.ctx.model_table.spec
         trainer = self.trainer
+        if trainer.uses_local_table:
+            local_spec = self.ctx.local_table.spec
+
+            def _step(arr, local, batch, hyper):
+                model = spec.pull_all(arr)                         # PULL
+                lmodel = local_spec.pull_all(local)
+                delta, new_l, metrics = trainer.compute_with_local(
+                    model, lmodel, batch, hyper
+                )                                                  # COMP
+                return (
+                    spec.push_all(arr, delta),                     # PUSH
+                    local_spec.write_all(local, new_l),
+                ), metrics
+
+            return _step
         if trainer.pull_mode == "all":
 
             def _step(arr, batch, hyper):
@@ -118,15 +141,35 @@ class WorkerTasklet:
                 "each batch splits evenly across data-parallel shards"
             )
         step = self._step_core()
-        self._step = jax.jit(step, out_shardings=(table.sharding, None), donate_argnums=0)
-        if self._use_fused_epoch():
+        if self.trainer.uses_local_table:
+            local = self.ctx.local_table
+            out_sh = ((table.sharding, local.sharding), None)
+            self._step = jax.jit(step, out_shardings=out_sh, donate_argnums=(0, 1))
+            if self._use_fused_epoch():
 
-            def _epoch(arr, stacked, hyper):
-                return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
+                def _epoch2(arr, larr, stacked, hyper):
+                    def body(carry, b):
+                        (new_pair, metrics) = step(carry[0], carry[1], b, hyper)
+                        return new_pair, metrics
 
-            self._epoch_fn = jax.jit(
-                _epoch, out_shardings=(table.sharding, None), donate_argnums=0
+                    (fa, fl), ms = jax.lax.scan(body, (arr, larr), stacked)
+                    return (fa, fl), ms
+
+                self._epoch_fn = jax.jit(
+                    _epoch2, out_shardings=out_sh, donate_argnums=(0, 1)
+                )
+        else:
+            self._step = jax.jit(
+                step, out_shardings=(table.sharding, None), donate_argnums=0
             )
+            if self._use_fused_epoch():
+
+                def _epoch(arr, stacked, hyper):
+                    return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
+
+                self._epoch_fn = jax.jit(
+                    _epoch, out_shardings=(table.sharding, None), donate_argnums=0
+                )
         self._eval_fn = jax.jit(self.trainer.evaluate)
         self._step_sharding = table.sharding
         self._batch_sharding = NamedSharding(table.mesh, P(DATA_AXIS))
@@ -155,11 +198,27 @@ class WorkerTasklet:
     def _hyper(self) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v) for k, v in self.trainer.hyperparams().items()}
 
+    def _dispatch_step(self, fn, batch_like):
+        """Route the dispatch through the owning table lock(s)."""
+        from harmony_tpu.table.table import DenseTable
+
+        if self.trainer.uses_local_table:
+            return DenseTable.apply_step_multi(
+                [self.ctx.model_table, self.ctx.local_table],
+                fn,
+                batch_like,
+                self._hyper(),
+            )
+        return self.ctx.model_table.apply_step(fn, batch_like, self._hyper())
+
     # -- the loop --------------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
         ctx, params = self.ctx, self.ctx.params
-        self.trainer.init_global_settings(ctx)
+        if self.global_init:
+            self.trainer.init_global_settings(ctx)
+        if self.post_init_barrier is not None:
+            self.post_init_barrier()
         self._build_step()
         stop = False
         global_batch_idx = 0
@@ -209,7 +268,7 @@ class WorkerTasklet:
                         self._batch_cache[batch_idx] = batch_dev
                 else:
                     batch_dev = self._shard_batch(batch)
-                metrics = table.apply_step(self._step, batch_dev, self._hyper())
+                metrics = self._dispatch_step(self._step, batch_dev)
                 # Block on the step's own outputs (metrics), never on a table
                 # snapshot another worker's donating step could invalidate.
                 jax.block_until_ready(metrics)
@@ -244,9 +303,7 @@ class WorkerTasklet:
                 for i in range(len(batches[0]))
             )
         t0 = time.perf_counter()
-        stacked_metrics = table.apply_step(
-            self._epoch_fn, self._stacked_cache, self._hyper()
-        )
+        stacked_metrics = self._dispatch_step(self._epoch_fn, self._stacked_cache)
         jax.block_until_ready(stacked_metrics)
         dt = time.perf_counter() - t0
         nb = self.data.num_mini_batches

@@ -68,6 +68,7 @@ class DolphinJobEntity(JobEntity):
         self._metric_sink = metric_sink
         self._master: Optional[ETMaster] = None
         self._handle: Optional[TableHandle] = None
+        self._local_handle: Optional[TableHandle] = None
         self._owns_model_table = True
         self._workers: List[WorkerTasklet] = []
         self._ctrl: Optional[MiniBatchController] = None
@@ -93,6 +94,7 @@ class DolphinJobEntity(JobEntity):
         self._master = master
         cfg = self.config
         data_axis = max(1, cfg.user.get("data_axis", 1))
+        probe = self._make_trainer()  # one probe serves all schema queries
         if cfg.tables:
             # Explicit table id => shared-table semantics: reuse if it exists
             # (the reference reuses same-id tables across jobs,
@@ -106,7 +108,7 @@ class DolphinJobEntity(JobEntity):
             # id so two concurrent jobs of the same app never collide on the
             # trainer's fixed default id (e.g. two MLR jobs both saying
             # "mlr-model").
-            table_cfg = self._make_trainer().model_table_config()
+            table_cfg = probe.model_table_config()
             table_cfg = table_cfg.replace(
                 table_id=f"{cfg.job_id}:{table_cfg.table_id}"
             )
@@ -115,6 +117,13 @@ class DolphinJobEntity(JobEntity):
         self._trainer_factory = lambda: (
             resolve_symbol(cfg.trainer)(**cfg.params.app_params)
         )
+        # Worker-local model table (ref: DolphinJobEntity's optional
+        # local-model table, created on workers alongside the input table).
+        self._local_handle = None
+        if getattr(probe, "uses_local_table", False):
+            local_cfg = probe.local_table_config()
+            local_cfg = local_cfg.replace(table_id=f"{cfg.job_id}:{local_cfg.table_id}")
+            self._local_handle = master.create_table(local_cfg, executor_ids, data_axis)
         self._executor_ids = list(executor_ids)
         self._data_arrays = self._make_data()
 
@@ -136,6 +145,9 @@ class DolphinJobEntity(JobEntity):
             else None
         )
         wsm = WorkerStateManager([f"{cfg.job_id}/w{i}" for i in range(num_workers)])
+        # Chief-only global init: others wait here until it has run
+        # (see WorkerTasklet.global_init).
+        init_barrier = threading.Barrier(num_workers)
         if self._global_tu is not None:
             self._global_tu.on_job_start(
                 cfg.job_id, [f"{cfg.job_id}/w{i}" for i in range(num_workers)]
@@ -161,6 +173,11 @@ class DolphinJobEntity(JobEntity):
                 ctx = TrainerContext(
                     params=params,
                     model_table=self._handle.table,
+                    local_table=(
+                        self._local_handle.table
+                        if self._local_handle is not None
+                        else None
+                    ),
                     worker_id=wid,
                     num_workers=num_workers,
                 )
@@ -180,11 +197,17 @@ class DolphinJobEntity(JobEntity):
                         self._ctrl.make_barrier(wid) if self._ctrl is not None else None
                     ),
                     taskunit=taskunit,
+                    global_init=(idx == 0),
+                    post_init_barrier=init_barrier.wait,
                 )
                 self._workers.append(worker)
                 results[wid] = worker.run()
             except BaseException as e:  # noqa: BLE001 - reported to dispatcher
                 errors.append(e)
+                # A worker that dies before the init barrier must break it,
+                # or every other worker waits forever (fail-fast, like the
+                # reference's driver-kill on evaluator failure).
+                init_barrier.abort()
             finally:
                 if self._ctrl is not None:
                     self._ctrl.deregister_worker(wid)
@@ -215,6 +238,9 @@ class DolphinJobEntity(JobEntity):
         end; shared/reused tables survive)."""
         if self._owns_model_table and self._handle is not None:
             self._handle.drop()
+        if self._local_handle is not None:
+            self._local_handle.drop()
+            self._local_handle = None
         self._handle = None
 
     @property

@@ -71,6 +71,7 @@ class JobServer:
         self._num_executors = num_executors
         self._jobs: Dict[str, JobResult] = {}
         self._entities: Dict[str, JobEntity] = {}
+        self._dispatch_threads: List[threading.Thread] = []
         self._lock = threading.Lock()
         self._tcp_thread: Optional[threading.Thread] = None
         self._tcp_sock: Optional[socket.socket] = None
@@ -113,6 +114,16 @@ class JobServer:
                 pending[0].future.result(timeout=remaining)
             except Exception:
                 pass  # failures/timeouts are visible via the futures
+        # Join the dispatch threads themselves (not just their futures): a
+        # thread still unwinding its finally-block at interpreter exit gets
+        # killed mid-C++-teardown and aborts the process. Joins share the
+        # same deadline (+ a small grace period when already past it).
+        with self._lock:
+            threads = list(self._dispatch_threads)
+        grace = time.monotonic() + 5.0
+        for t in threads:
+            limit = grace if deadline is None else max(deadline, grace)
+            t.join(timeout=max(0.0, limit - time.monotonic()))
         self._state.transition("CLOSED")
 
     @property
@@ -147,6 +158,11 @@ class JobServer:
             target=self._dispatch, args=(config, executor_ids), name=f"dispatch-{config.job_id}"
         )
         t.daemon = True
+        with self._lock:
+            # prune finished threads so a long-lived server doesn't retain
+            # one dead Thread per job ever dispatched
+            self._dispatch_threads = [x for x in self._dispatch_threads if x.is_alive()]
+            self._dispatch_threads.append(t)
         t.start()
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:

@@ -1,0 +1,5 @@
+from harmony_tpu.pregel.graph import Graph
+from harmony_tpu.pregel.computation import Computation
+from harmony_tpu.pregel.master import PregelMaster
+
+__all__ = ["Graph", "Computation", "PregelMaster"]

@@ -1,0 +1,54 @@
+"""The vertex computation SPI.
+
+Parity with the reference's ``Computation`` + ``Vertex`` API
+(pregel/graph/api/: compute(vertex, messages), sendMessage, voteToHalt) and
+its message combiners (pregel/combiner/).
+
+TPU-first reshaping: per-vertex Java objects become vectorized pure
+functions over the whole partition —
+
+  * ``compute(superstep, state, msg, has_msg)`` — all vertices at once;
+    returns the new state and a vote-to-halt mask (the reference's
+    voteToHalt). Halted vertices are revived by incoming messages, exactly
+    like Pregel semantics.
+  * ``edge_message(superstep, src_state, weight)`` — the value each edge
+    carries from its source, vectorized over edges; the framework combines
+    messages per destination with the declared ``combiner`` ("add"/"min"/
+    "max" — the reference's MessageCombiner), realized as one XLA
+    segment-reduction instead of per-vertex message queues.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+
+class Computation:
+    combiner: str = "add"        # fold for messages to one destination
+    state_dim: int = 1           # per-vertex state width
+    # identity for the combiner; also the "no message" value
+    msg_identity: float = 0.0
+
+    def initial_state(self, num_vertices: int) -> jnp.ndarray:
+        """[num_vertices, state_dim] initial vertex values."""
+        raise NotImplementedError
+
+    def compute(
+        self,
+        superstep: jnp.ndarray,
+        state: jnp.ndarray,      # [V, state_dim]
+        msg: jnp.ndarray,        # [V] combined incoming message
+        has_msg: jnp.ndarray,    # [V] bool
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (new_state, vote_to_halt [V] bool)."""
+        raise NotImplementedError
+
+    def edge_message(
+        self,
+        superstep: jnp.ndarray,
+        src_state: jnp.ndarray,  # [E, state_dim] gathered source states
+        weight: jnp.ndarray,     # [E]
+    ) -> jnp.ndarray:
+        """[E] message values carried along each edge."""
+        raise NotImplementedError

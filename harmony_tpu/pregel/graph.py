@@ -1,0 +1,50 @@
+"""Graph container for Pregel jobs.
+
+The reference stores vertices in an ET vertex table partitioned across
+workers (pregel/graph/ + PregelDriver.java:53-111). Here the graph is
+edge-list arrays (src, dst, weight) plus per-vertex out-degrees — the layout
+message scatter needs; vertex *state* lives in a DenseTable (see master.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class Graph:
+    def __init__(
+        self,
+        num_vertices: int,
+        src: np.ndarray,
+        dst: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+    ) -> None:
+        if src.shape != dst.shape:
+            raise ValueError("src/dst must align")
+        if (src >= num_vertices).any() or (dst >= num_vertices).any():
+            raise ValueError("edge endpoint out of range")
+        self.num_vertices = num_vertices
+        self.src = src.astype(np.int32)
+        self.dst = dst.astype(np.int32)
+        self.weight = (
+            weight.astype(np.float32) if weight is not None else np.ones(len(src), np.float32)
+        )
+        self.out_degree = np.bincount(self.src, minlength=num_vertices).astype(np.float32)
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.src)
+
+    @staticmethod
+    def from_edge_list(num_vertices: int, edges) -> "Graph":
+        """edges: iterable of (src, dst) or (src, dst, weight)."""
+        arr = [tuple(e) for e in edges]
+        src = np.array([e[0] for e in arr])
+        dst = np.array([e[1] for e in arr])
+        w = (
+            np.array([e[2] for e in arr], np.float32)
+            if arr and len(arr[0]) > 2
+            else None
+        )
+        return Graph(num_vertices, src, dst, w)

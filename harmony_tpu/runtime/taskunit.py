@@ -68,7 +68,7 @@ class GlobalTaskUnitScheduler:
         self._granted: Set[Tuple[str, int, str]] = set()
         # Bounded: a long-lived server grants one entry per phase per batch
         # forever; keep a recent window for tests/metrics, not full history.
-        self._grant_log: "OrderedDict | deque" = deque(maxlen=100_000)
+        self._grant_log: deque = deque(maxlen=100_000)
 
     def on_job_start(self, job_id: str, executor_ids: List[str]) -> None:
         with self._cond:

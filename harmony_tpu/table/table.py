@@ -240,6 +240,25 @@ class DenseTable:
                 new_arr = jax.device_put(new_arr, self._sharding)
             self._arr = new_arr
 
+    @staticmethod
+    def apply_step_multi(tables: Sequence["DenseTable"], step_fn, *extra):
+        """Like :meth:`apply_step` for a step over SEVERAL tables:
+        ``step_fn(arr0, arr1, ..., *extra) -> ((new0, new1, ...), aux)``.
+        Locks are taken in the given order (callers must use a consistent
+        table order to stay deadlock-free); used for jobs with a worker-local
+        table next to the PS table (ref: DolphinJobEntity's optional
+        local-model table)."""
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            for t in tables:
+                stack.enter_context(t._lock)
+            arrs = [t._arr for t in tables]
+            new_arrs, aux = step_fn(*arrs, *extra)
+            for t, new in zip(tables, new_arrs):
+                t.commit(new)
+        return aux
+
     def apply_step(self, step_fn, *extra):
         """Dispatch a functional step ``step_fn(arr, *extra) -> (new_arr, aux)``
         and commit its result atomically w.r.t. every other table accessor.

@@ -1,0 +1,112 @@
+"""App-level E2E tests for NMF, Lasso, LDA (the mlapps parity suite)."""
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TrainerParams
+from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+from harmony_tpu.table import DenseTable, TableSpec
+
+
+def run(trainer, arrays, mesh, params, local=True):
+    model = DenseTable(TableSpec(trainer.model_table_config()), mesh)
+    local_t = (
+        DenseTable(TableSpec(trainer.local_table_config()), mesh)
+        if getattr(trainer, "uses_local_table", False)
+        else None
+    )
+    ctx = TrainerContext(params=params, model_table=model, local_table=local_t)
+    w = WorkerTasklet(
+        "app", ctx, trainer, TrainingDataProvider(arrays, params.num_mini_batches), mesh
+    )
+    return model, local_t, w.run()
+
+
+class TestNMF:
+    def test_factorization_reduces_loss(self, mesh8):
+        from harmony_tpu.apps.nmf import NMFTrainer, make_synthetic
+
+        rows, cols, rank = 64, 32, 4
+        row_idx, x = make_synthetic(rows, cols, rank, seed=3)
+        tr = NMFTrainer(rows, cols, rank, step_size=0.02, seed=3)
+        params = TrainerParams(num_epochs=10, num_mini_batches=4)
+        model, local_t, result = run(tr, [row_idx, x], mesh8, params)
+        losses = result["losses"]
+        assert losses[-1] < losses[0] * 0.5, losses
+        # factors stay non-negative
+        assert float(np.asarray(model.pull_array()).min()) >= 0.0
+        assert float(np.asarray(local_t.pull_array()).min()) >= 0.0
+
+    def test_reconstruction_quality(self, mesh8):
+        from harmony_tpu.apps.nmf import NMFTrainer, make_synthetic
+
+        rows, cols, rank = 32, 16, 3
+        row_idx, x = make_synthetic(rows, cols, rank, seed=4)
+        tr = NMFTrainer(rows, cols, rank, step_size=0.05, seed=4)
+        params = TrainerParams(num_epochs=40, num_mini_batches=2)
+        model, local_t, _ = run(tr, [row_idx, x], mesh8, params)
+        l = np.asarray(local_t.pull_array())
+        r = np.asarray(model.pull_array())
+        rel = np.linalg.norm(l @ r.T - x) / np.linalg.norm(x)
+        assert rel < 0.25, rel
+
+
+class TestLasso:
+    def test_recovers_sparse_support(self, mesh8):
+        from harmony_tpu.apps.lasso import LassoTrainer, make_synthetic
+
+        n, d, nb = 256, 64, 8
+        x, y, w_true = make_synthetic(n, d, nnz=6, seed=5)
+        tr = LassoTrainer(num_features=d, lam=0.05)
+        params = TrainerParams(num_epochs=6, num_mini_batches=nb)
+        model, _, result = run(tr, [x, y], mesh8, params)
+        w = np.asarray(model.pull_array())
+        # support recovery: the true nonzeros dominate
+        top = set(np.argsort(-np.abs(w))[:6])
+        truth = set(np.flatnonzero(w_true))
+        assert len(top & truth) >= 5, (sorted(top), sorted(truth))
+        assert result["losses"][-1] < result["losses"][0]
+
+    def test_l1_sparsity(self, mesh8):
+        from harmony_tpu.apps.lasso import LassoTrainer, make_synthetic
+
+        n, d, nb = 256, 64, 8
+        x, y, _ = make_synthetic(n, d, nnz=4, noise=0.0, seed=6)
+        tr = LassoTrainer(num_features=d, lam=0.5)
+        params = TrainerParams(num_epochs=6, num_mini_batches=nb)
+        model, _, _ = run(tr, [x, y], mesh8, params)
+        w = np.asarray(model.pull_array())
+        assert np.sum(np.abs(w) > 1e-4) <= 16  # heavy penalty -> sparse model
+
+
+class TestLDA:
+    def test_topics_concentrate(self, mesh8):
+        from harmony_tpu.apps.lda import LDATrainer, make_synthetic
+
+        docs, vocab, topics, dlen = 48, 40, 4, 24
+        doc_idx, tokens, seeds = make_synthetic(docs, vocab, topics, dlen, seed=7)
+        tr = LDATrainer(vocab, topics, docs, dlen)
+        params = TrainerParams(num_epochs=12, num_mini_batches=4)
+        model, local_t, _ = run(tr, [doc_idx, tokens, seeds], mesh8, params)
+        counts = np.asarray(model.pull_array())[:vocab]  # [V, K]
+        # count conservation: total assignments == total valid tokens
+        total_tokens = int((tokens >= 0).sum())
+        assert abs(counts.sum() - total_tokens) < 1e-3
+        # concentration: each vocab slice should be dominated by one topic
+        wpt = vocab // topics
+        dominances = []
+        for t in range(topics):
+            slice_counts = counts[t * wpt : (t + 1) * wpt].sum(axis=0)
+            dominances.append(slice_counts.max() / max(slice_counts.sum(), 1e-9))
+        assert np.mean(dominances) > 0.5, dominances
+
+    def test_assignments_valid(self, mesh8):
+        from harmony_tpu.apps.lda import LDATrainer, make_synthetic
+
+        docs, vocab, topics, dlen = 16, 20, 2, 8
+        doc_idx, tokens, seeds = make_synthetic(docs, vocab, topics, dlen, seed=8)
+        tr = LDATrainer(vocab, topics, docs, dlen)
+        params = TrainerParams(num_epochs=2, num_mini_batches=2)
+        _, local_t, _ = run(tr, [doc_idx, tokens, seeds], mesh8, params)
+        z = np.asarray(local_t.pull_array())
+        valid = tokens >= 0
+        assert ((z >= 0) & (z < topics))[valid].all()

@@ -1,0 +1,82 @@
+"""Pregel framework tests — PageRank & shortest path with exact/known
+answers (the analogue of the reference's pregel/integration/ExampleTest)."""
+import numpy as np
+import pytest
+
+from harmony_tpu.apps.pagerank import PageRankComputation
+from harmony_tpu.apps.sssp import INF, ShortestPathComputation
+from harmony_tpu.pregel import Graph, PregelMaster
+
+
+class TestGraph:
+    def test_from_edge_list(self):
+        g = Graph.from_edge_list(3, [(0, 1), (1, 2, 2.5)])
+        assert g.num_edges == 2
+        assert g.out_degree.tolist() == [1.0, 1.0, 0.0]
+
+    def test_bad_edges_rejected(self):
+        with pytest.raises(ValueError):
+            Graph.from_edge_list(2, [(0, 5)])
+
+
+class TestSSSP:
+    def test_line_graph_distances(self, mesh8):
+        # 0 -1-> 1 -2-> 2 -3-> 3
+        g = Graph.from_edge_list(4, [(0, 1, 1.0), (1, 2, 2.0), (2, 3, 3.0)])
+        master = PregelMaster(g, ShortestPathComputation(source=0), mesh8)
+        result = master.run()
+        np.testing.assert_allclose(
+            result["vertex_values"][:, 0], [0.0, 1.0, 3.0, 6.0]
+        )
+        assert result["supersteps"] <= 6  # halts promptly after convergence
+
+    def test_shorter_path_wins(self, mesh8):
+        # two routes 0->3: direct cost 10 vs 0->1->2->3 cost 3
+        g = Graph.from_edge_list(
+            4, [(0, 3, 10.0), (0, 1, 1.0), (1, 2, 1.0), (2, 3, 1.0)]
+        )
+        result = PregelMaster(g, ShortestPathComputation(0), mesh8).run()
+        assert result["vertex_values"][3, 0] == 3.0
+
+    def test_unreachable_stays_inf(self, mesh8):
+        g = Graph.from_edge_list(3, [(0, 1, 1.0)])
+        result = PregelMaster(g, ShortestPathComputation(0), mesh8).run()
+        assert result["vertex_values"][2, 0] >= INF
+
+    def test_cycle_terminates(self, mesh8):
+        g = Graph.from_edge_list(3, [(0, 1, 1.0), (1, 2, 1.0), (2, 0, 1.0)])
+        result = PregelMaster(g, ShortestPathComputation(0), mesh8).run()
+        np.testing.assert_allclose(result["vertex_values"][:, 0], [0.0, 1.0, 2.0])
+
+
+class TestPageRank:
+    def test_ranks_sum_to_one(self, mesh8):
+        g = Graph.from_edge_list(
+            4, [(0, 1), (1, 2), (2, 0), (2, 3), (3, 0)]
+        )
+        comp = PageRankComputation(g, num_iterations=15)
+        result = PregelMaster(g, comp, mesh8, max_supersteps=20).run()
+        ranks = result["vertex_values"][:, 0]
+        np.testing.assert_allclose(ranks.sum(), 1.0, atol=1e-3)
+        assert result["supersteps"] == 15  # halts at the num_iterations-th step
+
+    def test_matches_power_iteration(self, mesh8):
+        rng = np.random.default_rng(9)
+        V, E = 12, 40
+        src = rng.integers(0, V, E)
+        dst = rng.integers(0, V, E)
+        # ensure every vertex has at least one out-edge (dangling-free)
+        src = np.concatenate([src, np.arange(V)])
+        dst = np.concatenate([dst, (np.arange(V) + 1) % V])
+        g = Graph(V, src, dst)
+        comp = PageRankComputation(g, num_iterations=30)
+        result = PregelMaster(g, comp, mesh8, max_supersteps=40).run()
+        ranks = result["vertex_values"][:, 0]
+        # reference power iteration
+        M = np.zeros((V, V))
+        for s, d in zip(g.src, g.dst):
+            M[d, s] += 1.0 / g.out_degree[s]
+        r = np.full(V, 1.0 / V)
+        for _ in range(30):
+            r = 0.15 / V + 0.85 * M @ r
+        np.testing.assert_allclose(ranks, r, atol=1e-4)
