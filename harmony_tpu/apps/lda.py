@@ -54,6 +54,16 @@ class LDATrainer(Trainer):
         self.max_doc_len = max_doc_len
         self.alpha = alpha
         self.beta = beta
+        self._epoch = 0
+
+    def hyperparams(self) -> Dict[str, float]:
+        # Epoch counter folded into the Gibbs PRNG keys: without it every
+        # sweep would replay the same randomness per document and the chain
+        # degenerates into a deterministic fixed-point iteration.
+        return {"epoch": float(self._epoch)}
+
+    def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
+        self._epoch = epoch_idx + 1
 
     # -- table schemas ---------------------------------------------------
 
@@ -120,7 +130,10 @@ class LDATrainer(Trainer):
             + jnp.log(jnp.maximum(n_kw_excl + self.beta, 1e-10))
             - jnp.log(jnp.maximum(n_k_excl + V * self.beta, 1e-10))
         )                                     # [B, L, K]
-        keys = jax.vmap(jax.random.PRNGKey)(seeds.astype(jnp.uint32))
+        epoch = hyper.get("epoch", jnp.asarray(0.0)).astype(jnp.uint32)
+        keys = jax.vmap(
+            lambda s: jax.random.fold_in(jax.random.PRNGKey(s), epoch)
+        )(seeds.astype(jnp.uint32))
         z_new = jax.vmap(
             lambda k, lg: jax.random.categorical(k, lg, axis=-1)
         )(keys, logits)                       # [B, L]

@@ -25,19 +25,10 @@ import numpy as np
 
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
-from harmony_tpu.table.update import UpdateFunction, register_update_fn
 
-# R updates: additive gradient push, but values projected >= 0 at apply time
-# (the reference's NMFETModelUpdateFunction clamps negatives).
-register_update_fn(
-    UpdateFunction(
-        name="nmf_add_nonneg",
-        init=lambda key: jnp.zeros(()),
-        combine=jnp.add,
-        apply=lambda old, d: jnp.maximum(old + d, 0.0),
-        scatter_mode="add",  # projection happens in-trainer before push
-    )
-)
+# Non-negativity (the reference clamps in NMFETModelUpdateFunction at the
+# server) is enforced by the in-trainer projection before push — see the
+# max(0, ...) in compute_with_local — so the table uses the plain "add" fn.
 
 
 class NMFTrainer(Trainer):

@@ -35,7 +35,10 @@ class PageRankComputation(Computation):
         rank, deg = state[:, 0], state[:, 1]
         base = (1.0 - self.damping) / self.num_vertices
         new_rank = jnp.where(superstep > 0, base + self.damping * msg, rank)
-        halt = jnp.full(rank.shape, superstep >= self.num_iterations - 1)
+        # Superstep 0 only seeds; updates happen at supersteps 1..num_iterations,
+        # so halting at `superstep >= num_iterations` yields exactly
+        # num_iterations rank updates (halting one earlier would drop one).
+        halt = jnp.full(rank.shape, superstep >= self.num_iterations)
         return jnp.stack([new_rank, deg], axis=1), halt
 
     def edge_message(self, superstep, src_state, weight) -> jnp.ndarray:

@@ -131,6 +131,12 @@ class CheckpointManager:
         """
         src = os.path.join(self.temp_root, chkp_id)
         dst = os.path.join(self.commit_root, chkp_id)
+        if os.path.isdir(dst):
+            # Already committed (e.g. a crash landed between the rename and
+            # the temp cleanup of a previous commit): finish the cleanup and
+            # treat the retry as success — commit is idempotent.
+            shutil.rmtree(src, ignore_errors=True)
+            return
         if not os.path.isdir(src):
             raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
         info = self._load_manifest(src)
@@ -211,4 +217,10 @@ class CheckpointManager:
         return handle
 
     def delete(self, chkp_id: str) -> None:
-        shutil.rmtree(self._dir_of(chkp_id))
+        """Remove every copy (a crashed commit can leave the checkpoint in
+        both the temp and durable roots — delete both)."""
+        self._dir_of(chkp_id)  # raises if the id exists nowhere
+        for root in (self.commit_root, self.temp_root):
+            d = os.path.join(root, chkp_id)
+            if os.path.isdir(d):
+                shutil.rmtree(d)

@@ -84,6 +84,7 @@ class WorkerTasklet:
         self._epoch_fn = None
         self._eval_fn = None
         self._step_sharding = None
+        self._local_sharding = None
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
         # Keep device-resident copies of batches across epochs (kills the
         # per-epoch H2D re-transfer; only valid when batches are stable).
@@ -172,6 +173,9 @@ class WorkerTasklet:
                 )
         self._eval_fn = jax.jit(self.trainer.evaluate)
         self._step_sharding = table.sharding
+        self._local_sharding = (
+            self.ctx.local_table.sharding if self.trainer.uses_local_table else None
+        )
         self._batch_sharding = NamedSharding(table.mesh, P(DATA_AXIS))
         self._batch_cache.clear()   # cached batches live on the old mesh
         self._stacked_cache = None
@@ -186,10 +190,15 @@ class WorkerTasklet:
         )
 
     def _maybe_rebuild(self) -> None:
-        """Live re-sharding: if the table's layout changed since compile
+        """Live re-sharding: if EITHER table's layout changed since compile
         (plan-driven migration), rebuild so out_shardings/donation target the
         new mesh instead of pinning results to released devices."""
         if self.ctx.model_table.sharding != self._step_sharding:
+            self._build_step()
+        elif (
+            self.trainer.uses_local_table
+            and self.ctx.local_table.sharding != self._local_sharding
+        ):
             self._build_step()
 
     def _shard_batch(self, batch: Tuple[np.ndarray, ...]):

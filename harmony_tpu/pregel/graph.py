@@ -22,7 +22,10 @@ class Graph:
     ) -> None:
         if src.shape != dst.shape:
             raise ValueError("src/dst must align")
-        if (src >= num_vertices).any() or (dst >= num_vertices).any():
+        if len(src) and (
+            (src >= num_vertices).any() or (dst >= num_vertices).any()
+            or (src < 0).any() or (dst < 0).any()
+        ):
             raise ValueError("edge endpoint out of range")
         self.num_vertices = num_vertices
         self.src = src.astype(np.int32)

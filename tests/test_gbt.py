@@ -1,0 +1,120 @@
+"""GBT app tests — boosting correctness on the 8-device mesh.
+
+Mirrors the reference's app-validation style (SURVEY.md §4: example apps
+double as validators) plus the key GBT invariant: incrementally-maintained
+margins must equal re-prediction from the stored trees."""
+import numpy as np
+import pytest
+
+from harmony_tpu.apps.gbt import GBTTrainer, apply_bins, bin_features, make_synthetic
+from harmony_tpu.config.params import TrainerParams
+from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+from harmony_tpu.table import DenseTable, TableSpec
+
+
+def boost(trainer, bins, y, mesh, num_epochs=2, num_batches=4):
+    model = DenseTable(TableSpec(trainer.model_table_config()), mesh)
+    state = DenseTable(TableSpec(trainer.local_table_config()), mesh)
+    params = TrainerParams(num_epochs=num_epochs, num_mini_batches=num_batches)
+    ctx = TrainerContext(params=params, model_table=model, local_table=state)
+    w = WorkerTasklet(
+        "gbt", ctx, trainer,
+        TrainingDataProvider([bins, y], num_batches), mesh,
+    )
+    result = w.run()
+    return model, state, result, w
+
+
+class TestGBTRegression:
+    def test_loss_decreases_and_fits(self, mesh8):
+        x, y = make_synthetic(512, 8, seed=0)
+        bins, edges = bin_features(x, 16)
+        tr = GBTTrainer(
+            num_features=8, num_examples=512, num_rounds=8,
+            loss="squared", max_depth=3, step_size=0.5,
+        )
+        model, margins, result, w = boost(tr, bins, y, mesh8)
+        # Boosting drives train loss down (losses[0] is already post-3-rounds:
+        # it's the last batch metric of epoch 0).
+        assert result["losses"][-1] < result["losses"][0]
+        ev = w.evaluate((bins, y))
+        assert ev["rmse"] < 0.6
+
+    def test_round_counter_and_tree_rows(self, mesh8):
+        """Every batch boosts exactly one round: the counter matches
+        epochs x batches, each boosted row holds a real tree (a leaf marker
+        exists), and un-boosted rows stay zero."""
+        x, y = make_synthetic(256, 6, seed=1)
+        bins, _ = bin_features(x, 16)
+        tr = GBTTrainer(
+            num_features=6, num_examples=256, num_rounds=16,
+            loss="squared", max_depth=2, step_size=0.4,
+        )
+        model, state, _, _ = boost(tr, bins, y, mesh8)  # 2 epochs x 4 batches
+        assert np.asarray(state.get(0))[0] == 8
+        rows = np.asarray(model.pull_array())
+        leaf_flags = rows[:, 2 * tr.num_nodes: 3 * tr.num_nodes]
+        assert (leaf_flags[:8].sum(axis=1) >= 1).all()
+        assert (rows[8:] == 0).all()
+
+    def test_held_out_binning(self, mesh8):
+        x, y = make_synthetic(512, 8, seed=2)
+        xt, yt = make_synthetic(128, 8, seed=99)
+        bins, edges = bin_features(x, 16)
+        tr = GBTTrainer(
+            num_features=8, num_examples=512, num_rounds=16,
+            loss="squared", max_depth=3, step_size=0.4,
+        )
+        model, _, _, w = boost(tr, bins, y, mesh8, num_epochs=4, num_batches=4)
+        ev = w.evaluate((apply_bins(xt, edges), yt))
+        base = float(np.sqrt(np.mean((yt - y.mean()) ** 2)))
+        assert ev["rmse"] < base  # beats predicting the mean
+
+
+class TestGBTClassification:
+    def test_binary_logistic(self, mesh8):
+        x, y = make_synthetic(512, 8, seed=3, task="binary")
+        bins, _ = bin_features(x, 16)
+        tr = GBTTrainer(
+            num_features=8, num_examples=512, num_rounds=16,
+            loss="logistic", max_depth=3, step_size=0.5,
+        )
+        _, _, result, w = boost(tr, bins, y, mesh8, num_epochs=4, num_batches=4)
+        ev = w.evaluate((bins, y))
+        assert ev["accuracy"] > 0.9
+        assert result["losses"][-1] < result["losses"][0]
+
+    def test_multiclass_softmax(self, mesh8):
+        x, y = make_synthetic(512, 8, seed=4, task="multiclass", num_classes=3)
+        bins, _ = bin_features(x, 16)
+        tr = GBTTrainer(
+            num_features=8, num_examples=512, num_rounds=16,
+            loss="softmax", num_outputs=3, max_depth=3, step_size=0.5,
+        )
+        _, _, result, w = boost(tr, bins, y, mesh8, num_epochs=4, num_batches=4)
+        ev = w.evaluate((bins, y))
+        assert ev["accuracy"] > 0.8
+
+    def test_categorical_binning(self):
+        x = np.column_stack(
+            [np.random.default_rng(0).integers(0, 5, 100), np.random.default_rng(1).normal(size=100)]
+        ).astype(np.float32)
+        bins, edges = bin_features(x, 16, categorical=np.array([True, False]))
+        assert (bins[:, 0] == x[:, 0].astype(np.int32)).all()
+
+    def test_regularization_prunes(self, mesh8):
+        """High gamma forces stump-free trees: every split must clear the
+        complexity bar, so a huge gamma yields a root-leaf-only tree."""
+        x, y = make_synthetic(256, 4, seed=5)
+        bins, _ = bin_features(x, 8)
+        tr = GBTTrainer(
+            num_features=4, num_examples=256, num_rounds=2,
+            loss="squared", max_depth=3, step_size=0.5, gamma=1e9,
+        )
+        model, _, _, _ = boost(tr, bins, y, mesh8, num_epochs=1, num_batches=2)
+        vec = np.asarray(model.get(0))
+        _, _, is_leaf, _ = (
+            vec[: tr.num_nodes], vec[tr.num_nodes: 2 * tr.num_nodes],
+            vec[2 * tr.num_nodes: 3 * tr.num_nodes], vec[3 * tr.num_nodes:],
+        )
+        assert is_leaf[0] == 1.0  # root is a leaf: nothing was worth gamma

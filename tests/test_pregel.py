@@ -58,7 +58,8 @@ class TestPageRank:
         result = PregelMaster(g, comp, mesh8, max_supersteps=20).run()
         ranks = result["vertex_values"][:, 0]
         np.testing.assert_allclose(ranks.sum(), 1.0, atol=1e-3)
-        assert result["supersteps"] == 15  # halts at the num_iterations-th step
+        # seed superstep + exactly num_iterations rank updates
+        assert result["supersteps"] == 16
 
     def test_matches_power_iteration(self, mesh8):
         rng = np.random.default_rng(9)
