@@ -299,9 +299,12 @@ class GBTTrainer(Trainer):
         g, h, loss = self._grad_hess(m, y)
         feat, thr, is_leaf, leaf_val, _ = self._grow_tree(bins, g, h)
         step = hyper["step"].astype(jnp.float32)
-        tree_vec = self._encode_tree(feat, thr, is_leaf, step * leaf_val)
-        # Write the tree at key = round (guard against budget overrun: rounds
-        # past capacity fold into the last row harmlessly — training is over).
+        # Rounds past num_rounds write NOTHING: the table's update fn is
+        # "add", so re-targeting an existing row would sum tree encodings
+        # elementwise and corrupt it. The mask freezes the ensemble once the
+        # budget is spent (extra batches just measure loss).
+        in_budget = (rnd < self.num_rounds).astype(jnp.float32)
+        tree_vec = self._encode_tree(feat, thr, is_leaf, step * leaf_val) * in_budget
         row = jnp.minimum(rnd, self.num_rounds - 1)
         delta = jnp.zeros(model.shape, model.dtype).at[row].set(tree_vec)
         new_local = local.at[0, 0].add(1.0)

@@ -62,6 +62,11 @@ class LDATrainer(Trainer):
         # degenerates into a deterministic fixed-point iteration.
         return {"epoch": float(self._epoch)}
 
+    def on_training_start(self, ctx: TrainerContext, starting_epoch: int) -> None:
+        # Resume: keep the PRNG fold aligned with the true epoch index so a
+        # restarted run never replays randomness already consumed.
+        self._epoch = starting_epoch
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
         self._epoch = epoch_idx + 1
 

@@ -27,8 +27,9 @@ from harmony_tpu.config.params import TableConfig
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 
 # Non-negativity (the reference clamps in NMFETModelUpdateFunction at the
-# server) is enforced by the in-trainer projection before push — see the
-# max(0, ...) in compute_with_local — so the table uses the plain "add" fn.
+# server) is enforced twice: the in-trainer projection keeps each worker's
+# delta valid, and the table's "add_nonneg" update fn clamps AFTER the fold —
+# concurrent deltas that are individually safe can still sum below zero.
 
 
 class NMFTrainer(Trainer):
@@ -61,7 +62,7 @@ class NMFTrainer(Trainer):
             capacity=self.num_cols,
             value_shape=(self.rank,),
             num_blocks=min(self.num_cols, 64),
-            update_fn="add",
+            update_fn="add_nonneg",
         )
 
     def local_table_config(self, table_id: str = "nmf-local") -> TableConfig:

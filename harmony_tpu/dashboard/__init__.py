@@ -1,0 +1,4 @@
+from harmony_tpu.dashboard.server import DashboardServer
+from harmony_tpu.dashboard.connector import DashboardConnector
+
+__all__ = ["DashboardServer", "DashboardConnector"]

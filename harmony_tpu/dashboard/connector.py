@@ -1,0 +1,66 @@
+"""Async metric forwarding to the dashboard.
+
+Parity with the reference's DashboardConnector (dolphin/dashboard/
+DashboardConnector.java:30-100): the driver POSTs metrics to the dashboard
+over HTTP *asynchronously* — a bounded queue drained by a background thread,
+drops (with a counter) instead of blocking the training path when the
+dashboard is slow or down.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class DashboardConnector:
+    def __init__(self, url: str, queue_size: int = 1024, timeout_sec: float = 2.0) -> None:
+        self.url = url.rstrip("/")
+        self.timeout_sec = timeout_sec
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue(maxsize=queue_size)
+        self.dropped = 0
+        self.sent = 0
+        self.errors = 0
+        self._thread = threading.Thread(
+            target=self._drain, name="dashboard-connector", daemon=True
+        )
+        self._thread.start()
+
+    def post(self, job_id: str, kind: str, payload: Dict[str, Any]) -> None:
+        """Enqueue without blocking; drop-newest on overflow (the training
+        loop never waits on observability)."""
+        try:
+            self._q.put_nowait({"job_id": job_id, "kind": kind, "payload": payload})
+        except queue.Full:
+            self.dropped += 1
+
+    def metric_sink(self, metric) -> None:
+        """Adapter for MetricCollector sinks: forwards dataclass metrics."""
+        kind = type(metric).__name__
+        job_id = getattr(metric, "job_id", "")
+        payload = {
+            k: v for k, v in vars(metric).items() if isinstance(v, (int, float, str))
+        }
+        self.post(job_id, kind, payload)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                req = urllib.request.Request(
+                    self.url + "/api/metrics",
+                    data=json.dumps(item).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=self.timeout_sec).read()
+                self.sent += 1
+            except Exception:
+                self.errors += 1
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=timeout)

@@ -1,0 +1,185 @@
+"""Metrics dashboard — HTTP + SQLite, dependency-free.
+
+Parity with the reference's dashboard (SURVEY.md §2.6: DashboardConnector
+POSTs metrics to a Flask+SQLite web app, jobserver/src/main/resources/
+dashboard/dashboard.py, launched by DashboardLauncher.java). Rebuilt on the
+stdlib: ``http.server.ThreadingHTTPServer`` + ``sqlite3`` — no Flask in the
+image, and the capability is the same: accept metric POSTs, persist them,
+serve a per-job view.
+
+Endpoints:
+  POST /api/metrics          {"job_id", "kind", "payload": {...}} -> stored
+  GET  /api/metrics?job_id=&kind=&limit=   -> JSON rows (newest first)
+  GET  /api/jobs             -> JSON job summary (count, last loss, kinds)
+  GET  /                     -> HTML summary table (the web UI)
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS metrics (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    job_id TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    payload TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_metrics_job ON metrics (job_id, kind, id);
+"""
+
+
+class DashboardServer:
+    """Serve on 127.0.0.1:port (port=0 picks a free one, like the launcher
+    probing for a usable port)."""
+
+    def __init__(self, db_path: str = ":memory:", port: int = 0) -> None:
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.executescript(_SCHEMA)
+        self._db_lock = threading.Lock()
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- storage ---------------------------------------------------------
+
+    def insert(self, job_id: str, kind: str, payload: Dict[str, Any]) -> None:
+        with self._db_lock:
+            self._db.execute(
+                "INSERT INTO metrics (ts, job_id, kind, payload) VALUES (?,?,?,?)",
+                (time.time(), job_id, kind, json.dumps(payload)),
+            )
+            self._db.commit()
+
+    def query(
+        self, job_id: Optional[str] = None, kind: Optional[str] = None, limit: int = 100
+    ) -> List[Dict[str, Any]]:
+        q = "SELECT ts, job_id, kind, payload FROM metrics"
+        cond, args = [], []
+        if job_id:
+            cond.append("job_id = ?")
+            args.append(job_id)
+        if kind:
+            cond.append("kind = ?")
+            args.append(kind)
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY id DESC LIMIT ?"
+        args.append(limit)
+        with self._db_lock:
+            rows = self._db.execute(q, args).fetchall()
+        return [
+            {"ts": ts, "job_id": j, "kind": k, "payload": json.loads(p)}
+            for ts, j, k, p in rows
+        ]
+
+    def jobs(self) -> List[Dict[str, Any]]:
+        with self._db_lock:
+            rows = self._db.execute(
+                "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
+            ).fetchall()
+        out = []
+        for job_id, count, last_ts in rows:
+            latest = self.query(job_id=job_id, limit=1)
+            last_loss = latest[0]["payload"].get("loss") if latest else None
+            out.append(
+                {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
+                 "last_loss": last_loss}
+            )
+        return out
+
+    # -- http ------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "DashboardServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+        with self._db_lock:
+            self._db.close()
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj: Any) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                if urlparse(self.path).path != "/api/metrics":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    msg = json.loads(self.rfile.read(n))
+                    server.insert(
+                        str(msg["job_id"]), str(msg["kind"]), dict(msg["payload"])
+                    )
+                    self._json(200, {"ok": True})
+                except Exception as e:  # bad payloads must not kill the server
+                    self._json(400, {"error": str(e)})
+
+            def do_GET(self) -> None:
+                parsed = urlparse(self.path)
+                if parsed.path == "/api/metrics":
+                    qs = parse_qs(parsed.query)
+                    self._json(
+                        200,
+                        server.query(
+                            job_id=qs.get("job_id", [None])[0],
+                            kind=qs.get("kind", [None])[0],
+                            limit=int(qs.get("limit", ["100"])[0]),
+                        ),
+                    )
+                elif parsed.path == "/api/jobs":
+                    self._json(200, server.jobs())
+                elif parsed.path == "/":
+                    rows = "".join(
+                        f"<tr><td>{j['job_id']}</td><td>{j['num_reports']}</td>"
+                        f"<td>{j['last_loss']}</td></tr>"
+                        for j in server.jobs()
+                    )
+                    body = (
+                        "<html><head><title>harmony_tpu dashboard</title></head>"
+                        "<body><h1>harmony_tpu jobs</h1>"
+                        "<table border=1><tr><th>job</th><th>reports</th>"
+                        f"<th>last loss</th></tr>{rows}</table></body></html>"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": "not found"})
+
+        return Handler

@@ -1,0 +1,43 @@
+from harmony_tpu.data.splits import SplitInfo, compute_splits, fetch_split
+from harmony_tpu.data.parsers import (
+    DataParser,
+    CsvParser,
+    LibSvmParser,
+    KeyValueVectorParser,
+    get_parser,
+    register_parser,
+)
+from harmony_tpu.data.storer import DataStorer, FileDataStorer
+
+
+def load_dataset(paths, parser, num_splits: int = 1):
+    """Worker-side input path: fetch+parse all splits and concatenate into
+    the arrays TrainingDataProvider consumes (the reference's input-table
+    bulk load collapsed to host arrays — SPMD workers shard per step)."""
+    import numpy as np
+
+    parts = []
+    for split in compute_splits(list(paths), num_splits):
+        records = fetch_split(split)
+        if records:
+            parts.append(parser.parse(records))
+    if not parts:
+        raise ValueError(f"no records in {paths}")
+    first = parts[0]
+    if isinstance(first, tuple):
+        return tuple(np.concatenate([p[i] for p in parts]) for i in range(len(first)))
+    return np.concatenate(parts)
+
+__all__ = [
+    "SplitInfo",
+    "compute_splits",
+    "fetch_split",
+    "DataParser",
+    "CsvParser",
+    "LibSvmParser",
+    "KeyValueVectorParser",
+    "get_parser",
+    "register_parser",
+    "DataStorer",
+    "FileDataStorer",
+]

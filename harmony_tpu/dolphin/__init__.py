@@ -1,6 +1,10 @@
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.dolphin.data import TrainingDataProvider
-from harmony_tpu.dolphin.accessor import ModelAccessor
+from harmony_tpu.dolphin.accessor import (
+    CachedModelAccessor,
+    ModelAccessor,
+    make_accessor,
+)
 from harmony_tpu.dolphin.worker import WorkerTasklet
 
 __all__ = [
@@ -8,5 +12,7 @@ __all__ = [
     "TrainerContext",
     "TrainingDataProvider",
     "ModelAccessor",
+    "CachedModelAccessor",
+    "make_accessor",
     "WorkerTasklet",
 ]

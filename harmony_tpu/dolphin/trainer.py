@@ -73,6 +73,12 @@ class Trainer:
         """One-time setup before the first epoch (may push initial model
         values into the table)."""
 
+    def on_training_start(self, ctx: TrainerContext, starting_epoch: int) -> None:
+        """Called by the worker just before the epoch loop with the resume
+        epoch (ref: StartingEpochIdx reaching the worker conf) — trainers
+        with epoch-dependent state (LDA's PRNG fold, decay schedules) must
+        seed from here, not assume epoch 0."""
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
         """Per-epoch hook (host side; may adjust step size etc.)."""
 

@@ -228,6 +228,7 @@ class WorkerTasklet:
             self.trainer.init_global_settings(ctx)
         if self.post_init_barrier is not None:
             self.post_init_barrier()
+        self.trainer.on_training_start(ctx, self.starting_epoch)
         self._build_step()
         stop = False
         global_batch_idx = 0

@@ -1,6 +1,12 @@
 from harmony_tpu.optimizer.api import DolphinPlan, Optimizer, TransferStep
 from harmony_tpu.optimizer.compiler import PlanCompiler
 from harmony_tpu.optimizer.homogeneous import HomogeneousOptimizer
+from harmony_tpu.optimizer.hetero import (
+    ExecutorProfile,
+    HeterogeneousOptimizer,
+    ILPSolver,
+    load_profiles,
+)
 from harmony_tpu.optimizer.sample import (
     AddOneServerOptimizer,
     DeleteOneServerOptimizer,
@@ -14,6 +20,10 @@ __all__ = [
     "TransferStep",
     "PlanCompiler",
     "HomogeneousOptimizer",
+    "HeterogeneousOptimizer",
+    "ILPSolver",
+    "ExecutorProfile",
+    "load_profiles",
     "AddOneServerOptimizer",
     "DeleteOneServerOptimizer",
     "EmptyPlanOptimizer",

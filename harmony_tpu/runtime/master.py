@@ -104,6 +104,27 @@ class TableHandle:
         self.block_manager.rebalance(list(executor_ids))
         self._reshard_to_owners()
 
+    def load(self, paths: Sequence[str], parser, num_splits: int = 0) -> int:
+        """Bulk-load keyed records from files (ref: AllocatedTable.load ->
+        TableLoadMsg -> BulkDataLoader -> table.multiPut). The driver
+        computes exactly one split per owning executor (ExactNumSplit
+        semantics) and each split's records are parsed and inserted; the
+        parser must yield ``(keys, values)`` (ExistKeyBulkDataLoader — keys
+        come from the data). Returns the number of records loaded."""
+        from harmony_tpu.data.splits import compute_splits, fetch_split
+
+        n = num_splits or max(len(self.owning_executors()), 1)
+        total = 0
+        for split in compute_splits(list(paths), n):
+            records = fetch_split(split)
+            if not records:
+                continue
+            keys, values = parser.parse(records)
+            if len(keys):
+                self.table.multi_put(keys, values)
+                total += len(keys)
+        return total
+
     def drop(self) -> None:
         self._master._drop_table(self.table_id)
 

@@ -121,14 +121,19 @@ class TableSpec:
         ref = arr.at[b, o]
         mode = self.update_fn.scatter_mode
         if mode == "add":
-            return ref.add(deltas.astype(arr.dtype))
-        if mode == "min":
-            return ref.min(deltas.astype(arr.dtype))
-        if mode == "max":
-            return ref.max(deltas.astype(arr.dtype))
-        if mode == "set":
-            return ref.set(deltas.astype(arr.dtype))
-        raise ValueError(f"unknown scatter_mode {mode!r}")
+            out = ref.add(deltas.astype(arr.dtype))
+        elif mode == "min":
+            out = ref.min(deltas.astype(arr.dtype))
+        elif mode == "max":
+            out = ref.max(deltas.astype(arr.dtype))
+        elif mode == "set":
+            out = ref.set(deltas.astype(arr.dtype))
+        else:
+            raise ValueError(f"unknown scatter_mode {mode!r}")
+        if self.update_fn.post is not None:
+            # Apply-time invariant on the touched entries only.
+            out = out.at[b, o].set(self.update_fn.post(out[b, o]))
+        return out
 
     def _pad_to_storage(self, values: jnp.ndarray, dtype) -> jnp.ndarray:
         """[capacity, *vshape] in key order -> storage layout (range tables
@@ -151,12 +156,16 @@ class TableSpec:
                 return self.write_all(arr, deltas)
             d = self._pad_to_storage(deltas, arr.dtype)
             if mode == "add":
-                return arr + d
-            if mode == "min":
-                return jnp.minimum(arr, d)
-            if mode == "max":
-                return jnp.maximum(arr, d)
-            raise ValueError(f"unknown scatter_mode {mode!r}")
+                out = arr + d
+            elif mode == "min":
+                out = jnp.minimum(arr, d)
+            elif mode == "max":
+                out = jnp.maximum(arr, d)
+            else:
+                raise ValueError(f"unknown scatter_mode {mode!r}")
+            if self.update_fn.post is not None:
+                out = self.update_fn.post(out)  # every entry is touched here
+            return out
         keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
         return self.push(arr, keys, deltas)
 
@@ -310,6 +319,19 @@ class DenseTable:
     # (ref: Table.updateNoReply / multiUpdateNoReply).
     update_no_reply = update
     multi_update_no_reply = multi_update
+
+    def multi_put(self, keys: Sequence[int], values: np.ndarray) -> None:
+        """Bulk set (no old-value return): the bulk-load insertion path
+        (ref: BulkDataLoader -> table.multiPut, HdfsSplitFetcher.java:44)."""
+        k = jnp.asarray(keys, dtype=jnp.int32)
+        v = jnp.asarray(values)
+
+        def _mput(a, kk, vv):
+            b, o = self.spec.partitioner.locate(kk)
+            return a.at[b, o].set(vv.astype(a.dtype))
+
+        with self._lock:
+            self._arr = self._jitted("multi_put", _mput)(self._arr, k, v)
 
     def put(self, key: int, value: np.ndarray) -> np.ndarray:
         """Set, returning the previous value (ref: Table.put returns old).

@@ -21,7 +21,7 @@ over the value shape.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import jax.numpy as jnp
 
@@ -38,6 +38,11 @@ class UpdateFunction:
     #   "add" -> at[].add, "min" -> at[].min, "max" -> at[].max,
     #   "set" -> at[].set (duplicate order unspecified, like concurrent puts).
     scatter_mode: str = "add"
+    # Optional elementwise transform applied to TOUCHED entries after the
+    # scatter fold — how apply-time invariants that aren't a pure fold (e.g.
+    # the reference NMF server's clamp-to-nonnegative updateValue) stay
+    # on-device: fold first, then post(new_value).
+    post: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None
 
 
 _REGISTRY: Dict[str, UpdateFunction] = {}
@@ -65,6 +70,20 @@ register_update_fn(
         init=lambda key: jnp.zeros(()),  # shape fixed up by the table's init broadcast
         combine=jnp.add,
         apply=jnp.add,
+    )
+)
+
+# Additive push with a non-negativity clamp at apply time (ref: NMF's
+# NMFETModelUpdateFunction clamping negatives at the server). The clamp runs
+# AFTER the fold, so concurrent deltas that individually preserve
+# non-negativity can't sum below zero.
+register_update_fn(
+    UpdateFunction(
+        name="add_nonneg",
+        init=lambda key: jnp.zeros(()),
+        combine=jnp.add,
+        apply=lambda old, d: jnp.maximum(old + d, 0.0),
+        post=lambda v: jnp.maximum(v, 0.0),
     )
 )
 

@@ -1,0 +1,28 @@
+from harmony_tpu.tracing.span import (
+    InMemorySpanReceiver,
+    LocalFileSpanReceiver,
+    Span,
+    SpanContext,
+    SpanReceiver,
+    Tracing,
+    current_span,
+    get_tracing,
+    set_tracing,
+    trace_span,
+)
+from harmony_tpu.tracing.profiler import device_trace, profile_session
+
+__all__ = [
+    "Span",
+    "SpanContext",
+    "SpanReceiver",
+    "InMemorySpanReceiver",
+    "LocalFileSpanReceiver",
+    "Tracing",
+    "trace_span",
+    "current_span",
+    "get_tracing",
+    "set_tracing",
+    "device_trace",
+    "profile_session",
+]

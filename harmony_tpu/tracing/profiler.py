@@ -1,0 +1,64 @@
+"""Device-side profiling hooks (the xprof / jax-profiler integration).
+
+SURVEY.md §5.9 maps the reference's HTrace wiring to "native profiler hooks
+(xprof/jax profiler) + spans" on TPU. This module is that bridge:
+
+  * ``device_trace(name)`` — annotate a region so it shows up named in a
+    captured device profile (jax.profiler.TraceAnnotation), AND as a host
+    span via tracing.span (one call sites both worlds);
+  * ``profile_session(logdir)`` — capture a full device trace
+    (jax.profiler.start_trace/stop_trace) around a code region; the
+    resulting xplane dump is the TPU analogue of a Zipkin trace for kernels.
+
+Both degrade to host-span-only when the profiler is unavailable (CPU test
+runs, ancient jax) — tracing never becomes a hard dependency of the hot
+path.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from harmony_tpu.tracing.span import trace_span
+
+
+@contextlib.contextmanager
+def device_trace(name: str, **annotations) -> Iterator[None]:
+    """Host span + device TraceAnnotation with one context manager."""
+    try:
+        import jax.profiler
+
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler always importable in CI
+        ann = contextlib.nullcontext()
+    with trace_span(name, **annotations):
+        with ann:
+            yield
+
+
+@contextlib.contextmanager
+def profile_session(logdir: str) -> Iterator[None]:
+    """Capture a device trace into ``logdir`` (view with xprof/tensorboard).
+
+    Swallows double-start errors so an outer session wins — mirroring how
+    the reference tolerates span-receiver re-wiring per process.
+    """
+    started = False
+    try:
+        import jax.profiler
+
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
+    try:
+        with trace_span("profile_session", logdir=logdir):
+            yield
+    finally:
+        if started:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -1,0 +1,60 @@
+"""CachedModelAccessor tests (ref: CachedModelAccessor.java semantics)."""
+import numpy as np
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.dolphin import CachedModelAccessor, ModelAccessor, make_accessor
+from harmony_tpu.table import DenseTable, TableSpec
+
+
+def make_table(mesh, cap=16, dim=4):
+    cfg = TableConfig(table_id="acc", capacity=cap, value_shape=(dim,), num_blocks=8)
+    return DenseTable(TableSpec(cfg), mesh)
+
+
+class TestCachedModelAccessor:
+    def test_pull_loads_and_caches(self, mesh8):
+        t = make_table(mesh8)
+        acc = CachedModelAccessor(t, refresh_period_sec=0)  # no background thread
+        v = acc.pull([1, 2, 3])
+        assert v.shape == (3, 4)
+        # Another writer pushes directly to the table; the cache is stale...
+        t.multi_update([1], np.ones((1, 4), np.float32) * 5)
+        np.testing.assert_array_equal(acc.pull([1])[0], np.zeros(4))
+        # ...until a refresh re-pulls cached keys.
+        acc.refresh_now()
+        np.testing.assert_array_equal(acc.pull([1])[0], np.full(4, 5.0))
+        acc.close()
+
+    def test_push_applies_locally_and_remotely(self, mesh8):
+        t = make_table(mesh8)
+        acc = CachedModelAccessor(t, refresh_period_sec=0)
+        acc.pull([0])
+        acc.push([0], np.ones((1, 4), np.float32) * 2)
+        # Cache sees own push immediately (no refresh needed)…
+        np.testing.assert_array_equal(acc.pull([0])[0], np.full(4, 2.0))
+        # …and the table (authoritative) got it too.
+        np.testing.assert_array_equal(t.get(0), np.full(4, 2.0))
+        acc.close()
+
+    def test_background_refresh_tracks_writers(self, mesh8):
+        import time
+
+        t = make_table(mesh8)
+        acc = CachedModelAccessor(t, refresh_period_sec=0.05)
+        acc.pull([7])
+        t.multi_update([7], np.ones((1, 4), np.float32) * 3)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if (acc.pull([7])[0] == 3.0).all():
+                break
+            time.sleep(0.05)
+        np.testing.assert_array_equal(acc.pull([7])[0], np.full(4, 3.0))
+        acc.close()
+
+    def test_factory_honors_flag(self, mesh8):
+        t = make_table(mesh8)
+        plain = make_accessor(t, model_cache_enabled=False)
+        cached = make_accessor(t, model_cache_enabled=True, refresh_period_sec=0)
+        assert type(plain) is ModelAccessor
+        assert isinstance(cached, CachedModelAccessor)
+        cached.close()

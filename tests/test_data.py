@@ -1,0 +1,123 @@
+"""Dataloader/datastorer tests (SURVEY.md §2.9 dataloader/datastorer parity)."""
+import os
+
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.data import (
+    CsvParser,
+    FileDataStorer,
+    KeyValueVectorParser,
+    LibSvmParser,
+    compute_splits,
+    fetch_split,
+    get_parser,
+    load_dataset,
+)
+from harmony_tpu.table import DenseTable, TableSpec
+
+
+@pytest.fixture()
+def text_file(tmp_path):
+    p = tmp_path / "data.txt"
+    lines = [f"{i} {i * 1.0} {i * 2.0}" for i in range(100)]
+    p.write_text("\n".join(lines) + "\n")
+    return str(p), lines
+
+
+class TestSplits:
+    def test_exactly_n_and_no_loss_no_dup(self, text_file):
+        path, lines = text_file
+        for n in (1, 3, 7, 16):
+            splits = compute_splits([path], n)
+            assert len(splits) == n
+            got = [r for s in splits for r in fetch_split(s)]
+            assert got == lines  # every record exactly once, in order
+
+    def test_more_splits_than_bytes(self, tmp_path):
+        p = tmp_path / "tiny.txt"
+        p.write_text("a\nb\n")
+        splits = compute_splits([str(p)], 8)
+        assert len(splits) == 8
+        got = [r for s in splits for r in fetch_split(s)]
+        assert got == ["a", "b"]
+
+    def test_multiple_files(self, tmp_path):
+        pa, pb = tmp_path / "a.txt", tmp_path / "b.txt"
+        pa.write_text("1\n2\n3\n")
+        pb.write_text("4\n5\n")
+        splits = compute_splits([str(pa), str(pb)], 4)
+        assert len(splits) == 4
+        got = sorted(r for s in splits for r in fetch_split(s))
+        assert got == ["1", "2", "3", "4", "5"]
+
+    def test_split_serializable(self, text_file):
+        path, _ = text_file
+        s = compute_splits([path], 2)[1]
+        clone = type(s).from_json(s.to_json())
+        assert fetch_split(clone) == fetch_split(s)
+
+
+class TestParsers:
+    def test_libsvm(self):
+        x, y = LibSvmParser(num_features=4).parse(["1 1:0.5 3:2.0", "-1 2:1.0"])
+        np.testing.assert_array_equal(y, [1.0, -1.0])
+        np.testing.assert_array_equal(x[0], [0.5, 0.0, 2.0, 0.0])
+        np.testing.assert_array_equal(x[1], [0.0, 1.0, 0.0, 0.0])
+
+    def test_csv_with_label(self):
+        x, y = CsvParser(label_col=0).parse(["1,2.5,3.5", "0,4.5,5.5"])
+        np.testing.assert_array_equal(y, [1.0, 0.0])
+        assert x.shape == (2, 2)
+
+    def test_keyvec(self):
+        k, v = KeyValueVectorParser().parse(["7 1.0 2.0", "9 3.0 4.0"])
+        np.testing.assert_array_equal(k, [7, 9])
+        np.testing.assert_array_equal(v, [[1, 2], [3, 4]])
+
+    def test_registry(self):
+        p = get_parser("libsvm", num_features=2)
+        assert isinstance(p, LibSvmParser)
+        with pytest.raises(KeyError):
+            get_parser("nope")
+
+
+class TestBulkLoad:
+    def test_table_load_from_files(self, tmp_path, mesh8):
+        from harmony_tpu.runtime.master import ETMaster
+        from harmony_tpu.parallel.mesh import DevicePool
+        import jax
+
+        p = tmp_path / "rows.txt"
+        p.write_text("\n".join(f"{i} {float(i)} {float(i) + 0.5}" for i in range(32)) + "\n")
+        master = ETMaster(DevicePool(jax.devices()[:8]))
+        execs = master.add_executors(4)
+        handle = master.create_table(
+            TableConfig(table_id="bulk", capacity=32, value_shape=(2,), num_blocks=8),
+            [e.id for e in execs],
+        )
+        n = handle.load([str(p)], KeyValueVectorParser())
+        assert n == 32
+        np.testing.assert_allclose(handle.table.get(5), [5.0, 5.5])
+        np.testing.assert_allclose(handle.table.get(31), [31.0, 31.5])
+
+    def test_load_dataset_for_training(self, text_file):
+        path, _ = text_file
+        keys, vals = load_dataset([path], KeyValueVectorParser(), num_splits=3)
+        assert keys.shape == (100,) and vals.shape == (100, 2)
+        np.testing.assert_array_equal(keys, np.arange(100))
+
+
+class TestStorer:
+    def test_array_json_text_roundtrip(self, tmp_path):
+        st = FileDataStorer(str(tmp_path / "out"))
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        st.store_array("model/final.npy", arr)
+        np.testing.assert_array_equal(st.load_array("model/final.npy"), arr)
+        st.store_json("result.json", {"loss": 0.5})
+        st.store_text("log.txt", "done")
+        assert os.path.exists(tmp_path / "out" / "result.json")
+        # no temp litter left behind
+        leftovers = [f for f in os.listdir(tmp_path / "out") if f.endswith(".tmp")]
+        assert leftovers == []

@@ -1,0 +1,139 @@
+"""Heterogeneous optimizer / ILP solver tests (ref: hetero/ILPSolver)."""
+import numpy as np
+import pytest
+
+from harmony_tpu.metrics.collector import BatchMetrics
+from harmony_tpu.optimizer import (
+    ExecutorProfile,
+    HeterogeneousOptimizer,
+    ILPSolver,
+    load_profiles,
+)
+from harmony_tpu.optimizer.api import EvaluatorParams
+from harmony_tpu.optimizer.hetero import _largest_remainder, predict_unknown_rates
+
+
+class TestLargestRemainder:
+    def test_proportional_and_exact(self):
+        out = _largest_remainder(10, [1.0, 1.0, 2.0])
+        assert sum(out) == 10
+        assert out[2] > out[0]
+
+    def test_floor_respected(self):
+        out = _largest_remainder(20, [1.0, 100.0], minimum=5)
+        assert sum(out) == 20
+        assert min(out) >= 5
+
+    def test_infeasible_floor_degrades(self):
+        out = _largest_remainder(3, [1.0, 1.0], minimum=5)
+        assert sum(out) == 3
+
+    def test_zero_weights(self):
+        assert sum(_largest_remainder(7, [0.0, 0.0])) == 7
+
+
+class TestRatePrediction:
+    def test_shared_core_power_rule(self):
+        # two known machines, same per-core power; 4-core unknown gets 2x the
+        # 2-core machines' rate (ref rule: T = Σ(1/rate)/Σ(1/cores)).
+        ps = [
+            ExecutorProfile("a", cores=2, rate=10.0),
+            ExecutorProfile("b", cores=2, rate=10.0),
+            ExecutorProfile("c", cores=4, rate=None),
+        ]
+        predict_unknown_rates(ps)
+        assert ps[2].rate == pytest.approx(20.0)
+
+    def test_no_known_rates_noop(self):
+        ps = [ExecutorProfile("a", cores=2)]
+        predict_unknown_rates(ps)
+        assert ps[0].rate is None
+
+
+class TestILPSolver:
+    def test_fast_machines_get_more_data(self):
+        ps = [
+            ExecutorProfile("owner", cores=1, bandwidth=10.0, rate=1.0),
+            ExecutorProfile("fast", cores=8, bandwidth=1.0, rate=8.0),
+            ExecutorProfile("slow", cores=1, bandwidth=1.0, rate=1.0),
+        ]
+        alloc = ILPSolver(min_model_blocks_per_owner=1).solve(ps, 90, 10)
+        assert alloc.trainers.get("fast", 0) > alloc.trainers.get("slow", 0)
+        assert sum(alloc.trainers.values()) == 90
+        assert sum(alloc.owners.values()) == 10
+
+    def test_high_bandwidth_owns_model(self):
+        ps = [
+            ExecutorProfile("bw", bandwidth=100.0, rate=1.0),
+            ExecutorProfile("w1", bandwidth=1.0, rate=5.0),
+            ExecutorProfile("w2", bandwidth=1.0, rate=5.0),
+        ]
+        alloc = ILPSolver(min_model_blocks_per_owner=1).solve(
+            ps, 100, 20, comm_cost_per_block=0.05
+        )
+        assert "bw" in alloc.owners
+
+    def test_greedy_path_above_enum_limit(self):
+        ps = [ExecutorProfile(f"e{i}", bandwidth=1.0 + i, rate=1.0) for i in range(16)]
+        alloc = ILPSolver(exact_enum_limit=4, min_model_blocks_per_owner=1).solve(ps, 64, 32)
+        assert sum(alloc.owners.values()) == 32
+        assert sum(alloc.trainers.values()) == 64
+
+    def test_too_few_executors(self):
+        with pytest.raises(ValueError):
+            ILPSolver().solve([ExecutorProfile("only")], 10, 10)
+
+
+class TestHeterogeneousOptimizer:
+    def _params(self, block_counts, rates):
+        wm = [
+            BatchMetrics(worker_id=w, num_examples=int(100 * r), batch_time_sec=1.0,
+                         epoch_idx=0, batch_idx=i)
+            for i, (w, r) in enumerate(rates.items())
+        ]
+        return EvaluatorParams(worker_metrics=wm, table_id="model",
+                               block_counts=block_counts)
+
+    def test_rebalances_toward_target(self):
+        opt = HeterogeneousOptimizer(
+            profiles={
+                "e0": ExecutorProfile("e0", bandwidth=8.0),
+                "e1": ExecutorProfile("e1", bandwidth=1.0),
+                "e2": ExecutorProfile("e2", bandwidth=1.0),
+            },
+            min_gain=0.0,
+            solver=ILPSolver(min_model_blocks_per_owner=1),
+        )
+        params = self._params(
+            {"e0": 10, "e1": 10, "e2": 10}, {"e0": 1.0, "e1": 4.0, "e2": 4.0}
+        )
+        plan = opt.optimize(params, 3)
+        # Plan conserves blocks: every transfer's src had them.
+        moved = sum(t.num_blocks for t in plan.transfer_steps)
+        assert moved > 0
+        for t in plan.transfer_steps:
+            assert t.src in params.block_counts
+
+    def test_single_executor_no_plan(self):
+        opt = HeterogeneousOptimizer()
+        assert opt.optimize(self._params({"e0": 30}, {"e0": 1.0}), 1).empty
+
+    def test_ema_smoothing(self):
+        opt = HeterogeneousOptimizer()
+        opt._update_rates(self._params({}, {"w": 1.0}))
+        first = opt._ema_rates["w"]
+        opt._update_rates(self._params({}, {"w": 3.0}))
+        second = opt._ema_rates["w"]
+        assert first < second < 300.0  # moved toward the new rate, smoothed
+
+
+class TestProfileFiles:
+    def test_load_profiles(self, tmp_path):
+        cores = tmp_path / "cores.txt"
+        bw = tmp_path / "bw.txt"
+        cores.write_text("# host cores\nhostA 8\nhostB 2\n")
+        bw.write_text("hostA 10.0\nhostC 5.0\n")
+        ps = load_profiles(str(cores), str(bw))
+        assert ps["hostA"].cores == 8 and ps["hostA"].bandwidth == 10.0
+        assert ps["hostB"].cores == 2
+        assert ps["hostC"].bandwidth == 5.0

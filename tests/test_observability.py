@@ -1,0 +1,151 @@
+"""Tracing spans + dashboard tests (SURVEY.md §5.1 / §5.5 parity)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from harmony_tpu.dashboard import DashboardConnector, DashboardServer
+from harmony_tpu.tracing import (
+    InMemorySpanReceiver,
+    LocalFileSpanReceiver,
+    SpanContext,
+    Tracing,
+    current_span,
+    device_trace,
+    set_tracing,
+    trace_span,
+)
+from harmony_tpu.tracing.span import wire_context
+
+
+@pytest.fixture()
+def tracing():
+    t = set_tracing(Tracing(process_id="test-proc"))
+    rec = t.add_receiver(InMemorySpanReceiver())
+    yield rec
+    set_tracing(Tracing())
+
+
+class TestSpans:
+    def test_nesting_and_emission(self, tracing):
+        with trace_span("outer") as outer:
+            with trace_span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id
+                assert current_span() is inner
+            assert current_span() is outer
+        assert current_span() is None
+        descs = [s.description for s in tracing.spans]
+        assert descs == ["inner", "outer"]  # children close first
+        assert all(s.stop_sec is not None for s in tracing.spans)
+
+    def test_wire_propagation(self, tracing):
+        """The TraceInfo-codec analogue: a remote child re-parents onto the
+        sender's span across a (simulated) message boundary."""
+        with trace_span("master-op") as master:
+            wire = wire_context()
+        ctx = SpanContext.from_wire(wire)
+        with trace_span("worker-op", parent=ctx):
+            pass
+        worker = tracing.by_description("worker-op")[0]
+        assert worker.parent_id == master.span_id
+        assert worker.trace_id == master.trace_id
+
+    def test_annotations(self, tracing):
+        with trace_span("op", table="t0") as s:
+            s.annotate("blocks", 4)
+        s = tracing.by_description("op")[0]
+        assert s.annotations == {"table": "t0", "blocks": 4}
+
+    def test_sampled_out(self):
+        t = set_tracing(Tracing(sample_rate=0.0))
+        rec = t.add_receiver(InMemorySpanReceiver())
+        with trace_span("never") as s:
+            assert s is None
+        assert rec.spans == []
+        set_tracing(Tracing())
+
+    def test_file_receiver(self, tmp_path):
+        t = set_tracing(Tracing())
+        path = str(tmp_path / "spans.jsonl")
+        t.add_receiver(LocalFileSpanReceiver(path))
+        with trace_span("filed"):
+            pass
+        t.close()
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["description"] == "filed"
+        set_tracing(Tracing())
+
+    def test_device_trace_wraps(self, tracing):
+        import jax.numpy as jnp
+
+        with device_trace("devop"):
+            jnp.ones(4).sum()
+        assert tracing.by_description("devop")
+
+
+class TestDashboard:
+    def test_post_query_roundtrip(self):
+        server = DashboardServer().start()
+        try:
+            body = json.dumps(
+                {"job_id": "j0", "kind": "BatchMetrics", "payload": {"loss": 0.5}}
+            ).encode()
+            req = urllib.request.Request(
+                server.url + "/api/metrics", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            assert json.loads(urllib.request.urlopen(req).read())["ok"]
+            rows = json.loads(
+                urllib.request.urlopen(server.url + "/api/metrics?job_id=j0").read()
+            )
+            assert rows[0]["payload"]["loss"] == 0.5
+            jobs = json.loads(urllib.request.urlopen(server.url + "/api/jobs").read())
+            assert jobs[0]["job_id"] == "j0" and jobs[0]["last_loss"] == 0.5
+            html = urllib.request.urlopen(server.url + "/").read().decode()
+            assert "j0" in html
+        finally:
+            server.stop()
+
+    def test_bad_payload_is_400(self):
+        server = DashboardServer().start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/api/metrics", data=b"not json",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400
+        finally:
+            server.stop()
+
+    def test_connector_async_delivery(self):
+        from harmony_tpu.metrics.collector import BatchMetrics
+
+        server = DashboardServer().start()
+        conn = DashboardConnector(server.url)
+        try:
+            conn.post("j1", "EpochMetrics", {"loss": 1.25})
+            conn.metric_sink(BatchMetrics(job_id="j1", loss=0.75))
+            deadline = time.time() + 5
+            while time.time() < deadline and conn.sent < 2:
+                time.sleep(0.02)
+            assert conn.sent == 2
+            rows = json.loads(
+                urllib.request.urlopen(server.url + "/api/metrics?job_id=j1").read()
+            )
+            assert len(rows) == 2
+        finally:
+            conn.close()
+            server.stop()
+
+    def test_connector_survives_dead_dashboard(self):
+        conn = DashboardConnector("http://127.0.0.1:1")  # nothing listens
+        conn.post("j", "k", {})
+        deadline = time.time() + 5
+        while time.time() < deadline and conn.errors < 1:
+            time.sleep(0.02)
+        assert conn.errors >= 1  # swallowed, training path unaffected
+        conn.close()

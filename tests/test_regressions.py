@@ -245,3 +245,103 @@ def test_multiworker_local_table_single_init(devices):
     for r in result["workers"].values():
         assert r["losses"][0] < 100, r["losses"]
     server.shutdown()
+
+
+def test_splits_fewer_than_files_cover_everything(tmp_path):
+    """Review finding: num_splits < len(paths) silently dropped whole files."""
+    from harmony_tpu.data import compute_splits, fetch_split
+
+    paths = []
+    expect = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.txt"
+        lines = [f"{i}-{j}" for j in range(10)]
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+        expect.extend(lines)
+    for n in (1, 2, 5):
+        splits = compute_splits(paths, n)
+        assert len(splits) == n
+        got = [r for s in splits for r in fetch_split(s)]
+        assert got == expect, f"n={n}"
+
+
+def test_gbt_rounds_past_budget_freeze_model(mesh8):
+    """Review finding: overrun rounds add-accumulated tree encodings into the
+    last model row (update_fn='add'), corrupting predictions."""
+    import numpy as np
+
+    from harmony_tpu.apps.gbt import GBTTrainer, bin_features, make_synthetic
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet
+    from harmony_tpu.table import DenseTable, TableSpec
+
+    x, y = make_synthetic(256, 6, seed=7)
+    bins, _ = bin_features(x, 8)
+    tr = GBTTrainer(num_features=6, num_examples=256, num_rounds=4,
+                    loss="squared", max_depth=2, step_size=0.4)
+    model = DenseTable(TableSpec(tr.model_table_config()), mesh8)
+    state = DenseTable(TableSpec(tr.local_table_config()), mesh8)
+    ctx = TrainerContext(
+        params=TrainerParams(num_epochs=2, num_mini_batches=4),  # 8 > 4 rounds
+        model_table=model, local_table=state,
+    )
+    w = WorkerTasklet("gbt-overrun", ctx, tr, TrainingDataProvider([bins, y], 4), mesh8)
+    w.run()
+    rows = np.asarray(model.pull_array())
+    # is_leaf flags must stay boolean and feature ids in range in EVERY row.
+    leaf = rows[:, 2 * tr.num_nodes: 3 * tr.num_nodes]
+    feats = rows[:, : tr.num_nodes]
+    assert set(np.unique(leaf)) <= {0.0, 1.0}
+    assert feats.max() < 6
+    ev = w.evaluate((bins, y))
+    assert ev["rmse"] < 0.7  # predictions stay sane after budget exhaustion
+
+
+def test_add_nonneg_clamps_after_fold(mesh8):
+    """Review finding: two individually-safe deltas can sum below zero; the
+    add_nonneg update fn must clamp AFTER the fold (ref: NMF server clamp)."""
+    import numpy as np
+
+    from harmony_tpu.config.params import TableConfig
+    from harmony_tpu.table import DenseTable, TableSpec
+
+    cfg = TableConfig(table_id="nn", capacity=4, value_shape=(2,), num_blocks=2,
+                      update_fn="add_nonneg")
+    t = DenseTable(TableSpec(cfg), mesh8)
+    t.multi_put([0], np.full((1, 2), 1.0, np.float32))
+    # Each delta alone keeps the value >= 0 (1 - 0.8 = 0.2), together -0.6.
+    t.multi_update([0, 0], np.full((2, 2), -0.8, np.float32))
+    np.testing.assert_array_equal(t.get(0), np.zeros(2))
+
+
+def test_cached_accessor_refresh_never_clobbers_push(mesh8):
+    """Review finding: a refresh snapshot read before a push must not
+    overwrite the pushed cache entry."""
+    import numpy as np
+
+    from harmony_tpu.config.params import TableConfig
+    from harmony_tpu.dolphin import CachedModelAccessor
+    from harmony_tpu.table import DenseTable, TableSpec
+
+    cfg = TableConfig(table_id="race", capacity=4, value_shape=(2,), num_blocks=2)
+    t = DenseTable(TableSpec(cfg), mesh8)
+    acc = CachedModelAccessor(t, refresh_period_sec=0)
+    acc.pull([0])
+    # Simulate the race: the push lands WHILE the refresh is reading the
+    # table, so the refresh's snapshot is pre-push but its install is after.
+    real_get = t.multi_get_or_init
+    stale = real_get([0])
+
+    def racing_get(keys):
+        acc.push([0], np.ones((1, 2), np.float32))  # interleaved push
+        return stale  # ...but the table read already happened (pre-push)
+
+    t.multi_get_or_init = racing_get
+    try:
+        acc.refresh_now()
+    finally:
+        t.multi_get_or_init = real_get
+    # The push must still be visible (version guard rejected the stale write).
+    np.testing.assert_array_equal(acc.pull([0])[0], np.ones(2))
+    acc.close()
