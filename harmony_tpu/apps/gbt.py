@@ -70,6 +70,7 @@ class GBTTrainer(Trainer):
         gamma: float = 0.0,
         step_size: float = 0.3,
         leaf_min_size: int = 1,
+        hist_mode: str = "auto",
     ) -> None:
         if loss not in ("squared", "logistic", "softmax"):
             raise ValueError(f"unknown loss {loss!r}")
@@ -88,6 +89,14 @@ class GBTTrainer(Trainer):
         self.gamma = gamma
         self.step_size = step_size
         self.leaf_min_size = leaf_min_size
+        # Histogram build strategy: "scatter" = XLA scatter-add; "matmul" =
+        # one-hot matmul (the harmony_tpu.ops Pallas kernel — MXU-bound,
+        # the TPU-fast path); "auto" picks matmul on TPU.
+        if hist_mode not in ("auto", "scatter", "matmul"):
+            raise ValueError(f"unknown hist_mode {hist_mode!r}")
+        if hist_mode == "auto":
+            hist_mode = "matmul" if jax.default_backend() == "tpu" else "scatter"
+        self.hist_mode = hist_mode
         # Full binary tree, levels 0..max_depth (ref: treeSize from treeMaxDepth).
         self.num_nodes = 2 ** (max_depth + 1) - 1
 
@@ -180,15 +189,26 @@ class GBTTrainer(Trainer):
             w = -Gn / (Hn + lam)                                    # [n_level, K]
 
             if d < self.max_depth:
-                # (node, feature, bin) histograms: ONE flat scatter-add.
+                # (node, feature, bin) histograms over one flat id space.
                 flat = (node[:, None] * F + f_idx) * Bn + bins      # [E, F]
                 flat = flat.reshape(-1)
                 reps = jnp.broadcast_to(g_eff[:, None, :], (E, F, K)).reshape(-1, K)
                 hreps = jnp.broadcast_to(h_eff[:, None, :], (E, F, K)).reshape(-1, K)
                 creps = jnp.broadcast_to(live, (E, F)).reshape(-1)
-                hg = jnp.zeros((n_level * F * Bn, K), jnp.float32).at[flat].add(reps)
-                hh = jnp.zeros((n_level * F * Bn, K), jnp.float32).at[flat].add(hreps)
-                hc = jnp.zeros((n_level * F * Bn,), jnp.float32).at[flat].add(creps)
+                nb = n_level * F * Bn
+                if self.hist_mode == "matmul":
+                    # ONE MXU one-hot matmul builds g, h and count together
+                    # (harmony_tpu.ops.weighted_histogram Pallas kernel).
+                    from harmony_tpu.ops import weighted_histogram
+
+                    stats = jnp.concatenate([reps, hreps, creps[:, None]], axis=1)
+                    hist = weighted_histogram(flat, stats, nb)
+                    hg, hh, hc = hist[:, :K], hist[:, K : 2 * K], hist[:, 2 * K]
+                else:
+                    # ONE flat scatter-add per statistic.
+                    hg = jnp.zeros((nb, K), jnp.float32).at[flat].add(reps)
+                    hh = jnp.zeros((nb, K), jnp.float32).at[flat].add(hreps)
+                    hc = jnp.zeros((nb,), jnp.float32).at[flat].add(creps)
                 hg = hg.reshape(n_level, F, Bn, K)
                 hh = hh.reshape(n_level, F, Bn, K)
                 hc = hc.reshape(n_level, F, Bn)

@@ -80,19 +80,28 @@ class DashboardServer:
         ]
 
     def jobs(self) -> List[Dict[str, Any]]:
+        # One aggregate query; last_loss = the newest report whose payload
+        # has a top-level "loss" key (json_extract, not substring match —
+        # '{"stage": "loss"}' must not shadow a real loss report).
+        q = """
+            SELECT m.job_id, c.n, c.last_ts, m.payload FROM metrics m
+            JOIN (SELECT job_id, COUNT(*) n, MAX(ts) last_ts, MAX(id) max_loss_id
+                  FROM metrics
+                  WHERE json_extract(payload, '$.loss') IS NOT NULL
+                  GROUP BY job_id
+                 ) c ON m.id = c.max_loss_id
+        """
         with self._db_lock:
-            rows = self._db.execute(
+            loss_rows = self._db.execute(q).fetchall()
+            all_rows = self._db.execute(
                 "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
             ).fetchall()
-        out = []
-        for job_id, count, last_ts in rows:
-            latest = self.query(job_id=job_id, limit=1)
-            last_loss = latest[0]["payload"].get("loss") if latest else None
-            out.append(
-                {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
-                 "last_loss": last_loss}
-            )
-        return out
+        loss_by_job = {r[0]: json.loads(r[3]).get("loss") for r in loss_rows}
+        return [
+            {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
+             "last_loss": loss_by_job.get(job_id)}
+            for job_id, count, last_ts in all_rows
+        ]
 
     # -- http ------------------------------------------------------------
 
@@ -151,15 +160,17 @@ class DashboardServer:
             def do_GET(self) -> None:
                 parsed = urlparse(self.path)
                 if parsed.path == "/api/metrics":
-                    qs = parse_qs(parsed.query)
-                    self._json(
-                        200,
-                        server.query(
+                    try:  # malformed queries must not kill the connection
+                        qs = parse_qs(parsed.query)
+                        result = server.query(
                             job_id=qs.get("job_id", [None])[0],
                             kind=qs.get("kind", [None])[0],
                             limit=int(qs.get("limit", ["100"])[0]),
-                        ),
-                    )
+                        )
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
                 elif parsed.path == "/api/jobs":
                     self._json(200, server.jobs())
                 elif parsed.path == "/":

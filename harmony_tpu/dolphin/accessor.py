@@ -121,15 +121,33 @@ class CachedModelAccessor(ModelAccessor):
 
     def pull(self, keys: Sequence[int]) -> np.ndarray:
         self.pull_tracer.start()
+        if not len(keys):  # np.stack rejects empty; match base-class shape
+            out = np.asarray(self._table.multi_get_or_init([]))
+            self.pull_tracer.record(0, block_on=None)
+            return out
         with self._cache_lock:
             missing = [k for k in keys if k not in self._cache]
+            versions = {k: self._versions.get(k, 0) for k in missing}
+        overlay = {}
         if missing:
             loaded = self._table.multi_get_or_init(missing)
             with self._cache_lock:
                 for k, v in zip(missing, loaded):
-                    self._cache[k] = v
+                    # Same version guard as refresh_now: if a push raced the
+                    # load, the table snapshot may predate that push, and
+                    # caching it would hide the pusher's write from later
+                    # pulls. Serve it for THIS call only (overlay) and leave
+                    # the key uncached so the next pull re-reads post-push
+                    # table state.
+                    if self._versions.get(k, 0) == versions[k]:
+                        self._cache[k] = v
+                    else:
+                        overlay[k] = v
         with self._cache_lock:
-            out = np.stack([self._cache[k] for k in keys])
+            out = np.stack([
+                self._cache.get(k, overlay.get(k)) if k in overlay else self._cache[k]
+                for k in keys
+            ])
         self.pull_tracer.record(len(keys), block_on=None)
         return out
 

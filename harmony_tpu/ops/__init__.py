@@ -1,0 +1,19 @@
+"""harmony_tpu.ops — Pallas TPU kernels + jittable fallbacks for hot ops.
+
+The reference reaches native compute through Breeze -> netlib JNI -> BLAS
+(SURVEY.md §5.9 item 1); the TPU rebuild's equivalent is XLA for everything
+fusible plus hand-written Pallas kernels where a custom schedule beats the
+compiler: streaming-softmax attention (flash), MXU one-hot histograms
+(GBT's hot op), and segment reductions (push aggregation).
+"""
+from harmony_tpu.ops.attention import blockwise_attention, flash_attention
+from harmony_tpu.ops.histogram import segment_sum, weighted_histogram
+from harmony_tpu.ops.ring import ring_attention
+
+__all__ = [
+    "blockwise_attention",
+    "flash_attention",
+    "ring_attention",
+    "segment_sum",
+    "weighted_histogram",
+]

@@ -1,0 +1,221 @@
+"""Flash attention for TPU: Pallas forward kernel + differentiable blockwise.
+
+The reference has no attention models at all (SURVEY.md §5.7) — long-context
+support is a first-class extension of this framework, not a port. Two tiers:
+
+  * :func:`blockwise_attention` — pure-JAX streaming-softmax attention
+    (lax.scan over KV blocks, O(S) memory). Differentiable by autodiff;
+    numerically identical to flash attention. Works on any backend.
+  * :func:`flash_attention` — Pallas TPU kernel for the forward pass
+    (grid (batch*heads, q_blocks, kv_blocks), online softmax state in VMEM
+    scratch, QK^T and PV on the MXU in fp32). Backward runs through the
+    blockwise implementation's VJP (recompute — the flash-attention trick of
+    trading FLOPs for HBM traffic, same spirit as jax.checkpoint).
+
+Layout: (batch, heads, seq, head_dim). head_dim should be a multiple of 128
+for peak MXU utilisation; any size compiles (pallas pads tiles).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30  # finite "-inf": keeps masked softmax NaN-free
+
+
+def _dot_f32(a, b, trans_b=False):
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Pure-JAX blockwise (differentiable reference path)
+# ---------------------------------------------------------------------------
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_k: int = DEFAULT_BLOCK_K,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Streaming-softmax attention: scan over KV blocks carrying (acc, m, l).
+
+    q [B,H,Sq,D], k/v [B,H,Sk,D] -> [B,H,Sq,D]. O(Sq * block_k) live memory
+    instead of O(Sq*Sk); autodiff through the scan gives the memory-efficient
+    backward.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = scale if scale is not None else D ** -0.5
+    block_k = min(block_k, Sk)
+    nk, rem = divmod(Sk, block_k)
+    if rem:  # pad KV to a whole number of blocks; padded keys are masked out
+        pad = block_k - rem
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        nk += 1
+    kb = k.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nk, block_k, D).transpose(2, 0, 1, 3, 4)
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(Sq)[:, None]
+
+    def step(carry, blk):
+        acc, m, l = carry
+        kblk, vblk, start = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk.astype(jnp.float32))
+        kv_pos = start + jnp.arange(block_k)[None, :]
+        mask = kv_pos < Sk  # padding
+        if causal:
+            mask = mask & (q_pos >= kv_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vblk.astype(jnp.float32)
+        )
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    starts = jnp.arange(nk) * block_k
+    (acc, _, l), _ = jax.lax.scan(step, (acc0, m0, l0), (kb, vb, starts))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               scale, causal, block_q, block_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: KV blocks strictly above the diagonal contribute nothing.
+    needed = True if not causal else (ik * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale     # (bq, d)
+        s = _dot_f32(q, k_ref[0].astype(jnp.float32), trans_b=True)  # (bq, bk)
+        if causal:
+            row = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                        # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)              # (bq, 1)
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + _dot_f32(p, v_ref[0].astype(jnp.float32))
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _write():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"seq lens ({Sq},{Sk}) must divide by blocks ({block_q},{block_k})"
+        )
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    grid = (B * H, Sq // block_q, Sk // block_k)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[
+            _vmem((block_q, 128)),   # running row-max m
+            _vmem((block_q, 128)),   # running normaliser l
+            _vmem((block_q, D)),     # unnormalised output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Sq, D)
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention. Forward = Pallas kernel (TPU) / interpreter (tests);
+    backward = VJP of the blockwise implementation (recompute, O(S) memory)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    interp = _default_interpret() if interpret is None else interpret
+    return _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, scale, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, block_k=block_k, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,124 @@
+"""MXU one-hot histogram / segment reduction kernels.
+
+The GBT trainer's hot op is building per-(node, feature, bin) gradient /
+hessian / count histograms (ref: mlapps/gbt/GBTTrainer.java — the reference
+does this with Java loops over instances; SURVEY.md §2.7). On TPU a scatter
+serialises, but a histogram is also a matmul: ``one_hot(ids)^T @ weights``
+— which runs on the 128x128 systolic array at full tilt.
+
+:func:`weighted_histogram` is the Pallas kernel: grid over tiles of N, each
+step builds the tile's one-hot on the fly in VMEM (never materialised in
+HBM) and accumulates the (bins, W) product into the revisited output block.
+:func:`segment_sum` is the same op named for its other use — aggregating
+per-key push deltas by destination key (the table push path).
+
+Both fall back to a pure-XLA one-hot matmul off-TPU (interpret mode is used
+by tests to validate the kernel itself).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_N = 1024
+DEFAULT_BLOCK_BINS = 2048
+
+
+def _hist_kernel(ids_ref, w_ref, out_ref, *, block_n, block_bins):
+    """Grid (bins_tiles, n_tiles): each step folds one tile of N into one
+    tile of the bin space, so VMEM holds only (block_n, block_bins) one-hot
+    + (block_bins, W) output regardless of total histogram size."""
+    jb = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:] - jb * block_bins                 # (bn, 1) int32, tile-local
+    bins = jax.lax.broadcasted_iota(jnp.int32, (block_n, block_bins), 1)
+    onehot = (ids == bins).astype(jnp.float32)         # (bn, block_bins)
+    # (block_bins, bn) @ (bn, W) on the MXU, accumulated across n tiles.
+    # HIGHEST precision: default MXU f32 truncates multiplicands to bf16 —
+    # fine for attention logits, not for histogram sums that feed split-gain
+    # ratios; full-f32 passes keep the histogram bit-comparable to scatter.
+    out_ref[:] += jax.lax.dot_general(
+        onehot, w_ref[:].astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _xla_histogram(ids, weights, num_bins):
+    onehot = jax.nn.one_hot(ids, num_bins, dtype=jnp.float32)
+    return onehot.T @ weights.astype(jnp.float32)
+
+
+def weighted_histogram(
+    ids: jnp.ndarray,
+    weights: jnp.ndarray,
+    num_bins: int,
+    block_n: int = DEFAULT_BLOCK_N,
+    block_bins: int = DEFAULT_BLOCK_BINS,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``out[b, w] = sum over i with ids[i]==b of weights[i, w]``.
+
+    ids [N] int32 (out-of-range / negative ids contribute nothing),
+    weights [N, W] -> [num_bins, W] float32.
+    """
+    if ids.ndim != 1 or weights.ndim != 2 or ids.shape[0] != weights.shape[0]:
+        raise ValueError(f"bad shapes ids={ids.shape} weights={weights.shape}")
+    interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+    if interp and interpret is None:
+        return _xla_histogram(ids, weights, num_bins)  # off-TPU fast path
+    N, W = weights.shape
+    block_n = min(block_n, max(N, 8))
+    block_bins = min(block_bins, num_bins)
+    pad = (-N) % block_n
+    if pad:
+        # padded ids = -1: match no bin
+        ids = jnp.pad(ids, (0, pad), constant_values=-1)
+        weights = jnp.pad(weights, ((0, pad), (0, 0)))
+        N += pad
+    pad_bins = (-num_bins) % block_bins
+    nb = num_bins + pad_bins
+    kernel = functools.partial(
+        _hist_kernel, block_n=block_n, block_bins=block_bins
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb // block_bins, N // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, 1), lambda jb, i: (i, 0)),
+            pl.BlockSpec((block_n, W), lambda jb, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_bins, W), lambda jb, i: (jb, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, W), jnp.float32),
+        interpret=interp,
+    )(ids.astype(jnp.int32)[:, None], weights)
+    return out[:num_bins] if pad_bins else out
+
+
+def segment_sum(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    **kw,
+) -> jnp.ndarray:
+    """Sum rows of ``data`` [N, W] by ``segment_ids`` [N] -> [num_segments, W].
+
+    The push-aggregation primitive: fold duplicate-key deltas before the
+    table scatter (ref semantics: server-side UpdateFunction applies each
+    delta; pre-reducing on the worker is the TPU-friendly equivalent)."""
+    squeeze = data.ndim == 1
+    if squeeze:
+        data = data[:, None]
+    out = weighted_histogram(segment_ids, data, num_segments, **kw)
+    return out[:, 0] if squeeze else out

@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context training shards the *sequence* across devices; each device
+holds a Q/K/V chunk and the K/V chunks rotate around the ring (ppermute
+over ICI) while every device folds each visiting chunk into its local
+online-softmax state. ICI transfer of chunk t+1 overlaps the attention
+compute of chunk t (XLA schedules the ppermute DMA concurrently with the
+einsums). Memory per device stays O(S_local^2 / ring) and the full-sequence
+softmax is exact — the blockwise/flash merge, distributed.
+
+The reference has nothing like this (SURVEY.md §5.7: its analogue of
+scaling one object beyond a node is table sharding); ring attention is the
+long-context capability this framework adds as first-class.
+
+:func:`ring_attention` is written to run INSIDE ``shard_map`` (it uses
+``lax.ppermute``/``axis_index``); :func:`ring_self_attention` is the
+host-level convenience that wraps it in shard_map over a mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    q/k/v: LOCAL shards [B, H, S_local, D] (call inside shard_map).
+    Returns the local output shard [B, H, S_local, D].
+    """
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * S + jnp.arange(S)[:, None]            # global q positions
+
+    def step(carry, t):
+        acc, m, l, kb, vb = carry
+        src = (my - t) % n                              # kv chunk's home shard
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            kv_pos = src * S + jnp.arange(S)[None, :]
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32)
+        )
+        # Rotate KV to the next device; after n steps it is home again.
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (acc_new, m_new, l_new, kb, vb), None
+
+    # The softmax state starts replicated but becomes device-varying inside
+    # the scan; mark it varying up front (jax >= 0.7 vma typing of shard_map).
+    _vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    acc0 = _vary(jnp.zeros((B, H, S, D), jnp.float32))
+    m0 = _vary(jnp.full((B, H, S), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, S), jnp.float32))
+    (acc, _, l, _, _), _ = lax.scan(
+        jax.checkpoint(step), (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh,
+    seq_axis: str,
+    batch_axis: Optional[str] = None,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Host-level wrapper: shard [B,H,S,D] inputs over ``mesh`` with the
+    sequence dim on ``seq_axis`` (and optionally batch on ``batch_axis``),
+    run :func:`ring_attention` under shard_map."""
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

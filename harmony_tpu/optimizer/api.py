@@ -45,6 +45,10 @@ class EvaluatorParams:
     server_metrics: List[ServerMetrics] = field(default_factory=list)
     table_id: Optional[str] = None
     block_counts: Dict[str, int] = field(default_factory=dict)
+    # worker_id -> executor_id. Jobserver workers report metrics under
+    # "<job>/wN" while block_counts is keyed by executor ids; optimizers
+    # must translate through this map (identity for absent keys).
+    worker_to_executor: Dict[str, str] = field(default_factory=dict)
 
 
 class Optimizer:

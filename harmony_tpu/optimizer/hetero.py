@@ -36,8 +36,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from harmony_tpu.optimizer.api import DolphinPlan, EvaluatorParams, Optimizer, TransferStep
 
-_vids = itertools.count()
-
 
 @dataclasses.dataclass
 class ExecutorProfile:
@@ -209,20 +207,27 @@ class HeterogeneousOptimizer(Optimizer):
         num_model_blocks: Optional[int] = None,
         min_gain: float = 0.05,
         solver: Optional[ILPSolver] = None,
+        comm_cost_per_block: Optional[float] = None,
     ) -> None:
         self.profiles = dict(profiles or {})
         self.num_model_blocks = num_model_blocks
         self.min_gain = min_gain
         self.solver = solver or ILPSolver()
+        # None = estimate from measured pull times (see _comm_cost).
+        self.comm_cost_per_block = comm_cost_per_block
         self._ema_rates: Dict[str, float] = {}
 
     # -- metric digestion -------------------------------------------------
 
     def _update_rates(self, params: EvaluatorParams) -> None:
+        # Metrics arrive keyed by worker id; translate to executor ids via
+        # params.worker_to_executor (identity when unmapped) so the EMA keys
+        # match the profile/block_counts key space.
         per_worker: Dict[str, List[float]] = {}
         for m in params.worker_metrics:
             if m.batch_time_sec > 0:
-                per_worker.setdefault(m.worker_id, []).append(
+                eid = params.worker_to_executor.get(m.worker_id, m.worker_id)
+                per_worker.setdefault(eid, []).append(
                     m.num_examples / m.batch_time_sec
                 )
         for wid, rates in per_worker.items():
@@ -251,18 +256,27 @@ class HeterogeneousOptimizer(Optimizer):
         self._update_rates(params)
         executor_ids = sorted(current)
         profiles = self._build_profiles(executor_ids)
-        total_model_blocks = self.num_model_blocks or sum(current.values())
+        # The actual block layout is authoritative: planning against any
+        # other total would emit a plan whose surplus/deficit pairing can't
+        # balance (silently incomplete migrations). num_model_blocks is only
+        # a documentation-of-intent fallback for empty layouts.
+        total_model_blocks = sum(current.values()) or (self.num_model_blocks or 0)
         num_data_blocks = max(
             len({(m.epoch_idx, m.batch_idx) for m in params.worker_metrics}), 1
         ) * max(len(executor_ids) - 1, 1)
-        alloc = self.solver.solve(profiles, num_data_blocks, total_model_blocks)
+        comm = self._comm_cost(params, total_model_blocks)
+        alloc = self.solver.solve(
+            profiles, num_data_blocks, total_model_blocks,
+            comm_cost_per_block=comm,
+        )
 
-        # Current predicted time (owners = executors as currently loaded,
-        # uniform data) to apply the min-gain hysteresis.
+        # Current predicted time under the SAME cost model (owners = current
+        # block distribution, every executor also training) so the min-gain
+        # hysteresis compares commensurate predictions.
         target = {eid: alloc.owners.get(eid, 0) for eid in executor_ids}
         if target == current:
             return DolphinPlan()
-        cur_worst = self._predict_current(profiles, current, num_data_blocks)
+        cur_worst = self._predict_current(profiles, current, num_data_blocks, comm)
         if cur_worst > 0 and (cur_worst - alloc.predicted_time) / cur_worst < self.min_gain:
             return DolphinPlan()
 
@@ -292,14 +306,35 @@ class HeterogeneousOptimizer(Optimizer):
                     deficit[di] = (dst, need)
         return plan
 
+    def _comm_cost(self, params: EvaluatorParams, total_model_blocks: int) -> float:
+        """Per-(model-block, trainer) pull cost. Explicit config wins;
+        otherwise estimated from measured per-batch pull times: with unit
+        bandwidths the cost model predicts pull_time ≈ comm * total_blocks."""
+        if self.comm_cost_per_block is not None:
+            return self.comm_cost_per_block
+        pulls = [m.pull_time_sec for m in params.worker_metrics if m.pull_time_sec > 0]
+        if not pulls or total_model_blocks <= 0:
+            return 0.0
+        return (sum(pulls) / len(pulls)) / total_model_blocks
+
     def _predict_current(
         self,
         profiles: Sequence[ExecutorProfile],
         current: Dict[str, int],
         num_data_blocks: int,
+        comm_cost_per_block: float = 0.0,
     ) -> float:
+        """Cost of the CURRENT layout: every executor trains (collocated PS)
+        and pulls against the current block distribution — the same objective
+        the solver minimizes, evaluated at the status quo."""
         d = _largest_remainder(num_data_blocks, [p.rate or 1.0 for p in profiles])
+        by_id = {p.executor_id: p for p in profiles}
+        owners = [(by_id[e], n) for e, n in current.items() if n > 0 and e in by_id]
         worst = 0.0
         for p, di in zip(profiles, d):
-            worst = max(worst, di / max(p.rate or 1.0, 1e-9))
+            pull = comm_cost_per_block * sum(
+                mj / max(min(p.bandwidth, o.bandwidth), 1e-9)
+                for o, mj in owners
+            )
+            worst = max(worst, di / max(p.rate or 1.0, 1e-9) + pull)
         return worst

@@ -14,8 +14,9 @@ a timer to emulate a dynamic cluster; ``available_fn`` plays that role
 """
 from __future__ import annotations
 
+import re
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from harmony_tpu.metrics.manager import MetricManager
 from harmony_tpu.optimizer.api import EvaluatorParams, Optimizer
@@ -47,12 +48,30 @@ class OptimizationOrchestrator:
 
     # -- one optimization round (callable directly for tests) ------------
 
+    def _worker_executor_map(self, worker_metrics) -> Dict[str, str]:
+        """Map jobserver worker ids ("<job>/wN") to the table's Nth
+        associated executor (collocated PS: worker N runs on executor N).
+        Ids that don't parse, or indexes beyond the executor list, are left
+        unmapped (optimizers fall back to identity)."""
+        executors = self.handle.block_manager.executors
+        out: Dict[str, str] = {}
+        for m in worker_metrics:
+            wid = m.worker_id
+            if wid in out:
+                continue
+            match = re.match(r".*/w(\d+)$", wid)
+            if match and int(match.group(1)) < len(executors):
+                out[wid] = executors[int(match.group(1))]
+        return out
+
     def run_once(self) -> Optional[PlanResult]:
+        worker_metrics = self.metrics.worker_batch_metrics()
         params = EvaluatorParams(
-            worker_metrics=self.metrics.worker_batch_metrics(),
+            worker_metrics=worker_metrics,
             server_metrics=self.metrics.server_metrics(),
             table_id=self.handle.table_id,
             block_counts=self.handle.block_manager.block_counts(),
+            worker_to_executor=self._worker_executor_map(worker_metrics),
         )
         # SPI contract: TOTAL executors the table may use = current owners +
         # free pool capacity (Optimizer.optimize docstring).

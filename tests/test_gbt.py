@@ -118,3 +118,22 @@ class TestGBTClassification:
             vec[2 * tr.num_nodes: 3 * tr.num_nodes], vec[3 * tr.num_nodes:],
         )
         assert is_leaf[0] == 1.0  # root is a leaf: nothing was worth gamma
+
+
+class TestHistModes:
+    def test_matmul_hist_matches_scatter(self):
+        """The MXU one-hot histogram (ops.weighted_histogram) grows the exact
+        same tree as the XLA scatter-add path."""
+        import jax.numpy as jnp
+
+        x, y = make_synthetic(256, 6, seed=5)
+        bins, _ = bin_features(x, 16)
+        kw = dict(num_features=6, num_examples=256, num_rounds=1,
+                  loss="squared", max_depth=3)
+        tr_s = GBTTrainer(**kw, hist_mode="scatter")
+        tr_m = GBTTrainer(**kw, hist_mode="matmul")
+        g, h, _ = tr_s._grad_hess(jnp.zeros((256, 1)), jnp.asarray(y))
+        out_s = tr_s._grow_tree(jnp.asarray(bins), g, h)
+        out_m = tr_m._grow_tree(jnp.asarray(bins), g, h)
+        for a, b in zip(out_s, out_m):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
