@@ -84,8 +84,8 @@ class DashboardServer:
         # has a top-level "loss" key (json_extract, not substring match —
         # '{"stage": "loss"}' must not shadow a real loss report).
         q = """
-            SELECT m.job_id, c.n, c.last_ts, m.payload FROM metrics m
-            JOIN (SELECT job_id, COUNT(*) n, MAX(ts) last_ts, MAX(id) max_loss_id
+            SELECT m.job_id, m.payload FROM metrics m
+            JOIN (SELECT MAX(id) max_loss_id
                   FROM metrics
                   WHERE json_extract(payload, '$.loss') IS NOT NULL
                   GROUP BY job_id
@@ -96,7 +96,7 @@ class DashboardServer:
             all_rows = self._db.execute(
                 "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
             ).fetchall()
-        loss_by_job = {r[0]: json.loads(r[3]).get("loss") for r in loss_rows}
+        loss_by_job = {r[0]: json.loads(r[1]).get("loss") for r in loss_rows}
         return [
             {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
              "last_loss": loss_by_job.get(job_id)}

@@ -1,0 +1,22 @@
+"""harmony_tpu.models — neural model families (beyond the reference's apps).
+
+The reference ships classic PS workloads only (SURVEY.md §2.7); this package
+adds the model families a TPU framework is actually judged on — starting
+with a decoder-only transformer LM whose attention runs on the
+harmony_tpu.ops kernels (flash single-chip, ring for sequence parallelism)
+and whose parameters live in the same elastic DenseTable substrate as every
+other app (so checkpointing, migration and multi-tenancy apply unchanged).
+"""
+from harmony_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    TransformerTrainer,
+    make_lm_data,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "TransformerLM",
+    "TransformerTrainer",
+    "make_lm_data",
+]

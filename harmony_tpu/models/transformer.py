@@ -1,0 +1,314 @@
+"""Decoder-only transformer LM — the framework's flagship neural model.
+
+Design (TPU-first, no reference counterpart — the reference has no attention
+models, SURVEY.md §5.7):
+
+  * **Functional params pytree** (dicts of arrays), f32 masters; activations
+    run in ``config.dtype`` (bf16 on hardware) so matmuls hit the MXU at
+    full rate.
+  * **Attention tiers**: single-chip uses the Pallas flash kernel
+    (harmony_tpu.ops.attention); sequence-parallel training uses ring
+    attention (harmony_tpu.ops.ring) inside ``shard_map`` over the mesh's
+    "seq" axis; the blockwise scan is the differentiable/any-backend tier.
+  * **PS-table integration**: :class:`TransformerTrainer` flattens the
+    pytree into a range-partitioned DenseTable ([rows, row_width]) so the
+    LM trains through the same Trainer SPI / WorkerTasklet / elastic-table
+    machinery as every classic app — checkpointing, live resharding and
+    multi-tenant scheduling apply to the LM for free.
+  * **make_sp_train_step**: the long-context path — batch sharded over
+    "data", sequence sharded over "seq"; grads are psum'd over both axes and
+    params stay replicated, so a step is ONE compiled SPMD program whose
+    collectives (ring ppermute + grad psum) ride ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+from harmony_tpu.ops.attention import blockwise_attention, flash_attention
+from harmony_tpu.ops.ring import ring_attention
+from harmony_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 256
+    dtype: Any = jnp.float32        # activation dtype (bf16 on hardware)
+    attn: str = "auto"              # "auto" | "flash" | "blockwise"
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _norm(x, w):
+    """RMSNorm (f32 statistics regardless of activation dtype)."""
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (xf * scale).astype(x.dtype) * w
+
+
+class TransformerLM:
+    """Pure-functional decoder-only LM: ``init`` -> params, ``apply`` ->
+    logits, ``loss`` -> mean next-token cross-entropy."""
+
+    def __init__(self, config: TransformerConfig) -> None:
+        self.config = config
+
+    # -- params ----------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        k_emb, k_pos, *k_layers = jax.random.split(rng, 2 + cfg.n_layers)
+        d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+
+        def dense(key, shape):
+            fan_in = shape[0]
+            return jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+
+        layers = []
+        for kl in k_layers:
+            ks = jax.random.split(kl, 4)
+            layers.append({
+                "ln1": jnp.ones((d,), jnp.float32),
+                "wqkv": dense(ks[0], (d, 3 * d)),
+                "wo": dense(ks[1], (d, d)),
+                "ln2": jnp.ones((d,), jnp.float32),
+                "w1": dense(ks[2], (d, f)),
+                "w2": dense(ks[3], (f, d)),
+            })
+        return {
+            "embed": jax.random.normal(k_emb, (cfg.vocab_size, d), jnp.float32) * 0.02,
+            "pos": jax.random.normal(k_pos, (cfg.max_seq, d), jnp.float32) * 0.02,
+            "ln_f": jnp.ones((d,), jnp.float32),
+            "layers": layers,
+        }
+
+    # -- forward ---------------------------------------------------------
+
+    def _attention(self, q, k, v, axis_name: Optional[str]):
+        cfg = self.config
+        if axis_name is not None:
+            return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+        S = q.shape[2]
+        attn = cfg.attn
+        if attn == "auto":
+            attn = "flash" if (jax.default_backend() == "tpu" and S % 128 == 0) \
+                else "blockwise"
+        if attn == "flash":
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=min(128, S), block_k=min(128, S))
+        return blockwise_attention(q, k, v, causal=True)
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jnp.ndarray,              # [B, S] int32 (LOCAL shard under SP)
+        axis_name: Optional[str] = None,  # seq-parallel ring axis (shard_map)
+        pos_offset: Any = 0,              # global position of tokens[:, 0]
+    ) -> jnp.ndarray:
+        cfg = self.config
+        B, S = tokens.shape
+        d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
+        pos = pos_offset + jnp.arange(S)
+        x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+        for layer in params["layers"]:
+            xn = _norm(x, layer["ln1"].astype(cfg.dtype))
+            qkv = xn @ layer["wqkv"].astype(cfg.dtype)          # [B, S, 3d]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            to_heads = lambda t: t.reshape(B, S, h, hd).transpose(0, 2, 1, 3)
+            o = self._attention(to_heads(q), to_heads(k), to_heads(v), axis_name)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
+            x = x + o @ layer["wo"].astype(cfg.dtype)
+            xn = _norm(x, layer["ln2"].astype(cfg.dtype))
+            x = x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
+                @ layer["w2"].astype(cfg.dtype)
+        x = _norm(x, params["ln_f"].astype(cfg.dtype))
+        # Weight-tied readout, f32 logits for a stable softmax.
+        return x.astype(jnp.float32) @ params["embed"].T
+
+    def loss(self, params, tokens, axis_name=None) -> jnp.ndarray:
+        """Mean next-token cross-entropy over the (single-device) batch."""
+        logits = self.apply(params, tokens[:, :-1], axis_name=axis_name)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel training step (the long-context path)
+# ---------------------------------------------------------------------------
+
+def make_sp_train_step(
+    model: TransformerLM,
+    mesh,
+    learning_rate: float = 0.1,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+):
+    """Build a jitted SPMD train step: ``step(params, tokens) ->
+    (new_params, loss)`` with batch over ``data_axis`` and sequence over
+    ``seq_axis`` (ring attention). ``tokens`` is the GLOBAL [B, S] array;
+    params are replicated and stay replicated (grad psum over both axes).
+    """
+    axes = (data_axis, seq_axis)
+
+    def local_step(params, tokens, targets, mask):
+        S_loc = tokens.shape[1]
+        offset = lax.axis_index(seq_axis) * S_loc
+
+        def loss_fn(p):
+            logits = model.apply(p, tokens, axis_name=seq_axis,
+                                 pos_offset=offset)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            tot = lax.psum((-ll * mask).sum(), axes)
+            cnt = lax.psum(mask.sum(), axes)
+            return tot / cnt
+
+        # Params enter replicated (unvarying) and the loss is psum-reduced,
+        # so shard_map's typed autodiff already inserts the cross-device
+        # gradient psum during transposition — grads come back replicated.
+        # (An explicit psum here would multiply the gradient by the device
+        # count.)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree.map(
+            lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads
+        )
+        return new_params, loss
+
+    tok_spec = P(data_axis, seq_axis)
+
+    @jax.jit
+    def step(params, tokens):
+        # Next-token setup happens globally, BEFORE sharding, so targets at
+        # a shard's last position come from the next shard's first token.
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+        )
+        mask = jnp.concatenate(
+            [jnp.ones_like(tokens[:, 1:], jnp.float32),
+             jnp.zeros_like(tokens[:, :1], jnp.float32)], axis=1
+        )
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), tok_spec, tok_spec, tok_spec),
+            out_specs=(P(), P()),
+        )(params, tokens, targets, mask)
+
+    return step
+
+
+def make_lm_data(
+    num_seqs: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> np.ndarray:
+    """Synthetic learnable corpus: orderly token walks with noise (next
+    token is predictable from the current one ~80% of the time), so
+    cross-entropy falls measurably within a few epochs."""
+    rng = np.random.default_rng(seed)
+    step = rng.integers(1, 7, size=(num_seqs, 1))
+    start = rng.integers(0, vocab_size, size=(num_seqs, 1))
+    walk = (start + step * np.arange(seq_len)[None, :]) % vocab_size
+    noise = rng.integers(0, vocab_size, size=walk.shape)
+    take_noise = rng.random(walk.shape) < 0.2
+    return np.where(take_noise, noise, walk).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Trainer SPI integration (LM in the elastic PS table)
+# ---------------------------------------------------------------------------
+
+class TransformerTrainer(Trainer):
+    """Train the LM through the framework: the flattened params pytree lives
+    in a range-partitioned DenseTable (rows of ``row_width`` f32), pull="all"
+    re-assembles it each batch, and the push folds ``-lr * grad`` through the
+    table's additive update fn. Batch = [B, S] int32 token matrix."""
+
+    pull_mode = "all"
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        row_width: int = 1024,
+        step_size: float = 0.1,
+        seed: int = 0,
+    ) -> None:
+        self.model = TransformerLM(config)
+        self.config = config
+        self.row_width = row_width
+        self.step_size = step_size
+        self.seed = seed
+        template = jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(0))
+        )
+        flat, self._unravel = ravel_pytree(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+        )
+        self.num_params = flat.shape[0]
+        self.num_rows = -(-self.num_params // row_width)
+
+    def model_table_config(
+        self, table_id: str = "lm-model", num_blocks: int = 0
+    ) -> TableConfig:
+        return TableConfig(
+            table_id=table_id,
+            capacity=self.num_rows,
+            value_shape=(self.row_width,),
+            num_blocks=num_blocks or max(self.num_rows // 8, 1),
+            is_ordered=True,
+            update_fn="add",
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def init_global_settings(self, ctx: TrainerContext) -> None:
+        params = self.model.init(jax.random.PRNGKey(self.seed))
+        flat, _ = ravel_pytree(params)
+        ctx.model_table.multi_put(
+            list(range(self.num_rows)), np.asarray(self._to_rows(flat))
+        )
+
+    # -- pure parts ------------------------------------------------------
+
+    def _to_rows(self, flat: jnp.ndarray) -> jnp.ndarray:
+        pad = self.num_rows * self.row_width - self.num_params
+        return jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)]
+        ).reshape(self.num_rows, self.row_width)
+
+    def hyperparams(self) -> Dict[str, float]:
+        return {"lr": self.step_size}
+
+    def compute(self, model, batch, hyper):
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        params = self._unravel(model.reshape(-1)[: self.num_params])
+        loss, grads = jax.value_and_grad(self.model.loss)(params, tokens)
+        gflat, _ = ravel_pytree(grads)
+        delta = self._to_rows(-hyper["lr"] * gflat)
+        return delta, {"loss": loss}
+
+    def evaluate(self, model, batch) -> Dict[str, jnp.ndarray]:
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        params = self._unravel(model.reshape(-1)[: self.num_params])
+        return {"loss": self.model.loss(params, tokens)}

@@ -79,6 +79,10 @@ def weighted_histogram(
     if interp and interpret is None:
         return _xla_histogram(ids, weights, num_bins)  # off-TPU fast path
     N, W = weights.shape
+    if N == 0:
+        # A zero-size grid would skip the kernel's i==0 init entirely and
+        # return an uninitialized buffer.
+        return jnp.zeros((num_bins, W), jnp.float32)
     block_n = min(block_n, max(N, 8))
     block_bins = min(block_bins, num_bins)
     pad = (-N) % block_n

@@ -51,9 +51,9 @@ def ring_attention(
     qf = q.astype(jnp.float32) * scale
     q_pos = my * S + jnp.arange(S)[:, None]            # global q positions
 
-    def step(carry, t):
-        acc, m, l, kb, vb = carry
-        src = (my - t) % n                              # kv chunk's home shard
+    def fold(acc, m, l, kb, vb, src):
+        """Merge one visiting KV chunk (home shard ``src``) into the online
+        softmax state."""
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
         if causal:
             kv_pos = src * S + jnp.arange(S)[None, :]
@@ -65,20 +65,30 @@ def ring_attention(
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32)
         )
-        # Rotate KV to the next device; after n steps it is home again.
+        return acc_new, m_new, l_new
+
+    def step(carry, t):
+        acc, m, l, kb, vb = carry
+        acc, m, l = fold(acc, m, l, kb, vb, (my - t) % n)
+        # Rotate KV to the next device for the following step.
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return (acc_new, m_new, l_new, kb, vb), None
+        return (acc, m, l, kb, vb), None
 
     # The softmax state starts replicated but becomes device-varying inside
-    # the scan; mark it varying up front (jax >= 0.7 vma typing of shard_map).
-    _vary = lambda x: lax.pcast(x, axis_name, to="varying")
-    acc0 = _vary(jnp.zeros((B, H, S, D), jnp.float32))
-    m0 = _vary(jnp.full((B, H, S), _NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, S), jnp.float32))
-    (acc, _, l, _, _), _ = lax.scan(
-        jax.checkpoint(step), (acc0, m0, l0, k, v), jnp.arange(n)
+    # the scan. Deriving it from q (zeros_like keeps the varying-axes type)
+    # gives it exactly q's manual axes — correct whether the surrounding
+    # shard_map maps one axis (the ring) or several (ring + batch).
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    m0 = jnp.full_like(q[..., 0], _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
+    # Scan the first n-1 chunks (each ends with a rotation); the last
+    # visiting chunk is folded outside the scan so its rotation — whose
+    # result nothing reads — is never issued.
+    (acc, m, l, kb, vb), _ = lax.scan(
+        jax.checkpoint(step), (acc0, m0, l0, k, v), jnp.arange(n - 1)
     )
+    acc, _, l = fold(acc, m, l, kb, vb, (my - (n - 1)) % n)
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 

@@ -45,23 +45,32 @@ class OptimizationOrchestrator:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.reconfig_log: List[PlanResult] = []
+        # Snapshot for worker->executor mapping (see _worker_executor_map).
+        self._initial_executors: List[str] = list(handle.block_manager.executors)
 
     # -- one optimization round (callable directly for tests) ------------
 
     def _worker_executor_map(self, worker_metrics) -> Dict[str, str]:
         """Map jobserver worker ids ("<job>/wN") to the table's Nth
         associated executor (collocated PS: worker N runs on executor N).
-        Ids that don't parse, or indexes beyond the executor list, are left
-        unmapped (optimizers fall back to identity)."""
-        executors = self.handle.block_manager.executors
+
+        Indexes into the executor list AS OF ORCHESTRATOR CREATION (job
+        setup): BlockManager.executors index-shifts when a plan unassociates
+        an executor, which would silently re-key surviving workers to the
+        wrong machines. Surviving workers keep their original executor;
+        workers whose executor has since left the table are left unmapped
+        (optimizers fall back to identity)."""
+        current = set(self.handle.block_manager.executors)
         out: Dict[str, str] = {}
         for m in worker_metrics:
             wid = m.worker_id
             if wid in out:
                 continue
             match = re.match(r".*/w(\d+)$", wid)
-            if match and int(match.group(1)) < len(executors):
-                out[wid] = executors[int(match.group(1))]
+            if match and int(match.group(1)) < len(self._initial_executors):
+                eid = self._initial_executors[int(match.group(1))]
+                if eid in current:
+                    out[wid] = eid
         return out
 
     def run_once(self) -> Optional[PlanResult]:

@@ -23,6 +23,7 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def local_devices(n: Optional[int] = None) -> List[jax.Device]:
@@ -39,28 +40,41 @@ def build_mesh(
     devices: Sequence[jax.Device],
     data: Optional[int] = None,
     model: Optional[int] = None,
+    seq: Optional[int] = None,
 ) -> Mesh:
-    """Build a 2-D (data, model) mesh over ``devices``.
+    """Build a (data, model) mesh — or (data, seq, model) when ``seq`` is
+    given — over ``devices``.
 
-    Defaults: all devices on the data axis (model axis size 1) — the pure
-    data-parallel shape. Either axis size may be given; the other is derived.
+    Defaults: all devices on the data axis (other axes size 1) — the pure
+    data-parallel shape. Any axis size may be given; one missing axis is
+    derived. The ``seq`` axis is the ring for sequence/context parallelism
+    (harmony_tpu.ops.ring); adjacent ring members are adjacent in the device
+    order, so on hardware the ppermute rides neighbour ICI links.
     """
     n = len(devices)
-    if data is None and model is None:
-        data, model = n, 1
-    elif data is None:
-        assert model is not None
-        if n % model:
-            raise ValueError(f"{n} devices not divisible by model={model}")
-        data = n // model
-    elif model is None:
-        if n % data:
-            raise ValueError(f"{n} devices not divisible by data={data}")
-        model = n // data
-    if data * model != n:
-        raise ValueError(f"data*model={data * model} != num devices {n}")
-    arr = np.asarray(devices, dtype=object).reshape(data, model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    if seq is None:
+        sizes = {"data": data, "model": model}
+        names = (DATA_AXIS, MODEL_AXIS)
+    else:
+        sizes = {"data": data, "seq": seq, "model": model}
+        names = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+    unknown = [k for k, v in sizes.items() if v is None]
+    known = int(np.prod([v for v in sizes.values() if v is not None])) or 1
+    if len(unknown) > 1:
+        if set(unknown) == {"data", "model"} and n % known == 0:
+            sizes["data"], sizes["model"] = n // known, 1
+        else:
+            raise ValueError(f"underdetermined mesh axes {unknown}")
+    elif len(unknown) == 1:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {sizes}")
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh {sizes} != num devices {n}")
+    order = ("data", "seq", "model") if seq is not None else ("data", "model")
+    arr = np.asarray(devices, dtype=object).reshape(*[sizes[k] for k in order])
+    return Mesh(arr, names)
 
 
 class DevicePool:

@@ -138,3 +138,9 @@ def test_weighted_histogram_bins_tiling():
     np.add.at(expect, ids, w)
     assert out.shape == (300, 2)
     np.testing.assert_allclose(out, expect, atol=1e-4)
+
+
+def test_segment_sum_empty_input():
+    out = segment_sum(jnp.zeros((0, 4)), jnp.zeros((0,), jnp.int32), 16,
+                      interpret=True)
+    np.testing.assert_allclose(out, np.zeros((16, 4)))
