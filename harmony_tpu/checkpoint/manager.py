@@ -15,9 +15,13 @@ Parity with the reference's checkpoint protocol (SURVEY.md §3.5):
     (ref: ChkpManagerMaster.java:49-61, restore path picking loaders by
     commit state).
 
-Format: one ``.npy`` per block plus a JSON manifest carrying the table
+Format: one block file per block plus a JSON manifest carrying the table
 config, ownership at checkpoint time, commit state, and sampling ratio —
-enough to rebuild the table (and its BlockManager) from scratch.
+enough to rebuild the table (and its BlockManager) from scratch. Block
+files use the native CRC32-checked ``.blk`` codec (harmony_tpu.native,
+C++) when available — restore then fails loudly on torn/corrupt blocks —
+and fall back to ``.npy``; restore reads either, so checkpoints travel
+between environments with and without the native library.
 """
 from __future__ import annotations
 
@@ -31,10 +35,27 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from harmony_tpu import native
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.runtime.master import ETMaster, TableHandle
 from harmony_tpu.table.table import TableSpec
+
+
+def _write_block(d: str, bid: int, arr: np.ndarray) -> None:
+    if native.available():
+        native.blk_write(os.path.join(d, f"{bid}.blk"), arr)
+    else:
+        np.save(os.path.join(d, f"{bid}.npy"), arr)
+
+
+def _read_block(d: str, bid: int) -> np.ndarray:
+    """Read a block in either format (native.BlockCorruptError propagates —
+    a corrupt committed block must abort the restore, not feed garbage)."""
+    blk = os.path.join(d, f"{bid}.blk")
+    if os.path.exists(blk):
+        return native.blk_read(blk)
+    return np.load(os.path.join(d, f"{bid}.npy"))
 
 
 @dataclasses.dataclass
@@ -103,7 +124,7 @@ class CheckpointManager:
         if sampling_ratio < 1.0:
             keep = max(1, int(table.spec.block_size * sampling_ratio))
         for bid, arr in blocks.items():
-            np.save(os.path.join(tdir, f"{bid}.npy"), arr[:keep] if keep else arr)
+            _write_block(tdir, bid, arr[:keep] if keep else arr)
         info = CheckpointInfo(
             chkp_id=chkp_id,
             table_config=table.spec.config,
@@ -203,7 +224,7 @@ class CheckpointManager:
             spec = handle.table.spec
             blocks: Dict[int, np.ndarray] = {}
             for bid in info.block_ids:
-                arr = np.load(os.path.join(d, f"{bid}.npy"))
+                arr = _read_block(d, bid)
                 if arr.shape[0] < spec.block_size:
                     # sampled: pad with the block's existing init values
                     full = np.array(handle.table.export_blocks([bid])[bid])

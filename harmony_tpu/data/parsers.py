@@ -57,6 +57,17 @@ class LibSvmParser(DataParser):
         self.base = base
 
     def parse(self, records: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        from harmony_tpu import native
+
+        if native.available() and records:
+            # C++ hot loop (native/harmony_native.cc: ht_parse_libsvm).
+            x, y = native.parse_libsvm(
+                "\n".join(records) + "\n", self.num_features, self.base
+            )
+            if x.shape[0] == len(records):
+                return x, y
+            # Row-count drift (e.g. records containing embedded newlines):
+            # fall through to the reference Python path.
         n = len(records)
         x = np.zeros((n, self.num_features), np.float32)
         y = np.zeros((n,), np.float32)

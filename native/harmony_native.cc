@@ -1,0 +1,172 @@
+// harmony_tpu native runtime pieces (C ABI, loaded via ctypes).
+//
+// The reference reaches native code only through library JNI (SURVEY.md
+// §5.9): BLAS via Breeze/netlib (→ XLA here), the Netty transport, and the
+// Hadoop HDFS client for data loading + checkpoint commit. This file is the
+// TPU build's equivalent of the latter two host-side data planes:
+//
+//   * ht_parse_libsvm — the data-loader hot loop (text records → dense
+//     feature matrix), ~20-40x the CPython per-token cost of the pure-Python
+//     parser (ref path: HdfsSplitFetcher.fetchData → DataParser).
+//   * ht_blk_write / ht_blk_read — per-block checkpoint files with a CRC32
+//     integrity footer, the durable-commit analogue of ChkpManagerSlave's
+//     temp→HDFS two-stage files (evaluator/impl/ChkpManagerSlave.java:50-63).
+//     Read verifies the checksum so a torn/corrupt block fails restore
+//     loudly instead of feeding garbage into a model table.
+//
+// Build: g++ -O3 -shared -fPIC (driven lazily by harmony_tpu/native).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32 (IEEE 802.3, table-driven)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static int crc_ready = 0;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_ready = 1;
+}
+
+uint32_t ht_crc32(const uint8_t* data, uint64_t len) {
+  if (!crc_ready) crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < len; i++)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// LibSVM parsing: "label idx:val idx:val ...\n" → dense x [rows, F] + y
+// ---------------------------------------------------------------------------
+
+static inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+  return p;
+}
+
+// Returns number of rows parsed, -1 if more than max_rows lines present,
+// or -2 on a malformed record (unparseable label or feature token) — strict
+// parity with the Python parser, which raises on corrupt data instead of
+// silently training on it. Out-of-range feature indices are ignored (also
+// parity).
+int64_t ht_parse_libsvm(const char* buf, uint64_t len, int32_t num_features,
+                        int32_t base, float* x, float* y, int64_t max_rows) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0;
+  while (p < end) {
+    p = skip_ws(p, end);
+    if (p >= end) break;
+    if (*p == '\n') { p++; continue; }  // blank line
+    if (row >= max_rows) return -1;
+    char* next = nullptr;
+    y[row] = strtof(p, &next);
+    if (next == p) return -2;  // label is not a number
+    p = next;
+    float* xrow = x + (uint64_t)row * num_features;
+    while (p < end && *p != '\n') {
+      p = skip_ws(p, end);
+      if (p >= end || *p == '\n') break;
+      long idx = strtol(p, &next, 10);
+      if (next == p || next >= end || *next != ':') return -2;
+      p = next + 1;  // past ':'
+      float val = strtof(p, &next);
+      if (next == p) return -2;  // "idx:" with no value
+      p = next;
+      long j = idx - base;
+      if (j >= 0 && j < num_features) xrow[j] = val;
+    }
+    if (p < end) p++;  // consume '\n'
+    row++;
+  }
+  return row;
+}
+
+// ---------------------------------------------------------------------------
+// Block files: [magic u32][dtype u32][ndim u32][shape u64 x ndim]
+//              [payload bytes][crc32 u32 of payload]
+// ---------------------------------------------------------------------------
+
+static const uint32_t BLK_MAGIC = 0x48544231u;  // "HTB1"
+#define BLK_MAX_NDIM 8
+
+// 0 on success, negative on error.
+int32_t ht_blk_write(const char* path, const void* data, uint64_t nbytes,
+                     const uint64_t* shape, int32_t ndim, int32_t dtype_code) {
+  if (ndim < 0 || ndim > BLK_MAX_NDIM) return -2;
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  uint32_t head[3] = {BLK_MAGIC, (uint32_t)dtype_code, (uint32_t)ndim};
+  uint32_t crc = ht_crc32((const uint8_t*)data, nbytes);
+  int ok = fwrite(head, sizeof(head), 1, f) == 1 &&
+           (ndim == 0 || fwrite(shape, sizeof(uint64_t), ndim, f) == (size_t)ndim) &&
+           (nbytes == 0 || fwrite(data, 1, nbytes, f) == nbytes) &&
+           fwrite(&crc, sizeof(crc), 1, f) == 1;
+  ok = (fflush(f) == 0) && ok;
+  ok = (fclose(f) == 0) && ok;
+  return ok ? 0 : -3;
+}
+
+// Phase 1 (out == NULL): fills *dtype_out, *ndim_out, shape_out and returns
+// payload byte count. Phase 2 (out != NULL, out_cap >= nbytes): copies the
+// payload, verifies CRC. Returns nbytes on success; negative on error
+// (-4 bad magic / truncated header, -5 payload/out_cap mismatch,
+//  -6 CRC mismatch — the corrupt-block signal).
+int64_t ht_blk_read(const char* path, void* out, uint64_t out_cap,
+                    uint64_t* shape_out, int32_t* ndim_out,
+                    int32_t* dtype_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint32_t head[3];
+  if (fread(head, sizeof(head), 1, f) != 1 || head[0] != BLK_MAGIC ||
+      head[2] > BLK_MAX_NDIM) {
+    fclose(f);
+    return -4;
+  }
+  int32_t ndim = (int32_t)head[2];
+  uint64_t shape[BLK_MAX_NDIM];
+  if (ndim > 0 && fread(shape, sizeof(uint64_t), ndim, f) != (size_t)ndim) {
+    fclose(f);
+    return -4;
+  }
+  long data_start = ftell(f);
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return -4; }
+  long file_end = ftell(f);
+  int64_t nbytes = file_end - data_start - (long)sizeof(uint32_t);
+  if (nbytes < 0) { fclose(f); return -4; }
+  if (dtype_out) *dtype_out = (int32_t)head[1];
+  if (ndim_out) *ndim_out = ndim;
+  if (shape_out)
+    for (int32_t i = 0; i < ndim; i++) shape_out[i] = shape[i];
+  if (!out) {  // metadata probe
+    fclose(f);
+    return nbytes;
+  }
+  if ((uint64_t)nbytes > out_cap) { fclose(f); return -5; }
+  if (fseek(f, data_start, SEEK_SET) != 0) { fclose(f); return -4; }
+  if (nbytes > 0 && fread(out, 1, (size_t)nbytes, f) != (size_t)nbytes) {
+    fclose(f);
+    return -4;
+  }
+  uint32_t crc_stored;
+  if (fread(&crc_stored, sizeof(crc_stored), 1, f) != 1) {
+    fclose(f);
+    return -4;
+  }
+  fclose(f);
+  if (ht_crc32((const uint8_t*)out, (uint64_t)nbytes) != crc_stored) return -6;
+  return nbytes;
+}
+
+}  // extern "C"

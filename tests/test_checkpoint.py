@@ -124,7 +124,9 @@ def test_failed_restore_leaves_no_orphan_table(mgr, master):
 
     h, _ = make_handle(master, tid="t-orphan")
     cid = mgr.checkpoint(h, commit=True)
-    os.remove(os.path.join(mgr.commit_root, cid, "3.npy"))
+    cdir = os.path.join(mgr.commit_root, cid)
+    victim = next(f for f in os.listdir(cdir) if f.startswith("3."))
+    os.remove(os.path.join(cdir, victim))
     with pytest.raises(FileNotFoundError):
         mgr.restore(master, cid, master.executor_ids()[:2], table_id="t-orphan2")
     assert "t-orphan2" not in master.table_ids()
