@@ -403,3 +403,21 @@ def make_synthetic(
         return x, (raw > raw.mean()).astype(np.float32)
     q = np.quantile(raw, np.linspace(0, 1, num_classes + 1)[1:-1])
     return x, np.digitize(raw, q).astype(np.int32)
+
+
+def make_binned_synthetic(
+    n: int,
+    num_features: int,
+    num_bins: int = 16,
+    seed: int = 0,
+    task: str = "regression",
+    num_classes: int = 2,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic data pre-quantized to bin ids — the (bins, y) pair the
+    trainer consumes (CLI preset convenience: bin_features + make_synthetic
+    in one call; the edges are discarded because synthetic demos never score
+    raw-valued held-out data)."""
+    x, y = make_synthetic(n, num_features, seed=seed, task=task,
+                          num_classes=num_classes)
+    bins, _ = bin_features(x, num_bins)
+    return bins, y

@@ -1,0 +1,314 @@
+"""Command-line entry points — parity with the reference's ``bin/`` scripts.
+
+Reference mapping (SURVEY.md appendix: entry-point index):
+
+  start_jobserver.sh      -> ``harmony-tpu start-jobserver``
+  submit_<app>.sh         -> ``harmony-tpu submit <app> [overrides]``
+  run_<app>.sh (standalone)-> ``harmony-tpu run <app> [overrides]``
+  (SHUTDOWN command)      -> ``harmony-tpu shutdown``
+  (status)                -> ``harmony-tpu status``
+  dashboard.py            -> ``harmony-tpu dashboard``
+
+Every app ships a synthetic-data preset (the reference's submit scripts
+likewise bake in example scales, e.g. submit_mlr.sh's 10x784) overridable
+with ``--set key=value`` (app hyper-params), ``--data key=value`` (data/graph
+args) and the common flags. ``submit`` talks to a running JobServer over the
+TCP control plane; ``run`` is the standalone ETDolphinLauncher analogue
+(in-process server, one job, exit).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+from harmony_tpu.config.params import JobConfig, TrainerParams
+
+# -- app presets ------------------------------------------------------------
+# Scales chosen to finish in seconds on one chip while exercising the real
+# code paths; override any field via --set / --data.
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "mlr": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        app_params={"num_classes": 10, "num_features": 784,
+                    "features_per_partition": 98, "step_size": 0.1},
+        data_fn="harmony_tpu.apps.mlr:make_synthetic",
+        data_args={"n": 4096, "num_features": 784, "num_classes": 10},
+    ),
+    "nmf": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.nmf:NMFTrainer",
+        app_params={"num_rows": 256, "num_cols": 256, "rank": 16,
+                    "step_size": 0.05},
+        data_fn="harmony_tpu.apps.nmf:make_synthetic",
+        data_args={"num_rows": 256, "num_cols": 256, "rank": 16},
+    ),
+    "lda": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.lda:LDATrainer",
+        app_params={"vocab_size": 500, "num_topics": 10, "num_docs": 256,
+                    "max_doc_len": 64},
+        data_fn="harmony_tpu.apps.lda:make_synthetic",
+        data_args={"num_docs": 256, "vocab_size": 500, "max_doc_len": 64,
+                   "num_topics": 10},
+    ),
+    "lasso": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.lasso:LassoTrainer",
+        app_params={"num_features": 256, "lam": 0.05},
+        data_fn="harmony_tpu.apps.lasso:make_synthetic",
+        data_args={"n": 2048, "num_features": 256},
+    ),
+    "gbt": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.gbt:GBTTrainer",
+        app_params={"num_features": 16, "num_examples": 2048,
+                    "num_rounds": 16, "loss": "squared", "max_depth": 4},
+        data_fn="harmony_tpu.apps.gbt:make_binned_synthetic",
+        data_args={"n": 2048, "num_features": 16},
+    ),
+    "addvector": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.addvector:AddVectorTrainer",
+        app_params={"num_keys": 32, "vector_dim": 8},
+        data_fn="harmony_tpu.apps.addvector:make_marks",
+        data_args={"n": 1024},
+    ),
+    "addinteger": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.addvector:AddIntegerTrainer",
+        app_params={"num_keys": 16},
+        data_fn="harmony_tpu.apps.addvector:make_marks",
+        data_args={"n": 1024},
+    ),
+    "lm": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.models.transformer:TransformerTrainer",
+        app_params={"vocab_size": 128, "d_model": 64, "n_heads": 4,
+                    "n_layers": 2, "d_ff": 256, "max_seq": 64,
+                    "step_size": 0.2},
+        data_fn="harmony_tpu.models.transformer:make_lm_data",
+        data_args={"num_seqs": 64, "seq_len": 65, "vocab_size": 128},
+    ),
+    "pagerank": dict(
+        app_type="pregel",
+        trainer="harmony_tpu.apps.pagerank:PageRankComputation",
+        app_params={"num_iterations": 10},
+        graph_fn="harmony_tpu.pregel.graph:random_graph",
+        graph_args={"num_vertices": 1000, "avg_degree": 5},
+    ),
+    "shortest-path": dict(
+        app_type="pregel",
+        trainer="harmony_tpu.apps.sssp:ShortestPathComputation",
+        app_params={"source": 0},
+        graph_fn="harmony_tpu.pregel.graph:random_graph",
+        graph_args={"num_vertices": 1000, "avg_degree": 5, "weighted": True},
+    ),
+}
+
+
+def _parse_kv(pairs: List[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for p in pairs or []:
+        if "=" not in p:
+            raise SystemExit(f"bad override {p!r}: expected key=value")
+        k, v = p.split("=", 1)
+        try:
+            out[k] = json.loads(v)   # numbers, bools, lists, quoted strings
+        except json.JSONDecodeError:
+            out[k] = v               # bare string
+    return out
+
+
+def build_config(app: str, args: argparse.Namespace) -> JobConfig:
+    if app not in PRESETS:
+        raise SystemExit(f"unknown app {app!r}; available: {sorted(PRESETS)}")
+    preset = {k: (dict(v) if isinstance(v, dict) else v)
+              for k, v in PRESETS[app].items()}
+    preset["app_params"].update(_parse_kv(args.set))
+    user: Dict[str, Any] = {}
+    if preset["app_type"] == "pregel":
+        if args.graph_file:
+            user["graph_fn"] = "harmony_tpu.pregel.graph:load_edge_list"
+            user["graph_args"] = {"path": args.graph_file}
+        else:
+            user["graph_fn"] = preset["graph_fn"]
+            user["graph_args"] = preset["graph_args"]
+        user["graph_args"].update(_parse_kv(args.data))
+        user["max_supersteps"] = args.max_supersteps
+    else:
+        user["data_fn"] = preset["data_fn"]
+        user["data_args"] = {**preset["data_args"], **_parse_kv(args.data)}
+    if app == "lm":
+        # vocab must match between model and data; an explicit override on
+        # either side wins over the preset default (both sides: error).
+        set_v = _parse_kv(args.set).get("vocab_size")
+        data_v = _parse_kv(args.data).get("vocab_size")
+        if set_v is not None and data_v is not None and set_v != data_v:
+            raise SystemExit(
+                f"conflicting vocab_size: --set {set_v} vs --data {data_v}")
+        vocab = set_v if set_v is not None else user["data_args"]["vocab_size"]
+        preset["app_params"]["vocab_size"] = vocab
+        user["data_args"]["vocab_size"] = vocab
+    job_id = args.job_id or f"{app}-job"
+    return JobConfig(
+        job_id=job_id,
+        app_type=preset["app_type"],
+        trainer=preset["trainer"],
+        params=TrainerParams(
+            num_epochs=args.epochs,
+            num_mini_batches=args.batches,
+            clock_slack=args.slack,
+            app_params=preset["app_params"],
+        ),
+        num_workers=args.workers,
+        user=user,
+    )
+
+
+def _common_job_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--job-id", default=None)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batches", type=int, default=4,
+                   help="mini-batches per epoch")
+    p.add_argument("--workers", type=int, default=0,
+                   help="0 = one worker per executor")
+    p.add_argument("--slack", type=int, default=0,
+                   help="SSP clock slack (0 = BSP)")
+    p.add_argument("--set", action="append", metavar="K=V", default=[],
+                   help="override an app hyper-parameter")
+    p.add_argument("--data", action="append", metavar="K=V", default=[],
+                   help="override a synthetic-data/graph argument")
+    p.add_argument("--graph-file", default=None,
+                   help="edge-list file (pregel apps; replaces the synthetic graph)")
+    p.add_argument("--max-supersteps", type=int, default=100)
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="harmony-tpu",
+        description="TPU-native multi-tenant elastic training framework",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start-jobserver", help="long-running multi-tenant master")
+    p.add_argument("--num-executors", type=int, default=0,
+                   help="0 = one per local device")
+    p.add_argument("--port", type=int, default=43110)
+
+    for name in ("submit", "run"):
+        p = sub.add_parser(
+            name,
+            help=("submit a job to a running jobserver" if name == "submit"
+                  else "run one job standalone (in-process server)"),
+        )
+        p.add_argument("app", choices=sorted(PRESETS))
+        _common_job_flags(p)
+        if name == "submit":
+            p.add_argument("--port", type=int, default=43110)
+        else:
+            p.add_argument("--num-executors", type=int, default=0)
+
+    p = sub.add_parser("status", help="query a running jobserver")
+    p.add_argument("--port", type=int, default=43110)
+    p = sub.add_parser("shutdown", help="graceful jobserver shutdown")
+    p.add_argument("--port", type=int, default=43110)
+    p = sub.add_parser("dashboard", help="metrics dashboard HTTP server")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--db", default=":memory:")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "start-jobserver":
+        return _cmd_start_jobserver(args)
+    if args.cmd == "submit":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        cfg = build_config(args.app, args)
+        resp = CommandSender(args.port).send_job_submit_command(cfg)
+        print(json.dumps(resp))
+        return 0 if resp.get("ok") else 1
+    if args.cmd == "run":
+        return _cmd_run(args)
+    if args.cmd in ("status", "shutdown"):
+        from harmony_tpu.jobserver.client import CommandSender
+
+        sender = CommandSender(args.port)
+        resp = (sender.send_status_command() if args.cmd == "status"
+                else sender.send_shutdown_command())
+        print(json.dumps(resp))
+        return 0
+    if args.cmd == "dashboard":
+        from harmony_tpu.dashboard.server import DashboardServer
+
+        server = DashboardServer(db_path=args.db, port=args.port).start()
+        print(f"dashboard at {server.url}", flush=True)
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+    raise SystemExit(f"unknown command {args.cmd}")
+
+
+def _make_server(num_executors: int):
+    import jax
+
+    from harmony_tpu.jobserver.server import JobServer
+
+    n = num_executors or len(jax.devices())
+    server = JobServer(num_executors=n)
+    server.start()
+    return server
+
+
+def _cmd_start_jobserver(args: argparse.Namespace) -> int:
+    server = _make_server(args.num_executors)
+    port = server.serve_tcp(args.port)
+    print(f"jobserver ready on port {port}", flush=True)
+    try:
+        while server.state != "CLOSED":
+            import time
+
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    server = _make_server(args.num_executors)
+    try:
+        cfg = build_config(args.app, args)
+        fut = server.submit(cfg)
+        result = fut.result()
+        print(json.dumps({"job_id": cfg.job_id, "result": _jsonable(result)}))
+        return 0
+    finally:
+        server.shutdown(timeout=60.0)
+
+
+def _jsonable(obj: Any) -> Any:
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    return obj
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,8 +248,92 @@ class DolphinJobEntity(JobEntity):
         return self._handle
 
 
+class PregelJobEntity(JobEntity):
+    """Vertex-centric BSP job under the JobServer (ref: the pregel side of
+    the app-type switch — pregel/jobserver/PregelJobEntity.java: vertex +
+    swapped message tables on the job's executors, PregelMaster run loop).
+
+    Config mapping: ``config.trainer`` names the Computation class;
+    ``user.graph_fn``/``user.graph_args`` build the Graph (the analogue of
+    the reference's vertex-file bulk load); ``user.max_supersteps`` bounds
+    the run. Computation classes that take the graph (PageRank's out-degree
+    normalization) receive it as a ``graph=`` kwarg."""
+
+    def __init__(
+        self,
+        config: JobConfig,
+        global_taskunit: Optional[GlobalTaskUnitScheduler] = None,
+        local_taskunit: Optional[LocalTaskUnitScheduler] = None,
+        metric_sink=None,
+    ) -> None:
+        super().__init__(config)
+        self._global_tu = global_taskunit
+        self._local_tu = local_taskunit
+        self._pregel_master = None
+        self._registered = False
+
+    def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
+        import inspect
+
+        from harmony_tpu.parallel.mesh import build_mesh
+        from harmony_tpu.pregel.master import PregelMaster
+
+        cfg = self.config
+        user = cfg.user
+        if "graph_fn" not in user:
+            raise ValueError(f"job {cfg.job_id}: user.graph_fn missing")
+        graph = resolve_symbol(user["graph_fn"])(**user.get("graph_args", {}))
+        comp_cls = resolve_symbol(cfg.trainer)
+        app_params = dict(cfg.params.app_params)
+        if "graph" in inspect.signature(comp_cls.__init__).parameters:
+            app_params["graph"] = graph
+        computation = comp_cls(**app_params)
+        devices = [master.executor(e).device for e in executor_ids]
+        mesh = build_mesh(devices, data=1)
+        taskunit = None
+        if self._global_tu is not None and self._local_tu is not None:
+            wid = f"{cfg.job_id}/w0"
+            self._global_tu.on_job_start(cfg.job_id, [wid])
+            self._registered = True
+            taskunit = TaskUnitClient(cfg.job_id, wid, self._global_tu, self._local_tu)
+        try:
+            self._pregel_master = PregelMaster(
+                graph,
+                computation,
+                mesh,
+                max_supersteps=int(user.get("max_supersteps", 100)),
+                taskunit=taskunit,
+                job_id=cfg.job_id,
+            )
+        except BaseException:
+            self._deregister()  # a failed setup must not leave a stale quorum
+            raise
+
+    def _deregister(self) -> None:
+        if self._registered and self._global_tu is not None:
+            self._global_tu.on_executor_done(self.config.job_id,
+                                             f"{self.config.job_id}/w0")
+            self._global_tu.on_job_finish(self.config.job_id)
+            self._registered = False
+
+    def run(self) -> Dict[str, Any]:
+        # Deregister in finally: a job that dies mid-superstep must not leave
+        # its quorum entry in the global TaskUnit scheduler (stale quorums
+        # deadlock other jobs' wait_ready on the long-running server).
+        try:
+            return self._pregel_master.run()
+        finally:
+            self._deregister()
+
+    def cleanup(self) -> None:
+        if self._pregel_master is not None:
+            self._pregel_master.close()
+
+
 def build_entity(config: JobConfig, **kwargs) -> JobEntity:
     """App-type dispatch (ref: JobEntity.getJobEntity app-type switch)."""
     if config.app_type == "dolphin":
         return DolphinJobEntity(config, **kwargs)
+    if config.app_type == "pregel":
+        return PregelJobEntity(config, **kwargs)
     raise ValueError(f"unknown app_type {config.app_type!r}")

@@ -249,11 +249,19 @@ class TransformerTrainer(Trainer):
 
     def __init__(
         self,
-        config: TransformerConfig,
+        config: Optional[TransformerConfig] = None,
         row_width: int = 1024,
         step_size: float = 0.1,
         seed: int = 0,
+        **config_kwargs,
     ) -> None:
+        if config is None:
+            # Flat-kwargs construction: JobConfig.app_params must stay
+            # JSON-serializable for the TCP submit path, so the CLI passes
+            # vocab_size/d_model/... directly instead of a config object.
+            config = TransformerConfig(**config_kwargs)
+        elif config_kwargs:
+            raise TypeError("pass either config= or flat config kwargs, not both")
         self.model = TransformerLM(config)
         self.config = config
         self.row_width = row_width

@@ -51,3 +51,48 @@ class Graph:
             else None
         )
         return Graph(num_vertices, src, dst, w)
+
+
+def random_graph(
+    num_vertices: int, avg_degree: int = 4, seed: int = 0, weighted: bool = False
+) -> Graph:
+    """Synthetic digraph for examples/CLI presets: every vertex gets
+    ``avg_degree`` out-edges to uniform targets (self-loops filtered),
+    optionally with uniform [0.5, 1.5) weights (for shortest-path demos)."""
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(num_vertices), avg_degree)
+    dst = rng.integers(0, num_vertices, size=src.shape)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    w = rng.uniform(0.5, 1.5, size=src.shape).astype(np.float32) if weighted else None
+    return Graph(num_vertices, src, dst, w)
+
+
+def load_edge_list(path: str, num_vertices: int = 0) -> Graph:
+    """Parse a whitespace edge-list file (``src dst [weight]`` per line,
+    ``#`` comments) — the CLI analogue of the reference's vertex-file input."""
+    src, dst, w = [], [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) not in (2, 3):
+                raise ValueError(f"{path}:{lineno}: expected 'src dst [weight]'")
+            src.append(int(parts[0]))
+            dst.append(int(parts[1]))
+            if len(parts) > 2:
+                w.append(float(parts[2]))
+            elif w:
+                raise ValueError(
+                    f"{path}:{lineno}: unweighted edge in a weighted file "
+                    "(every line must carry a weight, or none)"
+                )
+    if w and len(w) != len(src):
+        raise ValueError(f"{path}: only {len(w)} of {len(src)} edges weighted")
+    n = num_vertices or (max(max(src), max(dst)) + 1 if src else 0)
+    return Graph(
+        n, np.asarray(src), np.asarray(dst),
+        np.asarray(w, np.float32) if w else None,
+    )

@@ -181,6 +181,13 @@ class PregelMaster:
             "vertex_values": np.asarray(self.vertex_table.pull_array()),
         }
 
+    def close(self) -> None:
+        """Release every device-resident table (vertex + both message
+        double-buffers). The one place that knows the full table set — job
+        entities must call this instead of reaching into internals."""
+        for t in [self.vertex_table, *self._msg_tables, *self._has_msg]:
+            t.drop()
+
     def _tu(self, kind: str):
         if self.taskunit is None:
             import contextlib
