@@ -1,18 +1,18 @@
 #!/usr/bin/env python
-"""Headline benchmark: MLR training throughput through the framework.
+"""Headline benchmark — BASELINE.md config 4: aggregate training throughput
+of CONCURRENT MLR + NMF + LDA jobs sharing one mesh under the JobServer
+(the reference's north-star metric: aggregate samples/sec across concurrent
+jobs on a shared multi-tenant substrate).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
 
 The reference publishes no numbers (BASELINE.md: "published: {}"); its
-north-star target is >=4x a CPU-cluster aggregate on PS workloads. So
-``vs_baseline`` here is measured TPU samples/sec divided by the same
-framework step running on this host's CPU backend — the honest local proxy
-for "TPU vs CPU cluster": >=4.0 meets the north star.
-
-Scale is an MLR job sized for one chip (the reference's example operating
-point is 10 classes x 784 features on 5 tiny CPU executors; we bench a
-heavier softmax regression that actually exercises the MXU).
+north-star target is >=4x a CPU-cluster aggregate. ``vs_baseline`` is the
+measured accelerator aggregate divided by the SAME three concurrent jobs run
+on this host's CPU backend — the honest local proxy: >=4.0 meets the north
+star. Wall time includes each job's compile (both backends pay it), so the
+ratio is conservative.
 """
 import json
 import sys
@@ -28,75 +28,109 @@ try:
 except Exception:
     pass
 
-import numpy as np  # noqa: E402
+from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
+from harmony_tpu.jobserver.server import JobServer  # noqa: E402
+from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
 
-from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic  # noqa: E402
-from harmony_tpu.config.params import TrainerParams  # noqa: E402
-from harmony_tpu.dolphin import TrainerContext, TrainingDataProvider, WorkerTasklet  # noqa: E402
-from harmony_tpu.metrics import MetricCollector, MetricManager  # noqa: E402
-from harmony_tpu.parallel import build_mesh  # noqa: E402
-from harmony_tpu.table import DenseTable, TableSpec  # noqa: E402
-
-NUM_CLASSES = 64
-NUM_FEATURES = 4096
-FPP = 512
-N_EXAMPLES = 32768
-NUM_BATCHES = 8          # batch = 4096
-WARM_EPOCHS = 1
-MEASURE_EPOCHS = 3
+EPOCHS = 4
+BATCHES = 8
 
 
-def run(devices, epochs, n_examples=N_EXAMPLES, seed=0):
-    """Train MLR through the framework; return steady-state samples/sec
-    (excludes epoch 0: compile + H2D)."""
-    mesh = build_mesh(devices)
-    trainer = MLRTrainer(NUM_CLASSES, NUM_FEATURES, FPP, step_size=0.05)
-    table = DenseTable(TableSpec(trainer.model_table_config()), mesh)
-    params = TrainerParams(num_epochs=epochs, num_mini_batches=NUM_BATCHES)
-    x, y = make_synthetic(n_examples, NUM_FEATURES, NUM_CLASSES, seed=seed)
-    manager = MetricManager()
-    manager.start_collection()
-    worker = WorkerTasklet(
-        "bench-mlr",
-        TrainerContext(params=params, model_table=table),
-        trainer,
-        TrainingDataProvider([x, y], NUM_BATCHES),
-        mesh,
-        collector=MetricCollector(sink=manager.on_metric),
+def job_configs(scale: float):
+    """The three BASELINE jobs, sized to exercise the MXU; ``scale`` shrinks
+    the CPU baseline run (it only sets the denominator — rates, not totals,
+    are compared)."""
+    mlr_n = max(int(32768 * scale), BATCHES * 64)
+    nmf_rows = max(int(4096 * scale), BATCHES * 8)
+    lda_docs = max(int(2048 * scale), BATCHES * 8)
+    mlr = JobConfig(
+        job_id="bench-mlr", app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            app_params={"num_classes": 64, "num_features": 2048,
+                        "features_per_partition": 256, "step_size": 0.05},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": mlr_n, "num_features": 2048,
+                            "num_classes": 64}},
     )
-    worker.run()
-    steady = [m for m in manager.worker_batch_metrics() if m.epoch_idx >= WARM_EPOCHS]
-    n = sum(m.num_examples for m in steady)
-    t = sum(m.batch_time_sec for m in steady)
-    return n / t if t > 0 else 0.0
+    nmf = JobConfig(
+        job_id="bench-nmf", app_type="dolphin",
+        trainer="harmony_tpu.apps.nmf:NMFTrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            app_params={"num_rows": nmf_rows, "num_cols": 1024, "rank": 64,
+                        "step_size": 0.01},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.nmf:make_synthetic",
+              "data_args": {"num_rows": nmf_rows, "num_cols": 1024,
+                            "rank": 64}},
+    )
+    lda = JobConfig(
+        job_id="bench-lda", app_type="dolphin",
+        trainer="harmony_tpu.apps.lda:LDATrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            app_params={"vocab_size": 4096, "num_topics": 32,
+                        "num_docs": lda_docs, "max_doc_len": 128},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.lda:make_synthetic",
+              "data_args": {"num_docs": lda_docs, "vocab_size": 4096,
+                            "num_topics": 32, "doc_len": 128}},
+    )
+    # examples processed per job = epochs * dataset size
+    totals = {"bench-mlr": EPOCHS * mlr_n, "bench-nmf": EPOCHS * nmf_rows,
+              "bench-lda": EPOCHS * lda_docs}
+    return [mlr, nmf, lda], totals
+
+
+def run_concurrent(devices, scale: float) -> float:
+    """Submit the three jobs concurrently to one JobServer over ``devices``;
+    aggregate samples/sec = total examples / wall."""
+    configs, totals = job_configs(scale)
+    server = JobServer(num_executors=len(devices),
+                       device_pool=DevicePool(devices))
+    server.start()
+    try:
+        t0 = time.perf_counter()
+        futures = [server.submit(c) for c in configs]
+        for f in futures:
+            f.result(timeout=3600)
+        wall = time.perf_counter() - t0
+    finally:
+        server.shutdown(timeout=120)
+    total = sum(totals.values())
+    rate = total / wall
+    print(f"  {len(configs)} jobs, {total} examples, {wall:.1f}s "
+          f"-> {rate:,.0f} samples/sec aggregate", file=sys.stderr)
+    return rate
 
 
 def main():
-    accel = jax.devices()  # default platform = the real chip(s) under the driver
+    accel = jax.devices()
     print(f"accelerator devices: {accel}", file=sys.stderr)
-    tpu_rate = run(accel, WARM_EPOCHS + MEASURE_EPOCHS)
-    print(f"accelerator: {tpu_rate:,.0f} samples/sec", file=sys.stderr)
+    print("concurrent MLR+NMF+LDA on accelerator:", file=sys.stderr)
+    tpu_rate = run_concurrent(accel, scale=1.0)
 
     try:
-        cpu = jax.devices("cpu")
-        # Fewer epochs/examples on CPU — it only sets the denominator.
-        cpu_rate = run(cpu[:1], 2, n_examples=N_EXAMPLES // 4, seed=1)
-        print(f"cpu baseline: {cpu_rate:,.0f} samples/sec", file=sys.stderr)
+        cpu = jax.devices("cpu")[:1]
+        print("concurrent MLR+NMF+LDA on cpu (reduced size):", file=sys.stderr)
+        cpu_rate = run_concurrent(cpu, scale=0.125)
     except Exception as e:  # pragma: no cover - cpu backend always present
         print(f"cpu baseline unavailable: {e}", file=sys.stderr)
         cpu_rate = 0.0
 
     vs = tpu_rate / cpu_rate if cpu_rate > 0 else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": "MLR training throughput (single-chip, fused pull/comp/push)",
-                "value": round(tpu_rate, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(vs, 2),
-            }
-        )
-    )
+    print(json.dumps({
+        "metric": "aggregate throughput, concurrent MLR+NMF+LDA (multi-tenant jobserver)",
+        "value": round(tpu_rate, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 2),
+    }))
 
 
 if __name__ == "__main__":

@@ -52,7 +52,7 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         app_params={"vocab_size": 500, "num_topics": 10, "num_docs": 256,
                     "max_doc_len": 64},
         data_fn="harmony_tpu.apps.lda:make_synthetic",
-        data_args={"num_docs": 256, "vocab_size": 500, "max_doc_len": 64,
+        data_args={"num_docs": 256, "vocab_size": 500, "doc_len": 64,
                    "num_topics": 10},
     ),
     "lasso": dict(
@@ -92,6 +92,22 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                     "step_size": 0.2},
         data_fn="harmony_tpu.models.transformer:make_lm_data",
         data_args={"num_seqs": 64, "seq_len": 65, "vocab_size": 128},
+    ),
+    "fm": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.widedeep:FMTrainer",
+        app_params={"vocab_size": 10000, "num_slots": 8, "emb_dim": 8,
+                    "step_size": 0.2},
+        data_fn="harmony_tpu.apps.widedeep:make_synthetic",
+        data_args={"n": 8192, "vocab_size": 10000, "num_slots": 8},
+    ),
+    "widedeep": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.apps.widedeep:WideDeepTrainer",
+        app_params={"vocab_size": 10000, "num_slots": 8, "emb_dim": 8,
+                    "hidden": 64, "step_size": 0.2},
+        data_fn="harmony_tpu.apps.widedeep:make_synthetic",
+        data_args={"n": 8192, "vocab_size": 10000, "num_slots": 8},
     ),
     "pagerank": dict(
         app_type="pregel",
@@ -240,7 +256,7 @@ def main(argv: List[str] | None = None) -> int:
         resp = (sender.send_status_command() if args.cmd == "status"
                 else sender.send_shutdown_command())
         print(json.dumps(resp))
-        return 0
+        return 0 if resp.get("ok") else 1
     if args.cmd == "dashboard":
         from harmony_tpu.dashboard.server import DashboardServer
 
@@ -283,9 +299,9 @@ def _cmd_start_jobserver(args: argparse.Namespace) -> int:
 
 
 def _cmd_run(args: argparse.Namespace) -> int:
+    cfg = build_config(args.app, args)  # validate overrides BEFORE jax spins up
     server = _make_server(args.num_executors)
     try:
-        cfg = build_config(args.app, args)
         fut = server.submit(cfg)
         result = fut.result()
         print(json.dumps({"job_id": cfg.job_id, "result": _jsonable(result)}))

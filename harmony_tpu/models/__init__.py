@@ -7,6 +7,7 @@ harmony_tpu.ops kernels (flash single-chip, ring for sequence parallelism)
 and whose parameters live in the same elastic DenseTable substrate as every
 other app (so checkpointing, migration and multi-tenancy apply unchanged).
 """
+from harmony_tpu.models.moe import MoEConfig, init_moe_params, moe_ffn
 from harmony_tpu.models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -15,8 +16,11 @@ from harmony_tpu.models.transformer import (
 )
 
 __all__ = [
+    "MoEConfig",
     "TransformerConfig",
     "TransformerLM",
     "TransformerTrainer",
+    "init_moe_params",
     "make_lm_data",
+    "moe_ffn",
 ]

@@ -1,0 +1,113 @@
+"""Mixture-of-Experts FFN with expert parallelism (all_to_all routing).
+
+Completes the parallelism set (dp/tp/sp/pp/**ep**). Switch-Transformer
+style: top-1 router with bounded per-expert capacity; dispatch/combine are
+one-hot einsums (MXU-friendly, static shapes); with expert parallelism the
+expert dimension is sharded over a mesh axis and token buckets move to
+their expert's device — and back — via ``lax.all_to_all`` over ICI.
+
+Semantics:
+  * capacity C per (expert, source shard) = ceil(T_local * capacity_factor
+    / num_experts); tokens routed beyond capacity are DROPPED by dispatch
+    (their combine weight is 0) — callers keep a residual connection so a
+    dropped token passes through unchanged (standard Switch behavior).
+  * aux load-balance loss (mean over experts of fraction_dispatched *
+    mean_router_prob * E) encourages uniform routing.
+
+``moe_ffn`` is pure and runs anywhere; pass ``axis_name`` when the expert
+leading dim of the params is sharded over that mesh axis (inside shard_map).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.5
+
+    def capacity(self, num_tokens: int) -> int:
+        return max(1, -(-int(num_tokens * self.capacity_factor) // self.num_experts))
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
+    """Router + per-expert FFN weights (expert-stacked on the leading dim —
+    shard that dim over the EP mesh axis)."""
+    kr, k1, k2 = jax.random.split(rng, 3)
+    E, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": jax.random.normal(kr, (d, E), jnp.float32) * (d ** -0.5),
+        "w1": jax.random.normal(k1, (E, d, f), jnp.float32) * (d ** -0.5),
+        "w2": jax.random.normal(k2, (E, f, d), jnp.float32) * (f ** -0.5),
+    }
+
+
+def _dispatch_combine(x, router, num_experts: int, capacity: int):
+    """Top-1 routing tensors: dispatch [T, E, C] one-hot, combine = dispatch
+    * router prob, plus the Switch aux loss."""
+    T = x.shape[0]
+    logits = x.astype(jnp.float32) @ router          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)              # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [T, E]
+    # Position of each token within its expert's bucket (stable by index).
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot             # [T, E]
+    keep = (pos < capacity) * onehot
+    pos_oh = jax.nn.one_hot(pos.sum(axis=-1), capacity, dtype=jnp.float32)
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]                 # [T,E,C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * Σ_e (fraction of tokens to e) * (mean prob of e)
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,                      # [T, d] local tokens
+    cfg: MoEConfig,
+    axis_name: Optional[str] = None,     # EP axis (params expert-sharded)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out [T, d], aux_loss). Without ``axis_name`` all experts are
+    local; with it, params' leading expert dim holds E/S local experts and
+    token buckets are exchanged with ``all_to_all``."""
+    T, d = x.shape
+    E = cfg.num_experts
+    C = cfg.capacity(T)
+    dispatch, combine, aux = _dispatch_combine(x, params["router"], E, C)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))  # [E,C,d]
+
+    if axis_name is None:
+        w1, w2 = params["w1"], params["w2"]           # [E, d, f], [E, f, d]
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w1))
+        ye = jnp.einsum("ecf,efd->ecd", h, w2)        # [E, C, d]
+    else:
+        S = lax.psum(1, axis_name)
+        E_loc = E // S
+        # [E, C, d] -> exchange: each device keeps its E_loc experts but
+        # receives every shard's buckets for them: [S*E_loc, C, d] ->
+        # all_to_all splits the expert axis and concatenates source shards.
+        xe = xe.reshape(S, E_loc, C, d)
+        xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)              # [S, E_loc, C, d] src-major
+        xe = xe.transpose(1, 0, 2, 3).reshape(E_loc, S * C, d)
+        w1, w2 = params["w1"], params["w2"]           # [E_loc, d, f]
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w1))
+        ye = jnp.einsum("ecf,efd->ecd", h, w2)        # [E_loc, S*C, d]
+        ye = ye.reshape(E_loc, S, C, d).transpose(1, 0, 2, 3)  # [S, E_loc, C, d]
+        ye = lax.all_to_all(ye, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+        ye = ye.reshape(E, C, d)
+
+    out = jnp.einsum("tec,ecd->td", combine, ye).astype(x.dtype)
+    return out, aux

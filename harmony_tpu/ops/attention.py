@@ -86,9 +86,12 @@ def blockwise_attention(
         )
         return (acc_new, m_new, l_new), None
 
-    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
-    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    # Derive the init carry from qf so its varying-axes type matches under
+    # shard_map (plain zeros are "unvarying" and fail the scan's vma check
+    # when attention runs inside a manual-axes region, e.g. a pipeline stage).
+    acc0 = jnp.zeros_like(qf)
+    m0 = jnp.full_like(qf[..., 0], _NEG_INF)
+    l0 = jnp.zeros_like(qf[..., 0])
     starts = jnp.arange(nk) * block_k
     (acc, _, l), _ = jax.lax.scan(step, (acc0, m0, l0), (kb, vb, starts))
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

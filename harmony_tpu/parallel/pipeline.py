@@ -1,0 +1,121 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over a mesh axis.
+
+Completes the framework's parallelism vocabulary (data / model-tensor /
+sequence / **pipeline** / expert). No reference counterpart (the reference
+scales only by table sharding, SURVEY.md §2.10); this is TPU-first design:
+
+  * each device along the ``stage`` axis holds ONE stage's parameters
+    (stage-stacked pytrees sharded on their leading axis),
+  * microbatches stream through the ring: every tick each stage computes on
+    its current microbatch and ``ppermute``s the activation to the next
+    stage — the classic M + S - 1 tick schedule with bubbles masked out,
+  * everything lives in one ``lax.scan`` inside one ``shard_map``, so XLA
+    overlaps the ICI activation transfer of tick t with the compute of
+    tick t+1, and autodiff through the scan gives the pipelined backward
+    (activations rematerialized per-tick via jax.checkpoint).
+
+``pipeline_apply`` is the inside-shard_map primitive; ``make_pipeline_fn``
+wraps it for host-level use over a mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    axis_name: str = STAGE_AXIS,
+) -> jnp.ndarray:
+    """Run ``microbatches [M, ...]`` through S pipelined stages.
+
+    Call INSIDE shard_map: ``stage_params`` is the local stage's params
+    (pytree), ``microbatches`` the full replicated input stream. Stage s
+    applies ``stage_fn(stage_params, x)``; the composition over all stages
+    is the pipelined function. Returns the [M, ...] outputs, replicated.
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    mb_shape = microbatches.shape[1:]
+    # Activation carried between stages + output collection buffer. The
+    # input stream is replicated (unvarying) but the carry becomes stage-
+    # varying inside the scan — mark it so up front (shard_map vma typing).
+    _vary = lambda a: lax.pcast(a, axis_name, to="varying")
+    carry0 = _vary(jnp.zeros_like(microbatches[0]))
+    outbuf0 = _vary(jnp.zeros((M, *mb_shape), microbatches.dtype))
+
+    def tick(state, t):
+        carry_in, outbuf = state
+        # Stage 0 feeds microbatch t from the stream; later stages consume
+        # the activation ppermuted from their predecessor.
+        feed = microbatches[jnp.clip(t, 0, M - 1)]
+        x = jnp.where(idx == 0, feed, carry_in)
+        out = stage_fn(stage_params, x)
+        # Stage idx processes microbatch m = t - idx; valid only in [0, M).
+        m = t - idx
+        active = (m >= 0) & (m < M)
+        # Last stage banks its (active) outputs.
+        mc = jnp.clip(m, 0, M - 1)
+        write = active & (idx == S - 1)
+        outbuf = outbuf.at[mc].set(
+            jnp.where(write, out, outbuf[mc])
+        )
+        # Pass activations forward (the wrap-around S-1 -> 0 edge carries
+        # garbage that stage 0 always overwrites with its feed).
+        carry_out = lax.ppermute(out, axis_name, perm)
+        return (carry_out, outbuf), None
+
+    (_, outbuf), _ = lax.scan(
+        jax.checkpoint(tick), (carry0, outbuf0), jnp.arange(T)
+    )
+    # Broadcast the last stage's collected outputs to every stage.
+    return lax.psum(jnp.where(idx == S - 1, outbuf, jnp.zeros_like(outbuf)),
+                    axis_name)
+
+
+def make_pipeline_fn(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh,
+    axis_name: str = STAGE_AXIS,
+    num_microbatches: Optional[int] = None,
+):
+    """Host-level wrapper: ``fn(stacked_params, x) -> y`` where
+    ``stacked_params`` pytree leaves have leading dim S (stage-stacked,
+    sharded over ``axis_name``) and ``x [B, ...]`` is split into
+    ``num_microbatches`` (default S) equal microbatches."""
+    S = mesh.shape[axis_name]
+
+    def fn(stacked_params, x):
+        M = num_microbatches or S
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible into {M} microbatches")
+        mb = x.reshape(M, B // M, *x.shape[1:])
+
+        def local(params_stacked, mb_local):
+            # shard_map gives each stage a leading dim of 1: unstack.
+            params = jax.tree.map(lambda a: a[0], params_stacked)
+            return pipeline_apply(stage_fn, params, mb_local, axis_name)
+
+        out = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis_name), P()),
+            out_specs=P(),
+        )(stacked_params, mb)
+        return out.reshape(B, *out.shape[2:])
+
+    return fn

@@ -105,3 +105,27 @@ def test_submit_over_tcp(devices):
         assert sender.send_status_command().get("ok")
     finally:
         CommandSender(port).send_shutdown_command()
+
+
+@pytest.mark.parametrize("app", sorted(PRESETS))
+def test_preset_symbols_bind(app):
+    """Every preset's trainer and data/graph builder must resolve AND their
+    preset kwargs must bind against the real signatures — catches key drift
+    (e.g. doc_len vs max_doc_len) without running jax."""
+    import inspect
+
+    from harmony_tpu.config.base import resolve_symbol
+
+    cfg = build_config(app, _Args())
+    trainer_cls = resolve_symbol(cfg.trainer)
+    sig = inspect.signature(trainer_cls.__init__)
+    app_params = dict(cfg.params.app_params)
+    if cfg.app_type == "pregel" and "graph" in sig.parameters:
+        app_params["graph"] = None
+    sig.bind(None, **app_params)  # raises TypeError on drift
+    if cfg.app_type == "pregel":
+        fn = resolve_symbol(cfg.user["graph_fn"])
+        inspect.signature(fn).bind(**cfg.user["graph_args"])
+    else:
+        fn = resolve_symbol(cfg.user["data_fn"])
+        inspect.signature(fn).bind(**cfg.user["data_args"])
