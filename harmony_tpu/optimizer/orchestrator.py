@@ -128,3 +128,40 @@ class OptimizationOrchestrator:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+
+
+class ResourceFluctuator:
+    """Timer-toggled extra capacity — the reference's simulated dynamic
+    cluster (ETOptimizationOrchestrator toggling NumExtraResources on a
+    timer). Use as the orchestrator's ``available_fn``:
+
+        fluct = ResourceFluctuator(base=4, num_extra=2, period_sec=30)
+        OptimizationOrchestrator(..., available_fn=fluct)
+
+    For ``period_sec`` seconds the extra resources are present, then absent,
+    alternating. ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        base: int,
+        num_extra: int,
+        period_sec: float,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        if base < 0 or num_extra < 0 or period_sec <= 0:
+            raise ValueError("base/num_extra >= 0 and period_sec > 0 required")
+        import time as _time
+
+        self.base = base
+        self.num_extra = num_extra
+        self.period_sec = period_sec
+        self._clock = clock or _time.monotonic
+        self._t0 = self._clock()
+
+    def extra_available(self) -> bool:
+        phase = int((self._clock() - self._t0) / self.period_sec)
+        return phase % 2 == 0
+
+    def __call__(self) -> int:
+        return self.base + (self.num_extra if self.extra_available() else 0)

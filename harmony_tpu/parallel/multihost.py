@@ -1,0 +1,113 @@
+"""Multi-host wiring — the DCN side of the communication backend.
+
+SURVEY.md §5.8 prescribes the split this framework implements: the DATA
+plane is XLA collectives over ICI inside jitted steps (no counterpart of
+the reference's per-key Netty RPCs needed), and the reference's
+NameServer-based process bootstrap maps to JAX's distributed runtime:
+``jax.distributed.initialize`` connects every host process to a
+coordinator over DCN, after which ``jax.devices()`` is the GLOBAL device
+list and a mesh built over it spans the pod — the same program text runs
+single-host (this repo's tests, one chip or 8 virtual CPUs) and
+multi-host (a pod slice) unchanged.
+
+Single-host safe: every function degrades to a no-op/local equivalent, so
+the framework never needs an "am I distributed?" fork in app code.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from harmony_tpu.parallel.mesh import build_mesh
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host job (ref analogue: REEF NameServer registration,
+    JobServerClient binding NameServerConfiguration — SURVEY.md §2.10).
+
+    Arguments default from the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID). Returns True if a multi-process
+    runtime was (or already is) initialized, False for the single-process
+    no-op path.
+    """
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        # Already multi-process (initialized here or by an external
+        # launcher/app): honor the documented contract instead of calling
+        # jax.distributed.initialize a second time (which raises).
+        _initialized = True
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 0))
+    if not coordinator_address and num_processes <= 1:
+        return False  # single host: nothing to join
+    if not coordinator_address or num_processes <= 1:
+        # Half-configured launches must fail loudly: proceeding single-host
+        # while peers block in jax.distributed.initialize is a silent hang
+        # plus wrong-topology training.
+        raise ValueError(
+            "incomplete multi-host config: need BOTH a coordinator address "
+            f"and num_processes > 1 (got coordinator={coordinator_address!r}, "
+            f"num_processes={num_processes})"
+        )
+    process_id = (process_id if process_id is not None
+                  else int(os.environ.get("JAX_PROCESS_ID", 0)))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def global_devices() -> List[jax.Device]:
+    """All devices across all hosts (== jax.devices(); addressable subset
+    is jax.local_devices())."""
+    return list(jax.devices())
+
+
+def global_mesh(data=None, model=None, seq=None):
+    """Mesh over the GLOBAL device list. On a pod slice JAX orders devices
+    so that adjacent ids share ICI links; the (data, [seq,] model) reshape
+    keeps each model/seq group intra-host where possible."""
+    return build_mesh(global_devices(), data=data, model=model, seq=seq)
+
+
+def sync_global_devices(tag: str = "barrier") -> None:
+    """Cross-host barrier: a tiny psum over every device; returns when all
+    processes reached it (the analogue of the reference's driver-mediated
+    sync acks). Single-host it is a trivially fast all-device reduction."""
+    from jax.experimental import multihost_utils
+
+    if is_multihost():
+        multihost_utils.sync_global_devices(tag)
+    else:
+        # Single process: dispatch + block on a trivial all-device op so the
+        # call still orders against in-flight work on every local device.
+        x = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            np.ones((len(jax.local_devices()),), np.float32)
+        )
+        jax.block_until_ready(x)

@@ -2,7 +2,7 @@
 module-level symbols, mirroring how users ship trainer classes)."""
 from __future__ import annotations
 
-from harmony_tpu.apps.addvector import AddVectorTrainer
+from harmony_tpu.apps.addvector import AddIntegerTrainer, AddVectorTrainer
 
 
 class CrashOnW0Trainer(AddVectorTrainer):
@@ -22,3 +22,11 @@ def slow_data(n: int = 32):
 
     time.sleep(15)
     return (np.ones(n, np.float32),)
+
+
+class ExplodingTrainer(AddIntegerTrainer):
+    """Dies during global init on EVERY worker — the §5.3 failure-injection
+    stand-in for multi-tenant isolation tests."""
+
+    def init_global_settings(self, ctx) -> None:
+        raise RuntimeError("injected failure")

@@ -276,3 +276,46 @@ class TestTcpControlPlane:
         reply = CommandSender(port)._roundtrip({"command": "NOPE"})
         assert not reply["ok"] and "unknown command" in reply["error"]
         server.shutdown()
+
+
+class TestFailureIsolation:
+    def test_failed_job_does_not_poison_tenants(self, devices):
+        """A job that dies fails ITS future; a concurrent healthy job and a
+        subsequently submitted job both complete, and the server stays
+        open for business (ref stance §5.3: fail fast per job — here
+        per-job, not per-server)."""
+        import pytest as _pytest
+
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+        from harmony_tpu.jobserver.server import JobServer
+
+        def cfg(job_id, trainer, data_fn):
+            return JobConfig(
+                job_id=job_id, app_type="dolphin", trainer=trainer,
+                params=TrainerParams(num_epochs=2, num_mini_batches=2,
+                                     app_params={"num_keys": 4}),
+                num_workers=2,
+                user={"data_fn": data_fn, "data_args": {"n": 64}},
+            )
+
+        server = JobServer(num_executors=4)
+        server.start()
+        try:
+            bad = server.submit(cfg(
+                "boom", "tests.helpers:ExplodingTrainer",
+                "harmony_tpu.apps.addvector:make_marks"))
+            good = server.submit(cfg(
+                "good", "harmony_tpu.apps.addvector:AddIntegerTrainer",
+                "harmony_tpu.apps.addvector:make_marks"))
+            with _pytest.raises(RuntimeError, match="injected failure"):
+                bad.result(timeout=120)
+            result = good.result(timeout=120)
+            assert len(result["workers"]) == 2
+            # the server remains healthy: a post-failure submission succeeds
+            late = server.submit(cfg(
+                "late", "harmony_tpu.apps.addvector:AddIntegerTrainer",
+                "harmony_tpu.apps.addvector:make_marks"))
+            assert late.result(timeout=120)["workers"]
+            assert server.state != "CLOSED"
+        finally:
+            server.shutdown(timeout=60)

@@ -181,3 +181,25 @@ class TestOrchestrator:
         time.sleep(0.3)
         orch.stop()
         assert orch.reconfig_log == []  # empty plans never execute
+
+
+class TestResourceFluctuator:
+    def test_toggles_on_timer(self):
+        from harmony_tpu.optimizer.orchestrator import ResourceFluctuator
+
+        t = [0.0]
+        f = ResourceFluctuator(base=4, num_extra=2, period_sec=10.0,
+                               clock=lambda: t[0])
+        assert f() == 6          # phase 0: extras present
+        t[0] = 10.5
+        assert f() == 4          # phase 1: extras gone
+        t[0] = 20.1
+        assert f() == 6          # phase 2: back
+
+    def test_validation(self):
+        import pytest as _pytest
+
+        from harmony_tpu.optimizer.orchestrator import ResourceFluctuator
+
+        with _pytest.raises(ValueError):
+            ResourceFluctuator(base=1, num_extra=1, period_sec=0)

@@ -72,3 +72,28 @@ class TestStateMachine:
         t = threading.Timer(0.05, lambda: sm.transition("RUN"))
         t.start()
         assert sm.wait_for("RUN", timeout=2.0)
+
+
+class TestMultihost:
+    """Single-host degradation paths of the multi-host wiring (a real
+    multi-process run needs a pod; these pin the no-op semantics)."""
+
+    def test_initialize_noop_single_host(self, monkeypatch):
+        from harmony_tpu.parallel import multihost
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert multihost.initialize_distributed() is False
+        assert multihost.is_multihost() is False
+        assert multihost.process_index() == 0
+        assert multihost.process_count() == 1
+
+    def test_global_mesh_spans_devices(self, devices):
+        from harmony_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh(data=2, model=4)
+        assert mesh.shape == {"data": 2, "model": 4}
+
+    def test_sync_barrier_single_host(self):
+        from harmony_tpu.parallel import multihost
+
+        multihost.sync_global_devices("test")  # must not hang or raise
