@@ -1,0 +1,58 @@
+"""Stateful training optimizers over PS-table storage.
+
+The reference's trainers are plain SGD-family updates applied through the
+table's UpdateFunction (server-side fold). Momentum/Adam need per-parameter
+STATE shared exactly like the parameters — so the state lives in the same
+elastic table, as extra row sections:
+
+    rows = [ params | m (slot 1) | v (slot 2) | counter row ]
+
+Every section reshards, checkpoints and migrates with the table for free.
+The update math is pure (jit-safe) over flat vectors; trainers split their
+pulled rows into sections, call :func:`apply`, and push back per-section
+deltas (additive fold — delta = new - old).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+SLOTS = {"sgd": 0, "momentum": 1, "adam": 2}
+
+
+def num_slots(name: str) -> int:
+    try:
+        return SLOTS[name]
+    except KeyError:
+        raise ValueError(f"unknown optimizer {name!r}; have {sorted(SLOTS)}") from None
+
+
+def apply(
+    name: str,
+    params: jnp.ndarray,       # [n] flat
+    grads: jnp.ndarray,        # [n] flat
+    m: jnp.ndarray,            # [n] slot-1 state (ignored for sgd)
+    v: jnp.ndarray,            # [n] slot-2 state (adam only)
+    t: jnp.ndarray,            # scalar step count AFTER this update (>= 1)
+    hyper: Dict[str, jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (new_params, new_m, new_v). ``hyper``: lr (required),
+    beta1/beta2/eps (adam, defaulted), mu (momentum, defaulted)."""
+    lr = hyper["lr"]
+    if name == "sgd":
+        return params - lr * grads, m, v
+    if name == "momentum":
+        mu = hyper.get("mu", 0.9)
+        new_m = mu * m + grads
+        return params - lr * new_m, new_m, v
+    if name == "adam":
+        b1 = hyper.get("beta1", 0.9)
+        b2 = hyper.get("beta2", 0.999)
+        eps = hyper.get("eps", 1e-8)
+        new_m = b1 * m + (1 - b1) * grads
+        new_v = b2 * v + (1 - b2) * grads * grads
+        mhat = new_m / (1 - b1 ** t)
+        vhat = new_v / (1 - b2 ** t)
+        return params - lr * mhat / (jnp.sqrt(vhat) + eps), new_m, new_v
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -242,8 +242,14 @@ def make_lm_data(
 class TransformerTrainer(Trainer):
     """Train the LM through the framework: the flattened params pytree lives
     in a range-partitioned DenseTable (rows of ``row_width`` f32), pull="all"
-    re-assembles it each batch, and the push folds ``-lr * grad`` through the
-    table's additive update fn. Batch = [B, S] int32 token matrix."""
+    re-assembles it each batch, and the push folds the update through the
+    table's additive fold. Batch = [B, S] int32 token matrix.
+
+    Stateful optimizers (harmony_tpu.dolphin.optim): momentum/Adam state
+    occupies extra row sections of the SAME table —
+    ``[params | m | v | counter row]`` — so optimizer state checkpoints,
+    reshards and migrates with the parameters for free (the reference has no
+    shared-optimizer-state mechanism at all; its trainers are plain SGD)."""
 
     pull_mode = "all"
 
@@ -253,8 +259,11 @@ class TransformerTrainer(Trainer):
         row_width: int = 1024,
         step_size: float = 0.1,
         seed: int = 0,
+        optimizer: str = "sgd",
         **config_kwargs,
     ) -> None:
+        from harmony_tpu.dolphin import optim
+
         if config is None:
             # Flat-kwargs construction: JobConfig.app_params must stay
             # JSON-serializable for the TCP submit path, so the CLI passes
@@ -267,6 +276,8 @@ class TransformerTrainer(Trainer):
         self.row_width = row_width
         self.step_size = step_size
         self.seed = seed
+        self.optimizer = optimizer
+        self.num_state_slots = optim.num_slots(optimizer)  # validates name
         template = jax.eval_shape(
             lambda: self.model.init(jax.random.PRNGKey(0))
         )
@@ -276,14 +287,20 @@ class TransformerTrainer(Trainer):
         self.num_params = flat.shape[0]
         self.num_rows = -(-self.num_params // row_width)
 
+    @property
+    def capacity(self) -> int:
+        # param rows + one section per state slot + the step-counter row
+        extra = 1 if self.num_state_slots else 0
+        return self.num_rows * (1 + self.num_state_slots) + extra
+
     def model_table_config(
         self, table_id: str = "lm-model", num_blocks: int = 0
     ) -> TableConfig:
         return TableConfig(
             table_id=table_id,
-            capacity=self.num_rows,
+            capacity=self.capacity,
             value_shape=(self.row_width,),
-            num_blocks=num_blocks or max(self.num_rows // 8, 1),
+            num_blocks=num_blocks or max(self.capacity // 8, 1),
             is_ordered=True,
             update_fn="add",
         )
@@ -296,6 +313,8 @@ class TransformerTrainer(Trainer):
         ctx.model_table.multi_put(
             list(range(self.num_rows)), np.asarray(self._to_rows(flat))
         )
+        # m/v sections and the counter row start (and stay, until the first
+        # push) at the table's init value 0.
 
     # -- pure parts ------------------------------------------------------
 
@@ -305,18 +324,41 @@ class TransformerTrainer(Trainer):
             [flat, jnp.zeros((pad,), flat.dtype)]
         ).reshape(self.num_rows, self.row_width)
 
+    def _section(self, model: jnp.ndarray, i: int) -> jnp.ndarray:
+        """Flat [num_params] view of row section i (0=params, 1=m, 2=v)."""
+        rows = model[i * self.num_rows:(i + 1) * self.num_rows]
+        return rows.reshape(-1)[: self.num_params]
+
     def hyperparams(self) -> Dict[str, float]:
         return {"lr": self.step_size}
 
     def compute(self, model, batch, hyper):
+        from harmony_tpu.dolphin import optim
+
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        params = self._unravel(model.reshape(-1)[: self.num_params])
+        pflat = self._section(model, 0)
+        params = self._unravel(pflat)
         loss, grads = jax.value_and_grad(self.model.loss)(params, tokens)
         gflat, _ = ravel_pytree(grads)
-        delta = self._to_rows(-hyper["lr"] * gflat)
+        slots = self.num_state_slots
+        m = self._section(model, 1) if slots >= 1 else jnp.zeros_like(pflat)
+        v = self._section(model, 2) if slots >= 2 else jnp.zeros_like(pflat)
+        t = model[-1, 0] + 1.0 if slots else jnp.asarray(1.0)
+        new_p, new_m, new_v = optim.apply(
+            self.optimizer, pflat, gflat, m, v, t, hyper
+        )
+        sections = [self._to_rows(new_p - pflat)]
+        if slots >= 1:
+            sections.append(self._to_rows(new_m - m))
+        if slots >= 2:
+            sections.append(self._to_rows(new_v - v))
+        delta = jnp.concatenate(sections)
+        if slots:
+            counter = jnp.zeros((1, self.row_width), delta.dtype).at[0, 0].set(1.0)
+            delta = jnp.concatenate([delta, counter])
         return delta, {"loss": loss}
 
     def evaluate(self, model, batch) -> Dict[str, jnp.ndarray]:
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        params = self._unravel(model.reshape(-1)[: self.num_params])
+        params = self._unravel(self._section(model, 0))
         return {"loss": self.model.loss(params, tokens)}

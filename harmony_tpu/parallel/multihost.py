@@ -40,33 +40,49 @@ def initialize_distributed(
     no-op path.
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        # Already multi-process (initialized here or by an external
-        # launcher/app): honor the documented contract instead of calling
-        # jax.distributed.initialize a second time (which raises).
-        _initialized = True
+    if _initialized:
         return True
+    # IMPORTANT: decide from config BEFORE touching any jax API that could
+    # initialize the XLA backend (jax.process_count() does) —
+    # jax.distributed.initialize refuses to run after backend init.
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 0))
     if not coordinator_address and num_processes <= 1:
-        return False  # single host: nothing to join
+        # No multi-host config of our own; report whether an external
+        # launcher already initialized a multi-process runtime (safe to
+        # query the backend here — we will not initialize).
+        multi = jax.process_count() > 1
+        _initialized = multi
+        return multi
+    # Half-configured launches must fail loudly: proceeding single-host
+    # while peers block in jax.distributed.initialize is a silent hang plus
+    # wrong-topology training. That includes a missing process id — every
+    # host defaulting to id 0 conflicts at the coordinator.
     if not coordinator_address or num_processes <= 1:
-        # Half-configured launches must fail loudly: proceeding single-host
-        # while peers block in jax.distributed.initialize is a silent hang
-        # plus wrong-topology training.
         raise ValueError(
             "incomplete multi-host config: need BOTH a coordinator address "
             f"and num_processes > 1 (got coordinator={coordinator_address!r}, "
             f"num_processes={num_processes})"
         )
-    process_id = (process_id if process_id is not None
-                  else int(os.environ.get("JAX_PROCESS_ID", 0)))
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    pid_env = os.environ.get("JAX_PROCESS_ID")
+    if process_id is None and pid_env is None:
+        raise ValueError(
+            "incomplete multi-host config: JAX_PROCESS_ID (or process_id=) "
+            "is required when a coordinator is configured"
+        )
+    process_id = process_id if process_id is not None else int(pid_env)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            _initialized = True  # an external launcher beat us to it
+            return True
+        raise
     _initialized = True
     return True
 

@@ -97,3 +97,16 @@ class TestMultihost:
         from harmony_tpu.parallel import multihost
 
         multihost.sync_global_devices("test")  # must not hang or raise
+
+    def test_half_configured_launch_raises(self, monkeypatch):
+        from harmony_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "_initialized", False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+        with pytest.raises(ValueError, match="incomplete multi-host config"):
+            multihost.initialize_distributed()
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+        with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+            multihost.initialize_distributed()
