@@ -34,6 +34,23 @@ def _dot_f32(a, b, trans_b=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
 
 
+def _apply_causal_mask(s, iq, ik, block_q, block_k):
+    """Mask one (q-block, kv-block) score tile. Shared by the forward and
+    both backward kernels — they MUST mask identically or gradients silently
+    diverge from the forward."""
+    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(row >= col, s, _NEG_INF)
+
+
+def _resolve_defaults(q, scale, interpret):
+    """One source of truth for the scale/interpret defaults used by the
+    primal forward, the VJP forward and the VJP backward."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+    return scale, interp
+
+
 # ---------------------------------------------------------------------------
 # Pure-JAX blockwise (differentiable reference path)
 # ---------------------------------------------------------------------------
@@ -101,7 +118,7 @@ def blockwise_attention(
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                scale, causal, block_q, block_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -121,11 +138,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         q = q_ref[0].astype(jnp.float32) * scale     # (bq, d)
         s = _dot_f32(q, k_ref[0].astype(jnp.float32), trans_b=True)  # (bq, bk)
         if causal:
-            row = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            s = _apply_causal_mask(s, iq, ik, block_q, block_k)
         m_prev = m_ref[:, :1]                        # (bq, 1)
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -138,6 +151,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _write():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # log-sum-exp per row, consumed by the fused backward.
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l[:, 0]))
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
@@ -157,7 +172,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
         _fa_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -165,8 +180,14 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+        ],
         scratch_shapes=[
             _vmem((block_q, 128)),   # running row-max m
             _vmem((block_q, 128)),   # running normaliser l
@@ -174,7 +195,139 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Sq, D)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, scale, causal,
+              block_q, block_k):
+    """Shared backward math for one (q-block, kv-block) tile: returns
+    (p [bq,bk], ds [bq,bk]) with p the normalized softmax block."""
+    qf = q.astype(jnp.float32) * scale
+    s = _dot_f32(qf, k.astype(jnp.float32), trans_b=True)     # (bq, bk)
+    if causal:
+        s = _apply_causal_mask(s, iq, ik, block_q, block_k)
+    p = jnp.exp(s - lse[:, None])                             # normalized
+    dp = _dot_f32(do.astype(jnp.float32), v.astype(jnp.float32), trans_b=True)
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       scale, causal, block_q, block_k):
+    ik = pl.program_id(1)   # kv block (this output tile)
+    iq = pl.program_id(2)   # q blocks stream by
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = True if not causal else (iq * block_q + block_q - 1 >= ik * block_k)
+
+    @pl.when(needed)
+    def _compute():
+        p, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            iq, ik, scale, causal, block_q, block_k,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+
+    @pl.when(iq == nq - 1)
+    def _write():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    iq = pl.program_id(1)   # q block (this output tile)
+    ik = pl.program_id(2)   # kv blocks stream by
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = True if not causal else (ik * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        _, ds = _bwd_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            iq, ik, scale, causal, block_q, block_k,
+        )
+        dq_acc[:] += scale * _dot_f32(ds, k_ref[0].astype(jnp.float32))
+
+    @pl.when(ik == nk - 1)
+    def _write():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
+                    interpret):
+    """Fused flash backward: dK/dV kernel (grid over kv tiles) + dQ kernel
+    (grid over q tiles); softmax recomputed per tile from the saved LSE —
+    the O(S) memory trade the forward made, carried into the backward."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    dof = do.reshape(B * H, Sq, D)
+    lsef = lse.reshape(B * H, Sq)
+    # delta_i = dO_i . O_i (rowwise), cheap enough to leave to XLA.
+    delta = jnp.einsum("bsd,bsd->bs", dof.astype(jnp.float32),
+                       out.reshape(B * H, Sq, D).astype(jnp.float32))
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    dkv = functools.partial(
+        _fa_bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv,
+        grid=(B * H, Sk // block_k, Sq // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[_vmem((block_k, D)), _vmem((block_k, D))],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    q_spec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    dqk = functools.partial(
+        _fa_bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(B * H, Sq // block_q, Sk // block_k),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[_vmem((block_q, D))],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
 
 
 def _vmem(shape):
@@ -194,31 +347,26 @@ def flash_attention(
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Fused attention. Forward = Pallas kernel (TPU) / interpreter (tests);
-    backward = VJP of the blockwise implementation (recompute, O(S) memory)."""
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    interp = _default_interpret() if interpret is None else interpret
-    return _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
-
-
-def _default_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Fused attention. Forward AND backward are Pallas kernels (interpreter
+    off-TPU/tests): the forward saves only O(S) softmax statistics (LSE) and
+    the backward recomputes each softmax tile from them — flash attention's
+    memory/FLOPs trade in both directions."""
+    scale, interp = _resolve_defaults(q, scale, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, scale, interpret)
-    return out, (q, k, v)
+    scale, interp = _resolve_defaults(q, scale, interpret)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, block_k=block_k, scale=scale
-        ),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    scale, interp = _resolve_defaults(q, scale, interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           scale, interp)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
