@@ -180,14 +180,12 @@ def make_synthetic(
     rng = np.random.default_rng(seed)
     words_per_topic = vocab_size // num_topics
     doc_idx = np.arange(num_docs, dtype=np.int32)
-    tokens = np.full((num_docs, doc_len), -1, np.int32)
-    for d in range(num_docs):
-        t = d % num_topics
-        lo = t * words_per_topic
-        # 90% from own topic's slice, 10% uniform noise
-        own = rng.integers(lo, lo + words_per_topic, doc_len)
-        noise = rng.integers(0, vocab_size, doc_len)
-        pick = rng.uniform(size=doc_len) < 0.9
-        tokens[d] = np.where(pick, own, noise)
+    # 90% from the doc's own topic slice, 10% uniform noise — vectorized
+    # over docs (broadcast low/high bounds per row).
+    lo = ((doc_idx % num_topics) * words_per_topic).astype(np.int64)[:, None]
+    own = rng.integers(lo, lo + words_per_topic, (num_docs, doc_len))
+    noise = rng.integers(0, vocab_size, (num_docs, doc_len))
+    pick = rng.random((num_docs, doc_len)) < 0.9
+    tokens = np.where(pick, own, noise).astype(np.int32)
     seeds = rng.integers(0, 2**31 - 1, num_docs).astype(np.int32)
     return doc_idx, tokens, seeds

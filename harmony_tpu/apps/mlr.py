@@ -117,8 +117,11 @@ def make_synthetic(
     """Linearly-separable-ish synthetic set (the reference ships sample_mlr
     data files; we generate at the same shapes)."""
     rng = np.random.default_rng(seed)
-    true_w = rng.normal(size=(num_classes, num_features)).astype(np.float32)
-    x = rng.normal(size=(n, num_features)).astype(np.float32)
-    logits = x @ true_w.T + 0.1 * rng.normal(size=(n, num_classes))
+    # float32 end-to-end: generating doubles and downcasting doubled the
+    # wall time of large benchmark datasets.
+    true_w = rng.standard_normal((num_classes, num_features), dtype=np.float32)
+    x = rng.standard_normal((n, num_features), dtype=np.float32)
+    logits = x @ true_w.T
+    logits += 0.1 * rng.standard_normal((n, num_classes), dtype=np.float32)
     y = np.argmax(logits, axis=1).astype(np.int32)
     return x, y

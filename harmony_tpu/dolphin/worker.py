@@ -94,12 +94,24 @@ class WorkerTasklet:
 
     # -- step construction ----------------------------------------------
 
+    @staticmethod
+    def _with_sync(metrics, arr):
+        """Guarantee at least one step-output-dependent metric: the async
+        loop's in-flight throttle blocks on metrics, so an empty dict would
+        make the bound a no-op. ``_sync`` is one element of the pushed
+        array (data-dependent, so XLA cannot fold it away); host-side
+        consumers strip underscore-keys."""
+        if metrics:
+            return metrics
+        return {"_sync": jnp.ravel(arr)[0]}
+
     def _step_core(self):
         """The fused PULL/COMP/PUSH body shared by per-batch and per-epoch
         compilation. ``hyper`` is a dict of scalars (lr etc.) passed fresh
         each dispatch so host-side decay is honored."""
         spec = self.ctx.model_table.spec
         trainer = self.trainer
+        sync = self._with_sync
         if trainer.uses_local_table:
             local_spec = self.ctx.local_table.spec
 
@@ -109,10 +121,11 @@ class WorkerTasklet:
                 delta, new_l, metrics = trainer.compute_with_local(
                     model, lmodel, batch, hyper
                 )                                                  # COMP
+                new_arr = spec.push_all(arr, delta)                # PUSH
                 return (
-                    spec.push_all(arr, delta),                     # PUSH
+                    new_arr,
                     local_spec.write_all(local, new_l),
-                ), metrics
+                ), sync(metrics, new_arr)
 
             return _step
         if trainer.pull_mode == "all":
@@ -120,7 +133,8 @@ class WorkerTasklet:
             def _step(arr, batch, hyper):
                 model = spec.pull_all(arr)                         # PULL
                 delta, metrics = trainer.compute(model, batch, hyper)  # COMP
-                return spec.push_all(arr, delta), metrics          # PUSH
+                new_arr = spec.push_all(arr, delta)                # PUSH
+                return new_arr, sync(metrics, new_arr)
 
         else:
 
@@ -128,7 +142,8 @@ class WorkerTasklet:
                 keys = trainer.pull_keys(batch)
                 model = spec.pull(arr, keys)                       # PULL
                 delta, metrics = trainer.compute(model, batch, hyper)  # COMP
-                return spec.push(arr, keys, delta), metrics        # PUSH
+                new_arr = spec.push(arr, keys, delta)              # PUSH
+                return new_arr, sync(metrics, new_arr)
 
         return _step
 
@@ -182,7 +197,10 @@ class WorkerTasklet:
 
     def _use_fused_epoch(self) -> bool:
         """Whole-epoch compilation is only correct with no between-batch host
-        decisions: no SSP gate, no TaskUnit interleaving, stable batches."""
+        decisions: no SSP gate, no TaskUnit scheduling, stable batches.
+        Under a TaskUnit scheduler the per-batch path is kept so concurrent
+        tenants interleave at BATCH granularity (one fused epoch would hand
+        one tenant the device for a whole epoch per grant)."""
         return (
             self.batch_barrier is None
             and self.taskunit is None
@@ -207,18 +225,20 @@ class WorkerTasklet:
     def _hyper(self) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v) for k, v in self.trainer.hyperparams().items()}
 
-    def _dispatch_step(self, fn, batch_like):
+    def _dispatch_step(self, fn, batch_like, hyper=None):
         """Route the dispatch through the owning table lock(s)."""
         from harmony_tpu.table.table import DenseTable
 
+        if hyper is None:
+            hyper = self._hyper()
         if self.trainer.uses_local_table:
             return DenseTable.apply_step_multi(
                 [self.ctx.model_table, self.ctx.local_table],
                 fn,
                 batch_like,
-                self._hyper(),
+                hyper,
             )
-        return self.ctx.model_table.apply_step(fn, batch_like, self._hyper())
+        return self.ctx.model_table.apply_step(fn, batch_like, hyper)
 
     # -- the loop --------------------------------------------------------
 
@@ -255,14 +275,40 @@ class WorkerTasklet:
             "stopped_early": stop,
         }
 
+    # Bound on steps enqueued without a device sync (keeps the dispatch
+    # queue and donated-buffer chain short on long epochs).
+    MAX_INFLIGHT = 32
+
     def _run_batched_epoch(
         self, epoch: int, global_batch_idx: int
     ) -> Tuple[int, Dict[str, float], int, bool]:
-        """Per-batch dispatch with SYNC gate + TaskUnit announcement."""
-        table = self.ctx.model_table
+        """Per-batch dispatch with SYNC gate + TaskUnit announcement.
+
+        Dispatch is ASYNC: steps enqueue without blocking, metrics stay on
+        device, and ONE stacked transfer per metric key at epoch end fetches
+        them all — on a remote-attached chip every per-batch scalar read
+        costs a full network round-trip (~100ms measured), so per-step
+        blocking dominated wall time. Blocking on the step's own outputs
+        (never a table snapshot a donating step could invalidate) is
+        preserved; it just happens once per epoch / in-flight window.
+
+        TaskUnit semantics under async dispatch: the COMP scope gates
+        ADMISSION, not occupancy. The device executes one XLA program at a
+        time, so the globally-coordinated grant order becomes the device
+        queue order — which is the interleaving the reference's occupancy
+        slots produced on CPU executors (and, multi-host, identical
+        enqueue order across hosts is what keeps collectives
+        deadlock-free). Holding the slot through device execution would
+        add a full tunnel round-trip per batch without changing the
+        device-side serialization.
+        """
         epoch_examples = 0
         last_metrics: Dict[str, float] = {}
         stop = False
+        pending: List[Dict[str, jnp.ndarray]] = []
+        batch_sizes: List[int] = []
+        hyper = self._hyper()
+        work_t = 0.0  # dispatch+drain time, EXCLUDING SSP barrier waits
         for batch_idx, batch in enumerate(self.data.epoch_batches()):
             if self.batch_barrier is not None:  # SYNC TaskUnit
                 stop = self.batch_barrier(global_batch_idx)
@@ -278,28 +324,73 @@ class WorkerTasklet:
                         self._batch_cache[batch_idx] = batch_dev
                 else:
                     batch_dev = self._shard_batch(batch)
-                metrics = self._dispatch_step(self._step, batch_dev)
-                # Block on the step's own outputs (metrics), never on a table
-                # snapshot another worker's donating step could invalidate.
-                jax.block_until_ready(metrics)
-            dt = time.perf_counter() - t0
-            n = batch[0].shape[0]
-            epoch_examples += n
+                metrics = self._dispatch_step(self._step, batch_dev, hyper)
+            pending.append(metrics)
+            if len(pending) >= self.MAX_INFLIGHT:
+                # Sliding window: block on the OLDEST outstanding step so the
+                # device queue stays full (blocking on the newest would drain
+                # it and idle the chip for a host round-trip).
+                jax.block_until_ready(pending[len(pending) - self.MAX_INFLIGHT])
+            work_t += time.perf_counter() - t0
+            batch_sizes.append(batch[0].shape[0])
+            epoch_examples += batch[0].shape[0]
             global_batch_idx += 1
-            last_metrics = {k: float(v) for k, v in metrics.items()}
+        if pending:
+            # One stack-op + one transfer per metric key for the whole epoch.
+            # A mid-epoch reshard leaves metrics on different device sets, so
+            # stack per run of same-sharded values (still O(reshards) ops,
+            # not O(batches)).
+            t0 = time.perf_counter()
+            runs: List[List[Dict[str, jnp.ndarray]]] = [[pending[0]]]
+            probe = next(iter(pending[0]))
+            for m in pending[1:]:
+                if m[probe].sharding == runs[-1][-1][probe].sharding:
+                    runs[-1].append(m)
+                else:
+                    runs.append([m])
+            host = {
+                k: np.concatenate(
+                    [np.atleast_1d(np.asarray(jnp.stack([m[k] for m in r])))
+                     for r in runs]
+                )
+                for k in pending[0]
+            }
+            work_t += time.perf_counter() - t0
+            # Async dispatch makes true per-batch device time unobservable
+            # without per-step syncs; smear the epoch's work time (barrier
+            # waits excluded) evenly — averages feeding the optimizer stay
+            # right, per-batch variance is deliberately given up.
+            last_metrics = self._emit_batch_metrics(
+                epoch, host, batch_sizes, work_t / len(pending)
+            )
+        return epoch_examples, last_metrics, global_batch_idx, stop
+
+    def _emit_batch_metrics(
+        self,
+        epoch: int,
+        host: Dict[str, np.ndarray],
+        batch_sizes: List[int],
+        per_batch_time: float,
+    ) -> Dict[str, float]:
+        """Shared epoch-end drain: strip internal underscore-keys (_sync),
+        emit one BatchMetrics per batch with the smeared time, and return
+        the final batch's metrics as floats."""
+        host = {k: v for k, v in host.items() if not k.startswith("_")}
+        losses = host.get("loss", np.zeros(len(batch_sizes)))
+        for b, n in enumerate(batch_sizes):
             self.collector.add(
                 BatchMetrics(
                     job_id=self.job_id,
                     worker_id=self.ctx.worker_id,
                     epoch_idx=epoch,
-                    batch_idx=batch_idx,
+                    batch_idx=b,
                     num_examples=n,
-                    batch_time_sec=dt,
-                    comp_time_sec=dt,
-                    loss=last_metrics.get("loss", 0.0),
+                    batch_time_sec=per_batch_time,
+                    comp_time_sec=per_batch_time,
+                    loss=float(losses[b]),
                 )
             )
-        return epoch_examples, last_metrics, global_batch_idx, stop
+        return {k: float(v[-1]) for k, v in host.items()}
 
     def _run_fused_epoch(self, epoch: int) -> Tuple[int, Dict[str, float]]:
         """One dispatch for the whole epoch (see _build_step)."""
@@ -317,21 +408,12 @@ class WorkerTasklet:
         jax.block_until_ready(stacked_metrics)
         dt = time.perf_counter() - t0
         nb = self.data.num_mini_batches
-        host_metrics = {k: np.asarray(v) for k, v in stacked_metrics.items()}
-        for b in range(nb):
-            self.collector.add(
-                BatchMetrics(
-                    job_id=self.job_id,
-                    worker_id=self.ctx.worker_id,
-                    epoch_idx=epoch,
-                    batch_idx=b,
-                    num_examples=self.data.batch_size,
-                    batch_time_sec=dt / nb,
-                    comp_time_sec=dt / nb,
-                    loss=float(host_metrics.get("loss", np.zeros(nb))[b]),
-                )
-            )
-        last = {k: float(v[-1]) for k, v in host_metrics.items()}
+        host_metrics = {
+            k: np.atleast_1d(np.asarray(v)) for k, v in stacked_metrics.items()
+        }
+        last = self._emit_batch_metrics(
+            epoch, host_metrics, [self.data.batch_size] * nb, dt / nb
+        )
         return self.data.num_examples, last
 
     def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses):

@@ -93,3 +93,32 @@ class TestMetrics:
         assert len(batches) == 8  # 2 epochs x 4 batches
         assert all(b.num_examples == 32 for b in batches)
         assert manager.aggregate_throughput() > 0
+
+
+class TestAsyncBatchedDispatch:
+    def test_empty_metrics_trainer(self, mesh8):
+        """A trainer whose compute returns no metrics must not crash the
+        async per-batch drain (regression: StopIteration on empty dict)."""
+
+        class SilentTrainer(AddVectorTrainer):
+            def compute(self, model, batch, hyper):
+                delta, _ = super().compute(model, batch, hyper)
+                return delta, {}
+
+        n, keys, dim = 64, 8, 4
+        trainer = SilentTrainer(num_keys=keys, vector_dim=dim, delta=1.0)
+        params = TrainerParams(num_epochs=2, num_mini_batches=2)
+        spec = TableSpec(trainer.model_table_config())
+        table = DenseTable(spec, mesh8)
+        ctx = TrainerContext(params=params, model_table=table)
+        data = TrainingDataProvider(list(make_marks(n)), 2)
+        # a barrier that never stops forces the per-batch async path
+        w = WorkerTasklet(
+            "j", ctx, trainer, data, mesh8, batch_barrier=lambda i: False
+        )
+        result = w.run()
+        assert result["epochs_run"] == 2
+        vals = np.asarray(table.pull_array())
+        np.testing.assert_allclose(
+            vals, np.full((keys, dim), trainer.expected_value(n * 2))
+        )
