@@ -32,15 +32,16 @@ from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
 from harmony_tpu.jobserver.server import JobServer  # noqa: E402
 from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
 
-EPOCHS = 4
+EPOCHS = 6
 BATCHES = 8
 
 
 def job_configs(scale: float):
-    """The three BASELINE jobs, sized to exercise the MXU; ``scale`` shrinks
-    the CPU baseline run (it only sets the denominator — rates, not totals,
-    are compared)."""
-    mlr_n = max(int(32768 * scale), BATCHES * 64)
+    """The three BASELINE jobs, sized so per-sample compute lands on the
+    MXU (large matmuls — MLR 8192x256, NMF rank-256); ``scale`` shrinks
+    the CPU baseline run's DATASET only (per-sample compute is identical —
+    rates, not totals, are compared)."""
+    mlr_n = max(int(16384 * scale), BATCHES * 64)
     nmf_rows = max(int(4096 * scale), BATCHES * 8)
     lda_docs = max(int(2048 * scale), BATCHES * 8)
     mlr = JobConfig(
@@ -48,39 +49,39 @@ def job_configs(scale: float):
         trainer="harmony_tpu.apps.mlr:MLRTrainer",
         params=TrainerParams(
             num_epochs=EPOCHS, num_mini_batches=BATCHES,
-            app_params={"num_classes": 64, "num_features": 2048,
-                        "features_per_partition": 256, "step_size": 0.05},
+            app_params={"num_classes": 256, "num_features": 8192,
+                        "features_per_partition": 512, "step_size": 0.05},
         ),
         num_workers=1,
         user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
-              "data_args": {"n": mlr_n, "num_features": 2048,
-                            "num_classes": 64}},
+              "data_args": {"n": mlr_n, "num_features": 8192,
+                            "num_classes": 256}},
     )
     nmf = JobConfig(
         job_id="bench-nmf", app_type="dolphin",
         trainer="harmony_tpu.apps.nmf:NMFTrainer",
         params=TrainerParams(
             num_epochs=EPOCHS, num_mini_batches=BATCHES,
-            app_params={"num_rows": nmf_rows, "num_cols": 1024, "rank": 64,
+            app_params={"num_rows": nmf_rows, "num_cols": 4096, "rank": 256,
                         "step_size": 0.01},
         ),
         num_workers=1,
         user={"data_fn": "harmony_tpu.apps.nmf:make_synthetic",
-              "data_args": {"num_rows": nmf_rows, "num_cols": 1024,
-                            "rank": 64}},
+              "data_args": {"num_rows": nmf_rows, "num_cols": 4096,
+                            "rank": 256}},
     )
     lda = JobConfig(
         job_id="bench-lda", app_type="dolphin",
         trainer="harmony_tpu.apps.lda:LDATrainer",
         params=TrainerParams(
             num_epochs=EPOCHS, num_mini_batches=BATCHES,
-            app_params={"vocab_size": 4096, "num_topics": 32,
+            app_params={"vocab_size": 8192, "num_topics": 64,
                         "num_docs": lda_docs, "max_doc_len": 128},
         ),
         num_workers=1,
         user={"data_fn": "harmony_tpu.apps.lda:make_synthetic",
-              "data_args": {"num_docs": lda_docs, "vocab_size": 4096,
-                            "num_topics": 32, "doc_len": 128}},
+              "data_args": {"num_docs": lda_docs, "vocab_size": 8192,
+                            "num_topics": 64, "doc_len": 128}},
     )
     # examples processed per job = epochs * dataset size
     totals = {"bench-mlr": EPOCHS * mlr_n, "bench-nmf": EPOCHS * nmf_rows,

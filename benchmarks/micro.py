@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Microbenchmarks for the framework's data-plane primitives.
+
+BASELINE.md's north-star metrics are (a) aggregate multi-tenant throughput
+(bench.py at the repo root) and (b) ET push/pull bandwidth — this file
+measures (b) plus the other primitives a capacity-planning reader needs:
+
+  table      pull (all-gather of the sharded model) and push (delta fold)
+             bandwidth through DenseTable.apply_step — the analogue of the
+             reference's per-batch multiGetOrInit/multiUpdate path
+             (SURVEY.md §3.2 PULL/PUSH TaskUnits).
+  reshard    live migration cost: DenseTable.reshard between two mesh
+             layouts, reported as bytes moved per second (the reference's
+             MoveInitMsg/DataMsg block transfer, SURVEY.md §3.4).
+  attention  flash vs naive attention wall time (the framework's Pallas
+             kernel path where supported, jittable fallback elsewhere).
+  multiget   host-path random-key multi_get/multi_update ops/sec (the
+             sparse/irregular access path, e.g. embedding lookups).
+
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|all]
+
+Each section prints one JSON line so results diff cleanly across rounds.
+Uses whatever backend JAX is pointed at (real chip under axon; set
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 for
+the virtual multi-device mesh).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.config import TableConfig
+from harmony_tpu.parallel import build_mesh
+from harmony_tpu.table import DenseTable, TableSpec
+
+REPEATS = 10
+
+
+def _mesh():
+    devs = jax.devices()
+    data = 2 if len(devs) % 2 == 0 and len(devs) > 1 else 1
+    return build_mesh(devs, data=data)
+
+
+def _time(fn, *args):
+    jax.block_until_ready(fn(*args))  # warm (compile) and drain the queue
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / REPEATS
+
+
+def bench_table() -> dict:
+    """Pull+push bandwidth through one fused step over the job mesh."""
+    mesh = _mesh()
+    capacity, width = 16384, 256          # 16 MB model
+    spec = TableSpec(TableConfig(
+        table_id="bench", capacity=capacity, value_shape=(width,),
+        num_blocks=64, update_fn="add",
+    ))
+    table = DenseTable(spec, mesh)
+    model_bytes = capacity * width * 4
+
+    def step(arr):
+        model = spec.pull_all(arr)                 # PULL (all-gather)
+        delta = model * 1e-6                       # touch every element
+        return spec.push_all(arr, delta)           # PUSH (fold)
+
+    jstep = jax.jit(step)
+    dt = _time(jstep, table.array)
+    gbps = 2 * model_bytes / dt / 1e9              # pulled + pushed
+    return {"metric": "table pull+push bandwidth", "value": round(gbps, 2),
+            "unit": "GB/s", "model_mb": model_bytes // 2**20,
+            "devices": len(mesh.devices.flat)}
+
+
+def bench_reshard() -> dict:
+    """Live re-sharding cost between two mesh layouts."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"metric": "reshard bandwidth", "value": None,
+                "unit": "GB/s", "note": "needs >=2 devices"}
+    capacity, width = 16384, 256
+    spec = TableSpec(TableConfig(
+        table_id="bench-rs", capacity=capacity, value_shape=(width,),
+        num_blocks=64, update_fn="add",
+    ))
+    m1 = build_mesh(devs, data=1)
+    m2 = build_mesh(devs, data=len(devs))
+    table = DenseTable(spec, m1)
+    model_bytes = capacity * width * 4
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(REPEATS // 2):
+        table.reshard(m2)
+        table.reshard(m1)
+        n += 2
+    jax.block_until_ready(table.array)
+    dt = (time.perf_counter() - t0) / n
+    return {"metric": "reshard bandwidth", "value": round(model_bytes / dt / 1e9, 2),
+            "unit": "GB/s", "model_mb": model_bytes // 2**20,
+            "devices": len(devs)}
+
+
+def bench_attention() -> dict:
+    """Framework attention kernel vs the naive O(S^2)-memory reference."""
+    from harmony_tpu.ops import flash_attention
+
+    b, h, s, d = 4, 8, 2048, 64
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(k2, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(k3, (b, h, s, d), jnp.float32)
+
+    def naive(q, k, v):
+        a = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        a = jnp.where(mask, a, -jnp.inf)
+        return jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(a, -1), v)
+
+    t_naive = _time(jax.jit(naive), q, k, v)
+    t_flash = _time(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)), q, k, v)
+    return {"metric": "flash attention speedup vs naive", "seq": s,
+            "value": round(t_naive / t_flash, 2), "unit": "x",
+            "naive_ms": round(t_naive * 1e3, 1),
+            "flash_ms": round(t_flash * 1e3, 1)}
+
+
+def bench_multiget() -> dict:
+    """Host-path random-key access (sparse/irregular pulls)."""
+    mesh = _mesh()
+    capacity, width, nkeys = 65536, 64, 4096
+    spec = TableSpec(TableConfig(
+        table_id="bench-mg", capacity=capacity, value_shape=(width,),
+        num_blocks=64, update_fn="add",
+    ))
+    table = DenseTable(spec, mesh)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, capacity, nkeys)
+    deltas = rng.standard_normal((nkeys, width), dtype=np.float32)
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        table.multi_get(keys)
+        table.multi_update(keys, deltas)
+    dt = (time.perf_counter() - t0) / REPEATS
+    return {"metric": "host multi_get+multi_update", "value": round(2 * nkeys / dt),
+            "unit": "keys/sec", "keys_per_call": nkeys}
+
+
+SECTIONS = {
+    "table": bench_table,
+    "reshard": bench_reshard,
+    "attention": bench_attention,
+    "multiget": bench_multiget,
+}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    names = list(SECTIONS) if which == "all" else [which]
+    for name in names:
+        print(json.dumps(SECTIONS[name]()))
+
+
+if __name__ == "__main__":
+    main()

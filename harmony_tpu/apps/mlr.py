@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harmony_tpu.config.params import TableConfig, TrainerParams
+from harmony_tpu.ops.mxu import mxu_dot
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 
 
@@ -85,7 +86,8 @@ class MLRTrainer(Trainer):
         x, y = batch  # x: [B, D] float, y: [B] int
         w = self._weights(model)
         x = x.astype(jnp.float32)
-        logits = x @ w.T                                   # [B, C] (MXU)
+        # bf16 operands / f32 accumulation: MXU-native full rate
+        logits = mxu_dot(x, w.T)                           # [B, C] (MXU)
         logp = jax.nn.log_softmax(logits, axis=-1)
         onehot = jax.nn.one_hot(y, self.num_classes, dtype=logits.dtype)
         loss = -jnp.mean(jnp.sum(onehot * logp, axis=-1))
@@ -93,7 +95,7 @@ class MLRTrainer(Trainer):
         # grad wrt w: contraction over the (data-sharded) batch axis — XLA
         # inserts the cross-chip reduction here (the "push aggregation").
         probs = jnp.exp(logp)
-        grad_w = (probs - onehot).T @ x / x.shape[0]       # [C, D]
+        grad_w = mxu_dot((probs - onehot).T, x) / x.shape[0]  # [C, D]
         delta = (-hyper["lr"] * grad_w).reshape(model.shape)
         return delta, {"loss": loss, "accuracy": acc}
 
@@ -102,7 +104,7 @@ class MLRTrainer(Trainer):
     ) -> Dict[str, jnp.ndarray]:
         x, y = batch
         w = self._weights(model)
-        logits = x.astype(jnp.float32) @ w.T
+        logits = mxu_dot(x.astype(jnp.float32), w.T)
         logp = jax.nn.log_softmax(logits, axis=-1)
         onehot = jax.nn.one_hot(y, self.num_classes, dtype=logits.dtype)
         return {

@@ -25,6 +25,7 @@ import numpy as np
 
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+from harmony_tpu.ops.mxu import mxu_dot
 
 # Non-negativity (the reference clamps in NMFETModelUpdateFunction at the
 # server) is enforced twice: the in-trainer projection keeps each worker's
@@ -106,12 +107,13 @@ class NMFTrainer(Trainer):
         row_idx, x = batch                      # [B], [B, num_cols]
         lr = hyper["lr"]
         l_rows = local[row_idx]                 # [B, rank]
-        pred = l_rows @ model.T                 # [B, num_cols] (MXU)
+        # bf16 operands / f32 accumulation: MXU-native full rate
+        pred = mxu_dot(l_rows, model.T)         # [B, num_cols] (MXU)
         err = pred - x.astype(pred.dtype)
         loss = jnp.mean(jnp.sum(err * err, axis=-1))
         b = x.shape[0]
-        grad_l = 2.0 * (err @ model)            # [B, rank] (per-row exact)
-        grad_r = 2.0 * (err.T @ l_rows) / b     # [num_cols, rank] batch-avg
+        grad_l = 2.0 * mxu_dot(err, model)      # [B, rank]
+        grad_r = 2.0 * mxu_dot(err.T, l_rows) / b  # [num_cols, rank] batch-avg
         new_l_rows = jnp.maximum(l_rows - lr * grad_l, 0.0)
         new_local = local.at[row_idx].set(new_l_rows)
         # Project the pushed delta so R stays >= 0 after the fold.

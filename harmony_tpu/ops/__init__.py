@@ -8,11 +8,13 @@ compiler: streaming-softmax attention (flash), MXU one-hot histograms
 """
 from harmony_tpu.ops.attention import blockwise_attention, flash_attention
 from harmony_tpu.ops.histogram import segment_sum, weighted_histogram
+from harmony_tpu.ops.mxu import mxu_dot
 from harmony_tpu.ops.ring import ring_attention
 
 __all__ = [
     "blockwise_attention",
     "flash_attention",
+    "mxu_dot",
     "ring_attention",
     "segment_sum",
     "weighted_histogram",

@@ -1,0 +1,34 @@
+"""MXU-native matmul helper: bfloat16 inputs, float32 accumulation.
+
+The TPU MXU multiplies bf16 operand tiles at full rate and accumulates in
+f32; feeding it f32 operands costs multiple passes. The reference's dense
+kernels ran f32 through Breeze→BLAS (SURVEY.md §2.9 X1 — no precision
+knob), so bf16-in/f32-out here is a strict TPU-side win with the same
+accumulate precision.
+
+``precision="f32"`` keeps full-precision operands for exactness-sensitive
+callers. Rule of thumb: bf16 operands represent integers exactly only up
+to 256, so any matmul whose operands carry exact counts (e.g. GBT's
+one-hot histogram build) must pass ``precision="f32"``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mxu_dot(a: jnp.ndarray, b: jnp.ndarray, *, precision: str = "bf16") -> jnp.ndarray:
+    """``a @ b`` with MXU-native operand precision and f32 accumulation.
+
+    precision:
+      * "bf16" (default) — cast operands to bfloat16, accumulate f32.
+      * "f32" — full-precision operands (still forces f32 accumulation).
+    """
+    if precision == "f32":
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+    if precision != "bf16":
+        raise ValueError(f"unknown precision {precision!r}")
+    return jnp.matmul(
+        a.astype(jnp.bfloat16),
+        b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )

@@ -165,3 +165,33 @@ def test_flash_fused_backward_matches_naive(causal):
     g2 = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+class TestMxuDot:
+    def test_bf16_accumulates_f32(self):
+        from harmony_tpu.ops import mxu_dot
+
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((64, 256), dtype=np.float32)
+        b = rng.standard_normal((256, 32), dtype=np.float32)
+        out = np.asarray(mxu_dot(jnp.asarray(a), jnp.asarray(b)))
+        assert out.dtype == np.float32
+        exact = a @ b
+        # bf16 operands: ~2-3 decimal digits; accumulation stays f32 so the
+        # error scales with operand rounding, not with the contraction depth.
+        np.testing.assert_allclose(out, exact, rtol=3e-2, atol=3e-2 * np.abs(exact).max())
+
+    def test_f32_precision_mode(self):
+        from harmony_tpu.ops import mxu_dot
+
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((16, 64), dtype=np.float32)
+        b = rng.standard_normal((64, 8), dtype=np.float32)
+        out = np.asarray(mxu_dot(jnp.asarray(a), jnp.asarray(b), precision="f32"))
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+    def test_rejects_unknown_precision(self):
+        from harmony_tpu.ops import mxu_dot
+
+        with pytest.raises(ValueError):
+            mxu_dot(jnp.ones((2, 2)), jnp.ones((2, 2)), precision="fp8")
