@@ -137,12 +137,16 @@ class WorkerTasklet:
                 return new_arr, sync(metrics, new_arr)
 
         else:
+            # Resolve the push lowering from the table's ACTUAL devices at
+            # build time (rebuilt on reshard): MXU duplicate-fold on TPU,
+            # XLA scatter elsewhere.
+            push_via = self.ctx.model_table.push_via
 
             def _step(arr, batch, hyper):
                 keys = trainer.pull_keys(batch)
                 model = spec.pull(arr, keys)                       # PULL
                 delta, metrics = trainer.compute(model, batch, hyper)  # COMP
-                new_arr = spec.push(arr, keys, delta)              # PUSH
+                new_arr = spec.push(arr, keys, delta, via=push_via)  # PUSH
                 return new_arr, sync(metrics, new_arr)
 
         return _step

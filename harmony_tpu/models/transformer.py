@@ -159,6 +159,29 @@ class TransformerLM:
 # Sequence-parallel training step (the long-context path)
 # ---------------------------------------------------------------------------
 
+def _lm_targets_and_mask(tokens: jnp.ndarray):
+    """Global next-token targets + loss mask, built BEFORE sharding so a
+    shard's last position targets the next shard's first token."""
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    mask = jnp.concatenate(
+        [jnp.ones_like(tokens[:, 1:], jnp.float32),
+         jnp.zeros_like(tokens[:, :1], jnp.float32)], axis=1
+    )
+    return targets, mask
+
+
+def _masked_ce(logits, targets, mask, psum_axes):
+    """Masked mean next-token cross-entropy, psum-reduced over the sharded
+    batch/sequence axes."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    tot = lax.psum((-ll * mask).sum(), psum_axes)
+    cnt = lax.psum(mask.sum(), psum_axes)
+    return tot / cnt
+
+
 def make_sp_train_step(
     model: TransformerLM,
     mesh,
@@ -180,11 +203,7 @@ def make_sp_train_step(
         def loss_fn(p):
             logits = model.apply(p, tokens, axis_name=seq_axis,
                                  pos_offset=offset)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-            tot = lax.psum((-ll * mask).sum(), axes)
-            cnt = lax.psum(mask.sum(), axes)
-            return tot / cnt
+            return _masked_ce(logits, targets, mask, axes)
 
         # Params enter replicated (unvarying) and the loss is psum-reduced,
         # so shard_map's typed autodiff already inserts the cross-device
@@ -201,15 +220,7 @@ def make_sp_train_step(
 
     @jax.jit
     def step(params, tokens):
-        # Next-token setup happens globally, BEFORE sharding, so targets at
-        # a shard's last position come from the next shard's first token.
-        targets = jnp.concatenate(
-            [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
-        )
-        mask = jnp.concatenate(
-            [jnp.ones_like(tokens[:, 1:], jnp.float32),
-             jnp.zeros_like(tokens[:, :1], jnp.float32)], axis=1
-        )
+        targets, mask = _lm_targets_and_mask(tokens)
         return jax.shard_map(
             local_step,
             mesh=mesh,
@@ -218,6 +229,141 @@ def make_sp_train_step(
         )(params, tokens, targets, mask)
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Combined data x sequence x tensor parallel training step
+# ---------------------------------------------------------------------------
+
+def tp_param_specs(n_layers: int, model_axis: str) -> Dict[str, Any]:
+    """PartitionSpec tree for the tensor-parallel param layout (wqkv split
+    into wq/wk/wv): Megatron-style column-parallel in-projections
+    (``P(None, model)``) and row-parallel out-projections
+    (``P(model, None)``); everything else replicated."""
+    layer = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, model_axis), "wk": P(None, model_axis),
+        "wv": P(None, model_axis),
+        "wo": P(model_axis, None),
+        "w1": P(None, model_axis), "w2": P(model_axis, None),
+    }
+    return {
+        "embed": P(), "pos": P(), "ln_f": P(),
+        "layers": [dict(layer) for _ in range(n_layers)],
+    }
+
+
+def to_tp_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert the LM's packed-wqkv param tree to the TP layout (wq/wk/wv
+    separate so each can shard cleanly on its output dim)."""
+    layers = []
+    for layer in params["layers"]:
+        wq, wk, wv = jnp.split(layer["wqkv"], 3, axis=-1)
+        layers.append({
+            "ln1": layer["ln1"], "ln2": layer["ln2"],
+            "wq": wq, "wk": wk, "wv": wv,
+            "wo": layer["wo"], "w1": layer["w1"], "w2": layer["w2"],
+        })
+    return {"embed": params["embed"], "pos": params["pos"],
+            "ln_f": params["ln_f"], "layers": layers}
+
+
+def make_parallel_train_step(
+    model: TransformerLM,
+    mesh,
+    learning_rate: float = 0.1,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+    model_axis: str = "model",
+):
+    """Build the full 3-axis SPMD train step: batch over ``data_axis``,
+    sequence over ``seq_axis`` (ring attention), and tensor parallelism
+    over ``model_axis`` (column-parallel wq/wk/wv+w1 with heads split
+    across shards, row-parallel wo/w2 with a psum back to replicated
+    activations — the Megatron decomposition, expressed in shard_map so
+    XLA schedules every collective on ICI).
+
+    Returns ``(step, shard_params)``: ``shard_params(params)`` places a
+    replicated param tree into the TP layout/sharding; ``step(tp_params,
+    tokens) -> (new_tp_params, loss)`` takes the GLOBAL token matrix.
+
+    Gradient flow: the loss is psum-reduced over (data, seq); TP-sharded
+    leaves get their gradients locally (each shard owns its slice), while
+    replicated leaves (embeddings, norms) are transposed through the
+    forward psums, so shard_map's typed autodiff inserts the model-axis
+    gradient psum exactly where the math needs it.
+    """
+    cfg = model.config
+    from jax.sharding import NamedSharding
+
+    tp = mesh.shape.get(model_axis, 1)
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads {cfg.n_heads} must divide by tensor "
+                         f"parallelism {tp}")
+    if cfg.d_ff % tp or cfg.d_model % tp:
+        raise ValueError("d_model and d_ff must divide by tensor parallelism")
+    h_loc, hd = cfg.n_heads // tp, cfg.head_dim
+    specs = tp_param_specs(cfg.n_layers, model_axis)
+    # PartitionSpec subclasses tuple, hence the is_leaf guard.
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+    def shard_params(params: Dict[str, Any]) -> Dict[str, Any]:
+        # device_put validates the tree structures match, so a param leaf
+        # missing from tp_param_specs errors instead of mis-pairing.
+        return jax.device_put(to_tp_params(params), shardings)
+
+    def local_apply(p, tokens, offset):
+        B, S = tokens.shape
+        dtype = cfg.dtype
+        x = (p["embed"][tokens] + p["pos"][offset + jnp.arange(S)]).astype(dtype)
+        for layer in p["layers"]:
+            xn = _norm(x, layer["ln1"].astype(dtype))
+            to_heads = lambda t: t.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
+            o = ring_attention(
+                to_heads(xn @ layer["wq"].astype(dtype)),
+                to_heads(xn @ layer["wk"].astype(dtype)),
+                to_heads(xn @ layer["wv"].astype(dtype)),
+                axis_name=seq_axis, causal=True,
+            )
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, h_loc * hd)
+            # row-parallel out-projection: partial sums -> replicated x
+            x = x + lax.psum(o @ layer["wo"].astype(dtype), model_axis)
+            xn = _norm(x, layer["ln2"].astype(dtype))
+            hidden = jax.nn.gelu(xn @ layer["w1"].astype(dtype))
+            x = x + lax.psum(hidden @ layer["w2"].astype(dtype), model_axis)
+        x = _norm(x, p["ln_f"].astype(dtype))
+        return x.astype(jnp.float32) @ p["embed"].T
+
+    def local_step(p, tokens, targets, mask):
+        S_loc = tokens.shape[1]
+        offset = lax.axis_index(seq_axis) * S_loc
+
+        def loss_fn(p):
+            logits = local_apply(p, tokens, offset)
+            return _masked_ce(logits, targets, mask, (data_axis, seq_axis))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        new_p = jax.tree.map(
+            lambda w, g: w - learning_rate * g.astype(w.dtype), p, grads
+        )
+        return new_p, loss
+
+    tok_spec = P(data_axis, seq_axis)
+
+    @jax.jit
+    def step(tp_params, tokens):
+        targets, mask = _lm_targets_and_mask(tokens)
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, tok_spec, tok_spec, tok_spec),
+            out_specs=(specs, P()),
+        )(tp_params, tokens, targets, mask)
+
+    return step, shard_params
 
 
 def make_lm_data(

@@ -114,12 +114,59 @@ class TableSpec:
         keys = jnp.arange(self.config.capacity, dtype=jnp.int32)
         return self.pull(arr, keys)
 
-    def push(self, arr: jnp.ndarray, keys: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
-        """multiUpdate: fold ``deltas`` into the table (one XLA scatter;
-        duplicate keys fold per the update fn's scatter_mode)."""
+    def push(
+        self,
+        arr: jnp.ndarray,
+        keys: jnp.ndarray,
+        deltas: jnp.ndarray,
+        *,
+        via: str = "auto",
+    ) -> jnp.ndarray:
+        """multiUpdate: fold ``deltas`` into the table; duplicate keys fold
+        per the update fn's scatter_mode.
+
+        ``via`` picks the lowering of additive pushes:
+          * "scatter" — one XLA scatter (duplicate keys serialise on TPU).
+          * "mxu" — pre-fold duplicates with the one-hot segment-sum matmul
+            (ops.histogram.segment_sum) and apply ONE dense add; the
+            temporary is table-sized (memory is always affordable, but the
+            dense add streams the whole table through HBM).
+          * "mxu_auto" — "mxu" when the push touches a meaningful fraction
+            of the table (>= capacity/256 keys — the dense-add bandwidth
+            amortises over duplicate folds), else "scatter" (a few rows
+            into a huge table: streaming the table would dominate).
+          * "auto" — "scatter". The spec cannot see which devices the
+            array lives on (the process default backend is NOT it — a CPU
+            table in a TPU-default process is normal in tests/benchmarks),
+            so platform-aware callers resolve DenseTable.push_via and pass
+            it explicitly.
+        """
         b, o = self.partitioner.locate(keys)
-        ref = arr.at[b, o]
         mode = self.update_fn.scatter_mode
+        if via == "auto":
+            via = "scatter"
+        elif via == "mxu_auto":
+            dense_enough = keys.shape[0] >= max(32, self.config.capacity // 256)
+            via = "mxu" if mode == "add" and dense_enough else "scatter"
+        if via == "mxu":
+            if mode != "add":
+                raise ValueError("via='mxu' requires an additive update fn")
+            from harmony_tpu.ops.histogram import segment_sum
+
+            n = keys.shape[0]
+            flat_idx = (b * self.block_size + o).astype(jnp.int32).reshape(-1)
+            folded = segment_sum(
+                deltas.reshape(n, -1).astype(jnp.float32),
+                flat_idx,
+                self.num_blocks * self.block_size,
+            )
+            out = arr + folded.reshape(arr.shape).astype(arr.dtype)
+            if self.update_fn.post is not None:
+                out = out.at[b, o].set(self.update_fn.post(out[b, o]))
+            return out
+        if via != "scatter":
+            raise ValueError(f"unknown push route {via!r}")
+        ref = arr.at[b, o]
         if mode == "add":
             out = ref.add(deltas.astype(arr.dtype))
         elif mode == "min":
@@ -306,11 +353,25 @@ class DenseTable:
     get_or_init = get
     multi_get_or_init = multi_get
 
+    @property
+    def push_via(self) -> str:
+        """Platform-resolved keyed-push route: the size-gated MXU
+        duplicate-fold on an all-TPU mesh for additive tables, XLA scatter
+        everywhere else."""
+        on_tpu = all(d.platform == "tpu" for d in self._mesh.devices.flat)
+        return (
+            "mxu_auto"
+            if on_tpu and self.spec.update_fn.scatter_mode == "add"
+            else "scatter"
+        )
+
     def multi_update(self, keys: Sequence[int], deltas: np.ndarray) -> None:
         k = jnp.asarray(keys, dtype=jnp.int32)
         d = jnp.asarray(deltas)
         with self._lock:
-            self._arr = self._jitted("push", self.spec.push)(self._arr, k, d)
+            self._arr = self._jitted(
+                "push", partial(self.spec.push, via=self.push_via)
+            )(self._arr, k, d)
 
     def update(self, key: int, delta: np.ndarray) -> None:
         self.multi_update([key], jnp.asarray(delta)[None])

@@ -178,3 +178,53 @@ class TestBlockManager:
         bm.subscribe(lambda tid, owners: events.append((tid, list(owners))))
         bm.move("e0", "e1", 1)
         assert events and events[0][0] == "t"
+
+
+class TestMxuPushRoute:
+    def _spec(self, update_fn="add"):
+        from harmony_tpu.config import TableConfig
+        from harmony_tpu.table import TableSpec
+
+        return TableSpec(TableConfig(
+            table_id="mxu-push", capacity=100, value_shape=(6,),
+            num_blocks=8, update_fn=update_fn,
+        ))
+
+    def test_mxu_matches_scatter_with_duplicates(self):
+        spec = self._spec()
+        arr = spec.init_array()
+        rng = np.random.default_rng(0)
+        keys = jnp.asarray(rng.integers(0, 100, 64), jnp.int32)  # many dups
+        deltas = jnp.asarray(rng.standard_normal((64, 6), dtype=np.float32))
+        out_scatter = spec.push(arr, keys, deltas, via="scatter")
+        out_mxu = spec.push(arr, keys, deltas, via="mxu")
+        np.testing.assert_allclose(
+            np.asarray(out_mxu), np.asarray(out_scatter), rtol=1e-5, atol=1e-5
+        )
+
+    def test_mxu_applies_post_invariant(self):
+        spec = self._spec("add_nonneg")  # post clamps touched entries >= 0
+        arr = spec.init_array()
+        keys = jnp.asarray([3, 3, 7], jnp.int32)
+        deltas = jnp.asarray([[-5.0] * 6, [1.0] * 6, [2.0] * 6], jnp.float32)
+        out = spec.push(arr, keys, deltas, via="mxu")
+        got = np.asarray(spec.pull(out, jnp.asarray([3, 7], jnp.int32)))
+        np.testing.assert_allclose(got[0], np.zeros(6))   # clamped
+        np.testing.assert_allclose(got[1], np.full(6, 2.0))
+
+    def test_mxu_rejects_non_additive(self):
+        spec = self._spec("assign")
+        arr = spec.init_array()
+        with pytest.raises(ValueError):
+            spec.push(arr, jnp.asarray([1], jnp.int32),
+                      jnp.ones((1, 6), jnp.float32), via="mxu")
+
+    def test_mxu_auto_size_gate(self):
+        spec = self._spec()
+        arr = spec.init_array()
+        # few keys into the table -> downgrades to scatter (same result)
+        few = spec.push(arr, jnp.asarray([1, 1], jnp.int32),
+                        jnp.ones((2, 6), jnp.float32), via="mxu_auto")
+        ref = spec.push(arr, jnp.asarray([1, 1], jnp.int32),
+                        jnp.ones((2, 6), jnp.float32), via="scatter")
+        np.testing.assert_allclose(np.asarray(few), np.asarray(ref))
