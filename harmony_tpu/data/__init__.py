@@ -7,6 +7,7 @@ from harmony_tpu.data.parsers import (
     get_parser,
     register_parser,
 )
+from harmony_tpu.data.loader import PrefetchLoader
 from harmony_tpu.data.storer import DataStorer, FileDataStorer
 
 
@@ -17,10 +18,10 @@ def load_dataset(paths, parser, num_splits: int = 1):
     import numpy as np
 
     parts = []
-    for split in compute_splits(list(paths), num_splits):
-        records = fetch_split(split)
-        if records:
-            parts.append(parser.parse(records))
+    with PrefetchLoader(compute_splits(list(paths), num_splits)) as loader:
+        for records in loader:
+            if records:
+                parts.append(parser.parse(records))
     if not parts:
         raise ValueError(f"no records in {paths}")
     first = parts[0]
@@ -32,6 +33,7 @@ __all__ = [
     "SplitInfo",
     "compute_splits",
     "fetch_split",
+    "PrefetchLoader",
     "DataParser",
     "CsvParser",
     "LibSvmParser",

@@ -1,0 +1,140 @@
+"""PrefetchLoader — ordered background split reading.
+
+The reference's bulk load overlaps network fetch with table insertion via
+the HDFS client's own threads (TableLoadMsg → BulkDataLoader →
+HdfsSplitFetcher, SURVEY.md §3.2); the training loop itself reads nothing.
+Here file-fed jobs DO stream splits, so the loader is a real runtime
+component: a C++ worker pool (native/harmony_native.cc ht_prefetch_*)
+reads split byte-ranges with bounded lookahead and delivers them in
+submission order, keeping epoch composition deterministic while IO
+overlaps parsing/compute. A pure-Python thread pool provides the same
+contract when the native library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional, Sequence
+
+from harmony_tpu.data.splits import SplitInfo, fetch_split
+
+
+def _decode(raw: bytes) -> List[str]:
+    return [ln for ln in raw.decode("utf-8").split("\n") if ln.strip()]
+
+
+class PrefetchLoader:
+    """Iterate split record-lists in order while later splits load in the
+    background. SINGLE PASS: the loader is an exhaustible stream (the
+    native cursor only moves forward), so a second iteration raises
+    instead of silently differing between the native and fallback paths.
+    Use as a context manager (or call :meth:`close`)."""
+
+    def __init__(
+        self,
+        splits: Sequence[SplitInfo],
+        depth: int = 2,
+        workers: int = 2,
+        force_python: bool = False,
+    ) -> None:
+        if depth < 1 or workers < 1:
+            raise ValueError("depth and workers must be >= 1")
+        self.splits = list(splits)
+        self.depth = depth
+        self.workers = workers
+        self._handle = None
+        self._lib = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._consumed = False
+        if not force_python:
+            self._open_native()
+
+    # -- native path ------------------------------------------------------
+
+    def _open_native(self) -> None:
+        from harmony_tpu import native
+
+        if not native.available():
+            return
+        lib = native._load()
+        flat = [(p, int(o), int(n)) for s in self.splits for (p, o, n) in s.pieces]
+        n = len(flat)
+        paths = (ctypes.c_char_p * n)(*[p.encode() for p, _, _ in flat])
+        offsets = (ctypes.c_uint64 * n)(*[o for _, o, _ in flat])
+        lengths = (ctypes.c_uint64 * n)(*[ln for _, _, ln in flat])
+        counts = (ctypes.c_int32 * len(self.splits))(
+            *[len(s.pieces) for s in self.splits]
+        )
+        handle = lib.ht_prefetch_open(
+            paths, offsets, lengths, counts,
+            len(self.splits), self.depth, self.workers,
+        )
+        if handle:
+            # keep the ctypes arrays alive for the handle's lifetime
+            self._keep = (paths, offsets, lengths, counts)
+            self._handle = handle
+            self._lib = lib
+
+    def _iter_native(self) -> Iterator[List[str]]:
+        lib = self._lib
+        for idx in range(len(self.splits)):
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            size = lib.ht_prefetch_next(self._handle, ctypes.byref(out))
+            if size == -1:
+                return
+            if size < 0:
+                raise IOError(
+                    f"prefetch read failed on split {idx} "
+                    f"({self.splits[idx].pieces})"
+                )
+            try:
+                raw = ctypes.string_at(out, size)
+            finally:
+                lib.ht_prefetch_buf_free(out)
+            yield _decode(raw)
+
+    # -- python fallback --------------------------------------------------
+
+    def _iter_python(self) -> Iterator[List[str]]:
+        self._pool = ThreadPoolExecutor(max_workers=self.workers)
+        futures = {}
+        try:
+            for idx in range(min(self.depth, len(self.splits))):
+                futures[idx] = self._pool.submit(fetch_split, self.splits[idx])
+            for idx in range(len(self.splits)):
+                nxt = idx + self.depth
+                if nxt < len(self.splits):
+                    futures[nxt] = self._pool.submit(fetch_split, self.splits[nxt])
+                yield futures.pop(idx).result()
+        finally:
+            self.close()
+
+    def __iter__(self) -> Iterator[List[str]]:
+        if self._consumed:
+            raise RuntimeError(
+                "PrefetchLoader is single-pass; construct a new one to re-read"
+            )
+        self._consumed = True
+        if self._handle is not None:
+            return self._iter_native()
+        return self._iter_python()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.ht_prefetch_close(self._handle)
+            self._handle = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # safety net; explicit close preferred
+        try:
+            self.close()
+        except Exception:
+            pass

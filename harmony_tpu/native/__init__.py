@@ -49,7 +49,7 @@ class BlockCorruptError(IOError):
 
 def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -94,6 +94,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.ht_prefetch_open.restype = ctypes.c_void_p
+        lib.ht_prefetch_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.ht_prefetch_next.restype = ctypes.c_int64
+        lib.ht_prefetch_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.ht_prefetch_buf_free.restype = None
+        lib.ht_prefetch_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.ht_prefetch_close.restype = None
+        lib.ht_prefetch_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

@@ -170,3 +170,175 @@ int64_t ht_blk_read(const char* path, void* out, uint64_t out_cap,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Prefetching split loader: an ordered background reader pool.
+//
+// The reference overlaps training with data arrival only at bulk-load time
+// (HDFS client threads inside TableLoadMsg handling); here a C++ worker pool
+// reads split byte-ranges ahead of the training loop with bounded lookahead,
+// delivering splits IN ORDER so epoch composition stays deterministic.
+// Record-boundary semantics replicate harmony_tpu/data/splits.py
+// _fetch_range exactly (LineRecordReader alignment: a record belongs to the
+// split containing its first byte; the last record is finished by reading
+// past the range end) — parity is pinned by tests/test_native.py.
+// ---------------------------------------------------------------------------
+
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int fetch_range_cc(const std::string& path, uint64_t offset, uint64_t length,
+                   std::string& out) {
+  if (length == 0) return 0;
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return -1;
+  std::string chunk;
+  if (offset > 0) {
+    if (fseeko(f, (off_t)(offset - 1), SEEK_SET) != 0) { fclose(f); return -1; }
+    chunk.resize(length + 1);
+    size_t got = fread(&chunk[0], 1, length + 1, f);
+    chunk.resize(got);
+    size_t nl = chunk.find('\n');
+    if (nl == std::string::npos) { fclose(f); return 0; }  // mid-record range
+    chunk.erase(0, nl + 1);
+    if (chunk.empty()) { fclose(f); return 0; }  // no record starts here
+  } else {
+    chunk.resize(length);
+    size_t got = fread(&chunk[0], 1, length, f);
+    chunk.resize(got);
+  }
+  if (chunk.empty() || chunk.back() != '\n') {
+    char buf[4096];
+    for (;;) {
+      size_t got = fread(buf, 1, sizeof buf, f);
+      if (!got) break;
+      char* nl = (char*)memchr(buf, '\n', got);
+      if (nl) { chunk.append(buf, nl - buf + 1); break; }
+      chunk.append(buf, got);
+    }
+  }
+  fclose(f);
+  out += chunk;
+  return 0;
+}
+
+struct Piece { std::string path; uint64_t offset, length; };
+
+struct Prefetcher {
+  std::vector<std::vector<Piece>> splits;   // per split: its pieces
+  int32_t depth;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  size_t next_claim = 0;    // next split index a worker may take
+  size_t next_deliver = 0;  // next split index ht_prefetch_next returns
+  std::map<size_t, std::pair<std::string, int>> results;  // idx -> (bytes, err)
+  bool closing = false;
+  std::vector<std::thread> workers;
+
+  void worker() {
+    for (;;) {
+      size_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] {
+          return closing ||
+                 (next_claim < splits.size() &&
+                  next_claim < next_deliver + (size_t)depth);
+        });
+        if (closing) return;
+        idx = next_claim++;
+      }
+      std::string bytes;
+      int err = 0;
+      for (const Piece& p : splits[idx]) {
+        if (fetch_range_cc(p.path, p.offset, p.length, bytes) != 0) {
+          err = -1;
+          break;
+        }
+        // Terminate each piece's contribution: a file with no trailing
+        // newline must not fuse its last record with the next piece's
+        // first (the Python path splits per piece, so parity needs this).
+        if (!bytes.empty() && bytes.back() != '\n') bytes.push_back('\n');
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        results[idx] = {std::move(bytes), err};
+      }
+      cv_done.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ht_prefetch_open(const char* const* paths, const uint64_t* offsets,
+                       const uint64_t* lengths, const int32_t* piece_counts,
+                       int32_t n_splits, int32_t depth, int32_t n_workers) {
+  if (n_splits < 0 || depth < 1 || n_workers < 1) return nullptr;
+  Prefetcher* pf = new Prefetcher();
+  pf->depth = depth;
+  size_t k = 0;
+  pf->splits.resize((size_t)n_splits);
+  for (int32_t i = 0; i < n_splits; i++) {
+    for (int32_t j = 0; j < piece_counts[i]; j++, k++) {
+      pf->splits[i].push_back(Piece{paths[k], offsets[k], lengths[k]});
+    }
+  }
+  int32_t nw = n_workers < n_splits ? n_workers : (n_splits ? n_splits : 1);
+  for (int32_t i = 0; i < nw; i++)
+    pf->workers.emplace_back([pf] { pf->worker(); });
+  return pf;
+}
+
+// Returns the byte length of the next split (in submission order) and sets
+// *out to a malloc'd buffer the caller frees with ht_prefetch_buf_free.
+// -1 = all splits delivered; -2 = read error on this split.
+int64_t ht_prefetch_next(void* h, uint8_t** out) {
+  Prefetcher* pf = (Prefetcher*)h;
+  std::string bytes;
+  int err;
+  {
+    std::unique_lock<std::mutex> lk(pf->mu);
+    if (pf->next_deliver >= pf->splits.size()) return -1;
+    size_t idx = pf->next_deliver;
+    pf->cv_done.wait(lk, [&] { return pf->results.count(idx) > 0; });
+    auto it = pf->results.find(idx);
+    bytes = std::move(it->second.first);
+    err = it->second.second;
+    pf->results.erase(it);
+    pf->next_deliver++;
+  }
+  pf->cv_work.notify_all();  // lookahead window advanced
+  if (err != 0) return -2;
+  // One deliberate copy: the split's bytes move from the worker's string
+  // into a C-owned buffer the caller frees; with bounded lookahead the
+  // transient is depth x split-size, which the depth knob already caps.
+  uint8_t* buf = (uint8_t*)malloc(bytes.size() ? bytes.size() : 1);
+  if (!buf) return -3;  // OOM surfaces as an error, not a memcpy crash
+  memcpy(buf, bytes.data(), bytes.size());
+  *out = buf;
+  return (int64_t)bytes.size();
+}
+
+void ht_prefetch_buf_free(uint8_t* p) { free(p); }
+
+void ht_prefetch_close(void* h) {
+  Prefetcher* pf = (Prefetcher*)h;
+  {
+    std::lock_guard<std::mutex> lk(pf->mu);
+    pf->closing = true;
+  }
+  pf->cv_work.notify_all();
+  for (std::thread& t : pf->workers) t.join();
+  delete pf;
+}
+
+}  // extern "C"

@@ -156,3 +156,97 @@ def test_py_blk_reader_portability(tmp_path):
     open(p, "wb").write(bytes(raw))
     with pytest.raises(native.BlockCorruptError):
         native._py_blk_read(p)
+
+
+class TestPrefetchLoader:
+    def _make_files(self, tmp_path, n_files=3, lines_per=50):
+        rng = np.random.default_rng(0)
+        paths = []
+        for i in range(n_files):
+            p = tmp_path / f"part{i}.txt"
+            rows = [
+                f"{rng.integers(0, 5)} " +
+                " ".join(f"{j+1}:{rng.random():.4f}" for j in range(4))
+                for _ in range(lines_per)
+            ]
+            p.write_text("\n".join(rows) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def _expected(self, splits):
+        from harmony_tpu.data import fetch_split
+
+        return [fetch_split(s) for s in splits]
+
+    def test_native_matches_sequential(self, tmp_path):
+        from harmony_tpu import native
+        from harmony_tpu.data import PrefetchLoader, compute_splits
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        paths = self._make_files(tmp_path)
+        splits = compute_splits(paths, 7)  # byte-ranges cross record bounds
+        with PrefetchLoader(splits, depth=3, workers=3) as loader:
+            got = list(loader)
+        assert got == self._expected(splits)
+
+    def test_python_fallback_matches_sequential(self, tmp_path):
+        from harmony_tpu.data import PrefetchLoader, compute_splits
+
+        paths = self._make_files(tmp_path)
+        splits = compute_splits(paths, 5)
+        with PrefetchLoader(splits, depth=2, workers=2,
+                            force_python=True) as loader:
+            got = list(loader)
+        assert got == self._expected(splits)
+
+    def test_native_error_on_missing_file(self, tmp_path):
+        from harmony_tpu import native
+        from harmony_tpu.data import PrefetchLoader
+        from harmony_tpu.data.splits import SplitInfo
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        bad = SplitInfo(pieces=[(str(tmp_path / "missing.txt"), 0, 100)],
+                        split_idx=0, num_splits=1)
+        with PrefetchLoader([bad]) as loader:
+            with pytest.raises(IOError):
+                list(loader)
+
+    def test_empty_split_list(self):
+        from harmony_tpu.data import PrefetchLoader
+
+        with PrefetchLoader([]) as loader:
+            assert list(loader) == []
+
+    def test_load_dataset_through_prefetch(self, tmp_path):
+        from harmony_tpu.data import LibSvmParser, load_dataset
+
+        paths = self._make_files(tmp_path)
+        x, y = load_dataset(paths, LibSvmParser(num_features=4), num_splits=4)
+        assert x.shape == (150, 4) and y.shape == (150,)
+
+    def test_no_trailing_newline_piece_boundary(self, tmp_path):
+        """A file without a trailing newline must not fuse its last record
+        with the next file's first (native/python parity)."""
+        from harmony_tpu.data import PrefetchLoader, compute_splits, fetch_split
+
+        f1 = tmp_path / "a.txt"; f1.write_bytes(b"a\nb")   # no trailing \n
+        f2 = tmp_path / "b.txt"; f2.write_bytes(b"c\n")
+        splits = compute_splits([str(f1), str(f2)], 1)
+        expected = [fetch_split(s) for s in splits]
+        assert expected == [["a", "b", "c"]]
+        for force in (False, True):
+            with PrefetchLoader(splits, force_python=force) as loader:
+                assert list(loader) == expected, f"force_python={force}"
+
+    def test_single_pass_contract(self, tmp_path):
+        from harmony_tpu.data import PrefetchLoader, compute_splits
+
+        paths = self._make_files(tmp_path, n_files=1, lines_per=5)
+        for force in (False, True):
+            loader = PrefetchLoader(compute_splits(paths, 2), force_python=force)
+            list(loader)
+            with pytest.raises(RuntimeError):
+                iter(loader)
+            loader.close()
