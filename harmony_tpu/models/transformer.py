@@ -8,8 +8,10 @@ models, SURVEY.md §5.7):
     full rate.
   * **Attention tiers**: single-chip uses the Pallas flash kernel
     (harmony_tpu.ops.attention); sequence-parallel training uses ring
-    attention (harmony_tpu.ops.ring) inside ``shard_map`` over the mesh's
-    "seq" axis; the blockwise scan is the differentiable/any-backend tier.
+    attention (harmony_tpu.ops.ring) or the all-to-all head-scatter
+    scheme (harmony_tpu.ops.ulysses, ``sp_attn="a2a"``) inside
+    ``shard_map`` over the mesh's "seq" axis; the blockwise scan is the
+    differentiable/any-backend tier.
   * **PS-table integration**: :class:`TransformerTrainer` flattens the
     pytree into a range-partitioned DenseTable ([rows, row_width]) so the
     LM trains through the same Trainer SPI / WorkerTasklet / elastic-table
@@ -37,6 +39,7 @@ from harmony_tpu.config.params import TableConfig
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.ops.attention import blockwise_attention, flash_attention
 from harmony_tpu.ops.ring import ring_attention
+from harmony_tpu.ops.ulysses import a2a_attention
 from harmony_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 
@@ -50,10 +53,13 @@ class TransformerConfig:
     max_seq: int = 256
     dtype: Any = jnp.float32        # activation dtype (bf16 on hardware)
     attn: str = "auto"              # "auto" | "flash" | "blockwise"
+    sp_attn: str = "ring"           # sequence-parallel tier: "ring" | "a2a"
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
             raise ValueError("d_model must divide by n_heads")
+        if self.sp_attn not in ("ring", "a2a"):
+            raise ValueError(f"unknown sp_attn {self.sp_attn!r}")
 
     @property
     def head_dim(self) -> int:
@@ -108,7 +114,8 @@ class TransformerLM:
     def _attention(self, q, k, v, axis_name: Optional[str]):
         cfg = self.config
         if axis_name is not None:
-            return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+            sp = a2a_attention if cfg.sp_attn == "a2a" else ring_attention
+            return sp(q, k, v, axis_name=axis_name, causal=True)
         S = q.shape[2]
         attn = cfg.attn
         if attn == "auto":
@@ -303,6 +310,13 @@ def make_parallel_train_step(
     if cfg.d_ff % tp or cfg.d_model % tp:
         raise ValueError("d_model and d_ff must divide by tensor parallelism")
     h_loc, hd = cfg.n_heads // tp, cfg.head_dim
+    sp = mesh.shape.get(seq_axis, 1)
+    if cfg.sp_attn == "a2a" and h_loc % sp:
+        raise ValueError(
+            f"sp_attn='a2a' needs per-TP-shard heads ({h_loc}) divisible by "
+            f"the sequence axis ({sp})"
+        )
+    sp_attn_fn = a2a_attention if cfg.sp_attn == "a2a" else ring_attention
     specs = tp_param_specs(cfg.n_layers, model_axis)
     # PartitionSpec subclasses tuple, hence the is_leaf guard.
     shardings = jax.tree.map(
@@ -322,7 +336,7 @@ def make_parallel_train_step(
         for layer in p["layers"]:
             xn = _norm(x, layer["ln1"].astype(dtype))
             to_heads = lambda t: t.reshape(B, S, h_loc, hd).transpose(0, 2, 1, 3)
-            o = ring_attention(
+            o = sp_attn_fn(
                 to_heads(xn @ layer["wq"].astype(dtype)),
                 to_heads(xn @ layer["wk"].astype(dtype)),
                 to_heads(xn @ layer["wv"].astype(dtype)),

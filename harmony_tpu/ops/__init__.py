@@ -10,8 +10,11 @@ from harmony_tpu.ops.attention import blockwise_attention, flash_attention
 from harmony_tpu.ops.histogram import segment_sum, weighted_histogram
 from harmony_tpu.ops.mxu import mxu_dot
 from harmony_tpu.ops.ring import ring_attention
+from harmony_tpu.ops.ulysses import a2a_attention, a2a_self_attention
 
 __all__ = [
+    "a2a_attention",
+    "a2a_self_attention",
     "blockwise_attention",
     "flash_attention",
     "mxu_dot",

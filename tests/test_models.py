@@ -219,3 +219,56 @@ def test_parallel_step_rejects_bad_tp(devices):
     model = TransformerLM(CFG)  # n_heads=2 < tp=8
     with pytest.raises(ValueError):
         make_parallel_train_step(model, mesh)
+
+
+def test_sp_step_a2a_matches_ring(devices):
+    """The a2a sequence-parallel tier trains identically to ring (both are
+    exact attention; same grads to f32 tolerance)."""
+    import dataclasses
+
+    mesh = build_mesh(devices, data=4, seq=2, model=1)
+    tokens = jnp.asarray(make_lm_data(4, 32, CFG.vocab_size, seed=6))
+    results = {}
+    for impl in ("ring", "a2a"):
+        cfg = dataclasses.replace(CFG, sp_attn=impl)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(7))
+        step = make_sp_train_step(model, mesh, learning_rate=0.1)
+        new_p, loss = step(params, tokens)
+        results[impl] = (new_p, float(loss))
+    np.testing.assert_allclose(results["ring"][1], results["a2a"][1], atol=1e-5)
+    for a, b in zip(jax.tree.leaves(results["ring"][0]),
+                    jax.tree.leaves(results["a2a"][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_parallel_step_a2a_tier(devices):
+    """sp_attn='a2a' is honored by the 3-axis step (heads-per-TP-shard must
+    divide the seq axis) and trains to the same result as ring."""
+    import dataclasses
+
+    from harmony_tpu.models.transformer import make_parallel_train_step
+
+    cfg4 = dataclasses.replace(CFG, n_heads=4, sp_attn="a2a")
+    mesh = build_mesh(devices, data=2, seq=2, model=2)
+    tokens = jnp.asarray(make_lm_data(4, 32, CFG.vocab_size, seed=8))
+    outs = {}
+    for impl in ("ring", "a2a"):
+        cfg = dataclasses.replace(cfg4, sp_attn=impl)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(9))
+        step, shard = make_parallel_train_step(model, mesh, learning_rate=0.1)
+        new_p, loss = step(shard(params), tokens)
+        outs[impl] = float(loss)
+    np.testing.assert_allclose(outs["ring"], outs["a2a"], atol=1e-5)
+    # indivisible: 2 heads / tp=2 -> 1 head per shard, seq axis 2
+    bad = dataclasses.replace(CFG, sp_attn="a2a")
+    with pytest.raises(ValueError, match="divisible"):
+        make_parallel_train_step(TransformerLM(bad), mesh)
+
+
+def test_config_rejects_unknown_sp_attn():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="sp_attn"):
+        dataclasses.replace(CFG, sp_attn="alltoall")

@@ -195,3 +195,31 @@ class TestMxuDot:
 
         with pytest.raises(ValueError):
             mxu_dot(jnp.ones((2, 2)), jnp.ones((2, 2)), precision="fp8")
+
+
+class TestA2AAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, devices, causal):
+        from harmony_tpu.ops import a2a_self_attention, blockwise_attention
+        from harmony_tpu.parallel import build_mesh
+
+        mesh = build_mesh(devices, data=1, seq=8, model=1)
+        B, H, S, D = 2, 8, 64, 16
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, H, S, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, H, S, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, H, S, D), jnp.float32)
+        out = a2a_self_attention(q, k, v, mesh, seq_axis="seq", causal=causal)
+        ref = blockwise_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_rejects_indivisible_heads(self, devices):
+        from harmony_tpu.ops import a2a_self_attention
+        from harmony_tpu.parallel import build_mesh
+
+        mesh = build_mesh(devices, data=1, seq=8, model=1)
+        x = jnp.ones((2, 3, 64, 8))  # 3 heads, 8-way seq axis
+        with pytest.raises(ValueError):
+            a2a_self_attention(x, x, x, mesh, seq_axis="seq")
