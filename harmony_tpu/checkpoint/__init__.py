@@ -1,3 +1,7 @@
-from harmony_tpu.checkpoint.manager import CheckpointManager, CheckpointInfo
+from harmony_tpu.checkpoint.manager import (
+    CheckpointInfo,
+    CheckpointManager,
+    PendingCheckpoint,
+)
 
-__all__ = ["CheckpointManager", "CheckpointInfo"]
+__all__ = ["CheckpointManager", "CheckpointInfo", "PendingCheckpoint"]

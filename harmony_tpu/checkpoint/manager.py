@@ -81,6 +81,30 @@ class CheckpointInfo:
         return CheckpointInfo(**d)
 
 
+class PendingCheckpoint:
+    """Handle for an in-flight async checkpoint (see
+    CheckpointManager.checkpoint_async)."""
+
+    def __init__(self, chkp_id: str) -> None:
+        self.chkp_id = chkp_id
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        """Block until the write finishes; raises the writer's exception if
+        it failed, else returns the checkpoint id."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"checkpoint {self.chkp_id} still writing")
+        if self._error is not None:
+            raise self._error
+        return self.chkp_id
+
+
 class CheckpointManager:
     """Master-side coordinator (ref: ChkpManagerMaster) + the slave-side
     block IO collapsed in (single-controller: the master can reach every
@@ -96,6 +120,53 @@ class CheckpointManager:
 
     # -- write path ------------------------------------------------------
 
+    def _snapshot(self, handle: TableHandle, sampling_ratio: float):
+        """The synchronous prefix shared by sync and async checkpointing:
+        id allocation + an atomic device-side snapshot (O(dispatch); the
+        table lock is held for microseconds)."""
+        if not (0.0 < sampling_ratio <= 1.0):
+            raise ValueError(f"bad sampling_ratio {sampling_ratio}")
+        with self._lock:
+            self._counter += 1
+            chkp_id = f"{handle.table_id}-{self._counter}-{int(time.time() * 1000)}"
+        snap = handle.table.snapshot_blocks()
+        info = CheckpointInfo(
+            chkp_id=chkp_id,
+            table_config=handle.table.spec.config,
+            block_ids=sorted(snap),
+            ownership=handle.block_manager.ownership_vector(),
+            executors=handle.block_manager.executors,
+            sampling_ratio=sampling_ratio,
+            committed=False,
+            created_at=time.time(),
+        )
+        return chkp_id, snap, info
+
+    def _write(self, info, snap, block_size, commit):
+        """Stage the snapshot to temp files (+ optional commit): the slow
+        D2H + file IO half, runnable on any thread.
+
+        Writes into a ``.writing`` staging dir and renames into place
+        (atomic, same FS), so delete()/info()/restore()/list_checkpoints()
+        NEVER observe a half-written checkpoint — an in-flight async id
+        resolves to nothing until the rename."""
+        tdir = os.path.join(self.temp_root, info.chkp_id)
+        staging = tdir + ".writing"
+        os.makedirs(staging)
+        keep = None
+        if info.sampling_ratio < 1.0:
+            keep = max(1, int(block_size * info.sampling_ratio))
+        # pop as we go: each device block is released right after its D2H
+        # transfer instead of pinning the whole snapshot until the end.
+        for bid in sorted(snap):
+            arr = np.asarray(snap.pop(bid))
+            _write_block(staging, bid, arr[:keep] if keep else arr)
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            f.write(info.to_json())
+        os.rename(staging, tdir)
+        if commit:
+            self.commit(info.chkp_id)
+
     def checkpoint(
         self,
         handle: TableHandle,
@@ -107,39 +178,42 @@ class CheckpointManager:
         reference's tableId-timestamp scheme).
 
         Checkpoint and migration are mutually exclusive per table in the
-        reference (AllocatedTable doc); here the per-block export already
+        reference (AllocatedTable doc); here the per-block snapshot already
         dispatches under the table lock, so a concurrent reshard simply
         orders before or after the whole export.
         """
-        if not (0.0 < sampling_ratio <= 1.0):
-            raise ValueError(f"bad sampling_ratio {sampling_ratio}")
-        table = handle.table
-        with self._lock:
-            self._counter += 1
-            chkp_id = f"{handle.table_id}-{self._counter}-{int(time.time() * 1000)}"
-        tdir = os.path.join(self.temp_root, chkp_id)
-        os.makedirs(tdir)
-        blocks = table.export_blocks()
-        keep = None
-        if sampling_ratio < 1.0:
-            keep = max(1, int(table.spec.block_size * sampling_ratio))
-        for bid, arr in blocks.items():
-            _write_block(tdir, bid, arr[:keep] if keep else arr)
-        info = CheckpointInfo(
-            chkp_id=chkp_id,
-            table_config=table.spec.config,
-            block_ids=sorted(blocks),
-            ownership=handle.block_manager.ownership_vector(),
-            executors=handle.block_manager.executors,
-            sampling_ratio=sampling_ratio,
-            committed=False,
-            created_at=time.time(),
-        )
-        with open(os.path.join(tdir, "manifest.json"), "w") as f:
-            f.write(info.to_json())
-        if commit:
-            self.commit(chkp_id)
+        chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
+        self._write(info, snap, handle.table.spec.block_size, commit)
         return chkp_id
+
+    def checkpoint_async(
+        self,
+        handle: TableHandle,
+        sampling_ratio: float = 1.0,
+        commit: bool = False,
+    ) -> "PendingCheckpoint":
+        """Non-blocking checkpoint: the device-side snapshot is taken NOW
+        (atomic w.r.t. training steps), the D2H transfer and file IO run on
+        a background thread — training continues immediately. Returns a
+        :class:`PendingCheckpoint`; the checkpoint id resolves to a readable
+        directory only once ``wait()`` returns (the manifest is written
+        last, so an in-flight id never restores partially)."""
+        chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
+        pending = PendingCheckpoint(chkp_id)
+        block_size = handle.table.spec.block_size
+
+        def run():
+            try:
+                self._write(info, snap, block_size, commit)
+            except BaseException as e:  # surfaced by wait()
+                pending._error = e
+            finally:
+                pending._done.set()
+
+        t = threading.Thread(target=run, name=f"chkp-{chkp_id}", daemon=True)
+        pending._thread = t
+        t.start()
+        return pending
 
     def commit(self, chkp_id: str) -> None:
         """Stage 2: move temp -> durable (ref: commit on executor close).
@@ -196,6 +270,7 @@ class CheckpointManager:
             d
             for d in out
             if not d.endswith(".staging")
+            and not d.endswith(".writing")
             and (
                 os.path.isdir(os.path.join(self.commit_root, d))
                 or os.path.isdir(os.path.join(self.temp_root, d))

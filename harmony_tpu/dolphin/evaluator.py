@@ -14,13 +14,25 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from harmony_tpu.checkpoint.manager import CheckpointManager
+from harmony_tpu.checkpoint.manager import CheckpointManager, PendingCheckpoint
 from harmony_tpu.dolphin.trainer import Trainer
 from harmony_tpu.runtime.master import ETMaster, TableHandle
 
 
 class ModelChkpManager:
-    """Chains per-epoch snapshots of the model table during training."""
+    """Chains per-epoch snapshots of the model table during training.
+
+    Snapshots are ASYNC: the epoch hook runs on the worker's thread, so a
+    blocking checkpoint (device->host transfer + file IO) would stall
+    training for the write duration every period. The device-side snapshot
+    is atomic at the hook; the bytes drain in the background and
+    ``drain()`` (called before evaluation / at job end) joins the writers.
+    """
+
+    # Cap on concurrent background writers: each in-flight checkpoint pins
+    # one device-side table copy, so unbounded pendings could OOM a chip
+    # when the hook outpaces the disk.
+    MAX_PENDING = 2
 
     def __init__(
         self,
@@ -34,15 +46,36 @@ class ModelChkpManager:
         self._period = max(1, period)
         self._commit = commit
         self.chkp_ids: List[str] = []
+        self._pending: List[PendingCheckpoint] = []
 
     def on_epoch(self, epoch_idx: int) -> Optional[str]:
         """Epoch hook: snapshot every ``period`` epochs. Plugs into
         WorkerTasklet(epoch_callback=...)."""
         if (epoch_idx + 1) % self._period:
             return None
-        cid = self._mgr.checkpoint(self._handle, commit=self._commit)
-        self.chkp_ids.append(cid)
-        return cid
+        while len(self._pending) >= self.MAX_PENDING:
+            self._pending.pop(0).wait()  # backpressure: join the oldest
+        p = self._mgr.checkpoint_async(self._handle, commit=self._commit)
+        self._pending.append(p)
+        self.chkp_ids.append(p.chkp_id)
+        return p.chkp_id
+
+    def drain(self, timeout: float = 300.0) -> List[str]:
+        """Join ALL background writers; failed ids are removed from the
+        chain so the survivors stay replayable, then the first failure is
+        re-raised. Call before evaluating the chain / dropping the table."""
+        errors: List[BaseException] = []
+        for p in self._pending:
+            try:
+                p.wait(timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 - reported below
+                errors.append(e)
+                if p.chkp_id in self.chkp_ids:
+                    self.chkp_ids.remove(p.chkp_id)
+        self._pending.clear()
+        if errors:
+            raise errors[0]
+        return list(self.chkp_ids)
 
 
 class ModelEvaluator:

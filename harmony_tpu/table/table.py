@@ -446,14 +446,22 @@ class DenseTable:
 
     # -- per-block IO (checkpoint path) ----------------------------------
 
+    def snapshot_blocks(
+        self, block_ids: Optional[Sequence[int]] = None
+    ) -> Dict[int, jax.Array]:
+        """Atomic DEVICE-side snapshot of blocks: the per-block gathers are
+        dispatched under the lock (one consistent ``_arr``; a concurrent
+        donating step can't invalidate the source buffer), but nothing
+        transfers to host — callers pull bytes when/where they want
+        (e.g. a background checkpoint writer)."""
+        ids = list(range(self.spec.num_blocks)) if block_ids is None else list(block_ids)
+        with self._lock:
+            return {int(b): self._arr[int(b)] for b in ids}
+
     def export_blocks(self, block_ids: Optional[Sequence[int]] = None) -> Dict[int, np.ndarray]:
         """Materialize blocks to host memory (ref: ChkpManagerSlave writes
         local blocks to per-block files, evaluator/impl/ChkpManagerSlave.java)."""
-        ids = list(range(self.spec.num_blocks)) if block_ids is None else list(block_ids)
-        with self._lock:  # dispatch the per-block gathers under the lock so a
-            # concurrent donating step can't invalidate the source buffer
-            parts = {int(b): self._arr[int(b)] for b in ids}
-        return {b: np.asarray(a) for b, a in parts.items()}
+        return {b: np.asarray(a) for b, a in self.snapshot_blocks(block_ids).items()}
 
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> None:
         """Install block payloads (restore path; tolerates any topology —
