@@ -100,6 +100,9 @@ class PendingCheckpoint:
         it failed, else returns the checkpoint id."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"checkpoint {self.chkp_id} still writing")
+        if self._thread is not None:
+            self._thread.join()  # reap the writer thread
+            self._thread = None
         if self._error is not None:
             raise self._error
         return self.chkp_id

@@ -1,0 +1,76 @@
+"""Orbax interop — ecosystem-standard checkpoints for elastic tables.
+
+The framework's own two-stage `.blk` format (checkpoint/manager.py) is the
+performance path (CRC-checked per-block files, sampling, temp→durable
+commit — the reference's protocol, SURVEY.md §3.5). This module is the
+*compatibility* path: save/load a table as a plain Orbax PyTree
+checkpoint, so models trained here are readable by any JAX tooling that
+speaks Orbax (and vice versa for bootstrapping a table from an external
+JAX checkpoint).
+
+Layout: ``{"values": [capacity, *value_shape], "config": <table json>}`` —
+the VALUES in key order (not the internal block-major storage), because
+external consumers care about the logical table, not this runtime's
+sharding. Restore accepts any associator set / topology, like
+CheckpointManager.restore.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from harmony_tpu.config.base import ConfigBase
+from harmony_tpu.runtime.master import ETMaster, TableHandle
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_orbax(path: str, handle: TableHandle) -> str:
+    """Write the table as an Orbax PyTree checkpoint at ``path`` (absolute
+    or made absolute; orbax requires it). Returns the path."""
+    path = os.path.abspath(path)
+    table = handle.table
+    values = np.asarray(table.pull_array())  # key order, logical view
+    tree = {
+        "values": values,
+        "config": json.dumps(table.spec.config.to_dict(), sort_keys=True),
+    }
+    _checkpointer().save(path, tree)
+    return path
+
+
+def load_orbax(
+    path: str,
+    master: ETMaster,
+    associators: Sequence[str],
+    data_axis: int = 1,
+    table_id: Optional[str] = None,
+) -> TableHandle:
+    """Rebuild a table from an Orbax checkpoint on any associator set."""
+    path = os.path.abspath(path)
+    tree = _checkpointer().restore(path)
+    cfg = ConfigBase.from_dict(json.loads(tree["config"]))
+    if table_id is not None:
+        cfg = cfg.replace(table_id=table_id)
+    handle = master.create_table(cfg, associators, data_axis)
+    try:
+        values = np.asarray(tree["values"])
+        spec = handle.table.spec
+        if values.shape != (cfg.capacity, *spec.value_shape):
+            raise ValueError(
+                f"checkpoint values {values.shape} do not match table "
+                f"({cfg.capacity}, {spec.value_shape})"
+            )
+        handle.table.multi_put(list(range(cfg.capacity)), values)
+    except BaseException:
+        handle.drop()  # no half-restored orphan tables
+        raise
+    return handle

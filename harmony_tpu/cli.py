@@ -116,6 +116,13 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         graph_fn="harmony_tpu.pregel.graph:random_graph",
         graph_args={"num_vertices": 1000, "avg_degree": 5},
     ),
+    "connected-components": dict(
+        app_type="pregel",
+        trainer="harmony_tpu.apps.concomp:ConnectedComponentsComputation",
+        app_params={},
+        graph_fn="harmony_tpu.pregel.graph:random_graph",
+        graph_args={"num_vertices": 1000, "avg_degree": 5},
+    ),
     "shortest-path": dict(
         app_type="pregel",
         trainer="harmony_tpu.apps.sssp:ShortestPathComputation",

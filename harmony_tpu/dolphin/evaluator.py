@@ -54,7 +54,15 @@ class ModelChkpManager:
         if (epoch_idx + 1) % self._period:
             return None
         while len(self._pending) >= self.MAX_PENDING:
-            self._pending.pop(0).wait()  # backpressure: join the oldest
+            oldest = self._pending.pop(0)  # backpressure: join the oldest
+            try:
+                oldest.wait()
+            except BaseException:
+                # keep the chain consistent even on the backpressure path:
+                # a failed writer's id must not survive as a replayable id
+                if oldest.chkp_id in self.chkp_ids:
+                    self.chkp_ids.remove(oldest.chkp_id)
+                raise
         p = self._mgr.checkpoint_async(self._handle, commit=self._commit)
         self._pending.append(p)
         self.chkp_ids.append(p.chkp_id)
@@ -63,16 +71,23 @@ class ModelChkpManager:
     def drain(self, timeout: float = 300.0) -> List[str]:
         """Join ALL background writers; failed ids are removed from the
         chain so the survivors stay replayable, then the first failure is
-        re-raised. Call before evaluating the chain / dropping the table."""
+        re-raised. A TIMED-OUT writer is different from a failed one: its
+        checkpoint may still complete, so its id stays in the chain and
+        its handle stays pending — call drain() again to re-join it.
+        Call before evaluating the chain / dropping the table."""
         errors: List[BaseException] = []
+        still_pending: List[PendingCheckpoint] = []
         for p in self._pending:
             try:
                 p.wait(timeout=timeout)
+            except TimeoutError as e:
+                still_pending.append(p)  # in flight, not dead
+                errors.append(e)
             except BaseException as e:  # noqa: BLE001 - reported below
                 errors.append(e)
                 if p.chkp_id in self.chkp_ids:
                     self.chkp_ids.remove(p.chkp_id)
-        self._pending.clear()
+        self._pending = still_pending
         if errors:
             raise errors[0]
         return list(self.chkp_ids)

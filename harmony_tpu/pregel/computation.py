@@ -29,6 +29,9 @@ class Computation:
     state_dim: int = 1           # per-vertex state width
     # identity for the combiner; also the "no message" value
     msg_identity: float = 0.0
+    # True = messages must flow along BOTH directions of every edge; the
+    # master symmetrizes the graph before running (Graph.undirected).
+    undirected: bool = False
 
     def initial_state(self, num_vertices: int) -> jnp.ndarray:
         """[num_vertices, state_dim] initial vertex values."""

@@ -39,6 +39,18 @@ class Graph:
     def num_edges(self) -> int:
         return len(self.src)
 
+    def undirected(self) -> "Graph":
+        """Symmetrized copy: every edge also exists reversed (same weight).
+        Required by computations that flood in both directions (e.g.
+        connected components' HashMin — a directed edge alone would only
+        propagate labels forward)."""
+        return Graph(
+            self.num_vertices,
+            np.concatenate([self.src, self.dst]),
+            np.concatenate([self.dst, self.src]),
+            np.concatenate([self.weight, self.weight]),
+        )
+
     @staticmethod
     def from_edge_list(num_vertices: int, edges) -> "Graph":
         """edges: iterable of (src, dst) or (src, dst, weight)."""

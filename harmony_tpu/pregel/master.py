@@ -45,6 +45,8 @@ class PregelMaster:
         taskunit: Optional[Any] = None,
         job_id: str = "pregel",
     ) -> None:
+        if getattr(computation, "undirected", False):
+            graph = graph.undirected()
         self.graph = graph
         self.comp = computation
         self.mesh = mesh

@@ -223,3 +223,41 @@ class TestAsyncCheckpoint:
                         handle.block_manager.executors, table_id="drain-r")
         r.drop()
         handle.drop()
+
+
+class TestOrbaxInterop:
+    def test_roundtrip_any_topology(self, master, tmp_path):
+        from harmony_tpu.checkpoint.orbax_io import load_orbax, save_orbax
+
+        handle, vals = make_handle(master, n_exec=4, tid="orbax-t")
+        p = save_orbax(str(tmp_path / "ock"), handle)
+        # restore onto a DIFFERENT executor set size
+        exs2 = master.add_executors(2)
+        restored = load_orbax(p, master, [e.id for e in exs2],
+                              table_id="orbax-r")
+        np.testing.assert_allclose(
+            np.asarray(restored.table.pull_array()), vals
+        )
+        restored.drop()
+        handle.drop()
+
+    def test_shape_mismatch_rejected(self, master, tmp_path):
+        import orbax.checkpoint as ocp
+
+        from harmony_tpu.checkpoint.orbax_io import load_orbax, save_orbax
+
+        handle, _ = make_handle(master, tid="orbax-bad")
+        p = save_orbax(str(tmp_path / "ock2"), handle)
+        # corrupt: rewrite with wrong-shaped values
+        tree = ocp.PyTreeCheckpointer().restore(p)
+        tree["values"] = tree["values"][:-1]
+        import shutil
+
+        shutil.rmtree(p)
+        ocp.PyTreeCheckpointer().save(p, tree)
+        before = set(master.table_ids())
+        with pytest.raises(ValueError, match="do not match"):
+            load_orbax(p, master, handle.block_manager.executors,
+                       table_id="orbax-bad-r")
+        assert set(master.table_ids()) == before  # no orphan table
+        handle.drop()

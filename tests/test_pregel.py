@@ -81,3 +81,41 @@ class TestPageRank:
         for _ in range(30):
             r = 0.15 / V + 0.85 * M @ r
         np.testing.assert_allclose(ranks, r, atol=1e-4)
+
+
+class TestConnectedComponents:
+    def test_two_components(self, mesh8):
+        from harmony_tpu.apps.concomp import ConnectedComponentsComputation
+        from harmony_tpu.pregel.graph import Graph
+        from harmony_tpu.pregel.master import PregelMaster
+
+        # component A: 0-1-2 chain; component B: 3-4; isolated: 5
+        g = Graph.from_edge_list(
+            6, [(0, 1), (1, 0), (1, 2), (2, 1), (3, 4), (4, 3)]
+        )
+        result = PregelMaster(g, ConnectedComponentsComputation(), mesh8).run()
+        labels = result["vertex_values"][:, 0]
+        np.testing.assert_allclose(labels, [0, 0, 0, 3, 3, 5])
+
+    def test_directed_chain_propagates_min(self, mesh8):
+        """Weakly-directed edges still flood the min label forward."""
+        from harmony_tpu.apps.concomp import ConnectedComponentsComputation
+        from harmony_tpu.pregel.graph import Graph
+        from harmony_tpu.pregel.master import PregelMaster
+
+        g = Graph.from_edge_list(5, [(i, i + 1) for i in range(4)])
+        result = PregelMaster(g, ConnectedComponentsComputation(), mesh8).run()
+        np.testing.assert_allclose(result["vertex_values"][:, 0], [0] * 5)
+        assert result["supersteps"] <= 6
+
+    def test_reversed_chain_weak_components(self, mesh8):
+        """Edges pointing backward still form ONE weak component — the
+        master symmetrizes for undirected computations (HashMin would
+        otherwise only flood forward)."""
+        from harmony_tpu.apps.concomp import ConnectedComponentsComputation
+        from harmony_tpu.pregel.graph import Graph
+        from harmony_tpu.pregel.master import PregelMaster
+
+        g = Graph.from_edge_list(5, [(i + 1, i) for i in range(4)])
+        result = PregelMaster(g, ConnectedComponentsComputation(), mesh8).run()
+        np.testing.assert_allclose(result["vertex_values"][:, 0], [0] * 5)
