@@ -100,8 +100,9 @@ class PendingCheckpoint:
         it failed, else returns the checkpoint id."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"checkpoint {self.chkp_id} still writing")
-        if self._thread is not None:
-            self._thread.join()  # reap the writer thread
+        t = self._thread  # local capture: wait() may race with itself
+        if t is not None:
+            t.join()  # reap the writer thread (idempotent)
             self._thread = None
         if self._error is not None:
             raise self._error

@@ -69,7 +69,12 @@ def load_orbax(
                 f"checkpoint values {values.shape} do not match table "
                 f"({cfg.capacity}, {spec.value_shape})"
             )
-        handle.table.multi_put(list(range(cfg.capacity)), values)
+        # whole-table key-order write: write_all is a reshape for range
+        # tables and ONE scatter for hash tables — not per-key puts
+        handle.table.apply_step(
+            lambda arr, v: (jax.jit(spec.write_all)(arr, v), None),
+            values,
+        )
     except BaseException:
         handle.drop()  # no half-restored orphan tables
         raise

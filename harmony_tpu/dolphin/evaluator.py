@@ -89,6 +89,11 @@ class ModelChkpManager:
                     self.chkp_ids.remove(p.chkp_id)
         self._pending = still_pending
         if errors:
+            # A real writer failure outranks a timeout: the timeout's
+            # pending survives for a retry, the failure would be lost.
+            for e in errors:
+                if not isinstance(e, TimeoutError):
+                    raise e
             raise errors[0]
         return list(self.chkp_ids)
 

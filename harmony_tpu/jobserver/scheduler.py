@@ -78,9 +78,108 @@ class FifoExclusiveScheduler(JobScheduler):
             self._launch(nxt, list(self._executors))
 
 
+class CarveScheduler(JobScheduler):
+    """Mesh carving: every job gets a DISJOINT slice of the executor pool
+    (the BASELINE north-star sharing mode — jobs share the pod by slicing
+    the mesh, not by overlapping on every chip like ShareAll). Fair share
+    at arrival = pool // (running jobs + 1), floored at ``min_slice``;
+    arrivals that cannot get ``min_slice`` free executors queue FIFO, and
+    a finishing job returns its slice (launching queued jobs first)."""
+
+    def __init__(self, min_slice: int = 1, max_share: Optional[int] = None) -> None:
+        """``max_share`` caps any one job's slice — without it the FIRST
+        arrival's fair share is the whole idle pool and later jobs queue
+        behind it; set e.g. pool//2 to leave room for concurrent tenants."""
+        if min_slice < 1:
+            raise ValueError("min_slice must be >= 1")
+        if max_share is not None and max_share < min_slice:
+            raise ValueError("max_share must be >= min_slice")
+        self.min_slice = min_slice
+        self.max_share = max_share
+        self._lock = threading.Lock()
+        self._free: List[str] = []
+        self._slices: Dict[str, List[str]] = {}
+        self._queue: Deque[JobConfig] = deque()
+
+    def bind(self, executor_ids: List[str], launch: LaunchFn) -> None:
+        super().bind(executor_ids, launch)
+        self._free = list(executor_ids)
+
+    def _take_slice(self) -> Optional[List[str]]:
+        """Under the lock: carve the next job's slice or None to queue."""
+        share = max(
+            self.min_slice, len(self._executors) // (len(self._slices) + 1)
+        )
+        if self.max_share is not None:
+            share = min(share, self.max_share)
+        if len(self._free) < self.min_slice:
+            return None
+        take = self._free[: min(share, len(self._free))]
+        del self._free[: len(take)]
+        return take
+
+    def on_job_arrival(self, config: JobConfig) -> None:
+        with self._lock:
+            sl = self._take_slice()
+            if sl is None:
+                self._queue.append(config)
+                return
+            self._slices[config.job_id] = sl
+        self._launch(config, sl)
+
+    def on_job_finish(self, job_id: str) -> None:
+        launches = []
+        with self._lock:
+            known = set(self._executors)
+            # only still-provisioned executors return to the pool (some may
+            # have departed via on_resource_change while the job ran)
+            self._free.extend(
+                e for e in self._slices.pop(job_id, []) if e in known
+            )
+            while self._queue:
+                sl = self._take_slice()
+                if sl is None:
+                    break
+                cfg = self._queue.popleft()
+                self._slices[cfg.job_id] = sl
+                launches.append((cfg, sl))
+        for cfg, sl in launches:
+            self._launch(cfg, sl)
+
+    def on_resource_change(self, executor_ids: List[str]) -> None:
+        """Reconcile the free pool with the new executor set: departed
+        executors leave _free immediately (running jobs keep their slices
+        until they finish — a live re-carve is plan-engine territory), and
+        arrivals join _free, possibly unblocking the queue."""
+        launches = []
+        with self._lock:
+            super().on_resource_change(executor_ids)
+            known = set(executor_ids)
+            sliced = {e for sl in self._slices.values() for e in sl}
+            self._free = [e for e in self._free if e in known]
+            self._free.extend(
+                e for e in executor_ids
+                if e not in sliced and e not in self._free
+            )
+            while self._queue:
+                sl = self._take_slice()
+                if sl is None:
+                    break
+                cfg = self._queue.popleft()
+                self._slices[cfg.job_id] = sl
+                launches.append((cfg, sl))
+        for cfg, sl in launches:
+            self._launch(cfg, sl)
+
+    def slice_of(self, job_id: str) -> List[str]:
+        with self._lock:
+            return list(self._slices.get(job_id, []))
+
+
 _SCHEDULERS: Dict[str, type] = {
     "share_all": ShareAllScheduler,
     "fifo": FifoExclusiveScheduler,
+    "carve": CarveScheduler,
 }
 
 

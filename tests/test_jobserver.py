@@ -319,3 +319,93 @@ class TestFailureIsolation:
             assert server.state != "CLOSED"
         finally:
             server.shutdown(timeout=60)
+
+
+class TestCarveScheduler:
+    def test_disjoint_slices_and_queueing(self):
+        """Protocol-level (fake launch): slices are disjoint, arrivals
+        without min_slice free executors queue, finish re-launches."""
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        launched = {}
+        sched = CarveScheduler(min_slice=2)
+        sched.bind([f"e{i}" for i in range(8)],
+                   lambda cfg, exs: launched.__setitem__(cfg.job_id, exs))
+        sched.on_job_arrival(mlr_job("a"))
+        assert len(launched["a"]) == 8  # fair share at arrival = 8 // 1
+        sched.on_job_arrival(mlr_job("b"))
+        assert "b" not in launched  # pool exhausted -> queued
+        sched.on_job_finish("a")
+        assert len(launched["b"]) >= 2  # freed slice launches the queue
+        assert set(launched["b"]) <= {f"e{i}" for i in range(8)}
+
+    def test_fair_share_carving(self):
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        launched = {}
+        sched = CarveScheduler(min_slice=1)
+        sched.bind([f"e{i}" for i in range(8)],
+                   lambda cfg, exs: launched.__setitem__(cfg.job_id, exs))
+        # Drive arrivals while slices shrink: 8//1=8 for the first job, so
+        # use finish/arrive interleaving to observe carving at various loads
+        sched.on_job_arrival(mlr_job("a"))
+        sched.on_job_finish("a")
+        sched.on_job_arrival(mlr_job("b"))  # 8 free again
+        launched.clear()
+        sched.on_job_arrival(mlr_job("c"))  # 0 free -> queue
+        assert "c" not in launched
+        sched.on_job_finish("b")            # frees 8, c gets 8//1=8
+        assert len(launched["c"]) == 8
+        assert sorted(sched.slice_of("c")) == sorted(launched["c"])
+
+    def test_jobserver_integration_disjoint(self, devices):
+        """Two concurrent jobs under carve scheduling run on disjoint
+        executor slices and both complete with exact sums."""
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        sched = CarveScheduler(min_slice=4, max_share=4)
+        server = JobServer(8, scheduler=sched, device_pool=DevicePool(devices))
+        server.start()
+        try:
+            fa = server.submit(addvector_job("carve-a", workers=1, slack=0))
+            fb = server.submit(addvector_job("carve-b", workers=1, slack=0))
+            slices = {}
+            deadline = time.time() + 30
+            while time.time() < deadline and (
+                not sched.slice_of("carve-a") or not sched.slice_of("carve-b")
+            ):
+                time.sleep(0.05)
+            slices["a"] = set(sched.slice_of("carve-a"))
+            slices["b"] = set(sched.slice_of("carve-b"))
+            ra, rb = fa.result(timeout=120), fb.result(timeout=120)
+            assert slices["a"] and slices["b"] and not (slices["a"] & slices["b"])
+        finally:
+            server.shutdown(timeout=60)
+
+    def test_max_share_allows_concurrency(self):
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        launched = {}
+        sched = CarveScheduler(min_slice=2, max_share=4)
+        sched.bind([f"e{i}" for i in range(8)],
+                   lambda cfg, exs: launched.__setitem__(cfg.job_id, exs))
+        sched.on_job_arrival(mlr_job("a"))
+        sched.on_job_arrival(mlr_job("b"))
+        assert len(launched["a"]) == 4 and len(launched["b"]) == 4
+        assert not set(launched["a"]) & set(launched["b"])
+
+    def test_resource_change_reconciles_pool(self):
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        launched = {}
+        sched = CarveScheduler(min_slice=2, max_share=4)
+        sched.bind([f"e{i}" for i in range(8)],
+                   lambda cfg, exs: launched.__setitem__(cfg.job_id, exs))
+        sched.on_job_arrival(mlr_job("a"))           # takes e0..e3
+        # e4..e7 depart; e8..e9 arrive
+        sched.on_resource_change(launched["a"] + ["e8", "e9"])
+        sched.on_job_arrival(mlr_job("b"))
+        assert set(launched["b"]) == {"e8", "e9"}    # never the departed ones
+        sched.on_job_finish("a")                     # a's slice still known
+        sched.on_job_arrival(mlr_job("c"))
+        assert set(launched["c"]) <= set(launched["a"])
