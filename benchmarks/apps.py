@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Per-app throughput — BASELINE.md configs 1, 2, 3, 5 as single jobs.
+
+The repo-root ``bench.py`` measures config 4 (the headline: concurrent
+MLR+NMF+LDA under the multi-tenant JobServer). This file measures the
+remaining BASELINE configs individually so regressions localize to an
+app instead of hiding in the aggregate:
+
+  1. MLR — single job
+  2. NMF — single job
+  3. LDA — single job (sparse topic-word table)
+  5. Wide&Deep / FM (sparse embedding tables, keyed pulls)
+
+One JSON line per app: {"metric", "value" (samples/sec), "unit", ...}.
+Run: python benchmarks/apps.py [mlr|nmf|lda|fm|widedeep|all]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
+from harmony_tpu.jobserver.server import JobServer  # noqa: E402
+from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
+
+EPOCHS = 6
+BATCHES = 8
+
+
+def _sparse_jobs():
+    fm = JobConfig(
+        job_id="bench-fm", app_type="dolphin",
+        trainer="harmony_tpu.apps.widedeep:FMTrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            app_params={"vocab_size": 100_000, "num_slots": 16,
+                        "emb_dim": 16, "step_size": 0.1},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.widedeep:make_synthetic",
+              "data_args": {"n": 32768, "vocab_size": 100_000,
+                            "num_slots": 16}},
+    )
+    wd = JobConfig(
+        job_id="bench-widedeep", app_type="dolphin",
+        trainer="harmony_tpu.apps.widedeep:WideDeepTrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            app_params={"vocab_size": 100_000, "num_slots": 16,
+                        "emb_dim": 16, "hidden": 128, "step_size": 0.1},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.widedeep:make_synthetic",
+              "data_args": {"n": 32768, "vocab_size": 100_000,
+                            "num_slots": 16}},
+    )
+    return {"fm": (fm, EPOCHS * 32768), "widedeep": (wd, EPOCHS * 32768)}
+
+
+def run_single(config: JobConfig, total_examples: int) -> dict:
+    devices = jax.devices()
+    server = JobServer(num_executors=len(devices),
+                       device_pool=DevicePool(devices))
+    server.start()
+    try:
+        t0 = time.perf_counter()
+        server.submit(config).result(timeout=3600)
+        wall = time.perf_counter() - t0
+    finally:
+        server.shutdown(timeout=120)
+    return {
+        "metric": f"{config.job_id} throughput",
+        "value": round(total_examples / wall, 1),
+        "unit": "samples/sec",
+        "examples": total_examples,
+        "wall_sec": round(wall, 2),
+    }
+
+
+def main() -> None:
+    import bench
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    jobs, totals = bench.job_configs(1.0)
+    table = {c.job_id.removeprefix("bench-"): (c, totals[c.job_id])
+             for c in jobs}
+    table.update(_sparse_jobs())
+    names = list(table) if which == "all" else [which]
+    for name in names:
+        cfg, total = table[name]
+        print(json.dumps(run_single(cfg, total)))
+
+
+if __name__ == "__main__":
+    main()

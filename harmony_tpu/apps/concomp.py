@@ -24,6 +24,14 @@ class ConnectedComponentsComputation(Computation):
     undirected = True  # HashMin floods both ways (weak components)
 
     def initial_state(self, num_vertices: int) -> jnp.ndarray:
+        if num_vertices > 2 ** 24:
+            # labels ride float32 message tables; beyond 2^24 consecutive
+            # ids round together and distinct components merge SILENTLY —
+            # fail loudly instead.
+            raise ValueError(
+                f"{num_vertices} vertices exceed float32's exact-integer "
+                "range (2^24); shard the graph or widen the message dtype"
+            )
         return jnp.arange(num_vertices, dtype=jnp.float32)[:, None]
 
     def compute(self, superstep, state, msg, has_msg) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -1,7 +1,13 @@
 from harmony_tpu.checkpoint.manager import (
     CheckpointInfo,
     CheckpointManager,
+    CheckpointStillWriting,
     PendingCheckpoint,
 )
 
-__all__ = ["CheckpointManager", "CheckpointInfo", "PendingCheckpoint"]
+__all__ = [
+    "CheckpointManager",
+    "CheckpointInfo",
+    "CheckpointStillWriting",
+    "PendingCheckpoint",
+]

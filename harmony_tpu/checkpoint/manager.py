@@ -81,6 +81,12 @@ class CheckpointInfo:
         return CheckpointInfo(**d)
 
 
+class CheckpointStillWriting(TimeoutError):
+    """wait(timeout) expired while the writer is still running — distinct
+    from a writer that FAILED with a (generic) TimeoutError, so callers
+    can tell 'in flight, retry later' from 'dead'."""
+
+
 class PendingCheckpoint:
     """Handle for an in-flight async checkpoint (see
     CheckpointManager.checkpoint_async)."""
@@ -99,7 +105,9 @@ class PendingCheckpoint:
         """Block until the write finishes; raises the writer's exception if
         it failed, else returns the checkpoint id."""
         if not self._done.wait(timeout):
-            raise TimeoutError(f"checkpoint {self.chkp_id} still writing")
+            raise CheckpointStillWriting(
+                f"checkpoint {self.chkp_id} still writing"
+            )
         t = self._thread  # local capture: wait() may race with itself
         if t is not None:
             t.join()  # reap the writer thread (idempotent)
@@ -157,17 +165,23 @@ class CheckpointManager:
         tdir = os.path.join(self.temp_root, info.chkp_id)
         staging = tdir + ".writing"
         os.makedirs(staging)
-        keep = None
-        if info.sampling_ratio < 1.0:
-            keep = max(1, int(block_size * info.sampling_ratio))
-        # pop as we go: each device block is released right after its D2H
-        # transfer instead of pinning the whole snapshot until the end.
-        for bid in sorted(snap):
-            arr = np.asarray(snap.pop(bid))
-            _write_block(staging, bid, arr[:keep] if keep else arr)
-        with open(os.path.join(staging, "manifest.json"), "w") as f:
-            f.write(info.to_json())
-        os.rename(staging, tdir)
+        try:
+            keep = None
+            if info.sampling_ratio < 1.0:
+                keep = max(1, int(block_size * info.sampling_ratio))
+            # pop as we go: each device block is released right after its
+            # D2H transfer instead of pinning the snapshot until the end.
+            for bid in sorted(snap):
+                arr = np.asarray(snap.pop(bid))
+                _write_block(staging, bid, arr[:keep] if keep else arr)
+            with open(os.path.join(staging, "manifest.json"), "w") as f:
+                f.write(info.to_json())
+            os.rename(staging, tdir)
+        except BaseException:
+            # never leak an unreachable partial dir (list/delete filter
+            # '.writing', so nothing else could ever clean it up)
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
         if commit:
             self.commit(info.chkp_id)
 

@@ -14,7 +14,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from harmony_tpu.checkpoint.manager import CheckpointManager, PendingCheckpoint
+from harmony_tpu.checkpoint.manager import (
+    CheckpointManager,
+    CheckpointStillWriting,
+    PendingCheckpoint,
+)
 from harmony_tpu.dolphin.trainer import Trainer
 from harmony_tpu.runtime.master import ETMaster, TableHandle
 
@@ -80,7 +84,7 @@ class ModelChkpManager:
         for p in self._pending:
             try:
                 p.wait(timeout=timeout)
-            except TimeoutError as e:
+            except CheckpointStillWriting as e:
                 still_pending.append(p)  # in flight, not dead
                 errors.append(e)
             except BaseException as e:  # noqa: BLE001 - reported below
@@ -92,7 +96,7 @@ class ModelChkpManager:
             # A real writer failure outranks a timeout: the timeout's
             # pending survives for a retry, the failure would be lost.
             for e in errors:
-                if not isinstance(e, TimeoutError):
+                if not isinstance(e, CheckpointStillWriting):
                     raise e
             raise errors[0]
         return list(self.chkp_ids)

@@ -136,15 +136,22 @@ class CarveScheduler(JobScheduler):
             self._free.extend(
                 e for e in self._slices.pop(job_id, []) if e in known
             )
-            while self._queue:
-                sl = self._take_slice()
-                if sl is None:
-                    break
-                cfg = self._queue.popleft()
-                self._slices[cfg.job_id] = sl
-                launches.append((cfg, sl))
+            launches = self._drain_queue_locked()
         for cfg, sl in launches:
             self._launch(cfg, sl)
+
+    def _drain_queue_locked(self):
+        """Under the lock: carve slices for queued jobs while any fit;
+        returns the (config, slice) launches to fire outside the lock."""
+        launches = []
+        while self._queue:
+            sl = self._take_slice()
+            if sl is None:
+                break
+            cfg = self._queue.popleft()
+            self._slices[cfg.job_id] = sl
+            launches.append((cfg, sl))
+        return launches
 
     def on_resource_change(self, executor_ids: List[str]) -> None:
         """Reconcile the free pool with the new executor set: departed
@@ -161,13 +168,7 @@ class CarveScheduler(JobScheduler):
                 e for e in executor_ids
                 if e not in sliced and e not in self._free
             )
-            while self._queue:
-                sl = self._take_slice()
-                if sl is None:
-                    break
-                cfg = self._queue.popleft()
-                self._slices[cfg.job_id] = sl
-                launches.append((cfg, sl))
+            launches = self._drain_queue_locked()
         for cfg, sl in launches:
             self._launch(cfg, sl)
 

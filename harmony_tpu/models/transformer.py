@@ -54,6 +54,8 @@ class TransformerConfig:
     dtype: Any = jnp.float32        # activation dtype (bf16 on hardware)
     attn: str = "auto"              # "auto" | "flash" | "blockwise"
     sp_attn: str = "ring"           # sequence-parallel tier: "ring" | "a2a"
+    remat: bool = False             # rematerialize each layer's activations
+                                    # on the backward pass (HBM for FLOPs)
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -138,7 +140,8 @@ class TransformerLM:
         d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
         pos = pos_offset + jnp.arange(S)
         x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
-        for layer in params["layers"]:
+
+        def block(x, layer):
             xn = _norm(x, layer["ln1"].astype(cfg.dtype))
             qkv = xn @ layer["wqkv"].astype(cfg.dtype)          # [B, S, 3d]
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -147,8 +150,18 @@ class TransformerLM:
             o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
             x = x + o @ layer["wo"].astype(cfg.dtype)
             xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-            x = x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
+            return x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
                 @ layer["w2"].astype(cfg.dtype)
+
+        if cfg.remat:
+            # Per-layer rematerialization: the backward recomputes each
+            # block's activations instead of keeping them — activation HBM
+            # drops from O(n_layers * B * S * d) to O(B * S * d), bought
+            # with one extra forward pass of FLOPs (the MXU has headroom;
+            # HBM usually doesn't).
+            block = jax.checkpoint(block)
+        for layer in params["layers"]:
+            x = block(x, layer)
         x = _norm(x, params["ln_f"].astype(cfg.dtype))
         # Weight-tied readout, f32 logits for a stable softmax.
         return x.astype(jnp.float32) @ params["embed"].T

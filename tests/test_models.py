@@ -272,3 +272,19 @@ def test_config_rejects_unknown_sp_attn():
 
     with pytest.raises(ValueError, match="sp_attn"):
         dataclasses.replace(CFG, sp_attn="alltoall")
+
+
+def test_remat_same_loss_and_grads():
+    """remat=True changes memory scheduling, not math: identical loss and
+    gradients to the plain forward."""
+    import dataclasses
+
+    model = TransformerLM(CFG)
+    model_r = TransformerLM(dataclasses.replace(CFG, remat=True))
+    params = model.init(jax.random.PRNGKey(11))
+    tokens = jnp.asarray(make_lm_data(4, 32, CFG.vocab_size, seed=12))
+    l0, g0 = jax.value_and_grad(model.loss)(params, tokens)
+    l1, g1 = jax.value_and_grad(model_r.loss)(params, tokens)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
