@@ -25,12 +25,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+import bench  # noqa: E402
 from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
 from harmony_tpu.jobserver.server import JobServer  # noqa: E402
 from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
 
-EPOCHS = 6
-BATCHES = 8
+EPOCHS = bench.EPOCHS
+BATCHES = bench.BATCHES
 
 
 def _sparse_jobs():
@@ -60,7 +61,12 @@ def _sparse_jobs():
               "data_args": {"n": 32768, "vocab_size": 100_000,
                             "num_slots": 16}},
     )
-    return {"fm": (fm, EPOCHS * 32768), "widedeep": (wd, EPOCHS * 32768)}
+    # total = epochs x dataset size, derived from the config itself so a
+    # tuned data_args['n'] cannot silently skew the reported rate
+    return {
+        name: (cfg, cfg.params.num_epochs * cfg.user["data_args"]["n"])
+        for name, cfg in (("fm", fm), ("widedeep", wd))
+    }
 
 
 def run_single(config: JobConfig, total_examples: int) -> dict:
@@ -84,13 +90,13 @@ def run_single(config: JobConfig, total_examples: int) -> dict:
 
 
 def main() -> None:
-    import bench
-
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     jobs, totals = bench.job_configs(1.0)
     table = {c.job_id.removeprefix("bench-"): (c, totals[c.job_id])
              for c in jobs}
     table.update(_sparse_jobs())
+    if which != "all" and which not in table:
+        sys.exit(f"unknown app {which!r}; available: {sorted(table)} or 'all'")
     names = list(table) if which == "all" else [which]
     for name in names:
         cfg, total = table[name]

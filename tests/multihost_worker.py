@@ -1,0 +1,74 @@
+"""Worker process for the two-process multihost integration test.
+
+Launched twice by tests/test_multihost.py with JAX_PLATFORMS=cpu and 4
+virtual devices per process; the pair forms one jax.distributed job whose
+GLOBAL device list has 8 devices. Prints `RESULT <json>` for the parent
+to compare across processes.
+
+Usage: python multihost_worker.py <coordinator> <num_processes> <pid>
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from harmony_tpu.parallel import multihost
+
+    assert multihost.initialize_distributed(coordinator, nprocs, pid)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    assert multihost.process_count() == nprocs, multihost.process_count()
+    devices = multihost.global_devices()
+    assert len(devices) == 4 * nprocs, devices
+
+    # 1. a psum over the full global mesh (the DCN+ICI data plane)
+    mesh = multihost.global_mesh(data=len(devices))
+    total = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "data"),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P(),
+        )
+    )(np.ones((len(devices),), np.float32))
+    psum_val = float(np.asarray(total)[0])
+
+    # 2. one sequence-parallel LM train step over a (data=2, seq=4) global
+    # mesh — every process passes the SAME full token batch; jax shards it.
+    from harmony_tpu.models import TransformerConfig, TransformerLM, make_lm_data
+    from harmony_tpu.models.transformer import make_sp_train_step
+    from harmony_tpu.parallel import build_mesh
+
+    sp_mesh = build_mesh(devices, data=2, seq=4, model=1)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, max_seq=64, attn="blockwise")
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(make_lm_data(4, 64, cfg.vocab_size, seed=1))
+    step = make_sp_train_step(model, sp_mesh, learning_rate=0.1)
+    new_params, loss = step(params, tokens)
+    # params come back replicated: every process can read its local copy
+    first_leaf = np.asarray(
+        jax.tree.leaves(new_params)[0].addressable_data(0)
+    )
+
+    multihost.sync_global_devices("test-barrier")
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "psum": psum_val,
+        "loss": round(float(np.asarray(loss.addressable_data(0))), 6),
+        "leaf0": round(float(first_leaf.ravel()[0]), 6),
+    }))
+
+
+if __name__ == "__main__":
+    main()
