@@ -70,7 +70,7 @@ def _sparse_jobs():
 
 
 def run_single(config: JobConfig, total_examples: int) -> dict:
-    devices = jax.devices()
+    devices = bench._discover_devices()  # bounded: a wedged tunnel errors
     server = JobServer(num_executors=len(devices),
                        device_pool=DevicePool(devices))
     server.start()

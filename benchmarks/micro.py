@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import bench  # bounded device discovery (a wedged tunnel must error, not hang)
 from harmony_tpu.config import TableConfig
 from harmony_tpu.parallel import build_mesh
 from harmony_tpu.table import DenseTable, TableSpec
@@ -45,7 +46,7 @@ REPEATS = 10
 
 
 def _mesh():
-    devs = jax.devices()
+    devs = bench._discover_devices()
     data = 2 if len(devs) % 2 == 0 and len(devs) > 1 else 1
     return build_mesh(devs, data=data)
 
@@ -85,7 +86,7 @@ def bench_table() -> dict:
 
 def bench_reshard() -> dict:
     """Live re-sharding cost between two mesh layouts."""
-    devs = jax.devices()
+    devs = bench._discover_devices()
     if len(devs) < 2:
         return {"metric": "reshard bandwidth", "value": None,
                 "unit": "GB/s", "note": "needs >=2 devices"}
