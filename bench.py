@@ -29,11 +29,13 @@ except Exception:
     pass
 
 from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
+from harmony_tpu.utils.devices import discover_devices as _discover_devices  # noqa: E402
 from harmony_tpu.jobserver.server import JobServer  # noqa: E402
 from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
 
 EPOCHS = 6
 BATCHES = 8
+METRIC = "aggregate throughput, concurrent MLR+NMF+LDA (multi-tenant jobserver)"
 
 
 def job_configs(scale: float):
@@ -111,34 +113,12 @@ def run_concurrent(devices, scale: float) -> float:
     return rate
 
 
-def _discover_devices(timeout_s: float = 180.0):
-    """Bounded jax.devices(): the axon tunnel can wedge so badly that device
-    discovery never returns — emit a recordable error line instead of
-    hanging the whole bench run."""
-    import threading
-
-    out = {}
-
-    def probe():
-        try:
-            out["devices"] = jax.devices()
-        except Exception as e:  # pragma: no cover - backend-specific
-            out["error"] = repr(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" in out:
-        return out["devices"]
-    raise RuntimeError(out.get("error", f"device discovery hung >{timeout_s}s"))
-
-
 def main():
     try:
         accel = _discover_devices()
     except RuntimeError as e:
         print(json.dumps({
-            "metric": "aggregate throughput, concurrent MLR+NMF+LDA (multi-tenant jobserver)",
+            "metric": METRIC,
             "value": 0.0,
             "unit": "samples/sec",
             "vs_baseline": 0.0,
@@ -159,7 +139,7 @@ def main():
 
     vs = tpu_rate / cpu_rate if cpu_rate > 0 else 0.0
     print(json.dumps({
-        "metric": "aggregate throughput, concurrent MLR+NMF+LDA (multi-tenant jobserver)",
+        "metric": METRIC,
         "value": round(tpu_rate, 1),
         "unit": "samples/sec",
         "vs_baseline": round(vs, 2),

@@ -70,7 +70,7 @@ def _sparse_jobs():
 
 
 def run_single(config: JobConfig, total_examples: int) -> dict:
-    devices = bench._discover_devices()  # bounded: a wedged tunnel errors
+    devices = jax.devices()  # bounded probe already ran in main()
     server = JobServer(num_executors=len(devices),
                        device_pool=DevicePool(devices))
     server.start()
@@ -98,6 +98,15 @@ def main() -> None:
     if which != "all" and which not in table:
         sys.exit(f"unknown app {which!r}; available: {sorted(table)} or 'all'")
     names = list(table) if which == "all" else [which]
+    from harmony_tpu.utils.devices import discover_devices
+
+    try:
+        discover_devices()
+    except RuntimeError as e:
+        for name in names:
+            print(json.dumps({"metric": f"{name} throughput", "value": None,
+                              "error": f"accelerator unreachable: {e}"}))
+        return
     for name in names:
         cfg, total = table[name]
         print(json.dumps(run_single(cfg, total)))

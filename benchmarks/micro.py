@@ -37,16 +37,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import bench  # bounded device discovery (a wedged tunnel must error, not hang)
 from harmony_tpu.config import TableConfig
 from harmony_tpu.parallel import build_mesh
 from harmony_tpu.table import DenseTable, TableSpec
+from harmony_tpu.utils.devices import discover_devices
 
 REPEATS = 10
 
 
 def _mesh():
-    devs = bench._discover_devices()
+    devs = jax.devices()
     data = 2 if len(devs) % 2 == 0 and len(devs) > 1 else 1
     return build_mesh(devs, data=data)
 
@@ -86,7 +86,7 @@ def bench_table() -> dict:
 
 def bench_reshard() -> dict:
     """Live re-sharding cost between two mesh layouts."""
-    devs = bench._discover_devices()
+    devs = jax.devices()
     if len(devs) < 2:
         return {"metric": "reshard bandwidth", "value": None,
                 "unit": "GB/s", "note": "needs >=2 devices"}
@@ -167,7 +167,18 @@ SECTIONS = {
 
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which != "all" and which not in SECTIONS:
+        sys.exit(f"unknown section {which!r}; have {sorted(SECTIONS)} or 'all'")
     names = list(SECTIONS) if which == "all" else [which]
+    # ONE bounded probe up front: every section's first jax op would
+    # otherwise block forever on a wedged transport.
+    try:
+        discover_devices()
+    except RuntimeError as e:
+        for name in names:
+            print(json.dumps({"metric": name, "value": None,
+                              "error": f"accelerator unreachable: {e}"}))
+        return
     for name in names:
         print(json.dumps(SECTIONS[name]()))
 

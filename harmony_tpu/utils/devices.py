@@ -1,0 +1,32 @@
+"""Bounded device discovery.
+
+``jax.devices()`` initializes the backend on first call, and a wedged
+accelerator transport (e.g. a dead tunnel to a remote-attached chip) can
+make that initialization block forever. Benchmarks and tools that must
+produce a recordable result route discovery through this helper so a
+broken transport becomes an error, not a hang.
+"""
+from __future__ import annotations
+
+import threading
+
+
+def discover_devices(timeout_s: float = 180.0):
+    """``jax.devices()`` with a deadline; raises RuntimeError on a hang or
+    a backend initialization failure."""
+    import jax
+
+    out = {}
+
+    def probe():
+        try:
+            out["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover - backend-specific
+            out["error"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in out:
+        return out["devices"]
+    raise RuntimeError(out.get("error", f"device discovery hung >{timeout_s}s"))
