@@ -104,8 +104,12 @@ def main() -> None:
         discover_devices()
     except RuntimeError as e:
         for name in names:
-            print(json.dumps({"metric": f"{name} throughput", "value": None,
-                              "error": f"accelerator unreachable: {e}"}))
+            cfg, _ = table[name]
+            print(json.dumps({
+                "metric": f"{cfg.job_id} throughput",  # same key as success
+                "value": None, "unit": "samples/sec",
+                "error": f"accelerator unreachable: {e}",
+            }))
         return
     for name in names:
         cfg, total = table[name]

@@ -163,6 +163,14 @@ SECTIONS = {
     "attention": bench_attention,
     "multiget": bench_multiget,
 }
+# reported metric name + unit per section, so ERROR lines land in the same
+# metric series a success would (same keys a tracker would index on)
+SECTION_METRICS = {
+    "table": ("table pull+push bandwidth", "GB/s"),
+    "reshard": ("reshard bandwidth", "GB/s"),
+    "attention": ("flash attention speedup vs naive", "x"),
+    "multiget": ("host multi_get+multi_update", "keys/sec"),
+}
 
 
 def main() -> None:
@@ -176,7 +184,8 @@ def main() -> None:
         discover_devices()
     except RuntimeError as e:
         for name in names:
-            print(json.dumps({"metric": name, "value": None,
+            metric, unit = SECTION_METRICS[name]
+            print(json.dumps({"metric": metric, "value": None, "unit": unit,
                               "error": f"accelerator unreachable: {e}"}))
         return
     for name in names:

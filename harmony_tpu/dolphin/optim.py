@@ -18,7 +18,7 @@ from typing import Dict, Tuple
 
 import jax.numpy as jnp
 
-SLOTS = {"sgd": 0, "momentum": 1, "adam": 2}
+SLOTS = {"sgd": 0, "momentum": 1, "adagrad": 1, "rmsprop": 1, "adam": 2}
 
 
 def num_slots(name: str) -> int:
@@ -38,7 +38,9 @@ def apply(
     hyper: Dict[str, jnp.ndarray],
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Returns (new_params, new_m, new_v). ``hyper``: lr (required),
-    beta1/beta2/eps (adam, defaulted), mu (momentum, defaulted)."""
+    beta1/beta2/eps (adam, defaulted), mu (momentum), rho (rmsprop).
+    Slot-1 meaning per optimizer: momentum=velocity, adagrad=sum of
+    squared grads, rmsprop=EMA of squared grads."""
     lr = hyper["lr"]
     if name == "sgd":
         return params - lr * grads, m, v
@@ -46,6 +48,15 @@ def apply(
         mu = hyper.get("mu", 0.9)
         new_m = mu * m + grads
         return params - lr * new_m, new_m, v
+    if name == "adagrad":
+        eps = hyper.get("eps", 1e-8)
+        new_m = m + grads * grads
+        return params - lr * grads / (jnp.sqrt(new_m) + eps), new_m, v
+    if name == "rmsprop":
+        rho = hyper.get("rho", 0.9)
+        eps = hyper.get("eps", 1e-8)
+        new_m = rho * m + (1 - rho) * grads * grads
+        return params - lr * grads / (jnp.sqrt(new_m) + eps), new_m, v
     if name == "adam":
         b1 = hyper.get("beta1", 0.9)
         b2 = hyper.get("beta2", 0.999)

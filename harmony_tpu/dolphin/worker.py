@@ -257,15 +257,28 @@ class WorkerTasklet:
         stop = False
         global_batch_idx = 0
         epoch_losses: List[float] = []
+        from harmony_tpu.tracing import trace_span
+
         for epoch in range(self.starting_epoch, params.num_epochs):
             epoch_t0 = time.perf_counter()
-            if self._use_fused_epoch():
-                epoch_examples, last_metrics = self._run_fused_epoch(epoch)
-                global_batch_idx += self.data.num_mini_batches
-            else:
-                epoch_examples, last_metrics, global_batch_idx, stop = (
-                    self._run_batched_epoch(epoch, global_batch_idx)
-                )
+            with trace_span(
+                "dolphin.epoch",
+                job_id=self.job_id,
+                worker_id=self.ctx.worker_id,
+                epoch=epoch,
+                fused=self._use_fused_epoch(),
+            ) as span:
+                if self._use_fused_epoch():
+                    epoch_examples, last_metrics = self._run_fused_epoch(epoch)
+                    global_batch_idx += self.data.num_mini_batches
+                else:
+                    epoch_examples, last_metrics, global_batch_idx, stop = (
+                        self._run_batched_epoch(epoch, global_batch_idx)
+                    )
+                if epoch_examples == 0 and stop and span is not None:
+                    # stopped before any batch: "not an epoch at all" below,
+                    # so the span must not inflate per-epoch aggregates
+                    span.discard()
             if epoch_examples == 0 and stop:
                 break  # stopped before any batch: not an epoch at all
             self._finish_epoch(epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses)

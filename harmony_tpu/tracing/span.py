@@ -56,6 +56,11 @@ class Span:
     def annotate(self, key: str, value: Any) -> None:
         self.annotations[key] = value
 
+    def discard(self) -> None:
+        """Mark the span to be dropped at context exit (e.g. the work it
+        covers turned out not to have happened — an aborted epoch)."""
+        self._discarded = True
+
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
@@ -136,6 +141,11 @@ class Tracing:
         with self._lock:
             self._receivers.append(receiver)
         return receiver
+
+    def remove_receiver(self, receiver: SpanReceiver) -> None:
+        with self._lock:
+            if receiver in self._receivers:
+                self._receivers.remove(receiver)
 
     def emit(self, span: Span) -> None:
         with self._lock:
@@ -222,7 +232,8 @@ def trace_span(
     finally:
         _current.reset(token)
         span.stop_sec = time.time()
-        _tracing.emit(span)
+        if not getattr(span, "_discarded", False):
+            _tracing.emit(span)
 
 
 def wire_context() -> Optional[Dict[str, str]]:

@@ -144,7 +144,7 @@ class TestStatefulOptimizers:
         import pytest as _pytest
 
         with _pytest.raises(ValueError, match="unknown optimizer"):
-            TransformerTrainer(CFG, optimizer="adagrad")
+            TransformerTrainer(CFG, optimizer="lion")
 
     def test_optimizer_state_survives_checkpoint_restore(self, mesh8, tmp_path, devices):
         """Adam state rides the table: checkpoint -> restore -> keep

@@ -149,3 +149,42 @@ class TestDashboard:
             time.sleep(0.02)
         assert conn.errors >= 1  # swallowed, training path unaffected
         conn.close()
+
+
+class TestWorkerSpans:
+    def test_epoch_spans_emitted(self, mesh8):
+        """The worker hot loop emits one dolphin.epoch span per epoch with
+        job/worker/epoch annotations (the HTrace-style wiring, SURVEY §5.1)."""
+        from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+        from harmony_tpu.config.params import TrainerParams
+        from harmony_tpu.dolphin import (
+            TrainerContext,
+            TrainingDataProvider,
+            WorkerTasklet,
+        )
+        from harmony_tpu.table import DenseTable, TableSpec
+        from harmony_tpu.tracing import InMemorySpanReceiver, get_tracing
+
+        recv = get_tracing().add_receiver(InMemorySpanReceiver())
+        try:
+            trainer = MLRTrainer(2, 8, 4, step_size=0.5)
+            x, y = make_synthetic(64, 8, 2)
+            table = DenseTable(TableSpec(trainer.model_table_config()), mesh8)
+            w = WorkerTasklet(
+                "span-job",
+                TrainerContext(
+                    params=TrainerParams(num_epochs=3, num_mini_batches=2),
+                    model_table=table,
+                ),
+                trainer,
+                TrainingDataProvider([x, y], 2),
+                mesh8,
+            )
+            w.run()
+            spans = recv.by_description("dolphin.epoch")
+            assert len(spans) == 3
+            assert {s.annotations["epoch"] for s in spans} == {0, 1, 2}
+            assert all(s.annotations["job_id"] == "span-job" for s in spans)
+            assert all(s.duration_sec > 0 for s in spans)
+        finally:
+            get_tracing().remove_receiver(recv)
