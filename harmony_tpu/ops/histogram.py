@@ -6,9 +6,12 @@ does this with Java loops over instances; SURVEY.md §2.7). On TPU a scatter
 serialises, but a histogram is also a matmul: ``one_hot(ids)^T @ weights``
 — which runs on the 128x128 systolic array at full tilt.
 
-:func:`weighted_histogram` is the Pallas kernel: grid over tiles of N, each
-step builds the tile's one-hot on the fly in VMEM (never materialised in
-HBM) and accumulates the (bins, W) product into the revisited output block.
+:func:`weighted_histogram` is the Pallas kernel: grid over (W tiles, bin
+tiles, N tiles); each step builds its tile's one-hot on the fly in VMEM
+(never materialised in HBM) — *bins-major*, so the MXU contraction needs no
+transposed operand copy — and accumulates the (bins, W) product into the
+revisited output block. Tile sizes are clamped against a VMEM word budget
+so the kernel fits the scoped-VMEM limit (16 MB on v5e) at any input size.
 :func:`segment_sum` is the same op named for its other use — aggregating
 per-key push deltas by destination key (the table push path).
 
@@ -17,39 +20,67 @@ by tests to validate the kernel itself).
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_N = 1024
-DEFAULT_BLOCK_BINS = 2048
+DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_BINS = 512
+DEFAULT_BLOCK_W = 512
+
+# Budget for one grid step's VMEM working set, in f32 words. The step holds
+# the one-hot (bn x bb), double-buffered weight blocks (2 x bn x bw) and the
+# revisited output block (2 x bb x bw); ~6 MB keeps the whole set (plus
+# Mosaic scratch) comfortably inside the 16 MB scoped-VMEM limit on v5e.
+_VMEM_BUDGET_WORDS = 1_500_000
+_MIN_TILE = 128
 
 
-def _hist_kernel(ids_ref, w_ref, out_ref, *, block_n, block_bins):
-    """Grid (bins_tiles, n_tiles): each step folds one tile of N into one
-    tile of the bin space, so VMEM holds only (block_n, block_bins) one-hot
-    + (block_bins, W) output regardless of total histogram size."""
-    jb = pl.program_id(0)
-    i = pl.program_id(1)
+def _pick_tiles(bn: int, bb: int, bw: int) -> Tuple[int, int, int]:
+    """Shrink tile sizes until the step's working set fits the budget."""
+
+    def words(n: int, b: int, w: int) -> int:
+        return b * n + 2 * n * w + 2 * b * w
+
+    while words(bn, bb, bw) > _VMEM_BUDGET_WORDS:
+        if bb >= max(bn, bw) and bb > _MIN_TILE:
+            bb //= 2
+        elif bn >= bw and bn > _MIN_TILE:
+            bn //= 2
+        elif bw > _MIN_TILE:
+            bw //= 2
+        else:
+            break
+    return bn, bb, bw
+
+
+def _hist_kernel(ids_ref, w_ref, out_ref):
+    """Grid (w_tiles, bins_tiles, n_tiles), n innermost: each step folds one
+    tile of N into one (bin, W) output tile. The one-hot is built bins-major
+    — rows are tile-local bins, columns are examples — so the MXU contraction
+    is a plain (bb, bn) @ (bn, bw) with no transposed-operand copy (the
+    transpose copy is what blew the scoped-VMEM limit on v5e)."""
+    jb = pl.program_id(1)
+    i = pl.program_id(2)
 
     @pl.when(i == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    ids = ids_ref[:] - jb * block_bins                 # (bn, 1) int32, tile-local
-    bins = jax.lax.broadcasted_iota(jnp.int32, (block_n, block_bins), 1)
-    onehot = (ids == bins).astype(jnp.float32)         # (bn, block_bins)
-    # (block_bins, bn) @ (bn, W) on the MXU, accumulated across n tiles.
+    # Tile-local ids: the bin-tile size is the output block's row count (one
+    # source of truth — no kwarg that could drift from the BlockSpec).
+    ids = ids_ref[:] - jb * out_ref.shape[0]           # (1, bn) int32, tile-local
+    bins = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape[:1] + ids.shape[1:], 0)
+    onehot = (ids == bins).astype(jnp.float32)         # (bb, bn)
+    # (bb, bn) @ (bn, bw) on the MXU, accumulated across n tiles.
     # HIGHEST precision: default MXU f32 truncates multiplicands to bf16 —
     # fine for attention logits, not for histogram sums that feed split-gain
     # ratios; full-f32 passes keep the histogram bit-comparable to scatter.
     out_ref[:] += jax.lax.dot_general(
         onehot, w_ref[:].astype(jnp.float32),
-        (((0,), (0,)), ((), ())),
+        (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
@@ -66,6 +97,7 @@ def weighted_histogram(
     num_bins: int,
     block_n: int = DEFAULT_BLOCK_N,
     block_bins: int = DEFAULT_BLOCK_BINS,
+    block_w: int = DEFAULT_BLOCK_W,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """``out[b, w] = sum over i with ids[i]==b of weights[i, w]``.
@@ -79,35 +111,38 @@ def weighted_histogram(
     if interp and interpret is None:
         return _xla_histogram(ids, weights, num_bins)  # off-TPU fast path
     N, W = weights.shape
-    if N == 0:
+    if N == 0 or W == 0:
         # A zero-size grid would skip the kernel's i==0 init entirely and
-        # return an uninitialized buffer.
+        # return an uninitialized buffer (and W == 0 would zero the block
+        # size the pads divide by).
         return jnp.zeros((num_bins, W), jnp.float32)
     block_n = min(block_n, max(N, 8))
     block_bins = min(block_bins, num_bins)
+    block_w = min(block_w, W)
+    block_n, block_bins, block_w = _pick_tiles(block_n, block_bins, block_w)
     pad = (-N) % block_n
-    if pad:
+    pad_w = (-W) % block_w
+    if pad or pad_w:
+        # one pad for both axes (a second pad would copy the array twice);
         # padded ids = -1: match no bin
         ids = jnp.pad(ids, (0, pad), constant_values=-1)
-        weights = jnp.pad(weights, ((0, pad), (0, 0)))
+        weights = jnp.pad(weights, ((0, pad), (0, pad_w)))
         N += pad
+    Wp = W + pad_w
     pad_bins = (-num_bins) % block_bins
     nb = num_bins + pad_bins
-    kernel = functools.partial(
-        _hist_kernel, block_n=block_n, block_bins=block_bins
-    )
     out = pl.pallas_call(
-        kernel,
-        grid=(nb // block_bins, N // block_n),
+        _hist_kernel,
+        grid=(Wp // block_w, nb // block_bins, N // block_n),
         in_specs=[
-            pl.BlockSpec((block_n, 1), lambda jb, i: (i, 0)),
-            pl.BlockSpec((block_n, W), lambda jb, i: (i, 0)),
+            pl.BlockSpec((1, block_n), lambda jw, jb, i: (0, i)),
+            pl.BlockSpec((block_n, block_w), lambda jw, jb, i: (i, jw)),
         ],
-        out_specs=pl.BlockSpec((block_bins, W), lambda jb, i: (jb, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, W), jnp.float32),
+        out_specs=pl.BlockSpec((block_bins, block_w), lambda jw, jb, i: (jb, jw)),
+        out_shape=jax.ShapeDtypeStruct((nb, Wp), jnp.float32),
         interpret=interp,
-    )(ids.astype(jnp.int32)[:, None], weights)
-    return out[:num_bins] if pad_bins else out
+    )(ids.astype(jnp.int32)[None, :], weights)
+    return out[:num_bins, :W]
 
 
 def segment_sum(

@@ -140,6 +140,33 @@ def test_weighted_histogram_bins_tiling():
     np.testing.assert_allclose(out, expect, atol=1e-4)
 
 
+def test_weighted_histogram_w_tiling():
+    """W > block_w exercises the third grid dimension (all three tiled:
+    N, bins, W) with uneven padding on every axis."""
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 70, 333).astype(np.int32)
+    w = rng.normal(size=(333, 37)).astype(np.float32)
+    out = weighted_histogram(jnp.asarray(ids), jnp.asarray(w), 70,
+                             block_n=64, block_bins=32, block_w=16,
+                             interpret=True)
+    expect = np.zeros((70, 37), np.float32)
+    np.add.at(expect, ids, w)
+    assert out.shape == (70, 37)
+    np.testing.assert_allclose(out, expect, atol=1e-4)
+
+
+def test_histogram_tile_picker_respects_vmem_budget():
+    """Any input size must yield a working set under the scoped-VMEM budget
+    (the v5e limit is 16 MB; the kernel OOMed there before tiling W)."""
+    from harmony_tpu.ops.histogram import _VMEM_BUDGET_WORDS, _pick_tiles
+
+    for req in [(4096, 4096, 4096), (512, 2048, 256), (1024, 8192, 8192)]:
+        bn, bb, bw = _pick_tiles(*req)
+        words = bb * bn + 2 * bn * bw + 2 * bb * bw
+        assert words <= _VMEM_BUDGET_WORDS, (req, (bn, bb, bw), words)
+        assert min(bn, bb, bw) >= 8
+
+
 def test_segment_sum_empty_input():
     out = segment_sum(jnp.zeros((0, 4)), jnp.zeros((0,), jnp.int32), 16,
                       interpret=True)
