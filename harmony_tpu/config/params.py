@@ -104,6 +104,14 @@ class TrainerParams(ConfigBase):
     decay_period: int = 5
     num_trainer_threads: int = 1
     model_cache_enabled: bool = False
+    # Model-checkpoint chaining during training (ref: ModelChkpManager,
+    # dolphin/core/master/ModelChkpManager.java:40-80). 0 = disabled;
+    # N = snapshot the model table every N epochs.
+    model_chkp_period: int = 0
+    # Defer offline evaluation of the chained checkpoints to JobServer
+    # shutdown (ref: JobServerDriver graceful shutdown runs deferred model
+    # evaluation, JobServerDriver.java:178-214).
+    offline_model_eval: bool = False
     app_params: Dict[str, Any] = field(default_factory=dict)
 
 

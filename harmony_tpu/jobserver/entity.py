@@ -39,10 +39,13 @@ from harmony_tpu.runtime.taskunit import (
 
 
 class JobEntity:
-    """SPI: one instance per submitted job."""
+    """SPI: one instance per submitted job. ``chkp_root`` is where an app
+    type may durably stage model checkpoints (unused by apps that have no
+    model table to chain)."""
 
-    def __init__(self, config: JobConfig) -> None:
+    def __init__(self, config: JobConfig, chkp_root: Optional[str] = None) -> None:
         self.config = config
+        self.chkp_root = chkp_root
 
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
         raise NotImplementedError
@@ -53,6 +56,12 @@ class JobEntity:
     def cleanup(self) -> None:
         raise NotImplementedError
 
+    def deferred_evaluation(self):
+        """Optional: return a closure(master) the JobServer should run at
+        graceful shutdown (ref: deferred model evaluation,
+        JobServerDriver.java:178-214). Default: nothing deferred."""
+        return None
+
 
 class DolphinJobEntity(JobEntity):
     def __init__(
@@ -61,11 +70,15 @@ class DolphinJobEntity(JobEntity):
         global_taskunit: Optional[GlobalTaskUnitScheduler] = None,
         local_taskunit: Optional[LocalTaskUnitScheduler] = None,
         metric_sink=None,
+        chkp_root: Optional[str] = None,
     ) -> None:
-        super().__init__(config)
+        super().__init__(config, chkp_root)
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
         self._metric_sink = metric_sink
+        self._chkp_mgr = None
+        self._chkp_chain = None
+        self._chkp_dir: Optional[str] = None
         self._master: Optional[ETMaster] = None
         self._handle: Optional[TableHandle] = None
         self._local_handle: Optional[TableHandle] = None
@@ -137,6 +150,29 @@ class DolphinJobEntity(JobEntity):
         num_workers = cfg.num_workers or len(self._executor_ids)
         nb = params.num_mini_batches
         self.progress = BatchProgressTracker(nb)
+        # Model-checkpoint chaining (ref: ModelChkpManager wired by
+        # DolphinMaster.start:186-189): snapshots run off the CHIEF worker's
+        # epoch hook — one snapshot per job epoch, async writers.
+        epoch_hook = None
+        if params.model_chkp_period > 0:
+            import os
+            import tempfile
+
+            from harmony_tpu.checkpoint.manager import CheckpointManager
+            from harmony_tpu.dolphin.evaluator import ModelChkpManager
+
+            root = self.chkp_root or tempfile.mkdtemp(
+                prefix=f"harmony-chkp-{cfg.job_id}-"
+            )
+            self._chkp_dir = root
+            self._chkp_mgr = CheckpointManager(
+                os.path.join(root, cfg.job_id, "temp"),
+                os.path.join(root, cfg.job_id, "commit"),
+            )
+            self._chkp_chain = ModelChkpManager(
+                self._chkp_mgr, self._handle, period=params.model_chkp_period
+            )
+            epoch_hook = self._chkp_chain.on_epoch
         self._ctrl = (
             MiniBatchController(
                 params.clock_slack, params.num_epochs * nb, tracker=self.progress
@@ -197,6 +233,7 @@ class DolphinJobEntity(JobEntity):
                         self._ctrl.make_barrier(wid) if self._ctrl is not None else None
                     ),
                     taskunit=taskunit,
+                    epoch_callback=(epoch_hook if idx == 0 else None),
                     global_init=(idx == 0),
                     post_init_barrier=init_barrier.wait,
                 )
@@ -229,7 +266,70 @@ class DolphinJobEntity(JobEntity):
             self._global_tu.on_job_finish(cfg.job_id)
         if errors:
             raise errors[0]
-        return {"job_id": cfg.job_id, "workers": results}
+        out: Dict[str, Any] = {"job_id": cfg.job_id, "workers": results}
+        if self._chkp_chain is not None:
+            # Join the async snapshot writers before the dispatcher drops the
+            # table; the surviving ids are the replayable chain. A checkpoint
+            # problem must NOT fail a job whose training succeeded — record
+            # it as a warning and return the ids still considered live.
+            try:
+                out["model_chkp_ids"] = self._chkp_chain.drain()
+            except BaseException as e:  # noqa: BLE001 - demoted to warning
+                out["model_chkp_ids"] = list(self._chkp_chain.chkp_ids)
+                out["model_chkp_warning"] = f"{type(e).__name__}: {e}"
+            # The chain is a durable artifact (like the reference's
+            # HDFS-committed checkpoints): surface where it lives so callers
+            # can replay or delete it.
+            out["model_chkp_root"] = self._chkp_dir
+        return out
+
+    def deferred_evaluation(self):
+        """Return a closure replaying this job's checkpoint chain, or None.
+
+        Registered with the JobServer after a successful run; executed during
+        graceful shutdown (ref: JobServerDriver.java:178-214 — shutdown waits
+        for jobs, then runs the deferred model evaluation that
+        DolphinMaster.evaluate() performs over the ModelChkpManager chain).
+        Test data resolves lazily inside the closure (user.test_data_fn,
+        falling back to the training data) so nothing large is pinned between
+        job end and shutdown. Replayed checkpoints are deleted after
+        evaluation — the eval is the chain's consumer — so a long-lived
+        server doesn't accrete one model copy per epoch per job."""
+        if self._chkp_chain is None or not self.config.params.offline_model_eval:
+            return None
+        chkp_ids = list(self._chkp_chain.chkp_ids)
+        if not chkp_ids:
+            return None
+        cfg = self.config
+        mgr = self._chkp_mgr
+        trainer_factory = self._trainer_factory
+        executor_ids = list(self._executor_ids)
+        user = cfg.user
+
+        def run_eval(master: ETMaster) -> List[Dict[str, float]]:
+            from harmony_tpu.dolphin.evaluator import ModelEvaluator
+
+            # fn and args fall back TOGETHER: pairing a custom test_data_fn
+            # with the training data_args would call it with foreign kwargs.
+            if "test_data_fn" in user:
+                fn = resolve_symbol(user["test_data_fn"])
+                args = user.get("test_data_args", {})
+            else:
+                fn = resolve_symbol(user["data_fn"])
+                args = user.get("test_data_args", user.get("data_args", {}))
+            out = fn(**args)
+            batch = tuple(
+                np.asarray(a)
+                for a in (out if isinstance(out, (tuple, list)) else (out,))
+            )
+            metrics = ModelEvaluator(master, mgr).evaluate_checkpoints(
+                chkp_ids, trainer_factory(), batch, executor_ids
+            )
+            for cid in chkp_ids:  # consumed: reclaim the disk
+                mgr.delete(cid)
+            return metrics
+
+        return run_eval
 
     # -- teardown --------------------------------------------------------
 
@@ -265,8 +365,9 @@ class PregelJobEntity(JobEntity):
         global_taskunit: Optional[GlobalTaskUnitScheduler] = None,
         local_taskunit: Optional[LocalTaskUnitScheduler] = None,
         metric_sink=None,
+        chkp_root: Optional[str] = None,
     ) -> None:
-        super().__init__(config)
+        super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
         self._pregel_master = None

@@ -50,6 +50,7 @@ class JobServer:
         device_pool: Optional[DevicePool] = None,
         cpu_slots: int = 1,
         net_slots: int = 2,
+        chkp_root: Optional[str] = None,
     ) -> None:
         if isinstance(scheduler, str):
             scheduler = make_scheduler(scheduler)  # the -scheduler flag analogue
@@ -69,8 +70,13 @@ class JobServer:
         self.local_taskunit = LocalTaskUnitScheduler(cpu_slots, net_slots)
         self._scheduler = scheduler or ShareAllScheduler()
         self._num_executors = num_executors
+        self._chkp_root = chkp_root
         self._jobs: Dict[str, JobResult] = {}
         self._entities: Dict[str, JobEntity] = {}
+        # Deferred model evaluations, run during graceful shutdown (ref:
+        # JobServerDriver.java:178-214). job_id -> closure(master).
+        self._deferred_evals: Dict[str, Any] = {}
+        self.eval_results: Dict[str, Any] = {}
         self._dispatch_threads: List[threading.Thread] = []
         self._lock = threading.Lock()
         self._tcp_thread: Optional[threading.Thread] = None
@@ -121,10 +127,60 @@ class JobServer:
         with self._lock:
             threads = list(self._dispatch_threads)
         grace = time.monotonic() + 5.0
+        drained = True
         for t in threads:
             limit = grace if deadline is None else max(deadline, grace)
             t.join(timeout=max(0.0, limit - time.monotonic()))
+            if t.is_alive():
+                drained = False  # straggler still owns its executors
+        self._run_deferred_evals(timeout, drained)
         self._state.transition("CLOSED")
+
+    def _run_deferred_evals(self, timeout: Optional[float], drained: bool) -> None:
+        """The deferred-work stage of graceful shutdown (ref:
+        JobServerDriver.java:178-214: after the job drain, run the model
+        evaluations the Dolphin masters deferred). Failures are recorded per
+        job, never raised — shutdown must complete. The stage gets its own
+        ``timeout`` budget (shutdown is thus bounded by ~2x timeout): each
+        eval runs on a daemon thread and a slow one is abandoned with a
+        recorded error, so user eval code cannot hold shutdown hostage.
+        If the job drain itself timed out, evals are SKIPPED — stragglers
+        still occupy the executors the eval would restore tables onto."""
+        with self._lock:
+            evals = dict(self._deferred_evals)
+            self._deferred_evals.clear()
+        if not evals:
+            return
+        stage_deadline = None if timeout is None else time.monotonic() + timeout
+        for job_id, fn in evals.items():
+            if not drained:
+                self.eval_results[job_id] = {
+                    "error": "skipped: job drain timed out"
+                }
+                continue
+            box: Dict[str, Any] = {}
+
+            def call(fn=fn, box=box) -> None:
+                try:
+                    box["result"] = fn(self.master)
+                except Exception as e:  # noqa: BLE001 - recorded below
+                    box["error"] = f"{type(e).__name__}: {e}"
+
+            t = threading.Thread(
+                target=call, daemon=True, name=f"deferred-eval-{job_id}"
+            )
+            t.start()
+            remaining = (
+                None if stage_deadline is None
+                else max(0.0, stage_deadline - time.monotonic())
+            )
+            t.join(timeout=remaining)
+            if t.is_alive():
+                self.eval_results[job_id] = {"error": "timed out"}
+            elif "error" in box:
+                self.eval_results[job_id] = {"error": box["error"]}
+            else:
+                self.eval_results[job_id] = box["result"]
 
     @property
     def state(self) -> str:
@@ -177,11 +233,19 @@ class JobServer:
                 global_taskunit=self.global_taskunit,
                 local_taskunit=self.local_taskunit,
                 metric_sink=self.metrics.on_metric,
+                chkp_root=self._chkp_root,
             )
             with self._lock:
                 self._entities[config.job_id] = entity
             entity.setup(self.master, executor_ids)
             result = entity.run()
+            # Register the job's deferred model evaluation BEFORE cleanup
+            # drops its tables — the eval replays checkpoints from disk at
+            # shutdown, so it needs only the closure, not the tables.
+            deferred = entity.deferred_evaluation()
+            if deferred is not None:
+                with self._lock:
+                    self._deferred_evals[config.job_id] = deferred
             entity.cleanup()
             jr.future.set_result(result)
         except BaseException as e:  # noqa: BLE001 - delivered via future
@@ -246,7 +310,12 @@ class JobServer:
                     self.submit(config)
                     reply = {"ok": True, "job_id": config.job_id}
                 elif cmd == "STATUS":
-                    reply = {"ok": True, "state": self.state, "running": self.running_jobs()}
+                    reply = {
+                        "ok": True,
+                        "state": self.state,
+                        "running": self.running_jobs(),
+                        "evaluated": sorted(self.eval_results),
+                    }
                 elif cmd == "SHUTDOWN":
                     threading.Thread(target=self.shutdown, daemon=True).start()
                     reply = {"ok": True}

@@ -409,3 +409,64 @@ class TestCarveScheduler:
         sched.on_job_finish("a")                     # a's slice still known
         sched.on_job_arrival(mlr_job("c"))
         assert set(launched["c"]) <= set(launched["a"])
+
+
+class TestDeferredModelEval:
+    """Deferred model evaluation at graceful shutdown (ref: JobServerDriver
+    shutdown runs deferred evaluation over the ModelChkpManager chain,
+    JobServerDriver.java:178-214 + DolphinMaster.evaluate())."""
+
+    def _job(self, tmp_path, epochs=3):
+        cfg = mlr_job("eval-mlr", n=256, epochs=epochs, workers=1)
+        cfg.params.model_chkp_period = 1
+        cfg.params.offline_model_eval = True
+        return cfg
+
+    def test_chain_and_eval_at_shutdown(self, devices, tmp_path):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]),
+                           chkp_root=str(tmp_path))
+        server.start()
+        cfg = self._job(tmp_path, epochs=3)
+        res = server.submit(cfg).result(timeout=300)
+        assert len(res["model_chkp_ids"]) == 3  # one snapshot per epoch
+        assert "eval-mlr" not in server.eval_results  # deferred, not yet run
+        server.shutdown(timeout=300)
+        evals = server.eval_results["eval-mlr"]
+        assert isinstance(evals, list) and len(evals) == 3
+        # training progress is visible across the replayed chain: the last
+        # snapshot must beat the first on training-set loss
+        assert evals[-1]["loss"] < evals[0]["loss"]
+        assert all(np.isfinite(m["loss"]) for m in evals)
+        # replay consumes the chain: the disk is reclaimed
+        import os
+
+        root = os.path.join(str(tmp_path), "eval-mlr")
+        leftovers = [
+            d for sub in ("temp", "commit")
+            for d in os.listdir(os.path.join(root, sub))
+            if os.path.isdir(os.path.join(root, sub, d))
+        ]
+        assert leftovers == []
+
+    def test_no_chain_without_period(self, devices, tmp_path):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]),
+                           chkp_root=str(tmp_path))
+        server.start()
+        res = server.submit(mlr_job("plain", n=128, epochs=1, workers=1)).result(
+            timeout=300
+        )
+        assert "model_chkp_ids" not in res
+        server.shutdown(timeout=300)
+        assert server.eval_results == {}
+
+    def test_eval_failure_recorded_not_raised(self, devices, tmp_path):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]),
+                           chkp_root=str(tmp_path))
+        server.start()
+        cfg = self._job(tmp_path, epochs=1)
+        # break the deferred eval's data source AFTER training uses it: the
+        # test_data_fn path resolves lazily inside the closure
+        cfg.user["test_data_fn"] = "harmony_tpu.apps.mlr:no_such_fn"
+        server.submit(cfg).result(timeout=300)
+        server.shutdown(timeout=300)
+        assert "error" in server.eval_results["eval-mlr"]
