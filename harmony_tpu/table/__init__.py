@@ -2,8 +2,11 @@ from harmony_tpu.table.update import UpdateFunction, get_update_fn, register_upd
 from harmony_tpu.table.partition import BlockPartitioner, HashPartitioner, RangePartitioner
 from harmony_tpu.table.ownership import BlockManager
 from harmony_tpu.table.table import DenseTable, TableSpec
+from harmony_tpu.table.hashtable import DeviceHashTable, HashTableSpec
 
 __all__ = [
+    "DeviceHashTable",
+    "HashTableSpec",
     "UpdateFunction",
     "get_update_fn",
     "register_update_fn",

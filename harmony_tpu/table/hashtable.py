@@ -1,0 +1,471 @@
+"""DeviceHashTable — capacity-bounded device-resident hash table, TPU-first.
+
+The reference's tables are true KV stores: ``getOrInit`` admits ANY key on
+first touch and the table grows (services/et evaluator/api/Table.java:46-221,
+hash-partitioned by HashBasedBlockPartitioner). ``DenseTable`` reproduces
+that only for key domains small enough to preallocate ([0, capacity)).
+This module covers the other half — sparse, unbounded key domains (embedding
+ids, LDA word ids at web scale) — the way SURVEY.md §7.1 prescribes:
+"fixed-capacity hash tables in device memory with per-block ownership".
+
+Design (no reference analogue to translate — this is the TPU-native shape):
+
+  * Storage is a pair of dense arrays, ``slot_keys [num_blocks, block_slots]``
+    (int32, -1 = empty) and ``values [num_blocks, block_slots, *value_shape]``,
+    both sharded block-major over the mesh "model" axis exactly like
+    DenseTable storage — a block maps to a chip the way a reference block
+    maps to a server executor, so re-sharding/checkpointing reuse the same
+    block-granular machinery.
+  * A key hashes to its owning block (per-block ownership, ref:
+    HashBasedBlockPartitioner) and then double-hash probes WITHIN that
+    block's slots, so a key never leaves its owner chip: lookups gather,
+    inserts scatter, and XLA lowers the cross-shard traffic to collectives.
+  * Everything is functional and static-shaped: ``ensure`` resolves a whole
+    batch of keys in ``max_probes`` unrolled rounds of gather + masked
+    scatter + read-back (the read-back arbitrates same-slot races *within a
+    batch* — the winner is whoever the scatter kept; losers continue to
+    their next candidate). No data-dependent shapes, no host round-trips.
+  * Capacity is a hard bound: a key that exhausts its probe budget reports
+    ``ok=False`` (counted, never silently corrupted) — the analogue of the
+    reference's table running an executor out of heap, made observable.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.parallel.mesh import MODEL_AXIS
+from harmony_tpu.table.update import UpdateFunction, get_update_fn
+
+EMPTY = jnp.int32(-1)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _mix32(x: jnp.ndarray, seed: int) -> jnp.ndarray:
+    """Murmur3-style finalizer over uint32 (wrapping arithmetic)."""
+    x = x.astype(jnp.uint32) ^ jnp.uint32(seed)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+class HashTableSpec:
+    """Static description + pure on-device ops (safe inside any jit).
+
+    ``config.capacity`` is the total SLOT budget (rounded so each block holds
+    a power-of-two slot count — double-hash probing with an odd stride then
+    cycles the whole block). The key domain is any non-negative int32.
+    """
+
+    def __init__(
+        self,
+        config: TableConfig,
+        update_fn: Optional[UpdateFunction] = None,
+        max_probes: int = 16,
+    ):
+        self.config = config
+        self.update_fn = update_fn or get_update_fn(config.update_fn)
+        # TableConfig.__post_init__ already clamps num_blocks <= capacity —
+        # the config stays the single source of truth for block count.
+        self.num_blocks = config.num_blocks
+        self.block_slots = _next_pow2(
+            max(1, -(-config.capacity // self.num_blocks))
+        )
+        self.max_probes = min(max_probes, self.block_slots)
+        self.value_shape: Tuple[int, ...] = tuple(config.value_shape)
+        self.dtype = jnp.dtype(config.dtype)
+
+    @property
+    def table_id(self) -> str:
+        return self.config.table_id
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_slots
+
+    @property
+    def keys_shape(self) -> Tuple[int, int]:
+        return (self.num_blocks, self.block_slots)
+
+    @property
+    def values_shape(self) -> Tuple[int, ...]:
+        return (self.num_blocks, self.block_slots, *self.value_shape)
+
+    # -- hashing ---------------------------------------------------------
+
+    def _route(self, keys: jnp.ndarray):
+        """key -> (owning block, probe start, odd probe stride)."""
+        k = keys.astype(jnp.int32)
+        block = (_mix32(k, 0x9E3779B9) % jnp.uint32(self.num_blocks)).astype(
+            jnp.int32
+        )
+        start = (
+            _mix32(k, 0x7F4A7C15) % jnp.uint32(self.block_slots)
+        ).astype(jnp.int32)
+        # odd stride is coprime with the power-of-two block size, so the
+        # probe sequence visits every slot of the block
+        stride = (
+            (_mix32(k, 0x94D049BB) | jnp.uint32(1))
+            % jnp.uint32(self.block_slots)
+        ).astype(jnp.int32) | jnp.int32(1)
+        return block, start, stride
+
+    def _probe_slot(self, start, stride, r: int):
+        return (start + stride * r) % self.block_slots
+
+    # -- pure ops --------------------------------------------------------
+
+    def init_state(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Empty table: all slots EMPTY, values zeroed."""
+        return (
+            jnp.full(self.keys_shape, EMPTY, jnp.int32),
+            jnp.zeros(self.values_shape, self.dtype),
+        )
+
+    def _init_values(self, keys: jnp.ndarray) -> jnp.ndarray:
+        vals = jax.vmap(self.update_fn.init)(keys)
+        if vals.ndim == 1 and self.value_shape:
+            vals = jnp.broadcast_to(
+                vals.reshape(-1, *([1] * len(self.value_shape))),
+                (keys.shape[0], *self.value_shape),
+            )
+        return vals.astype(self.dtype)
+
+    def _one_writer_per_slot(self, block, slot, mask):
+        """Among batch entries with ``mask`` targeting (block, slot), keep
+        exactly one (the last by batch order — the reference's per-key
+        ordering makes the last duplicate win). Scatter-safe: a temp marker
+        array arbitrates, no masked scatter needed."""
+        order = jnp.arange(block.shape[0], dtype=jnp.int32)
+        marker = jnp.full(self.keys_shape, -1, jnp.int32)
+        marker = marker.at[block, slot].max(jnp.where(mask, order, -1))
+        return mask & (marker[block, slot] == order)
+
+    def ensure(
+        self, state: Tuple[jnp.ndarray, jnp.ndarray], keys: jnp.ndarray
+    ):
+        """getOrInit admission: resolve every key to a slot, inserting
+        missing keys (value = update_fn.init(key)).
+
+        Returns ``(new_state, (block, slot, ok))``; ``ok=False`` marks keys
+        that exhausted the probe budget (table effectively full for their
+        block) or are negative (invalid) — pulls for those yield init
+        values, pushes are dropped. Duplicate keys in the batch resolve to
+        the same slot; distinct keys racing for one empty slot are
+        arbitrated by a ``max`` scatter (EMPTY=-1 loses to any key) and a
+        read-back: losers continue to their next candidate next round.
+        """
+        slot_keys, values = state
+        keys = keys.astype(jnp.int32).reshape(-1)
+        valid = keys >= 0
+        block, start, stride = self._route(keys)
+        slot = jnp.full_like(keys, -1)
+        fresh = jnp.zeros_like(keys, dtype=jnp.bool_)
+        for r in range(self.max_probes):
+            cand = self._probe_slot(start, stride, r)
+            sk = slot_keys[block, cand]
+            need = valid & (slot < 0)
+            is_match = need & (sk == keys)
+            is_empty = need & (sk == EMPTY)
+            # Claim empty candidates via max-scatter: non-claimers write
+            # EMPTY (-1), a no-op against any occupied slot (keys >= 0), so
+            # there is no masked-scatter ordering hazard. Racing claimers
+            # resolve to the larger key; the read-back tells losers to
+            # continue probing.
+            slot_keys = slot_keys.at[block, cand].max(
+                jnp.where(is_empty, keys, EMPTY)
+            )
+            won = is_empty & (slot_keys[block, cand] == keys)
+            slot = jnp.where(is_match | won, cand, slot)
+            fresh = fresh | won
+        ok = valid & (slot >= 0)
+        safe_slot = jnp.maximum(slot, 0)
+        # Initialize freshly claimed slots. Never-claimed slots hold zeros
+        # (init_state; slots are never freed), so ONE additive write per
+        # slot realises init exactly; duplicates of the same new key are
+        # deduped first.
+        fresh = self._one_writer_per_slot(block, safe_slot, fresh)
+        init_v = self._init_values(keys)
+        vmask = fresh.reshape(-1, *([1] * len(self.value_shape)))
+        values = values.at[block, safe_slot].add(jnp.where(vmask, init_v, 0))
+        return (slot_keys, values), (block, safe_slot, ok)
+
+    def lookup(
+        self, state: Tuple[jnp.ndarray, jnp.ndarray], keys: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Read-only multiGet: values for present keys, init values for
+        absent ones (no insertion — the reference's ``get`` vs ``getOrInit``
+        distinction)."""
+        slot_keys, values = state
+        keys = keys.astype(jnp.int32).reshape(-1)
+        valid = keys >= 0
+        block, start, stride = self._route(keys)
+        slot = jnp.full_like(keys, -1)
+        for r in range(self.max_probes):
+            cand = self._probe_slot(start, stride, r)
+            sk = slot_keys[block, cand]
+            hit = valid & (slot < 0) & (sk == keys)
+            slot = jnp.where(hit, cand, slot)
+        found = valid & (slot >= 0)
+        got = values[block, jnp.maximum(slot, 0)]
+        init_v = self._init_values(keys)
+        mask = found.reshape(-1, *([1] * len(self.value_shape)))
+        return jnp.where(mask, got, init_v)
+
+    def pull(self, state, keys):
+        """getOrInit pull: admit + gather. Returns (new_state, vals, token);
+        pass the token to :meth:`push` to fold deltas for the same keys
+        without re-probing (the pull/push pair of one train step)."""
+        new_state, token = self.ensure(state, keys)
+        block, slot, ok = token
+        vals = new_state[1][block, slot]
+        init_v = self._init_values(keys.astype(jnp.int32).reshape(-1))
+        mask = ok.reshape(-1, *([1] * len(self.value_shape)))
+        return new_state, jnp.where(mask, vals, init_v), token
+
+    def _sentinel(self, kind: str):
+        info = (
+            jnp.finfo(self.dtype)
+            if jnp.issubdtype(self.dtype, jnp.floating)
+            else jnp.iinfo(self.dtype)
+        )
+        return jnp.asarray(info.max if kind == "max" else info.min, self.dtype)
+
+    def push(self, state, token, deltas: jnp.ndarray):
+        """multiUpdate at slots resolved by pull/ensure. Duplicate keys fold
+        per the update fn's scatter_mode; overflowed/invalid keys
+        (ok=False) are dropped. Every lowering is scatter-race-free: dropped
+        entries write the mode's identity (0 / ±sentinel), and set-mode is
+        realised as ONE exact additive write per slot — no masked ``.set``
+        whose duplicate ordering XLA could pick either way."""
+        slot_keys, values = state
+        block, slot, ok = token
+        deltas = deltas.astype(self.dtype)
+        mode = self.update_fn.scatter_mode
+        mask = ok.reshape(-1, *([1] * len(self.value_shape)))
+        ref = values.at[block, slot]
+        if mode == "add":
+            values = ref.add(jnp.where(mask, deltas, 0))
+        elif mode == "min":
+            values = ref.min(jnp.where(mask, deltas, self._sentinel("max")))
+        elif mode == "max":
+            values = ref.max(jnp.where(mask, deltas, self._sentinel("min")))
+        elif mode == "set":
+            # Last duplicate wins (ref: per-key op ordering). Exact-set in
+            # two race-free scatters: multiply the winning slot by 0 (mul is
+            # commutative — losers' x1 writes can land in any order), then
+            # add the winner's value. Exact for finite stored values (a
+            # stored ±inf would 0*inf -> nan; assign-mode inits are finite).
+            win = self._one_writer_per_slot(block, slot, ok)
+            wmask = win.reshape(-1, *([1] * len(self.value_shape)))
+            values = ref.multiply(
+                jnp.where(wmask, jnp.asarray(0, self.dtype),
+                          jnp.asarray(1, self.dtype))
+            )
+            values = values.at[block, slot].add(jnp.where(wmask, deltas, 0))
+        else:
+            raise ValueError(f"unknown scatter_mode {mode!r}")
+        if self.update_fn.post is not None:
+            # Writers to one slot must agree on the written value: apply the
+            # post-invariant exactly where some ok-writer touched the slot,
+            # computed per slot so dropped entries sharing a slot index
+            # write the identical value.
+            touched = jnp.zeros(self.keys_shape, jnp.int32)
+            touched = touched.at[block, slot].max(ok.astype(jnp.int32))
+            t = (touched[block, slot] > 0).reshape(
+                -1, *([1] * len(self.value_shape))
+            )
+            upd = values[block, slot]
+            values = values.at[block, slot].set(
+                jnp.where(t, self.update_fn.post(upd), upd)
+            )
+        return (slot_keys, values)
+
+
+class DeviceHashTable:
+    """Host-side handle: sharded state, serialized commits, re-sharding,
+    block export/import — the DenseTable facade for sparse key domains."""
+
+    def __init__(
+        self,
+        spec: HashTableSpec,
+        mesh: Mesh,
+        state: Optional[Tuple[jax.Array, jax.Array]] = None,
+    ):
+        self.spec = spec
+        self._lock = threading.RLock()
+        self._mesh = mesh
+        self._jit_cache: Dict[str, object] = {}
+        self._ksh, self._vsh = self._make_shardings(mesh)
+        if state is None:
+            sk, v = spec.init_state()
+            state = (
+                jax.device_put(sk, self._ksh),
+                jax.device_put(v, self._vsh),
+            )
+        self._state = state
+        self._dropped = False
+        # Cumulative keys dropped by probe-budget overflow / invalid keys —
+        # the "counted, never silent" contract for the host op surface.
+        self.overflow_count = 0
+
+    def _make_shardings(self, mesh: Mesh):
+        model = mesh.shape.get(MODEL_AXIS, 1)
+        if (
+            self.spec.num_blocks % max(model, 1) == 0
+            and MODEL_AXIS in mesh.axis_names
+        ):
+            sh = NamedSharding(mesh, P(MODEL_AXIS))
+        else:
+            # Fallback: replicate (tiny tables / indivisible block counts) —
+            # same policy as DenseTable._make_sharding.
+            sh = NamedSharding(mesh, P())
+        return sh, sh
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def state(self) -> Tuple[jax.Array, jax.Array]:
+        with self._lock:
+            self._check()
+            return self._state
+
+    def commit(self, new_state) -> None:
+        with self._lock:
+            self._check()
+            self._state = new_state
+
+    def apply_step(self, step_fn, *args):
+        """Run ``step_fn(state, *args) -> (new_state, out)`` and commit under
+        the table lock (same contract as DenseTable.apply_step: in-flight
+        steps see immutable snapshots; commits serialize)."""
+        with self._lock:
+            self._check()
+            new_state, out = step_fn(self._state, *args)
+            self._state = new_state
+            return out
+
+    def _check(self):
+        if self._dropped:
+            raise RuntimeError(f"table {self.spec.table_id} was dropped")
+
+    def _jitted(self, name: str, fn):
+        with self._lock:
+            if name not in self._jit_cache:
+                self._jit_cache[name] = jax.jit(fn)
+            return self._jit_cache[name]
+
+    # -- host op surface (ref: Table.java multiGet/multiUpdate/put) ------
+
+    def multi_get_or_init(self, keys: Sequence[int]) -> np.ndarray:
+        """getOrInit pull; keys the table cannot admit (probe budget
+        exhausted) read as init and bump :attr:`overflow_count`."""
+        k = jnp.asarray(list(keys), jnp.int32)
+
+        def step(state, kk):
+            new_state, vals, (_, _, ok) = self.spec.pull(state, kk)
+            return new_state, (vals, jnp.sum(~ok))
+
+        vals, dropped = self.apply_step(self._jitted("pull", step), k)
+        self.overflow_count += int(dropped)
+        return np.asarray(vals)
+
+    def multi_get(self, keys: Sequence[int]) -> np.ndarray:
+        k = jnp.asarray(list(keys), jnp.int32)
+        with self._lock:
+            self._check()
+            out = self._jitted("lookup", self.spec.lookup)(self._state, k)
+        return np.asarray(out)
+
+    def multi_update(self, keys: Sequence[int], deltas) -> int:
+        """multiUpdate; returns the number of keys DROPPED (0 when the
+        table admitted everything) and accumulates :attr:`overflow_count`."""
+        k = jnp.asarray(list(keys), jnp.int32)
+        d = jnp.asarray(deltas)
+
+        def step(state, kk, dd):
+            new_state, token = self.spec.ensure(state, kk)
+            ok = token[2]
+            return self.spec.push(new_state, token, dd), jnp.sum(~ok)
+
+        dropped = int(self.apply_step(self._jitted("update", step), k, d))
+        self.overflow_count += dropped
+        return dropped
+
+    def num_present(self) -> int:
+        """Occupied slots (host-visible fill metric for capacity planning)."""
+        with self._lock:
+            self._check()
+            return int(jnp.sum(self._state[0] != EMPTY))
+
+    # -- elasticity / checkpoint (block-granular, like DenseTable) -------
+
+    def reshard(self, new_mesh: Mesh) -> None:
+        """Live migration to a new mesh: one XLA resharding transfer under
+        the lock (ownership-first semantics collapse to the commit)."""
+        with self._lock:
+            self._check()
+            self._mesh = new_mesh
+            self._ksh, self._vsh = self._make_shardings(new_mesh)
+            self._state = (
+                jax.device_put(self._state[0], self._ksh),
+                jax.device_put(self._state[1], self._vsh),
+            )
+
+    def export_blocks(
+        self, block_ids: Optional[Sequence[int]] = None
+    ) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            self._check()
+            sk = np.asarray(self._state[0])
+            v = np.asarray(self._state[1])
+        ids = range(self.spec.num_blocks) if block_ids is None else block_ids
+        return {int(b): (sk[b], v[b]) for b in ids}
+
+    def import_blocks(
+        self, blocks: Dict[int, Tuple[np.ndarray, np.ndarray]]
+    ) -> None:
+        with self._lock:
+            self._check()
+            sk = np.asarray(self._state[0]).copy()
+            v = np.asarray(self._state[1]).copy()
+            for b, (bk, bv) in blocks.items():
+                sk[b] = bk
+                v[b] = bv
+            self._state = (
+                jax.device_put(jnp.asarray(sk), self._ksh),
+                jax.device_put(jnp.asarray(v), self._vsh),
+            )
+
+    def items(self) -> Dict[int, np.ndarray]:
+        """All present (key, value) pairs — test/debug surface."""
+        with self._lock:
+            self._check()
+            sk = np.asarray(self._state[0]).reshape(-1)
+            v = np.asarray(self._state[1]).reshape(-1, *self.spec.value_shape)
+        out = {}
+        for i in np.nonzero(sk >= 0)[0]:
+            out[int(sk[i])] = v[i]
+        return out
+
+    def drop(self) -> None:
+        with self._lock:
+            self._dropped = True
+            self._state = None

@@ -1,0 +1,252 @@
+"""DeviceHashTable — capacity-bounded sparse table over unbounded key
+domains (SURVEY.md §7.1 "fixed-capacity hash tables in device memory with
+per-block ownership"; the reference analogue is the hash-partitioned ET
+table whose getOrInit admits any key, evaluator/api/Table.java:46-221).
+
+Validated against a python dict reference model, including collision-heavy
+blocks, batch-internal races for empty slots, overflow accounting, sharded
+execution on the virtual mesh, and live resharding.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harmony_tpu.config import TableConfig
+from harmony_tpu.parallel import build_mesh
+from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+
+def make_table(devices, capacity=256, num_blocks=4, value_shape=(4,),
+               update_fn="add", max_probes=16, data=1, model=1):
+    cfg = TableConfig(
+        table_id="ht", capacity=capacity, value_shape=value_shape,
+        num_blocks=num_blocks, is_ordered=False, update_fn=update_fn,
+    )
+    spec = HashTableSpec(cfg, max_probes=max_probes)
+    mesh = build_mesh(devices[: data * model], data=data, model=model)
+    return DeviceHashTable(spec, mesh)
+
+
+def sparse_keys(rng, n, lo=0, hi=2**31 - 1):
+    """Keys drawn from the full int32 domain — the case DenseTable cannot
+    preallocate."""
+    return rng.choice(hi - lo, size=n, replace=False).astype(np.int32) + lo
+
+
+class TestBasicOps:
+    def test_insert_lookup_roundtrip(self, devices):
+        t = make_table(devices)
+        rng = np.random.default_rng(0)
+        keys = sparse_keys(rng, 60)
+        deltas = rng.standard_normal((60, 4)).astype(np.float32)
+        t.multi_update(keys, deltas)
+        got = t.multi_get(keys)
+        np.testing.assert_allclose(got, deltas, atol=1e-6)
+        assert t.num_present() == 60
+
+    def test_get_or_init_admits_and_persists(self, devices):
+        t = make_table(devices)
+        keys = [7, 123456789, 2**30 + 17]
+        vals = t.multi_get_or_init(keys)
+        np.testing.assert_allclose(vals, np.zeros((3, 4)))  # add-init = 0
+        assert t.num_present() == 3
+        t.multi_update(keys, np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(t.multi_get(keys), np.ones((3, 4)))
+
+    def test_lookup_does_not_insert(self, devices):
+        t = make_table(devices)
+        t.multi_get([5, 6, 7])
+        assert t.num_present() == 0  # get vs getOrInit distinction
+
+    def test_duplicate_keys_fold_additively(self, devices):
+        t = make_table(devices)
+        keys = np.asarray([42, 42, 42, 99], np.int32)
+        deltas = np.asarray(
+            [[1, 0, 0, 0], [2, 0, 0, 0], [3, 0, 0, 0], [5, 0, 0, 0]],
+            np.float32,
+        )
+        t.multi_update(keys, deltas)
+        got = t.multi_get([42, 99])
+        np.testing.assert_allclose(got[0], [6, 0, 0, 0])
+        np.testing.assert_allclose(got[1], [5, 0, 0, 0])
+        assert t.num_present() == 2  # duplicate new key inserted once
+
+    def test_accumulation_across_batches_matches_dict(self, devices):
+        t = make_table(devices, capacity=512, num_blocks=8)
+        rng = np.random.default_rng(1)
+        universe = sparse_keys(rng, 100)
+        model = {}
+        for _ in range(5):
+            idx = rng.integers(0, 100, 40)
+            keys = universe[idx]
+            deltas = rng.standard_normal((40, 4)).astype(np.float32)
+            t.multi_update(keys, deltas)
+            for k, d in zip(keys, deltas):
+                model[int(k)] = model.get(int(k), np.zeros(4, np.float32)) + d
+        items = t.items()
+        assert set(items) == set(model)
+        for k, v in model.items():
+            np.testing.assert_allclose(items[k], v, atol=1e-4)
+
+    def test_negative_keys_rejected(self, devices):
+        t = make_table(devices)
+        t.multi_update([-1, -5, 3], np.ones((3, 4), np.float32))
+        assert t.num_present() == 1  # only key 3 admitted
+        np.testing.assert_allclose(t.multi_get([3])[0], np.ones(4))
+
+
+class TestCollisionsAndOverflow:
+    def test_collision_heavy_single_block(self, devices):
+        """One block, load factor ~0.75, full probe budget: every key must
+        resolve (double hashing cycles the whole power-of-two block)."""
+        t = make_table(devices, capacity=64, num_blocks=1, max_probes=64)
+        rng = np.random.default_rng(2)
+        keys = sparse_keys(rng, 48)
+        deltas = rng.standard_normal((48, 4)).astype(np.float32)
+        t.multi_update(keys, deltas)
+        assert t.num_present() == 48
+        np.testing.assert_allclose(t.multi_get(keys), deltas, atol=1e-6)
+
+    def test_overflow_is_observable_not_corrupting(self, devices):
+        t = make_table(devices, capacity=16, num_blocks=1, max_probes=16)
+        rng = np.random.default_rng(3)
+        keys = sparse_keys(rng, 40)
+
+        state = t.state
+        new_state, (b, s, ok) = t.spec.ensure(
+            state, jnp.asarray(keys, jnp.int32)
+        )
+        ok = np.asarray(ok)
+        assert ok.sum() == 16  # exactly the slot budget admitted
+        t.commit(new_state)
+        # admitted keys still readable; dropped ones read as init
+        admitted = keys[ok]
+        got = t.multi_get(admitted)
+        assert np.isfinite(got).all()
+        assert t.num_present() == 16
+
+    def test_overflow_counted_on_host_surface(self, devices):
+        """multi_update/multi_get_or_init surface dropped keys — the
+        'counted, never silent' contract at the API callers actually use."""
+        t = make_table(devices, capacity=16, num_blocks=1, max_probes=16)
+        rng = np.random.default_rng(8)
+        keys = sparse_keys(rng, 40)
+        dropped = t.multi_update(keys, np.ones((40, 4), np.float32))
+        assert dropped == 40 - 16
+        assert t.overflow_count == dropped
+        t.multi_get_or_init(keys)  # the same 16 resolve; 24 drop again
+        assert t.overflow_count == 2 * dropped
+
+    def test_indivisible_blocks_fall_back_to_replication(self, devices):
+        """num_blocks not divisible by the mesh model axis must replicate
+        (DenseTable's fallback policy), not crash in device_put."""
+        t = make_table(devices, capacity=6, num_blocks=6, model=4)
+        t.multi_update([3, 9], np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(t.multi_get([3, 9]), np.ones((2, 4)))
+
+    def test_batch_race_for_one_empty_slot(self, devices):
+        """Distinct keys whose probe sequences collide must all land
+        somewhere (losers move to their next candidate)."""
+        t = make_table(devices, capacity=32, num_blocks=1, max_probes=32)
+        keys = np.arange(0, 24, dtype=np.int32) * 7919 + 13
+        t.multi_update(keys, np.ones((24, 4), np.float32))
+        assert t.num_present() == 24
+        np.testing.assert_allclose(t.multi_get(keys), np.ones((24, 4)))
+
+
+class TestUpdateModes:
+    def test_min_mode(self, devices):
+        t = make_table(devices, update_fn="min", value_shape=())
+        t.multi_update([10, 20, 10], np.asarray([5.0, 7.0, 3.0]))
+        got = t.multi_get([10, 20])
+        np.testing.assert_allclose(got, [3.0, 7.0])
+        t.multi_update([10], np.asarray([9.0]))  # larger: no-op
+        np.testing.assert_allclose(t.multi_get([10]), [3.0])
+
+    def test_assign_mode_last_wins(self, devices):
+        t = make_table(devices, update_fn="assign")
+        t.multi_update([5, 5], np.asarray(
+            [[1, 1, 1, 1], [2, 2, 2, 2]], np.float32))
+        np.testing.assert_allclose(t.multi_get([5])[0], [2, 2, 2, 2])
+        t.multi_update([5], np.full((1, 4), 9.0, np.float32))
+        np.testing.assert_allclose(t.multi_get([5])[0], [9, 9, 9, 9])
+
+    def test_assign_exact_across_magnitudes(self, devices):
+        """Set must be exact in float32 even when |cur| >> |new| (an
+        additive cur + (new - cur) lowering loses the small value)."""
+        t = make_table(devices, update_fn="assign", value_shape=())
+        t.multi_update([5], np.asarray([1e8], np.float32))
+        t.multi_update([5], np.asarray([1.0], np.float32))
+        np.testing.assert_array_equal(t.multi_get([5]), [1.0])
+
+    def test_post_invariant_only_on_touched(self, devices):
+        t = make_table(devices, update_fn="add_nonneg")
+        t.multi_update([1, 2], np.asarray(
+            [[1, 1, 1, 1], [2, 2, 2, 2]], np.float32))
+        t.multi_update([1], np.full((1, 4), -5.0, np.float32))
+        got = t.multi_get([1, 2])
+        np.testing.assert_allclose(got[0], np.zeros(4))  # clamped
+        np.testing.assert_allclose(got[1], np.full(4, 2.0))  # untouched
+
+
+class TestShardedAndElastic:
+    def test_sharded_ops_on_mesh(self, devices):
+        t = make_table(devices, capacity=1024, num_blocks=8, model=4, data=2)
+        rng = np.random.default_rng(4)
+        keys = sparse_keys(rng, 200)
+        deltas = rng.standard_normal((200, 4)).astype(np.float32)
+        t.multi_update(keys, deltas)
+        np.testing.assert_allclose(t.multi_get(keys), deltas, atol=1e-5)
+
+    def test_pull_push_inside_one_jitted_step(self, devices):
+        """The train-step pattern: pull (admitting), compute, push — one
+        compiled program, token reused so the push does not re-probe."""
+        t = make_table(devices, capacity=256, num_blocks=4, model=2)
+        spec = t.spec
+
+        @jax.jit
+        def step(state, keys, grads):
+            state, vals, token = spec.pull(state, keys)
+            new_vals_delta = -0.5 * grads + 0.0 * vals
+            state = spec.push(state, token, new_vals_delta)
+            return state, vals
+
+        rng = np.random.default_rng(5)
+        keys = jnp.asarray(sparse_keys(rng, 32), jnp.int32)
+        grads = jnp.asarray(rng.standard_normal((32, 4)), jnp.float32)
+        vals = t.apply_step(step, keys, grads)
+        np.testing.assert_allclose(np.asarray(vals), np.zeros((32, 4)))
+        np.testing.assert_allclose(
+            t.multi_get(np.asarray(keys)), -0.5 * np.asarray(grads), atol=1e-6
+        )
+
+    def test_reshard_preserves_contents(self, devices):
+        t = make_table(devices, capacity=512, num_blocks=8, model=4)
+        rng = np.random.default_rng(6)
+        keys = sparse_keys(rng, 120)
+        deltas = rng.standard_normal((120, 4)).astype(np.float32)
+        t.multi_update(keys, deltas)
+        t.reshard(build_mesh(devices[:2], data=1, model=2))
+        np.testing.assert_allclose(t.multi_get(keys), deltas, atol=1e-5)
+        t.multi_update(keys[:10], np.ones((10, 4), np.float32))
+        np.testing.assert_allclose(
+            t.multi_get(keys[:10]), deltas[:10] + 1.0, atol=1e-5
+        )
+
+    def test_export_import_blocks_roundtrip(self, devices):
+        t = make_table(devices, capacity=256, num_blocks=4)
+        rng = np.random.default_rng(7)
+        keys = sparse_keys(rng, 50)
+        deltas = rng.standard_normal((50, 4)).astype(np.float32)
+        t.multi_update(keys, deltas)
+        blocks = t.export_blocks()
+        t2 = make_table(devices, capacity=256, num_blocks=4)
+        t2.import_blocks(blocks)
+        np.testing.assert_allclose(t2.multi_get(keys), deltas, atol=1e-6)
+
+    def test_drop(self, devices):
+        t = make_table(devices)
+        t.drop()
+        with pytest.raises(RuntimeError):
+            t.multi_get([1])
