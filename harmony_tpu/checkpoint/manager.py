@@ -58,6 +58,31 @@ def _read_block(d: str, bid: int) -> np.ndarray:
     return np.load(os.path.join(d, f"{bid}.npy"))
 
 
+def _pack_hash_block(sk: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Hash-table block (slot_keys, values) -> ONE uint8 array for the
+    block codec: [int64 n_slots, int64 value_nbytes] + keys + value bytes.
+    The shapes/dtypes are reconstructed from the table config at restore."""
+    head = np.asarray([sk.shape[0], v.nbytes], np.int64).tobytes()
+    payload = head + np.ascontiguousarray(sk, np.int32).tobytes()
+    payload += np.ascontiguousarray(v).tobytes()
+    return np.frombuffer(payload, np.uint8)
+
+
+def _unpack_hash_block(raw: np.ndarray, spec) -> "tuple[np.ndarray, np.ndarray]":
+    buf = raw.tobytes()
+    n_slots, v_nbytes = np.frombuffer(buf[:16], np.int64)
+    if n_slots != spec.block_slots:
+        raise IOError(
+            f"hash block slot count {n_slots} != config {spec.block_slots}"
+        )
+    koff = 16 + int(n_slots) * 4
+    sk = np.frombuffer(buf[16:koff], np.int32)
+    v = np.frombuffer(buf[koff : koff + int(v_nbytes)], spec.dtype).reshape(
+        spec.block_slots, *spec.value_shape
+    )
+    return sk, v
+
+
 @dataclasses.dataclass
 class CheckpointInfo:
     chkp_id: str
@@ -138,6 +163,11 @@ class CheckpointManager:
         table lock is held for microseconds)."""
         if not (0.0 < sampling_ratio <= 1.0):
             raise ValueError(f"bad sampling_ratio {sampling_ratio}")
+        if handle.table.spec.config.sparse and sampling_ratio < 1.0:
+            raise ValueError(
+                "sampling is undefined for sparse (hash) tables: slot order "
+                "is not key order, so a prefix is not a sample"
+            )
         with self._lock:
             self._counter += 1
             chkp_id = f"{handle.table_id}-{self._counter}-{int(time.time() * 1000)}"
@@ -169,11 +199,18 @@ class CheckpointManager:
             keep = None
             if info.sampling_ratio < 1.0:
                 keep = max(1, int(block_size * info.sampling_ratio))
+            sparse = info.table_config.sparse
             # pop as we go: each device block is released right after its
             # D2H transfer instead of pinning the snapshot until the end.
             for bid in sorted(snap):
-                arr = np.asarray(snap.pop(bid))
-                _write_block(staging, bid, arr[:keep] if keep else arr)
+                item = snap.pop(bid)
+                if sparse:
+                    sk, v = item
+                    arr = _pack_hash_block(np.asarray(sk), np.asarray(v))
+                else:
+                    arr = np.asarray(item)
+                    arr = arr[:keep] if keep else arr
+                _write_block(staging, bid, arr)
             with open(os.path.join(staging, "manifest.json"), "w") as f:
                 f.write(info.to_json())
             os.rename(staging, tdir)
@@ -318,6 +355,9 @@ class CheckpointManager:
             blocks: Dict[int, np.ndarray] = {}
             for bid in info.block_ids:
                 arr = _read_block(d, bid)
+                if cfg.sparse:
+                    blocks[bid] = _unpack_hash_block(arr, spec)
+                    continue
                 if arr.shape[0] < spec.block_size:
                     # sampled: pad with the block's existing init values
                     full = np.array(handle.table.export_blocks([bid])[bid])

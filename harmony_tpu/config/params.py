@@ -36,6 +36,11 @@ class TableConfig(ConfigBase):
     is_ordered: bool = True            # range partitioner; False = hash
     is_mutable: bool = True
     update_fn: str = "add"             # name in table.update registry
+    # Sparse key domain: back the table with a capacity-bounded device hash
+    # table (DeviceHashTable) — getOrInit admits ANY non-negative int32 key,
+    # ``capacity`` bounds SLOTS, not the key domain. Dense tables
+    # (sparse=False) preallocate exactly [0, capacity).
+    sparse: bool = False
     # Optional bulk-load source (ref: FilePath / BulkDataLoader binding).
     input_path: Optional[str] = None
     parser: Optional[str] = None       # dotted path of DataParser

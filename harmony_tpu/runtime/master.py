@@ -217,8 +217,13 @@ class ETMaster:
                 raise ValueError("need at least one associator")
             devices = [self._executors[e].device for e in associators]
             mesh = _mesh_over(devices, data_axis)
-            table = DenseTable(TableSpec(config), mesh)
-            bm = BlockManager(config.table_id, TableSpec(config).num_blocks, associators)
+            if config.sparse:
+                from harmony_tpu.table.hashtable import DeviceHashTable, HashTableSpec
+
+                table = DeviceHashTable(HashTableSpec(config), mesh)
+            else:
+                table = DenseTable(TableSpec(config), mesh)
+            bm = BlockManager(config.table_id, table.spec.num_blocks, associators)
             handle = TableHandle(self, table, bm)
             self._tables[config.table_id] = handle
             self._data_axis[config.table_id] = data_axis

@@ -99,6 +99,11 @@ class HashTableSpec:
         return self.num_blocks * self.block_slots
 
     @property
+    def block_size(self) -> int:
+        """Slots per block (the checkpoint manager's per-block row count)."""
+        return self.block_slots
+
+    @property
     def keys_shape(self) -> Tuple[int, int]:
         return (self.num_blocks, self.block_slots)
 
@@ -146,15 +151,42 @@ class HashTableSpec:
             )
         return vals.astype(self.dtype)
 
+    def _slot_groups(self, block, slot, mask):
+        """Batch-local grouping of entries by target slot: O(B log B) sort,
+        no table-sized temporaries (a marker array would cost O(capacity)
+        HBM traffic per batch). Returns (perm, group_id, group_start) over
+        the linearized slot ids, with masked-out entries sorted last."""
+        lin = block * jnp.int32(self.block_slots) + slot
+        lin = jnp.where(mask, lin, jnp.iinfo(jnp.int32).max)
+        order = jnp.arange(block.shape[0], dtype=jnp.int32)
+        perm = jnp.lexsort((order, lin))
+        sl = lin[perm]
+        start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sl[1:] != sl[:-1]]
+        )
+        return perm, sl, start
+
     def _one_writer_per_slot(self, block, slot, mask):
         """Among batch entries with ``mask`` targeting (block, slot), keep
         exactly one (the last by batch order — the reference's per-key
-        ordering makes the last duplicate win). Scatter-safe: a temp marker
-        array arbitrates, no masked scatter needed."""
-        order = jnp.arange(block.shape[0], dtype=jnp.int32)
-        marker = jnp.full(self.keys_shape, -1, jnp.int32)
-        marker = marker.at[block, slot].max(jnp.where(mask, order, -1))
-        return mask & (marker[block, slot] == order)
+        ordering makes the last duplicate win)."""
+        perm, sl, start = self._slot_groups(block, slot, mask)
+        is_last = jnp.concatenate(
+            [sl[1:] != sl[:-1], jnp.ones((1,), jnp.bool_)]
+        )
+        win_sorted = is_last & (sl != jnp.iinfo(jnp.int32).max)
+        return jnp.zeros_like(mask).at[perm].set(win_sorted)
+
+    def _any_per_slot(self, block, slot, mask):
+        """Per entry: does ANY batch entry targeting the same slot have
+        ``mask`` set? (batch-local, same sort as _one_writer_per_slot)."""
+        perm, sl, start = self._slot_groups(block, slot, mask)
+        gid = jnp.cumsum(start.astype(jnp.int32)) - 1
+        seg = jax.ops.segment_max(
+            mask[perm].astype(jnp.int32), gid, num_segments=mask.shape[0]
+        )
+        out_sorted = seg[gid] > 0
+        return jnp.zeros_like(mask).at[perm].set(out_sorted)
 
     def ensure(
         self, state: Tuple[jnp.ndarray, jnp.ndarray], keys: jnp.ndarray
@@ -238,6 +270,30 @@ class HashTableSpec:
         mask = ok.reshape(-1, *([1] * len(self.value_shape)))
         return new_state, jnp.where(mask, vals, init_v), token
 
+    def _exact_set(self, values, block, slot, mask, new_vals):
+        """Exact overwrite at resolved slots. Last duplicate wins (ref:
+        per-key op ordering), realised as two race-free scatters: multiply
+        the winning slot by 0 (mul is commutative — losers' x1 writes can
+        land in any order), then add the winner's value. Exact for finite
+        stored values (a stored ±inf would 0*inf -> nan; assign-mode inits
+        are finite)."""
+        win = self._one_writer_per_slot(block, slot, mask)
+        wmask = win.reshape(-1, *([1] * len(self.value_shape)))
+        new_vals = new_vals.astype(self.dtype)
+        values = values.at[block, slot].multiply(
+            jnp.where(wmask, jnp.asarray(0, self.dtype),
+                      jnp.asarray(1, self.dtype))
+        )
+        return values.at[block, slot].add(jnp.where(wmask, new_vals, 0))
+
+    def put(self, state, token, values_in: jnp.ndarray):
+        """Overwrite-put at slots resolved by ensure — put/multiPut
+        semantics (ref: Table.java put), independent of the table's update
+        fn."""
+        slot_keys, values = state
+        block, slot, ok = token
+        return (slot_keys, self._exact_set(values, block, slot, ok, values_in))
+
     def _sentinel(self, kind: str):
         info = (
             jnp.finfo(self.dtype)
@@ -266,18 +322,7 @@ class HashTableSpec:
         elif mode == "max":
             values = ref.max(jnp.where(mask, deltas, self._sentinel("min")))
         elif mode == "set":
-            # Last duplicate wins (ref: per-key op ordering). Exact-set in
-            # two race-free scatters: multiply the winning slot by 0 (mul is
-            # commutative — losers' x1 writes can land in any order), then
-            # add the winner's value. Exact for finite stored values (a
-            # stored ±inf would 0*inf -> nan; assign-mode inits are finite).
-            win = self._one_writer_per_slot(block, slot, ok)
-            wmask = win.reshape(-1, *([1] * len(self.value_shape)))
-            values = ref.multiply(
-                jnp.where(wmask, jnp.asarray(0, self.dtype),
-                          jnp.asarray(1, self.dtype))
-            )
-            values = values.at[block, slot].add(jnp.where(wmask, deltas, 0))
+            values = self._exact_set(values, block, slot, ok, deltas)
         else:
             raise ValueError(f"unknown scatter_mode {mode!r}")
         if self.update_fn.post is not None:
@@ -285,9 +330,7 @@ class HashTableSpec:
             # post-invariant exactly where some ok-writer touched the slot,
             # computed per slot so dropped entries sharing a slot index
             # write the identical value.
-            touched = jnp.zeros(self.keys_shape, jnp.int32)
-            touched = touched.at[block, slot].max(ok.astype(jnp.int32))
-            t = (touched[block, slot] > 0).reshape(
+            t = self._any_per_slot(block, slot, ok).reshape(
                 -1, *([1] * len(self.value_shape))
             )
             upd = values[block, slot]
@@ -325,16 +368,9 @@ class DeviceHashTable:
         self.overflow_count = 0
 
     def _make_shardings(self, mesh: Mesh):
-        model = mesh.shape.get(MODEL_AXIS, 1)
-        if (
-            self.spec.num_blocks % max(model, 1) == 0
-            and MODEL_AXIS in mesh.axis_names
-        ):
-            sh = NamedSharding(mesh, P(MODEL_AXIS))
-        else:
-            # Fallback: replicate (tiny tables / indivisible block counts) —
-            # same policy as DenseTable._make_sharding.
-            sh = NamedSharding(mesh, P())
+        from harmony_tpu.table.table import block_sharding
+
+        sh = block_sharding(mesh, self.spec.num_blocks)
         return sh, sh
 
     @property
@@ -348,9 +384,21 @@ class DeviceHashTable:
             return self._state
 
     def commit(self, new_state) -> None:
+        """Install post-step state. If a reshard happened while the step was
+        in flight, the result still carries the OLD layout — re-home it so
+        the table never holds devices released back to the pool (same guard
+        as DenseTable.commit)."""
         with self._lock:
             self._check()
-            self._state = new_state
+            self._state = self._rehome(new_state)
+
+    def _rehome(self, state):
+        sk, v = state
+        if getattr(sk, "sharding", self._ksh) != self._ksh:
+            sk = jax.device_put(sk, self._ksh)
+        if getattr(v, "sharding", self._vsh) != self._vsh:
+            v = jax.device_put(v, self._vsh)
+        return (sk, v)
 
     def apply_step(self, step_fn, *args):
         """Run ``step_fn(state, *args) -> (new_state, out)`` and commit under
@@ -359,7 +407,7 @@ class DeviceHashTable:
         with self._lock:
             self._check()
             new_state, out = step_fn(self._state, *args)
-            self._state = new_state
+            self._state = self._rehome(new_state)
             return out
 
     def _check(self):
@@ -384,8 +432,12 @@ class DeviceHashTable:
             return new_state, (vals, jnp.sum(~ok))
 
         vals, dropped = self.apply_step(self._jitted("pull", step), k)
-        self.overflow_count += int(dropped)
+        self._count_dropped(int(dropped))
         return np.asarray(vals)
+
+    def _count_dropped(self, n: int) -> None:
+        with self._lock:  # read-add-store must not interleave across threads
+            self.overflow_count += n
 
     def multi_get(self, keys: Sequence[int]) -> np.ndarray:
         k = jnp.asarray(list(keys), jnp.int32)
@@ -406,8 +458,38 @@ class DeviceHashTable:
             return self.spec.push(new_state, token, dd), jnp.sum(~ok)
 
         dropped = int(self.apply_step(self._jitted("update", step), k, d))
-        self.overflow_count += dropped
+        self._count_dropped(dropped)
         return dropped
+
+    def multi_put(self, keys: Sequence[int], values) -> int:
+        """Bulk overwrite-put (the bulk-load path, ref: BulkDataLoader ->
+        table.multiPut); returns keys dropped by overflow."""
+        k = jnp.asarray(list(keys), jnp.int32)
+        v = jnp.asarray(values)
+
+        def step(state, kk, vv):
+            new_state, token = self.spec.ensure(state, kk)
+            return self.spec.put(new_state, token, vv), jnp.sum(~token[2])
+
+        dropped = int(self.apply_step(self._jitted("put", step), k, v))
+        self._count_dropped(dropped)
+        return dropped
+
+    def snapshot_blocks(
+        self, block_ids: Optional[Sequence[int]] = None
+    ) -> Dict[int, Tuple[jax.Array, jax.Array]]:
+        """Atomic device-side snapshot: per block, the (slot_keys, values)
+        pair — same contract as DenseTable.snapshot_blocks (nothing
+        transfers to host here; checkpoint writers pull bytes later)."""
+        ids = (
+            list(range(self.spec.num_blocks))
+            if block_ids is None
+            else list(block_ids)
+        )
+        with self._lock:
+            self._check()
+            sk, v = self._state
+            return {int(b): (sk[int(b)], v[int(b)]) for b in ids}
 
     def num_present(self) -> int:
         """Occupied slots (host-visible fill metric for capacity planning)."""

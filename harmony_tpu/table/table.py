@@ -53,6 +53,16 @@ from harmony_tpu.table.partition import (
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 
+def block_sharding(mesh: Mesh, num_blocks: int) -> NamedSharding:
+    """Placement policy for block-major table storage, shared by dense and
+    hash tables: shard the leading (block) axis over the mesh model axis
+    when divisible, else replicate (tiny tables / indivisible counts)."""
+    model = mesh.shape.get(MODEL_AXIS, 1)
+    if num_blocks % max(model, 1) == 0 and MODEL_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(MODEL_AXIS))
+    return NamedSharding(mesh, P())
+
+
 class TableSpec:
     """Static description of a table + its pure on-device ops.
 
@@ -250,11 +260,7 @@ class DenseTable:
     # -- layout ----------------------------------------------------------
 
     def _make_sharding(self, mesh: Mesh) -> NamedSharding:
-        model = mesh.shape.get(MODEL_AXIS, 1)
-        if self.spec.num_blocks % max(model, 1) == 0 and MODEL_AXIS in mesh.axis_names:
-            return NamedSharding(mesh, P(MODEL_AXIS))
-        # Fallback: replicate (tiny tables / indivisible block counts).
-        return NamedSharding(mesh, P())
+        return block_sharding(mesh, self.spec.num_blocks)
 
     @property
     def mesh(self) -> Mesh:

@@ -250,3 +250,74 @@ class TestShardedAndElastic:
         t.drop()
         with pytest.raises(RuntimeError):
             t.multi_get([1])
+
+
+class TestRuntimeIntegration:
+    """Sparse tables as first-class citizens of the runtime: created by the
+    ETMaster (TableConfig.sparse), migrated by TableHandle, checkpointed and
+    restored across topologies by the CheckpointManager."""
+
+    def _master(self, devices, n=4):
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime.master import ETMaster
+
+        m = ETMaster(DevicePool(devices[:n]))
+        m.add_executors(n)
+        return m
+
+    def _cfg(self, **kw):
+        base = dict(table_id="s-emb", capacity=256, value_shape=(4,),
+                    num_blocks=4, is_ordered=False, sparse=True)
+        base.update(kw)
+        return TableConfig(**base)
+
+    def test_master_creates_hash_table(self, devices):
+        from harmony_tpu.table import DeviceHashTable
+
+        m = self._master(devices)
+        h = m.create_table(self._cfg(), m.executor_ids(), data_axis=1)
+        assert isinstance(h.table, DeviceHashTable)
+        rng = np.random.default_rng(10)
+        keys = sparse_keys(rng, 40)
+        vals = rng.standard_normal((40, 4)).astype(np.float32)
+        h.table.multi_put(keys, vals)
+        np.testing.assert_allclose(h.table.multi_get(keys), vals, atol=1e-6)
+        # put overwrites (not folds), regardless of the add update fn
+        h.table.multi_put(keys[:5], np.zeros((5, 4), np.float32))
+        np.testing.assert_allclose(h.table.multi_get(keys[:5]), np.zeros((5, 4)))
+
+    def test_move_blocks_migrates_sparse_table(self, devices):
+        m = self._master(devices)
+        h = m.create_table(self._cfg(), m.executor_ids(), data_axis=1)
+        rng = np.random.default_rng(11)
+        keys = sparse_keys(rng, 60)
+        vals = rng.standard_normal((60, 4)).astype(np.float32)
+        h.table.multi_update(keys, vals)
+        ex = m.executor_ids()
+        h.move_blocks(ex[0], ex[1], 1)  # live migration
+        np.testing.assert_allclose(h.table.multi_get(keys), vals, atol=1e-6)
+
+    def test_checkpoint_restore_cross_topology(self, devices, tmp_path):
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        m = self._master(devices)
+        h = m.create_table(self._cfg(), m.executor_ids(), data_axis=1)
+        rng = np.random.default_rng(12)
+        keys = sparse_keys(rng, 80)
+        vals = rng.standard_normal((80, 4)).astype(np.float32)
+        h.table.multi_update(keys, vals)
+        mgr = CheckpointManager(str(tmp_path / "t"), str(tmp_path / "c"))
+        cid = mgr.checkpoint(h, commit=True)
+        # restore onto HALF the executors under a new id
+        h2 = mgr.restore(m, cid, m.executor_ids()[:2], table_id="s-emb2")
+        np.testing.assert_allclose(h2.table.multi_get(keys), vals, atol=1e-6)
+        assert h2.table.num_present() == 80
+
+    def test_sampling_rejected_for_sparse(self, devices, tmp_path):
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        m = self._master(devices)
+        h = m.create_table(self._cfg(), m.executor_ids(), data_axis=1)
+        mgr = CheckpointManager(str(tmp_path / "t"), str(tmp_path / "c"))
+        with pytest.raises(ValueError, match="sparse"):
+            mgr.checkpoint(h, sampling_ratio=0.5)
