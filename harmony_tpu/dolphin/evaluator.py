@@ -128,8 +128,22 @@ class ModelEvaluator:
                 self._master, cid, executor_ids, table_id=f"__eval__:{cid}"
             )
             try:
-                model = handle.table.pull_array()
-                metrics = eval_fn(model, tuple(map(np.asarray, test_batch)))
+                if handle.table.spec.config.sparse:
+                    # no full-model array exists over an unbounded key
+                    # domain: trainers provide a keyed-lookup evaluation
+                    sparse_eval = getattr(trainer, "evaluate_sparse", None)
+                    if sparse_eval is None:
+                        raise NotImplementedError(
+                            f"{type(trainer).__name__} has no "
+                            "evaluate_sparse(table, batch); required to "
+                            "evaluate a sparse (hash-backed) checkpoint"
+                        )
+                    metrics = sparse_eval(
+                        handle.table, tuple(map(np.asarray, test_batch))
+                    )
+                else:
+                    model = handle.table.pull_array()
+                    metrics = eval_fn(model, tuple(map(np.asarray, test_batch)))
                 out.append({k: float(v) for k, v in metrics.items()})
             finally:
                 handle.drop()

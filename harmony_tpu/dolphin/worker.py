@@ -128,6 +128,40 @@ class WorkerTasklet:
                 ), sync(metrics, new_arr)
 
             return _step
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
+        if isinstance(self.ctx.model_table, DeviceHashTable):
+            # Sparse model table: the keyed pull ADMITS new keys (getOrInit
+            # over an unbounded domain) and returns a slot token so the push
+            # folds at the resolved slots without re-probing — still one
+            # fused XLA program.
+            if trainer.pull_mode != "keys":
+                raise ValueError(
+                    "hash-backed model tables need pull_mode='keys' "
+                    "(pull_all over an unbounded key domain is undefined)"
+                )
+
+            replicated = NamedSharding(self.ctx.model_table.mesh, P())
+
+            def _step(state, batch, hyper):
+                # Keys MUST be replicated before they index the table: a
+                # data-sharded key vector of uneven per-shard length (batch
+                # ids + replicated reserved rows) makes XLA's SPMD
+                # partitioner pad the claim scatter, and the padded lanes
+                # write key 0 into slot (0,0) — a ghost admission.
+                keys = jax.lax.with_sharding_constraint(
+                    trainer.pull_keys(batch), replicated
+                )
+                state, model, token = spec.pull(state, keys)       # PULL
+                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
+                state = spec.push(state, token, delta)             # PUSH
+                # drops must never be silent: surfaced per batch, drained
+                # into table.overflow_count at epoch end (_emit_batch_metrics)
+                metrics = dict(metrics)
+                metrics["_dropped"] = jnp.sum(~token[2]).astype(jnp.float32)
+                return state, sync(metrics, state[1])
+
+            return _step
         if trainer.pull_mode == "all":
 
             def _step(arr, batch, hyper):
@@ -392,6 +426,15 @@ class WorkerTasklet:
         """Shared epoch-end drain: strip internal underscore-keys (_sync),
         emit one BatchMetrics per batch with the smeared time, and return
         the final batch's metrics as floats."""
+        if "_dropped" in host:
+            # keys the sparse table refused mid-training: fold into the
+            # table's cumulative overflow counter (never silent). "_dropped"
+            # is only emitted by the hash-table step, so the concrete type
+            # is known — no defensive getattr that could silently detach
+            # the counter.
+            n = int(np.sum(host["_dropped"]))
+            if n:
+                self.ctx.model_table.count_dropped(n)
         host = {k: v for k, v in host.items() if not k.startswith("_")}
         losses = host.get("loss", np.zeros(len(batch_sizes)))
         for b, n in enumerate(batch_sizes):
@@ -458,7 +501,15 @@ class WorkerTasklet:
     # -- evaluation (ref: ModelEvaluator over checkpointed models) -------
 
     def evaluate(self, batch: Tuple[np.ndarray, ...]) -> Dict[str, float]:
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
         table = self.ctx.model_table
+        if isinstance(table, DeviceHashTable):
+            raise NotImplementedError(
+                "full-model evaluate is undefined over an unbounded key "
+                "domain; evaluate a sparse model through its keyed pull "
+                "(trainer.compute-style) or train with a dense table"
+            )
         if self._eval_fn is None:
             self._eval_fn = jax.jit(self.trainer.evaluate)
         model = table.pull_array()

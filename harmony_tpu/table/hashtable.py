@@ -11,20 +11,26 @@ ids, LDA word ids at web scale) — the way SURVEY.md §7.1 prescribes:
 Design (no reference analogue to translate — this is the TPU-native shape):
 
   * Storage is a pair of dense arrays, ``slot_keys [num_blocks, block_slots]``
-    (int32, -1 = empty) and ``values [num_blocks, block_slots, *value_shape]``,
-    both sharded block-major over the mesh "model" axis exactly like
-    DenseTable storage — a block maps to a chip the way a reference block
-    maps to a server executor, so re-sharding/checkpointing reuse the same
-    block-granular machinery.
+    (int32; 0 = empty, a present key k is stored as ``-(k+2)``) and
+    ``values [num_blocks, block_slots, *value_shape]``, both sharded
+    block-major over the mesh "model" axis exactly like DenseTable storage —
+    a block maps to a chip the way a reference block maps to a server
+    executor, so re-sharding/checkpointing reuse the same block-granular
+    machinery.
   * A key hashes to its owning block (per-block ownership, ref:
     HashBasedBlockPartitioner) and then double-hash probes WITHIN that
     block's slots, so a key never leaves its owner chip: lookups gather,
     inserts scatter, and XLA lowers the cross-shard traffic to collectives.
   * Everything is functional and static-shaped: ``ensure`` resolves a whole
-    batch of keys in ``max_probes`` unrolled rounds of gather + masked
+    batch of keys in ``max_probes`` unrolled rounds of gather + claim
     scatter + read-back (the read-back arbitrates same-slot races *within a
     batch* — the winner is whoever the scatter kept; losers continue to
     their next candidate). No data-dependent shapes, no host round-trips.
+  * Every scatter is PAD-SAFE: claims are ``min`` over the negative stored
+    encoding and value writes are adds, so an update of 0 — what XLA's SPMD
+    partitioner pads uneven scatter operands with — is always the identity
+    (see the EMPTY_STORED comment). The table stays correct under any
+    sharding of the key/delta tensors inside a jitted SPMD step.
   * Capacity is a hard bound: a key that exhausts its probe budget reports
     ``ok=False`` (counted, never silently corrupted) — the analogue of the
     reference's table running an executor out of heap, made observable.
@@ -43,7 +49,32 @@ from harmony_tpu.config.params import TableConfig
 from harmony_tpu.parallel.mesh import MODEL_AXIS
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
-EMPTY = jnp.int32(-1)
+# Stored-key encoding: key k (MIN_KEY <= k <= MAX_KEY) is stored as -(k + 2);
+# EMPTY slots hold 0. Why: XLA's SPMD partitioner pads scatter operands
+# with ZEROS when their length doesn't divide the mesh axis evenly (e.g. a
+# batch's ids concatenated with replicated reserved keys), and a padded
+# lane writes its zero at index (0, 0). With EMPTY == 0 and every scatter
+# in this module lowered so that a 0-update is the identity (claims via
+# `min` against non-positive stored keys; value writes via `add`), padded
+# lanes are structurally no-ops — no ghost keys, no clobbered values,
+# under ANY sharding the partitioner picks.
+EMPTY_STORED = jnp.int32(0)
+MAX_KEY = 2**31 - 3  # -(k+2) must not wrap int32
+# Key 0 is RESERVED (valid keys are 1..MAX_KEY). XLA pads uneven sharded
+# tensors with zeros and the padded lanes flow through the WHOLE elementwise
+# chain like real elements — a pad lane therefore materializes as "key 0",
+# recomputing every derived value (route, encoding, claim update) as a
+# legitimate-looking key. Scatter-level identities can't catch that; the
+# only structural defense is that the pad value itself is an invalid key.
+MIN_KEY = 1
+
+
+def _encode_keys(keys: jnp.ndarray) -> jnp.ndarray:
+    return -(keys.astype(jnp.int32) + jnp.int32(2))
+
+
+def _decode_stored(sk: np.ndarray) -> np.ndarray:
+    return (-sk.astype(np.int64) - 2).astype(np.int32)
 
 
 def _next_pow2(n: int) -> int:
@@ -69,8 +100,18 @@ class HashTableSpec:
 
     ``config.capacity`` is the total SLOT budget (rounded so each block holds
     a power-of-two slot count — double-hash probing with an odd stride then
-    cycles the whole block). The key domain is any non-negative int32.
+    cycles the whole block). The key domain is int32 in [1, MAX_KEY] —
+    key 0 is reserved (see the MIN_KEY comment: it is XLA's pad value, so
+    a padded lane must be structurally invalid).
     """
+
+    # Blocks must hold enough slots for probing to work: a 1-2 slot block
+    # degrades max_probes to 1-2 and keys start dropping at tiny load
+    # factors. block_slots is floored (over-provisioning slots, never
+    # shrinking the block count): num_blocks stays EXACTLY config.num_blocks,
+    # so the configured block/mesh divisibility is preserved and the config
+    # remains the single source of truth for block count.
+    MIN_BLOCK_SLOTS = 32
 
     def __init__(
         self,
@@ -80,12 +121,10 @@ class HashTableSpec:
     ):
         self.config = config
         self.update_fn = update_fn or get_update_fn(config.update_fn)
-        # TableConfig.__post_init__ already clamps num_blocks <= capacity —
-        # the config stays the single source of truth for block count.
         self.num_blocks = config.num_blocks
-        self.block_slots = _next_pow2(
-            max(1, -(-config.capacity // self.num_blocks))
-        )
+        raw = _next_pow2(max(1, -(-config.capacity // config.num_blocks)))
+        floor = min(self.MIN_BLOCK_SLOTS, _next_pow2(config.capacity))
+        self.block_slots = max(raw, floor)
         self.max_probes = min(max_probes, self.block_slots)
         self.value_shape: Tuple[int, ...] = tuple(config.value_shape)
         self.dtype = jnp.dtype(config.dtype)
@@ -136,9 +175,9 @@ class HashTableSpec:
     # -- pure ops --------------------------------------------------------
 
     def init_state(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Empty table: all slots EMPTY, values zeroed."""
+        """Empty table: all slots EMPTY (0), values zeroed."""
         return (
-            jnp.full(self.keys_shape, EMPTY, jnp.int32),
+            jnp.zeros(self.keys_shape, jnp.int32),
             jnp.zeros(self.values_shape, self.dtype),
         )
 
@@ -149,7 +188,15 @@ class HashTableSpec:
                 vals.reshape(-1, *([1] * len(self.value_shape))),
                 (keys.shape[0], *self.value_shape),
             )
-        return vals.astype(self.dtype)
+        vals = vals.astype(self.dtype)
+        if jnp.issubdtype(self.dtype, jnp.floating):
+            # Stored values must stay finite: every write path is built from
+            # exact add-pairs (v + (-v) == 0 only for finite v), so +-inf
+            # inits (the "min"/"max" fns) clamp to the dtype's sentinels —
+            # semantically equivalent for fold purposes.
+            info = jnp.finfo(self.dtype)
+            vals = jnp.nan_to_num(vals, posinf=info.max, neginf=info.min)
+        return vals
 
     def _slot_groups(self, block, slot, mask):
         """Batch-local grouping of entries by target slot: O(B log B) sort,
@@ -175,7 +222,9 @@ class HashTableSpec:
             [sl[1:] != sl[:-1], jnp.ones((1,), jnp.bool_)]
         )
         win_sorted = is_last & (sl != jnp.iinfo(jnp.int32).max)
-        return jnp.zeros_like(mask).at[perm].set(win_sorted)
+        # un-permute by GATHER (inverse permutation), not scatter — gathers
+        # have no padded-lane write hazard
+        return win_sorted[jnp.argsort(perm)]
 
     def _any_per_slot(self, block, slot, mask):
         """Per entry: does ANY batch entry targeting the same slot have
@@ -186,7 +235,17 @@ class HashTableSpec:
             mask[perm].astype(jnp.int32), gid, num_segments=mask.shape[0]
         )
         out_sorted = seg[gid] > 0
-        return jnp.zeros_like(mask).at[perm].set(out_sorted)
+        return out_sorted[jnp.argsort(perm)]
+
+    def _fold_per_slot(self, block, slot, mask, deltas, mode: str):
+        """Per entry: the min/max fold of ALL ok-entries targeting its slot
+        (batch-local; masked entries contribute the fold's identity)."""
+        perm, sl, start = self._slot_groups(block, slot, mask)
+        gid = jnp.cumsum(start.astype(jnp.int32)) - 1
+        d = deltas.reshape(deltas.shape[0], -1)[perm]
+        seg = jax.ops.segment_min if mode == "min" else jax.ops.segment_max
+        folded = seg(d, gid, num_segments=mask.shape[0])[gid]
+        return folded[jnp.argsort(perm)].reshape(deltas.shape)
 
     def ensure(
         self, state: Tuple[jnp.ndarray, jnp.ndarray], keys: jnp.ndarray
@@ -196,15 +255,17 @@ class HashTableSpec:
 
         Returns ``(new_state, (block, slot, ok))``; ``ok=False`` marks keys
         that exhausted the probe budget (table effectively full for their
-        block) or are negative (invalid) — pulls for those yield init
-        values, pushes are dropped. Duplicate keys in the batch resolve to
-        the same slot; distinct keys racing for one empty slot are
-        arbitrated by a ``max`` scatter (EMPTY=-1 loses to any key) and a
+        block) or are out of domain — pulls for those yield init values,
+        pushes are dropped. Duplicate keys in the batch resolve to the same
+        slot; distinct keys racing for one empty slot are arbitrated by a
+        ``min`` scatter over the negative stored encoding (EMPTY=0 loses to
+        any stored key, and a padded lane's 0-write is the identity) and a
         read-back: losers continue to their next candidate next round.
         """
         slot_keys, values = state
         keys = keys.astype(jnp.int32).reshape(-1)
-        valid = keys >= 0
+        valid = (keys >= MIN_KEY) & (keys <= MAX_KEY)
+        enc = _encode_keys(keys)
         block, start, stride = self._route(keys)
         slot = jnp.full_like(keys, -1)
         fresh = jnp.zeros_like(keys, dtype=jnp.bool_)
@@ -212,17 +273,17 @@ class HashTableSpec:
             cand = self._probe_slot(start, stride, r)
             sk = slot_keys[block, cand]
             need = valid & (slot < 0)
-            is_match = need & (sk == keys)
-            is_empty = need & (sk == EMPTY)
-            # Claim empty candidates via max-scatter: non-claimers write
-            # EMPTY (-1), a no-op against any occupied slot (keys >= 0), so
-            # there is no masked-scatter ordering hazard. Racing claimers
-            # resolve to the larger key; the read-back tells losers to
+            is_match = need & (sk == enc)
+            is_empty = need & (sk == EMPTY_STORED)
+            # Claim via min-scatter on the negative encoding: non-claimers
+            # (and XLA's padded lanes) write 0 — the identity against both
+            # EMPTY (0) and any stored key (< 0). Racing claimers resolve
+            # to the smaller stored value; the read-back tells losers to
             # continue probing.
-            slot_keys = slot_keys.at[block, cand].max(
-                jnp.where(is_empty, keys, EMPTY)
+            slot_keys = slot_keys.at[block, cand].min(
+                jnp.where(is_empty, enc, EMPTY_STORED)
             )
-            won = is_empty & (slot_keys[block, cand] == keys)
+            won = is_empty & (slot_keys[block, cand] == enc)
             slot = jnp.where(is_match | won, cand, slot)
             fresh = fresh | won
         ok = valid & (slot >= 0)
@@ -245,13 +306,14 @@ class HashTableSpec:
         distinction)."""
         slot_keys, values = state
         keys = keys.astype(jnp.int32).reshape(-1)
-        valid = keys >= 0
+        valid = (keys >= MIN_KEY) & (keys <= MAX_KEY)
+        enc = _encode_keys(keys)
         block, start, stride = self._route(keys)
         slot = jnp.full_like(keys, -1)
         for r in range(self.max_probes):
             cand = self._probe_slot(start, stride, r)
             sk = slot_keys[block, cand]
-            hit = valid & (slot < 0) & (sk == keys)
+            hit = valid & (slot < 0) & (sk == enc)
             slot = jnp.where(hit, cand, slot)
         found = valid & (slot >= 0)
         got = values[block, jnp.maximum(slot, 0)]
@@ -270,20 +332,21 @@ class HashTableSpec:
         mask = ok.reshape(-1, *([1] * len(self.value_shape)))
         return new_state, jnp.where(mask, vals, init_v), token
 
-    def _exact_set(self, values, block, slot, mask, new_vals):
+    def _exact_set(self, values, block, slot, mask, new_vals, win=None):
         """Exact overwrite at resolved slots. Last duplicate wins (ref:
-        per-key op ordering), realised as two race-free scatters: multiply
-        the winning slot by 0 (mul is commutative — losers' x1 writes can
-        land in any order), then add the winner's value. Exact for finite
-        stored values (a stored ±inf would 0*inf -> nan; assign-mode inits
-        are finite)."""
-        win = self._one_writer_per_slot(block, slot, mask)
+        per-key op ordering), realised as two ADD scatters with one writer
+        per slot: add(-current) zeroes the slot exactly (v + (-v) == 0 for
+        finite v), then add(target) writes it exactly — and a 0-update
+        (losers, dropped entries, XLA's padded lanes) is the add identity,
+        so no scatter-ordering or padding hazard exists. Caveat: stored
+        values must be finite (inf - inf = nan); init values are clamped to
+        the dtype's sentinels for exactly this reason."""
+        if win is None:
+            win = self._one_writer_per_slot(block, slot, mask)
         wmask = win.reshape(-1, *([1] * len(self.value_shape)))
         new_vals = new_vals.astype(self.dtype)
-        values = values.at[block, slot].multiply(
-            jnp.where(wmask, jnp.asarray(0, self.dtype),
-                      jnp.asarray(1, self.dtype))
-        )
+        cur = values[block, slot]
+        values = values.at[block, slot].add(jnp.where(wmask, -cur, 0))
         return values.at[block, slot].add(jnp.where(wmask, new_vals, 0))
 
     def put(self, state, token, values_in: jnp.ndarray):
@@ -294,48 +357,43 @@ class HashTableSpec:
         block, slot, ok = token
         return (slot_keys, self._exact_set(values, block, slot, ok, values_in))
 
-    def _sentinel(self, kind: str):
-        info = (
-            jnp.finfo(self.dtype)
-            if jnp.issubdtype(self.dtype, jnp.floating)
-            else jnp.iinfo(self.dtype)
-        )
-        return jnp.asarray(info.max if kind == "max" else info.min, self.dtype)
-
     def push(self, state, token, deltas: jnp.ndarray):
         """multiUpdate at slots resolved by pull/ensure. Duplicate keys fold
         per the update fn's scatter_mode; overflowed/invalid keys
-        (ok=False) are dropped. Every lowering is scatter-race-free: dropped
-        entries write the mode's identity (0 / ±sentinel), and set-mode is
-        realised as ONE exact additive write per slot — no masked ``.set``
-        whose duplicate ordering XLA could pick either way."""
+        (ok=False) are dropped. Every lowering bottoms out in ADD scatters
+        (identity 0), so dropped entries, duplicate-write ordering, and
+        XLA's padded lanes are all structural no-ops: add folds directly;
+        min/max pre-fold the batch per slot (segment fold) and then ONE
+        writer per slot applies the combined result as an exact set; set
+        is the exact-set pair itself."""
         slot_keys, values = state
         block, slot, ok = token
         deltas = deltas.astype(self.dtype)
         mode = self.update_fn.scatter_mode
         mask = ok.reshape(-1, *([1] * len(self.value_shape)))
-        ref = values.at[block, slot]
         if mode == "add":
-            values = ref.add(jnp.where(mask, deltas, 0))
-        elif mode == "min":
-            values = ref.min(jnp.where(mask, deltas, self._sentinel("max")))
-        elif mode == "max":
-            values = ref.max(jnp.where(mask, deltas, self._sentinel("min")))
+            values = values.at[block, slot].add(jnp.where(mask, deltas, 0))
+        elif mode in ("min", "max"):
+            folded = self._fold_per_slot(block, slot, ok, deltas, mode)
+            cur = values[block, slot]
+            comb = (
+                jnp.minimum(cur, folded) if mode == "min"
+                else jnp.maximum(cur, folded)
+            )
+            values = self._exact_set(values, block, slot, ok, comb)
         elif mode == "set":
             values = self._exact_set(values, block, slot, ok, deltas)
         else:
             raise ValueError(f"unknown scatter_mode {mode!r}")
         if self.update_fn.post is not None:
-            # Writers to one slot must agree on the written value: apply the
-            # post-invariant exactly where some ok-writer touched the slot,
-            # computed per slot so dropped entries sharing a slot index
-            # write the identical value.
-            t = self._any_per_slot(block, slot, ok).reshape(
-                -1, *([1] * len(self.value_shape))
-            )
+            # Apply the post-invariant exactly where some ok-writer touched
+            # the slot; one writer per touched slot performs an exact
+            # add-pair set (padded lanes again add 0).
+            touched = self._any_per_slot(block, slot, ok)
+            win = self._one_writer_per_slot(block, slot, touched)
             upd = values[block, slot]
-            values = values.at[block, slot].set(
-                jnp.where(t, self.update_fn.post(upd), upd)
+            values = self._exact_set(
+                values, block, slot, touched, self.update_fn.post(upd), win=win
             )
         return (slot_keys, values)
 
@@ -376,6 +434,13 @@ class DeviceHashTable:
     @property
     def mesh(self) -> Mesh:
         return self._mesh
+
+    @property
+    def sharding(self):
+        """(keys, values) shardings — the layout identity rebuild checks
+        compare (changes exactly when a reshard moved the table)."""
+        with self._lock:
+            return (self._ksh, self._vsh)
 
     @property
     def state(self) -> Tuple[jax.Array, jax.Array]:
@@ -432,10 +497,13 @@ class DeviceHashTable:
             return new_state, (vals, jnp.sum(~ok))
 
         vals, dropped = self.apply_step(self._jitted("pull", step), k)
-        self._count_dropped(int(dropped))
+        self.count_dropped(int(dropped))
         return np.asarray(vals)
 
-    def _count_dropped(self, n: int) -> None:
+    def count_dropped(self, n: int) -> None:
+        """Fold externally-observed drops (e.g. a fused train step's
+        per-batch ok-mask) into :attr:`overflow_count` — the public half of
+        the 'counted, never silent' contract. Thread-safe."""
         with self._lock:  # read-add-store must not interleave across threads
             self.overflow_count += n
 
@@ -458,7 +526,7 @@ class DeviceHashTable:
             return self.spec.push(new_state, token, dd), jnp.sum(~ok)
 
         dropped = int(self.apply_step(self._jitted("update", step), k, d))
-        self._count_dropped(dropped)
+        self.count_dropped(dropped)
         return dropped
 
     def multi_put(self, keys: Sequence[int], values) -> int:
@@ -472,7 +540,7 @@ class DeviceHashTable:
             return self.spec.put(new_state, token, vv), jnp.sum(~token[2])
 
         dropped = int(self.apply_step(self._jitted("put", step), k, v))
-        self._count_dropped(dropped)
+        self.count_dropped(dropped)
         return dropped
 
     def snapshot_blocks(
@@ -495,7 +563,7 @@ class DeviceHashTable:
         """Occupied slots (host-visible fill metric for capacity planning)."""
         with self._lock:
             self._check()
-            return int(jnp.sum(self._state[0] != EMPTY))
+            return int(jnp.sum(self._state[0] < 0))  # stored keys are < 0
 
     # -- elasticity / checkpoint (block-granular, like DenseTable) -------
 
@@ -543,8 +611,8 @@ class DeviceHashTable:
             sk = np.asarray(self._state[0]).reshape(-1)
             v = np.asarray(self._state[1]).reshape(-1, *self.spec.value_shape)
         out = {}
-        for i in np.nonzero(sk >= 0)[0]:
-            out[int(sk[i])] = v[i]
+        for i in np.nonzero(sk < 0)[0]:
+            out[int(_decode_stored(sk[i]))] = v[i]
         return out
 
     def drop(self) -> None:

@@ -54,12 +54,47 @@ def register_update_fn(fn: UpdateFunction) -> UpdateFunction:
 
 
 def get_update_fn(name: str) -> UpdateFunction:
+    """Resolve a registered update fn by name.
+
+    Names may also be DURABLE factory references of the form
+    ``"pkg.mod:factory?arg=1&scale=0.05"`` — the factory (a module-level
+    function returning an UpdateFunction) is imported and called with the
+    parsed kwargs (int/float/str coercion), and the result is cached under
+    the full name. This is what lets a persisted TableConfig (checkpoint
+    manifests, shipped job configs) restore in a FRESH process where no
+    code ran to register the fn by hand — the name itself carries the
+    recipe, like every other dotted-path binding in the config system.
+    """
     try:
         return _REGISTRY[name]
     except KeyError:
-        raise KeyError(
-            f"unknown update fn {name!r}; registered: {sorted(_REGISTRY)}"
-        ) from None
+        pass
+    if ":" in name:
+        from harmony_tpu.config.base import resolve_symbol
+
+        path, _, query = name.partition("?")
+        kwargs = {}
+        for pair in query.split("&") if query else []:
+            k, _, v = pair.partition("=")
+            try:
+                kwargs[k] = int(v)
+            except ValueError:
+                try:
+                    kwargs[k] = float(v)
+                except ValueError:
+                    kwargs[k] = v
+        fn = resolve_symbol(path)(**kwargs)
+        if not isinstance(fn, UpdateFunction):
+            raise TypeError(
+                f"update-fn factory {path!r} returned {type(fn).__name__}, "
+                "expected UpdateFunction"
+            )
+        fn = dataclasses.replace(fn, name=name)
+        _REGISTRY[name] = fn
+        return fn
+    raise KeyError(
+        f"unknown update fn {name!r}; registered: {sorted(_REGISTRY)}"
+    ) from None
 
 
 # The workhorse: push = accumulate deltas (all Dolphin apps use vector add,

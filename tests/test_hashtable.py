@@ -28,9 +28,9 @@ def make_table(devices, capacity=256, num_blocks=4, value_shape=(4,),
     return DeviceHashTable(spec, mesh)
 
 
-def sparse_keys(rng, n, lo=0, hi=2**31 - 1):
-    """Keys drawn from the full int32 domain — the case DenseTable cannot
-    preallocate."""
+def sparse_keys(rng, n, lo=1, hi=2**31 - 3):
+    """Keys drawn from the full valid domain [1, MAX_KEY] — the case
+    DenseTable cannot preallocate (0 is reserved: XLA's pad value)."""
     return rng.choice(hi - lo, size=n, replace=False).astype(np.int32) + lo
 
 
@@ -89,9 +89,11 @@ class TestBasicOps:
         for k, v in model.items():
             np.testing.assert_allclose(items[k], v, atol=1e-4)
 
-    def test_negative_keys_rejected(self, devices):
+    def test_out_of_domain_keys_rejected(self, devices):
+        """Negative keys AND key 0 (reserved — XLA's pad value) drop."""
         t = make_table(devices)
-        t.multi_update([-1, -5, 3], np.ones((3, 4), np.float32))
+        dropped = t.multi_update([-1, 0, -5, 3], np.ones((4, 4), np.float32))
+        assert dropped == 3
         assert t.num_present() == 1  # only key 3 admitted
         np.testing.assert_allclose(t.multi_get([3])[0], np.ones(4))
 

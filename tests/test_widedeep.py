@@ -94,3 +94,144 @@ def test_fm_through_jobserver(devices):
         assert np.isfinite(losses).all()
     finally:
         server.shutdown(timeout=60)
+
+
+class TestSparseMode:
+    """sparse=True: the model lives in a DeviceHashTable — ids from the
+    whole int32 domain, lazy per-key embedding init, same fused step."""
+
+    def _train_sparse(self, trainer, ids, y, mesh, epochs=6, batches=4):
+        from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+        cfg = trainer.model_table_config()
+        assert cfg.sparse
+        table = DeviceHashTable(HashTableSpec(cfg), mesh)
+        params = TrainerParams(num_epochs=epochs, num_mini_batches=batches)
+        w = WorkerTasklet(
+            "wd-sparse", TrainerContext(params=params, model_table=table),
+            trainer, TrainingDataProvider([ids, y], batches), mesh,
+        )
+        return table, w.run()
+
+    def test_sparse_fm_learns_on_full_domain_ids(self, mesh8):
+        from harmony_tpu.apps.widedeep import make_synthetic_sparse
+
+        ids, y = make_synthetic_sparse(1024, vocab_size=64, num_slots=4, seed=0)
+        assert ids.max() > 2**24  # genuinely outside any dense preallocation
+        tr = FMTrainer(vocab_size=64, num_slots=4, emb_dim=4, step_size=2.0,
+                       sparse=True)
+        table, result = self._train_sparse(tr, ids, y, mesh8, epochs=8)
+        assert result["losses"][-1] < result["losses"][0] - 0.05, result["losses"]
+        # every distinct feature id (+ bias row) was admitted, none dropped
+        assert table.num_present() == len(np.unique(ids)) + tr.num_extra_rows
+        assert table.overflow_count == 0
+
+    def test_sparse_widedeep_learns(self, mesh8):
+        from harmony_tpu.apps.widedeep import make_synthetic_sparse
+
+        ids, y = make_synthetic_sparse(512, vocab_size=32, num_slots=2, seed=2)
+        tr = WideDeepTrainer(vocab_size=32, num_slots=2, emb_dim=4, hidden=8,
+                             step_size=1.0, sparse=True)
+        table, result = self._train_sparse(tr, ids, y, mesh8, epochs=6)
+        assert result["losses"][-1] < result["losses"][0], result["losses"]
+        # EVERY row the model needs was admitted — embeddings AND the
+        # reserved bias/MLP rows; nothing dropped anywhere in training
+        assert table.num_present() == len(np.unique(ids)) + tr.num_extra_rows
+        assert table.overflow_count == 0
+
+    def test_lazy_init_is_deterministic_and_nonzero(self, mesh8):
+        """Two independent tables admit the same key to the same embedding
+        (per-key hash init), with zero wide weight and nonzero noise."""
+        from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+        tr = FMTrainer(vocab_size=16, num_slots=2, emb_dim=4, sparse=True)
+        cfg = tr.model_table_config()
+        a = DeviceHashTable(HashTableSpec(cfg), mesh8)
+        b = DeviceHashTable(HashTableSpec(cfg), mesh8)
+        keys = [123456789, 7, 2**30]
+        va, vb = a.multi_get_or_init(keys), b.multi_get_or_init(keys)
+        np.testing.assert_array_equal(va, vb)
+        assert np.allclose(va[:, 0], 0.0)          # wide weight starts 0
+        assert (np.abs(va[:, 1:]) > 0).all()       # embeddings start noisy
+
+    def test_sparse_fm_through_jobserver(self, devices):
+        from harmony_tpu.config.params import JobConfig
+        from harmony_tpu.jobserver import JobServer
+        from harmony_tpu.parallel import DevicePool
+
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        cfg = JobConfig(
+            job_id="sparse-fm", app_type="dolphin",
+            trainer="harmony_tpu.apps.widedeep:FMTrainer",
+            params=TrainerParams(
+                num_epochs=4, num_mini_batches=4,
+                app_params={"vocab_size": 64, "num_slots": 4, "emb_dim": 4,
+                            "step_size": 2.0, "sparse": True},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.widedeep:make_synthetic_sparse",
+                  "data_args": {"n": 512, "vocab_size": 64, "num_slots": 4}},
+        )
+        res = server.submit(cfg).result(timeout=300)
+        server.shutdown(timeout=120)
+        losses = res["workers"]["sparse-fm/w0"]["losses"]
+        assert losses[-1] < losses[0], losses
+
+
+class TestSparseDurability:
+    def test_factory_update_fn_restores_in_fresh_registry(self, devices, tmp_path):
+        """A persisted sparse TableConfig must restore without any live
+        FMTrainer having registered its init fn (fresh-process semantics:
+        the durable factory name carries the recipe)."""
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime.master import ETMaster
+        from harmony_tpu.table.update import _REGISTRY
+
+        tr = FMTrainer(vocab_size=32, num_slots=2, emb_dim=4, sparse=True)
+        cfg = tr.model_table_config()
+        m = ETMaster(DevicePool(devices[:2]))
+        m.add_executors(2)
+        h = m.create_table(cfg, m.executor_ids(), data_axis=1)
+        h.table.multi_update([7, 9], np.ones((2, tr.width), np.float32))
+        mgr = CheckpointManager(str(tmp_path / "t"), str(tmp_path / "c"))
+        cid = mgr.checkpoint(h, commit=True)
+        # simulate a fresh process: forget the dynamically-resolved fn
+        _REGISTRY.pop(cfg.update_fn, None)
+        h2 = mgr.restore(m, cid, m.executor_ids(), table_id="restored")
+        got = h2.table.multi_get([7, 9])
+        assert np.isfinite(got).all()
+        # lazy init still works post-restore for a NEW key
+        vals = h2.table.multi_get_or_init([12345])
+        assert np.abs(vals[0, 1:]).min() > 0  # hash noise, not zeros
+
+    def test_sparse_deferred_eval_at_shutdown(self, devices, tmp_path):
+        """Sparse checkpoints feed the deferred offline evaluation at
+        JobServer shutdown through trainer.evaluate_sparse."""
+        from harmony_tpu.config.params import JobConfig
+        from harmony_tpu.jobserver import JobServer
+        from harmony_tpu.parallel import DevicePool
+
+        server = JobServer(2, device_pool=DevicePool(devices[:2]),
+                           chkp_root=str(tmp_path))
+        server.start()
+        cfg = JobConfig(
+            job_id="sp-ev", app_type="dolphin",
+            trainer="harmony_tpu.apps.widedeep:FMTrainer",
+            params=TrainerParams(
+                num_epochs=4, num_mini_batches=4,
+                model_chkp_period=2, offline_model_eval=True,
+                app_params={"vocab_size": 64, "num_slots": 4, "emb_dim": 4,
+                            "step_size": 2.0, "sparse": True},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.widedeep:make_synthetic_sparse",
+                  "data_args": {"n": 512, "vocab_size": 64, "num_slots": 4}},
+        )
+        res = server.submit(cfg).result(timeout=300)
+        assert len(res["model_chkp_ids"]) == 2
+        server.shutdown(timeout=300)
+        evals = server.eval_results["sp-ev"]
+        assert isinstance(evals, list) and len(evals) == 2, evals
+        assert evals[-1]["loss"] < evals[0]["loss"]
