@@ -91,9 +91,12 @@ def job_configs(scale: float):
     return [mlr, nmf, lda], totals
 
 
-def run_concurrent(devices, scale: float) -> float:
+def run_concurrent(devices, scale: float, job_timeout: float = 900.0) -> float:
     """Submit the three jobs concurrently to one JobServer over ``devices``;
-    aggregate samples/sec = total examples / wall."""
+    aggregate samples/sec = total examples / wall. ``job_timeout`` bounds
+    each job: tight for the accelerator pass (a wedged chip must surface as
+    an error line, not a stall), looser for the slow-but-healthy CPU
+    reference pass."""
     configs, totals = job_configs(scale)
     server = JobServer(num_executors=len(devices),
                        device_pool=DevicePool(devices))
@@ -102,7 +105,7 @@ def run_concurrent(devices, scale: float) -> float:
         t0 = time.perf_counter()
         futures = [server.submit(c) for c in configs]
         for f in futures:
-            f.result(timeout=3600)
+            f.result(timeout=job_timeout)
         wall = time.perf_counter() - t0
     finally:
         server.shutdown(timeout=120)
@@ -127,12 +130,22 @@ def main():
         return
     print(f"accelerator devices: {accel}", file=sys.stderr)
     print("concurrent MLR+NMF+LDA on accelerator:", file=sys.stderr)
-    tpu_rate = run_concurrent(accel, scale=1.0)
+    try:
+        tpu_rate = run_concurrent(accel, scale=1.0)
+    except Exception as e:  # a half-dead transport must still yield a line
+        print(json.dumps({
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": "samples/sec",
+            "vs_baseline": 0.0,
+            "error": f"accelerator run failed: {type(e).__name__}: {e}",
+        }))
+        return
 
     try:
         cpu = jax.devices("cpu")[:1]
         print("concurrent MLR+NMF+LDA on cpu (reduced size):", file=sys.stderr)
-        cpu_rate = run_concurrent(cpu, scale=0.125)
+        cpu_rate = run_concurrent(cpu, scale=0.125, job_timeout=3600.0)
     except Exception as e:  # pragma: no cover - cpu backend always present
         print(f"cpu baseline unavailable: {e}", file=sys.stderr)
         cpu_rate = 0.0

@@ -16,8 +16,10 @@ measures (b) plus the other primitives a capacity-planning reader needs:
              kernel path where supported, jittable fallback elsewhere).
   multiget   host-path random-key multi_get/multi_update ops/sec (the
              sparse/irregular access path, e.g. embedding lookups).
+  sparse     DeviceHashTable fused pull/push keys/sec — the hash-backed
+             embedding hot path (admission + gather + fold in one step).
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|all]
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -157,11 +159,50 @@ def bench_multiget() -> dict:
             "unit": "keys/sec", "keys_per_call": nkeys}
 
 
+def bench_sparse() -> dict:
+    """Fused sparse pull/push on the DeviceHashTable — the embedding-table
+    hot path (admission + gather + scatter-fold in ONE jitted step, keys
+    from the full int32 domain)."""
+    from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+    mesh = _mesh()
+    slots, width, nkeys = 262144, 64, 8192
+    spec = HashTableSpec(TableConfig(
+        table_id="bench-sp", capacity=slots, value_shape=(width,),
+        num_blocks=64, is_ordered=False, update_fn="add", sparse=True,
+    ))
+    table = DeviceHashTable(spec, mesh)
+    rng = np.random.default_rng(0)
+    universe = rng.choice(2**31 - 3, size=4 * nkeys, replace=False) + 1
+    keys = jnp.asarray(universe[rng.integers(0, 4 * nkeys, nkeys)], jnp.int32)
+    deltas = jnp.asarray(
+        rng.standard_normal((nkeys, width)), jnp.float32
+    )
+
+    def step(state, kk, dd):
+        state, vals, token = spec.pull(state, kk)
+        return spec.push(state, token, dd + 0.0 * vals), None
+
+    jstep = jax.jit(step)
+
+    def run(state):
+        out, _ = jstep(state, keys, deltas)
+        return out
+
+    dt = _time(run, table.state)
+    row_bytes = width * 4
+    return {"metric": "sparse table fused pull+push", "value": round(2 * nkeys / dt),
+            "unit": "keys/sec", "keys_per_step": nkeys,
+            "mb_per_step": round(2 * nkeys * row_bytes / 2**20, 1),
+            "devices": len(mesh.devices.flat)}
+
+
 SECTIONS = {
     "table": bench_table,
     "reshard": bench_reshard,
     "attention": bench_attention,
     "multiget": bench_multiget,
+    "sparse": bench_sparse,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -170,6 +211,7 @@ SECTION_METRICS = {
     "reshard": ("reshard bandwidth", "GB/s"),
     "attention": ("flash attention speedup vs naive", "x"),
     "multiget": ("host multi_get+multi_update", "keys/sec"),
+    "sparse": ("sparse table fused pull+push", "keys/sec"),
 }
 
 

@@ -58,7 +58,11 @@ from harmony_tpu.table.update import UpdateFunction, get_update_fn
 # `min` against non-positive stored keys; value writes via `add`), padded
 # lanes are structurally no-ops — no ghost keys, no clobbered values,
 # under ANY sharding the partitioner picks.
-EMPTY_STORED = jnp.int32(0)
+# Plain python int, NOT jnp.int32(0): a module-level jnp constant would
+# materialize a device array at import time — initializing the backend (and
+# hanging the whole import on a wedged transport) before any bounded
+# discovery can run.
+EMPTY_STORED = 0
 MAX_KEY = 2**31 - 3  # -(k+2) must not wrap int32
 # Key 0 is RESERVED (valid keys are 1..MAX_KEY). XLA pads uneven sharded
 # tensors with zeros and the padded lanes flow through the WHOLE elementwise
