@@ -35,8 +35,18 @@ from harmony_tpu.config.params import TableConfig
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 
 
+from harmony_tpu.table.hashtable import MAX_KEY
+
+# Sparse mode reserves the top of the VALID key space: the topic-summary row
+# and a pad sink for masked token positions (deltas there are always zero).
+# Derived from MAX_KEY so a change to the table's key domain cannot strand
+# these as silently-dropped out-of-domain keys.
+LDA_SUMMARY_KEY = MAX_KEY
+LDA_PAD_KEY = MAX_KEY - 1
+LDA_MAX_WORD_KEY = MAX_KEY - 2
+
+
 class LDATrainer(Trainer):
-    pull_mode = "all"
     uses_local_table = True
 
     def __init__(
@@ -47,14 +57,27 @@ class LDATrainer(Trainer):
         max_doc_len: int,
         alpha: float = 0.1,
         beta: float = 0.01,
+        sparse: bool = False,
+        slot_budget: int = 0,
     ) -> None:
+        """``sparse=True`` holds the topic-word counts in a DeviceHashTable:
+        word ids come from the whole int32 domain [1, LDA_MAX_WORD_KEY] and
+        ``slot_budget`` bounds admitted words (default 4x vocab_size, which
+        then only scales the budget; ``vocab_size`` still enters the
+        sampler's V*beta smoothing term as the notional vocabulary size)."""
         self.vocab_size = vocab_size
         self.num_topics = num_topics
         self.num_docs = num_docs
         self.max_doc_len = max_doc_len
         self.alpha = alpha
         self.beta = beta
+        self.sparse = sparse
+        self.slot_budget = slot_budget or 4 * vocab_size
         self._epoch = 0
+
+    @property
+    def pull_mode(self) -> str:
+        return "keys" if self.sparse else "all"
 
     def hyperparams(self) -> Dict[str, float]:
         # Epoch counter folded into the Gibbs PRNG keys: without it every
@@ -73,7 +96,20 @@ class LDATrainer(Trainer):
     # -- table schemas ---------------------------------------------------
 
     def model_table_config(self, table_id: str = "lda-model") -> TableConfig:
-        """word -> [K] topic counts; key vocab_size = topic summary n_k."""
+        """word -> [K] topic counts; summary row n_k at key vocab_size
+        (dense) / LDA_SUMMARY_KEY (sparse). Counts start at zero, so the
+        hash table's add-init needs no custom init fn."""
+        if self.sparse:
+            cap = self.slot_budget + 2  # + summary and pad rows
+            return TableConfig(
+                table_id=table_id,
+                capacity=cap,
+                value_shape=(self.num_topics,),
+                num_blocks=min(cap, 64),
+                is_ordered=False,
+                update_fn="add",
+                sparse=True,
+            )
         return TableConfig(
             table_id=table_id,
             capacity=self.vocab_size + 1,
@@ -81,6 +117,16 @@ class LDATrainer(Trainer):
             num_blocks=min(self.vocab_size + 1, 64),
             update_fn="add",
         )
+
+    def pull_keys(self, batch) -> jnp.ndarray:
+        """Sparse pull: one key per token position (padding routed to the
+        pad sink — its deltas are identically zero) + the summary row last."""
+        _, tokens, _ = batch
+        word = jnp.where(tokens >= 0, tokens, LDA_PAD_KEY)
+        return jnp.concatenate([
+            word.reshape(-1),
+            jnp.asarray([LDA_SUMMARY_KEY], jnp.int32),
+        ])
 
     def local_table_config(self, table_id: str = "lda-local") -> TableConfig:
         """doc -> [max_len] current topic assignment per token (-1 = unset)."""
@@ -105,20 +151,31 @@ class LDATrainer(Trainer):
 
     def compute_with_local(
         self,
-        model: jnp.ndarray,   # [V+1, K] counts (row V = n_k summary)
+        model: jnp.ndarray,
         local: jnp.ndarray,   # [num_docs, L] assignments
         batch: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
         hyper: Dict[str, jnp.ndarray],
     ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """Dense mode: ``model`` is the full [V+1, K] count table (row V =
+        summary). Sparse mode: ``model`` is the KEYED pull for this batch —
+        [B*L + 1, K] rows in pull_keys order (one per token position, then
+        the summary row) — and the returned delta uses the same layout
+        (duplicate words fold in the hash table's scatter-add push, exactly
+        the reference's per-key update application)."""
         doc_idx, tokens, seeds = batch       # [B], [B, L], [B]
         K, V = self.num_topics, self.vocab_size
+        B, L = tokens.shape
         valid = tokens >= 0                  # [B, L]
         word = jnp.where(valid, tokens, 0)
         old_z = local[doc_idx]               # [B, L]
         assigned = old_z >= 0
 
-        n_kw = model[word]                   # [B, L, K] word-topic counts
-        n_k = model[V]                       # [K]
+        if self.sparse:
+            n_kw = model[: B * L].reshape(B, L, K)   # per-token rows
+            n_k = model[B * L]                       # summary row
+        else:
+            n_kw = model[word]               # [B, L, K] word-topic counts
+            n_k = model[V]                   # [K]
         # doc-topic counts from current assignments (batch-local, exact)
         old_onehot = jax.nn.one_hot(jnp.where(assigned, old_z, 0), K) * (
             assigned & valid
@@ -148,13 +205,20 @@ class LDATrainer(Trainer):
             z_new >= 0
         )[..., None].astype(jnp.float32)
         delta_tok = new_onehot - old_onehot   # [B, L, K]
-
-        # push: scatter word-topic deltas + summary row delta, one array
-        delta = jnp.zeros_like(model)
-        flat_words = word.reshape(-1)
         flat_delta = delta_tok.reshape(-1, K)
-        delta = delta.at[flat_words].add(flat_delta)
-        delta = delta.at[V].add(jnp.sum(flat_delta, axis=0))
+
+        if self.sparse:
+            # keyed layout: per-token-position delta rows + summary delta;
+            # the table's push folds duplicate words on-device
+            delta = jnp.concatenate(
+                [flat_delta, jnp.sum(flat_delta, axis=0, keepdims=True)]
+            )
+        else:
+            # push: scatter word-topic deltas + summary row delta, one array
+            delta = jnp.zeros_like(model)
+            flat_words = word.reshape(-1)
+            delta = delta.at[flat_words].add(flat_delta)
+            delta = delta.at[V].add(jnp.sum(flat_delta, axis=0))
 
         new_local = local.at[doc_idx].set(z_new)
         # progress metric: mean log p of sampled topics (stale-count proxy)
@@ -189,3 +253,23 @@ def make_synthetic(
     tokens = np.where(pick, own, noise).astype(np.int32)
     seeds = rng.integers(0, 2**31 - 1, num_docs).astype(np.int32)
     return doc_idx, tokens, seeds
+
+
+def make_synthetic_sparse(
+    num_docs: int,
+    vocab_size: int,
+    num_topics: int,
+    doc_len: int,
+    seed: int = 0,
+):
+    """Same topic model, word ids spread over the whole admissible int32
+    domain [1, LDA_MAX_WORD_KEY] — the corpus only a hash-backed topic-word
+    table can hold (sparse=True trainers). Topic structure is preserved
+    (the spread is per-id deterministic)."""
+    doc_idx, tokens, seeds = make_synthetic(
+        num_docs, vocab_size, num_topics, doc_len, seed
+    )
+    spread = (
+        (tokens.astype(np.int64) * 2654435761 + 777) % (LDA_MAX_WORD_KEY - 1)
+    ).astype(np.int32) + 1
+    return doc_idx, spread, seeds

@@ -109,11 +109,62 @@ class WorkerTasklet:
         """The fused PULL/COMP/PUSH body shared by per-batch and per-epoch
         compilation. ``hyper`` is a dict of scalars (lr etc.) passed fresh
         each dispatch so host-side decay is honored."""
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
         spec = self.ctx.model_table.spec
         trainer = self.trainer
         sync = self._with_sync
+        is_hash = isinstance(self.ctx.model_table, DeviceHashTable)
+
+        def _hash_pull_push(state, batch, compute):
+            """Shared keyed core for hash-backed model tables: getOrInit
+            pull -> compute(rows) -> token push, in one fused program.
+
+            Keys MUST be replicated before they index the table: a
+            data-sharded key vector of uneven per-shard length (batch ids +
+            replicated reserved rows) makes XLA's SPMD partitioner pad its
+            operands, and padded lanes flow through the elementwise chain
+            as phantom key-0 entries (key 0 is reserved as a second
+            defense). Returns (state, compute's aux, metrics with the
+            mandatory _dropped count — drops are drained into
+            table.overflow_count at epoch end, never silent)."""
+            replicated = NamedSharding(self.ctx.model_table.mesh, P())
+            keys = jax.lax.with_sharding_constraint(
+                trainer.pull_keys(batch), replicated
+            )
+            state, rows, token = spec.pull(state, keys)            # PULL
+            delta, aux, metrics = compute(rows)                    # COMP
+            state = spec.push(state, token, delta)                 # PUSH
+            metrics = dict(metrics)
+            metrics["_dropped"] = jnp.sum(~token[2]).astype(jnp.float32)
+            return state, aux, metrics
+
+        if is_hash and trainer.pull_mode != "keys":
+            raise ValueError(
+                "hash-backed model tables need pull_mode='keys' "
+                "(pull_all over an unbounded key domain is undefined)"
+            )
         if trainer.uses_local_table:
             local_spec = self.ctx.local_table.spec
+            if is_hash:
+                # Sparse model table beside a dense worker-local table (the
+                # sparse-LDA shape: hash-backed topic-word counts, dense
+                # per-doc assignments).
+
+                def _step(state, local, batch, hyper):
+                    state, new_l, metrics = _hash_pull_push(
+                        state,
+                        batch,
+                        lambda rows: trainer.compute_with_local(
+                            rows, local_spec.pull_all(local), batch, hyper
+                        ),
+                    )
+                    return (
+                        state,
+                        local_spec.write_all(local, new_l),
+                    ), sync(metrics, state[1])
+
+                return _step
 
             def _step(arr, local, batch, hyper):
                 model = spec.pull_all(arr)                         # PULL
@@ -128,37 +179,15 @@ class WorkerTasklet:
                 ), sync(metrics, new_arr)
 
             return _step
-        from harmony_tpu.table.hashtable import DeviceHashTable
 
-        if isinstance(self.ctx.model_table, DeviceHashTable):
-            # Sparse model table: the keyed pull ADMITS new keys (getOrInit
-            # over an unbounded domain) and returns a slot token so the push
-            # folds at the resolved slots without re-probing — still one
-            # fused XLA program.
-            if trainer.pull_mode != "keys":
-                raise ValueError(
-                    "hash-backed model tables need pull_mode='keys' "
-                    "(pull_all over an unbounded key domain is undefined)"
-                )
-
-            replicated = NamedSharding(self.ctx.model_table.mesh, P())
+        if is_hash:
 
             def _step(state, batch, hyper):
-                # Keys MUST be replicated before they index the table: a
-                # data-sharded key vector of uneven per-shard length (batch
-                # ids + replicated reserved rows) makes XLA's SPMD
-                # partitioner pad the claim scatter, and the padded lanes
-                # write key 0 into slot (0,0) — a ghost admission.
-                keys = jax.lax.with_sharding_constraint(
-                    trainer.pull_keys(batch), replicated
-                )
-                state, model, token = spec.pull(state, keys)       # PULL
-                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
-                state = spec.push(state, token, delta)             # PUSH
-                # drops must never be silent: surfaced per batch, drained
-                # into table.overflow_count at epoch end (_emit_batch_metrics)
-                metrics = dict(metrics)
-                metrics["_dropped"] = jnp.sum(~token[2]).astype(jnp.float32)
+                def compute(rows):
+                    delta, metrics = trainer.compute(rows, batch, hyper)
+                    return delta, None, metrics
+
+                state, _, metrics = _hash_pull_push(state, batch, compute)
                 return state, sync(metrics, state[1])
 
             return _step

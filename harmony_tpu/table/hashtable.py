@@ -447,6 +447,12 @@ class DeviceHashTable:
             return (self._ksh, self._vsh)
 
     @property
+    def _step_state(self):
+        """Uniform state accessor for mixed-table steps (DenseTable's
+        counterpart returns its storage array)."""
+        return self._state
+
+    @property
     def state(self) -> Tuple[jax.Array, jax.Array]:
         with self._lock:
             self._check()

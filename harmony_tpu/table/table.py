@@ -315,11 +315,17 @@ class DenseTable:
         with contextlib.ExitStack() as stack:
             for t in tables:
                 stack.enter_context(t._lock)
-            arrs = [t._arr for t in tables]
+            arrs = [t._step_state for t in tables]
             new_arrs, aux = step_fn(*arrs, *extra)
             for t, new in zip(tables, new_arrs):
                 t.commit(new)
         return aux
+
+    @property
+    def _step_state(self):
+        """Uniform state accessor for mixed-table steps (DeviceHashTable
+        exposes the same property over its (keys, values) pair)."""
+        return self._arr
 
     def apply_step(self, step_fn, *extra):
         """Dispatch a functional step ``step_fn(arr, *extra) -> (new_arr, aux)``
