@@ -118,15 +118,34 @@ class LDATrainer(Trainer):
             update_fn="add",
         )
 
+    def _sparse_valid(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        """Sparse word domain is [1, LDA_MAX_WORD_KEY]: id 0 (the table's
+        reserved key) and ids that would alias the pad/summary rows are
+        treated as PADDING — excluded from sampling entirely, so they can
+        neither corrupt the reserved rows nor leak deltas."""
+        return (tokens >= 1) & (tokens <= LDA_MAX_WORD_KEY)
+
     def pull_keys(self, batch) -> jnp.ndarray:
-        """Sparse pull: one key per token position (padding routed to the
-        pad sink — its deltas are identically zero) + the summary row last."""
+        """Sparse pull: one key per token position (padding and
+        out-of-domain ids routed to the pad sink — their deltas are
+        identically zero) + the summary row last."""
         _, tokens, _ = batch
-        word = jnp.where(tokens >= 0, tokens, LDA_PAD_KEY)
+        word = jnp.where(self._sparse_valid(tokens), tokens, LDA_PAD_KEY)
         return jnp.concatenate([
             word.reshape(-1),
             jnp.asarray([LDA_SUMMARY_KEY], jnp.int32),
         ])
+
+    def mask_delta(self, delta: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+        """Reconcile the summary row with the admission mask (hook called
+        by the worker's hash step): a word row the table dropped must not
+        contribute to n_k either, or the sampler's denominator drifts from
+        the sum of word counts for the rest of the run."""
+        if not self.sparse:
+            return delta
+        word_rows = delta[:-1] * ok[:-1, None].astype(delta.dtype)
+        summary = jnp.sum(word_rows, axis=0, keepdims=True)
+        return jnp.concatenate([word_rows, summary])
 
     def local_table_config(self, table_id: str = "lda-local") -> TableConfig:
         """doc -> [max_len] current topic assignment per token (-1 = unset)."""
@@ -165,7 +184,9 @@ class LDATrainer(Trainer):
         doc_idx, tokens, seeds = batch       # [B], [B, L], [B]
         K, V = self.num_topics, self.vocab_size
         B, L = tokens.shape
-        valid = tokens >= 0                  # [B, L]
+        # sparse mode narrows validity to the admissible word domain (out-
+        # of-domain ids are padding, see _sparse_valid)
+        valid = self._sparse_valid(tokens) if self.sparse else tokens >= 0
         word = jnp.where(valid, tokens, 0)
         old_z = local[doc_idx]               # [B, L]
         assigned = old_z >= 0

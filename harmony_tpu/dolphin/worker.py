@@ -134,6 +134,12 @@ class WorkerTasklet:
             )
             state, rows, token = spec.pull(state, keys)            # PULL
             delta, aux, metrics = compute(rows)                    # COMP
+            if hasattr(trainer, "mask_delta"):
+                # trainers maintaining cross-row invariants (e.g. LDA's
+                # summary row = sum of word rows) reconcile the delta with
+                # the admission mask so a dropped row's contribution drops
+                # EVERYWHERE, not just at its own slot
+                delta = trainer.mask_delta(delta, token[2])
             state = spec.push(state, token, delta)                 # PUSH
             metrics = dict(metrics)
             metrics["_dropped"] = jnp.sum(~token[2]).astype(jnp.float32)
@@ -465,7 +471,10 @@ class WorkerTasklet:
             if n:
                 self.ctx.model_table.count_dropped(n)
         host = {k: v for k, v in host.items() if not k.startswith("_")}
-        losses = host.get("loss", np.zeros(len(batch_sizes)))
+        # same fallback as _primary_metric, per batch: apps whose objective
+        # isn't named 'loss' must not emit flat-zero batch series either
+        lkey = "loss" if "loss" in host else (sorted(host)[0] if host else None)
+        losses = host[lkey] if lkey is not None else np.zeros(len(batch_sizes))
         for b, n in enumerate(batch_sizes):
             self.collector.add(
                 BatchMetrics(
@@ -505,7 +514,20 @@ class WorkerTasklet:
         )
         return self.data.num_examples, last
 
+    @staticmethod
+    def _primary_metric(metrics: Dict[str, float]) -> float:
+        """The per-epoch progress scalar: 'loss' when the trainer reports
+        one, else its first metric by name (e.g. LDA's log_likelihood) —
+        so result['losses'] is never a flat 0.0 for apps whose objective
+        has another name."""
+        if "loss" in metrics:
+            return metrics["loss"]
+        for k in sorted(metrics):
+            return float(metrics[k])
+        return 0.0
+
     def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses):
+        progress = self._primary_metric(last_metrics)
         self.collector.add(
             EpochMetrics(
                 job_id=self.job_id,
@@ -513,10 +535,10 @@ class WorkerTasklet:
                 epoch_idx=epoch,
                 num_examples=epoch_examples,
                 epoch_time_sec=time.perf_counter() - epoch_t0,
-                loss=last_metrics.get("loss", 0.0),
+                loss=progress,
             )
         )
-        epoch_losses.append(last_metrics.get("loss", 0.0))
+        epoch_losses.append(progress)
         self.trainer.on_epoch_finished(self.ctx, epoch)
         if self.epoch_callback is not None:
             self.epoch_callback(epoch)
