@@ -48,6 +48,7 @@ LDA_MAX_WORD_KEY = MAX_KEY - 2
 
 class LDATrainer(Trainer):
     uses_local_table = True
+    objective_metric = "log_likelihood"
 
     def __init__(
         self,

@@ -66,6 +66,11 @@ class Trainer:
     # then threads BOTH table arrays functionally and ``compute_with_local``
     # is used instead of ``compute``.
     uses_local_table: bool = False
+    # Name of the trainer's objective in its compute() metrics when it is
+    # NOT called "loss" (e.g. LDA's "log_likelihood"): per-batch/epoch
+    # progress series fall back to it. None = only "loss" counts; other
+    # metric keys are counters, never relabeled as a loss.
+    objective_metric: "str | None" = None
 
     # -- lifecycle (host side) ------------------------------------------
 
@@ -98,6 +103,14 @@ class Trainer:
     def pull_keys(self, batch: Any) -> jnp.ndarray:
         """keys to pull for this batch (pull_mode == "keys" only)."""
         raise NotImplementedError
+
+    def mask_delta(self, delta: jnp.ndarray, ok: jnp.ndarray) -> jnp.ndarray:
+        """Hash-backed tables only: reconcile the push delta with the
+        admission mask (``ok`` per pulled key) BEFORE the push. Override
+        when rows carry cross-row invariants that a dropped row must leave
+        consistent (e.g. LDA's summary row = sum of word rows). Default:
+        identity — the table itself already drops ok=False rows."""
+        return delta
 
     def compute(
         self, model: jnp.ndarray, batch: Any, hyper: Dict[str, jnp.ndarray]

@@ -134,12 +134,11 @@ class WorkerTasklet:
             )
             state, rows, token = spec.pull(state, keys)            # PULL
             delta, aux, metrics = compute(rows)                    # COMP
-            if hasattr(trainer, "mask_delta"):
-                # trainers maintaining cross-row invariants (e.g. LDA's
-                # summary row = sum of word rows) reconcile the delta with
-                # the admission mask so a dropped row's contribution drops
-                # EVERYWHERE, not just at its own slot
-                delta = trainer.mask_delta(delta, token[2])
+            # SPI hook (identity by default): trainers maintaining cross-row
+            # invariants (e.g. LDA's summary row = sum of word rows)
+            # reconcile the delta with the admission mask so a dropped
+            # row's contribution drops EVERYWHERE, not just at its own slot
+            delta = trainer.mask_delta(delta, token[2])
             state = spec.push(state, token, delta)                 # PUSH
             metrics = dict(metrics)
             metrics["_dropped"] = jnp.sum(~token[2]).astype(jnp.float32)
@@ -471,9 +470,8 @@ class WorkerTasklet:
             if n:
                 self.ctx.model_table.count_dropped(n)
         host = {k: v for k, v in host.items() if not k.startswith("_")}
-        # same fallback as _primary_metric, per batch: apps whose objective
-        # isn't named 'loss' must not emit flat-zero batch series either
-        lkey = "loss" if "loss" in host else (sorted(host)[0] if host else None)
+        # one shared fallback rule (_primary_key) for the per-batch series
+        lkey = self._primary_key(host)
         losses = host[lkey] if lkey is not None else np.zeros(len(batch_sizes))
         for b, n in enumerate(batch_sizes):
             self.collector.add(
@@ -514,17 +512,18 @@ class WorkerTasklet:
         )
         return self.data.num_examples, last
 
-    @staticmethod
-    def _primary_metric(metrics: Dict[str, float]) -> float:
-        """The per-epoch progress scalar: 'loss' when the trainer reports
-        one, else its first metric by name (e.g. LDA's log_likelihood) —
-        so result['losses'] is never a flat 0.0 for apps whose objective
-        has another name."""
+    def _primary_key(self, metrics) -> Optional[str]:
+        """The ONE key that is this job's progress scalar: 'loss', else the
+        trainer's declared objective_metric (e.g. LDA's log_likelihood).
+        Other metric keys are counters — never relabeled as a loss."""
         if "loss" in metrics:
-            return metrics["loss"]
-        for k in sorted(metrics):
-            return float(metrics[k])
-        return 0.0
+            return "loss"
+        om = self.trainer.objective_metric
+        return om if om and om in metrics else None
+
+    def _primary_metric(self, metrics: Dict[str, float]) -> float:
+        k = self._primary_key(metrics)
+        return float(metrics[k]) if k is not None else 0.0
 
     def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses):
         progress = self._primary_metric(last_metrics)

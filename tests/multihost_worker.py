@@ -61,12 +61,48 @@ def main() -> None:
         jax.tree.leaves(new_params)[0].addressable_data(0)
     )
 
+    # 3. sparse hash table sharded over the global mesh: one fused
+    # getOrInit pull + push with identical replicated inputs; admissions,
+    # drops, and the value checksum must agree across processes.
+    from jax.sharding import NamedSharding
+    from harmony_tpu.config import TableConfig
+    from harmony_tpu.parallel.mesh import MODEL_AXIS
+    from harmony_tpu.table import HashTableSpec
+
+    hspec = HashTableSpec(TableConfig(
+        table_id="mh", capacity=1024, value_shape=(8,),
+        num_blocks=len(devices), is_ordered=False, sparse=True,
+    ))
+    hmesh = build_mesh(devices, data=1, model=len(devices))
+    hsh = NamedSharding(hmesh, P(MODEL_AXIS))
+    rng = np.random.default_rng(7)
+    hkeys = jnp.asarray(
+        rng.choice(2**31 - 3, size=256, replace=False) + 1, jnp.int32
+    )
+    hdeltas = jnp.asarray(rng.standard_normal((256, 8)), jnp.float32)
+
+    @jax.jit
+    def hash_step(keys, deltas):
+        state = jax.lax.with_sharding_constraint(hspec.init_state(), (hsh, hsh))
+        state, vals, token = hspec.pull(state, keys)
+        state = hspec.push(state, token, deltas)
+        return (
+            jnp.sum(state[0] < 0),
+            jnp.sum(state[1]),
+            jnp.sum(~token[2]),
+        )
+
+    present, vsum, dropped = hash_step(hkeys, hdeltas)
+
     multihost.sync_global_devices("test-barrier")
     print("RESULT " + json.dumps({
         "pid": pid,
         "psum": psum_val,
         "loss": round(float(np.asarray(loss.addressable_data(0))), 6),
         "leaf0": round(float(first_leaf.ravel()[0]), 6),
+        "hash_present": int(np.asarray(present.addressable_data(0))),
+        "hash_sum": round(float(np.asarray(vsum.addressable_data(0))), 4),
+        "hash_dropped": int(np.asarray(dropped.addressable_data(0))),
     }))
 
 

@@ -180,3 +180,60 @@ class TestLiveMigration:
         np.testing.assert_allclose(
             np.asarray(handle.table.pull_array()), np.full((16, 2), expected)
         )
+
+
+class TestSparseTableMigration:
+    def test_concurrent_migration_during_sparse_training(self, devices):
+        """Live plan-driven migration of a HASH-backED model table while a
+        sparse FM job is mid-epoch: the table lock + commit re-homing must
+        keep training correct through the ownership flip (the sparse
+        analogue of test_concurrent_migration_during_batches)."""
+        from harmony_tpu.apps.widedeep import FMTrainer, make_synthetic_sparse
+
+        pool = DevicePool(devices[:4])
+        master = ETMaster(pool)
+        exs = master.add_executors(2)
+        tr = FMTrainer(vocab_size=64, num_slots=4, emb_dim=4, step_size=2.0,
+                       sparse=True)
+        cfg = tr.model_table_config().replace(num_blocks=16)
+        handle = master.create_table(cfg, [e.id for e in exs])
+        ids, y = make_synthetic_sparse(512, vocab_size=64, num_slots=4, seed=5)
+        params = TrainerParams(num_epochs=8, num_mini_batches=4)
+        ctx = TrainerContext(params=params, model_table=handle.table)
+        worker = WorkerTasklet(
+            "sp-mig", ctx, tr,
+            TrainingDataProvider([ids, y], 4),
+            handle.table.mesh,
+            batch_barrier=lambda i: False,  # per-batch path, no gating
+        )
+        errors = []
+
+        def migrate():
+            try:
+                time.sleep(0.05)
+                plan = ETPlan()
+                alloc = plan.add_op(AllocateOp("m"))
+                assoc = plan.add_op(
+                    AssociateOp(handle.table_id, "m"), depends_on=[alloc]
+                )
+                plan.add_op(
+                    MoveOp(handle.table_id, exs[0].id, "m", 4), depends_on=[assoc]
+                )
+                r = PlanExecutor(master).execute(plan)
+                if not r.success:
+                    errors.append(r.error)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=migrate)
+        t.start()
+        result = worker.run()
+        t.join(timeout=30)
+        assert not errors, errors
+        # training remained healthy through the migration
+        assert result["losses"][-1] < result["losses"][0], result["losses"]
+        assert handle.table.num_present() == len(np.unique(ids)) + tr.num_extra_rows
+        assert handle.table.overflow_count == 0
+        # the newly allocated executor (virtual id "m" resolved to a real
+        # one by AllocateOp) really owns blocks now: three owners total
+        assert len(handle.owning_executors()) == 3

@@ -64,3 +64,8 @@ def test_two_process_distributed_job():
     assert a["psum"] == b["psum"] == 8.0          # all 8 global devices
     assert a["loss"] == b["loss"]                 # same SPMD step result
     assert a["leaf0"] == b["leaf0"]               # params stayed replicated
+    # sparse hash table over the global mesh: every key admitted, no drops,
+    # identical state on both processes
+    assert a["hash_present"] == b["hash_present"] == 256
+    assert a["hash_dropped"] == b["hash_dropped"] == 0
+    assert a["hash_sum"] == b["hash_sum"]
