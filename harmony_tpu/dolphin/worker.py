@@ -91,6 +91,9 @@ class WorkerTasklet:
         self.cache_device_batches = not data.is_shuffling
         self._batch_cache: Dict[int, Any] = {}
         self._stacked_cache = None
+        # This worker's own op counters (single-threaded; per-job metric
+        # attribution sums these across the job's workers).
+        self.op_stats: Dict[str, int] = {"pulls": 0, "pushes": 0, "pull_bytes": 0}
 
     # -- step construction ----------------------------------------------
 
@@ -259,6 +262,19 @@ class WorkerTasklet:
                     _epoch, out_shardings=(table.sharding, None), donate_argnums=0
                 )
         self._eval_fn = jax.jit(self.trainer.evaluate)
+        # Per-batch pull size for op accounting (ref: RemoteAccessOpStat
+        # counters behind MetricReportMsg): keys-mode row count comes from
+        # an eval_shape of pull_keys (no compute), all-mode pulls capacity.
+        if self.trainer.pull_mode == "keys":
+            sample = tuple(
+                jax.ShapeDtypeStruct((self.data.batch_size, *a.shape[1:]), a.dtype)
+                for a in self.data._arrays
+            )
+            self._pull_rows = int(
+                jax.eval_shape(self.trainer.pull_keys, sample).shape[0]
+            )
+        else:
+            self._pull_rows = int(table.spec.config.capacity)
         self._step_sharding = table.sharding
         self._local_sharding = (
             self.ctx.local_table.sharding if self.trainer.uses_local_table else None
@@ -448,6 +464,7 @@ class WorkerTasklet:
             last_metrics = self._emit_batch_metrics(
                 epoch, host, batch_sizes, work_t / len(pending)
             )
+            self._account_ops(len(pending))
         return epoch_examples, last_metrics, global_batch_idx, stop
 
     def _emit_batch_metrics(
@@ -510,6 +527,7 @@ class WorkerTasklet:
         last = self._emit_batch_metrics(
             epoch, host_metrics, [self.data.batch_size] * nb, dt / nb
         )
+        self._account_ops(nb)
         return self.data.num_examples, last
 
     def _primary_key(self, metrics) -> Optional[str]:
@@ -542,6 +560,17 @@ class WorkerTasklet:
         if self.epoch_callback is not None:
             self.epoch_callback(epoch)
         self.collector.flush()
+
+    def _account_ops(self, num_steps: int) -> None:
+        """Fold this dispatch window's pull/push counts (one pull + one push
+        per fused step) into this worker's own counters — per-job metric
+        attribution sums the job's workers, so jobs sharing one table never
+        double-count each other's traffic."""
+        spec = self.ctx.model_table.spec
+        row_bytes = int(np.prod(spec.value_shape)) * spec.dtype.itemsize if spec.value_shape else spec.dtype.itemsize
+        self.op_stats["pulls"] += num_steps
+        self.op_stats["pushes"] += num_steps
+        self.op_stats["pull_bytes"] += num_steps * self._pull_rows * row_bytes
 
     def _taskunit_scope(self, kind: str):
         if self.taskunit is None:

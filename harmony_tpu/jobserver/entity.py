@@ -82,7 +82,6 @@ class DolphinJobEntity(JobEntity):
         self._master: Optional[ETMaster] = None
         self._handle: Optional[TableHandle] = None
         self._local_handle: Optional[TableHandle] = None
-        self._owns_model_table = True
         self._workers: List[WorkerTasklet] = []
         self._ctrl: Optional[MiniBatchController] = None
         self.progress: Optional[BatchProgressTracker] = None
@@ -112,10 +111,9 @@ class DolphinJobEntity(JobEntity):
             # Explicit table id => shared-table semantics: reuse if it exists
             # (the reference reuses same-id tables across jobs,
             # DolphinJobEntity.java:76-121 — deliberately shared state).
-            self._handle, created = master.get_or_create_table(
+            self._handle, _ = master.get_or_create_table(
                 cfg.tables[0], executor_ids, data_axis
             )
-            self._owns_model_table = created
         else:
             # Trainer-default schema => PRIVATE model table: namespace by job
             # id so two concurrent jobs of the same app never collide on the
@@ -126,7 +124,6 @@ class DolphinJobEntity(JobEntity):
                 table_id=f"{cfg.job_id}:{table_cfg.table_id}"
             )
             self._handle = master.create_table(table_cfg, executor_ids, data_axis)
-            self._owns_model_table = True
         self._trainer_factory = lambda: (
             resolve_symbol(cfg.trainer)(**cfg.params.app_params)
         )
@@ -173,6 +170,8 @@ class DolphinJobEntity(JobEntity):
                 self._chkp_mgr, self._handle, period=params.model_chkp_period
             )
             epoch_hook = self._chkp_chain.on_epoch
+        tm_hook = self._make_table_metrics_hook()
+        epoch_hook = self._compose_epoch_hooks(epoch_hook, tm_hook)
         self._ctrl = (
             MiniBatchController(
                 params.clock_slack, params.num_epochs * nb, tracker=self.progress
@@ -266,6 +265,11 @@ class DolphinJobEntity(JobEntity):
             self._global_tu.on_job_finish(cfg.job_id)
         if errors:
             raise errors[0]
+        if tm_hook is not None:
+            # final report AFTER all workers joined: the chief's last epoch
+            # hook fires while SSP-lagging peers may still be dispatching;
+            # their tail ops land in this closing window
+            tm_hook(params.num_epochs)
         out: Dict[str, Any] = {"job_id": cfg.job_id, "workers": results}
         if self._chkp_chain is not None:
             # Join the async snapshot writers before the dispatcher drops the
@@ -282,6 +286,75 @@ class DolphinJobEntity(JobEntity):
             # can replay or delete it.
             out["model_chkp_root"] = self._chkp_dir
         return out
+
+    @staticmethod
+    def _compose_epoch_hooks(*hooks):
+        hooks = [h for h in hooks if h is not None]
+        if not hooks:
+            return None
+        if len(hooks) == 1:
+            return hooks[0]
+
+        def composed(epoch_idx: int) -> None:
+            for h in hooks:
+                h(epoch_idx)
+
+        return composed
+
+    def _make_table_metrics_hook(self):
+        """Per-epoch ServerMetrics emission (ref: the ET MetricReportMsg
+        built-ins every executor reports — per-table block counts, pull
+        request counts, pulled bytes — feeding MetricManager and through it
+        the optimizer's cost models). Single-controller attribution: each
+        owning executor reports its block count and a block-proportional
+        share of THIS JOB's op-counter deltas since the last report — the
+        deltas come from the job's own workers, not the table's cumulative
+        counters, so jobs sharing one table never claim each other's
+        traffic."""
+        if self._metric_sink is None:
+            return None
+        from harmony_tpu.metrics.collector import ServerMetrics
+
+        last = {"pulls": 0, "pushes": 0, "pull_bytes": 0}
+        job_id = self.config.job_id
+        handle = self._handle
+
+        def apportion(total: int, weights) -> list:
+            """Largest-remainder split: the shares sum EXACTLY to total
+            (plain flooring leaks the remainder ops every window)."""
+            wsum = max(sum(weights), 1)
+            raw = [total * w / wsum for w in weights]
+            floors = [int(r) for r in raw]
+            for i in sorted(range(len(raw)), key=lambda i: raw[i] - floors[i],
+                            reverse=True)[: total - sum(floors)]:
+                floors[i] += 1
+            return floors
+
+        def report(epoch_idx: int) -> None:
+            stats = {k: 0 for k in last}
+            for w in list(self._workers):
+                for k in stats:
+                    stats[k] += w.op_stats[k]
+            delta = {k: stats[k] - last[k] for k in last}
+            last.update(stats)
+            counts = handle.block_manager.block_counts()
+            owners = [(ex, n) for ex, n in counts.items() if n > 0]
+            weights = [n for _, n in owners]
+            pulls = apportion(delta["pulls"], weights)
+            pushes = apportion(delta["pushes"], weights)
+            pbytes = apportion(delta["pull_bytes"], weights)
+            for i, (ex, nblocks) in enumerate(owners):
+                self._metric_sink(ServerMetrics(
+                    job_id=job_id,
+                    executor_id=ex,
+                    window_idx=epoch_idx,
+                    num_blocks=nblocks,
+                    pull_count=pulls[i],
+                    push_count=pushes[i],
+                    pull_bytes=pbytes[i],
+                ))
+
+        return report
 
     def deferred_evaluation(self):
         """Return a closure replaying this job's checkpoint chain, or None.
@@ -334,14 +407,21 @@ class DolphinJobEntity(JobEntity):
     # -- teardown --------------------------------------------------------
 
     def cleanup(self) -> None:
-        """Drop job-owned tables (ref: JobDispatcher drops tables at job
-        end; shared/reused tables survive)."""
-        if self._owns_model_table and self._handle is not None:
-            self._handle.drop()
-        if self._local_handle is not None:
-            self._local_handle.drop()
-            self._local_handle = None
-        self._handle = None
+        """Release job tables (ref: JobDispatcher drops tables at job end;
+        shared/reused tables survive). The master refcounts shared tables:
+        every tenant releases its reference and storage is freed only when
+        the LAST one does — a creator finishing first must not delete
+        buffers under a tenant still training."""
+        # Idempotent: the dispatcher calls cleanup() again on exceptions —
+        # each handle reference is nulled BEFORE dropping so a second pass
+        # (or a drop that raises midway) can never decrement the shared
+        # refcount twice and steal another tenant's reference.
+        h, self._handle = self._handle, None
+        lh, self._local_handle = self._local_handle, None
+        if h is not None:
+            h.drop()
+        if lh is not None:
+            lh.drop()
 
     @property
     def table_handle(self) -> Optional[TableHandle]:

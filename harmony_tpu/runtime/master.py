@@ -150,6 +150,11 @@ class ETMaster:
         self._executors: Dict[str, Executor] = {}
         self._tables: Dict[str, TableHandle] = {}
         self._data_axis: Dict[str, int] = {}
+        # Shared-table lifetime: get_or_create_table hands the same handle
+        # to multiple jobs, so storage is released only when the LAST user
+        # drops (a creator finishing first must not delete buffers under a
+        # tenant still training).
+        self._table_refs: Dict[str, int] = {}
 
     # -- executors -------------------------------------------------------
 
@@ -227,6 +232,7 @@ class ETMaster:
             handle = TableHandle(self, table, bm)
             self._tables[config.table_id] = handle
             self._data_axis[config.table_id] = data_axis
+            self._table_refs[config.table_id] = 1
             return handle
 
     def get_or_create_table(
@@ -239,6 +245,7 @@ class ETMaster:
         must not both create it). Returns (handle, created)."""
         with self._lock:
             if config.table_id in self._tables:
+                self._table_refs[config.table_id] += 1
                 return self._tables[config.table_id], False
             return self.create_table(config, associators, data_axis), True
 
@@ -255,7 +262,16 @@ class ETMaster:
             return self._data_axis.get(table_id, 1)
 
     def _drop_table(self, table_id: str) -> None:
+        """Release one reference; storage is freed when the last user drops
+        (handles from get_or_create_table share the refcount)."""
         with self._lock:
+            refs = self._table_refs.get(table_id)
+            if refs is None:
+                return  # already fully dropped (idempotent)
+            if refs > 1:
+                self._table_refs[table_id] = refs - 1
+                return
+            self._table_refs.pop(table_id, None)
             handle = self._tables.pop(table_id, None)
             self._data_axis.pop(table_id, None)
         if handle is not None:

@@ -203,6 +203,7 @@ class TestAsyncCheckpoint:
         handle, _ = make_handle(master, tid="drain-t")
         chain = ModelChkpManager(mgr, handle, period=1, commit=False)
         chain.on_epoch(0)  # good
+        chain.drain(timeout=60)  # join the good writer BEFORE sabotage
         import harmony_tpu.checkpoint.manager as m
 
         orig = m._write_block
@@ -213,10 +214,13 @@ class TestAsyncCheckpoint:
         m._write_block = boom
         try:
             chain.on_epoch(1)  # bad
+            # drain INSIDE the patched window: the async writer may not
+            # have reached _write_block yet when on_epoch returns, so
+            # unpatching first would let it succeed under load (flaky)
+            with pytest.raises(IOError, match="enospc"):
+                chain.drain(timeout=60)
         finally:
             m._write_block = orig
-        with pytest.raises(IOError, match="enospc"):
-            chain.drain(timeout=60)
         assert len(chain.chkp_ids) == 1
         # the surviving id restores fine
         r = mgr.restore(master, chain.chkp_ids[0],

@@ -470,3 +470,37 @@ class TestDeferredModelEval:
         server.submit(cfg).result(timeout=300)
         server.shutdown(timeout=300)
         assert "error" in server.eval_results["eval-mlr"]
+
+
+class TestSharedTableLifetime:
+    def test_creator_finishing_first_does_not_kill_tenant(self, devices):
+        """Two jobs share one model table by id; the CREATOR finishes long
+        before the tenant. Storage must survive until the LAST user releases
+        (master refcount) — previously the creator's cleanup deleted the
+        buffers under the still-training tenant."""
+        from harmony_tpu.config.params import TableConfig
+
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        shared = TableConfig(table_id="life-m", capacity=16,
+                             value_shape=(4,), num_blocks=8)
+
+        def job(jid, epochs):
+            cfg = mlr_job(jid, n=64, epochs=epochs, workers=1)
+            cfg.tables = [shared]
+            return cfg
+
+        fa = server.submit(job("life-a", epochs=1))   # creator: done fast
+        fa.result(timeout=300)
+        # creator already finished and released; tenant must still be able
+        # to ATTACH (refcount went 1 -> 0 would have dropped it... the
+        # sequential case recreates; the concurrent case is the real race)
+        fb = server.submit(job("life-b", epochs=3))
+        fc = server.submit(job("life-c", epochs=6))   # overlapping tenants
+        rb, rc = fb.result(timeout=300), fc.result(timeout=300)
+        server.shutdown(timeout=60)
+        for r in (rb, rc):
+            losses = next(iter(r["workers"].values()))["losses"]
+            assert np.isfinite(losses).all()
+        # fully released at the end: a later server could recreate the id
+        assert "life-m" not in server.master.table_ids()
