@@ -128,6 +128,12 @@ class JobConfig(ConfigBase):
     job_id: str
     app_type: str                      # "dolphin" | "pregel"
     trainer: Optional[str] = None      # dotted path of Trainer subclass
+    # Metric-driven elasticity for this job (ref: the per-job Optimizer
+    # binding behind ETOptimizationOrchestrator, and the -optimizer flag):
+    # "homogeneous" | "add_one_server" | "delete_one_server" | a dotted
+    # path resolving to an Optimizer class/factory. None = static sharding.
+    optimizer: Optional[str] = None
+    optimizer_period: float = 5.0      # seconds between optimization rounds
     update_fn: str = "add"
     tables: List[TableConfig] = field(default_factory=list)
     params: TrainerParams = field(default_factory=TrainerParams)

@@ -310,6 +310,43 @@ class WorkerTasklet:
     def _shard_batch(self, batch: Tuple[np.ndarray, ...]):
         return tuple(jax.device_put(a, self._batch_sharding) for a in batch)
 
+    # Bounded retries when a live reshard lands BETWEEN the rebuild check
+    # and the dispatch (a step compiled for the old layout then receives the
+    # new-layout array — XLA raises a device-mismatch at dispatch time, the
+    # step does not execute). Reshards are rare; one retry usually wins.
+    MAX_RESHARD_RETRIES = 4
+
+    @staticmethod
+    def _is_layout_race(e: ValueError) -> bool:
+        return "incompatible devices" in str(e)
+
+    def _dispatch_batch(self, batch_idx: int, batch, hyper):
+        """Rebuild-check + batch placement + dispatch, retried across
+        concurrent reshards (the batch cache re-populates on the new mesh
+        after a rebuild clears it)."""
+        for _ in range(self.MAX_RESHARD_RETRIES):
+            self._maybe_rebuild()
+            if self.cache_device_batches:
+                batch_dev = self._batch_cache.get(batch_idx)
+                if batch_dev is None:
+                    batch_dev = self._shard_batch(batch)
+                    self._batch_cache[batch_idx] = batch_dev
+            else:
+                batch_dev = self._shard_batch(batch)
+            try:
+                return self._dispatch_step(self._step, batch_dev, hyper)
+            except ValueError as e:
+                if not self._is_layout_race(e):
+                    raise
+                # FORCE a rebuild: the race proves something layout-derived
+                # is stale even if the cheap sharding compare above missed
+                # it (every cache repopulates on the current mesh)
+                self._build_step()
+        raise RuntimeError(
+            f"table resharded {self.MAX_RESHARD_RETRIES}x during one batch "
+            "dispatch; reconfiguration is outpacing training"
+        )
+
     def _hyper(self) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v) for k, v in self.trainer.hyperparams().items()}
 
@@ -415,17 +452,9 @@ class WorkerTasklet:
                 stop = self.batch_barrier(global_batch_idx)
                 if stop:
                     break
-            self._maybe_rebuild()
             t0 = time.perf_counter()
             with self._taskunit_scope("COMP"):
-                if self.cache_device_batches:
-                    batch_dev = self._batch_cache.get(batch_idx)
-                    if batch_dev is None:
-                        batch_dev = self._shard_batch(batch)
-                        self._batch_cache[batch_idx] = batch_dev
-                else:
-                    batch_dev = self._shard_batch(batch)
-                metrics = self._dispatch_step(self._step, batch_dev, hyper)
+                metrics = self._dispatch_batch(batch_idx, batch, hyper)
             pending.append(metrics)
             if len(pending) >= self.MAX_INFLIGHT:
                 # Sliding window: block on the OLDEST outstanding step so the
@@ -508,16 +537,30 @@ class WorkerTasklet:
     def _run_fused_epoch(self, epoch: int) -> Tuple[int, Dict[str, float]]:
         """One dispatch for the whole epoch (see _build_step)."""
         table = self.ctx.model_table
-        self._maybe_rebuild()
-        if self._stacked_cache is None:
-            batches = list(self.data.epoch_batches())
-            stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
-            self._stacked_cache = tuple(
-                jax.device_put(np.stack([b[i] for b in batches]), stacked_sharding)
-                for i in range(len(batches[0]))
+        for _ in range(self.MAX_RESHARD_RETRIES):
+            self._maybe_rebuild()
+            if self._stacked_cache is None:
+                batches = list(self.data.epoch_batches())
+                stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
+                self._stacked_cache = tuple(
+                    jax.device_put(np.stack([b[i] for b in batches]), stacked_sharding)
+                    for i in range(len(batches[0]))
+                )
+            # timer starts AFTER cache build: the one-time dataset stacking/
+            # transfer must not inflate per-batch times fed to the optimizer
+            t0 = time.perf_counter()
+            try:
+                stacked_metrics = self._dispatch_step(self._epoch_fn, self._stacked_cache)
+                break
+            except ValueError as e:
+                if not self._is_layout_race(e):
+                    raise
+                self._build_step()  # force-rebuild (see _dispatch_batch)
+        else:
+            raise RuntimeError(
+                f"table resharded {self.MAX_RESHARD_RETRIES}x during one "
+                "epoch dispatch; reconfiguration is outpacing training"
             )
-        t0 = time.perf_counter()
-        stacked_metrics = self._dispatch_step(self._epoch_fn, self._stacked_cache)
         jax.block_until_ready(stacked_metrics)
         dt = time.perf_counter() - t0
         nb = self.data.num_mini_batches

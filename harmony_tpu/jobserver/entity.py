@@ -71,11 +71,13 @@ class DolphinJobEntity(JobEntity):
         local_taskunit: Optional[LocalTaskUnitScheduler] = None,
         metric_sink=None,
         chkp_root: Optional[str] = None,
+        metric_manager=None,
     ) -> None:
         super().__init__(config, chkp_root)
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
         self._metric_sink = metric_sink
+        self._metric_manager = metric_manager
         self._chkp_mgr = None
         self._chkp_chain = None
         self._chkp_dir: Optional[str] = None
@@ -172,6 +174,7 @@ class DolphinJobEntity(JobEntity):
             epoch_hook = self._chkp_chain.on_epoch
         tm_hook = self._make_table_metrics_hook()
         epoch_hook = self._compose_epoch_hooks(epoch_hook, tm_hook)
+        orchestrator = self._make_orchestrator()
         self._ctrl = (
             MiniBatchController(
                 params.clock_slack, params.num_epochs * nb, tracker=self.progress
@@ -257,10 +260,17 @@ class DolphinJobEntity(JobEntity):
             threading.Thread(target=run_worker, args=(i,), name=f"{cfg.job_id}-w{i}")
             for i in range(num_workers)
         ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        if orchestrator is not None:
+            orchestrator.start()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if orchestrator is not None:
+                orchestrator.stop()
+                self._master.release_optimizer_lease(self._handle.table_id)
         if self._global_tu is not None:
             self._global_tu.on_job_finish(cfg.job_id)
         if errors:
@@ -271,6 +281,14 @@ class DolphinJobEntity(JobEntity):
             # their tail ops land in this closing window
             tm_hook(params.num_epochs)
         out: Dict[str, Any] = {"job_id": cfg.job_id, "workers": results}
+        if orchestrator is not None:
+            out["reconfigs"] = len(orchestrator.reconfig_log)
+            if orchestrator.errors:
+                # failed rounds must be visible in the job result, not just
+                # in a list that dies with the orchestrator
+                out["optimizer_errors"] = [
+                    f"{type(e).__name__}: {e}" for e in orchestrator.errors
+                ]
         if self._chkp_chain is not None:
             # Join the async snapshot writers before the dispatcher drops the
             # table; the surviving ids are the replayable chain. A checkpoint
@@ -286,6 +304,44 @@ class DolphinJobEntity(JobEntity):
             # can replay or delete it.
             out["model_chkp_root"] = self._chkp_dir
         return out
+
+    _OPTIMIZERS = {
+        "homogeneous": "harmony_tpu.optimizer:HomogeneousOptimizer",
+        "heterogeneous": "harmony_tpu.optimizer:HeterogeneousOptimizer",
+        "add_one_server": "harmony_tpu.optimizer:AddOneServerOptimizer",
+        "delete_one_server": "harmony_tpu.optimizer:DeleteOneServerOptimizer",
+        "empty": "harmony_tpu.optimizer:EmptyPlanOptimizer",
+    }
+
+    def _make_orchestrator(self):
+        """Per-job elasticity loop (ref: ETOptimizationOrchestrator run by
+        the driver for each Dolphin job): metrics -> Optimizer -> plan ->
+        live migration of THIS job's model table while it trains. Enabled
+        by JobConfig.optimizer (a registry name or dotted path)."""
+        name = self.config.optimizer
+        if not name:
+            return None
+        if self._metric_manager is None:
+            raise ValueError(
+                f"job {self.config.job_id}: optimizer={name!r} needs the "
+                "jobserver's MetricManager (running outside a JobServer?)"
+            )
+        # One optimization loop per table: a tenant attaching to a shared
+        # table whose creator already optimizes it trains unoptimized
+        # rather than racing competing migration plans.
+        if not self._master.acquire_optimizer_lease(self._handle.table_id):
+            return None
+        from harmony_tpu.optimizer import OptimizationOrchestrator
+
+        cls = resolve_symbol(self._OPTIMIZERS.get(name, name))
+        return OptimizationOrchestrator(
+            self._master,
+            self._handle,
+            cls(),
+            self._metric_manager,
+            period_sec=self.config.optimizer_period,
+            job_id=self.config.job_id,
+        )
 
     @staticmethod
     def _compose_epoch_hooks(*hooks):
@@ -319,16 +375,9 @@ class DolphinJobEntity(JobEntity):
         job_id = self.config.job_id
         handle = self._handle
 
-        def apportion(total: int, weights) -> list:
-            """Largest-remainder split: the shares sum EXACTLY to total
-            (plain flooring leaks the remainder ops every window)."""
-            wsum = max(sum(weights), 1)
-            raw = [total * w / wsum for w in weights]
-            floors = [int(r) for r in raw]
-            for i in sorted(range(len(raw)), key=lambda i: raw[i] - floors[i],
-                            reverse=True)[: total - sum(floors)]:
-                floors[i] += 1
-            return floors
+        # largest-remainder split: shares sum EXACTLY to the total (plain
+        # flooring leaks remainder ops every window)
+        from harmony_tpu.optimizer.hetero import _largest_remainder as apportion
 
         def report(epoch_idx: int) -> None:
             stats = {k: 0 for k in last}
@@ -446,6 +495,7 @@ class PregelJobEntity(JobEntity):
         local_taskunit: Optional[LocalTaskUnitScheduler] = None,
         metric_sink=None,
         chkp_root: Optional[str] = None,
+        metric_manager=None,  # no per-table optimizer loop for graphs
     ) -> None:
         super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit

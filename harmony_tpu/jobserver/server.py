@@ -234,6 +234,7 @@ class JobServer:
                 local_taskunit=self.local_taskunit,
                 metric_sink=self.metrics.on_metric,
                 chkp_root=self._chkp_root,
+                metric_manager=self.metrics,
             )
             with self._lock:
                 self._entities[config.job_id] = entity

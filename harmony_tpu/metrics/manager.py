@@ -32,11 +32,20 @@ class MetricManager:
         with self._lock:
             self._collecting = False
 
-    def clear(self) -> None:
+    def clear(self, job_id: Optional[str] = None) -> None:
+        """Drop stored metrics — all of them, or only one job's (a
+        multi-tenant reconfiguration must not erase other tenants' data)."""
         with self._lock:
-            self._batch.clear()
-            self._epoch.clear()
-            self._server.clear()
+            if job_id is None:
+                self._batch.clear()
+                self._epoch.clear()
+                self._server.clear()
+                return
+            for store in (self._batch, self._epoch, self._server):
+                for key in list(store):
+                    store[key] = [m for m in store[key] if m.job_id != job_id]
+                    if not store[key]:
+                        del store[key]
 
     # -- ingest ----------------------------------------------------------
 
@@ -54,15 +63,24 @@ class MetricManager:
 
     # -- queries (optimizer inputs) --------------------------------------
 
-    def worker_batch_metrics(self, worker_id: Optional[str] = None) -> List[BatchMetrics]:
+    def worker_batch_metrics(
+        self, worker_id: Optional[str] = None, job_id: Optional[str] = None
+    ) -> List[BatchMetrics]:
         with self._lock:
             if worker_id is not None:
-                return list(self._batch.get(worker_id, []))
-            return [m for ms in self._batch.values() for m in ms]
+                ms = list(self._batch.get(worker_id, []))
+            else:
+                ms = [m for mlist in self._batch.values() for m in mlist]
+        if job_id is not None:
+            ms = [m for m in ms if m.job_id == job_id]
+        return ms
 
-    def server_metrics(self) -> List[ServerMetrics]:
+    def server_metrics(self, job_id: Optional[str] = None) -> List[ServerMetrics]:
         with self._lock:
-            return [m for ms in self._server.values() for m in ms]
+            ms = [m for mlist in self._server.values() for m in mlist]
+        if job_id is not None:
+            ms = [m for m in ms if m.job_id == job_id]
+        return ms
 
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star

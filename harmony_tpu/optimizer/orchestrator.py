@@ -34,17 +34,28 @@ class OptimizationOrchestrator:
         metrics: MetricManager,
         period_sec: float = 5.0,
         available_fn: Optional[Callable[[], int]] = None,
+        job_id: Optional[str] = None,
     ) -> None:
+        """``job_id`` scopes a multi-tenant deployment: the optimizer sees
+        ONLY this job's metrics (another tenant's throughput must not steer
+        this table's placement) and post-migration cleanup clears only this
+        job's skewed samples instead of pausing/erasing every tenant's
+        collection. None = single-tenant mode (whole-manager pause/clear,
+        like the reference's per-driver orchestrator)."""
         self.master = master
         self.handle = handle
         self.optimizer = optimizer
         self.metrics = metrics
         self.period_sec = period_sec
+        self.job_id = job_id
         self._available_fn = available_fn
         self._compiler = PlanCompiler()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.reconfig_log: List[PlanResult] = []
+        from collections import deque
+
+        self.errors = deque(maxlen=16)  # failed rounds (loop continues)
         # Snapshot for worker->executor mapping (see _worker_executor_map).
         self._initial_executors: List[str] = list(handle.block_manager.executors)
 
@@ -74,10 +85,10 @@ class OptimizationOrchestrator:
         return out
 
     def run_once(self) -> Optional[PlanResult]:
-        worker_metrics = self.metrics.worker_batch_metrics()
+        worker_metrics = self.metrics.worker_batch_metrics(job_id=self.job_id)
         params = EvaluatorParams(
             worker_metrics=worker_metrics,
-            server_metrics=self.metrics.server_metrics(),
+            server_metrics=self.metrics.server_metrics(job_id=self.job_id),
             table_id=self.handle.table_id,
             block_counts=self.handle.block_manager.block_counts(),
             worker_to_executor=self._worker_executor_map(worker_metrics),
@@ -95,14 +106,19 @@ class OptimizationOrchestrator:
         if dplan.empty:
             return None
         plan = self._compiler.compile(dplan, self.handle.table_id)
-        # Pause metric intake during migration (skewed samples poison the
-        # next round's cost estimate).
-        self.metrics.stop_collection()
+        # Migration-window samples are skewed and must not feed the next
+        # round's cost estimate. Single-tenant: pause+clear the manager
+        # (ref: MetricManager pause/resume). Multi-tenant (job_id set):
+        # never touch other tenants' data — clear only this job's records
+        # after the migration.
+        if self.job_id is None:
+            self.metrics.stop_collection()
         try:
             result = PlanExecutor(self.master).execute(plan)
         finally:
-            self.metrics.clear()
-            self.metrics.start_collection()
+            self.metrics.clear(job_id=self.job_id)
+            if self.job_id is None:
+                self.metrics.start_collection()
         self.reconfig_log.append(result)
         return result
 
@@ -114,11 +130,15 @@ class OptimizationOrchestrator:
         self._stop.clear()
 
         def loop() -> None:
-            while not self._stop.wait(self.period_sec):
+            # first round immediately: a job shorter than one period still
+            # gets optimized once (then the periodic cadence takes over)
+            while True:
                 try:
                     self.run_once()
-                except Exception:  # noqa: BLE001 - keep optimizing
-                    pass
+                except Exception as e:  # noqa: BLE001 - keep optimizing
+                    self.errors.append(e)  # visible, never silently eaten
+                if self._stop.wait(self.period_sec):
+                    return
 
         self._thread = threading.Thread(target=loop, daemon=True, name="optimizer")
         self._thread.start()

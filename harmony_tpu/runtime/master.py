@@ -155,6 +155,10 @@ class ETMaster:
         # drops (a creator finishing first must not delete buffers under a
         # tenant still training).
         self._table_refs: Dict[str, int] = {}
+        # At most ONE optimization loop may drive a table's migrations:
+        # two orchestrators planning from stale snapshots would race
+        # competing Move/Unassociate plans against one block map.
+        self._optimizer_leases: set = set()
 
     # -- executors -------------------------------------------------------
 
@@ -260,6 +264,19 @@ class ETMaster:
     def data_axis_of(self, table_id: str) -> int:
         with self._lock:
             return self._data_axis.get(table_id, 1)
+
+    def acquire_optimizer_lease(self, table_id: str) -> bool:
+        """True if the caller may run the optimization loop for this table
+        (exclusive; see _optimizer_leases)."""
+        with self._lock:
+            if table_id in self._optimizer_leases:
+                return False
+            self._optimizer_leases.add(table_id)
+            return True
+
+    def release_optimizer_lease(self, table_id: str) -> None:
+        with self._lock:
+            self._optimizer_leases.discard(table_id)
 
     def _drop_table(self, table_id: str) -> None:
         """Release one reference; storage is freed when the last user drops

@@ -452,7 +452,6 @@ class DeviceHashTable:
         counterpart returns its storage array)."""
         return self._state
 
-
     @property
     def state(self) -> Tuple[jax.Array, jax.Array]:
         with self._lock:

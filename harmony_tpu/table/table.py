@@ -327,7 +327,6 @@ class DenseTable:
         exposes the same property over its (keys, values) pair)."""
         return self._arr
 
-
     def apply_step(self, step_fn, *extra):
         """Dispatch a functional step ``step_fn(arr, *extra) -> (new_arr, aux)``
         and commit its result atomically w.r.t. every other table accessor.

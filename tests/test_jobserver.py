@@ -504,3 +504,56 @@ class TestSharedTableLifetime:
             assert np.isfinite(losses).all()
         # fully released at the end: a later server could recreate the id
         assert "life-m" not in server.master.table_ids()
+
+
+class TestJobOptimizerLoop:
+    def test_job_reconfigures_itself_mid_training(self, devices):
+        """JobConfig.optimizer wires the per-job elasticity loop (the
+        reference's ETOptimizationOrchestrator run by the driver): a canned
+        add-one-server optimizer forces a live migration WHILE the job
+        trains under the JobServer; training stays correct and the result
+        reports the reconfiguration."""
+        server = JobServer(2, device_pool=DevicePool(devices[:4]))
+        server.start()
+        cfg = addvector_job("opt-addv", n=128, epochs=6, workers=1, slack=0)
+        cfg.optimizer = "add_one_server"
+        cfg.optimizer_period = 0.2
+        result = server.submit(cfg).result(timeout=300)
+        assert result.get("reconfigs", 0) >= 1, result
+        server.shutdown(timeout=60)
+
+    def test_homogeneous_optimizer_runs_quietly(self, devices):
+        """The real cost-model optimizer (not a canned plan) runs on live
+        metrics without breaking training; with a tiny balanced job it may
+        or may not reconfigure, but the job must stay correct."""
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        cfg = mlr_job("opt-mlr", n=256, epochs=4, workers=1)
+        cfg.optimizer = "homogeneous"
+        cfg.optimizer_period = 0.2
+        result = server.submit(cfg).result(timeout=300)
+        losses = result["workers"]["opt-mlr/w0"]["losses"]
+        assert losses[-1] < losses[0]
+        server.shutdown(timeout=60)
+
+    def test_one_jobs_reconfig_does_not_erase_tenant_metrics(self, devices):
+        """Job A's optimizer migrates A's table mid-run; job B's metrics
+        (and its exact ServerMetrics accounting) must survive untouched —
+        reconfiguration cleanup is scoped to the reconfiguring job."""
+        server = JobServer(2, device_pool=DevicePool(devices[:4]))
+        server.start()
+        a = addvector_job("iso-a", n=128, epochs=6, workers=1, slack=0)
+        a.optimizer = "add_one_server"
+        a.optimizer_period = 0.1
+        b = mlr_job("iso-b", n=256, epochs=4, workers=1)
+        ra = server.submit(a)
+        rb = server.submit(b)
+        res_a, res_b = ra.result(timeout=300), rb.result(timeout=300)
+        assert res_a.get("reconfigs", 0) >= 1, res_a
+        assert "optimizer_errors" not in res_a, res_a
+        # B's per-job accounting stayed exact despite A's migrations
+        b_pulls = sum(m.pull_count for m in server.metrics.server_metrics(job_id="iso-b"))
+        assert b_pulls == 4 * 4  # 4 epochs x 4 batches
+        # and B's batch series survived the reconfig window
+        assert server.metrics.worker_batch_metrics(job_id="iso-b")
+        server.shutdown(timeout=60)
