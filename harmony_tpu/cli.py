@@ -176,15 +176,45 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
         vocab = set_v if set_v is not None else user["data_args"]["vocab_size"]
         preset["app_params"]["vocab_size"] = vocab
         user["data_args"]["vocab_size"] = vocab
+    # Dolphin-only flags must fail LOUDLY on graph apps and before any jax
+    # work (same client-side validation stance as the --set overrides).
+    if preset["app_type"] == "pregel" and (
+        args.optimizer or args.model_chkp_period or args.offline_eval
+    ):
+        raise SystemExit(
+            "--optimizer / --model-chkp-period / --offline-eval apply to "
+            "dolphin (training) apps only; pregel jobs ignore them"
+        )
+    if args.offline_eval and args.model_chkp_period <= 0:
+        raise SystemExit(
+            "--offline-eval needs --model-chkp-period > 0: deferred "
+            "evaluation replays the checkpoint chain, and 0 chains nothing"
+        )
+    if args.optimizer:
+        from harmony_tpu.config.base import resolve_symbol
+        from harmony_tpu.jobserver.entity import DolphinJobEntity
+
+        ref = DolphinJobEntity._OPTIMIZERS.get(args.optimizer, args.optimizer)
+        try:
+            resolve_symbol(ref)
+        except Exception as e:  # typo'd names fail at submit, not mid-job
+            raise SystemExit(
+                f"unknown --optimizer {args.optimizer!r} "
+                f"(registry: {sorted(DolphinJobEntity._OPTIMIZERS)}): {e}"
+            )
     job_id = args.job_id or f"{app}-job"
     return JobConfig(
         job_id=job_id,
         app_type=preset["app_type"],
         trainer=preset["trainer"],
+        optimizer=args.optimizer,
+        optimizer_period=args.optimizer_period,
         params=TrainerParams(
             num_epochs=args.epochs,
             num_mini_batches=args.batches,
             clock_slack=args.slack,
+            model_chkp_period=args.model_chkp_period,
+            offline_model_eval=args.offline_eval,
             app_params=preset["app_params"],
         ),
         num_workers=args.workers,
@@ -208,6 +238,17 @@ def _common_job_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--graph-file", default=None,
                    help="edge-list file (pregel apps; replaces the synthetic graph)")
     p.add_argument("--max-supersteps", type=int, default=100)
+    p.add_argument("--optimizer", default=None,
+                   help="per-job elasticity loop: homogeneous | heterogeneous"
+                        " | add_one_server | delete_one_server | dotted path"
+                        " (the reference's -optimizer binding)")
+    p.add_argument("--optimizer-period", type=float, default=5.0,
+                   help="seconds between optimization rounds")
+    p.add_argument("--model-chkp-period", type=int, default=0,
+                   help="snapshot the model table every N epochs (0 = off)")
+    p.add_argument("--offline-eval", action="store_true",
+                   help="defer model evaluation over the checkpoint chain to"
+                        " jobserver shutdown")
 
 
 def main(argv: List[str] | None = None) -> int:

@@ -23,6 +23,10 @@ class _Args:
         self.data = []
         self.graph_file = None
         self.max_supersteps = 20
+        self.optimizer = None
+        self.optimizer_period = 5.0
+        self.model_chkp_period = 0
+        self.offline_eval = False
         self.__dict__.update(kw)
 
 
@@ -129,3 +133,37 @@ def test_preset_symbols_bind(app):
     else:
         fn = resolve_symbol(cfg.user["data_fn"])
         inspect.signature(fn).bind(**cfg.user["data_args"])
+
+
+def test_cli_flags_reach_job_config():
+    """--optimizer/--model-chkp-period/--offline-eval plumb into JobConfig."""
+    from harmony_tpu.cli import build_config
+
+    args = _Args(epochs=2, batches=2, workers=1)
+    args.optimizer = "homogeneous"
+    args.optimizer_period = 1.5
+    args.model_chkp_period = 2
+    args.offline_eval = True
+    cfg = build_config("mlr", args)
+    assert cfg.optimizer == "homogeneous"
+    assert cfg.optimizer_period == 1.5
+    assert cfg.params.model_chkp_period == 2
+    assert cfg.params.offline_model_eval is True
+
+
+def test_cli_rejects_misconfigured_flags():
+    from harmony_tpu.cli import build_config
+    import pytest
+
+    a = _Args()
+    a.offline_eval = True  # no chkp chain to replay
+    with pytest.raises(SystemExit, match="model-chkp-period"):
+        build_config("mlr", a)
+    b = _Args()
+    b.optimizer = "homogenous"  # typo: fails at submit, not mid-job
+    with pytest.raises(SystemExit, match="unknown --optimizer"):
+        build_config("mlr", b)
+    c = _Args()
+    c.optimizer = "homogeneous"  # dolphin-only flag on a graph app
+    with pytest.raises(SystemExit, match="dolphin"):
+        build_config("pagerank", c)
