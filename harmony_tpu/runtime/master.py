@@ -30,6 +30,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 from harmony_tpu.config.params import ExecutorConfig, TableConfig
 from harmony_tpu.parallel.mesh import DevicePool, build_mesh
@@ -70,6 +71,7 @@ class TableHandle:
         self._master = master
         self.table = table
         self.block_manager = bm
+        self._next_generated_key = 0  # NoneKey loads (see load docstring)
 
     @property
     def table_id(self) -> str:
@@ -104,13 +106,27 @@ class TableHandle:
         self.block_manager.rebalance(list(executor_ids))
         self._reshard_to_owners()
 
-    def load(self, paths: Sequence[str], parser, num_splits: int = 0) -> int:
-        """Bulk-load keyed records from files (ref: AllocatedTable.load ->
+    def load(
+        self,
+        paths: Sequence[str],
+        parser,
+        num_splits: int = 0,
+        generate_keys: bool = False,
+    ) -> int:
+        """Bulk-load records from files (ref: AllocatedTable.load ->
         TableLoadMsg -> BulkDataLoader -> table.multiPut). The driver
         computes exactly one split per owning executor (ExactNumSplit
-        semantics) and each split's records are parsed and inserted; the
-        parser must yield ``(keys, values)`` (ExistKeyBulkDataLoader — keys
-        come from the data). Returns the number of records loaded."""
+        semantics) and each split's records are parsed and inserted.
+
+        Two loader modes, mirroring the reference's BulkDataLoader impls:
+          * ``generate_keys=False`` — ExistKeyBulkDataLoader: the parser
+            yields ``(keys, values)``; keys come from the data.
+          * ``generate_keys=True``  — NoneKeyBulkDataLoader: the parser
+            yields values only; keys are GENERATED sequentially across the
+            splits (the reference's LocalKeyGenerator produces per-executor
+            block-local keys; single-controller, a global running offset
+            gives the same no-collision guarantee).
+        Returns the number of records loaded."""
         from harmony_tpu.data.splits import compute_splits, fetch_split
 
         n = num_splits or max(len(self.owning_executors()), 1)
@@ -119,7 +135,30 @@ class TableHandle:
             records = fetch_split(split)
             if not records:
                 continue
-            keys, values = parser.parse(records)
+            parsed = parser.parse(records)
+            if generate_keys:
+                if isinstance(parsed, tuple):
+                    raise ValueError(
+                        "generate_keys=True needs a values-only parser; "
+                        f"{type(parser).__name__}.parse returned a tuple "
+                        "(its keys would be discarded silently)"
+                    )
+                values = parsed
+                # the generator counter PERSISTS across load() calls (like
+                # the reference's LocalKeyGenerator): repeated loads append
+                # instead of silently overwriting earlier rows
+                start = self._next_generated_key
+                end = start + len(values)
+                if end > self.table.spec.config.capacity:
+                    raise ValueError(
+                        f"generated keys {start}..{end - 1} exceed table "
+                        f"capacity {self.table.spec.config.capacity}; the "
+                        "out-of-range rows would be dropped silently"
+                    )
+                keys = np.arange(start, end)
+                self._next_generated_key = end
+            else:
+                keys, values = parsed
             if len(keys):
                 self.table.multi_put(keys, values)
                 total += len(keys)

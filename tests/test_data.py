@@ -102,6 +102,39 @@ class TestBulkLoad:
         np.testing.assert_allclose(handle.table.get(5), [5.0, 5.5])
         np.testing.assert_allclose(handle.table.get(31), [31.0, 31.5])
 
+    def test_table_load_generated_keys(self, tmp_path, mesh8):
+        """NoneKeyBulkDataLoader semantics: rows carry no keys; the loader
+        generates collision-free sequential keys across splits (ref:
+        LocalKeyGenerator)."""
+        from harmony_tpu.data.parsers import CsvParser
+        from harmony_tpu.parallel.mesh import DevicePool
+        from harmony_tpu.runtime.master import ETMaster
+        import jax
+
+        p = tmp_path / "vals.csv"
+        p.write_text("\n".join(f"{float(i)},{float(i) + 0.5}" for i in range(24)) + "\n")
+        master = ETMaster(DevicePool(jax.devices()[:8]))
+        execs = master.add_executors(4)
+        handle = master.create_table(
+            TableConfig(table_id="nk", capacity=24, value_shape=(2,), num_blocks=8),
+            [e.id for e in execs],
+        )
+        n = handle.load([str(p)], CsvParser(), num_splits=3, generate_keys=True)
+        assert n == 24
+        got = handle.table.multi_get(list(range(24)))
+        np.testing.assert_allclose(got[:, 0], np.arange(24, dtype=np.float32))
+        np.testing.assert_allclose(got[:, 1] - got[:, 0], 0.5)
+        # keyed parser + generate_keys is a loud error, not silent key loss
+        import pytest
+
+        with pytest.raises(ValueError, match="values-only"):
+            handle.load([str(p)], CsvParser(label_col=0), generate_keys=True)
+        # the key generator persists across loads: a second load must not
+        # restart at key 0 and overwrite — here it exceeds capacity, which
+        # errors loudly instead of dropping rows silently
+        with pytest.raises(ValueError, match="capacity"):
+            handle.load([str(p)], CsvParser(), generate_keys=True)
+
     def test_load_dataset_for_training(self, text_file):
         path, _ = text_file
         keys, vals = load_dataset([path], KeyValueVectorParser(), num_splits=3)
