@@ -208,6 +208,7 @@ def make_sp_train_step(
     learning_rate: float = 0.1,
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
+    donate: bool = True,
 ):
     """Build a jitted SPMD train step: ``step(params, tokens) ->
     (new_params, loss)`` with batch over ``data_axis`` and sequence over
@@ -238,7 +239,10 @@ def make_sp_train_step(
 
     tok_spec = P(data_axis, seq_axis)
 
-    @jax.jit
+    # donate=True (default): the update aliases params in place instead of
+    # holding old AND new parameter buffers live across the step (2x param
+    # HBM on TPU). Callers needing the pre-step params pass donate=False.
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(params, tokens):
         targets, mask = _lm_targets_and_mask(tokens)
         return jax.shard_map(
@@ -295,6 +299,7 @@ def make_parallel_train_step(
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
     model_axis: str = "model",
+    donate: bool = True,
 ):
     """Build the full 3-axis SPMD train step: batch over ``data_axis``,
     sequence over ``seq_axis`` (ring attention), and tensor parallelism
@@ -380,7 +385,7 @@ def make_parallel_train_step(
 
     tok_spec = P(data_axis, seq_axis)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())  # see make_sp_train_step
     def step(tp_params, tokens):
         targets, mask = _lm_targets_and_mask(tokens)
         return jax.shard_map(

@@ -52,7 +52,8 @@ def test_sp_step_matches_single_device(devices):
     params = model.init(jax.random.PRNGKey(1))
     tokens = jnp.asarray(make_lm_data(4, 32, CFG.vocab_size, seed=2))
 
-    sp_step = make_sp_train_step(model, mesh, learning_rate=0.1)
+    # donate=False: this parity test reuses the pre-step params below
+    sp_step = make_sp_train_step(model, mesh, learning_rate=0.1, donate=False)
     new_sp, loss_sp = sp_step(params, tokens)
 
     def ref_loss(p):
@@ -181,7 +182,9 @@ def test_parallel_step_matches_single_device(devices):
     params = model.init(jax.random.PRNGKey(3))
     tokens = jnp.asarray(make_lm_data(4, 32, CFG.vocab_size, seed=4))
 
-    step, shard_params = make_parallel_train_step(model, mesh, learning_rate=0.1)
+    # donate=False: this parity test reuses the pre-step params below
+    step, shard_params = make_parallel_train_step(model, mesh, learning_rate=0.1,
+                                                  donate=False)
     tp_params = shard_params(params)
     new_tp, loss_tp = step(tp_params, tokens)
 
