@@ -166,3 +166,55 @@ class TestMinMaxUpdateFns:
         t.update(3, np.float32(a))
         t.update(3, np.float32(b))
         assert float(t.get(3)) == expect
+
+
+class TestRound1Surfaces:
+    """Direct coverage for this round's new public surfaces, so a rename
+    breaks loudly here before it breaks a user."""
+
+    def test_sparse_table_public_api(self, mesh8):
+        from harmony_tpu.config import TableConfig
+        from harmony_tpu.table import DeviceHashTable, HashTableSpec
+        from harmony_tpu.table.hashtable import MAX_KEY, MIN_KEY
+
+        assert MIN_KEY == 1 and MAX_KEY == 2**31 - 3
+        t = DeviceHashTable(
+            HashTableSpec(TableConfig(table_id="api", capacity=64,
+                                      value_shape=(2,), num_blocks=4,
+                                      sparse=True)),
+            mesh8,
+        )
+        for name in ("multi_get", "multi_get_or_init", "multi_update",
+                     "multi_put", "apply_step", "reshard", "export_blocks",
+                     "import_blocks", "snapshot_blocks", "num_present",
+                     "count_dropped", "overflow_count", "items", "drop"):
+            assert hasattr(t, name), name
+        for name in ("pull", "push", "ensure", "lookup", "put", "init_state"):
+            assert hasattr(t.spec, name), name
+
+    def test_job_config_round1_fields(self):
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+
+        cfg = JobConfig(job_id="x", app_type="dolphin",
+                        optimizer="homogeneous", optimizer_period=2.0,
+                        params=TrainerParams(model_chkp_period=1,
+                                             offline_model_eval=True))
+        # round-trips through the serializable config system (TCP submit)
+        from harmony_tpu.config.base import ConfigBase
+
+        back = ConfigBase.from_dict(cfg.to_dict())
+        assert back.optimizer == "homogeneous"
+        assert back.params.offline_model_eval is True
+
+    def test_trainer_spi_round1_hooks(self):
+        from harmony_tpu.dolphin.trainer import Trainer
+
+        assert Trainer.objective_metric is None
+        assert hasattr(Trainer, "mask_delta")
+
+    def test_jobserver_round1_surfaces(self):
+        from harmony_tpu.jobserver.server import JobServer
+
+        srv = JobServer(0)
+        for name in ("eval_results", "_run_deferred_evals"):
+            assert hasattr(srv, name), name
