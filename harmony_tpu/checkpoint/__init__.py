@@ -1,3 +1,9 @@
+from harmony_tpu.checkpoint.backends import (
+    CommitBackend,
+    OrbaxCommitBackend,
+    PosixCommitBackend,
+    make_commit_backend,
+)
 from harmony_tpu.checkpoint.manager import (
     CheckpointInfo,
     CheckpointManager,
@@ -10,4 +16,8 @@ __all__ = [
     "CheckpointInfo",
     "CheckpointStillWriting",
     "PendingCheckpoint",
+    "CommitBackend",
+    "PosixCommitBackend",
+    "OrbaxCommitBackend",
+    "make_commit_backend",
 ]

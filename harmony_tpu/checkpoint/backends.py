@@ -1,0 +1,223 @@
+"""Pluggable durable-commit backends for two-stage checkpointing.
+
+The reference's stage-2 commit moves per-block temp files into HDFS
+(ref: services/et/.../evaluator/impl/ChkpManagerSlave.java:50-63); the
+durable store is a deployment choice, not part of the protocol. Here the
+commit stage is an SPI so the same CheckpointManager drives:
+
+  * :class:`PosixCommitBackend` — durable directory on a mounted
+    filesystem (local disk, NFS, a FUSE-mounted bucket). Atomic same-FS
+    rename commit; the default, and the only backend tests need.
+  * :class:`OrbaxCommitBackend` — the checkpoint is committed as ONE
+    Orbax/tensorstore checkpoint at any path orbax can write, including
+    ``gs://`` object-store URLs on TPU pods (SURVEY.md §5.9.4's
+    GCS/tensorstore prescription). Fetch materializes blocks back into a
+    local cache dir so the restore path stays identical.
+
+Backends store the staged checkpoint directory (block files + a
+``manifest.json`` whose ``committed`` flag they flip to True) under the
+checkpoint id, and hand back a local directory on fetch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import List, Optional
+
+import numpy as np
+
+
+class CommitBackend:
+    """SPI: durable storage for committed checkpoints."""
+
+    def exists(self, chkp_id: str) -> bool:
+        raise NotImplementedError
+
+    def commit(self, chkp_id: str, src_dir: str) -> None:
+        """Persist ``src_dir`` (blocks + manifest.json) durably under
+        ``chkp_id``, with the stored manifest's ``committed`` flag True.
+        Must be atomic: a crash mid-commit must leave the id unresolvable,
+        never resolvable-but-partial."""
+        raise NotImplementedError
+
+    def fetch(self, chkp_id: str) -> Optional[str]:
+        """Local directory holding the committed checkpoint's files, or
+        None if the id is not committed here."""
+        raise NotImplementedError
+
+    def delete(self, chkp_id: str) -> None:
+        raise NotImplementedError
+
+    def list_ids(self) -> List[str]:
+        raise NotImplementedError
+
+
+class PosixCommitBackend(CommitBackend):
+    """Durable directory + atomic rename (the original commit path)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def exists(self, chkp_id: str) -> bool:
+        return os.path.isdir(os.path.join(self.root, chkp_id))
+
+    def commit(self, chkp_id: str, src_dir: str) -> None:
+        # Crash-safe across filesystems: copy into a .staging dir INSIDE
+        # the durable root, then rename into place (same-FS rename =
+        # atomic). A crash mid-copy leaves only a .staging orphan.
+        dst = os.path.join(self.root, chkp_id)
+        staging = dst + ".staging"
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)  # leftover from a crashed commit
+        shutil.copytree(src_dir, staging)
+        manifest = os.path.join(staging, "manifest.json")
+        with open(manifest) as f:
+            info = json.load(f)
+        info["committed"] = True
+        with open(manifest, "w") as f:
+            json.dump(info, f, sort_keys=True)
+        os.rename(staging, dst)
+
+    def fetch(self, chkp_id: str) -> Optional[str]:
+        d = os.path.join(self.root, chkp_id)
+        return d if os.path.isdir(d) else None
+
+    def delete(self, chkp_id: str) -> None:
+        d = os.path.join(self.root, chkp_id)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+
+    def list_ids(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.root)
+            if not d.endswith(".staging") and not d.endswith(".writing")
+            and os.path.isdir(os.path.join(self.root, d))
+        )
+
+
+class OrbaxCommitBackend(CommitBackend):
+    """Commit to an Orbax/tensorstore location (object stores included).
+
+    Layout per checkpoint: one PyTree checkpoint at ``<root>/<chkp_id>``
+    holding ``{"manifest": <json str>, "blocks": {"<bid>": uint8 bytes}}``
+    — blocks travel as the exact bytes of their staged files, so the CRC
+    trailer of ``.blk``-coded blocks survives the round trip and torn
+    objects still fail loudly at restore. Orbax's own finalize step makes
+    the object-store write atomic (a crashed save never lists).
+    """
+
+    def __init__(self, root: str, cache_root: Optional[str] = None) -> None:
+        self.root = root if _is_url(root) else os.path.abspath(root)
+        self.cache_root = cache_root  # local materialization dir for fetch
+        self._fetched: dict = {}
+
+    def _path(self, chkp_id: str) -> str:
+        return (f"{self.root.rstrip('/')}/{chkp_id}" if _is_url(self.root)
+                else os.path.join(self.root, chkp_id))
+
+    @staticmethod
+    def _checkpointer():
+        import orbax.checkpoint as ocp
+
+        return ocp.PyTreeCheckpointer()
+
+    def exists(self, chkp_id: str) -> bool:
+        path = self._path(chkp_id)
+        if _is_url(path):
+            try:
+                self._checkpointer().metadata(path)
+                return True
+            except Exception:
+                return False
+        # a finalized orbax dir always carries its metadata file
+        return os.path.isdir(path)
+
+    def commit(self, chkp_id: str, src_dir: str) -> None:
+        with open(os.path.join(src_dir, "manifest.json")) as f:
+            info = json.load(f)
+        info["committed"] = True
+        blocks = {}
+        for name in os.listdir(src_dir):
+            if name == "manifest.json":
+                continue
+            with open(os.path.join(src_dir, name), "rb") as f:
+                blocks[name] = np.frombuffer(f.read(), np.uint8)
+        tree = {"manifest": json.dumps(info, sort_keys=True), "blocks": blocks}
+        self._checkpointer().save(self._path(chkp_id), tree)
+
+    def fetch(self, chkp_id: str) -> Optional[str]:
+        cached = self._fetched.get(chkp_id)
+        if cached and os.path.isdir(cached):
+            return cached
+        if not self.exists(chkp_id):
+            return None
+        tree = self._checkpointer().restore(self._path(chkp_id))
+        base = self.cache_root or os.path.join(
+            os.path.expanduser("~"), ".cache", "harmony_tpu", "chkp-fetch"
+        )
+        d = os.path.join(base, chkp_id)
+        staging = d + ".writing"
+        os.makedirs(staging, exist_ok=True)
+        try:
+            for name, data in tree["blocks"].items():
+                with open(os.path.join(staging, name), "wb") as f:
+                    f.write(np.asarray(data, np.uint8).tobytes())
+            with open(os.path.join(staging, "manifest.json"), "w") as f:
+                f.write(tree["manifest"])
+            if os.path.isdir(d):
+                shutil.rmtree(d)
+            os.rename(staging, d)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._fetched[chkp_id] = d
+        return d
+
+    def delete(self, chkp_id: str) -> None:
+        cached = self._fetched.pop(chkp_id, None)
+        if cached and os.path.isdir(cached):
+            shutil.rmtree(cached)
+        path = self._path(chkp_id)
+        if not _is_url(path) and os.path.isdir(path):
+            shutil.rmtree(path)
+        elif _is_url(path):  # pragma: no cover - needs a live object store
+            from etils import epath
+
+            epath.Path(path).rmtree()
+
+    def list_ids(self) -> List[str]:
+        # filter orbax's in-flight temp dirs (".orbax-checkpoint-tmp"
+        # siblings of a crashed/in-progress save) — same reason the posix
+        # backend filters ".staging"/".writing": an unfinished commit must
+        # never surface as a restorable id
+        if _is_url(self.root):  # pragma: no cover - needs a live object store
+            from etils import epath
+
+            return sorted(p.name for p in epath.Path(self.root).iterdir()
+                          if ".orbax-checkpoint-tmp" not in p.name)
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+            and ".orbax-checkpoint-tmp" not in d
+        )
+
+
+def _is_url(path: str) -> bool:
+    return "://" in path
+
+
+def make_commit_backend(commit_root: str, backend=None) -> CommitBackend:
+    """Resolve the commit stage: an explicit CommitBackend instance, the
+    names "posix"/"orbax", or by inspection of ``commit_root`` (object-store
+    URLs need tensorstore, so they get the orbax backend)."""
+    if isinstance(backend, CommitBackend):
+        return backend
+    if backend == "orbax" or (backend is None and _is_url(commit_root)):
+        return OrbaxCommitBackend(commit_root)
+    if backend in (None, "posix"):
+        return PosixCommitBackend(commit_root)
+    raise ValueError(f"unknown commit backend {backend!r}")

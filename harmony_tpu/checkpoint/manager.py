@@ -147,11 +147,17 @@ class CheckpointManager:
     block IO collapsed in (single-controller: the master can reach every
     shard directly via the table's export/import)."""
 
-    def __init__(self, temp_root: str, commit_root: str) -> None:
+    def __init__(self, temp_root: str, commit_root: str, backend=None) -> None:
+        """``commit_root`` names the durable store: a directory (posix
+        backend), or an object-store URL like ``gs://bucket/chkps`` (orbax/
+        tensorstore backend). ``backend`` overrides the inference — a name
+        ("posix"/"orbax") or a CommitBackend instance (see backends.py)."""
+        from harmony_tpu.checkpoint.backends import make_commit_backend
+
         self.temp_root = temp_root
         self.commit_root = commit_root
         os.makedirs(temp_root, exist_ok=True)
-        os.makedirs(commit_root, exist_ok=True)
+        self._backend = make_commit_backend(commit_root, backend)
         self._lock = threading.Lock()
         self._counter = 0
 
@@ -273,38 +279,26 @@ class CheckpointManager:
     def commit(self, chkp_id: str) -> None:
         """Stage 2: move temp -> durable (ref: commit on executor close).
 
-        Crash-safe across filesystems: the data is first copied to a
-        ``.staging`` directory INSIDE the durable root, then renamed into
-        place (same-FS rename = atomic), then the temp copy is removed. A
-        crash mid-copy leaves only a .staging orphan — the real id never
-        resolves to a partial directory, and the temp copy stays restorable.
-        """
+        Delegated to the pluggable CommitBackend (atomic per its store:
+        same-FS rename for posix, orbax finalize for object stores); the
+        temp copy is removed only after the durable write lands, so a crash
+        mid-commit leaves the temp copy restorable. Idempotent: a retry
+        after a crash between the durable write and the temp cleanup just
+        finishes the cleanup."""
         src = os.path.join(self.temp_root, chkp_id)
-        dst = os.path.join(self.commit_root, chkp_id)
-        if os.path.isdir(dst):
-            # Already committed (e.g. a crash landed between the rename and
-            # the temp cleanup of a previous commit): finish the cleanup and
-            # treat the retry as success — commit is idempotent.
+        if self._backend.exists(chkp_id):
             shutil.rmtree(src, ignore_errors=True)
             return
         if not os.path.isdir(src):
             raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
-        info = self._load_manifest(src)
-        info.committed = True
-        staging = dst + ".staging"
-        if os.path.isdir(staging):
-            shutil.rmtree(staging)  # leftover from a crashed commit
-        shutil.copytree(src, staging)
-        with open(os.path.join(staging, "manifest.json"), "w") as f:
-            f.write(info.to_json())
-        os.rename(staging, dst)
+        self._backend.commit(chkp_id, src)
         shutil.rmtree(src)
 
     # -- read path -------------------------------------------------------
 
     def _dir_of(self, chkp_id: str) -> str:
-        committed = os.path.join(self.commit_root, chkp_id)
-        if os.path.isdir(committed):
+        committed = self._backend.fetch(chkp_id)
+        if committed is not None:
             return committed
         temp = os.path.join(self.temp_root, chkp_id)
         if os.path.isdir(temp):
@@ -320,17 +314,12 @@ class CheckpointManager:
         return self._load_manifest(self._dir_of(chkp_id))
 
     def list_checkpoints(self) -> List[str]:
-        out = set(os.listdir(self.commit_root)) | set(os.listdir(self.temp_root))
-        return sorted(
-            d
-            for d in out
-            if not d.endswith(".staging")
-            and not d.endswith(".writing")
-            and (
-                os.path.isdir(os.path.join(self.commit_root, d))
-                or os.path.isdir(os.path.join(self.temp_root, d))
-            )
+        temp = set(
+            d for d in os.listdir(self.temp_root)
+            if not d.endswith(".staging") and not d.endswith(".writing")
+            and os.path.isdir(os.path.join(self.temp_root, d))
         )
+        return sorted(temp | set(self._backend.list_ids()))
 
     def restore(
         self,
@@ -372,9 +361,12 @@ class CheckpointManager:
 
     def delete(self, chkp_id: str) -> None:
         """Remove every copy (a crashed commit can leave the checkpoint in
-        both the temp and durable roots — delete both)."""
-        self._dir_of(chkp_id)  # raises if the id exists nowhere
-        for root in (self.commit_root, self.temp_root):
-            d = os.path.join(root, chkp_id)
-            if os.path.isdir(d):
-                shutil.rmtree(d)
+        both the temp and durable roots — delete both). Existence is checked
+        via ``backend.exists`` — NOT ``_dir_of``, whose fetch() would
+        download a remote checkpoint in full just to delete it."""
+        temp = os.path.join(self.temp_root, chkp_id)
+        if not self._backend.exists(chkp_id) and not os.path.isdir(temp):
+            raise FileNotFoundError(f"checkpoint {chkp_id} not found")
+        self._backend.delete(chkp_id)
+        if os.path.isdir(temp):
+            shutil.rmtree(temp)

@@ -148,6 +148,12 @@ class DolphinJobEntity(JobEntity):
         # documented 'all executors' default, ref SchedulerImpl runs on all).
         num_workers = cfg.num_workers or len(self._executor_ids)
         nb = params.num_mini_batches
+        from harmony_tpu.jobserver.joblog import job_logger
+
+        job_logger(cfg.job_id).info(
+            "training: %d worker(s), %d epoch(s) x %d mini-batch(es)",
+            num_workers, params.num_epochs, nb,
+        )
         self.progress = BatchProgressTracker(nb)
         # Model-checkpoint chaining (ref: ModelChkpManager wired by
         # DolphinMaster.start:186-189): snapshots run off the CHIEF worker's
@@ -331,17 +337,24 @@ class DolphinJobEntity(JobEntity):
         # rather than racing competing migration plans.
         if not self._master.acquire_optimizer_lease(self._handle.table_id):
             return None
-        from harmony_tpu.optimizer import OptimizationOrchestrator
+        try:
+            from harmony_tpu.optimizer import OptimizationOrchestrator
 
-        cls = resolve_symbol(self._OPTIMIZERS.get(name, name))
-        return OptimizationOrchestrator(
-            self._master,
-            self._handle,
-            cls(),
-            self._metric_manager,
-            period_sec=self.config.optimizer_period,
-            job_id=self.config.job_id,
-        )
+            cls = resolve_symbol(self._OPTIMIZERS.get(name, name))
+            return OptimizationOrchestrator(
+                self._master,
+                self._handle,
+                cls(),
+                self._metric_manager,
+                period_sec=self.config.optimizer_period,
+                job_id=self.config.job_id,
+            )
+        except BaseException:
+            # run()'s finally only releases through the orchestrator; a
+            # construction failure here would otherwise hold the lease
+            # forever and make every resubmission train unoptimized
+            self._master.release_optimizer_lease(self._handle.table_id)
+            raise
 
     @staticmethod
     def _compose_epoch_hooks(*hooks):

@@ -1,0 +1,33 @@
+"""Per-job prefixed operator logging.
+
+Rebuilds the reference's JobLogger (ref: jobserver/src/main/java/edu/snu/
+cay/jobserver/JobLogger.java:34-75): a multi-tenant server interleaves many
+jobs' lifecycle events in one operator log, so every job-scoped line carries
+a ``[JobId: <id>]`` prefix. The reference injects a JobLogger per job via
+Tang and re-infers the caller frame by hand; here the analogue is a
+``logging.LoggerAdapter`` over the shared ``harmony_tpu.jobserver`` logger —
+stdlib logging already records the caller, handlers/levels stay configurable
+by the host application, and the adapter is cheap enough to create per job.
+"""
+from __future__ import annotations
+
+import logging
+
+#: Shared base logger for server-scoped (not job-scoped) events.
+server_log = logging.getLogger("harmony_tpu.jobserver")
+
+
+class JobLogger(logging.LoggerAdapter):
+    """Logger whose every message is prefixed with the owning job's id."""
+
+    def __init__(self, job_id: str, logger: logging.Logger | None = None) -> None:
+        super().__init__(logger or server_log, {"job_id": job_id})
+        self.job_id = job_id
+
+    def process(self, msg, kwargs):
+        return f"[JobId: {self.job_id}] {msg}", kwargs
+
+
+def job_logger(job_id: str) -> JobLogger:
+    """The per-job logger factory (the Tang-injection analogue)."""
+    return JobLogger(job_id)

@@ -1,0 +1,268 @@
+"""Multi-host JobServer — the driver/evaluator split over real processes.
+
+The reference's JobServer is a driver PROCESS coordinating remote evaluator
+JVMs (ref: jobserver/src/main/java/edu/snu/cay/jobserver/driver/
+JobServerDriver.java:149-163, ResourcePool.java:73-81). The TPU-pod
+equivalent keeps the same split with JAX's multi-controller SPMD model:
+
+  * every host process joins one ``jax.distributed`` runtime
+    (parallel/multihost.py), after which ``jax.devices()`` is the GLOBAL
+    chip list on all of them;
+  * process 0 runs the :class:`PodJobServer` — the ordinary JobServer
+    (scheduling, registry, TCP submit endpoint) plus a pod control plane;
+  * every other process runs a :class:`PodFollower` loop.
+
+Control plane (DCN, JSON-over-TCP — same framing as client.py): followers
+JOIN the leader; for each dispatched job the leader broadcasts RUN_JOB with
+the serialized JobConfig and executor grant, every process builds the SAME
+JobEntity and runs it, and the jitted train steps inside are global-mesh
+SPMD programs — their XLA collectives (ICI/DCN) are the data plane and the
+de-facto barrier, exactly the reference's msg-plus-collective split
+(SURVEY.md §5.8). At job end followers report JOB_DONE with their local
+worker metrics, which the leader records per process id — the cross-process
+metric flow the reference routes through its MetricManager msg senders.
+
+Determinism contract (what makes lockstep correct): entity construction is
+a pure function of the JobConfig, executor ids are allocated by a fresh
+per-process counter in identical order, and synthetic/file data loading is
+seeded — so all processes issue the same global computations in the same
+order. Pod jobs are serialized by the leader (one RUN_JOB at a time): two
+concurrently-dispatched jobs would interleave their collectives in
+process-dependent order and deadlock the mesh.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from harmony_tpu.config.base import ConfigBase
+from harmony_tpu.config.params import JobConfig
+from harmony_tpu.jobserver.joblog import job_logger, server_log
+from harmony_tpu.jobserver.server import JobServer
+
+
+def _send(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    sock.sendall((json.dumps(msg) + "\n").encode())
+
+
+def _recv(f) -> Optional[Dict[str, Any]]:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class PodJobServer(JobServer):
+    """JobServer on process 0 of a pod: adds the follower control plane."""
+
+    def __init__(self, *args, num_followers: int = 0, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._num_followers = num_followers
+        self._pod_sock: Optional[socket.socket] = None
+        self._followers: Dict[int, Any] = {}  # pid -> (sock, reader file)
+        self._pod_lock = threading.Lock()  # serializes pod job execution
+        #: job_id -> {pid: follower JOB_DONE payload}
+        self.pod_reports: Dict[str, Dict[int, Dict[str, Any]]] = {}
+
+    # -- follower management --------------------------------------------
+
+    def serve_pod(self, port: int = 0, join_timeout: float = 300.0) -> int:
+        """Listen for follower JOINs; blocks until all ``num_followers``
+        processes have joined (startup is a pod-wide barrier — dispatching
+        before the pod is whole would hang the first collective anyway)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("0.0.0.0", port))
+        sock.listen(16)
+        self._pod_sock = sock
+        bound = sock.getsockname()[1]
+        sock.settimeout(join_timeout)
+        while len(self._followers) < self._num_followers:
+            try:
+                conn, addr = sock.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"pod join: {len(self._followers)}/{self._num_followers} "
+                    f"followers after {join_timeout}s"
+                )
+            f = conn.makefile("r")
+            hello = _recv(f)
+            if not hello or hello.get("cmd") != "JOIN":
+                conn.close()
+                continue
+            pid = int(hello["pid"])
+            self._followers[pid] = (conn, f)
+            server_log.info("pod follower %d joined from %s", pid, addr)
+        return bound
+
+    def _broadcast(self, msg: Dict[str, Any]) -> None:
+        for pid, (conn, _) in sorted(self._followers.items()):
+            _send(conn, msg)
+
+    def _collect_done(self, job_id: str, timeout: float) -> Dict[int, Dict[str, Any]]:
+        """One JOB_DONE per follower; a silent follower is recorded as an
+        error entry rather than wedging the leader forever. A stale report
+        from an earlier job (its collection timed out; the follower finished
+        late) is skipped, never attributed to this job."""
+        deadline = time.monotonic() + timeout
+        out: Dict[int, Dict[str, Any]] = {}
+        for pid, (conn, f) in sorted(self._followers.items()):
+            while pid not in out:
+                conn.settimeout(max(0.1, deadline - time.monotonic()))
+                try:
+                    msg = _recv(f)
+                except (socket.timeout, OSError) as e:
+                    out[pid] = {"ok": False, "error": f"follower read: {e}"}
+                    continue
+                if msg is None:
+                    out[pid] = {"ok": False,
+                                "error": "follower closed connection"}
+                elif msg.get("job_id") == job_id:
+                    out[pid] = msg
+                else:  # stale report from a timed-out earlier collection
+                    server_log.warning(
+                        "pod: dropping stale report from follower %d "
+                        "(job %s, collecting %s)",
+                        pid, msg.get("job_id"), job_id,
+                    )
+        return out
+
+    # -- dispatch override ------------------------------------------------
+
+    def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
+        with self._pod_lock:  # one pod job at a time (see module doc)
+            if self._followers:
+                job_logger(config.job_id).info(
+                    "pod: broadcasting RUN_JOB to %d follower(s)",
+                    len(self._followers),
+                )
+                self._broadcast({
+                    "cmd": "RUN_JOB",
+                    "conf": config.to_dict(),
+                    "executor_ids": list(executor_ids),
+                    # Followers must build the entity with the SAME aux
+                    # components: the TaskUnit schedulers change how the
+                    # worker phases its device dispatches (fused vs split
+                    # PULL/COMP/PUSH), and any asymmetry there is a
+                    # cross-process collective mismatch.
+                    "cpu_slots": self.local_taskunit.cpu_slots,
+                    "net_slots": self.local_taskunit.net_slots,
+                })
+            super()._dispatch(config, executor_ids)
+            if self._followers:
+                self.pod_reports[config.job_id] = self._collect_done(
+                    config.job_id, timeout=600.0
+                )
+
+    def shutdown(self, timeout: Optional[float] = 300.0) -> None:
+        super().shutdown(timeout)
+        # The job futures resolve BEFORE follower reports are collected, so
+        # a client reacting to job completion can reach shutdown while
+        # _dispatch is still reading JOB_DONEs; taking the pod lock here
+        # orders the socket teardown after that collection.
+        with self._pod_lock:
+            pass
+        if self._followers:
+            try:
+                self._broadcast({"cmd": "SHUTDOWN"})
+            except OSError:
+                pass
+            for conn, f in self._followers.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._followers.clear()
+        if self._pod_sock is not None:
+            self._pod_sock.close()
+            self._pod_sock = None
+
+
+class PodFollower:
+    """Evaluator-side loop on processes 1..N-1 of a pod.
+
+    Mirrors the leader's job lifecycle against a local ETMaster whose
+    executor ids — produced by the same fresh-process allocation order —
+    name the same global devices as the leader's."""
+
+    def __init__(self, leader_host: str, pod_port: int, pid: int,
+                 num_executors: int, join_timeout: float = 300.0) -> None:
+        self.pid = pid
+        # The leader may still be initializing its runtime when followers
+        # come up (hosts boot in any order): retry until the deadline.
+        deadline = time.monotonic() + join_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (leader_host, pod_port), timeout=10.0
+                )
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        self._sock.settimeout(None)  # RUN_JOB may arrive much later
+        self._file = self._sock.makefile("r")
+        _send(self._sock, {"cmd": "JOIN", "pid": pid})
+
+        from harmony_tpu.metrics.manager import MetricManager
+        from harmony_tpu.runtime.master import ETMaster
+
+        self.master = ETMaster()
+        self.master.add_executors(num_executors)
+        self.metrics = MetricManager()
+        self.metrics.start_collection()
+
+    def run(self) -> None:
+        """Serve RUN_JOB commands until SHUTDOWN (or leader hangup)."""
+        from harmony_tpu.jobserver.entity import build_entity
+        from harmony_tpu.runtime.taskunit import (
+            GlobalTaskUnitScheduler,
+            LocalTaskUnitScheduler,
+        )
+
+        global_tu = GlobalTaskUnitScheduler()
+        while True:
+            msg = _recv(self._file)
+            if msg is None or msg.get("cmd") == "SHUTDOWN":
+                self._sock.close()
+                return
+            assert msg.get("cmd") == "RUN_JOB", msg
+            config = ConfigBase.from_dict(msg["conf"])
+            executor_ids = msg["executor_ids"]
+            report: Dict[str, Any] = {
+                "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,
+            }
+            try:
+                missing = set(executor_ids) - set(self.master.executor_ids())
+                if missing:
+                    raise RuntimeError(
+                        f"follower {self.pid} missing executors {missing} "
+                        "(leader/follower allocation orders diverged)"
+                    )
+                # Mirror the leader's entity EXACTLY (see RUN_JOB comment):
+                # same taskunit phasing, a local metric pipeline of our own.
+                entity = build_entity(
+                    config,
+                    global_taskunit=global_tu,
+                    local_taskunit=LocalTaskUnitScheduler(
+                        msg.get("cpu_slots", 1), msg.get("net_slots", 2)
+                    ),
+                    metric_sink=self.metrics.on_metric,
+                    metric_manager=self.metrics,
+                )
+                entity.setup(self.master, executor_ids)
+                result = entity.run()
+                entity.cleanup()
+                report["ok"] = True
+                report["workers"] = {
+                    wid: {"losses": [float(x) for x in w.get("losses", [])]}
+                    for wid, w in result.get("workers", {}).items()
+                }
+            except BaseException as e:  # noqa: BLE001 - reported to leader
+                report["ok"] = False
+                report["error"] = f"{type(e).__name__}: {e}"
+            _send(self._sock, report)

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.entity import JobEntity, build_entity
+from harmony_tpu.jobserver.joblog import job_logger, server_log
 from harmony_tpu.jobserver.scheduler import JobScheduler, ShareAllScheduler, make_scheduler
 from harmony_tpu.metrics.manager import MetricManager
 from harmony_tpu.parallel.mesh import DevicePool
@@ -90,6 +91,8 @@ class JobServer:
         executors = self.master.add_executors(self._num_executors)
         self._scheduler.bind([e.id for e in executors], self._launch)
         self._state.transition("INIT")
+        server_log.info("jobserver up: %d executors, scheduler=%s",
+                        len(executors), type(self._scheduler).__name__)
 
     def shutdown(self, timeout: Optional[float] = 300.0) -> None:
         """Graceful: stop accepting, drain running jobs, close (ref:
@@ -103,6 +106,9 @@ class JobServer:
         and the stragglers stay visible through their futures."""
         with self._lock:
             initiated = self._state.compare_and_transition("INIT", "CLOSING")
+        if initiated:
+            server_log.info("shutdown initiated; draining %d running job(s)",
+                            len(self.running_jobs()))
         if not initiated:
             self._state.wait_for("CLOSED", timeout=timeout)
             return
@@ -204,6 +210,10 @@ class JobServer:
                     del self._jobs[jid]
             jr = JobResult()
             self._jobs[config.job_id] = jr
+        job_logger(config.job_id).info(
+            "submitted (app_type=%s, workers=%d)",
+            config.app_type, config.num_workers,
+        )
         self._scheduler.on_job_arrival(config)
         return jr.future
 
@@ -223,6 +233,9 @@ class JobServer:
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
         jr = self._jobs[config.job_id]
+        jlog = job_logger(config.job_id)
+        jlog.info("dispatched on executors %s", executor_ids)
+        t0 = time.monotonic()
         entity = None
         try:
             # build_entity inside the try: an unknown app_type or bad config
@@ -248,8 +261,11 @@ class JobServer:
                 with self._lock:
                     self._deferred_evals[config.job_id] = deferred
             entity.cleanup()
+            jlog.info("finished in %.1fs", time.monotonic() - t0)
             jr.future.set_result(result)
         except BaseException as e:  # noqa: BLE001 - delivered via future
+            jlog.error("failed after %.1fs: %s: %s",
+                       time.monotonic() - t0, type(e).__name__, e)
             if entity is not None:
                 try:
                     entity.cleanup()

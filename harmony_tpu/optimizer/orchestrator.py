@@ -106,6 +106,12 @@ class OptimizationOrchestrator:
         if dplan.empty:
             return None
         plan = self._compiler.compile(dplan, self.handle.table_id)
+        if self.job_id is not None:
+            from harmony_tpu.jobserver.joblog import job_logger
+
+            job_logger(self.job_id).info(
+                "reconfiguring table %s: %s", self.handle.table_id, dplan
+            )
         # Migration-window samples are skewed and must not feed the next
         # round's cost estimate. Single-tenant: pause+clear the manager
         # (ref: MetricManager pause/resume). Multi-tenant (job_id set):

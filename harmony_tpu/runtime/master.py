@@ -38,6 +38,14 @@ from harmony_tpu.table.ownership import BlockManager
 from harmony_tpu.table.table import DenseTable, TableSpec
 
 
+def _table_min_key(table) -> int:
+    """Smallest key a table admits: 1 for sparse hash tables (key 0 is
+    reserved as XLA's scatter pad value), 0 for dense tables."""
+    from harmony_tpu.table.hashtable import MIN_KEY, DeviceHashTable
+
+    return MIN_KEY if isinstance(table, DeviceHashTable) else 0
+
+
 def _mesh_over(devices: Sequence[jax.Device], data_axis: int):
     """(data, model) mesh over ONE device set: collocation means the same
     devices appear on both axes as a factorization (each chip holds a model
@@ -148,6 +156,10 @@ class TableHandle:
                 # the reference's LocalKeyGenerator): repeated loads append
                 # instead of silently overwriting earlier rows
                 start = self._next_generated_key
+                if start < _table_min_key(self.table):
+                    # sparse tables reserve key 0 (hashtable MIN_KEY: XLA's
+                    # scatter pad value) — a generated key 0 would be dropped
+                    start = _table_min_key(self.table)
                 end = start + len(values)
                 if end > self.table.spec.config.capacity:
                     raise ValueError(
@@ -160,8 +172,10 @@ class TableHandle:
             else:
                 keys, values = parsed
             if len(keys):
-                self.table.multi_put(keys, values)
-                total += len(keys)
+                # sparse multi_put returns the overflow-dropped count (dense
+                # returns None): report records actually stored, not offered
+                dropped = self.table.multi_put(keys, values) or 0
+                total += len(keys) - dropped
         return total
 
     def drop(self) -> None:

@@ -134,6 +134,8 @@ class LocalTaskUnitScheduler:
     """Executor-side slot gate (1 CPU / 2 NET by default)."""
 
     def __init__(self, cpu_slots: int = 1, net_slots: int = 2) -> None:
+        self.cpu_slots = cpu_slots
+        self.net_slots = net_slots
         self._sems = {
             CPU: threading.BoundedSemaphore(cpu_slots),
             NET: threading.BoundedSemaphore(net_slots),

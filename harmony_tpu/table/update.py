@@ -47,6 +47,20 @@ class UpdateFunction:
 
 _REGISTRY: Dict[str, UpdateFunction] = {}
 
+# Durable update-fn names ("pkg.mod:factory?...") are persisted in checkpoint
+# manifests and shipped job configs, then imported and CALLED at restore time.
+# A manifest is therefore code-bearing input; factory resolution is gated to
+# these module prefixes so restoring a manifest from an untrusted source can't
+# execute arbitrary modules. Deployments registering their own factories add
+# their package via allow_update_fn_prefix() (or register the fn by hand).
+_FACTORY_PREFIXES = {"harmony_tpu."}
+
+
+def allow_update_fn_prefix(prefix: str) -> None:
+    """Permit durable update-fn factory references under ``prefix`` (a module
+    path prefix like ``"myapp."``)."""
+    _FACTORY_PREFIXES.add(prefix)
+
 
 def register_update_fn(fn: UpdateFunction) -> UpdateFunction:
     _REGISTRY[fn.name] = fn
@@ -73,6 +87,14 @@ def get_update_fn(name: str) -> UpdateFunction:
         from harmony_tpu.config.base import resolve_symbol
 
         path, _, query = name.partition("?")
+        module = path.partition(":")[0]
+        if not any(module.startswith(p) or module == p.rstrip(".")
+                   for p in _FACTORY_PREFIXES):
+            raise PermissionError(
+                f"update-fn factory module {module!r} is not allowlisted; "
+                "call allow_update_fn_prefix() or register_update_fn() "
+                "before restoring (checkpoint manifests are code-bearing)"
+            )
         kwargs = {}
         for pair in query.split("&") if query else []:
             k, _, v = pair.partition("=")

@@ -1,0 +1,68 @@
+"""Worker process for the multi-host JobServer end-to-end test.
+
+Launched N times by tests/test_multihost.py (CPU backend, 4 virtual
+devices per process → an 8-device GLOBAL mesh for N=2). Process 0 runs the
+PodJobServer (TCP submit endpoint + pod control plane); the rest run
+PodFollower loops. The parent submits an MLR job to process 0 over TCP,
+every process executes the same SPMD entity over the global mesh, and
+process 0 prints the pod-wide outcome as `RESULT <json>`.
+
+Usage: python pod_worker.py <coordinator> <nprocs> <pid> <pod_port> <tcp_port>
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, nprocs, pid, pod_port, tcp_port = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        int(sys.argv[5]),
+    )
+
+    from harmony_tpu.parallel import multihost
+
+    assert multihost.initialize_distributed(coordinator, nprocs, pid)
+
+    import jax
+
+    n_exec = len(jax.devices())  # global device count, identical everywhere
+
+    if pid == 0:
+        from harmony_tpu.jobserver.pod import PodJobServer
+
+        server = PodJobServer(num_executors=n_exec, num_followers=nprocs - 1)
+        server.start()
+        server.serve_pod(pod_port)
+        server.serve_tcp(tcp_port)
+        print("READY", flush=True)
+        while server.state != "CLOSED":
+            time.sleep(0.2)
+        local = {}
+        for job_id, jr in server._jobs.items():
+            try:
+                res = jr.future.result(timeout=0)
+                local[job_id] = {
+                    wid: {"losses": [float(x) for x in w.get("losses", [])]}
+                    for wid, w in res.get("workers", {}).items()
+                }
+            except Exception as e:  # noqa: BLE001 - reported in RESULT
+                local[job_id] = {"error": f"{type(e).__name__}: {e}"}
+        print("RESULT " + json.dumps({
+            "pid": 0,
+            "local_results": local,
+            "pod_reports": server.pod_reports,
+        }), flush=True)
+    else:
+        from harmony_tpu.jobserver.pod import PodFollower
+
+        follower = PodFollower("127.0.0.1", pod_port, pid, n_exec)
+        follower.run()
+        print("RESULT " + json.dumps({"pid": pid}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

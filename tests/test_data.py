@@ -135,6 +135,30 @@ class TestBulkLoad:
         with pytest.raises(ValueError, match="capacity"):
             handle.load([str(p)], CsvParser(), generate_keys=True)
 
+    def test_generated_keys_skip_reserved_zero_on_sparse(self, tmp_path, mesh8):
+        """Sparse hash tables reserve key 0 (XLA's scatter pad value): a
+        NoneKey load must generate keys from 1 and report records actually
+        stored, not offered."""
+        from harmony_tpu.data.parsers import CsvParser
+        from harmony_tpu.parallel.mesh import DevicePool
+        from harmony_tpu.runtime.master import ETMaster
+        import jax
+
+        p = tmp_path / "vals.csv"
+        p.write_text("\n".join(f"{float(i)},{float(i) + 0.5}" for i in range(16)) + "\n")
+        master = ETMaster(DevicePool(jax.devices()[:2]))
+        execs = master.add_executors(2)
+        handle = master.create_table(
+            TableConfig(table_id="nk-sparse", capacity=256, value_shape=(2,),
+                        num_blocks=2, sparse=True),
+            [e.id for e in execs],
+        )
+        n = handle.load([str(p)], CsvParser(), generate_keys=True)
+        assert n == 16
+        assert handle.table.overflow_count == 0  # key 0 was never offered
+        got = handle.table.multi_get(list(range(1, 17)))
+        np.testing.assert_allclose(got[:, 0], np.arange(16, dtype=np.float32))
+
     def test_load_dataset_for_training(self, text_file):
         path, _ = text_file
         keys, vals = load_dataset([path], KeyValueVectorParser(), num_splits=3)

@@ -506,6 +506,27 @@ class TestSharedTableLifetime:
         assert "life-m" not in server.master.table_ids()
 
 
+class TestJobLogger:
+    def test_per_job_prefixed_log_lines(self, devices, caplog):
+        """Operator-facing lifecycle logging carries a [JobId: x] prefix on
+        every job-scoped line (ref: jobserver/JobLogger.java:34-75), so a
+        multi-tenant server's interleaved log stays attributable."""
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="harmony_tpu.jobserver"):
+            server = JobServer(1, device_pool=DevicePool(devices[:1]))
+            server.start()
+            cfg = addvector_job("logged", n=32, epochs=1, workers=1, slack=0)
+            server.submit(cfg).result(timeout=300)
+            server.shutdown(timeout=60)
+        msgs = [r.getMessage() for r in caplog.records]
+        for want in ("submitted", "dispatched", "training", "finished"):
+            assert any(m.startswith(f"[JobId: logged] {want}") for m in msgs), (
+                want, msgs)
+        assert any(m.startswith("jobserver up") for m in msgs)
+        assert any(m.startswith("shutdown initiated") for m in msgs)
+
+
 class TestJobOptimizerLoop:
     def test_job_reconfigures_itself_mid_training(self, devices):
         """JobConfig.optimizer wires the per-job elasticity loop (the
@@ -535,6 +556,33 @@ class TestJobOptimizerLoop:
         losses = result["workers"]["opt-mlr/w0"]["losses"]
         assert losses[-1] < losses[0]
         server.shutdown(timeout=60)
+
+    def test_lease_released_when_orchestrator_construction_fails(self, devices):
+        """If optimizer resolution/construction raises AFTER the exclusive
+        lease is acquired, the lease must be released — otherwise every
+        resubmission of the job silently trains unoptimized."""
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.jobserver.entity import DolphinJobEntity
+        from harmony_tpu.runtime.master import ETMaster
+
+        master = ETMaster(DevicePool(devices[:1]))
+        execs = master.add_executors(1)
+        handle = master.create_table(
+            TableConfig(table_id="leak", capacity=8, value_shape=(2,),
+                        num_blocks=2),
+            [execs[0].id],
+        )
+        cfg = JobConfig(job_id="leak-job", app_type="dolphin",
+                        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+                        params=TrainerParams(),
+                        optimizer="no.such.module:Opt")
+        ent = DolphinJobEntity(cfg, metric_manager=object())
+        ent._master = master
+        ent._handle = handle
+        with pytest.raises(ModuleNotFoundError):
+            ent._make_orchestrator()
+        assert master.acquire_optimizer_lease(handle.table_id)
+        master.release_optimizer_lease(handle.table_id)
 
     def test_one_jobs_reconfig_does_not_erase_tenant_metrics(self, devices):
         """Job A's optimizer migrates A's table mid-run; job B's metrics

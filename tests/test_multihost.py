@@ -13,10 +13,12 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+POD_WORKER = os.path.join(os.path.dirname(__file__), "pod_worker.py")
 
 
 def _free_port() -> int:
@@ -25,13 +27,20 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_job():
-    port = _free_port()
-    coordinator = f"127.0.0.1:{port}"
+def _sanitized_env(devices_per_proc: int = 4) -> dict:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU claim in the workers
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    return env
+
+
+def test_two_process_distributed_job():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = _sanitized_env()
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, coordinator, "2", str(pid)],
@@ -69,3 +78,86 @@ def test_two_process_distributed_job():
     assert a["hash_present"] == b["hash_present"] == 256
     assert a["hash_dropped"] == b["hash_dropped"] == 0
     assert a["hash_sum"] == b["hash_sum"]
+
+
+def test_pod_jobserver_end_to_end():
+    """The multi-host control plane (ref: JobServerDriver.java:149-163
+    driving remote evaluators): process 0 hosts the JobServer, a job
+    submitted over TCP trains over the GLOBAL 8-device mesh with process 1
+    executing the same SPMD steps via the pod follower loop, and the
+    follower's worker metrics land back on process 0."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    from harmony_tpu.jobserver.client import CommandSender
+
+    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    env = _sanitized_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
+             str(pod_port), str(tcp_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    try:
+        # wait for process 0's READY line (runtime + pod join + TCP up)
+        deadline = time.monotonic() + 240
+        line = ""
+        while time.monotonic() < deadline:
+            line = procs[0].stdout.readline()
+            if line.strip() == "READY" or not line:
+                break
+        assert line.strip() == "READY", "leader never became ready"
+
+        cfg = JobConfig(
+            job_id="pod-mlr", app_type="dolphin",
+            trainer="harmony_tpu.apps.mlr:MLRTrainer",
+            params=TrainerParams(
+                num_epochs=2, num_mini_batches=4,
+                app_params={"num_classes": 4, "num_features": 16,
+                            "features_per_partition": 4, "step_size": 0.1},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4}},
+        )
+        sender = CommandSender(tcp_port)
+        resp = sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        # poll until the job drains, then shut the pod down
+        while time.monotonic() < deadline:
+            status = sender.send_status_command()
+            if not status.get("running"):
+                break
+            time.sleep(0.5)
+        sender.send_shutdown_command()
+
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                pytest.fail("pod worker hung")
+            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    # leader's stdout was partially consumed by the READY loop; RESULT is
+    # in what communicate() returned afterwards
+    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
+    assert lead, f"no RESULT from leader: {outs[0]!r}"
+    result = json.loads(lead[0][len("RESULT "):])
+    # local (process 0) training happened and converged
+    losses = result["local_results"]["pod-mlr"]["pod-mlr/w0"]["losses"]
+    assert len(losses) == 2 and losses[-1] < losses[0], losses
+    # follower (process 1) ran the SAME job and reported its metrics back
+    follower = result["pod_reports"]["pod-mlr"]["1"]
+    assert follower["ok"], follower
+    f_losses = follower["workers"]["pod-mlr/w0"]["losses"]
+    # SPMD lockstep: both processes computed the identical loss series
+    assert [round(x, 5) for x in f_losses] == [round(x, 5) for x in losses]

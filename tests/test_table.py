@@ -86,6 +86,26 @@ class TestOps:
         t.update(0, np.asarray(9.0, np.float32))
         assert t.get(0) == 5.0
 
+    def test_factory_update_fn_allowlist(self):
+        """Durable factory names come from code-bearing input (checkpoint
+        manifests): resolution outside the allowlisted prefixes must refuse,
+        and allow_update_fn_prefix must admit."""
+        import pytest
+
+        from harmony_tpu.table.update import (
+            _FACTORY_PREFIXES, allow_update_fn_prefix, get_update_fn,
+        )
+
+        with pytest.raises(PermissionError, match="allowlisted"):
+            get_update_fn("os.path:join")
+        allow_update_fn_prefix("tests.")
+        try:
+            with pytest.raises(ModuleNotFoundError):
+                # admitted past the gate: fails on import, not on policy
+                get_update_fn("tests.no_such_module:factory")
+        finally:
+            _FACTORY_PREFIXES.discard("tests.")
+
     def test_capacity_not_divisible_by_blocks(self, mesh8):
         t = make_table(mesh8, capacity=50, num_blocks=16)
         t.update(49, np.ones(4, np.float32))
