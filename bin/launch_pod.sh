@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Per-host bootstrap for a multi-host (TPU pod slice) deployment.
+# The analogue of the reference's deploy/ + jobserver/bin/start_jobserver.sh
+# pair (Hadoop/YARN confs + driver launcher): run this ONCE ON EACH HOST of
+# the slice and the pod assembles itself — process 0 becomes the JobServer
+# (submit to ITS host, port 43110), the rest become followers.
+#
+# Required environment (or flags; see `harmony-tpu start-pod --help`):
+#   JAX_COORDINATOR_ADDRESS  host0-internal-ip:8476   (same on every host)
+#   JAX_NUM_PROCESSES        number of hosts in the slice
+#   JAX_PROCESS_ID           this host's index, 0..N-1
+#
+# On Cloud TPU VMs the three values come from the metadata server; with
+# `gcloud compute tpus tpu-vm ssh ... --worker=all` the per-worker index is
+# available as $TPU_WORKER_ID and the coordinator is worker 0's internal IP:
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all --command='
+#     cd ~/harmony_tpu &&
+#     JAX_COORDINATOR_ADDRESS=$COORD:8476 \
+#     JAX_NUM_PROCESSES=$NUM_HOSTS \
+#     JAX_PROCESS_ID=$TPU_WORKER_ID \
+#     bin/launch_pod.sh'
+#
+# Keep it alive across SSH drops with tmux (or the systemd unit below):
+#   tmux new-session -d -s harmony 'bin/launch_pod.sh'
+#
+#   # /etc/systemd/system/harmony-pod.service
+#   [Service]
+#   Environment=JAX_COORDINATOR_ADDRESS=10.0.0.2:8476
+#   Environment=JAX_NUM_PROCESSES=4
+#   Environment=JAX_PROCESS_ID=%H-derived-index
+#   WorkingDirectory=/opt/harmony_tpu
+#   ExecStart=/opt/harmony_tpu/bin/launch_pod.sh
+#   Restart=on-failure
+#
+# Submitting: from anywhere that can reach host 0 —
+#   bin/harmony-tpu submit mlr --port 43110      # on host 0 itself, or
+#   ssh host0 'cd harmony_tpu && bin/harmony-tpu submit mlr'
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m harmony_tpu.cli start-pod "$@"

@@ -46,6 +46,16 @@ class CommitBackend:
         None if the id is not committed here."""
         raise NotImplementedError
 
+    def fetch_manifest(self, chkp_id: str) -> Optional[str]:
+        """The stored manifest.json text WITHOUT materializing block data
+        (info()/listing must not download a multi-GB checkpoint to read
+        metadata). Default falls back to a full fetch."""
+        d = self.fetch(chkp_id)
+        if d is None:
+            return None
+        with open(os.path.join(d, "manifest.json")) as f:
+            return f.read()
+
     def delete(self, chkp_id: str) -> None:
         raise NotImplementedError
 
@@ -146,6 +156,37 @@ class OrbaxCommitBackend(CommitBackend):
                 blocks[name] = np.frombuffer(f.read(), np.uint8)
         tree = {"manifest": json.dumps(info, sort_keys=True), "blocks": blocks}
         self._checkpointer().save(self._path(chkp_id), tree)
+        # Manifest sidecar: a small sibling object so info()/retention scans
+        # read metadata without restoring the block tree. Written AFTER the
+        # finalized save — a crash in between leaves the checkpoint fully
+        # usable (fetch_manifest falls back to the full fetch).
+        self._write_text(self._path(chkp_id) + ".manifest.json",
+                         json.dumps(info, sort_keys=True))
+
+    @staticmethod
+    def _write_text(path: str, text: str) -> None:
+        if _is_url(path):  # pragma: no cover - needs a live object store
+            from etils import epath
+
+            epath.Path(path).write_text(text)
+        else:
+            with open(path, "w") as f:
+                f.write(text)
+
+    def fetch_manifest(self, chkp_id: str) -> Optional[str]:
+        if not self.exists(chkp_id):
+            return None
+        side = self._path(chkp_id) + ".manifest.json"
+        if _is_url(side):  # pragma: no cover - needs a live object store
+            from etils import epath
+
+            p = epath.Path(side)
+            if p.exists():
+                return p.read_text()
+        elif os.path.exists(side):
+            with open(side) as f:
+                return f.read()
+        return super().fetch_manifest(chkp_id)  # pre-sidecar checkpoints
 
     def fetch(self, chkp_id: str) -> Optional[str]:
         cached = self._fetched.get(chkp_id)
@@ -180,12 +221,19 @@ class OrbaxCommitBackend(CommitBackend):
         if cached and os.path.isdir(cached):
             shutil.rmtree(cached)
         path = self._path(chkp_id)
-        if not _is_url(path) and os.path.isdir(path):
-            shutil.rmtree(path)
-        elif _is_url(path):  # pragma: no cover - needs a live object store
+        side = path + ".manifest.json"
+        if not _is_url(path):
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            if os.path.exists(side):
+                os.remove(side)
+        else:  # pragma: no cover - needs a live object store
             from etils import epath
 
             epath.Path(path).rmtree()
+            sp = epath.Path(side)
+            if sp.exists():
+                sp.unlink()
 
     def list_ids(self) -> List[str]:
         # filter orbax's in-flight temp dirs (".orbax-checkpoint-tmp"
@@ -196,7 +244,8 @@ class OrbaxCommitBackend(CommitBackend):
             from etils import epath
 
             return sorted(p.name for p in epath.Path(self.root).iterdir()
-                          if ".orbax-checkpoint-tmp" not in p.name)
+                          if ".orbax-checkpoint-tmp" not in p.name
+                          and not p.name.endswith(".manifest.json"))
         if not os.path.isdir(self.root):
             return []
         return sorted(

@@ -311,7 +311,15 @@ class CheckpointManager:
             return CheckpointInfo.from_json(f.read())
 
     def info(self, chkp_id: str) -> CheckpointInfo:
-        return self._load_manifest(self._dir_of(chkp_id))
+        """Manifest only — never materializes block data (a remote backend's
+        full fetch can be GBs; metadata reads must stay cheap)."""
+        text = self._backend.fetch_manifest(chkp_id)
+        if text is not None:
+            return CheckpointInfo.from_json(text)
+        temp = os.path.join(self.temp_root, chkp_id)
+        if os.path.isdir(temp):
+            return self._load_manifest(temp)
+        raise FileNotFoundError(f"checkpoint {chkp_id} not found")
 
     def list_checkpoints(self) -> List[str]:
         temp = set(

@@ -276,6 +276,25 @@ def main(argv: List[str] | None = None) -> int:
         else:
             p.add_argument("--num-executors", type=int, default=0)
 
+    p = sub.add_parser(
+        "start-pod",
+        help="one pod process: leader jobserver on process 0, follower "
+             "loop elsewhere (roles from JAX_PROCESS_ID)",
+    )
+    p.add_argument("--num-executors", type=int, default=0,
+                   help="0 = one per GLOBAL device")
+    p.add_argument("--port", type=int, default=43110,
+                   help="leader's TCP submit port")
+    p.add_argument("--pod-port", type=int, default=43111,
+                   help="leader's follower-control port")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of the jax.distributed coordinator "
+                        "(default: $JAX_COORDINATOR_ADDRESS)")
+    p.add_argument("--num-processes", type=int, default=0,
+                   help="default: $JAX_NUM_PROCESSES")
+    p.add_argument("--process-id", type=int, default=-1,
+                   help="default: $JAX_PROCESS_ID")
+
     p = sub.add_parser("status", help="query a running jobserver")
     p.add_argument("--port", type=int, default=43110)
     p = sub.add_parser("shutdown", help="graceful jobserver shutdown")
@@ -288,6 +307,8 @@ def main(argv: List[str] | None = None) -> int:
 
     if args.cmd == "start-jobserver":
         return _cmd_start_jobserver(args)
+    if args.cmd == "start-pod":
+        return _cmd_start_pod(args)
     if args.cmd == "submit":
         from harmony_tpu.jobserver.client import CommandSender
 
@@ -322,11 +343,14 @@ def main(argv: List[str] | None = None) -> int:
 
 
 def _make_server(num_executors: int):
-    import jax
-
     from harmony_tpu.jobserver.server import JobServer
+    from harmony_tpu.utils.devices import discover_devices
 
-    n = num_executors or len(jax.devices())
+    # Bounded discovery: a wedged accelerator transport (dead tunnel to a
+    # remote chip) hangs jax.devices() forever inside backend init; the CLI
+    # must fail with a diagnosis instead.
+    devices = discover_devices()
+    n = num_executors or len(devices)
     server = JobServer(num_executors=n)
     server.start()
     return server
@@ -343,6 +367,58 @@ def _cmd_start_jobserver(args: argparse.Namespace) -> int:
             time.sleep(0.5)
     except KeyboardInterrupt:
         server.shutdown()
+    return 0
+
+
+def _cmd_start_pod(args: argparse.Namespace) -> int:
+    """One pod process (see bin/launch_pod.sh + README 'TPU-pod deploy'):
+    joins the jax.distributed runtime, then process 0 becomes the pod
+    JobServer (TCP submit + follower control plane) and every other
+    process enters the follower loop. The reference's analogue is the
+    driver process vs remote evaluator JVM split (JobServerDriver.java:
+    149-163)."""
+    import os
+    import time
+
+    from harmony_tpu.parallel import multihost
+
+    coordinator = args.coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nprocs = args.num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 0))
+    pid = (args.process_id if args.process_id >= 0
+           else int(os.environ.get("JAX_PROCESS_ID", -1)))
+    if not coordinator or nprocs < 2 or pid < 0:
+        print("start-pod needs --coordinator/--num-processes/--process-id "
+              "(or the JAX_* env vars); for single-host use start-jobserver",
+              file=sys.stderr)
+        return 2
+    multihost.initialize_distributed(coordinator, nprocs, pid)
+
+    import jax
+
+    n_exec = args.num_executors or len(jax.devices())
+    if pid == 0:
+        from harmony_tpu.jobserver.pod import PodJobServer
+
+        server = PodJobServer(num_executors=n_exec,
+                              num_followers=nprocs - 1)
+        server.start()
+        server.serve_pod(args.pod_port)
+        port = server.serve_tcp(args.port)
+        print(f"pod jobserver ready: {nprocs} processes, "
+              f"{len(jax.devices())} global devices, submit port {port}",
+              flush=True)
+        try:
+            while server.state != "CLOSED":
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+    from harmony_tpu.jobserver.pod import PodFollower
+
+    leader_host = coordinator.rsplit(":", 1)[0]
+    print(f"pod follower {pid} joining {leader_host}:{args.pod_port}",
+          flush=True)
+    PodFollower(leader_host, args.pod_port, pid, n_exec).run()
     return 0
 
 

@@ -80,6 +80,12 @@ class ExecutorConfig(ConfigBase):
     # TaskUnit slots per executor (ref: LocalTaskUnitScheduler.java:36-37).
     cpu_slots: int = 1
     net_slots: int = 2
+    # Heterogeneous resource specs (ref: HeterogeneousEvalManager.java:40-70
+    # matching allocations to per-request node names/sizes): restrict this
+    # request to devices of a kind (case-insensitive substring, e.g.
+    # "v5 lite") and/or one host process of a multi-host pod. None = any.
+    device_kind: Optional[str] = None
+    process_index: Optional[int] = None
 
 
 @config

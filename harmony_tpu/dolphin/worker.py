@@ -83,6 +83,11 @@ class WorkerTasklet:
         self._step = None
         self._epoch_fn = None
         self._eval_fn = None
+        # Comm/comp split probe (see _probe_comm): period in epochs; 0 = off.
+        self.comm_probe_every = 1
+        self._probe_pull = None
+        self._probe_pp = None
+        self._comm_probe_times = (0.0, 0.0)
         self._step_sharding = None
         self._local_sharding = None
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
@@ -282,6 +287,104 @@ class WorkerTasklet:
         self._batch_sharding = NamedSharding(table.mesh, P(DATA_AXIS))
         self._batch_cache.clear()   # cached batches live on the old mesh
         self._stacked_cache = None
+        self._probe_pull = None     # probe programs target the old layout
+
+    def _build_comm_probe(self) -> None:
+        """Standalone PULL and PULL+PUSH(zero-delta) programs mirroring the
+        step's table traffic.
+
+        The fused step folds pull/push into one XLA program, so their time
+        is unobservable from outside — and the elasticity optimizer's cost
+        model degenerates without a comm/comp split (more shards always
+        looks free). These probes make the split measurable: dispatching
+        PULL alone times the model-axis all-gather; PULL+PUSH adds the
+        delta fold's scatter/reduction; the step time minus both is comp.
+        The reference fed its optimizer per-op pull/push timers
+        (dolphin/core/worker/ModelAccessor.java:33-49); one probe per
+        epoch is the fused-mode equivalent. Non-donating (the live table
+        buffer must survive), so a probe transiently holds one extra copy
+        of the table array."""
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
+        spec = self.ctx.model_table.spec
+        trainer = self.trainer
+        if isinstance(self.ctx.model_table, DeviceHashTable):
+            replicated = NamedSharding(self.ctx.model_table.mesh, P())
+
+            def pull_fn(state, batch):
+                keys = jax.lax.with_sharding_constraint(
+                    trainer.pull_keys(batch), replicated
+                )
+                _, rows, _ = spec.pull(state, keys)
+                return rows
+
+            def pp_fn(state, batch):
+                keys = jax.lax.with_sharding_constraint(
+                    trainer.pull_keys(batch), replicated
+                )
+                new_state, rows, token = spec.pull(state, keys)
+                return spec.push(new_state, token, jnp.zeros_like(rows))
+
+        elif trainer.pull_mode == "all":
+
+            def pull_fn(arr, batch):
+                return spec.pull_all(arr)
+
+            def pp_fn(arr, batch):
+                model = spec.pull_all(arr)
+                return spec.push_all(arr, jnp.zeros_like(model))
+
+        else:
+            push_via = self.ctx.model_table.push_via
+
+            def pull_fn(arr, batch):
+                return spec.pull(arr, trainer.pull_keys(batch))
+
+            def pp_fn(arr, batch):
+                keys = trainer.pull_keys(batch)
+                rows = spec.pull(arr, keys)
+                return spec.push(arr, keys, jnp.zeros_like(rows), via=push_via)
+
+        self._probe_pull = jax.jit(pull_fn)
+        self._probe_pp = jax.jit(pp_fn)
+
+    def _probe_comm(self, batch: Tuple[np.ndarray, ...]) -> None:
+        """Time the probe programs on one batch (warmup dispatch first so
+        compile never lands in the measurement); stores (pull_s, push_s)
+        for _emit_batch_metrics. A live reshard racing the probe just skips
+        this epoch's measurement — the previous split stays in effect."""
+        if self._probe_pull is None:
+            self._build_comm_probe()
+
+        def timed(fn, *args) -> float:
+            # min-of-3 after a warmup/compile dispatch: these programs run
+            # sub-millisecond on small tables and the split comes from a
+            # SUBTRACTION, so single-shot jitter would routinely invert it
+            jax.block_until_ready(fn(*args))
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        try:
+            # Under the table lock: another worker's DONATING step must not
+            # invalidate the state buffer mid-probe (same rule as every
+            # host accessor — see DenseTable.array). The lock is held for
+            # the few-ms probe dispatches, once per epoch.
+            with self.ctx.model_table._lock:
+                state = self.ctx.model_table._step_state
+                batch_dev = self._shard_batch(batch)
+                t_pull = timed(self._probe_pull, state, batch_dev)
+                t_pp = timed(self._probe_pp, state, batch_dev)
+        except Exception:
+            # a probe failure (layout race, donated buffer, transient
+            # backend error) must never kill training — skip this epoch's
+            # measurement and rebuild the programs next time
+            self._probe_pull = None
+            return
+        self._comm_probe_times = (t_pull, max(t_pp - t_pull, 0.0))
 
     def _use_fused_epoch(self) -> bool:
         """Whole-epoch compilation is only correct with no between-batch host
@@ -381,6 +484,12 @@ class WorkerTasklet:
         from harmony_tpu.tracing import trace_span
 
         for epoch in range(self.starting_epoch, params.num_epochs):
+            if self.comm_probe_every and (
+                (epoch - self.starting_epoch) % self.comm_probe_every == 0
+            ):
+                first = next(iter(self.data.epoch_batches()), None)
+                if first is not None:
+                    self._probe_comm(first)
             epoch_t0 = time.perf_counter()
             with trace_span(
                 "dolphin.epoch",
@@ -478,12 +587,21 @@ class WorkerTasklet:
                     runs[-1].append(m)
                 else:
                     runs.append([m])
+            # The eager stacks DISPATCH under the table lock: they are
+            # multi-device programs (and can carry an implicit transfer when
+            # a metric landed with a different placement), and a dispatch
+            # racing other workers' step dispatches enqueues per-device work
+            # in divergent orders — on backends with in-process collectives
+            # that inverts a rendezvous and deadlocks. The lock is the
+            # global dispatch serializer; the D2H copies below stay outside.
+            with self.ctx.model_table._lock:
+                stacked = {
+                    k: [jnp.stack([m[k] for m in r]) for r in runs]
+                    for k in pending[0]
+                }
             host = {
-                k: np.concatenate(
-                    [np.atleast_1d(np.asarray(jnp.stack([m[k] for m in r])))
-                     for r in runs]
-                )
-                for k in pending[0]
+                k: np.concatenate([np.atleast_1d(np.asarray(s)) for s in v])
+                for k, v in stacked.items()
             }
             work_t += time.perf_counter() - t0
             # Async dispatch makes true per-batch device time unobservable
@@ -519,6 +637,12 @@ class WorkerTasklet:
         # one shared fallback rule (_primary_key) for the per-batch series
         lkey = self._primary_key(host)
         losses = host[lkey] if lkey is not None else np.zeros(len(batch_sizes))
+        # honest comm/comp split from the last probe (see _probe_comm):
+        # comp = measured step time minus the probed pull/push device time.
+        # With the probe off both are 0 and comp degenerates to the whole
+        # batch time — the conservative fused-mode default.
+        t_pull, t_push = self._comm_probe_times
+        comp = max(per_batch_time - t_pull - t_push, 0.0)
         for b, n in enumerate(batch_sizes):
             self.collector.add(
                 BatchMetrics(
@@ -528,7 +652,9 @@ class WorkerTasklet:
                     batch_idx=b,
                     num_examples=n,
                     batch_time_sec=per_batch_time,
-                    comp_time_sec=per_batch_time,
+                    pull_time_sec=t_pull,
+                    comp_time_sec=comp,
+                    push_time_sec=t_push,
                     loss=float(losses[b]),
                 )
             )

@@ -88,11 +88,19 @@ class PodJobServer(JobServer):
                     f"pod join: {len(self._followers)}/{self._num_followers} "
                     f"followers after {join_timeout}s"
                 )
+            # accept()'d sockets are BLOCKING regardless of the listener's
+            # timeout: a connection that never sends JOIN (health check,
+            # scanner, crashed follower) must not hang bootstrap forever
+            conn.settimeout(30.0)
             f = conn.makefile("r")
-            hello = _recv(f)
+            try:
+                hello = _recv(f)
+            except (socket.timeout, OSError):
+                hello = None
             if not hello or hello.get("cmd") != "JOIN":
                 conn.close()
                 continue
+            conn.settimeout(None)  # RUN_JOB/JOB_DONE set their own deadlines
             pid = int(hello["pid"])
             self._followers[pid] = (conn, f)
             server_log.info("pod follower %d joined from %s", pid, addr)
@@ -111,8 +119,8 @@ class PodJobServer(JobServer):
         out: Dict[int, Dict[str, Any]] = {}
         for pid, (conn, f) in sorted(self._followers.items()):
             while pid not in out:
-                conn.settimeout(max(0.1, deadline - time.monotonic()))
                 try:
+                    conn.settimeout(max(0.1, deadline - time.monotonic()))
                     msg = _recv(f)
                 except (socket.timeout, OSError) as e:
                     out[pid] = {"ok": False, "error": f"follower read: {e}"}
@@ -139,23 +147,40 @@ class PodJobServer(JobServer):
                     "pod: broadcasting RUN_JOB to %d follower(s)",
                     len(self._followers),
                 )
-                self._broadcast({
-                    "cmd": "RUN_JOB",
-                    "conf": config.to_dict(),
-                    "executor_ids": list(executor_ids),
-                    # Followers must build the entity with the SAME aux
-                    # components: the TaskUnit schedulers change how the
-                    # worker phases its device dispatches (fused vs split
-                    # PULL/COMP/PUSH), and any asymmetry there is a
-                    # cross-process collective mismatch.
-                    "cpu_slots": self.local_taskunit.cpu_slots,
-                    "net_slots": self.local_taskunit.net_slots,
-                })
+                try:
+                    self._broadcast({
+                        "cmd": "RUN_JOB",
+                        "conf": config.to_dict(),
+                        "executor_ids": list(executor_ids),
+                        # Followers must build the entity with the SAME aux
+                        # components: the TaskUnit schedulers change how the
+                        # worker phases its device dispatches (fused vs
+                        # split PULL/COMP/PUSH), and any asymmetry there is
+                        # a cross-process collective mismatch.
+                        "cpu_slots": self.local_taskunit.cpu_slots,
+                        "net_slots": self.local_taskunit.net_slots,
+                    })
+                except OSError as e:
+                    # A partially-delivered RUN_JOB cannot train (the SPMD
+                    # collectives need every process), and base _dispatch's
+                    # guarantees live inside ITS try-block — so fail the
+                    # job the way the base error path would: resolve the
+                    # future and unwedge the scheduler.
+                    jr = self._jobs[config.job_id]
+                    jr.future.set_exception(
+                        RuntimeError(f"pod RUN_JOB broadcast failed: {e}")
+                    )
+                    self._scheduler.on_job_finish(config.job_id)
+                    return
             super()._dispatch(config, executor_ids)
             if self._followers:
-                self.pod_reports[config.job_id] = self._collect_done(
-                    config.job_id, timeout=600.0
-                )
+                try:
+                    reports = self._collect_done(config.job_id, timeout=600.0)
+                except Exception as e:  # noqa: BLE001 - job already resolved
+                    reports = {"error": f"report collection failed: {e}"}
+                self.pod_reports[config.job_id] = reports
+                while len(self.pod_reports) > 256:  # bound leader memory
+                    self.pod_reports.pop(next(iter(self.pod_reports)))
 
     def shutdown(self, timeout: Optional[float] = 300.0) -> None:
         super().shutdown(timeout)

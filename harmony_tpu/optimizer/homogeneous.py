@@ -18,9 +18,13 @@ Cost model (per batch, d = number of owning executors):
   link, hence the (d-1)/d factor (this replaces the reference's per-key RPC
   cost terms with the TPU collective cost shape).
 
-Measured pull/push times feed comm_unit; in fused-step mode those are folded
-into comp, making the model conservative about growing d (correct default:
-fused jobs are compute-dominated).
+Measured pull/push times feed comm_unit. Fused-step mode folds pull/push
+device time into one program, so the worker measures the split with a
+per-epoch PROBE — the step's PULL and PULL+PUSH sub-programs dispatched
+standalone (WorkerTasklet._probe_comm; the fused-mode analogue of the
+reference's per-op ModelAccessor pull/push timers, ModelAccessor.java:
+33-49). If the probe is disabled the split degenerates to comm=0 and the
+model stays conservative about growing d.
 """
 from __future__ import annotations
 

@@ -77,6 +77,21 @@ def build_mesh(
     return Mesh(arr, names)
 
 
+def _device_matches(
+    d: jax.Device,
+    device_kind: Optional[str],
+    process_index: Optional[int],
+) -> bool:
+    """Per-request spec predicate (see DevicePool.lease)."""
+    if device_kind is not None:
+        kind = str(getattr(d, "device_kind", d.platform))
+        if device_kind.lower() not in kind.lower():
+            return False
+    if process_index is not None and d.process_index != process_index:
+        return False
+    return True
+
+
 class DevicePool:
     """Thread-safe pool of devices carved into per-job slices.
 
@@ -108,9 +123,24 @@ class DevicePool:
             self._exclusive[job_id] = False
             return devs
 
-    def lease(self, job_id: str, n: int) -> List[jax.Device]:
+    def lease(
+        self,
+        job_id: str,
+        n: int,
+        device_kind: Optional[str] = None,
+        process_index: Optional[int] = None,
+    ) -> List[jax.Device]:
         """Grant ``n`` exclusive devices (no overlap with other *exclusive*
-        leases; shared lease_all leases coexist with anything)."""
+        leases; shared lease_all leases coexist with anything).
+
+        ``device_kind`` / ``process_index`` are PER-REQUEST resource specs —
+        the heterogeneous-allocation analogue of the reference matching
+        evaluator allocations to requests by node name and size (ref:
+        services/evalmanager/impl/HeterogeneousEvalManager.java:40-70).
+        ``device_kind`` is a case-insensitive substring of the platform's
+        device kind (e.g. "v5 lite", "cpu"); ``process_index`` pins to one
+        host of a multi-host pod. All-or-nothing like the homogeneous path.
+        """
         with self._lock:
             taken = {
                 d
@@ -118,9 +148,18 @@ class DevicePool:
                 if self._exclusive.get(j)
                 for d in ds
             }
-            free = [d for d in self._devices if d not in taken]
+            free = [
+                d for d in self._devices
+                if d not in taken and _device_matches(d, device_kind, process_index)
+            ]
             if len(free) < n:
-                raise RuntimeError(f"need {n} devices, only {len(free)} free")
+                spec = ""
+                if device_kind is not None or process_index is not None:
+                    spec = (f" matching kind={device_kind!r}, "
+                            f"process={process_index!r}")
+                raise RuntimeError(
+                    f"need {n} devices{spec}, only {len(free)} free"
+                )
             devs = free[:n]
             self._leases[job_id] = devs
             self._exclusive[job_id] = True

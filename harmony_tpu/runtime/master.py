@@ -218,24 +218,33 @@ class ETMaster:
     def add_executors(self, num: int, conf: Optional[ExecutorConfig] = None) -> List[Executor]:
         """Allocate ``num`` executors (ref: ETMaster.addExecutors). Each
         leases one device from the pool; device reuse across executors is
-        allowed (multi-tenant overlap) via shared leases."""
+        allowed (multi-tenant overlap) via shared leases.
+
+        ``conf.device_kind`` / ``conf.process_index`` make this a
+        HETEROGENEOUS request: only devices matching the spec are granted
+        (ref: HeterogeneousEvalManager.java:40-70 matching allocations to
+        per-request specs; the homogeneous path is spec-less)."""
+        kind = conf.device_kind if conf is not None else None
+        proc = conf.process_index if conf is not None else None
         out = []
         with self._lock:
             try:
                 for _ in range(num):
                     eid = f"executor-{next(Executor._counter)}"
-                    devs = self._pool.lease(eid, 1)
+                    devs = self._pool.lease(
+                        eid, 1, device_kind=kind, process_index=proc
+                    )
                     ex = Executor(eid, devs[0])
                     self._executors[eid] = ex
                     out.append(ex)
-            except RuntimeError:
+            except RuntimeError as e:
                 # All-or-nothing (ref: EvaluatorManager fulfills whole request
                 # plans): roll back partial allocations before re-raising.
                 for ex in out:
                     self._executors.pop(ex.id, None)
                     self._pool.release(ex.id)
                 raise RuntimeError(
-                    f"cannot allocate {num} executors: pool exhausted"
+                    f"cannot allocate {num} executors: {e}"
                 ) from None
         return out
 

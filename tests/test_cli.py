@@ -135,6 +135,19 @@ def test_preset_symbols_bind(app):
         inspect.signature(fn).bind(**cfg.user["data_args"])
 
 
+def test_start_pod_requires_topology(monkeypatch, capsys):
+    """start-pod must refuse a half-configured launch (missing coordinator/
+    process id) instead of silently running single-host while peers block in
+    jax.distributed.initialize."""
+    from harmony_tpu.cli import main
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert main(["start-pod"]) == 2
+    assert "start-pod needs" in capsys.readouterr().err
+
+
 def test_cli_flags_reach_job_config():
     """--optimizer/--model-chkp-period/--offline-eval plumb into JobConfig."""
     from harmony_tpu.cli import build_config

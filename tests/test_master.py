@@ -177,3 +177,47 @@ class TestSSPTraining:
         vals = np.asarray(table.pull_array())
         # Both workers processed all their batches: 2 workers x 128 examples.
         np.testing.assert_allclose(vals, np.full((8, 2), 2 * n_per_worker * epochs))
+
+
+class TestHeterogeneousLeases:
+    """Per-request resource specs (ref: HeterogeneousEvalManager.java:40-70
+    matching allocations to requested node names/sizes): DevicePool leases
+    and ETMaster.add_executors accept device-kind / process-index specs and
+    stay all-or-nothing."""
+
+    def test_lease_matching_kind(self, devices):
+        from harmony_tpu.parallel import DevicePool
+
+        pool = DevicePool(devices[:4])
+        got = pool.lease("het-a", 2, device_kind="cpu")  # matches this host
+        assert len(got) == 2
+        with pytest.raises(RuntimeError, match="kind='tpu'"):
+            pool.lease("het-b", 1, device_kind="tpu")
+        # the failed spec-request must not have consumed anything
+        assert len(pool.lease("het-c", 2)) == 2
+
+    def test_lease_matching_process(self, devices):
+        from harmony_tpu.parallel import DevicePool
+
+        pool = DevicePool(devices[:2])
+        assert len(pool.lease("p0", 2, process_index=0)) == 2
+        pool.release("p0")
+        with pytest.raises(RuntimeError, match="process=3"):
+            pool.lease("p3", 1, process_index=3)
+
+    def test_add_executors_with_spec(self, devices):
+        from harmony_tpu.config.params import ExecutorConfig
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime.master import ETMaster
+
+        m = ETMaster(DevicePool(devices[:3]))
+        ex = m.add_executors(2, ExecutorConfig(device_kind="cpu",
+                                               process_index=0))
+        assert len(ex) == 2
+        # all-or-nothing with rollback: asking for 2 more cpu devices when
+        # only 1 remains must grant none and release the partial lease
+        before = set(m.executor_ids())
+        with pytest.raises(RuntimeError, match="cannot allocate"):
+            m.add_executors(2, ExecutorConfig(device_kind="cpu"))
+        assert set(m.executor_ids()) == before
+        assert len(m.add_executors(1)) == 1  # the rolled-back device is free

@@ -345,3 +345,32 @@ def test_cached_accessor_refresh_never_clobbers_push(mesh8):
     # The push must still be visible (version guard rejected the stale write).
     np.testing.assert_array_equal(acc.pull([0])[0], np.ones(2))
     acc.close()
+
+
+def test_one_worker_per_executor_job_completes(devices):
+    """Regression: a job with one worker PER executor (the --workers 0
+    'all executors' default) over the full 8-device mesh deadlocked XLA's
+    in-process collectives — the epoch-end metric stacking dispatched
+    eager multi-device programs outside the table lock, racing the other
+    workers' step dispatches into divergent per-device enqueue orders.
+    All device dispatches must go through the table lock."""
+    from harmony_tpu.jobserver import JobServer
+    from harmony_tpu.config.params import TrainerParams
+
+    server = JobServer(8, device_pool=DevicePool(devices))
+    server.start()
+    cfg = JobConfig(
+        job_id="allworkers", app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=2, num_mini_batches=2,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.1},
+        ),
+        num_workers=0,  # one worker per granted executor = 8 workers
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 256, "num_features": 16, "num_classes": 4}},
+    )
+    result = server.submit(cfg).result(timeout=300)
+    assert len(result["workers"]) == 8
+    server.shutdown(timeout=60)
