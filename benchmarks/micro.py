@@ -18,8 +18,18 @@ measures (b) plus the other primitives a capacity-planning reader needs:
              sparse/irregular access path, e.g. embedding lookups).
   sparse     DeviceHashTable fused pull/push keys/sec — the hash-backed
              embedding hot path (admission + gather + fold in one step).
+  mxu        dense bf16 matmul achieved FLOP/s and MFU (fraction of the
+             chip's peak) — the ceiling every MXU-shaped op is judged
+             against (BASELINE.md measurement plan; per-batch analogue of
+             the reference's metrics.avsc:164-201 compute records).
+  mxupush    the size-gated MXU duplicate-fold push route (one-hot matmul
+             fold, table/table.py) vs the scatter route — GB/s both ways
+             plus the fold's achieved FLOP/s.
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|all]
+Attention also reports achieved FLOP/s + MFU. MFU is null off-TPU (no
+meaningful peak). Run on the real chip and commit the JSON.
+
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -132,10 +142,79 @@ def bench_attention() -> dict:
 
     t_naive = _time(jax.jit(naive), q, k, v)
     t_flash = _time(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)), q, k, v)
-    return {"metric": "flash attention speedup vs naive", "seq": s,
-            "value": round(t_naive / t_flash, 2), "unit": "x",
-            "naive_ms": round(t_naive * 1e3, 1),
-            "flash_ms": round(t_flash * 1e3, 1)}
+    # causal attention FLOPs: QK^T + AV = 2 x 2bhs^2d, halved by the mask
+    flops = 2 * b * h * s * s * d
+    out = {"metric": "flash attention speedup vs naive", "seq": s,
+           "value": round(t_naive / t_flash, 2), "unit": "x",
+           "naive_ms": round(t_naive * 1e3, 1),
+           "flash_ms": round(t_flash * 1e3, 1),
+           "flash_tflops": round(flops / t_flash / 1e12, 2)}
+    out["flash_mfu"] = _mfu(flops / t_flash)
+    return out
+
+
+def _mfu(achieved_flops: float):
+    """achieved/peak for ONE chip, or None off-TPU."""
+    from harmony_tpu.utils.platform import device_is_tpu, peak_bf16_flops
+
+    d = jax.devices()[0]
+    peak = peak_bf16_flops(d) if device_is_tpu(d) else None
+    return round(achieved_flops / peak, 3) if peak else None
+
+
+def bench_mxu() -> dict:
+    """Dense bf16 matmul MFU — the roofline every MXU op is judged by."""
+    n = 4096
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    a = jax.random.normal(k1, (n, n), jnp.bfloat16)
+    b = jax.random.normal(k2, (n, n), jnp.bfloat16)
+    dt = _time(jax.jit(lambda a, b: a @ b), a, b)
+    flops = 2 * n * n * n
+    return {"metric": "mxu_dot bf16 achieved", "value": round(flops / dt / 1e12, 2),
+            "unit": "TFLOP/s", "n": n, "mfu": _mfu(flops / dt)}
+
+
+def bench_mxupush() -> dict:
+    """The keyed-push routes: XLA scatter vs the MXU duplicate-fold
+    (one-hot matmul, table/table.py push via='mxu') on a duplicate-heavy
+    batch — the shape where the fold is supposed to win on TPU."""
+    mesh = _mesh()
+    capacity, width, nkeys = 4096, 256, 8192   # many duplicates per key
+    spec = TableSpec(TableConfig(
+        table_id="bench-mp", capacity=capacity, value_shape=(width,),
+        num_blocks=64, update_fn="add",
+    ))
+    table = DenseTable(spec, mesh)
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, capacity, nkeys), jnp.int32)
+    deltas = jnp.asarray(rng.standard_normal((nkeys, width)), np.float32)
+    push_bytes = nkeys * width * 4
+
+    out = {"metric": "mxu push route", "unit": "GB/s", "keys": nkeys,
+           "capacity": capacity, "devices": len(mesh.devices.flat)}
+    t_scatter = _time(
+        jax.jit(lambda a, k, d: spec.push(a, k, d, via="scatter")),
+        table.array, keys, deltas,
+    )
+    out["scatter_gbps"] = round(push_bytes / t_scatter / 1e9, 2)
+    from harmony_tpu.utils.platform import tpu_backend
+
+    if tpu_backend():
+        t_mxu = _time(
+            jax.jit(lambda a, k, d: spec.push(a, k, d, via="mxu")),
+            table.array, keys, deltas,
+        )
+        # the fold is a [capacity, nkeys] x [nkeys, width] one-hot matmul
+        fold_flops = 2 * capacity * nkeys * width
+        out["value"] = round(push_bytes / t_mxu / 1e9, 2)
+        out["mxu_gbps"] = out["value"]
+        out["speedup_vs_scatter"] = round(t_scatter / t_mxu, 2)
+        out["fold_tflops"] = round(fold_flops / t_mxu / 1e12, 2)
+        out["fold_mfu"] = _mfu(fold_flops / t_mxu)
+    else:
+        out["value"] = out["scatter_gbps"]
+        out["note"] = "MXU route needs a TPU backend; scatter only"
+    return out
 
 
 def bench_multiget() -> dict:
@@ -203,6 +282,8 @@ SECTIONS = {
     "attention": bench_attention,
     "multiget": bench_multiget,
     "sparse": bench_sparse,
+    "mxu": bench_mxu,
+    "mxupush": bench_mxupush,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -212,6 +293,8 @@ SECTION_METRICS = {
     "attention": ("flash attention speedup vs naive", "x"),
     "multiget": ("host multi_get+multi_update", "keys/sec"),
     "sparse": ("sparse table fused pull+push", "keys/sec"),
+    "mxu": ("mxu_dot bf16 achieved", "TFLOP/s"),
+    "mxupush": ("mxu push route", "GB/s"),
 }
 
 

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Multi-worker / multi-tenant aggregate on the VIRTUAL 8-device mesh.
+
+bench.py (the driver-run headline) measures the concurrent MLR+NMF+LDA
+aggregate with num_workers=1 per job — on one real chip that is the whole
+machine. This companion records the same three jobs with the MULTI-WORKER
+machinery engaged (SSP mini-batch controller, worker state barriers,
+per-worker data splits) over the 8-virtual-CPU mesh, so the round also
+carries a number for the sharing mode the reference's north star actually
+describes (BASELINE.md config 4; SchedulerImpl runs every job on all
+executors). Numbers are CPU-mesh numbers — comparable across rounds, not
+to the chip.
+
+Prints ONE JSON line. Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python benchmarks/multiworker.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
+from harmony_tpu.jobserver.server import JobServer  # noqa: E402
+from harmony_tpu.parallel.mesh import DevicePool  # noqa: E402
+
+EPOCHS = 4
+BATCHES = 4
+WORKERS = 4  # per job, SSP slack 1
+
+
+def _cfg(job_id, trainer, app_params, data_fn, data_args, n):
+    return JobConfig(
+        job_id=job_id, app_type="dolphin", trainer=trainer,
+        params=TrainerParams(num_epochs=EPOCHS, num_mini_batches=BATCHES,
+                             clock_slack=1, app_params=app_params),
+        num_workers=WORKERS,
+        user={"data_fn": data_fn, "data_args": data_args},
+    ), EPOCHS * n
+
+
+def main() -> None:
+    devices = jax.devices()[:8]
+    mlr_n, nmf_rows, lda_docs = 2048, 512, 256
+    jobs = [
+        _cfg("mw-mlr", "harmony_tpu.apps.mlr:MLRTrainer",
+             {"num_classes": 64, "num_features": 1024,
+              "features_per_partition": 128, "step_size": 0.05},
+             "harmony_tpu.apps.mlr:make_synthetic",
+             {"n": mlr_n, "num_features": 1024, "num_classes": 64}, mlr_n),
+        _cfg("mw-nmf", "harmony_tpu.apps.nmf:NMFTrainer",
+             {"num_rows": nmf_rows, "num_cols": 1024, "rank": 64,
+              "step_size": 0.01},
+             "harmony_tpu.apps.nmf:make_synthetic",
+             {"num_rows": nmf_rows, "num_cols": 1024, "rank": 64}, nmf_rows),
+        _cfg("mw-lda", "harmony_tpu.apps.lda:LDATrainer",
+             {"vocab_size": 1024, "num_topics": 16, "num_docs": lda_docs,
+              "max_doc_len": 64},
+             "harmony_tpu.apps.lda:make_synthetic",
+             {"num_docs": lda_docs, "vocab_size": 1024, "num_topics": 16,
+              "doc_len": 64}, lda_docs),
+    ]
+    server = JobServer(num_executors=8, device_pool=DevicePool(devices))
+    server.start()
+    try:
+        t0 = time.perf_counter()
+        futures = [server.submit(c) for c, _ in jobs]
+        for f in futures:
+            f.result(timeout=1800)
+        wall = time.perf_counter() - t0
+    finally:
+        server.shutdown(timeout=120)
+    total = sum(n for _, n in jobs)
+    print(json.dumps({
+        "metric": "multi-worker aggregate, concurrent MLR+NMF+LDA "
+                  "(8-device virtual mesh)",
+        "value": round(total / wall, 1),
+        "unit": "samples/sec",
+        "workers_per_job": WORKERS,
+        "ssp_slack": 1,
+        "devices": len(devices),
+        "wall_sec": round(wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

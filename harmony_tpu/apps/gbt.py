@@ -95,7 +95,9 @@ class GBTTrainer(Trainer):
         if hist_mode not in ("auto", "scatter", "matmul"):
             raise ValueError(f"unknown hist_mode {hist_mode!r}")
         if hist_mode == "auto":
-            hist_mode = "matmul" if jax.default_backend() == "tpu" else "scatter"
+            from harmony_tpu.utils.platform import tpu_backend
+
+            hist_mode = "matmul" if tpu_backend() else "scatter"
         self.hist_mode = hist_mode
         # Full binary tree, levels 0..max_depth (ref: treeSize from treeMaxDepth).
         self.num_nodes = 2 ** (max_depth + 1) - 1

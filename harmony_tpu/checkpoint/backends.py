@@ -168,25 +168,36 @@ class OrbaxCommitBackend(CommitBackend):
         if _is_url(path):  # pragma: no cover - needs a live object store
             from etils import epath
 
-            epath.Path(path).write_text(text)
+            epath.Path(path).write_text(text)  # object writes are atomic
         else:
-            with open(path, "w") as f:
+            # temp + rename: a crash mid-write must not leave a torn
+            # sidecar shadowing a fully valid checkpoint
+            tmp = path + ".writing"
+            with open(tmp, "w") as f:
                 f.write(text)
+            os.replace(tmp, path)
 
     def fetch_manifest(self, chkp_id: str) -> Optional[str]:
         if not self.exists(chkp_id):
             return None
         side = self._path(chkp_id) + ".manifest.json"
+        text = None
         if _is_url(side):  # pragma: no cover - needs a live object store
             from etils import epath
 
             p = epath.Path(side)
             if p.exists():
-                return p.read_text()
+                text = p.read_text()
         elif os.path.exists(side):
             with open(side) as f:
-                return f.read()
-        return super().fetch_manifest(chkp_id)  # pre-sidecar checkpoints
+                text = f.read()
+        if text is not None:
+            try:
+                json.loads(text)
+                return text
+            except ValueError:
+                pass  # torn sidecar: fall through to the full fetch
+        return super().fetch_manifest(chkp_id)  # absent/torn sidecar
 
     def fetch(self, chkp_id: str) -> Optional[str]:
         cached = self._fetched.get(chkp_id)

@@ -348,11 +348,24 @@ class WorkerTasklet:
         self._probe_pull = jax.jit(pull_fn)
         self._probe_pp = jax.jit(pp_fn)
 
+    @staticmethod
+    def _mesh_spans_processes(mesh: Mesh) -> bool:
+        return len({d.process_index for d in mesh.devices.flat}) > 1
+
     def _probe_comm(self, batch: Tuple[np.ndarray, ...]) -> None:
         """Time the probe programs on one batch (warmup dispatch first so
         compile never lands in the measurement); stores (pull_s, push_s)
-        for _emit_batch_metrics. A live reshard racing the probe just skips
-        this epoch's measurement — the previous split stays in effect."""
+        for _emit_batch_metrics — on the SHARED table, so every worker of
+        the job reads the chief's measurement instead of re-measuring the
+        same table's cost (the probe blocks the table lock for several
+        device round-trips; once per job per epoch is enough). A failed
+        probe just skips this epoch's measurement — the previous split
+        stays in effect."""
+        if self._mesh_spans_processes(self.ctx.model_table.mesh):
+            # Multi-process mesh: probe programs are global collectives,
+            # and a locally-swallowed failure would desynchronize the pod's
+            # SPMD lockstep. Measurement stays single-host for now.
+            return
         if self._probe_pull is None:
             self._build_comm_probe()
 
@@ -385,6 +398,8 @@ class WorkerTasklet:
             self._probe_pull = None
             return
         self._comm_probe_times = (t_pull, max(t_pp - t_pull, 0.0))
+        # publish for sibling workers sharing this table (read at emit time)
+        self.ctx.model_table._comm_split = self._comm_probe_times
 
     def _use_fused_epoch(self) -> bool:
         """Whole-epoch compilation is only correct with no between-batch host
@@ -484,11 +499,17 @@ class WorkerTasklet:
         from harmony_tpu.tracing import trace_span
 
         for epoch in range(self.starting_epoch, params.num_epochs):
-            if self.comm_probe_every and (
+            # chief-only (the split is a property of the shared table, not
+            # the worker; siblings read the published value). Probe batch
+            # is a plain prefix slice — the provider's epoch_batches()
+            # would consume a shuffle from its RNG and change seeded batch
+            # order relative to a probe-free run.
+            if self.comm_probe_every and self.global_init and (
                 (epoch - self.starting_epoch) % self.comm_probe_every == 0
             ):
-                first = next(iter(self.data.epoch_batches()), None)
-                if first is not None:
+                first = tuple(a[: self.data.batch_size]
+                              for a in self.data._arrays)
+                if first and len(first[0]):
                     self._probe_comm(first)
             epoch_t0 = time.perf_counter()
             with trace_span(
@@ -641,7 +662,9 @@ class WorkerTasklet:
         # comp = measured step time minus the probed pull/push device time.
         # With the probe off both are 0 and comp degenerates to the whole
         # batch time — the conservative fused-mode default.
-        t_pull, t_push = self._comm_probe_times
+        t_pull, t_push = getattr(
+            self.ctx.model_table, "_comm_split", self._comm_probe_times
+        )
         comp = max(per_batch_time - t_pull - t_push, 0.0)
         for b, n in enumerate(batch_sizes):
             self.collector.add(

@@ -64,6 +64,11 @@ class PodJobServer(JobServer):
         self._pod_sock: Optional[socket.socket] = None
         self._followers: Dict[int, Any] = {}  # pid -> (sock, reader file)
         self._pod_lock = threading.Lock()  # serializes pod job execution
+        # A partially-delivered RUN_JOB leaves the followers that DID
+        # receive it blocked in global collectives (XLA collectives do not
+        # time out); no later job can run on this pod. The flag fails all
+        # subsequent pod dispatches fast instead of hanging them.
+        self._pod_broken: Optional[str] = None
         #: job_id -> {pid: follower JOB_DONE payload}
         self.pod_reports: Dict[str, Dict[int, Dict[str, Any]]] = {}
 
@@ -95,13 +100,15 @@ class PodJobServer(JobServer):
             f = conn.makefile("r")
             try:
                 hello = _recv(f)
-            except (socket.timeout, OSError):
-                hello = None
-            if not hello or hello.get("cmd") != "JOIN":
+                # garbage (an HTTP health check, a scanner) or a JOIN with
+                # no pid must be dropped like silence, not crash bootstrap
+                pid = int(hello["pid"]) if hello else None
+            except (socket.timeout, OSError, ValueError, KeyError, TypeError):
+                hello, pid = None, None
+            if not hello or hello.get("cmd") != "JOIN" or pid is None:
                 conn.close()
                 continue
             conn.settimeout(None)  # RUN_JOB/JOB_DONE set their own deadlines
-            pid = int(hello["pid"])
             self._followers[pid] = (conn, f)
             server_log.info("pod follower %d joined from %s", pid, addr)
         return bound
@@ -140,8 +147,20 @@ class PodJobServer(JobServer):
 
     # -- dispatch override ------------------------------------------------
 
+    def _fail_job(self, config: JobConfig, error: str) -> None:
+        jr = self._jobs[config.job_id]
+        jr.future.set_exception(RuntimeError(error))
+        self._scheduler.on_job_finish(config.job_id)
+
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
         with self._pod_lock:  # one pod job at a time (see module doc)
+            if self._followers and self._pod_broken:
+                self._fail_job(
+                    config,
+                    f"pod is broken ({self._pod_broken}); restart the pod "
+                    "processes — followers may be wedged in collectives",
+                )
+                return
             if self._followers:
                 job_logger(config.job_id).info(
                     "pod: broadcasting RUN_JOB to %d follower(s)",
@@ -164,13 +183,14 @@ class PodJobServer(JobServer):
                     # A partially-delivered RUN_JOB cannot train (the SPMD
                     # collectives need every process), and base _dispatch's
                     # guarantees live inside ITS try-block — so fail the
-                    # job the way the base error path would: resolve the
-                    # future and unwedge the scheduler.
-                    jr = self._jobs[config.job_id]
-                    jr.future.set_exception(
-                        RuntimeError(f"pod RUN_JOB broadcast failed: {e}")
+                    # job the way the base error path would, and POISON the
+                    # pod: followers that did get the message are now
+                    # blocked in collectives no later job can satisfy.
+                    self._pod_broken = f"RUN_JOB broadcast failed: {e}"
+                    server_log.error("pod broken: %s", self._pod_broken)
+                    self._fail_job(
+                        config, f"pod RUN_JOB broadcast failed: {e}"
                     )
-                    self._scheduler.on_job_finish(config.job_id)
                     return
             super()._dispatch(config, executor_ids)
             if self._followers:

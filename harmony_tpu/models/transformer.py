@@ -121,7 +121,9 @@ class TransformerLM:
         S = q.shape[2]
         attn = cfg.attn
         if attn == "auto":
-            attn = "flash" if (jax.default_backend() == "tpu" and S % 128 == 0) \
+            from harmony_tpu.utils.platform import tpu_backend
+
+            attn = "flash" if (tpu_backend() and S % 128 == 0) \
                 else "blockwise"
         if attn == "flash":
             return flash_attention(q, k, v, causal=True,

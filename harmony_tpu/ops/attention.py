@@ -47,7 +47,9 @@ def _resolve_defaults(q, scale, interpret):
     """One source of truth for the scale/interpret defaults used by the
     primal forward, the VJP forward and the VJP backward."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+    from harmony_tpu.utils.platform import tpu_backend
+
+    interp = (not tpu_backend()) if interpret is None else interpret
     return scale, interp
 
 

@@ -107,7 +107,9 @@ def weighted_histogram(
     """
     if ids.ndim != 1 or weights.ndim != 2 or ids.shape[0] != weights.shape[0]:
         raise ValueError(f"bad shapes ids={ids.shape} weights={weights.shape}")
-    interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+    from harmony_tpu.utils.platform import tpu_backend
+
+    interp = (not tpu_backend()) if interpret is None else interpret
     if interp and interpret is None:
         return _xla_histogram(ids, weights, num_bins)  # off-TPU fast path
     N, W = weights.shape

@@ -370,7 +370,9 @@ class DenseTable:
         """Platform-resolved keyed-push route: the size-gated MXU
         duplicate-fold on an all-TPU mesh for additive tables, XLA scatter
         everywhere else."""
-        on_tpu = all(d.platform == "tpu" for d in self._mesh.devices.flat)
+        from harmony_tpu.utils.platform import device_is_tpu
+
+        on_tpu = all(device_is_tpu(d) for d in self._mesh.devices.flat)
         return (
             "mxu_auto"
             if on_tpu and self.spec.update_fn.scatter_mode == "add"

@@ -1,0 +1,72 @@
+"""TPU detection and chip peak specs.
+
+The fast paths (Pallas kernels, MXU duplicate-fold push, matmul
+histograms) are gated on "is this a TPU?". ``jax.default_backend()``
+alone is the WRONG test: experimental PJRT plugins expose real TPU chips
+under a different platform name (e.g. a remote-attached chip registered
+as ``axon``), and keying on the literal string "tpu" silently routes the
+flagship kernels to interpret/scatter fallbacks on actual hardware. The
+chip GENERATION still shows in ``device_kind`` ("TPU v5 lite", ...), so
+detection checks platform names and the device kind.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+# Platform names that are TPU hardware. "axon" is an experimental
+# remote-attach PJRT plugin for TPU chips.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def device_is_tpu(d: jax.Device) -> bool:
+    if d.platform in _TPU_PLATFORMS:
+        return True
+    return "tpu" in str(getattr(d, "device_kind", "")).lower()
+
+
+def tpu_backend() -> bool:
+    """True when the default backend runs on TPU hardware."""
+    if jax.default_backend() in _TPU_PLATFORMS:
+        return True
+    try:
+        return device_is_tpu(jax.devices()[0])
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+# Peak dense bf16 matmul throughput per chip, FLOP/s (public spec sheets;
+# MFU denominators). Matched as substrings of device_kind, most specific
+# first.
+_PEAK_BF16 = (
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def peak_bf16_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s for one chip, or None when unknown (e.g. CPU).
+
+    Falls back to the axon generation env var when the plugin's
+    device_kind does not carry the generation."""
+    kinds = []
+    if device is not None:
+        kinds.append(str(getattr(device, "device_kind", "")))
+    else:
+        try:
+            kinds.append(str(getattr(jax.devices()[0], "device_kind", "")))
+        except Exception:  # pragma: no cover
+            pass
+    kinds.append(os.environ.get("PALLAS_AXON_TPU_GEN", ""))
+    for kind in kinds:
+        kl = kind.lower()
+        for sub, peak in _PEAK_BF16:
+            if sub in kl:
+                return peak
+    return None

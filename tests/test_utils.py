@@ -110,3 +110,34 @@ class TestMultihost:
         monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
         with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
             multihost.initialize_distributed()
+
+
+class TestPlatformDetection:
+    """TPU gates must recognize TPU chips exposed through experimental
+    PJRT plugins (platform name != "tpu" but device_kind names the chip) —
+    otherwise the Pallas/MXU fast paths silently fall back on hardware."""
+
+    class _Dev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self.device_kind = kind
+
+    def test_device_is_tpu(self):
+        from harmony_tpu.utils.platform import device_is_tpu
+
+        assert device_is_tpu(self._Dev("tpu", "TPU v4"))
+        assert device_is_tpu(self._Dev("axon", "whatever"))
+        assert device_is_tpu(self._Dev("plugin", "TPU v5 lite"))
+        assert not device_is_tpu(self._Dev("cpu", "cpu"))
+
+    def test_peak_bf16_flops(self):
+        from harmony_tpu.utils.platform import peak_bf16_flops
+
+        assert peak_bf16_flops(self._Dev("tpu", "TPU v5 lite")) == 197e12
+        assert peak_bf16_flops(self._Dev("tpu", "TPU v4")) == 275e12
+        assert peak_bf16_flops(self._Dev("cpu", "cpu")) in (None, 197e12)
+
+    def test_tpu_backend_false_on_cpu(self):
+        from harmony_tpu.utils.platform import tpu_backend
+
+        assert tpu_backend() is False  # conftest pins the cpu backend
