@@ -22,6 +22,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The metric name hardcodes "8-device virtual mesh": force the 8 virtual
+# devices ourselves (must happen before jax import) so a bare run can't
+# silently record a 1-device sample into the same series.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -47,6 +56,7 @@ def _cfg(job_id, trainer, app_params, data_fn, data_args, n):
 
 def main() -> None:
     devices = jax.devices()[:8]
+    assert len(devices) == 8, f"need 8 virtual devices, have {len(devices)}"
     mlr_n, nmf_rows, lda_docs = 2048, 512, 256
     jobs = [
         _cfg("mw-mlr", "harmony_tpu.apps.mlr:MLRTrainer",

@@ -198,6 +198,18 @@ class PodJobServer(JobServer):
                     reports = self._collect_done(config.job_id, timeout=600.0)
                 except Exception as e:  # noqa: BLE001 - job already resolved
                     reports = {"error": f"report collection failed: {e}"}
+                # A follower that never reported is wedged (likely stuck in
+                # a collective): the next RUN_JOB's collectives could never
+                # complete — poison the pod like the broadcast-failure path.
+                dead = [pid for pid, r in reports.items()
+                        if isinstance(r, dict) and not r.get("ok", True)
+                        and "follower read" in str(r.get("error", ""))]
+                if dead:
+                    self._pod_broken = (
+                        f"follower(s) {dead} never reported for "
+                        f"{config.job_id}"
+                    )
+                    server_log.error("pod broken: %s", self._pod_broken)
                 self.pod_reports[config.job_id] = reports
                 while len(self.pod_reports) > 256:  # bound leader memory
                     self.pod_reports.pop(next(iter(self.pod_reports)))

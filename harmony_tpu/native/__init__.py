@@ -49,7 +49,8 @@ class BlockCorruptError(IOError):
 
 def _build() -> bool:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, _SRC]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, _SRC,
+           "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -87,6 +88,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ht_blk_write.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.ht_blk_write2.restype = ctypes.c_int32
+        lib.ht_blk_write2.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,
         ]
         lib.ht_blk_read.restype = ctypes.c_int64
         lib.ht_blk_read.argtypes = [
@@ -152,8 +159,13 @@ def parse_libsvm(
     return x[:n], y[:n]
 
 
-def blk_write(path: str, arr: np.ndarray) -> None:
-    """Write an array as a CRC-checked block file."""
+def blk_write(path: str, arr: np.ndarray, level: int = 1) -> None:
+    """Write an array as a CRC-checked block file.
+
+    ``level``: zlib compression 1..9 for the v2 format (payload stored raw
+    when incompressible); 0 writes the uncompressed v1 format. Compression
+    exists for the durable-commit leg — a checkpoint block crosses the
+    network twice in the two-stage protocol (temp -> object store)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
@@ -162,17 +174,24 @@ def blk_write(path: str, arr: np.ndarray) -> None:
     if code is None:
         raise TypeError(f"unsupported block dtype {a.dtype}")
     shape = (ctypes.c_uint64 * max(a.ndim, 1))(*(a.shape or (0,)))
-    rc = lib.ht_blk_write(
-        path.encode(), a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
-        shape, a.ndim, code,
-    )
+    if level > 0:
+        rc = lib.ht_blk_write2(
+            path.encode(), a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+            shape, a.ndim, code, level,
+        )
+    else:
+        rc = lib.ht_blk_write(
+            path.encode(), a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+            shape, a.ndim, code,
+        )
     if rc != 0:
         raise IOError(f"blk_write({path}) failed: rc={rc}")
 
 
 def _py_blk_read(path: str) -> np.ndarray:
-    """Pure-Python .blk reader (same format, zlib CRC) so checkpoints
-    written with the native codec restore in g++-less environments."""
+    """Pure-Python .blk reader (v1 + compressed v2, zlib CRC) so
+    checkpoints written with the native codec restore in g++-less
+    environments."""
     import struct
     import zlib
 
@@ -181,13 +200,31 @@ def _py_blk_read(path: str) -> np.ndarray:
         if len(head) < 12:
             raise IOError(f"blk_read({path}): truncated header")
         magic, dtype_code, ndim = struct.unpack("<III", head)
-        if magic != 0x48544231 or ndim > 8:
+        if magic not in (0x48544231, 0x48544232) or ndim > 8:
             raise IOError(f"blk_read({path}): bad magic/ndim")
         shape = struct.unpack(f"<{ndim}Q", f.read(8 * ndim)) if ndim else ()
+        raw_n = comp_n = None
+        if magic == 0x48544232:
+            sizes = f.read(16)
+            if len(sizes) < 16:
+                raise IOError(f"blk_read({path}): truncated header")
+            raw_n, comp_n = struct.unpack("<QQ", sizes)
+            # bound header-carried sizes before allocating from them (a
+            # corrupt raw_n must not drive an unbounded decompress buffer)
+            if comp_n > raw_n or (comp_n != raw_n
+                                  and raw_n > comp_n * 1032 + 1024):
+                raise IOError(f"blk_read({path}): implausible size header")
         rest = f.read()
     if len(rest) < 4:
         raise IOError(f"blk_read({path}): truncated payload")
     payload, crc_stored = rest[:-4], struct.unpack("<I", rest[-4:])[0]
+    if comp_n is not None and comp_n != raw_n:
+        if len(payload) != comp_n:
+            raise IOError(f"blk_read({path}): truncated payload")
+        try:
+            payload = zlib.decompress(payload, bufsize=raw_n)
+        except zlib.error as e:
+            raise BlockCorruptError(f"corrupt block {path}: {e}") from None
     if (zlib.crc32(payload) & 0xFFFFFFFF) != crc_stored:
         raise BlockCorruptError(f"CRC mismatch reading {path}")
     if dtype_code not in _CODE_DTYPES:
@@ -215,8 +252,8 @@ def blk_read(path: str) -> np.ndarray:
         path.encode(), out.ctypes.data_as(ctypes.c_void_p), nbytes,
         shape, ctypes.byref(ndim), ctypes.byref(dtype),
     )
-    if rc == -6:
-        raise BlockCorruptError(f"CRC mismatch reading {path}")
+    if rc in (-6, -8):  # CRC mismatch / failed inflate — both corruption
+        raise BlockCorruptError(f"corrupt block {path} (rc={rc})")
     if rc < 0:
         raise IOError(f"blk_read({path}) failed: rc={rc}")
     shp = tuple(shape[i] for i in range(ndim.value))

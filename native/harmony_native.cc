@@ -20,6 +20,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <zlib.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -94,11 +96,21 @@ int64_t ht_parse_libsvm(const char* buf, uint64_t len, int32_t num_features,
 }
 
 // ---------------------------------------------------------------------------
-// Block files: [magic u32][dtype u32][ndim u32][shape u64 x ndim]
+// Block files.
+//   v1 "HTB1": [magic u32][dtype u32][ndim u32][shape u64 x ndim]
 //              [payload bytes][crc32 u32 of payload]
+//   v2 "HTB2": [magic u32][dtype u32][ndim u32][shape u64 x ndim]
+//              [raw u64][comp u64][payload comp bytes][crc32 u32 of RAW]
+//   v2 adds zlib payload compression (comp == raw means stored raw; the
+//   writer keeps whichever is smaller). The CRC always covers the RAW
+//   bytes, so a bad inflate fails the same check as bit rot. Durable
+//   commit to object stores is the reason this exists: the two-stage
+//   protocol (ChkpManagerSlave.java:50-63 temp->HDFS) moves every block
+//   over the network twice.
 // ---------------------------------------------------------------------------
 
-static const uint32_t BLK_MAGIC = 0x48544231u;  // "HTB1"
+static const uint32_t BLK_MAGIC = 0x48544231u;   // "HTB1"
+static const uint32_t BLK_MAGIC2 = 0x48544232u;  // "HTB2"
 #define BLK_MAX_NDIM 8
 
 // 0 on success, negative on error.
@@ -118,55 +130,134 @@ int32_t ht_blk_write(const char* path, const void* data, uint64_t nbytes,
   return ok ? 0 : -3;
 }
 
+// v2 writer: zlib-compress the payload at `level` (1..9; <=0 stores raw).
+// Keeps whichever of raw/compressed is smaller. 0 on success.
+int32_t ht_blk_write2(const char* path, const void* data, uint64_t nbytes,
+                      const uint64_t* shape, int32_t ndim, int32_t dtype_code,
+                      int32_t level) {
+  if (ndim < 0 || ndim > BLK_MAX_NDIM) return -2;
+  uint8_t* comp_buf = nullptr;
+  uint64_t comp_n = nbytes;  // == raw means "stored raw"
+  if (level > 0 && nbytes > 0) {
+    uLongf bound = compressBound((uLong)nbytes);
+    comp_buf = (uint8_t*)malloc(bound);
+    if (!comp_buf) return -7;
+    uLongf got = bound;
+    if (compress2(comp_buf, &got, (const Bytef*)data, (uLong)nbytes,
+                  level > 9 ? 9 : level) == Z_OK &&
+        (uint64_t)got < nbytes) {
+      comp_n = (uint64_t)got;
+    } else {
+      free(comp_buf);
+      comp_buf = nullptr;  // incompressible: store raw
+    }
+  }
+  FILE* f = fopen(path, "wb");
+  if (!f) {
+    free(comp_buf);
+    return -1;
+  }
+  uint32_t head[3] = {BLK_MAGIC2, (uint32_t)dtype_code, (uint32_t)ndim};
+  uint64_t sizes[2] = {nbytes, comp_n};
+  uint32_t crc = ht_crc32((const uint8_t*)data, nbytes);
+  const void* payload = comp_buf ? (const void*)comp_buf : data;
+  int ok = fwrite(head, sizeof(head), 1, f) == 1 &&
+           (ndim == 0 || fwrite(shape, sizeof(uint64_t), ndim, f) == (size_t)ndim) &&
+           fwrite(sizes, sizeof(uint64_t), 2, f) == 2 &&
+           (comp_n == 0 || fwrite(payload, 1, comp_n, f) == comp_n) &&
+           fwrite(&crc, sizeof(crc), 1, f) == 1;
+  ok = (fflush(f) == 0) && ok;
+  ok = (fclose(f) == 0) && ok;
+  free(comp_buf);
+  return ok ? 0 : -3;
+}
+
 // Phase 1 (out == NULL): fills *dtype_out, *ndim_out, shape_out and returns
-// payload byte count. Phase 2 (out != NULL, out_cap >= nbytes): copies the
-// payload, verifies CRC. Returns nbytes on success; negative on error
-// (-4 bad magic / truncated header, -5 payload/out_cap mismatch,
-//  -6 CRC mismatch — the corrupt-block signal).
+// the RAW payload byte count. Phase 2 (out != NULL, out_cap >= nbytes):
+// copies (v2: inflates) the payload, verifies the raw CRC. Returns nbytes
+// on success; negative on error (-4 bad magic / truncated header,
+// -5 payload/out_cap mismatch, -6 CRC mismatch — the corrupt-block signal,
+// -7 OOM, -8 inflate failure).
 int64_t ht_blk_read(const char* path, void* out, uint64_t out_cap,
                     uint64_t* shape_out, int32_t* ndim_out,
                     int32_t* dtype_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
   uint32_t head[3];
-  if (fread(head, sizeof(head), 1, f) != 1 || head[0] != BLK_MAGIC ||
+  if (fread(head, sizeof(head), 1, f) != 1 ||
+      (head[0] != BLK_MAGIC && head[0] != BLK_MAGIC2) ||
       head[2] > BLK_MAX_NDIM) {
     fclose(f);
     return -4;
   }
+  int is_v2 = head[0] == BLK_MAGIC2;
   int32_t ndim = (int32_t)head[2];
   uint64_t shape[BLK_MAX_NDIM];
   if (ndim > 0 && fread(shape, sizeof(uint64_t), ndim, f) != (size_t)ndim) {
     fclose(f);
     return -4;
   }
+  uint64_t raw_n = 0, comp_n = 0;
+  if (is_v2) {
+    uint64_t sizes[2];
+    if (fread(sizes, sizeof(uint64_t), 2, f) != 2) { fclose(f); return -4; }
+    raw_n = sizes[0];
+    comp_n = sizes[1];
+    // Sanity-bound the header-carried sizes BEFORE anyone allocates from
+    // them: a bit flip in raw_n must fail like any other corruption, not
+    // drive an unbounded allocation in the caller. zlib's worst-case
+    // expansion is < 1032x (+ small constant); comp > raw never happens
+    // (the writer stores raw in that case).
+    if (comp_n > raw_n ||
+        (comp_n != raw_n && raw_n > comp_n * 1032 + 1024)) {
+      fclose(f);
+      return -4;
+    }
+  }
   long data_start = ftell(f);
   if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return -4; }
   long file_end = ftell(f);
-  int64_t nbytes = file_end - data_start - (long)sizeof(uint32_t);
-  if (nbytes < 0) { fclose(f); return -4; }
+  int64_t stored = file_end - data_start - (long)sizeof(uint32_t);
+  if (stored < 0) { fclose(f); return -4; }
+  if (!is_v2) {
+    raw_n = comp_n = (uint64_t)stored;
+  } else if ((uint64_t)stored != comp_n) {
+    fclose(f);
+    return -4;  // truncated payload
+  }
   if (dtype_out) *dtype_out = (int32_t)head[1];
   if (ndim_out) *ndim_out = ndim;
   if (shape_out)
     for (int32_t i = 0; i < ndim; i++) shape_out[i] = shape[i];
   if (!out) {  // metadata probe
     fclose(f);
-    return nbytes;
+    return (int64_t)raw_n;
   }
-  if ((uint64_t)nbytes > out_cap) { fclose(f); return -5; }
+  if (raw_n > out_cap) { fclose(f); return -5; }
   if (fseek(f, data_start, SEEK_SET) != 0) { fclose(f); return -4; }
-  if (nbytes > 0 && fread(out, 1, (size_t)nbytes, f) != (size_t)nbytes) {
-    fclose(f);
-    return -4;
+  int64_t rc = (int64_t)raw_n;
+  if (comp_n == raw_n) {  // stored raw (v1, or incompressible v2)
+    if (raw_n > 0 && fread(out, 1, (size_t)raw_n, f) != (size_t)raw_n) rc = -4;
+  } else {
+    uint8_t* comp_buf = (uint8_t*)malloc(comp_n ? comp_n : 1);
+    if (!comp_buf) { fclose(f); return -7; }
+    if (fread(comp_buf, 1, (size_t)comp_n, f) != (size_t)comp_n) {
+      rc = -4;
+    } else {
+      uLongf got = (uLongf)raw_n;
+      if (uncompress((Bytef*)out, &got, comp_buf, (uLong)comp_n) != Z_OK ||
+          (uint64_t)got != raw_n)
+        rc = -8;
+    }
+    free(comp_buf);
   }
-  uint32_t crc_stored;
-  if (fread(&crc_stored, sizeof(crc_stored), 1, f) != 1) {
-    fclose(f);
-    return -4;
-  }
+  uint32_t crc_stored = 0;
+  if (rc >= 0 && fread(&crc_stored, sizeof(crc_stored), 1, f) != 1) rc = -4;
   fclose(f);
-  if (ht_crc32((const uint8_t*)out, (uint64_t)nbytes) != crc_stored) return -6;
-  return nbytes;
+  if (rc >= 0 &&
+      ht_crc32((const uint8_t*)out, raw_n) != crc_stored)
+    return -6;
+  return rc;
 }
 
 }  // extern "C"
