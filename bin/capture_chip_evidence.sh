@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Capture the round's on-chip evidence in one shot: headline bench,
+# MFU/data-plane microbenches, and the single-chip compile check.
+# Artifacts land in benchmarks/ as committed JSON (suffix = $1, e.g. r02).
+# Safe on a wedged transport: every stage probes with a bounded deadline
+# and records an error line instead of hanging.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+SUF="${1:-local}"
+
+echo "== headline bench (bench.py)"
+python bench.py | tee "benchmarks/BENCH_${SUF}.json"
+
+echo "== microbenches incl. MFU (benchmarks/micro.py)"
+python benchmarks/micro.py all | tee "benchmarks/MICRO_${SUF}.json"
+
+echo "== single-chip compile check (__graft_entry__.entry)"
+python - <<'EOF'
+import json, time
+from harmony_tpu.utils.devices import discover_devices
+try:
+    devs = discover_devices()
+except RuntimeError as e:
+    print(json.dumps({"metric": "entry compile", "value": None,
+                      "error": str(e)}))
+    raise SystemExit(0)
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+t0 = time.perf_counter()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+jax.block_until_ready(jax.jit(fn)(*args))
+print(json.dumps({"metric": "entry forward", "device": str(devs[0]),
+                  "compile_sec": round(compile_s, 1),
+                  "step_ms": round((time.perf_counter() - t0) * 1e3, 2)}))
+EOF

@@ -130,10 +130,15 @@ class PodJobServer(JobServer):
                     conn.settimeout(max(0.1, deadline - time.monotonic()))
                     msg = _recv(f)
                 except (socket.timeout, OSError) as e:
-                    out[pid] = {"ok": False, "error": f"follower read: {e}"}
+                    # "infra" marks leader-observed transport failures
+                    # (timeout/hangup) — the follower is gone or wedged —
+                    # as opposed to a follower-REPORTED job error, after
+                    # which the follower is alive and serviceable.
+                    out[pid] = {"ok": False, "infra": True,
+                                "error": f"follower read: {e}"}
                     continue
                 if msg is None:
-                    out[pid] = {"ok": False,
+                    out[pid] = {"ok": False, "infra": True,
                                 "error": "follower closed connection"}
                 elif msg.get("job_id") == job_id:
                     out[pid] = msg
@@ -202,8 +207,7 @@ class PodJobServer(JobServer):
                 # a collective): the next RUN_JOB's collectives could never
                 # complete — poison the pod like the broadcast-failure path.
                 dead = [pid for pid, r in reports.items()
-                        if isinstance(r, dict) and not r.get("ok", True)
-                        and "follower read" in str(r.get("error", ""))]
+                        if isinstance(r, dict) and r.get("infra")]
                 if dead:
                     self._pod_broken = (
                         f"follower(s) {dead} never reported for "

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from harmony_tpu.metrics.collector import BatchMetrics, ServerMetrics
 
@@ -30,6 +30,11 @@ class DolphinPlan:
     evaluators_to_add: List[str] = field(default_factory=list)    # virtual ids
     evaluators_to_delete: List[str] = field(default_factory=list)  # real ids
     transfer_steps: List[TransferStep] = field(default_factory=list)
+    # Optional per-request resource spec for an added evaluator (virtual id
+    # -> ExecutorConfig with device_kind / process_index) — heterogeneous
+    # requests flow through AllocateOp to DevicePool.lease's matching (ref:
+    # HeterogeneousEvalManager.java:40-70). Absent = homogeneous.
+    add_specs: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def empty(self) -> bool:

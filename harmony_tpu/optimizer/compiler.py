@@ -27,11 +27,19 @@ from harmony_tpu.plan.plan import ETPlan
 
 class PlanCompiler:
     def compile(self, dplan: DolphinPlan, table_id: str) -> ETPlan:
+        stray = set(dplan.add_specs) - set(dplan.evaluators_to_add)
+        if stray:
+            # a typo'd virtual id would otherwise silently lease ANY device
+            # where the optimizer asked for a specific kind
+            raise ValueError(
+                f"add_specs for unknown virtual ids {sorted(stray)}; "
+                f"evaluators_to_add={dplan.evaluators_to_add}"
+            )
         plan = ETPlan()
         alloc_ops: Dict[str, Op] = {}
         assoc_ops: Dict[str, Op] = {}
         for vid in dplan.evaluators_to_add:
-            a = plan.add_op(AllocateOp(vid))
+            a = plan.add_op(AllocateOp(vid, conf=dplan.add_specs.get(vid)))
             alloc_ops[vid] = a
             assoc_ops[vid] = plan.add_op(AssociateOp(table_id, vid), depends_on=[a])
         move_ops: List[Op] = []

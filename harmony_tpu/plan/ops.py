@@ -49,16 +49,22 @@ class PlanContext:
 
 
 class AllocateOp(Op):
-    """Allocate one executor; binds ``virtual_id`` to the real id."""
+    """Allocate one executor; binds ``virtual_id`` to the real id.
+
+    ``conf`` (an ExecutorConfig) carries an optional heterogeneous resource
+    spec — device kind / host process — matched by the pool at lease time
+    (ref: HeterogeneousEvalManager.java:40-70); an unmatchable spec fails
+    the op (and with it the plan) loudly."""
 
     kind = "allocate"
 
-    def __init__(self, virtual_id: str) -> None:
+    def __init__(self, virtual_id: str, conf: Any = None) -> None:
         super().__init__()
         self.virtual_id = virtual_id
+        self.conf = conf
 
     def execute(self, ctx: PlanContext) -> None:
-        (ex,) = ctx.master.add_executors(1)
+        (ex,) = ctx.master.add_executors(1, self.conf)
         ctx.virtual_ids[self.virtual_id] = ex.id
 
 

@@ -85,6 +85,33 @@ class TestPlanCompiler:
         assert result.success, result.error
         assert len(h.block_manager.executors) == 3
 
+    def test_add_with_device_spec(self, devices):
+        """DolphinPlan.add_specs flows through AllocateOp to the pool's
+        heterogeneous matching; an unmatchable spec fails the plan loudly
+        (ref: HeterogeneousEvalManager.java:40-70 per-request specs)."""
+        from harmony_tpu.config.params import ExecutorConfig
+
+        master = ETMaster(DevicePool(devices[:3]))
+        exs = master.add_executors(2)
+        cfg = TableConfig(table_id="hspec", capacity=32, value_shape=(), num_blocks=8)
+        h = master.create_table(cfg, [e.id for e in exs])
+        dplan = DolphinPlan(
+            evaluators_to_add=["v0"],
+            transfer_steps=[TransferStep("hspec", exs[0].id, "v0", 2)],
+            add_specs={"v0": ExecutorConfig(device_kind="cpu",
+                                            process_index=0)},
+        )
+        result = PlanExecutor(master).execute(PlanCompiler().compile(dplan, "hspec"))
+        assert result.success, result.error
+        assert len(h.block_manager.executors) == 3
+        bad = DolphinPlan(
+            evaluators_to_add=["v1"],
+            add_specs={"v1": ExecutorConfig(device_kind="tpu")},
+        )
+        result = PlanExecutor(master).execute(PlanCompiler().compile(bad, "hspec"))
+        assert not result.success
+        assert "kind='tpu'" in str(result.error)
+
     def test_delete_orders_drain_first(self, devices):
         master = ETMaster(DevicePool(devices))
         exs = master.add_executors(3)
@@ -203,3 +230,14 @@ class TestResourceFluctuator:
 
         with _pytest.raises(ValueError):
             ResourceFluctuator(base=1, num_extra=1, period_sec=0)
+
+
+def test_stray_add_spec_rejected(devices):
+    from harmony_tpu.config.params import ExecutorConfig
+
+    dplan = DolphinPlan(
+        evaluators_to_add=["v0"],
+        add_specs={"v-typo": ExecutorConfig(device_kind="cpu")},
+    )
+    with pytest.raises(ValueError, match="unknown virtual ids"):
+        PlanCompiler().compile(dplan, "t")
