@@ -8,11 +8,24 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 SUF="${1:-local}"
 
+run_stage() {  # run_stage <artifact> <cmd...>: a crash still records JSON
+  local out="$1"; shift
+  if "$@" > "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    local rc=$?  # before anything (even a $(substitution)) clobbers it
+    echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
+         "\"error\": \"stage crashed (rc=$rc): $*\"}" > "$out"
+    rm -f "$out.tmp"
+  fi
+  cat "$out"
+}
+
 echo "== headline bench (bench.py)"
-python bench.py | tee "benchmarks/BENCH_${SUF}.json"
+run_stage "benchmarks/BENCH_${SUF}.json" python bench.py
 
 echo "== microbenches incl. MFU (benchmarks/micro.py)"
-python benchmarks/micro.py all | tee "benchmarks/MICRO_${SUF}.json"
+run_stage "benchmarks/MICRO_${SUF}.json" python benchmarks/micro.py all
 
 echo "== single-chip compile check (__graft_entry__.entry)"
 python - <<'EOF'
@@ -27,12 +40,12 @@ except RuntimeError as e:
 import jax
 import __graft_entry__ as g
 fn, args = g.entry()
+jfn = jax.jit(fn)  # ONE wrapper: a second jax.jit(fn) would recompile
 t0 = time.perf_counter()
-out = jax.jit(fn)(*args)
-jax.block_until_ready(out)
+jax.block_until_ready(jfn(*args))
 compile_s = time.perf_counter() - t0
 t0 = time.perf_counter()
-jax.block_until_ready(jax.jit(fn)(*args))
+jax.block_until_ready(jfn(*args))
 print(json.dumps({"metric": "entry forward", "device": str(devs[0]),
                   "compile_sec": round(compile_s, 1),
                   "step_ms": round((time.perf_counter() - t0) * 1e3, 2)}))

@@ -527,6 +527,33 @@ class TestJobLogger:
         assert any(m.startswith("shutdown initiated") for m in msgs)
 
 
+class TestPodFastFail:
+    def test_broken_pod_fails_dispatch_fast(self, devices):
+        """Once the pod is poisoned (partial broadcast / wedged follower),
+        later dispatches must fail in milliseconds with a restart
+        instruction — not hang in collectives that can never complete."""
+        from harmony_tpu.jobserver.pod import PodJobServer
+
+        server = PodJobServer(1, device_pool=DevicePool(devices[:1]),
+                              num_followers=1)
+        server.start()
+
+        class _FakeConn:
+            def close(self):
+                pass
+
+        server._followers[1] = (_FakeConn(), None)
+        server._pod_broken = "simulated wedged follower"
+        fut = server.submit(addvector_job("podfail", n=32, epochs=1,
+                                          workers=1, slack=0))
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="pod is broken"):
+            fut.result(timeout=60)
+        assert time.monotonic() - t0 < 5.0
+        server._followers.clear()
+        server.shutdown(timeout=30)
+
+
 class TestJobOptimizerLoop:
     def test_job_reconfigures_itself_mid_training(self, devices):
         """JobConfig.optimizer wires the per-job elasticity loop (the
