@@ -157,8 +157,43 @@ class PodJobServer(JobServer):
         jr.future.set_exception(RuntimeError(error))
         self._scheduler.on_job_finish(config.job_id)
 
+    def _status(self) -> Dict[str, Any]:
+        out = super()._status()
+        out["pod"] = {
+            "followers": sorted(self._followers),
+            "broken": self._pod_broken,
+        }
+        return out
+
+    def submit(self, config: JobConfig):
+        # Statically-invalid configs are rejected HERE so TCP submitters
+        # see {"ok": false, error} instead of an ok-then-vanished job
+        # (num_workers == 0 resolves against the executor grant and is
+        # checked at dispatch).
+        if self._num_followers and config.num_workers > 1:
+            raise ValueError(
+                f"pod jobs need one dispatch thread, got num_workers="
+                f"{config.num_workers}: the SPMD lockstep contract cannot "
+                "hold across multiple dispatch threads"
+            )
+        return super().submit(config)
+
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
         with self._pod_lock:  # one pod job at a time (see module doc)
+            effective_workers = config.num_workers or len(executor_ids)
+            if self._followers and effective_workers != 1:
+                # >1 worker per process = N dispatch threads whose host
+                # scheduling differs across processes -> divergent global
+                # enqueue order -> collective mismatch. Reject loudly
+                # instead of wedging the pod.
+                self._fail_job(
+                    config,
+                    f"pod jobs need one dispatch thread, got "
+                    f"num_workers={config.num_workers} over "
+                    f"{len(executor_ids)} executors: the SPMD lockstep "
+                    "contract cannot hold across multiple dispatch threads",
+                )
+                return
             if self._followers and self._pod_broken:
                 self._fail_job(
                     config,

@@ -281,6 +281,15 @@ class JobServer:
         with self._lock:
             return [j for j, r in self._jobs.items() if not r.future.done()]
 
+    def _status(self) -> Dict[str, Any]:
+        """STATUS reply body (subclasses extend, e.g. pod health)."""
+        return {
+            "ok": True,
+            "state": self.state,
+            "running": self.running_jobs(),
+            "evaluated": sorted(self.eval_results),
+        }
+
     # -- TCP command endpoint (ref: CommandListener) ---------------------
 
     def serve_tcp(self, port: int = 0) -> int:
@@ -327,12 +336,7 @@ class JobServer:
                     self.submit(config)
                     reply = {"ok": True, "job_id": config.job_id}
                 elif cmd == "STATUS":
-                    reply = {
-                        "ok": True,
-                        "state": self.state,
-                        "running": self.running_jobs(),
-                        "evaluated": sorted(self.eval_results),
-                    }
+                    reply = self._status()
                 elif cmd == "SHUTDOWN":
                     threading.Thread(target=self.shutdown, daemon=True).start()
                     reply = {"ok": True}

@@ -553,6 +553,29 @@ class TestPodFastFail:
         server._followers.clear()
         server.shutdown(timeout=30)
 
+    def test_multiworker_pod_job_rejected(self, devices):
+        """Multi-worker jobs cannot hold the pod's SPMD lockstep contract
+        (N dispatch threads interleave differently per process) — they must
+        be rejected with a clear error, never deadlock the mesh."""
+        from harmony_tpu.jobserver.pod import PodJobServer
+
+        server = PodJobServer(1, device_pool=DevicePool(devices[:1]),
+                              num_followers=1)
+        server.start()
+
+        class _FakeConn:
+            def close(self):
+                pass
+
+        server._followers[1] = (_FakeConn(), None)
+        # statically invalid (workers > 1): rejected at SUBMIT so TCP
+        # clients get {"ok": false} instead of an ok-then-vanished job
+        with pytest.raises(ValueError, match="num_workers=2"):
+            server.submit(addvector_job("podmw", n=32, epochs=1,
+                                        workers=2, slack=0))
+        server._followers.clear()
+        server.shutdown(timeout=30)
+
 
 class TestJobOptimizerLoop:
     def test_job_reconfigures_itself_mid_training(self, devices):

@@ -125,6 +125,8 @@ def test_pod_jobserver_end_to_end():
                                 "num_classes": 4}},
         )
         sender = CommandSender(tcp_port)
+        status = sender.send_status_command()
+        assert status["pod"] == {"followers": [1], "broken": None}, status
         resp = sender.send_job_submit_command(cfg)
         assert resp.get("ok"), resp
         # poll until the job drains, then shut the pod down
