@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Flagship LM benchmarks: training tokens/sec + model FLOPs utilization.
+
+The table apps carry the reference-parity headline (bench.py); this file
+measures the framework's model path — the transformer LM whose attention
+runs through the framework kernels (Pallas flash on TPU, blockwise
+elsewhere):
+
+  train   single-device train step: tokens/sec, model-FLOPs/sec, MFU
+          (6*N*T approximation for the training FLOPs of an N-param
+          decoder, + exact attention term).
+  sp      sequence-parallel train step (ring attention over a data x seq
+          mesh): tokens/sec on whatever devices are visible — the
+          long-context path the reference has no counterpart for.
+
+Prints one JSON line per section. Run on a chip, or
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python benchmarks/lm.py sp
+for the virtual-mesh sanity pass (CPU numbers are not chip numbers).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harmony_tpu.utils.devices import discover_devices
+
+REPEATS = 5
+
+
+def _time(fn, *args):
+    out = fn(*args)  # warmup/compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / REPEATS
+
+
+def _param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+def _train_flops(n_params: int, tokens: int, cfg) -> float:
+    """~6*N per token (fwd 2N + bwd 4N) + the attention term 12*L*S*d per
+    token (QK^T + AV fwd and bwd, causal-halved)."""
+    return tokens * (6.0 * n_params
+                     + 12.0 * cfg.n_layers * cfg.max_seq * cfg.d_model / 2)
+
+
+def _mfu(achieved: float):
+    from harmony_tpu.utils.platform import device_is_tpu, peak_bf16_flops
+
+    d = jax.devices()[0]
+    peak = peak_bf16_flops(d) if device_is_tpu(d) else None
+    return round(achieved / peak, 3) if peak else None
+
+
+def _model(on_tpu: bool, seq: int | None = None, layers: int | None = None):
+    from harmony_tpu.models import TransformerConfig, TransformerLM
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=8192, d_model=512, n_heads=8, n_layers=layers or 8,
+            d_ff=2048, max_seq=seq or 1024, attn="auto", dtype=jnp.bfloat16,
+        )
+    else:
+        # CPU sanity shapes: the chip-sized model needs >10s per step on a
+        # laptop core — these validate the path, not the number
+        cfg = TransformerConfig(
+            vocab_size=1024, d_model=128, n_heads=4, n_layers=layers or 2,
+            d_ff=512, max_seq=seq or 256, attn="auto", dtype=jnp.float32,
+        )
+    return cfg, TransformerLM(cfg)
+
+
+def bench_train() -> dict:
+    from harmony_tpu.models import make_lm_data
+    from harmony_tpu.utils.platform import tpu_backend
+
+    on_tpu = tpu_backend()
+    cfg, model = _model(on_tpu)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = 8 if on_tpu else 2
+    tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
+
+    @jax.jit
+    def step(p, t):
+        loss, grads = jax.value_and_grad(model.loss)(p, t)
+        new = jax.tree.map(lambda w, g: w - 0.1 * g.astype(w.dtype), p, grads)
+        return new, loss
+
+    dt = _time(lambda p, t: step(p, t)[1], params, tokens)
+    n_tok = batch * cfg.max_seq
+    n_params = _param_count(params)
+    flops = _train_flops(n_params, n_tok, cfg)
+    out = {"metric": "lm train step", "value": round(n_tok / dt),
+           "unit": "tokens/sec", "params_m": round(n_params / 1e6, 1),
+           "seq": cfg.max_seq, "batch": batch,
+           "tflops": round(flops / dt / 1e12, 2), "mfu": _mfu(flops / dt)}
+    if not on_tpu:
+        out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+def bench_sp() -> dict:
+    from harmony_tpu.models import make_lm_data
+    from harmony_tpu.models.transformer import make_sp_train_step
+    from harmony_tpu.parallel import build_mesh
+    from harmony_tpu.utils.platform import tpu_backend
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        return {"metric": "lm sp train step", "value": None,
+                "unit": "tokens/sec", "note": "needs >=2 devices"}
+    data_ax = 2 if n % 2 == 0 else 1
+    seq_ax = n // data_ax
+    on_tpu = tpu_backend()
+    # long-context shape: sequence scales with the ring size
+    per_shard = 1024 if on_tpu else 128
+    cfg, model = _model(on_tpu, seq=per_shard * seq_ax, layers=4 if on_tpu else 1)
+    mesh = build_mesh(devs, data=data_ax, seq=seq_ax, model=1)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = (2 if on_tpu else 1) * data_ax
+    tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
+    step = make_sp_train_step(model, mesh, learning_rate=0.1, donate=False)
+    dt = _time(lambda p, t: step(p, t)[1], params, tokens)
+    n_tok = batch * cfg.max_seq
+    out = {"metric": "lm sp train step", "value": round(n_tok / dt),
+           "unit": "tokens/sec", "seq": cfg.max_seq, "batch": batch,
+           "mesh": {"data": data_ax, "seq": seq_ax},
+           "devices": n}
+    if not on_tpu:
+        out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+SECTIONS = {"train": bench_train, "sp": bench_sp}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which != "all" and which not in SECTIONS:
+        sys.exit(f"unknown section {which!r}; have {sorted(SECTIONS)} or 'all'")
+    names = list(SECTIONS) if which == "all" else [which]
+    try:
+        discover_devices()
+    except RuntimeError as e:
+        for name in names:
+            print(json.dumps({"metric": f"lm {name}", "value": None,
+                              "error": f"accelerator unreachable: {e}"}))
+        return
+    for name in names:
+        print(json.dumps(SECTIONS[name]()))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,9 @@ run_stage "benchmarks/BENCH_${SUF}.json" python bench.py
 echo "== microbenches incl. MFU (benchmarks/micro.py)"
 run_stage "benchmarks/MICRO_${SUF}.json" python benchmarks/micro.py all
 
+echo "== flagship LM train step (benchmarks/lm.py)"
+run_stage "benchmarks/LM_${SUF}.json" python benchmarks/lm.py train
+
 echo "== single-chip compile check (__graft_entry__.entry)"
 python - <<'EOF'
 import json, time

@@ -166,15 +166,17 @@ class PodJobServer(JobServer):
         return out
 
     def submit(self, config: JobConfig):
-        # Statically-invalid configs are rejected HERE so TCP submitters
-        # see {"ok": false, error} instead of an ok-then-vanished job
-        # (num_workers == 0 resolves against the executor grant and is
-        # checked at dispatch).
-        if self._num_followers and config.num_workers > 1:
+        # Rejected HERE so TCP submitters see {"ok": false, error} instead
+        # of an ok-then-vanished job. num_workers=0 (the CLI default,
+        # "one per granted executor") is included: a pod leader always
+        # holds every GLOBAL device and the default scheduler grants them
+        # all, so 0 always resolves to >1 dispatch threads.
+        if self._num_followers and config.num_workers != 1:
             raise ValueError(
-                f"pod jobs need one dispatch thread, got num_workers="
-                f"{config.num_workers}: the SPMD lockstep contract cannot "
-                "hold across multiple dispatch threads"
+                f"pod jobs need num_workers=1 (got "
+                f"{config.num_workers}; 0 means one per executor): the "
+                "SPMD lockstep contract cannot hold across multiple "
+                "dispatch threads — submit with --workers 1"
             )
         return super().submit(config)
 

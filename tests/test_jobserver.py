@@ -568,11 +568,14 @@ class TestPodFastFail:
                 pass
 
         server._followers[1] = (_FakeConn(), None)
-        # statically invalid (workers > 1): rejected at SUBMIT so TCP
-        # clients get {"ok": false} instead of an ok-then-vanished job
-        with pytest.raises(ValueError, match="num_workers=2"):
-            server.submit(addvector_job("podmw", n=32, epochs=1,
-                                        workers=2, slack=0))
+        # rejected at SUBMIT so TCP clients get {"ok": false} instead of
+        # an ok-then-vanished job — including the all-executors default
+        # (0), which on a pod always resolves to >1 dispatch threads
+        for workers in (2, 0):
+            with pytest.raises(ValueError, match="num_workers=1"):
+                server.submit(addvector_job(f"podmw{workers}", n=32,
+                                            epochs=1, workers=workers,
+                                            slack=0))
         server._followers.clear()
         server.shutdown(timeout=30)
 
