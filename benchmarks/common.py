@@ -1,0 +1,27 @@
+"""Shared helpers for the benchmark scripts (micro.py, lm.py)."""
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def timed(fn, *args, repeats: int = 10) -> float:
+    """Mean wall time per call after a warmup/compile dispatch (which also
+    drains the device queue)."""
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeats
+
+
+def mfu(achieved_flops: float):
+    """achieved/peak for ONE chip, or None off-TPU."""
+    from harmony_tpu.utils.platform import device_is_tpu, peak_bf16_flops
+
+    d = jax.devices()[0]
+    peak = peak_bf16_flops(d) if device_is_tpu(d) else None
+    return round(achieved_flops / peak, 3) if peak else None

@@ -33,17 +33,11 @@ import numpy as np
 
 from harmony_tpu.utils.devices import discover_devices
 
-REPEATS = 5
+from common import mfu as _mfu, timed  # noqa: E402 (shared helpers)
 
 
 def _time(fn, *args):
-    out = fn(*args)  # warmup/compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / REPEATS
+    return timed(fn, *args, repeats=5)
 
 
 def _param_count(params) -> int:
@@ -55,14 +49,6 @@ def _train_flops(n_params: int, tokens: int, cfg) -> float:
     token (QK^T + AV fwd and bwd, causal-halved)."""
     return tokens * (6.0 * n_params
                      + 12.0 * cfg.n_layers * cfg.max_seq * cfg.d_model / 2)
-
-
-def _mfu(achieved: float):
-    from harmony_tpu.utils.platform import device_is_tpu, peak_bf16_flops
-
-    d = jax.devices()[0]
-    peak = peak_bf16_flops(d) if device_is_tpu(d) else None
-    return round(achieved / peak, 3) if peak else None
 
 
 def _model(on_tpu: bool, seq: int | None = None, layers: int | None = None):
@@ -156,8 +142,11 @@ def main() -> None:
     try:
         discover_devices()
     except RuntimeError as e:
+        # error lines carry the SAME metric names as success lines so
+        # cross-round artifact consumers see one series in two states
+        metric_names = {"train": "lm train step", "sp": "lm sp train step"}
         for name in names:
-            print(json.dumps({"metric": f"lm {name}", "value": None,
+            print(json.dumps({"metric": metric_names[name], "value": None,
                               "error": f"accelerator unreachable: {e}"}))
         return
     for name in names:

@@ -54,6 +54,8 @@ from harmony_tpu.parallel import build_mesh
 from harmony_tpu.table import DenseTable, TableSpec
 from harmony_tpu.utils.devices import discover_devices
 
+from common import mfu, timed  # noqa: E402 (shared helpers)
+
 REPEATS = 10
 
 
@@ -64,12 +66,7 @@ def _mesh():
 
 
 def _time(fn, *args):
-    jax.block_until_ready(fn(*args))  # warm (compile) and drain the queue
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / REPEATS
+    return timed(fn, *args, repeats=REPEATS)
 
 
 def bench_table() -> dict:
@@ -153,13 +150,7 @@ def bench_attention() -> dict:
     return out
 
 
-def _mfu(achieved_flops: float):
-    """achieved/peak for ONE chip, or None off-TPU."""
-    from harmony_tpu.utils.platform import device_is_tpu, peak_bf16_flops
-
-    d = jax.devices()[0]
-    peak = peak_bf16_flops(d) if device_is_tpu(d) else None
-    return round(achieved_flops / peak, 3) if peak else None
+_mfu = mfu
 
 
 def bench_mxu() -> dict:

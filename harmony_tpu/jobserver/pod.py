@@ -168,10 +168,14 @@ class PodJobServer(JobServer):
     def submit(self, config: JobConfig):
         # Rejected HERE so TCP submitters see {"ok": false, error} instead
         # of an ok-then-vanished job. num_workers=0 (the CLI default,
-        # "one per granted executor") is included: a pod leader always
-        # holds every GLOBAL device and the default scheduler grants them
-        # all, so 0 always resolves to >1 dispatch threads.
-        if self._num_followers and config.num_workers != 1:
+        # "one per granted executor") is included when the pool holds more
+        # than one executor — the default scheduler grants them all, so 0
+        # resolves to >1 dispatch threads. (A 1-executor pod legally runs
+        # 0; the dispatch-time effective check stays as ground truth.)
+        if self._num_followers and (
+            config.num_workers > 1
+            or (config.num_workers == 0 and self._num_executors > 1)
+        ):
             raise ValueError(
                 f"pod jobs need num_workers=1 (got "
                 f"{config.num_workers}; 0 means one per executor): the "
