@@ -556,10 +556,13 @@ class TestPodFastFail:
     def test_multiworker_pod_job_rejected(self, devices):
         """Multi-worker jobs cannot hold the pod's SPMD lockstep contract
         (N dispatch threads interleave differently per process) — they must
-        be rejected with a clear error, never deadlock the mesh."""
+        be rejected with a clear error, never deadlock the mesh. A
+        MULTI-executor pod also rejects the all-executors default (0); a
+        1-executor pod legally resolves 0 to one worker (not tested here —
+        dispatch would need a live follower)."""
         from harmony_tpu.jobserver.pod import PodJobServer
 
-        server = PodJobServer(1, device_pool=DevicePool(devices[:1]),
+        server = PodJobServer(2, device_pool=DevicePool(devices[:2]),
                               num_followers=1)
         server.start()
 
