@@ -14,12 +14,15 @@ from harmony_tpu.models.transformer import (
     TransformerTrainer,
     make_lm_data,
 )
+from harmony_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
     "MoEConfig",
     "TransformerConfig",
     "TransformerLM",
     "TransformerTrainer",
+    "ViT",
+    "ViTConfig",
     "init_moe_params",
     "make_lm_data",
     "moe_ffn",

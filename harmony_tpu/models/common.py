@@ -1,0 +1,43 @@
+"""Numeric primitives shared by the model families (LM, ViT)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: valid values for a model config's ``attn`` field
+ATTN_CHOICES = ("auto", "flash", "blockwise")
+
+
+def rms_norm(x, w):
+    """RMSNorm (f32 statistics regardless of activation dtype)."""
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def validate_attn(attn: str) -> str:
+    if attn not in ATTN_CHOICES:
+        raise ValueError(f"unknown attn {attn!r}; choose from {ATTN_CHOICES}")
+    return attn
+
+
+def flash_ok(seq: int, block: int | None = None) -> bool:
+    """Can the Pallas flash kernel tile this sequence length with the
+    caller's block size? Blocks clamp to min(block, seq), so any
+    seq <= block tiles exactly; longer sequences need divisibility.
+    ``block`` must match what the caller passes to flash_attention
+    (default: the kernel's DEFAULT_BLOCK_Q)."""
+    if block is None:
+        from harmony_tpu.ops.attention import DEFAULT_BLOCK_Q
+
+        block = DEFAULT_BLOCK_Q
+    return seq % min(block, seq) == 0
+
+
+def resolve_attn(attn: str, seq: int, block: int | None = None) -> str:
+    """'auto' -> 'flash' on TPU when the kernel can tile, else 'blockwise'."""
+    if attn != "auto":
+        return attn
+    from harmony_tpu.utils.platform import tpu_backend
+
+    return "flash" if tpu_backend() and flash_ok(seq, block) else "blockwise"

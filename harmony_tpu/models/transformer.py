@@ -58,21 +58,20 @@ class TransformerConfig:
                                     # on the backward pass (HBM for FLOPs)
 
     def __post_init__(self):
+        from harmony_tpu.models.common import validate_attn
+
         if self.d_model % self.n_heads:
             raise ValueError("d_model must divide by n_heads")
         if self.sp_attn not in ("ring", "a2a"):
             raise ValueError(f"unknown sp_attn {self.sp_attn!r}")
+        validate_attn(self.attn)
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
 
-def _norm(x, w):
-    """RMSNorm (f32 statistics regardless of activation dtype)."""
-    xf = x.astype(jnp.float32)
-    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
-    return (xf * scale).astype(x.dtype) * w
+from harmony_tpu.models.common import rms_norm as _norm  # noqa: E402
 
 
 class TransformerLM:
@@ -119,12 +118,9 @@ class TransformerLM:
             sp = a2a_attention if cfg.sp_attn == "a2a" else ring_attention
             return sp(q, k, v, axis_name=axis_name, causal=True)
         S = q.shape[2]
-        attn = cfg.attn
-        if attn == "auto":
-            from harmony_tpu.utils.platform import tpu_backend
+        from harmony_tpu.models.common import resolve_attn
 
-            attn = "flash" if (tpu_backend() and S % 128 == 0) \
-                else "blockwise"
+        attn = resolve_attn(cfg.attn, S, block=128)  # matches blocks below
         if attn == "flash":
             return flash_attention(q, k, v, causal=True,
                                    block_q=min(128, S), block_k=min(128, S))

@@ -84,6 +84,8 @@ _SLOW_TESTS = {
     "test_regressions.py::test_shutdown_timeout_bounds_wedged_job",
     "test_optim.py::test_adagrad_in_lm_trainer",
     "test_migration.py::TestSparseTableMigration::test_concurrent_migration_during_sparse_training",
+    "test_vit.py::test_sharded_step_matches_single_device",
+    "test_vit.py::test_learns_and_classifies",
 }
 
 

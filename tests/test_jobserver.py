@@ -583,6 +583,49 @@ class TestPodFastFail:
         server.shutdown(timeout=30)
 
 
+class TestPodFollower:
+    def test_follower_protocol_and_error_reporting(self, devices):
+        """Drive a PodFollower with a scripted leader socket: JOIN arrives,
+        a RUN_JOB naming executors the follower does not have yields a
+        JOB_DONE error report (never a crash or a hang), and SHUTDOWN ends
+        the loop."""
+        import json as _json
+        import socket as _socket
+        import threading as _threading
+
+        from harmony_tpu.jobserver.pod import PodFollower
+
+        lsock = _socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+        box = {}
+
+        def leader():
+            conn, _ = lsock.accept()
+            f = conn.makefile("r")
+            box["join"] = _json.loads(f.readline())
+            cfg = mlr_job("pod-missing", n=64, epochs=1, workers=1)
+            conn.sendall((_json.dumps({
+                "cmd": "RUN_JOB", "conf": cfg.to_dict(),
+                "executor_ids": ["executor-does-not-exist"],
+            }) + "\n").encode())
+            box["done"] = _json.loads(f.readline())
+            conn.sendall(b'{"cmd": "SHUTDOWN"}\n')
+            conn.close()
+
+        t = _threading.Thread(target=leader, daemon=True)
+        t.start()
+        follower = PodFollower("127.0.0.1", port, pid=3, num_executors=1)
+        follower.run()  # returns on SHUTDOWN
+        t.join(timeout=30)
+        assert box["join"] == {"cmd": "JOIN", "pid": 3}
+        done = box["done"]
+        assert done["cmd"] == "JOB_DONE" and done["pid"] == 3
+        assert not done["ok"]
+        assert "missing executors" in done["error"]
+
+
 class TestJobOptimizerLoop:
     def test_job_reconfigures_itself_mid_training(self, devices):
         """JobConfig.optimizer wires the per-job elasticity loop (the
