@@ -131,7 +131,37 @@ def bench_sp() -> dict:
     return out
 
 
-SECTIONS = {"train": bench_train, "sp": bench_sp}
+def bench_decode() -> dict:
+    """KV-cache generation throughput (models/generate.py): one compiled
+    scan for the whole continuation, no per-token host round-trips."""
+    from harmony_tpu.models import make_lm_data
+    from harmony_tpu.models.generate import make_generate_fn
+    from harmony_tpu.utils.platform import tpu_backend
+
+    on_tpu = tpu_backend()
+    cfg, model = _model(on_tpu, seq=1024 if on_tpu else 128)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = 8 if on_tpu else 2
+    prompt_len = 32 if on_tpu else 8
+    num_new = (cfg.max_seq - prompt_len) // 2
+    prompt = jnp.asarray(make_lm_data(batch, prompt_len, cfg.vocab_size))
+    gen = make_generate_fn(model, prompt_len, num_new)
+    dt = _time(gen, params, prompt)
+    # the prefill is per-token decode steps too, so the honest per-token
+    # rate divides by ALL steps executed — not just the sampled ones
+    # (num_new-only would skew with the prompt/continuation split)
+    steps = prompt_len + num_new
+    out = {"metric": "lm decode (kv cache)",
+           "value": round(batch * steps / dt),
+           "unit": "tokens/sec", "batch": batch, "prompt": prompt_len,
+           "new_tokens": num_new,
+           "ms_per_token": round(dt / steps * 1e3, 2)}
+    if not on_tpu:
+        out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+SECTIONS = {"train": bench_train, "sp": bench_sp, "decode": bench_decode}
 
 
 def main() -> None:
@@ -144,7 +174,8 @@ def main() -> None:
     except RuntimeError as e:
         # error lines carry the SAME metric names as success lines so
         # cross-round artifact consumers see one series in two states
-        metric_names = {"train": "lm train step", "sp": "lm sp train step"}
+        metric_names = {"train": "lm train step", "sp": "lm sp train step",
+                        "decode": "lm decode (kv cache)"}
         for name in names:
             print(json.dumps({"metric": metric_names[name], "value": None,
                               "error": f"accelerator unreachable: {e}"}))

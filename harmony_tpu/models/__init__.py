@@ -7,6 +7,7 @@ harmony_tpu.ops kernels (flash single-chip, ring for sequence parallelism)
 and whose parameters live in the same elastic DenseTable substrate as every
 other app (so checkpointing, migration and multi-tenancy apply unchanged).
 """
+from harmony_tpu.models.generate import make_generate_fn
 from harmony_tpu.models.moe import MoEConfig, init_moe_params, moe_ffn
 from harmony_tpu.models.transformer import (
     TransformerConfig,
@@ -24,6 +25,7 @@ __all__ = [
     "ViT",
     "ViTConfig",
     "init_moe_params",
+    "make_generate_fn",
     "make_lm_data",
     "moe_ffn",
 ]

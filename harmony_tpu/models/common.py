@@ -8,6 +8,11 @@ import jax.numpy as jnp
 ATTN_CHOICES = ("auto", "flash", "blockwise")
 
 
+def dense_init(key, shape):
+    """1/sqrt(fan_in)-scaled normal init for a [fan_in, ...] weight."""
+    return jax.random.normal(key, shape, jnp.float32) * shape[0] ** -0.5
+
+
 def rms_norm(x, w):
     """RMSNorm (f32 statistics regardless of activation dtype)."""
     xf = x.astype(jnp.float32)

@@ -88,9 +88,7 @@ class TransformerLM:
         k_emb, k_pos, *k_layers = jax.random.split(rng, 2 + cfg.n_layers)
         d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
 
-        def dense(key, shape):
-            fan_in = shape[0]
-            return jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+        from harmony_tpu.models.common import dense_init as dense
 
         layers = []
         for kl in k_layers:

@@ -22,7 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from harmony_tpu.models.common import resolve_attn, rms_norm, validate_attn
+from harmony_tpu.models.common import (
+    dense_init,
+    resolve_attn,
+    rms_norm,
+    validate_attn,
+)
 from harmony_tpu.ops import blockwise_attention, flash_attention
 from harmony_tpu.parallel.mesh import DATA_AXIS
 
@@ -72,27 +77,23 @@ class ViT:
         ks = jax.random.split(key, 4 + cfg.n_layers)
         d, f = cfg.d_model, cfg.d_ff
 
-        def dense(k, fan_in, shape):
-            return (jax.random.normal(k, shape, jnp.float32)
-                    * fan_in ** -0.5)
-
         layers = []
         for i in range(cfg.n_layers):
             lk = jax.random.split(ks[4 + i], 4)
             layers.append({
                 "ln1": jnp.ones((d,), jnp.float32),
-                "wqkv": dense(lk[0], d, (d, 3 * d)),
-                "wo": dense(lk[1], d, (d, d)),
+                "wqkv": dense_init(lk[0], (d, 3 * d)),
+                "wo": dense_init(lk[1], (d, d)),
                 "ln2": jnp.ones((d,), jnp.float32),
-                "w1": dense(lk[2], d, (d, f)),
-                "w2": dense(lk[3], f, (f, d)),
+                "w1": dense_init(lk[2], (d, f)),
+                "w2": dense_init(lk[3], (f, d)),
             })
         return {
-            "embed": dense(ks[0], cfg.patch_dim, (cfg.patch_dim, d)),
+            "embed": dense_init(ks[0], (cfg.patch_dim, d)),
             "pos": 0.02 * jax.random.normal(ks[1], (cfg.seq, d), jnp.float32),
             "cls": jnp.zeros((d,), jnp.float32),
             "ln_f": jnp.ones((d,), jnp.float32),
-            "head": dense(ks[2], d, (d, cfg.num_classes)),
+            "head": dense_init(ks[2], (d, cfg.num_classes)),
             "layers": layers,
         }
 

@@ -86,6 +86,7 @@ _SLOW_TESTS = {
     "test_migration.py::TestSparseTableMigration::test_concurrent_migration_during_sparse_training",
     "test_vit.py::test_sharded_step_matches_single_device",
     "test_vit.py::test_learns_and_classifies",
+    "test_generate.py::test_greedy_matches_stepwise_argmax",
 }
 
 

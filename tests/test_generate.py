@@ -1,0 +1,80 @@
+"""KV-cache decoding: cache-consistency with the full forward, and the
+jitted generate loop."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harmony_tpu.models import TransformerConfig, TransformerLM, make_lm_data
+from harmony_tpu.models.generate import (
+    decode_step,
+    init_kv_cache,
+    make_generate_fn,
+)
+
+CFG = TransformerConfig(vocab_size=128, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, max_seq=32, attn="blockwise")
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TransformerLM(CFG)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_cache_decode_matches_full_forward(model_and_params):
+    """Stepping a sequence through the KV cache must reproduce the full
+    forward's logits at every position — the cache correctness pin."""
+    model, params = model_and_params
+    tokens = jnp.asarray(make_lm_data(3, 16, CFG.vocab_size, seed=4))
+    full = model.apply(params, tokens)                    # [B, 16, V]
+    cache = init_kv_cache(CFG, 3)
+    step = jax.jit(lambda c, t, p: decode_step(model, params, c, t, p))
+    for pos in range(16):
+        logits, cache = step(cache, tokens[:, pos], jnp.int32(pos))
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, pos]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_greedy_generation_shapes_and_determinism(model_and_params):
+    model, params = model_and_params
+    gen = make_generate_fn(model, prompt_len=4, num_new=6)
+    prompt = jnp.asarray(make_lm_data(2, 4, CFG.vocab_size, seed=5))
+    out1 = gen(params, prompt)
+    out2 = gen(params, prompt)
+    assert out1.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompt))
+
+
+def test_greedy_matches_stepwise_argmax(model_and_params):
+    """The fused scan must produce exactly the tokens a hand-rolled
+    argmax decode produces."""
+    model, params = model_and_params
+    prompt = jnp.asarray(make_lm_data(2, 3, CFG.vocab_size, seed=6))
+    gen = make_generate_fn(model, prompt_len=3, num_new=5)
+    fused = np.asarray(gen(params, prompt))
+    # hand-rolled: full forward each step, argmax of the last position
+    toks = np.asarray(prompt)
+    for _ in range(5):
+        logits = model.apply(params, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
+        toks = np.concatenate([toks, nxt], axis=1)
+    np.testing.assert_array_equal(fused, toks)
+
+
+def test_sampling_temperature(model_and_params):
+    model, params = model_and_params
+    gen = make_generate_fn(model, prompt_len=2, num_new=8, temperature=1.0)
+    prompt = jnp.asarray(make_lm_data(2, 2, CFG.vocab_size, seed=7))
+    a = np.asarray(gen(params, prompt, jax.random.PRNGKey(1)))
+    b = np.asarray(gen(params, prompt, jax.random.PRNGKey(2)))
+    assert a.shape == b.shape == (2, 10)
+    assert (a[:, 2:] != b[:, 2:]).any()  # different keys, different samples
+
+
+def test_length_bound_validated(model_and_params):
+    model, _ = model_and_params
+    with pytest.raises(ValueError, match="max_seq"):
+        make_generate_fn(model, prompt_len=30, num_new=10)
