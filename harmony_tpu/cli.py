@@ -93,6 +93,17 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         data_fn="harmony_tpu.models.transformer:make_lm_data",
         data_args={"num_seqs": 64, "seq_len": 65, "vocab_size": 128},
     ),
+    "vit": dict(
+        app_type="dolphin",
+        trainer="harmony_tpu.models.vit:ViTTrainer",
+        app_params={"image_size": 16, "patch_size": 4, "num_classes": 4,
+                    "channels": 3, "d_model": 64, "n_heads": 4,
+                    "n_layers": 2, "d_ff": 128, "row_width": 512,
+                    "step_size": 0.05},
+        data_fn="harmony_tpu.models.vit:make_synthetic",
+        data_args={"n": 128, "image_size": 16, "patch_size": 4,
+                   "num_classes": 4, "channels": 3},
+    ),
     "fm": dict(
         app_type="dolphin",
         trainer="harmony_tpu.apps.widedeep:FMTrainer",
@@ -165,17 +176,22 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
     else:
         user["data_fn"] = preset["data_fn"]
         user["data_args"] = {**preset["data_args"], **_parse_kv(args.data)}
-    if app == "lm":
-        # vocab must match between model and data; an explicit override on
-        # either side wins over the preset default (both sides: error).
-        set_v = _parse_kv(args.set).get("vocab_size")
-        data_v = _parse_kv(args.data).get("vocab_size")
+    # Model/data-coupled keys must match between --set and --data: an
+    # explicit override on either side wins over the preset default, a
+    # conflicting pair is an error at submit time (not silently-wrong
+    # training or a mid-job shape crash).
+    _COUPLED = {"lm": ("vocab_size",),
+                "vit": ("image_size", "patch_size", "num_classes", "channels")}
+    for key in _COUPLED.get(app, ()):
+        set_v = _parse_kv(args.set).get(key)
+        data_v = _parse_kv(args.data).get(key)
         if set_v is not None and data_v is not None and set_v != data_v:
             raise SystemExit(
-                f"conflicting vocab_size: --set {set_v} vs --data {data_v}")
-        vocab = set_v if set_v is not None else user["data_args"]["vocab_size"]
-        preset["app_params"]["vocab_size"] = vocab
-        user["data_args"]["vocab_size"] = vocab
+                f"conflicting {key}: --set {set_v} vs --data {data_v}")
+        v = set_v if set_v is not None else user["data_args"].get(
+            key, data_v if data_v is not None else preset["app_params"][key])
+        preset["app_params"][key] = v
+        user["data_args"][key] = v
     # Dolphin-only flags must fail LOUDLY on graph apps and before any jax
     # work (same client-side validation stance as the --set overrides).
     if preset["app_type"] == "pregel" and (

@@ -15,15 +15,18 @@ from harmony_tpu.models.transformer import (
     TransformerTrainer,
     make_lm_data,
 )
-from harmony_tpu.models.vit import ViT, ViTConfig
+from harmony_tpu.models.pytree_trainer import PyTreeTrainer
+from harmony_tpu.models.vit import ViT, ViTConfig, ViTTrainer
 
 __all__ = [
     "MoEConfig",
     "TransformerConfig",
     "TransformerLM",
     "TransformerTrainer",
+    "PyTreeTrainer",
     "ViT",
     "ViTConfig",
+    "ViTTrainer",
     "init_moe_params",
     "make_generate_fn",
     "make_lm_data",

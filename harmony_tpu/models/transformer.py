@@ -32,11 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
-from harmony_tpu.config.params import TableConfig
-from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.ops.attention import blockwise_attention, flash_attention
 from harmony_tpu.ops.ring import ring_attention
 from harmony_tpu.ops.ulysses import a2a_attention
@@ -413,126 +410,20 @@ def make_lm_data(
 # Trainer SPI integration (LM in the elastic PS table)
 # ---------------------------------------------------------------------------
 
-class TransformerTrainer(Trainer):
-    """Train the LM through the framework: the flattened params pytree lives
-    in a range-partitioned DenseTable (rows of ``row_width`` f32), pull="all"
-    re-assembles it each batch, and the push folds the update through the
-    table's additive fold. Batch = [B, S] int32 token matrix.
+from harmony_tpu.models.pytree_trainer import PyTreeTrainer  # noqa: E402
 
-    Stateful optimizers (harmony_tpu.dolphin.optim): momentum/Adam state
-    occupies extra row sections of the SAME table —
-    ``[params | m | v | counter row]`` — so optimizer state checkpoints,
-    reshards and migrates with the parameters for free (the reference has no
-    shared-optimizer-state mechanism at all; its trainers are plain SGD)."""
 
-    pull_mode = "all"
+class TransformerTrainer(PyTreeTrainer):
+    """Train the LM through the framework's elastic-table substrate (see
+    PyTreeTrainer for the row layout and optimizer-state sections). Batch =
+    [B, S] int32 token matrix."""
 
-    def __init__(
-        self,
-        config: Optional[TransformerConfig] = None,
-        row_width: int = 1024,
-        step_size: float = 0.1,
-        seed: int = 0,
-        optimizer: str = "sgd",
-        **config_kwargs,
-    ) -> None:
-        from harmony_tpu.dolphin import optim
+    default_table_id = "lm-model"
+    config_cls = TransformerConfig
 
-        if config is None:
-            # Flat-kwargs construction: JobConfig.app_params must stay
-            # JSON-serializable for the TCP submit path, so the CLI passes
-            # vocab_size/d_model/... directly instead of a config object.
-            config = TransformerConfig(**config_kwargs)
-        elif config_kwargs:
-            raise TypeError("pass either config= or flat config kwargs, not both")
-        self.model = TransformerLM(config)
-        self.config = config
-        self.row_width = row_width
-        self.step_size = step_size
-        self.seed = seed
-        self.optimizer = optimizer
-        self.num_state_slots = optim.num_slots(optimizer)  # validates name
-        template = jax.eval_shape(
-            lambda: self.model.init(jax.random.PRNGKey(0))
-        )
-        flat, self._unravel = ravel_pytree(
-            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
-        )
-        self.num_params = flat.shape[0]
-        self.num_rows = -(-self.num_params // row_width)
+    def build_model(self, config: TransformerConfig) -> TransformerLM:
+        return TransformerLM(config)
 
-    @property
-    def capacity(self) -> int:
-        # param rows + one section per state slot + the step-counter row
-        extra = 1 if self.num_state_slots else 0
-        return self.num_rows * (1 + self.num_state_slots) + extra
-
-    def model_table_config(
-        self, table_id: str = "lm-model", num_blocks: int = 0
-    ) -> TableConfig:
-        return TableConfig(
-            table_id=table_id,
-            capacity=self.capacity,
-            value_shape=(self.row_width,),
-            num_blocks=num_blocks or max(self.capacity // 8, 1),
-            is_ordered=True,
-            update_fn="add",
-        )
-
-    # -- lifecycle -------------------------------------------------------
-
-    def init_global_settings(self, ctx: TrainerContext) -> None:
-        params = self.model.init(jax.random.PRNGKey(self.seed))
-        flat, _ = ravel_pytree(params)
-        ctx.model_table.multi_put(
-            list(range(self.num_rows)), np.asarray(self._to_rows(flat))
-        )
-        # m/v sections and the counter row start (and stay, until the first
-        # push) at the table's init value 0.
-
-    # -- pure parts ------------------------------------------------------
-
-    def _to_rows(self, flat: jnp.ndarray) -> jnp.ndarray:
-        pad = self.num_rows * self.row_width - self.num_params
-        return jnp.concatenate(
-            [flat, jnp.zeros((pad,), flat.dtype)]
-        ).reshape(self.num_rows, self.row_width)
-
-    def _section(self, model: jnp.ndarray, i: int) -> jnp.ndarray:
-        """Flat [num_params] view of row section i (0=params, 1=m, 2=v)."""
-        rows = model[i * self.num_rows:(i + 1) * self.num_rows]
-        return rows.reshape(-1)[: self.num_params]
-
-    def hyperparams(self) -> Dict[str, float]:
-        return {"lr": self.step_size}
-
-    def compute(self, model, batch, hyper):
-        from harmony_tpu.dolphin import optim
-
+    def loss_on_batch(self, params, batch):
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        pflat = self._section(model, 0)
-        params = self._unravel(pflat)
-        loss, grads = jax.value_and_grad(self.model.loss)(params, tokens)
-        gflat, _ = ravel_pytree(grads)
-        slots = self.num_state_slots
-        m = self._section(model, 1) if slots >= 1 else jnp.zeros_like(pflat)
-        v = self._section(model, 2) if slots >= 2 else jnp.zeros_like(pflat)
-        t = model[-1, 0] + 1.0 if slots else jnp.asarray(1.0)
-        new_p, new_m, new_v = optim.apply(
-            self.optimizer, pflat, gflat, m, v, t, hyper
-        )
-        sections = [self._to_rows(new_p - pflat)]
-        if slots >= 1:
-            sections.append(self._to_rows(new_m - m))
-        if slots >= 2:
-            sections.append(self._to_rows(new_v - v))
-        delta = jnp.concatenate(sections)
-        if slots:
-            counter = jnp.zeros((1, self.row_width), delta.dtype).at[0, 0].set(1.0)
-            delta = jnp.concatenate([delta, counter])
-        return delta, {"loss": loss}
-
-    def evaluate(self, model, batch) -> Dict[str, jnp.ndarray]:
-        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        params = self._unravel(self._section(model, 0))
-        return {"loss": self.model.loss(params, tokens)}
+        return self.model.loss(params, tokens)

@@ -28,6 +28,7 @@ from harmony_tpu.models.common import (
     rms_norm,
     validate_attn,
 )
+from harmony_tpu.models.pytree_trainer import PyTreeTrainer
 from harmony_tpu.ops import blockwise_attention, flash_attention
 from harmony_tpu.parallel.mesh import DATA_AXIS
 
@@ -175,12 +176,44 @@ def make_train_step(model: ViT, mesh=None, learning_rate: float = 0.1,
     return jax.jit(sharded, out_shardings=(rep, rep), donate_argnums=dn)
 
 
+class ViTTrainer(PyTreeTrainer):
+    """ViT through the framework's elastic-table substrate (see
+    PyTreeTrainer for the row layout and optimizer-state sections). Batch =
+    (images [B,H,W,C], labels [B])."""
+
+    default_table_id = "vit-model"
+    config_cls = ViTConfig
+
+    def build_model(self, config: ViTConfig) -> "ViT":
+        return ViT(config)
+
+    def loss_on_batch(self, params, batch):
+        images, labels = batch
+        return self.model.loss(params, images, labels)
+
+    def eval_metrics(self, params, batch):
+        images, labels = batch
+        return {
+            "loss": self.model.loss(params, images, labels),
+            "accuracy": self.model.accuracy(params, images, labels),
+        }
+
+
 def make_synthetic(
-    n: int, cfg: Optional[ViTConfig] = None, seed: int = 0
+    n: int, cfg: Optional[ViTConfig] = None, seed: int = 0, **cfg_kwargs
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Class-separable synthetic images: each class gets a random template,
-    samples are noisy copies."""
-    cfg = cfg or ViTConfig()
+    samples are noisy copies. Accepts flat config kwargs (image_size, ...)
+    so JSON-serialized job configs can parameterize it; unknown keys (and
+    kwargs alongside an explicit cfg) raise — a typo'd override must not
+    silently revert to defaults."""
+    if cfg is not None and cfg_kwargs:
+        raise TypeError("pass either cfg= or flat config kwargs, not both")
+    if cfg is None:
+        unknown = set(cfg_kwargs) - set(ViTConfig.__dataclass_fields__)
+        if unknown:
+            raise TypeError(f"unknown make_synthetic kwargs {sorted(unknown)}")
+        cfg = ViTConfig(**cfg_kwargs)
     rng = np.random.default_rng(seed)
     templates = rng.standard_normal(
         (cfg.num_classes, cfg.image_size, cfg.image_size, cfg.channels)

@@ -87,6 +87,7 @@ _SLOW_TESTS = {
     "test_vit.py::test_sharded_step_matches_single_device",
     "test_vit.py::test_learns_and_classifies",
     "test_generate.py::test_greedy_matches_stepwise_argmax",
+    "test_vit.py::test_vit_trainer_through_worker_loop",
 }
 
 

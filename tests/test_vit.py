@@ -62,6 +62,38 @@ def test_sharded_step_matches_single_device(mesh_dp):
                                    rtol=2e-3, atol=2e-5)
 
 
+def test_vit_trainer_through_worker_loop(mesh8):
+    """ViT trains through the elastic-table substrate (PyTreeTrainer):
+    params + Adam state live in a DenseTable, the fused worker loop drives
+    the epochs, and evaluate reads accuracy back from the table."""
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import (
+        TrainerContext,
+        TrainingDataProvider,
+        WorkerTasklet,
+    )
+    from harmony_tpu.models.vit import ViTTrainer
+    from harmony_tpu.table import DenseTable, TableSpec
+
+    trainer = ViTTrainer(image_size=16, patch_size=4, channels=3,
+                         num_classes=4, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, row_width=512, step_size=0.01,
+                         optimizer="adam")
+    table = DenseTable(TableSpec(trainer.model_table_config()), mesh8)
+    x, y = make_synthetic(64, trainer.config, seed=9)
+    ctx = TrainerContext(
+        params=TrainerParams(num_epochs=8, num_mini_batches=2),
+        model_table=table,
+    )
+    w = WorkerTasklet("vit-job", ctx, trainer,
+                      TrainingDataProvider([x, y], 2), mesh8)
+    result = w.run()
+    losses = result["losses"]
+    assert losses[-1] < losses[0] * 0.6, losses
+    ev = w.evaluate((jnp.asarray(x), jnp.asarray(y)))
+    assert float(ev["accuracy"]) > 0.8, ev
+
+
 def test_attn_resolution_and_validation():
     from harmony_tpu.models.common import flash_ok, resolve_attn
 
