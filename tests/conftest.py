@@ -38,7 +38,8 @@ jax.config.update("jax_platforms", "cpu")
 
 _SLOW_TESTS = {
     "test_multihost.py::test_two_process_distributed_job",
-    "test_multihost.py::test_pod_jobserver_end_to_end",
+    "test_multihost.py::test_pod_jobserver_end_to_end[2-4]",
+    "test_multihost.py::test_pod_jobserver_end_to_end[3-2]",
     "test_moe.py::test_expert_parallel_gradients",
     "test_moe.py::test_expert_parallel_matches_reference",
     "test_moe.py::test_moe_matches_per_token_reference",

@@ -1,7 +1,7 @@
 """Worker process for the multi-host JobServer end-to-end test.
 
-Launched N times by tests/test_multihost.py (CPU backend, 4 virtual
-devices per process → an 8-device GLOBAL mesh for N=2). Process 0 runs the
+Launched N times by tests/test_multihost.py (CPU backend; the harness
+picks the virtual devices per process, e.g. 2x4 or 3x2). Process 0 runs the
 PodJobServer (TCP submit endpoint + pod control plane); the rest run
 PodFollower loops. The parent submits an MLR job to process 0 over TCP,
 every process executes the same SPMD entity over the global mesh, and

@@ -13,6 +13,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -80,33 +81,45 @@ def test_two_process_distributed_job():
     assert a["hash_sum"] == b["hash_sum"]
 
 
-def test_pod_jobserver_end_to_end():
+@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 4), (3, 2)])
+def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
     """The multi-host control plane (ref: JobServerDriver.java:149-163
     driving remote evaluators): process 0 hosts the JobServer, a job
-    submitted over TCP trains over the GLOBAL 8-device mesh with process 1
-    executing the same SPMD steps via the pod follower loop, and the
-    follower's worker metrics land back on process 0."""
+    submitted over TCP trains over the GLOBAL mesh with every other
+    process executing the same SPMD steps via the pod follower loop, and
+    follower worker metrics land back on process 0. Two topologies: the
+    8-device pair and a 3-process/6-device pod."""
     from harmony_tpu.config.params import JobConfig, TrainerParams
     from harmony_tpu.jobserver.client import CommandSender
 
     coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
     coordinator = f"127.0.0.1:{coord_port}"
-    env = _sanitized_env()
+    env = _sanitized_env(devs_per_proc)
     procs = [
         subprocess.Popen(
-            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
+            [sys.executable, POD_WORKER, coordinator, str(nprocs), str(pid),
              str(pod_port), str(tcp_port)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     try:
-        # wait for process 0's READY line (runtime + pod join + TCP up)
+        # wait for process 0's READY line (runtime + pod join + TCP up).
+        # readline() runs on a helper thread so a silently-wedged leader
+        # (no stdout at all) hits the deadline instead of hanging the
+        # suite — readline itself blocks unboundedly otherwise.
         deadline = time.monotonic() + 240
         line = ""
         while time.monotonic() < deadline:
-            line = procs[0].stdout.readline()
+            box = {}
+            t = threading.Thread(
+                target=lambda: box.update(line=procs[0].stdout.readline()),
+                daemon=True,
+            )
+            t.start()
+            t.join(max(0.1, deadline - time.monotonic()))
+            line = box.get("line", "")
             if line.strip() == "READY" or not line:
                 break
         assert line.strip() == "READY", "leader never became ready"
@@ -126,7 +139,8 @@ def test_pod_jobserver_end_to_end():
         )
         sender = CommandSender(tcp_port)
         status = sender.send_status_command()
-        assert status["pod"] == {"followers": [1], "broken": None}, status
+        assert status["pod"] == {"followers": list(range(1, nprocs)),
+                                 "broken": None}, status
         resp = sender.send_job_submit_command(cfg)
         assert resp.get("ok"), resp
         # poll until the job drains, then shut the pod down
@@ -157,9 +171,10 @@ def test_pod_jobserver_end_to_end():
     # local (process 0) training happened and converged
     losses = result["local_results"]["pod-mlr"]["pod-mlr/w0"]["losses"]
     assert len(losses) == 2 and losses[-1] < losses[0], losses
-    # follower (process 1) ran the SAME job and reported its metrics back
-    follower = result["pod_reports"]["pod-mlr"]["1"]
-    assert follower["ok"], follower
-    f_losses = follower["workers"]["pod-mlr/w0"]["losses"]
-    # SPMD lockstep: both processes computed the identical loss series
-    assert [round(x, 5) for x in f_losses] == [round(x, 5) for x in losses]
+    # every follower ran the SAME job and reported its metrics back
+    for pid in range(1, nprocs):
+        follower = result["pod_reports"]["pod-mlr"][str(pid)]
+        assert follower["ok"], follower
+        f_losses = follower["workers"]["pod-mlr/w0"]["losses"]
+        # SPMD lockstep: identical loss series on every process
+        assert [round(x, 5) for x in f_losses] == [round(x, 5) for x in losses]
