@@ -121,6 +121,23 @@ class TransformerLM:
                                    block_q=min(128, S), block_k=min(128, S))
         return blockwise_attention(q, k, v, causal=True)
 
+    def _block(self, x, layer, axis_name: Optional[str]):
+        """One pre-norm decoder block — the shared body of ``apply`` and
+        the pipeline-parallel stage fn."""
+        cfg = self.config
+        B, S = x.shape[0], x.shape[1]
+        d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
+        xn = _norm(x, layer["ln1"].astype(cfg.dtype))
+        qkv = xn @ layer["wqkv"].astype(cfg.dtype)              # [B, S, 3d]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, S, h, hd).transpose(0, 2, 1, 3)
+        o = self._attention(to_heads(q), to_heads(k), to_heads(v), axis_name)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
+        x = x + o @ layer["wo"].astype(cfg.dtype)
+        xn = _norm(x, layer["ln2"].astype(cfg.dtype))
+        return x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
+            @ layer["w2"].astype(cfg.dtype)
+
     def apply(
         self,
         params: Dict[str, Any],
@@ -129,22 +146,10 @@ class TransformerLM:
         pos_offset: Any = 0,              # global position of tokens[:, 0]
     ) -> jnp.ndarray:
         cfg = self.config
-        B, S = tokens.shape
-        d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
-        pos = pos_offset + jnp.arange(S)
-        x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+        x = _embed_in(cfg, params["embed"], params["pos"], tokens, pos_offset)
 
         def block(x, layer):
-            xn = _norm(x, layer["ln1"].astype(cfg.dtype))
-            qkv = xn @ layer["wqkv"].astype(cfg.dtype)          # [B, S, 3d]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            to_heads = lambda t: t.reshape(B, S, h, hd).transpose(0, 2, 1, 3)
-            o = self._attention(to_heads(q), to_heads(k), to_heads(v), axis_name)
-            o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
-            x = x + o @ layer["wo"].astype(cfg.dtype)
-            xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-            return x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
-                @ layer["w2"].astype(cfg.dtype)
+            return self._block(x, layer, axis_name)
 
         if cfg.remat:
             # Per-layer rematerialization: the backward recomputes each
@@ -162,10 +167,23 @@ class TransformerLM:
     def loss(self, params, tokens, axis_name=None) -> jnp.ndarray:
         """Mean next-token cross-entropy over the (single-device) batch."""
         logits = self.apply(params, tokens[:, :-1], axis_name=axis_name)
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        return _next_token_ce(logits, tokens[:, 1:])
+
+
+def _next_token_ce(logits, targets) -> jnp.ndarray:
+    """Mean next-token cross-entropy — ONE implementation shared by the
+    single-device loss and the pipeline-parallel loss (the SP path's
+    _masked_ce differs: psum-reduced masked mean over sharded axes)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def _embed_in(cfg, embed, pos, tokens, pos_offset=0) -> jnp.ndarray:
+    """Token+position embedding in activation dtype — shared by apply and
+    the pipeline-parallel path."""
+    idx = pos_offset + jnp.arange(tokens.shape[1])
+    return (embed[tokens] + pos[idx]).astype(cfg.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -387,6 +405,84 @@ def make_parallel_train_step(
             in_specs=(specs, tok_spec, tok_spec, tok_spec),
             out_specs=(specs, P()),
         )(tp_params, tokens, targets, mask)
+
+    return step, shard_params
+
+
+def make_pp_train_step(
+    model: TransformerLM,
+    mesh,
+    learning_rate: float = 0.1,
+    num_microbatches: Optional[int] = None,
+    stage_axis: str = "stage",
+    donate: bool = True,
+):
+    """Pipeline-parallel train step: the LM's blocks split into S
+    contiguous stages over ``mesh``'s ``stage`` axis (GPipe microbatching,
+    parallel/pipeline.py); embed/positions/final-norm stay replicated and
+    run outside the pipeline. Returns ``(step, shard_params)``:
+    ``shard_params(params)`` converts an ordinary init tree into the
+    stage-stacked, stage-sharded layout; ``step(pp_params, tokens) ->
+    (new_pp_params, loss)`` is one jitted SPMD program whose inter-stage
+    activation transfers are ppermutes riding ICI."""
+    from jax.sharding import NamedSharding
+
+    from harmony_tpu.parallel.pipeline import make_pipeline_fn
+
+    cfg = model.config
+    S = mesh.shape[stage_axis]
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible into "
+                         f"{S} pipeline stages")
+    lps = cfg.n_layers // S
+
+    def stage_fn(stage_layers, x):
+        # stage_layers leaves are [layers_per_stage, ...]: apply in order
+        def body(x, layer):
+            return model._block(x, layer, None), None
+
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    pipe = make_pipeline_fn(stage_fn, mesh, axis_name=stage_axis,
+                            num_microbatches=num_microbatches)
+
+    def to_pp(params):
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *params["layers"])
+        stages = jax.tree.map(
+            lambda a: a.reshape(S, lps, *a.shape[1:]), stacked
+        )
+        return {"embed": params["embed"], "pos": params["pos"],
+                "ln_f": params["ln_f"], "stages": stages}
+
+    rep = NamedSharding(mesh, P())
+    staged = NamedSharding(mesh, P(stage_axis))
+
+    def shard_params(params):
+        pp = to_pp(params)
+        # one device_put over a sharding pytree: structure mismatches error
+        # instead of silently mis-pairing leaves
+        shardings = {
+            "embed": rep, "pos": rep, "ln_f": rep,
+            "stages": jax.tree.map(lambda _: staged, pp["stages"]),
+        }
+        return jax.device_put(pp, shardings)
+
+    def loss_fn(pp, tokens):
+        inp, targets = tokens[:, :-1], tokens[:, 1:]
+        x = _embed_in(cfg, pp["embed"], pp["pos"], inp)
+        h = pipe(pp["stages"], x)
+        h = _norm(h, pp["ln_f"].astype(cfg.dtype))
+        logits = h.astype(jnp.float32) @ pp["embed"].T  # weight-tied readout
+        return _next_token_ce(logits, targets)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(pp, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(pp, tokens)
+        new = jax.tree.map(
+            lambda p, g: p - learning_rate * g.astype(p.dtype), pp, grads
+        )
+        return new, loss
 
     return step, shard_params
 

@@ -68,6 +68,8 @@ _SLOW_TESTS = {
     "test_cli.py::test_cli_run_standalone[lm]",
     "test_pipeline.py::test_pipeline_transformer_blocks",
     "test_pipeline.py::test_pipeline_gradients_match",
+    "test_pipeline.py::test_pp_train_step_matches_single_device",
+    "test_pipeline.py::test_pp_train_step_learns",
     "test_hashtable.py::TestUpdateModes::test_min_mode",
     "test_hashtable.py::TestUpdateModes::test_assign_mode_last_wins",
     "test_hashtable.py::TestUpdateModes::test_post_invariant_only_on_touched",
