@@ -66,11 +66,19 @@ def decode_step(model, params, cache, token: jnp.ndarray, pos: jnp.ndarray):
                        cv.astype(jnp.float32)).astype(cfg.dtype)
         x = x + o.reshape(B, d) @ layer["wo"].astype(cfg.dtype)
         xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-        x = x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
-            @ layer["w2"].astype(cfg.dtype)
+        x = x + _ffn(cfg, layer, xn)
     xf = _norm(x, params["ln_f"].astype(cfg.dtype))
     logits = xf.astype(jnp.float32) @ params["embed"].T          # [B, vocab]
     return logits, {"k": cache_k, "v": cache_v}
+
+
+def _ffn(cfg, layer, xn):
+    """Decode-side FFN: the shared dense/MoE dispatch with NO-DROP expert
+    capacity (per-step batches are tiny; the training capacity factor
+    would drop tokens whenever two rows pick one expert)."""
+    from harmony_tpu.models.transformer import ffn_apply
+
+    return ffn_apply(cfg, layer, xn, no_drop=True)[0]
 
 
 def prefill(model, params, cache, prompt: jnp.ndarray):
@@ -105,8 +113,7 @@ def prefill(model, params, cache, prompt: jnp.ndarray):
         x = x + o.transpose(0, 2, 1, 3).reshape(B, P, d) \
             @ layer["wo"].astype(cfg.dtype)
         xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-        x = x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
-            @ layer["w2"].astype(cfg.dtype)
+        x = x + _ffn(cfg, layer, xn)
     xf = _norm(x[:, -1], params["ln_f"].astype(cfg.dtype))
     logits = xf.astype(jnp.float32) @ params["embed"].T           # [B,V]
     return logits, {"k": cache_k, "v": cache_v}

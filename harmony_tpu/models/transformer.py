@@ -53,6 +53,14 @@ class TransformerConfig:
     sp_attn: str = "ring"           # sequence-parallel tier: "ring" | "a2a"
     remat: bool = False             # rematerialize each layer's activations
                                     # on the backward pass (HBM for FLOPs)
+    # Mixture-of-Experts FFN (Switch-style, models/moe.py): 0 = dense.
+    # Every ``moe_every``-th block swaps its FFN for a top-1-routed expert
+    # bank; the Switch aux load-balance loss joins the CE at
+    # ``moe_aux_weight``.
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         from harmony_tpu.models.common import validate_attn
@@ -61,7 +69,23 @@ class TransformerConfig:
             raise ValueError("d_model must divide by n_heads")
         if self.sp_attn not in ("ring", "a2a"):
             raise ValueError(f"unknown sp_attn {self.sp_attn!r}")
+        if self.moe_experts and self.moe_every < 1:
+            raise ValueError("moe_every must be >= 1")
         validate_attn(self.attn)
+
+    def is_moe_layer(self, i: int) -> bool:
+        """Block i uses the MoE FFN (the last of every ``moe_every`` group —
+        Switch interleaves dense and expert blocks)."""
+        return bool(self.moe_experts) and (i % self.moe_every
+                                           == self.moe_every - 1)
+
+    @property
+    def moe_cfg(self):
+        from harmony_tpu.models.moe import MoEConfig
+
+        return MoEConfig(num_experts=self.moe_experts, d_model=self.d_model,
+                         d_ff=self.d_ff,
+                         capacity_factor=self.moe_capacity_factor)
 
     @property
     def head_dim(self) -> int:
@@ -88,16 +112,22 @@ class TransformerLM:
         from harmony_tpu.models.common import dense_init as dense
 
         layers = []
-        for kl in k_layers:
+        for i, kl in enumerate(k_layers):
             ks = jax.random.split(kl, 4)
-            layers.append({
+            layer = {
                 "ln1": jnp.ones((d,), jnp.float32),
                 "wqkv": dense(ks[0], (d, 3 * d)),
                 "wo": dense(ks[1], (d, d)),
                 "ln2": jnp.ones((d,), jnp.float32),
-                "w1": dense(ks[2], (d, f)),
-                "w2": dense(ks[3], (f, d)),
-            })
+            }
+            if cfg.is_moe_layer(i):
+                from harmony_tpu.models.moe import init_moe_params
+
+                layer["moe"] = init_moe_params(ks[2], cfg.moe_cfg)
+            else:
+                layer["w1"] = dense(ks[2], (d, f))
+                layer["w2"] = dense(ks[3], (f, d))
+            layers.append(layer)
         return {
             "embed": jax.random.normal(k_emb, (cfg.vocab_size, d), jnp.float32) * 0.02,
             "pos": jax.random.normal(k_pos, (cfg.max_seq, d), jnp.float32) * 0.02,
@@ -123,7 +153,9 @@ class TransformerLM:
 
     def _block(self, x, layer, axis_name: Optional[str]):
         """One pre-norm decoder block — the shared body of ``apply`` and
-        the pipeline-parallel stage fn."""
+        the pipeline-parallel stage fn. Returns ``(x, aux)``: aux is the
+        Switch load-balance loss when the block carries an MoE FFN, 0
+        otherwise."""
         cfg = self.config
         B, S = x.shape[0], x.shape[1]
         d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
@@ -135,8 +167,8 @@ class TransformerLM:
         o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
         x = x + o @ layer["wo"].astype(cfg.dtype)
         xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-        return x + jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
-            @ layer["w2"].astype(cfg.dtype)
+        out, aux = ffn_apply(cfg, layer, xn)
+        return x + out, aux
 
     def apply(
         self,
@@ -145,6 +177,11 @@ class TransformerLM:
         axis_name: Optional[str] = None,  # seq-parallel ring axis (shard_map)
         pos_offset: Any = 0,              # global position of tokens[:, 0]
     ) -> jnp.ndarray:
+        logits, _ = self._apply_with_aux(params, tokens, axis_name, pos_offset)
+        return logits
+
+    def _apply_with_aux(self, params, tokens, axis_name=None, pos_offset=0):
+        """apply + the summed MoE aux loss (0 for dense configs)."""
         cfg = self.config
         x = _embed_in(cfg, params["embed"], params["pos"], tokens, pos_offset)
 
@@ -158,16 +195,23 @@ class TransformerLM:
             # with one extra forward pass of FLOPs (the MXU has headroom;
             # HBM usually doesn't).
             block = jax.checkpoint(block)
+        aux = jnp.asarray(0.0, jnp.float32)
         for layer in params["layers"]:
-            x = block(x, layer)
+            x, a = block(x, layer)
+            aux = aux + a
         x = _norm(x, params["ln_f"].astype(cfg.dtype))
         # Weight-tied readout, f32 logits for a stable softmax.
-        return x.astype(jnp.float32) @ params["embed"].T
+        return x.astype(jnp.float32) @ params["embed"].T, aux
 
     def loss(self, params, tokens, axis_name=None) -> jnp.ndarray:
-        """Mean next-token cross-entropy over the (single-device) batch."""
-        logits = self.apply(params, tokens[:, :-1], axis_name=axis_name)
-        return _next_token_ce(logits, tokens[:, 1:])
+        """Mean next-token cross-entropy over the (single-device) batch,
+        plus the weighted MoE load-balance aux for expert configs."""
+        logits, aux = self._apply_with_aux(params, tokens[:, :-1],
+                                           axis_name=axis_name)
+        ce = _next_token_ce(logits, tokens[:, 1:])
+        if self.config.moe_experts:
+            return ce + self.config.moe_aux_weight * aux
+        return ce
 
 
 def _next_token_ce(logits, targets) -> jnp.ndarray:
@@ -177,6 +221,29 @@ def _next_token_ce(logits, targets) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean()
+
+
+def ffn_apply(cfg, layer, xn, no_drop: bool = False):
+    """Dense or MoE FFN on [..., d] activations — the ONE dense/MoE
+    dispatch shared by training blocks and the decode path. Returns
+    ``(out, aux)``. ``no_drop`` lifts the expert capacity to cover every
+    token (decode routes tiny per-step batches where the training
+    capacity_factor would drop tokens whenever two rows share an expert,
+    letting one sequence degrade another's output)."""
+    if "moe" in layer:
+        import dataclasses as _dc
+
+        from harmony_tpu.models.moe import moe_ffn
+
+        mcfg = cfg.moe_cfg
+        if no_drop:
+            mcfg = _dc.replace(mcfg, capacity_factor=float(mcfg.num_experts))
+        flat = xn.reshape(-1, cfg.d_model)
+        out, aux = moe_ffn(layer["moe"], flat, mcfg)
+        return out.reshape(xn.shape), aux
+    out = jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
+        @ layer["w2"].astype(cfg.dtype)
+    return out, jnp.asarray(0.0, jnp.float32)
 
 
 def _embed_in(cfg, embed, pos, tokens, pos_offset=0) -> jnp.ndarray:
@@ -233,9 +300,17 @@ def make_sp_train_step(
         offset = lax.axis_index(seq_axis) * S_loc
 
         def loss_fn(p):
-            logits = model.apply(p, tokens, axis_name=seq_axis,
-                                 pos_offset=offset)
-            return _masked_ce(logits, targets, mask, axes)
+            logits, aux = model._apply_with_aux(
+                p, tokens, axis_name=seq_axis, pos_offset=offset
+            )
+            loss = _masked_ce(logits, targets, mask, axes)
+            if model.config.moe_experts:
+                # aux is per-shard (each shard routes its local tokens):
+                # mean over shards keeps the weight comparable to the
+                # single-device objective
+                loss = loss + model.config.moe_aux_weight \
+                    * lax.pmean(aux, axes)
+            return loss
 
         # Params enter replicated (unvarying) and the loss is psum-reduced,
         # so shard_map's typed autodiff already inserts the cross-device
@@ -332,6 +407,13 @@ def make_parallel_train_step(
     cfg = model.config
     from jax.sharding import NamedSharding
 
+    if cfg.moe_experts:
+        raise ValueError(
+            "make_parallel_train_step is dense-only (its Megatron sharding "
+            "splits w1/w2 over the model axis; MoE layers have no w1/w2) — "
+            "train MoE configs with the single-device or sp steps, or run "
+            "moe_ffn under expert parallelism directly"
+        )
     tp = mesh.shape.get(model_axis, 1)
     if cfg.n_heads % tp:
         raise ValueError(f"n_heads {cfg.n_heads} must divide by tensor "
@@ -430,6 +512,12 @@ def make_pp_train_step(
     from harmony_tpu.parallel.pipeline import make_pipeline_fn
 
     cfg = model.config
+    if cfg.moe_experts:
+        raise ValueError(
+            "make_pp_train_step needs homogeneous layers to stage-stack; "
+            "MoE configs interleave two layer structures — use the sp/dp "
+            "steps (or set moe_experts=0)"
+        )
     S = mesh.shape[stage_axis]
     if cfg.n_layers % S:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible into "
@@ -439,7 +527,7 @@ def make_pp_train_step(
     def stage_fn(stage_layers, x):
         # stage_layers leaves are [layers_per_stage, ...]: apply in order
         def body(x, layer):
-            return model._block(x, layer, None), None
+            return model._block(x, layer, None)[0], None
 
         x, _ = lax.scan(body, x, stage_layers)
         return x

@@ -202,7 +202,10 @@ def _py_blk_read(path: str) -> np.ndarray:
         magic, dtype_code, ndim = struct.unpack("<III", head)
         if magic not in (0x48544231, 0x48544232) or ndim > 8:
             raise IOError(f"blk_read({path}): bad magic/ndim")
-        shape = struct.unpack(f"<{ndim}Q", f.read(8 * ndim)) if ndim else ()
+        shape_bytes = f.read(8 * ndim)
+        if len(shape_bytes) < 8 * ndim:
+            raise IOError(f"blk_read({path}): truncated header")
+        shape = struct.unpack(f"<{ndim}Q", shape_bytes) if ndim else ()
         raw_n = comp_n = None
         if magic == 0x48544232:
             sizes = f.read(16)

@@ -43,6 +43,9 @@ _SLOW_TESTS = {
     "test_moe.py::test_expert_parallel_gradients",
     "test_moe.py::test_expert_parallel_matches_reference",
     "test_moe.py::test_moe_matches_per_token_reference",
+    "test_moe.py::TestMoELM::test_moe_lm_learns_with_aux",
+    "test_moe.py::TestMoELM::test_single_expert_equals_dense",
+    "test_moe.py::TestMoELM::test_moe_cache_decode_matches_forward",
     "test_widedeep.py::TestSparseDurability::test_sparse_deferred_eval_at_shutdown",
     "test_widedeep.py::TestSparseDurability::test_factory_update_fn_restores_in_fresh_registry",
     "test_widedeep.py::TestFM::test_duplicate_ids_fold_in_push",
