@@ -151,11 +151,13 @@ class TransformerLM:
                                    block_q=min(128, S), block_k=min(128, S))
         return blockwise_attention(q, k, v, causal=True)
 
-    def _block(self, x, layer, axis_name: Optional[str]):
+    def _block(self, x, layer, axis_name: Optional[str],
+               moe_axis: Optional[str] = None):
         """One pre-norm decoder block — the shared body of ``apply`` and
         the pipeline-parallel stage fn. Returns ``(x, aux)``: aux is the
         Switch load-balance loss when the block carries an MoE FFN, 0
-        otherwise."""
+        otherwise. ``moe_axis`` = expert-parallel mesh axis (see
+        ffn_apply)."""
         cfg = self.config
         B, S = x.shape[0], x.shape[1]
         d, h, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
@@ -167,7 +169,7 @@ class TransformerLM:
         o = o.transpose(0, 2, 1, 3).reshape(B, S, d)
         x = x + o @ layer["wo"].astype(cfg.dtype)
         xn = _norm(x, layer["ln2"].astype(cfg.dtype))
-        out, aux = ffn_apply(cfg, layer, xn)
+        out, aux = ffn_apply(cfg, layer, xn, moe_axis=moe_axis)
         return x + out, aux
 
     def apply(
@@ -180,13 +182,14 @@ class TransformerLM:
         logits, _ = self._apply_with_aux(params, tokens, axis_name, pos_offset)
         return logits
 
-    def _apply_with_aux(self, params, tokens, axis_name=None, pos_offset=0):
+    def _apply_with_aux(self, params, tokens, axis_name=None, pos_offset=0,
+                        moe_axis=None):
         """apply + the summed MoE aux loss (0 for dense configs)."""
         cfg = self.config
         x = _embed_in(cfg, params["embed"], params["pos"], tokens, pos_offset)
 
         def block(x, layer):
-            return self._block(x, layer, axis_name)
+            return self._block(x, layer, axis_name, moe_axis=moe_axis)
 
         if cfg.remat:
             # Per-layer rematerialization: the backward recomputes each
@@ -223,13 +226,16 @@ def _next_token_ce(logits, targets) -> jnp.ndarray:
     return -ll.mean()
 
 
-def ffn_apply(cfg, layer, xn, no_drop: bool = False):
+def ffn_apply(cfg, layer, xn, no_drop: bool = False,
+              moe_axis: Optional[str] = None):
     """Dense or MoE FFN on [..., d] activations — the ONE dense/MoE
     dispatch shared by training blocks and the decode path. Returns
     ``(out, aux)``. ``no_drop`` lifts the expert capacity to cover every
     token (decode routes tiny per-step batches where the training
     capacity_factor would drop tokens whenever two rows share an expert,
-    letting one sequence degrade another's output)."""
+    letting one sequence degrade another's output). ``moe_axis`` is the
+    expert-parallel mesh axis: expert params are sharded on their leading
+    dim and token buckets move over ICI via all_to_all (moe_ffn)."""
     if "moe" in layer:
         import dataclasses as _dc
 
@@ -239,7 +245,7 @@ def ffn_apply(cfg, layer, xn, no_drop: bool = False):
         if no_drop:
             mcfg = _dc.replace(mcfg, capacity_factor=float(mcfg.num_experts))
         flat = xn.reshape(-1, cfg.d_model)
-        out, aux = moe_ffn(layer["moe"], flat, mcfg)
+        out, aux = moe_ffn(layer["moe"], flat, mcfg, axis_name=moe_axis)
         return out.reshape(xn.shape), aux
     out = jax.nn.gelu(xn @ layer["w1"].astype(cfg.dtype)) \
         @ layer["w2"].astype(cfg.dtype)
@@ -487,6 +493,81 @@ def make_parallel_train_step(
             in_specs=(specs, tok_spec, tok_spec, tok_spec),
             out_specs=(specs, P()),
         )(tp_params, tokens, targets, mask)
+
+    return step, shard_params
+
+
+def make_ep_train_step(
+    model: TransformerLM,
+    mesh,
+    learning_rate: float = 0.1,
+    data_axis: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Expert+data-parallel train step for MoE configs: the batch shards
+    over ``data_axis`` and the SAME axis carries expert parallelism — each
+    shard owns ``moe_experts / shards`` experts (MoE params sharded on
+    their leading expert dim) and token buckets move to their expert's
+    device and back via ``all_to_all`` over ICI (models/moe.py). Dense
+    layers and attention run data-parallel; non-expert params stay
+    replicated with the gradient psum inserted by shard_map's typed
+    autodiff. Returns ``(step, shard_params)``."""
+    from jax.sharding import NamedSharding
+
+    cfg = model.config
+    ep = mesh.shape[data_axis]
+    if not cfg.moe_experts:
+        raise ValueError("make_ep_train_step needs an MoE config "
+                         "(moe_experts > 0); use the dp/sp steps for dense")
+    if cfg.moe_experts % ep:
+        raise ValueError(f"moe_experts {cfg.moe_experts} must divide by the "
+                         f"{data_axis} axis size {ep}")
+
+    rep = NamedSharding(mesh, P())
+    exp = NamedSharding(mesh, P(data_axis))
+
+    def param_specs(params):
+        """ONE spec tree drives both placement and the shard_map in/out
+        specs — deriving them separately would let the two layouts drift."""
+        specs = jax.tree.map(lambda _: P(), params)
+        for spec_layer, layer in zip(specs["layers"], params["layers"]):
+            if "moe" in layer:
+                spec_layer["moe"]["w1"] = P(data_axis)
+                spec_layer["moe"]["w2"] = P(data_axis)
+        return specs
+
+    def shard_params(params):
+        shardings = jax.tree.map(
+            lambda s: exp if s == P(data_axis) else rep, param_specs(params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(params, shardings)
+
+    def local_step(params, tokens, targets, mask):
+        def loss_fn(p):
+            logits, aux = model._apply_with_aux(p, tokens,
+                                                moe_axis=data_axis)
+            loss = _masked_ce(logits, targets, mask, (data_axis,))
+            return loss + cfg.moe_aux_weight * lax.pmean(aux, data_axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new = jax.tree.map(
+            lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads
+        )
+        return new, loss
+
+    tok_spec = P(data_axis)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(params, tokens):
+        targets, mask = _lm_targets_and_mask(tokens)
+        specs = param_specs(params)
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, tok_spec, tok_spec, tok_spec),
+            out_specs=(specs, P()),
+        )(params, tokens, targets, mask)
 
     return step, shard_params
 

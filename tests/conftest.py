@@ -47,6 +47,8 @@ _SLOW_TESTS = {
     "test_moe.py::TestMoELM::test_single_expert_equals_dense",
     "test_moe.py::TestMoELM::test_moe_cache_decode_matches_forward",
     "test_moe.py::TestMoELM::test_sp_step_carries_aux",
+    "test_moe.py::TestMoELM::test_ep_step_matches_single_device_ce",
+    "test_moe.py::TestMoELM::test_ep_step_learns",
     "test_moe.py::test_capacity_drops_tokens",
     "test_apps.py::TestSparseLDAOverflowConsistency::test_out_of_domain_ids_are_ignored_not_corrupting",
     "test_widedeep.py::TestSparseDurability::test_sparse_deferred_eval_at_shutdown",
