@@ -176,6 +176,11 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
     else:
         user["data_fn"] = preset["data_fn"]
         user["data_args"] = {**preset["data_args"], **_parse_kv(args.data)}
+    if app == "lm" and "path" in user.get("data_args", {}):
+        # real-file corpus: byte-level tokenization replaces the synthetic
+        # generator; the preset's seq_len/num_seqs/vocab_size args carry
+        # over (load_text_tokens shares the signature)
+        user["data_fn"] = "harmony_tpu.models.transformer:load_text_tokens"
     # Model/data-coupled keys must match between --set and --data: an
     # explicit override on either side wins over the preset default, a
     # conflicting pair is an error at submit time (not silently-wrong

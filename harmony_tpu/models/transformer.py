@@ -656,6 +656,37 @@ def make_pp_train_step(
     return step, shard_params
 
 
+def load_text_tokens(
+    path: str, seq_len: int, num_seqs: int = 0, vocab_size: int = 256
+) -> np.ndarray:
+    """Real-file LM data: byte-level tokenization of a text file into a
+    [num_seqs, seq_len] int32 matrix (the LM counterpart of the classic
+    apps' file loaders — usable as a JobConfig ``data_fn`` with
+    ``data_args={"path": ..., "seq_len": ...}``).
+
+    Bytes >= vocab_size fold modulo (byte-level needs vocab_size 256; a
+    smaller vocab still trains, just lossily). ``num_seqs=0`` takes every
+    whole window the file provides."""
+    if vocab_size < 2:
+        raise ValueError("vocab_size must be >= 2")
+    if num_seqs < 0:
+        raise ValueError(f"num_seqs must be >= 0, got {num_seqs}")
+    raw = np.fromfile(path, np.uint8)
+    total = raw.shape[0] // seq_len
+    if total == 0:
+        raise ValueError(
+            f"{path}: {raw.shape[0]} bytes cannot fill one {seq_len}-token "
+            "sequence"
+        )
+    if num_seqs and total < num_seqs:
+        raise ValueError(
+            f"{path}: holds {total} windows of {seq_len}, wanted {num_seqs}"
+        )
+    n = num_seqs or total
+    toks = raw[: n * seq_len].reshape(n, seq_len).astype(np.int32)
+    return toks % vocab_size
+
+
 def make_lm_data(
     num_seqs: int, seq_len: int, vocab_size: int, seed: int = 0
 ) -> np.ndarray:

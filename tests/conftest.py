@@ -73,6 +73,7 @@ _SLOW_TESTS = {
     "test_models.py::TestStatefulOptimizers::test_adam_learns_and_tracks_steps",
     "test_models.py::TestStatefulOptimizers::test_optimizer_state_survives_checkpoint_restore",
     "test_models.py::test_forward_shapes_and_finite",
+    "test_models.py::test_load_text_tokens_and_trains",
     "test_cli.py::test_cli_run_standalone[lm]",
     "test_pipeline.py::test_pipeline_transformer_blocks",
     "test_pipeline.py::test_pipeline_gradients_match",

@@ -180,3 +180,16 @@ def test_cli_rejects_misconfigured_flags():
     c.optimizer = "homogeneous"  # dolphin-only flag on a graph app
     with pytest.raises(SystemExit, match="dolphin"):
         build_config("pagerank", c)
+
+
+def test_lm_preset_with_file_corpus(tmp_path):
+    """`--data path=...` on the lm preset swaps in the byte-level file
+    loader while the coupled vocab sync still applies."""
+    p = tmp_path / "c.txt"
+    p.write_text("x" * 10000)
+    cfg = build_config("lm", _Args(data=[f"path={p}"]))
+    assert cfg.user["data_fn"].endswith(":load_text_tokens")
+    assert cfg.user["data_args"]["path"] == str(p)
+    # coupled key still synced between model and data sides
+    assert (cfg.params.app_params["vocab_size"]
+            == cfg.user["data_args"]["vocab_size"])
