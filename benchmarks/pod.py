@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Multi-host pod throughput on a virtual 2-process/8-device pod.
+
+Launches the same worker processes the e2e test uses (tests/pod_worker.py:
+process 0 = PodJobServer, process 1 = follower in SPMD lockstep over the
+global mesh), submits one MLR job over TCP, and records aggregate
+samples/sec measured from submit to drain. CPU-mesh numbers — comparable
+across rounds, not to a chip.
+
+Prints ONE JSON line. Run: python benchmarks/pod.py
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EPOCHS = 6
+BATCHES = 4
+N = 16384  # examples
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> None:
+    worker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "pod_worker.py")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    coord, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"127.0.0.1:{coord}", "2", str(pid),
+             str(pod_port), str(tcp_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    try:
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(line=procs[0].stdout.readline()),
+            daemon=True,
+        )
+        t.start()
+        t.join(240)  # a crashed follower leaves the leader silent forever
+        line = box.get("line", "")
+        if line.strip() != "READY":
+            print(json.dumps({
+                "metric": "pod MLR throughput "
+                          "(2-process virtual pod, SPMD lockstep)",
+                "value": None, "unit": "samples/sec",
+                "error": f"leader not ready within 240s (got {line!r})",
+            }))
+            return
+
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+        from harmony_tpu.jobserver.client import CommandSender
+
+        cfg = JobConfig(
+            job_id="pod-bench", app_type="dolphin",
+            trainer="harmony_tpu.apps.mlr:MLRTrainer",
+            params=TrainerParams(
+                num_epochs=EPOCHS, num_mini_batches=BATCHES,
+                app_params={"num_classes": 64, "num_features": 1024,
+                            "features_per_partition": 128,
+                            "step_size": 0.05},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": N, "num_features": 1024,
+                                "num_classes": 64}},
+        )
+        sender = CommandSender(tcp_port)
+        t0 = time.perf_counter()
+        assert sender.send_job_submit_command(cfg)["ok"]
+        timed_out = True
+        while time.perf_counter() - t0 < 1200:
+            if not sender.send_status_command().get("running"):
+                timed_out = False
+                break
+            time.sleep(0.5)
+        wall = time.perf_counter() - t0
+        sender.send_shutdown_command()
+        lead_out, _ = procs[0].communicate(timeout=120)
+        procs[1].communicate(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    out = {"metric": "pod MLR throughput "
+                     "(2-process virtual pod, SPMD lockstep)",
+           "unit": "samples/sec", "processes": 2, "global_devices": 8,
+           "wall_sec": round(wall, 1)}
+    # A drained-but-failed job (or a timeout) must not print an inflated
+    # rate: verify the leader's RESULT carries the full loss series.
+    result_lines = [ln for ln in lead_out.splitlines()
+                    if ln.startswith("RESULT ")]
+    losses = []
+    if result_lines:
+        res = json.loads(result_lines[0][len("RESULT "):])
+        job = res.get("local_results", {}).get("pod-bench", {})
+        losses = job.get("pod-bench/w0", {}).get("losses", [])
+        if "error" in job:
+            out.update(value=None, error=f"job failed: {job['error']}")
+    if timed_out:
+        out.update(value=None, error=f"job still running after {wall:.0f}s")
+    elif "error" not in out and len(losses) != EPOCHS:
+        out.update(value=None,
+                   error=f"expected {EPOCHS} epoch losses, got {losses}")
+    elif "error" not in out:
+        total = EPOCHS * N
+        out.update(value=round(total / wall, 1), examples=total)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -179,8 +179,20 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
     if app == "lm" and "path" in user.get("data_args", {}):
         # real-file corpus: byte-level tokenization replaces the synthetic
         # generator; the preset's seq_len/num_seqs/vocab_size args carry
-        # over (load_text_tokens shares the signature)
+        # over (load_text_tokens shares those names). Args the file loader
+        # does NOT take (e.g. seed) fail HERE, not mid-job.
+        import inspect
+
+        from harmony_tpu.models.transformer import load_text_tokens
+
         user["data_fn"] = "harmony_tpu.models.transformer:load_text_tokens"
+        allowed = set(inspect.signature(load_text_tokens).parameters)
+        stray = set(user["data_args"]) - allowed
+        if stray:
+            raise SystemExit(
+                f"--data keys {sorted(stray)} do not apply to file corpora "
+                f"(load_text_tokens takes {sorted(allowed)})"
+            )
     # Model/data-coupled keys must match between --set and --data: an
     # explicit override on either side wins over the preset default, a
     # conflicting pair is an error at submit time (not silently-wrong

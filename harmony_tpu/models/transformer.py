@@ -669,6 +669,8 @@ def load_text_tokens(
     whole window the file provides."""
     if vocab_size < 2:
         raise ValueError("vocab_size must be >= 2")
+    if seq_len < 2:  # a next-token example needs at least 2 tokens
+        raise ValueError(f"seq_len must be >= 2, got {seq_len}")
     if num_seqs < 0:
         raise ValueError(f"num_seqs must be >= 0, got {num_seqs}")
     raw = np.fromfile(path, np.uint8)

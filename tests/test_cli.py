@@ -193,3 +193,10 @@ def test_lm_preset_with_file_corpus(tmp_path):
     # coupled key still synced between model and data sides
     assert (cfg.params.app_params["vocab_size"]
             == cfg.user["data_args"]["vocab_size"])
+
+
+def test_lm_file_corpus_rejects_stray_data_keys(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_text("y" * 1000)
+    with pytest.raises(SystemExit, match="do not apply to file corpora"):
+        build_config("lm", _Args(data=[f"path={p}", "seed=3"]))
