@@ -11,32 +11,25 @@ Prints ONE JSON line. Run: python benchmarks/pod.py
 """
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from common import free_port, sanitized_cpu_env, wait_for_ready  # noqa: E402
+
 EPOCHS = 6
 BATCHES = 4
 N = 16384  # examples
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+METRIC = "pod MLR throughput (2-process virtual pod, SPMD lockstep)"
 
 
 def main() -> None:
     worker = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tests", "pod_worker.py")
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    coord, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
+    env = sanitized_cpu_env(4)
+    coord, pod_port, tcp_port = free_port(), free_port(), free_port()
     procs = [
         subprocess.Popen(
             [sys.executable, worker, f"127.0.0.1:{coord}", "2", str(pid),
@@ -47,22 +40,10 @@ def main() -> None:
         for pid in range(2)
     ]
     try:
-        import threading
-
-        box = {}
-        t = threading.Thread(
-            target=lambda: box.update(line=procs[0].stdout.readline()),
-            daemon=True,
-        )
-        t.start()
-        t.join(240)  # a crashed follower leaves the leader silent forever
-        line = box.get("line", "")
-        if line.strip() != "READY":
+        if not wait_for_ready(procs[0], 240):
             print(json.dumps({
-                "metric": "pod MLR throughput "
-                          "(2-process virtual pod, SPMD lockstep)",
-                "value": None, "unit": "samples/sec",
-                "error": f"leader not ready within 240s (got {line!r})",
+                "metric": METRIC, "value": None, "unit": "samples/sec",
+                "error": "leader not ready within 240s",
             }))
             return
 
@@ -85,23 +66,33 @@ def main() -> None:
         )
         sender = CommandSender(tcp_port)
         t0 = time.perf_counter()
-        assert sender.send_job_submit_command(cfg)["ok"]
+        resp = sender.send_job_submit_command(cfg)  # NOT in an assert:
+        if not resp.get("ok"):                      # -O must still submit
+            raise RuntimeError(f"submit failed: {resp}")
         timed_out = True
-        while time.perf_counter() - t0 < 1200:
-            if not sender.send_status_command().get("running"):
-                timed_out = False
-                break
-            time.sleep(0.5)
-        wall = time.perf_counter() - t0
-        sender.send_shutdown_command()
-        lead_out, _ = procs[0].communicate(timeout=120)
-        procs[1].communicate(timeout=120)
+        lead_out = ""
+        try:
+            while time.perf_counter() - t0 < 1200:
+                if not sender.send_status_command().get("running"):
+                    timed_out = False
+                    break
+                time.sleep(0.5)
+            wall = time.perf_counter() - t0
+            sender.send_shutdown_command()
+            lead_out, _ = procs[0].communicate(timeout=120)
+            procs[1].communicate(timeout=120)
+        except Exception as e:  # dead leader / wedged drain: still one line
+            print(json.dumps({
+                "metric": METRIC, "value": None, "unit": "samples/sec",
+                "wall_sec": round(time.perf_counter() - t0, 1),
+                "error": f"{type(e).__name__}: {e}",
+            }))
+            return
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    out = {"metric": "pod MLR throughput "
-                     "(2-process virtual pod, SPMD lockstep)",
+    out = {"metric": METRIC,
            "unit": "samples/sec", "processes": 2, "global_devices": 8,
            "wall_sec": round(wall, 1)}
     # A drained-but-failed job (or a timeout) must not print an inflated

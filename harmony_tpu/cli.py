@@ -29,6 +29,11 @@ from harmony_tpu.config.params import JobConfig, TrainerParams
 # Scales chosen to finish in seconds on one chip while exercising the real
 # code paths; override any field via --set / --data.
 
+# Parameters of models.transformer:load_text_tokens — kept STATIC so the
+# thin TCP submit path never imports jax; pinned against the real signature
+# by tests/test_cli.py.
+FILE_CORPUS_KEYS = frozenset({"path", "seq_len", "num_seqs", "vocab_size"})
+
 PRESETS: Dict[str, Dict[str, Any]] = {
     "mlr": dict(
         app_type="dolphin",
@@ -180,18 +185,16 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
         # real-file corpus: byte-level tokenization replaces the synthetic
         # generator; the preset's seq_len/num_seqs/vocab_size args carry
         # over (load_text_tokens shares those names). Args the file loader
-        # does NOT take (e.g. seed) fail HERE, not mid-job.
-        import inspect
-
-        from harmony_tpu.models.transformer import load_text_tokens
-
+        # does NOT take (e.g. seed) fail HERE, not mid-job. STATIC key set:
+        # importing the models package (jax) into this otherwise-thin TCP
+        # submit path would cost seconds and touch the accelerator plugin;
+        # a test pins the set against the real signature.
         user["data_fn"] = "harmony_tpu.models.transformer:load_text_tokens"
-        allowed = set(inspect.signature(load_text_tokens).parameters)
-        stray = set(user["data_args"]) - allowed
+        stray = set(user["data_args"]) - FILE_CORPUS_KEYS
         if stray:
             raise SystemExit(
                 f"--data keys {sorted(stray)} do not apply to file corpora "
-                f"(load_text_tokens takes {sorted(allowed)})"
+                f"(load_text_tokens takes {sorted(FILE_CORPUS_KEYS)})"
             )
     # Model/data-coupled keys must match between --set and --data: an
     # explicit override on either side wins over the preset default, a

@@ -135,6 +135,50 @@ class TransformerLM:
             "layers": layers,
         }
 
+    def init_numpy(self, seed: int = 0) -> Dict[str, Any]:
+        """``init`` with numpy arrays and NO jax op — same layout and
+        scaling, usable where touching jax would initialize a backend that
+        might hang (e.g. the graft entry point on a wedged transport).
+        Values differ from ``init`` (different RNG); structure is pinned
+        against ``init`` by test."""
+        cfg = self.config
+        rng = np.random.default_rng(seed)
+        d, f = cfg.d_model, cfg.d_ff
+
+        def dense(shape):
+            return (rng.standard_normal(shape)
+                    * shape[0] ** -0.5).astype(np.float32)
+
+        layers = []
+        for i in range(cfg.n_layers):
+            layer = {
+                "ln1": np.ones((d,), np.float32),
+                "wqkv": dense((d, 3 * d)),
+                "wo": dense((d, d)),
+                "ln2": np.ones((d,), np.float32),
+            }
+            if cfg.is_moe_layer(i):
+                E = cfg.moe_experts
+                layer["moe"] = {
+                    "router": dense((d, E)),
+                    "w1": (rng.standard_normal((E, d, f)) * d ** -0.5
+                           ).astype(np.float32),
+                    "w2": (rng.standard_normal((E, f, d)) * f ** -0.5
+                           ).astype(np.float32),
+                }
+            else:
+                layer["w1"] = dense((d, f))
+                layer["w2"] = dense((f, d))
+            layers.append(layer)
+        return {
+            "embed": (0.02 * rng.standard_normal(
+                (cfg.vocab_size, d))).astype(np.float32),
+            "pos": (0.02 * rng.standard_normal(
+                (cfg.max_seq, d))).astype(np.float32),
+            "ln_f": np.ones((d,), np.float32),
+            "layers": layers,
+        }
+
     # -- forward ---------------------------------------------------------
 
     def _attention(self, q, k, v, axis_name: Optional[str]):

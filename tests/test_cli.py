@@ -200,3 +200,15 @@ def test_lm_file_corpus_rejects_stray_data_keys(tmp_path):
     p.write_text("y" * 1000)
     with pytest.raises(SystemExit, match="do not apply to file corpora"):
         build_config("lm", _Args(data=[f"path={p}", "seed=3"]))
+
+
+def test_file_corpus_keys_pin_real_signature():
+    """FILE_CORPUS_KEYS is static (the submit path must stay jax-free) —
+    this test is what keeps it in sync with load_text_tokens."""
+    import inspect
+
+    from harmony_tpu.cli import FILE_CORPUS_KEYS
+    from harmony_tpu.models.transformer import load_text_tokens
+
+    assert FILE_CORPUS_KEYS == frozenset(
+        inspect.signature(load_text_tokens).parameters)

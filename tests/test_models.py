@@ -331,3 +331,23 @@ def test_load_text_tokens_and_trains(tmp_path):
     server.shutdown(timeout=60)
     losses = result["workers"]["lm-file/w0"]["losses"]
     assert losses[-1] < losses[0], losses  # real text is learnable
+
+
+def test_init_numpy_matches_init_layout():
+    """init_numpy (no jax ops; used by the graft entry point) must mirror
+    init's tree structure, shapes and dtypes exactly — for dense AND MoE
+    configs."""
+    import jax
+
+    from harmony_tpu.models import TransformerConfig, TransformerLM
+
+    for kw in ({}, {"moe_experts": 2, "moe_every": 2}):
+        cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                n_layers=2, d_ff=32, max_seq=16, **kw)
+        model = TransformerLM(cfg)
+        a = model.init(jax.random.PRNGKey(0))
+        b = model.init_numpy()
+        assert (jax.tree_util.tree_structure(a)
+                == jax.tree_util.tree_structure(b))
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            assert la.shape == lb.shape and la.dtype == lb.dtype

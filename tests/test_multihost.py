@@ -13,35 +13,26 @@ import os
 import socket
 import subprocess
 import sys
-import threading
 import time
 
 import pytest
+
+# launch harness shared with benchmarks/pod.py (env sanitization strips
+# ALL TPU-claim vars incl. AXON_*; bounded READY waits)
+from benchmarks.common import (  # noqa: E402
+    free_port as _free_port,
+    sanitized_cpu_env as _sanitized_env,
+    wait_for_ready,
+)
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 POD_WORKER = os.path.join(os.path.dirname(__file__), "pod_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _sanitized_env(devices_per_proc: int = 4) -> dict:
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU claim in the workers
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={devices_per_proc}"
-    )
-    return env
-
-
 def test_two_process_distributed_job():
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
-    env = _sanitized_env()
+    env = _sanitized_env(4)
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, coordinator, "2", str(pid)],
@@ -105,24 +96,10 @@ def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
         for pid in range(nprocs)
     ]
     try:
-        # wait for process 0's READY line (runtime + pod join + TCP up).
-        # readline() runs on a helper thread so a silently-wedged leader
-        # (no stdout at all) hits the deadline instead of hanging the
-        # suite — readline itself blocks unboundedly otherwise.
+        # bounded READY wait (helper-thread readlines: a silently-wedged
+        # leader hits the deadline instead of hanging the suite)
+        assert wait_for_ready(procs[0], 240), "leader never became ready"
         deadline = time.monotonic() + 240
-        line = ""
-        while time.monotonic() < deadline:
-            box = {}
-            t = threading.Thread(
-                target=lambda: box.update(line=procs[0].stdout.readline()),
-                daemon=True,
-            )
-            t.start()
-            t.join(max(0.1, deadline - time.monotonic()))
-            line = box.get("line", "")
-            if line.strip() == "READY" or not line:
-                break
-        assert line.strip() == "READY", "leader never became ready"
 
         cfg = JobConfig(
             job_id="pod-mlr", app_type="dolphin",
