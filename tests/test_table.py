@@ -248,3 +248,55 @@ class TestMxuPushRoute:
         ref = spec.push(arr, jnp.asarray([1, 1], jnp.int32),
                         jnp.ones((2, 6), jnp.float32), via="scatter")
         np.testing.assert_allclose(np.asarray(few), np.asarray(ref))
+
+
+class TestRandomizedOpEquivalence:
+    def test_random_op_sequence_matches_dict_model(self, mesh8):
+        """200 random put/update/remove/get ops against the sharded table
+        must match a plain dict model exactly (the dense-table counterpart
+        of the hash table's dict-equivalence sweep)."""
+        rng = np.random.default_rng(42)
+        capacity, vshape = 48, (3,)
+        t = make_table(mesh8, capacity=capacity, vshape=vshape,
+                       num_blocks=8, update="add")
+        model = {}  # key -> np value; absent = init (zeros)
+
+        def expect(k):
+            return model.get(k, np.zeros(vshape, np.float32))
+
+        for _ in range(200):
+            op = rng.choice(["update", "put", "remove", "get", "multi_get",
+                             "multi_update"])
+            k = int(rng.integers(0, capacity))
+            if op == "update":
+                d = rng.standard_normal(vshape).astype(np.float32)
+                t.update(k, d)
+                model[k] = expect(k) + d
+            elif op == "put":
+                v = rng.standard_normal(vshape).astype(np.float32)
+                t.put(k, v)
+                model[k] = v
+            elif op == "remove":
+                got = t.remove(k)
+                np.testing.assert_allclose(got, expect(k), rtol=1e-5,
+                                           atol=1e-5)
+                model.pop(k, None)
+            elif op == "get":
+                np.testing.assert_allclose(t.get(k), expect(k), rtol=1e-5,
+                                           atol=1e-5)
+            elif op == "multi_get":
+                ks = rng.integers(0, capacity, 5).tolist()
+                got = t.multi_get(ks)
+                want = np.stack([expect(x) for x in ks])
+                np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+            else:  # multi_update with DUPLICATE keys (additive fold)
+                ks = rng.integers(0, capacity, 6).tolist()
+                ds = rng.standard_normal((6, *vshape)).astype(np.float32)
+                t.multi_update(ks, ds)
+                for x, dd in zip(ks, ds):
+                    model[x] = expect(x) + dd
+        # final full-table sweep
+        final = np.asarray(t.pull_array())
+        for k in range(capacity):
+            np.testing.assert_allclose(final[k], expect(k), rtol=1e-4,
+                                       atol=1e-5)
