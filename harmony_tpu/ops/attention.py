@@ -27,6 +27,8 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30  # finite "-inf": keeps masked softmax NaN-free
+_LANES = 128  # TPU lane width: per-row stats (LSE, delta) are stored
+              # lane-replicated so their blocks are (8,128)-tileable
 
 
 def _dot_f32(a, b, trans_b=False):
@@ -153,8 +155,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     def _write():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        # log-sum-exp per row, consumed by the fused backward.
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(l[:, 0]))
+        # log-sum-exp per row, consumed by the fused backward. Stored
+        # broadcast across a 128-lane trailing dim: Mosaic requires the last
+        # two block dims be (8,128)-tileable, and a (1, block_q) row block is
+        # not — the lane-replicated layout is the canonical TPU shape for
+        # per-row softmax stats (cf. jax.experimental.pallas.ops.tpu
+        # flash_attention's l/m outputs).
+        lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l), lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
@@ -184,11 +191,11 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((block_q, 128)),   # running row-max m
@@ -197,20 +204,22 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+    return out.reshape(B, H, Sq, D), lse[:, :, 0].reshape(B, H, Sq)
 
 
 def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, scale, causal,
               block_q, block_k):
     """Shared backward math for one (q-block, kv-block) tile: returns
-    (p [bq,bk], ds [bq,bk]) with p the normalized softmax block."""
+    (p [bq,bk], ds [bq,bk]) with p the normalized softmax block.
+    ``lse``/``delta`` arrive as (bq, 1) column tiles (lane 0 of the
+    lane-replicated stats)."""
     qf = q.astype(jnp.float32) * scale
     s = _dot_f32(qf, k.astype(jnp.float32), trans_b=True)     # (bq, bk)
     if causal:
         s = _apply_causal_mask(s, iq, ik, block_q, block_k)
-    p = jnp.exp(s - lse[:, None])                             # normalized
+    p = jnp.exp(s - lse)                                      # normalized
     dp = _dot_f32(do.astype(jnp.float32), v.astype(jnp.float32), trans_b=True)
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta)
     return p, ds
 
 
@@ -231,7 +240,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(needed)
     def _compute():
         p, ds = _bwd_p_ds(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+            lse_ref[0, :, :1], delta_ref[0, :, :1],
             iq, ik, scale, causal, block_q, block_k,
         )
         dv_acc[:] += jax.lax.dot_general(
@@ -262,7 +272,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(needed)
     def _compute():
         _, ds = _bwd_p_ds(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+            lse_ref[0, :, :1], delta_ref[0, :, :1],
             iq, ik, scale, causal, block_q, block_k,
         )
         dq_acc[:] += scale * _dot_f32(ds, k_ref[0].astype(jnp.float32))
@@ -285,14 +296,18 @@ def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
     kf = k.reshape(B * H, Sk, D)
     vf = v.reshape(B * H, Sk, D)
     dof = do.reshape(B * H, Sq, D)
-    lsef = lse.reshape(B * H, Sq)
+    # Per-row stats enter lane-replicated (see _LANES note in the forward);
+    # XLA materializes the broadcasts, the kernels read lane 0.
+    lsef = jnp.broadcast_to(lse.reshape(B * H, Sq)[:, :, None],
+                            (B * H, Sq, _LANES))
     # delta_i = dO_i . O_i (rowwise), cheap enough to leave to XLA.
     delta = jnp.einsum("bsd,bsd->bs", dof.astype(jnp.float32),
                        out.reshape(B * H, Sq, D).astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[:, :, None], (B * H, Sq, _LANES))
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0))
     dkv = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
@@ -314,7 +329,7 @@ def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
 
     q_spec2 = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     kv_spec2 = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
-    row_spec2 = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    row_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     dqk = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
