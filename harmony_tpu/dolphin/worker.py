@@ -42,6 +42,7 @@ from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, MetricCollector
 from harmony_tpu.parallel.mesh import DATA_AXIS
+from harmony_tpu.tracing import trace_span
 
 
 class WorkerTasklet:
@@ -496,7 +497,6 @@ class WorkerTasklet:
         stop = False
         global_batch_idx = 0
         epoch_losses: List[float] = []
-        from harmony_tpu.tracing import trace_span
 
         for epoch in range(self.starting_epoch, params.num_epochs):
             # chief-only (the split is a property of the shared table, not
@@ -510,7 +510,9 @@ class WorkerTasklet:
                 first = tuple(a[: self.data.batch_size]
                               for a in self.data._arrays)
                 if first and len(first[0]):
-                    self._probe_comm(first)
+                    with trace_span("dolphin.comm_probe",
+                                    job_id=self.job_id, epoch=epoch):
+                        self._probe_comm(first)
             epoch_t0 = time.perf_counter()
             with trace_span(
                 "dolphin.epoch",
@@ -601,29 +603,32 @@ class WorkerTasklet:
             # stack per run of same-sharded values (still O(reshards) ops,
             # not O(batches)).
             t0 = time.perf_counter()
-            runs: List[List[Dict[str, jnp.ndarray]]] = [[pending[0]]]
-            probe = next(iter(pending[0]))
-            for m in pending[1:]:
-                if m[probe].sharding == runs[-1][-1][probe].sharding:
-                    runs[-1].append(m)
-                else:
-                    runs.append([m])
-            # The eager stacks DISPATCH under the table lock: they are
-            # multi-device programs (and can carry an implicit transfer when
-            # a metric landed with a different placement), and a dispatch
-            # racing other workers' step dispatches enqueues per-device work
-            # in divergent orders — on backends with in-process collectives
-            # that inverts a rendezvous and deadlocks. The lock is the
-            # global dispatch serializer; the D2H copies below stay outside.
-            with self.ctx.model_table._lock:
-                stacked = {
-                    k: [jnp.stack([m[k] for m in r]) for r in runs]
-                    for k in pending[0]
+            with trace_span("dolphin.metric_drain", job_id=self.job_id,
+                            epoch=epoch, batches=len(pending)):
+                runs: List[List[Dict[str, jnp.ndarray]]] = [[pending[0]]]
+                probe = next(iter(pending[0]))
+                for m in pending[1:]:
+                    if m[probe].sharding == runs[-1][-1][probe].sharding:
+                        runs[-1].append(m)
+                    else:
+                        runs.append([m])
+                # The eager stacks DISPATCH under the table lock: they are
+                # multi-device programs (and can carry an implicit transfer
+                # when a metric landed with a different placement), and a
+                # dispatch racing other workers' step dispatches enqueues
+                # per-device work in divergent orders — on backends with
+                # in-process collectives that inverts a rendezvous and
+                # deadlocks. The lock is the global dispatch serializer; the
+                # D2H copies below stay outside.
+                with self.ctx.model_table._lock:
+                    stacked = {
+                        k: [jnp.stack([m[k] for m in r]) for r in runs]
+                        for k in pending[0]
+                    }
+                host = {
+                    k: np.concatenate([np.atleast_1d(np.asarray(s)) for s in v])
+                    for k, v in stacked.items()
                 }
-            host = {
-                k: np.concatenate([np.atleast_1d(np.asarray(s)) for s in v])
-                for k, v in stacked.items()
-            }
             work_t += time.perf_counter() - t0
             # Async dispatch makes true per-batch device time unobservable
             # without per-step syncs; smear the epoch's work time (barrier
@@ -689,12 +694,14 @@ class WorkerTasklet:
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
             if self._stacked_cache is None:
-                batches = list(self.data.epoch_batches())
-                stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
-                self._stacked_cache = tuple(
-                    jax.device_put(np.stack([b[i] for b in batches]), stacked_sharding)
-                    for i in range(len(batches[0]))
-                )
+                with trace_span("dolphin.dataset_upload", job_id=self.job_id):
+                    batches = list(self.data.epoch_batches())
+                    stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
+                    self._stacked_cache = tuple(
+                        jax.device_put(np.stack([b[i] for b in batches]),
+                                       stacked_sharding)
+                        for i in range(len(batches[0]))
+                    )
             # timer starts AFTER cache build: the one-time dataset stacking/
             # transfer must not inflate per-batch times fed to the optimizer
             t0 = time.perf_counter()
