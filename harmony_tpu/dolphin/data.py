@@ -27,7 +27,13 @@ class TrainingDataProvider:
         num_mini_batches: int,
         shuffle_each_epoch: bool = False,
         seed: int = 0,
+        dataset_key: "tuple | None" = None,
     ) -> None:
+        # Identity of the DATA SOURCE (generator path + args + worker slice),
+        # set by the job entity: stable batches with a key participate in the
+        # process-level device cache (data/devcache.py) so resubmitted jobs
+        # reuse device-resident copies. None (the default) = private data.
+        self.dataset_key = dataset_key if not shuffle_each_epoch else None
         if not arrays:
             raise ValueError("need at least one data array")
         n = arrays[0].shape[0]

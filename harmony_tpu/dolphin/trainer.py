@@ -100,6 +100,43 @@ class Trainer:
         and silently never decay."""
         return {}
 
+    def jit_signature(self) -> "tuple | None":
+        """Structural identity of this trainer's TRACED behavior, or None.
+
+        Jobs whose trainers report equal signatures (together with equal
+        table/mesh/batch signatures) reuse each other's compiled step
+        programs across submissions (runtime/progcache) — the long-running
+        JobServer's resubmit-the-same-app pattern stops paying a recompile
+        per job, which on a remote-attached accelerator dominates short
+        jobs.
+
+        Contract: the signature must determine everything ``compute`` /
+        ``pull_keys`` / ``hyperparams``-keys trace. The default derives it
+        from the instance ``__dict__`` when every attribute is a plain
+        scalar (int/float/str/bool/None, or flat tuples thereof) and opts
+        out (None) otherwise — a trainer holding arrays, callables or other
+        objects cannot be structurally named, and silently sharing programs
+        would be worse than recompiling. Note scalars that compute() bakes
+        into the trace are frozen at first dispatch ANYWAY (mutating them
+        mid-job never retraces), so keying on their at-build values adds no
+        new staleness hazard; per-epoch knobs belong in hyperparams().
+        """
+        items = []
+        for k, v in sorted(self.__dict__.items()):
+            # Type-tag every scalar: Python's cross-type equality
+            # (True == 1 == 1.0) would otherwise collide keys whose traced
+            # programs differ (an int baked into a trace doesn't promote
+            # like a float would).
+            if isinstance(v, (int, float, str, bool, type(None))):
+                items.append((k, type(v).__name__, v))
+            elif isinstance(v, tuple) and all(
+                isinstance(x, (int, float, str, bool)) for x in v
+            ):
+                items.append((k, tuple((type(x).__name__, x) for x in v)))
+            else:
+                return None
+        return (type(self).__module__, type(self).__qualname__, tuple(items))
+
     def pull_keys(self, batch: Any) -> jnp.ndarray:
         """keys to pull for this batch (pull_mode == "keys" only)."""
         raise NotImplementedError

@@ -38,10 +38,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from harmony_tpu.data import devcache
 from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, MetricCollector
 from harmony_tpu.parallel.mesh import DATA_AXIS
+from harmony_tpu.runtime import progcache
 from harmony_tpu.tracing import trace_span
 
 
@@ -84,6 +86,7 @@ class WorkerTasklet:
         self._step = None
         self._epoch_fn = None
         self._eval_fn = None
+        self._program_cache_key = None  # set by _build_step
         # Comm/comp split probe (see _probe_comm): period in epochs; 0 = off.
         self.comm_probe_every = 1
         self._probe_pull = None
@@ -228,6 +231,33 @@ class WorkerTasklet:
 
         return _step
 
+    def _program_key(self) -> "tuple | None":
+        """Structural signature of everything the jitted step traces, for the
+        process-level program cache (runtime/progcache) — None opts out.
+        Components: trainer behavior, table schema + CURRENT layout (a live
+        reshard changes the signature, so stale programs never resurface),
+        batch shapes, hyper keys, and the dispatch shape."""
+        tsig = self.trainer.jit_signature()
+        if tsig is None:
+            return None
+        table_sig = progcache.table_signature(self.ctx.model_table)
+        if table_sig is None:
+            return None
+        if self.trainer.uses_local_table:
+            local_sig = progcache.table_signature(self.ctx.local_table)
+            if local_sig is None:
+                return None
+        else:
+            local_sig = None
+        batch_sig = tuple(
+            (self.data.batch_size, *a.shape[1:], str(a.dtype))
+            for a in self.data._arrays
+        )
+        hyper_sig = tuple(sorted(self.trainer.hyperparams().keys()))
+        return (tsig, table_sig, local_sig, batch_sig, hyper_sig,
+                getattr(self.ctx.model_table, "push_via", None),
+                self.data.num_mini_batches if self._use_fused_epoch() else None)
+
     def _build_step(self) -> None:
         table = self.ctx.model_table
         data_ax = table.mesh.shape.get(DATA_AXIS, 1)
@@ -237,12 +267,22 @@ class WorkerTasklet:
                 f"mesh data axis ({data_ax}); pick num_mini_batches so that "
                 "each batch splits evenly across data-parallel shards"
             )
-        step = self._step_core()
-        if self.trainer.uses_local_table:
-            local = self.ctx.local_table
-            out_sh = ((table.sharding, local.sharding), None)
-            self._step = jax.jit(step, out_shardings=out_sh, donate_argnums=(0, 1))
-            if self._use_fused_epoch():
+        self._program_cache_key = self._program_key()
+        key = self._program_cache_key
+
+        def build_step():
+            step = self._step_core()
+            if self.trainer.uses_local_table:
+                out_sh = ((table.sharding, self.ctx.local_table.sharding), None)
+                return jax.jit(step, out_shardings=out_sh, donate_argnums=(0, 1))
+            return jax.jit(
+                step, out_shardings=(table.sharding, None), donate_argnums=0
+            )
+
+        def build_epoch():
+            step = self._step_core()
+            if self.trainer.uses_local_table:
+                out_sh = ((table.sharding, self.ctx.local_table.sharding), None)
 
                 def _epoch2(arr, larr, stacked, hyper):
                     def body(carry, b):
@@ -252,22 +292,26 @@ class WorkerTasklet:
                     (fa, fl), ms = jax.lax.scan(body, (arr, larr), stacked)
                     return (fa, fl), ms
 
-                self._epoch_fn = jax.jit(
-                    _epoch2, out_shardings=out_sh, donate_argnums=(0, 1)
-                )
-        else:
-            self._step = jax.jit(
-                step, out_shardings=(table.sharding, None), donate_argnums=0
+                return jax.jit(_epoch2, out_shardings=out_sh, donate_argnums=(0, 1))
+
+            def _epoch(arr, stacked, hyper):
+                return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
+
+            return jax.jit(
+                _epoch, out_shardings=(table.sharding, None), donate_argnums=0
             )
-            if self._use_fused_epoch():
 
-                def _epoch(arr, stacked, hyper):
-                    return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
-
-                self._epoch_fn = jax.jit(
-                    _epoch, out_shardings=(table.sharding, None), donate_argnums=0
-                )
-        self._eval_fn = jax.jit(self.trainer.evaluate)
+        self._step = progcache.get_or_build(
+            None if key is None else (key, "step"), build_step
+        )
+        if self._use_fused_epoch():
+            self._epoch_fn = progcache.get_or_build(
+                None if key is None else (key, "epoch"), build_epoch
+            )
+        self._eval_fn = progcache.get_or_build(
+            None if key is None else (key, "eval"),
+            lambda: jax.jit(self.trainer.evaluate),
+        )
         # Per-batch pull size for op accounting (ref: RemoteAccessOpStat
         # counters behind MetricReportMsg): keys-mode row count comes from
         # an eval_shape of pull_keys (no compute), all-mode pulls capacity.
@@ -289,6 +333,15 @@ class WorkerTasklet:
         self._batch_cache.clear()   # cached batches live on the old mesh
         self._stacked_cache = None
         self._probe_pull = None     # probe programs target the old layout
+        if self.data.dataset_key is not None:
+            # Release this dataset's GLOBAL device buffers made unreachable
+            # by a layout change (their keys embed the old sharding sig) —
+            # otherwise up to the cache budget of HBM stays pinned on
+            # devices the job may have just released.
+            cur = progcache.sharding_signature(self._batch_sharding)
+            devcache.drop(
+                lambda k: k[0] == self.data.dataset_key and k[2] != cur
+            )
 
     def _build_comm_probe(self) -> None:
         """Standalone PULL and PULL+PUSH(zero-delta) programs mirroring the
@@ -346,8 +399,15 @@ class WorkerTasklet:
                 rows = spec.pull(arr, keys)
                 return spec.push(arr, keys, jnp.zeros_like(rows), via=push_via)
 
-        self._probe_pull = jax.jit(pull_fn)
-        self._probe_pp = jax.jit(pp_fn)
+        key = self._program_cache_key
+        self._probe_pull = progcache.get_or_build(
+            None if key is None else (key, "probe_pull"),
+            lambda: jax.jit(pull_fn),
+        )
+        self._probe_pp = progcache.get_or_build(
+            None if key is None else (key, "probe_pp"),
+            lambda: jax.jit(pp_fn),
+        )
 
     @staticmethod
     def _mesh_spans_processes(mesh: Mesh) -> bool:
@@ -429,6 +489,32 @@ class WorkerTasklet:
     def _shard_batch(self, batch: Tuple[np.ndarray, ...]):
         return tuple(jax.device_put(a, self._batch_sharding) for a in batch)
 
+    def _devcache_key(self, tag) -> "tuple | None":
+        """Key into the process-level device data cache (data/devcache) —
+        None unless the provider carries a data-source identity."""
+        if self.data.dataset_key is None:
+            return None
+        return (self.data.dataset_key, tag,
+                progcache.sharding_signature(self._batch_sharding))
+
+    def _cached_batch(self, batch_idx: int, batch):
+        """Device copy of one batch. The global cache (when the dataset has
+        an identity) lets resubmitted jobs reuse device buffers; the
+        per-worker cache is ALWAYS kept as well, so a dataset that blows the
+        global byte budget (LRU thrash, 0% hit rate) still uploads at most
+        once per worker — never worse than the cache-free behavior."""
+        batch_dev = self._batch_cache.get(batch_idx)
+        if batch_dev is not None:
+            return batch_dev
+        gkey = self._devcache_key(batch_idx)
+        batch_dev = devcache.get(gkey) if gkey is not None else None
+        if batch_dev is None:
+            batch_dev = self._shard_batch(batch)
+            if gkey is not None:
+                devcache.put(gkey, batch_dev)
+        self._batch_cache[batch_idx] = batch_dev
+        return batch_dev
+
     # Bounded retries when a live reshard lands BETWEEN the rebuild check
     # and the dispatch (a step compiled for the old layout then receives the
     # new-layout array — XLA raises a device-mismatch at dispatch time, the
@@ -446,10 +532,7 @@ class WorkerTasklet:
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
             if self.cache_device_batches:
-                batch_dev = self._batch_cache.get(batch_idx)
-                if batch_dev is None:
-                    batch_dev = self._shard_batch(batch)
-                    self._batch_cache[batch_idx] = batch_dev
+                batch_dev = self._cached_batch(batch_idx, batch)
             else:
                 batch_dev = self._shard_batch(batch)
             try:
@@ -694,14 +777,21 @@ class WorkerTasklet:
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
             if self._stacked_cache is None:
-                with trace_span("dolphin.dataset_upload", job_id=self.job_id):
-                    batches = list(self.data.epoch_batches())
-                    stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
-                    self._stacked_cache = tuple(
-                        jax.device_put(np.stack([b[i] for b in batches]),
-                                       stacked_sharding)
-                        for i in range(len(batches[0]))
-                    )
+                gkey = self._devcache_key("stacked")
+                hit = devcache.get(gkey) if gkey is not None else None
+                if hit is not None:
+                    self._stacked_cache = hit
+                else:
+                    with trace_span("dolphin.dataset_upload", job_id=self.job_id):
+                        batches = list(self.data.epoch_batches())
+                        stacked_sharding = NamedSharding(table.mesh,
+                                                         P(None, DATA_AXIS))
+                        self._stacked_cache = tuple(
+                            jax.device_put(np.stack([b[i] for b in batches]),
+                                           stacked_sharding)
+                            for i in range(len(batches[0]))
+                        )
+                    devcache.put(gkey, self._stacked_cache)
             # timer starts AFTER cache build: the one-time dataset stacking/
             # transfer must not inflate per-batch times fed to the optimizer
             t0 = time.perf_counter()

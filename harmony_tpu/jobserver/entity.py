@@ -21,6 +21,7 @@ import numpy as np
 
 from harmony_tpu.config.base import resolve_symbol
 from harmony_tpu.config.params import JobConfig, TrainerParams
+from harmony_tpu.data.devcache import host_data as _HOST_DATA_CACHE
 from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.master import (
     BatchProgressTracker,
@@ -96,13 +97,54 @@ class DolphinJobEntity(JobEntity):
         cls = resolve_symbol(self.config.trainer)
         return cls(**self.config.params.app_params)
 
+    def _data_source_key(self) -> "tuple | None":
+        """Identity of this job's data source: the generator/loader dotted
+        path + canonicalized args. Jobs sharing it reuse device-resident
+        batches (data/devcache) — the analogue of the reference's same-id
+        input-table reuse (DolphinJobEntity.java:76-121). None when args
+        aren't canonicalizable (unhashable values)."""
+        user = self.config.user
+
+        def tag(v):
+            # type-tagged (see Trainer.jit_signature: True == 1 == 1.0 must
+            # not collide — a data_fn can behave differently per type)
+            if isinstance(v, list):
+                return ("list", tuple(tag(x) for x in v))
+            return (type(v).__name__, v)
+
+        try:
+            args = tuple(sorted(
+                (k, tag(v)) for k, v in user.get("data_args", {}).items()
+            ))
+            hash(args)
+        except TypeError:
+            return None
+        return (user.get("data_fn"), args)
+
     def _make_data(self) -> List[np.ndarray]:
+        """Materialize the job's dataset. Jobs with the SAME (data_fn,
+        data_args) are defined to see the same dataset — the host arrays are
+        cached under the source key (and the per-batch device copies under
+        the same key in data/devcache), mirroring the reference's same-id
+        input-table sharing. Non-deterministic sources that must differ per
+        job should vary their args (e.g. a seed) to opt out."""
         user = self.config.user
         if "data_fn" not in user:
             raise ValueError(f"job {self.config.job_id}: user.data_fn missing")
+        key = self._data_source_key()
+        if key is not None:
+            cached = _HOST_DATA_CACHE.get(key)
+            if cached is not None:
+                return cached
         fn = resolve_symbol(user["data_fn"])
         out = fn(**user.get("data_args", {}))
-        return [np.asarray(a) for a in (out if isinstance(out, (tuple, list)) else (out,))]
+        arrays = [
+            np.asarray(a)
+            for a in (out if isinstance(out, (tuple, list)) else (out,))
+        ]
+        if key is not None:
+            _HOST_DATA_CACHE.put(key, arrays)
+        return arrays
 
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
         self._master = master
@@ -213,7 +255,13 @@ class DolphinJobEntity(JobEntity):
                 # Last worker takes the remainder so no example is dropped.
                 hi = (idx + 1) * per if idx < num_workers - 1 else n
                 sl = slice(idx * per, hi)
-                data = TrainingDataProvider([a[sl] for a in self._data_arrays], nb)
+                src = self._data_source_key()
+                data = TrainingDataProvider(
+                    [a[sl] for a in self._data_arrays], nb,
+                    dataset_key=(
+                        None if src is None else (src, sl.start, hi, nb)
+                    ),
+                )
                 ctx = TrainerContext(
                     params=params,
                     model_table=self._handle.table,

@@ -1,0 +1,116 @@
+"""Process-level cache of compiled training-step programs.
+
+A long-running JobServer repeatedly runs structurally identical jobs (the
+reference's standing use case: resubmitting the same Dolphin app to the same
+resource pool, DolphinJobLauncher -> JobServerDriver SUBMIT). Every submit
+builds a fresh ``WorkerTasklet``, whose ``jax.jit(step)`` closure is a new
+Python object — so the in-memory executable from the previous run is
+unreachable and the step recompiles. On a locally-attached backend that
+costs milliseconds; on a remote-attached chip each compile crosses the
+tunnel and dominates short jobs (measured: the headline bench's accelerator
+pass spent its wall on recompiles of programs the warmup pass had already
+built).
+
+This cache keys the jitted callable on a STRUCTURAL signature of everything
+the trace depends on — trainer behavior (Trainer.jit_signature), table
+schema, current sharding/mesh layout, batch shapes, hyper-parameter keys,
+dispatch shape (per-batch vs fused-epoch) — and returns the same callable
+for equal keys, so resubmitted jobs reuse the compiled executable.
+
+Opt-out is the default at the trainer level: ``Trainer.jit_signature``
+returns None unless every instance attribute is a plain scalar (see its
+docstring for the contract), and tables with caller-supplied update
+functions never cache (no stable identity for arbitrary callables).
+
+The cached callable closes over the FIRST job's trainer/spec instances;
+the signature contract is exactly the guarantee that any other job with
+the same key would have traced the identical program. Entries are LRU,
+bounded — compiled TPU executables hold device memory for constants, so
+the bound is deliberately small.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Hashable, Optional, Tuple
+
+from jax.sharding import Mesh, NamedSharding
+
+_MAX_ENTRIES = 32
+_lock = threading.Lock()
+_cache: "OrderedDict[Hashable, Callable]" = OrderedDict()
+_stats = {"hits": 0, "misses": 0}
+
+
+def mesh_signature(mesh: Mesh) -> Tuple:
+    """Value identity of a mesh: axis layout + the concrete device list.
+    Two Mesh objects over the same devices in the same arrangement produce
+    interchangeable programs (jax compares meshes by value the same way)."""
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(s) for s in mesh.devices.shape),
+        tuple((d.platform, d.process_index, d.id) for d in mesh.devices.flat),
+    )
+
+
+def sharding_signature(sharding) -> Tuple:
+    """Hash tables expose a (keys, vals) sharding tuple; recurse."""
+    if isinstance(sharding, tuple):
+        return tuple(sharding_signature(s) for s in sharding)
+    return (mesh_signature(sharding.mesh), str(sharding.spec))
+
+
+def table_signature(table: Any) -> Optional[Tuple]:
+    """Structural identity of a table's traced ops, or None when the spec
+    carries behavior the config string cannot name (custom update fn)."""
+    spec = table.spec
+    if getattr(spec, "custom_update_fn", True):
+        return None
+    cfg = spec.config
+    return (
+        type(table).__name__,
+        cfg.capacity,
+        tuple(cfg.value_shape),
+        cfg.dtype,
+        spec.num_blocks,
+        cfg.is_ordered,
+        cfg.is_mutable,
+        cfg.sparse,
+        cfg.update_fn,
+        getattr(spec, "max_probes", None),  # hash tables: probing depth is
+                                            # constructor state, not config
+        sharding_signature(table.sharding),
+    )
+
+
+def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Callable:
+    """Return the cached callable for ``key``, building (and caching) on
+    miss. ``key=None`` bypasses the cache entirely."""
+    if key is None:
+        return build()
+    with _lock:
+        fn = _cache.get(key)
+        if fn is not None:
+            _cache.move_to_end(key)
+            _stats["hits"] += 1
+            return fn
+    # Build OUTSIDE the lock: tracing can be slow and may itself dispatch.
+    fn = build()
+    with _lock:
+        _stats["misses"] += 1
+        _cache[key] = fn
+        _cache.move_to_end(key)
+        while len(_cache) > _MAX_ENTRIES:
+            _cache.popitem(last=False)
+    return fn
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats, entries=len(_cache))
+
+
+def clear() -> None:
+    with _lock:
+        _cache.clear()
+        _stats.update(hits=0, misses=0)

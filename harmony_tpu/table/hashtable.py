@@ -124,6 +124,8 @@ class HashTableSpec:
         max_probes: int = 16,
     ):
         self.config = config
+        # Same program-cache exclusion rule as TableSpec (runtime/progcache).
+        self.custom_update_fn = update_fn is not None
         self.update_fn = update_fn or get_update_fn(config.update_fn)
         self.num_blocks = config.num_blocks
         raw = _next_pow2(max(1, -(-config.capacity // config.num_blocks)))

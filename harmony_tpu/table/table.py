@@ -73,6 +73,9 @@ class TableSpec:
 
     def __init__(self, config: TableConfig, update_fn: Optional[UpdateFunction] = None):
         self.config = config
+        # Caller-supplied update fns have no stable identity, so specs built
+        # with one are excluded from program-cache keys (runtime/progcache).
+        self.custom_update_fn = update_fn is not None
         self.update_fn = update_fn or get_update_fn(config.update_fn)
         part_cls = RangePartitioner if config.is_ordered else HashPartitioner
         self.partitioner: BlockPartitioner = part_cls(config.capacity, config.num_blocks)
@@ -251,7 +254,20 @@ class DenseTable:
         self._mesh = mesh
         self._sharding = self._make_sharding(mesh)
         if arr is None:
-            arr = jax.jit(spec.init_array, out_shardings=self._sharding)()
+            # Route the init program through the process-level program cache:
+            # every table construction otherwise compiles a fresh closure,
+            # and a multi-tenant server constructs tables per job submit.
+            from harmony_tpu.runtime import progcache
+
+            key = (
+                None if spec.custom_update_fn
+                else (progcache.table_signature(self), "table_init")
+            )
+            init = progcache.get_or_build(
+                key,
+                lambda: jax.jit(spec.init_array, out_shardings=self._sharding),
+            )
+            arr = init()
         else:
             arr = jax.device_put(arr, self._sharding)
         self._arr: jax.Array = arr
