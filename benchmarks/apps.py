@@ -25,6 +25,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+from harmony_tpu.utils.platform import mirror_env_platform_request  # noqa: E402
+
+mirror_env_platform_request()  # JAX_PLATFORMS=cpu must mean cpu (axon hook)
+
 import bench  # noqa: E402
 from harmony_tpu.config.params import JobConfig, TrainerParams  # noqa: E402
 from harmony_tpu.jobserver.server import JobServer  # noqa: E402

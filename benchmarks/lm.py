@@ -28,6 +28,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+from harmony_tpu.utils.platform import mirror_env_platform_request
+
+mirror_env_platform_request()  # JAX_PLATFORMS=cpu must mean cpu (axon hook)
 import jax.numpy as jnp
 import numpy as np
 
@@ -85,6 +89,15 @@ def bench_train() -> dict:
         new = jax.tree.map(lambda w, g: w - 0.1 * g.astype(w.dtype), p, grads)
         return new, loss
 
+    # Stderr markers: on a remote-attached chip a big compile can take
+    # minutes and a wedged transport hangs forever — make which one it was
+    # visible in the capture log instead of an opaque stall.
+    print(f"lm train: compiling (params={_param_count(params)/1e6:.1f}M, "
+          f"seq={cfg.max_seq}, batch={batch})...", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(params, tokens)[1])
+    print(f"lm train: compiled+first step in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr, flush=True)
     dt = _time(lambda p, t: step(p, t)[1], params, tokens)
     n_tok = batch * cfg.max_seq
     n_params = _param_count(params)

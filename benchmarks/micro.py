@@ -46,6 +46,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+from harmony_tpu.utils.platform import mirror_env_platform_request
+
+mirror_env_platform_request()  # JAX_PLATFORMS=cpu must mean cpu (axon hook)
 import jax.numpy as jnp
 import numpy as np
 

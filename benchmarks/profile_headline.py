@@ -30,11 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-# The axon register hook hijacks backend init even when JAX_PLATFORMS=cpu
-# is in the environment (and hangs when the chip transport is wedged); the
-# config-level update is honored, so mirror the env request through it.
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+from harmony_tpu.utils.platform import mirror_env_platform_request
+
+mirror_env_platform_request()  # JAX_PLATFORMS=cpu must mean cpu (axon hook)
 
 import jax.numpy as jnp
 import numpy as np

@@ -110,8 +110,11 @@ class Trainer:
         per job, which on a remote-attached accelerator dominates short
         jobs.
 
-        Contract: the signature must determine everything ``compute`` /
-        ``pull_keys`` / ``hyperparams``-keys trace. The default derives it
+        Contract: the signature must determine everything the trainer's
+        traced functions — ``compute``/``compute_with_local``,
+        ``pull_keys``, ``evaluate``, and the ``hyperparams`` key set —
+        would trace (the worker caches its eval program under the same
+        key). The default derives it
         from the instance ``__dict__`` when every attribute is a plain
         scalar (int/float/str/bool/None, or flat tuples thereof) and opts
         out (None) otherwise — a trainer holding arrays, callables or other

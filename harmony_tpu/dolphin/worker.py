@@ -42,6 +42,7 @@ from harmony_tpu.data import devcache
 from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, MetricCollector
+from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
 from harmony_tpu.tracing import trace_span
@@ -87,14 +88,16 @@ class WorkerTasklet:
         self._epoch_fn = None
         self._eval_fn = None
         self._program_cache_key = None  # set by _build_step
+        self._built_once = False
         # Comm/comp split probe (see _probe_comm): period in epochs; 0 = off.
-        self.comm_probe_every = 1
+        self.comm_probe_every = getattr(ctx.params, "comm_probe_period", 1)
         self._probe_pull = None
         self._probe_pp = None
         self._comm_probe_times = (0.0, 0.0)
         self._step_sharding = None
         self._local_sharding = None
         self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._batch_sig = progcache.sharding_signature(self._batch_sharding)
         # Keep device-resident copies of batches across epochs (kills the
         # per-epoch H2D re-transfer; only valid when batches are stable).
         self.cache_device_batches = not data.is_shuffling
@@ -231,20 +234,26 @@ class WorkerTasklet:
 
         return _step
 
-    def _program_key(self) -> "tuple | None":
+    def _program_key(self, table_sharding, local_sharding) -> "tuple | None":
         """Structural signature of everything the jitted step traces, for the
         process-level program cache (runtime/progcache) — None opts out.
-        Components: trainer behavior, table schema + CURRENT layout (a live
-        reshard changes the signature, so stale programs never resurface),
-        batch shapes, hyper keys, and the dispatch shape."""
+        Components: trainer behavior, table schema + layout SNAPSHOT (the
+        same snapshot the jit out_shardings use — reading the live sharding
+        twice would let a concurrent reshard poison the cache with a
+        key/executable layout mismatch), batch shapes, hyper keys, and the
+        dispatch shape."""
         tsig = self.trainer.jit_signature()
         if tsig is None:
             return None
-        table_sig = progcache.table_signature(self.ctx.model_table)
+        table_sig = progcache.table_signature(
+            self.ctx.model_table, sharding=table_sharding
+        )
         if table_sig is None:
             return None
         if self.trainer.uses_local_table:
-            local_sig = progcache.table_signature(self.ctx.local_table)
+            local_sig = progcache.table_signature(
+                self.ctx.local_table, sharding=local_sharding
+            )
             if local_sig is None:
                 return None
         else:
@@ -267,22 +276,24 @@ class WorkerTasklet:
                 f"mesh data axis ({data_ax}); pick num_mini_batches so that "
                 "each batch splits evenly across data-parallel shards"
             )
-        self._program_cache_key = self._program_key()
+        # ONE locked read of each table's layout, used for BOTH the cache
+        # key and the compiled out_shardings (see _program_key docstring).
+        tsh = table.sharding
+        lsh = self.ctx.local_table.sharding if self.trainer.uses_local_table else None
+        prev_key = self._program_cache_key if self._built_once else None
+        self._program_cache_key = self._program_key(tsh, lsh)
         key = self._program_cache_key
 
         def build_step():
             step = self._step_core()
             if self.trainer.uses_local_table:
-                out_sh = ((table.sharding, self.ctx.local_table.sharding), None)
-                return jax.jit(step, out_shardings=out_sh, donate_argnums=(0, 1))
-            return jax.jit(
-                step, out_shardings=(table.sharding, None), donate_argnums=0
-            )
+                return jax.jit(step, out_shardings=((tsh, lsh), None),
+                               donate_argnums=(0, 1))
+            return jax.jit(step, out_shardings=(tsh, None), donate_argnums=0)
 
         def build_epoch():
             step = self._step_core()
             if self.trainer.uses_local_table:
-                out_sh = ((table.sharding, self.ctx.local_table.sharding), None)
 
                 def _epoch2(arr, larr, stacked, hyper):
                     def body(carry, b):
@@ -292,14 +303,13 @@ class WorkerTasklet:
                     (fa, fl), ms = jax.lax.scan(body, (arr, larr), stacked)
                     return (fa, fl), ms
 
-                return jax.jit(_epoch2, out_shardings=out_sh, donate_argnums=(0, 1))
+                return jax.jit(_epoch2, out_shardings=((tsh, lsh), None),
+                               donate_argnums=(0, 1))
 
             def _epoch(arr, stacked, hyper):
                 return jax.lax.scan(lambda a, b: step(a, b, hyper), arr, stacked)
 
-            return jax.jit(
-                _epoch, out_shardings=(table.sharding, None), donate_argnums=0
-            )
+            return jax.jit(_epoch, out_shardings=(tsh, None), donate_argnums=0)
 
         self._step = progcache.get_or_build(
             None if key is None else (key, "step"), build_step
@@ -325,23 +335,44 @@ class WorkerTasklet:
             )
         else:
             self._pull_rows = int(table.spec.config.capacity)
-        self._step_sharding = table.sharding
-        self._local_sharding = (
-            self.ctx.local_table.sharding if self.trainer.uses_local_table else None
-        )
-        self._batch_sharding = NamedSharding(table.mesh, P(DATA_AXIS))
+        self._step_sharding = tsh
+        self._local_sharding = lsh
+        prev_batch_sig = self._batch_sig if self._built_once else None
+        # hash tables snapshot a (keys, vals) sharding pair — same mesh
+        mesh_now = (tsh[0] if isinstance(tsh, tuple) else tsh).mesh
+        # keep the worker's mesh view current: the probe/drain dispatch
+        # scopes key their global-order decision on it, and a stale 1-device
+        # mesh would skip the lock for now-multi-device programs
+        self.mesh = mesh_now
+        self._batch_sharding = NamedSharding(mesh_now, P(DATA_AXIS))
         self._batch_cache.clear()   # cached batches live on the old mesh
         self._stacked_cache = None
         self._probe_pull = None     # probe programs target the old layout
-        if self.data.dataset_key is not None:
-            # Release this dataset's GLOBAL device buffers made unreachable
-            # by a layout change (their keys embed the old sharding sig) —
-            # otherwise up to the cache budget of HBM stays pinned on
-            # devices the job may have just released.
-            cur = progcache.sharding_signature(self._batch_sharding)
+        # memoized: _devcache_key needs it per batch, and the signature
+        # enumerates every mesh device
+        self._batch_sig = progcache.sharding_signature(self._batch_sharding)
+        cur_batch_sig = self._batch_sig
+        if (self.data.dataset_key is not None
+                and prev_batch_sig is not None
+                and prev_batch_sig != cur_batch_sig):
+            # An ACTUAL layout transition: release the global device buffers
+            # THIS worker cached under the departed layout — otherwise up to
+            # the cache budget of HBM stays pinned on devices the job may
+            # have just released. Only the departed signature is dropped
+            # (never "everything unlike mine"): another tenant's buffers
+            # under a different live layout must survive, and a dropped
+            # entry in concurrent use stays valid anyway (drops only forget
+            # the cache reference; device buffers are immutable).
             devcache.drop(
-                lambda k: k[0] == self.data.dataset_key and k[2] != cur
+                lambda k: k[0] == self.data.dataset_key
+                and k[2] == prev_batch_sig
             )
+        if (prev_key is not None and key != prev_key):
+            # Same for compiled programs: the departed layout's executables
+            # (out_shardings bound to possibly-released devices) can never
+            # hit again under the old key.
+            progcache.drop(lambda k: k[0] == prev_key)
+        self._built_once = True
 
     def _build_comm_probe(self) -> None:
         """Standalone PULL and PULL+PUSH(zero-delta) programs mirroring the
@@ -433,14 +464,19 @@ class WorkerTasklet:
         def timed(fn, *args) -> float:
             # min-of-3 after a warmup/compile dispatch: these programs run
             # sub-millisecond on small tables and the split comes from a
-            # SUBTRACTION, so single-shot jitter would routinely invert it
-            jax.block_until_ready(fn(*args))
-            best = float("inf")
-            for _ in range(3):
+            # SUBTRACTION, so single-shot jitter would routinely invert it.
+            # The global dispatch scope wraps each DISPATCH, not the whole
+            # loop — on async backends the wait happens outside the lock, so
+            # other tenants never stall behind a probe's round-trips.
+            def once() -> float:
                 t0 = time.perf_counter()
-                jax.block_until_ready(fn(*args))
-                best = min(best, time.perf_counter() - t0)
-            return best
+                with dispatch_scope(self.mesh) as fin:
+                    out = fin(fn(*args))
+                jax.block_until_ready(out)
+                return time.perf_counter() - t0
+
+            once()  # warmup/compile
+            return min(once() for _ in range(3))
 
         try:
             # Under the table lock: another worker's DONATING step must not
@@ -494,8 +530,7 @@ class WorkerTasklet:
         None unless the provider carries a data-source identity."""
         if self.data.dataset_key is None:
             return None
-        return (self.data.dataset_key, tag,
-                progcache.sharding_signature(self._batch_sharding))
+        return (self.data.dataset_key, tag, self._batch_sig)
 
     def _cached_batch(self, batch_idx: int, batch):
         """Device copy of one batch. The global cache (when the dataset has
@@ -695,19 +730,20 @@ class WorkerTasklet:
                         runs[-1].append(m)
                     else:
                         runs.append([m])
-                # The eager stacks DISPATCH under the table lock: they are
-                # multi-device programs (and can carry an implicit transfer
-                # when a metric landed with a different placement), and a
-                # dispatch racing other workers' step dispatches enqueues
-                # per-device work in divergent orders — on backends with
-                # in-process collectives that inverts a rendezvous and
-                # deadlocks. The lock is the global dispatch serializer; the
-                # D2H copies below stay outside.
+                # The eager stacks DISPATCH under the table lock AND the
+                # process-wide dispatch scope: they are multi-device
+                # programs (and can carry an implicit transfer when a metric
+                # landed with a different placement), and a dispatch racing
+                # ANY other job's dispatches enqueues per-device work in
+                # divergent orders — on backends with in-process collectives
+                # that inverts a rendezvous and aborts the process
+                # (parallel/dispatch.py). The D2H copies below stay outside.
                 with self.ctx.model_table._lock:
-                    stacked = {
-                        k: [jnp.stack([m[k] for m in r]) for r in runs]
-                        for k in pending[0]
-                    }
+                    with dispatch_scope(self.mesh) as finish:
+                        stacked = finish({
+                            k: [jnp.stack([m[k] for m in r]) for r in runs]
+                            for k in pending[0]
+                        })
                 host = {
                     k: np.concatenate([np.atleast_1d(np.asarray(s)) for s in v])
                     for k, v in stacked.items()

@@ -106,10 +106,11 @@ class DolphinJobEntity(JobEntity):
         user = self.config.user
 
         def tag(v):
-            # type-tagged (see Trainer.jit_signature: True == 1 == 1.0 must
-            # not collide — a data_fn can behave differently per type)
-            if isinstance(v, list):
-                return ("list", tuple(tag(x) for x in v))
+            # type-tagged recursively (see Trainer.jit_signature: True == 1
+            # == 1.0 must not collide — a data_fn can behave differently per
+            # type, and (1,) == (1.0,) collides the same way)
+            if isinstance(v, (list, tuple)):
+                return (type(v).__name__, tuple(tag(x) for x in v))
             return (type(v).__name__, v)
 
         try:

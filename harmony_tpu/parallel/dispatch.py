@@ -1,0 +1,78 @@
+"""Process-wide serializer for multi-device program dispatch.
+
+XLA's in-process collectives (the CPU backend's InProcessCommunicator)
+rendezvous per collective op across all participating devices, with each
+participant needing a live execution thread. Two concurrent multi-device
+programs can therefore kill the process two ways:
+
+  * enqueue-order inversion — job A enqueued first on device 0, job B
+    first on device 1: every device waits inside a different program's
+    collective;
+  * participant starvation — overlapping executions need more concurrent
+    participant threads than the host has (reproduced on a 1-core host:
+    three 8-device table steps in flight, rendezvous aborts the process
+    after its termination timeout, rendezvous.cc "Exiting to ensure a
+    consistent program state").
+
+The remedy is the insight the reference encodes as its
+GlobalTaskUnitScheduler (driver/impl/GlobalTaskUnitScheduler.java:29-36):
+concurrent jobs sharing executors need ONE GLOBAL ORDER of work units.
+There it removed per-executor divergence for fairness; here it is a
+correctness requirement. Every multi-device dispatch in the framework
+enters this scope:
+
+  * all backends: programs ENQUEUE atomically across their devices in one
+    process-wide order (fixes inversion; the lock is held microseconds);
+  * in-process-collective backends (cpu): the caller additionally BLOCKS
+    on the program inside the scope via the yielded ``finish`` hook, so at
+    most one multi-device program executes at a time (fixes starvation).
+    Real TPU queues execute in enqueue order with hardware collectives —
+    ``finish`` is the identity there and dispatch stays asynchronous.
+
+Single-device programs (no collectives, nothing to invert) skip the scope
+entirely — the flagship single-chip path pays nothing.
+
+Lock order convention: table lock(s) first, THEN this scope, and no other
+lock is ever taken inside it.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_LOCK = threading.RLock()
+
+
+def _identity(x):
+    return x
+
+
+def _mesh_info(mesh) -> "tuple[int, str]":
+    devs = mesh.devices.flat
+    first = next(iter(devs))
+    return mesh.devices.size, first.platform
+
+
+@contextlib.contextmanager
+def dispatch_scope(mesh):
+    """Enter the global enqueue-order scope for a program over ``mesh``.
+
+    Yields a ``finish`` hook the caller passes its dispatched outputs
+    through BEFORE leaving the scope: on in-process-collective backends it
+    blocks until ready (serializing execution), elsewhere it is the
+    identity (dispatch stays async).
+
+        with dispatch_scope(table.mesh) as finish:
+            out = finish(step(arr, batch))
+    """
+    n, platform = _mesh_info(mesh)
+    if n <= 1:
+        yield _identity
+        return
+    with _LOCK:
+        if platform == "cpu":
+            yield jax.block_until_ready
+        else:
+            yield _identity

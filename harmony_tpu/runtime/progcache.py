@@ -60,9 +60,15 @@ def sharding_signature(sharding) -> Tuple:
     return (mesh_signature(sharding.mesh), str(sharding.spec))
 
 
-def table_signature(table: Any) -> Optional[Tuple]:
+def table_signature(table: Any, sharding=None) -> Optional[Tuple]:
     """Structural identity of a table's traced ops, or None when the spec
-    carries behavior the config string cannot name (custom update fn)."""
+    carries behavior the config string cannot name (custom update fn).
+
+    ``sharding`` lets the caller pass a SNAPSHOT of the table's layout: a
+    live reshard can land between reading the layout for the key and
+    reading it again for jit out_shardings, and a key/executable layout
+    mismatch poisons the cache — callers that also compile must read the
+    sharding once and pass it here."""
     spec = table.spec
     if getattr(spec, "custom_update_fn", True):
         return None
@@ -79,30 +85,65 @@ def table_signature(table: Any) -> Optional[Tuple]:
         cfg.update_fn,
         getattr(spec, "max_probes", None),  # hash tables: probing depth is
                                             # constructor state, not config
-        sharding_signature(table.sharding),
+        sharding_signature(table.sharding if sharding is None else sharding),
     )
+
+
+_inflight: dict = {}
 
 
 def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Callable:
     """Return the cached callable for ``key``, building (and caching) on
-    miss. ``key=None`` bypasses the cache entirely."""
+    miss. ``key=None`` bypasses the cache entirely.
+
+    Concurrent misses on one key are deduplicated: the first caller builds,
+    the rest wait on its completion — a multi-worker job's N simultaneous
+    ``_build_step`` calls must compile once, not N times (on a
+    remote-attached chip each duplicate is a tunnel-crossing compile)."""
     if key is None:
         return build()
-    with _lock:
-        fn = _cache.get(key)
-        if fn is not None:
+    while True:
+        with _lock:
+            fn = _cache.get(key)
+            if fn is not None:
+                _cache.move_to_end(key)
+                _stats["hits"] += 1
+                return fn
+            ev = _inflight.get(key)
+            if ev is None:
+                ev = threading.Event()
+                _inflight[key] = ev
+                break  # this thread builds
+        ev.wait()
+        # builder finished (or failed): loop re-checks the cache; on builder
+        # failure the entry is absent and THIS thread takes over the build.
+    try:
+        # Build OUTSIDE the lock: tracing can be slow and may itself dispatch.
+        fn = build()
+        with _lock:
+            _stats["misses"] += 1
+            _cache[key] = fn
             _cache.move_to_end(key)
-            _stats["hits"] += 1
-            return fn
-    # Build OUTSIDE the lock: tracing can be slow and may itself dispatch.
-    fn = build()
+            while len(_cache) > _MAX_ENTRIES:
+                _cache.popitem(last=False)
+        return fn
+    finally:
+        with _lock:
+            _inflight.pop(key, None)
+        ev.set()
+
+
+def drop(predicate) -> int:
+    """Forget every entry whose key matches; returns the count. Used by the
+    reshard path: executables whose out_shardings bind released devices can
+    never hit again under their old key, and each holds device memory for
+    its constants. Dropping is always SAFE — workers keep direct references
+    to callables in use, so a drop only affects future lookups."""
     with _lock:
-        _stats["misses"] += 1
-        _cache[key] = fn
-        _cache.move_to_end(key)
-        while len(_cache) > _MAX_ENTRIES:
-            _cache.popitem(last=False)
-    return fn
+        stale = [k for k in _cache if predicate(k)]
+        for k in stale:
+            del _cache[k]
+        return len(stale)
 
 
 def stats() -> dict:

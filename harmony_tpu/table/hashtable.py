@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from harmony_tpu.config.params import TableConfig
+from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import MODEL_AXIS
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
@@ -483,7 +484,11 @@ class DeviceHashTable:
         steps see immutable snapshots; commits serialize)."""
         with self._lock:
             self._check()
-            new_state, out = step_fn(self._state, *args)
+            # Global dispatch scope: see parallel/dispatch.py (concurrent
+            # jobs' multi-device programs must enqueue in one process order,
+            # and execute one at a time on in-process-collective backends).
+            with dispatch_scope(self._mesh) as finish:
+                new_state, out = finish(step_fn(self._state, *args))
             self._state = self._rehome(new_state)
             return out
 
@@ -494,7 +499,14 @@ class DeviceHashTable:
     def _jitted(self, name: str, fn):
         with self._lock:
             if name not in self._jit_cache:
-                self._jit_cache[name] = jax.jit(fn)
+                jf = jax.jit(fn)
+                mesh = self._mesh
+
+                def wrapped(*args, _jf=jf, _mesh=mesh, **kw):
+                    with dispatch_scope(_mesh) as finish:
+                        return finish(_jf(*args, **kw))
+
+                self._jit_cache[name] = wrapped
             return self._jit_cache[name]
 
     # -- host op surface (ref: Table.java multiGet/multiUpdate/put) ------
@@ -590,6 +602,9 @@ class DeviceHashTable:
                 jax.device_put(self._state[0], self._ksh),
                 jax.device_put(self._state[1], self._vsh),
             )
+            # cached host-op wrappers pin the OLD mesh into their
+            # dispatch_scope decision (and their compiled layouts)
+            self._jit_cache.clear()
 
     def export_blocks(
         self, block_ids: Optional[Sequence[int]] = None

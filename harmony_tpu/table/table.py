@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from harmony_tpu.config.params import TableConfig
+from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import MODEL_AXIS
 from harmony_tpu.table.partition import (
     BlockPartitioner,
@@ -267,7 +268,8 @@ class DenseTable:
                 key,
                 lambda: jax.jit(spec.init_array, out_shardings=self._sharding),
             )
-            arr = init()
+            with dispatch_scope(mesh) as finish:
+                arr = finish(init())
         else:
             arr = jax.device_put(arr, self._sharding)
         self._arr: jax.Array = arr
@@ -332,7 +334,8 @@ class DenseTable:
             for t in tables:
                 stack.enter_context(t._lock)
             arrs = [t._step_state for t in tables]
-            new_arrs, aux = step_fn(*arrs, *extra)
+            with dispatch_scope(tables[0]._mesh) as finish:
+                new_arrs, aux = finish(step_fn(*arrs, *extra))
             for t, new in zip(tables, new_arrs):
                 t.commit(new)
         return aux
@@ -355,7 +358,13 @@ class DenseTable:
         device computation.
         """
         with self._lock:
-            new_arr, aux = step_fn(self._arr, *extra)
+            # Global enqueue-order scope: concurrent JOBS (each under its own
+            # table lock) must still enqueue multi-device programs in one
+            # process-wide order — and on in-process-collective backends
+            # execute one at a time — or the collective rendezvous aborts
+            # the process. See parallel/dispatch.py.
+            with dispatch_scope(self._mesh) as finish:
+                new_arr, aux = finish(step_fn(self._arr, *extra))
             self.commit(new_arr)  # RLock: re-homes if resharded mid-flight
         return aux
 
@@ -364,7 +373,17 @@ class DenseTable:
     def _jitted(self, name: str, fn: Callable) -> Callable:
         with self._lock:
             if name not in self._jit_cache:
-                self._jit_cache[name] = jax.jit(fn)
+                jf = jax.jit(fn)
+                mesh = self._mesh  # stable: cache cleared on reshard
+
+                def wrapped(*args, _jf=jf, _mesh=mesh, **kw):
+                    # host ops dispatch multi-device programs too (gathers/
+                    # all-gathers over the sharded storage): same global
+                    # dispatch rule as apply_step
+                    with dispatch_scope(_mesh) as finish:
+                        return finish(_jf(*args, **kw))
+
+                self._jit_cache[name] = wrapped
             return self._jit_cache[name]
 
     def multi_get(self, keys: Sequence[int]) -> np.ndarray:
@@ -468,11 +487,21 @@ class DenseTable:
         and their commit lands on the new layout via sharding constraint at
         next dispatch.
         """
+        from harmony_tpu.runtime import progcache
+
         with self._lock:
+            old_sig = (
+                None if self.spec.custom_update_fn
+                else progcache.table_signature(self)
+            )
             self._mesh = new_mesh
             self._sharding = self._make_sharding(new_mesh)
             self._arr = jax.device_put(self._arr, self._sharding)
             self._jit_cache.clear()
+            if old_sig is not None:
+                # The departed layout's init executable can never hit again
+                # under its old key; don't let it squat in the LRU.
+                progcache.drop(lambda k: k == (old_sig, "table_init"))
 
     # -- per-block IO (checkpoint path) ----------------------------------
 

@@ -21,6 +21,18 @@ import jax
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
+def mirror_env_platform_request() -> None:
+    """Honor a ``JAX_PLATFORMS=cpu`` environment request at the CONFIG level.
+
+    The axon register hook hijacks backend init even when JAX_PLATFORMS=cpu
+    is in the environment (and its client init hangs forever when the chip
+    transport is wedged); ``jax.config.update`` IS honored, so entry points
+    that want the env var to mean what it says call this right after
+    ``import jax``."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
 def device_is_tpu(d: jax.Device) -> bool:
     if d.platform in _TPU_PLATFORMS:
         return True

@@ -229,3 +229,39 @@ class TestJobServerResubmit:
         from harmony_tpu.data import devcache
         assert devcache.host_data.stats()["hits"] >= 1
         assert devcache.stats()["hits"] >= 1
+
+    def test_concurrent_identical_jobs_share_mesh(self):
+        """Concurrent jobs dispatching multi-device collective programs
+        used to abort the process (in-process rendezvous inversion/
+        starvation — parallel/dispatch.py); the global dispatch scope must
+        keep N simultaneous identical submissions alive."""
+        import dataclasses
+
+        from harmony_tpu.config.params import JobConfig
+        from harmony_tpu.jobserver.server import JobServer
+        from harmony_tpu.parallel.mesh import DevicePool
+
+        cfg = JobConfig(
+            job_id="cc-0", app_type="dolphin",
+            trainer="harmony_tpu.apps.mlr:MLRTrainer",
+            params=TrainerParams(
+                num_epochs=2, num_mini_batches=2,
+                app_params={"num_classes": 4, "num_features": 8,
+                            "features_per_partition": 4},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 16, "num_features": 8, "num_classes": 4}},
+        )
+        server = JobServer(num_executors=8,
+                           device_pool=DevicePool(jax.devices()))
+        server.start()
+        try:
+            futs = [
+                server.submit(dataclasses.replace(cfg, job_id=f"cc-{i}"))
+                for i in range(3)
+            ]
+            for f in futs:
+                f.result(timeout=300)
+        finally:
+            server.shutdown(timeout=60)
