@@ -30,6 +30,9 @@ run_stage "benchmarks/MICRO_${SUF}.json" python benchmarks/micro.py all
 echo "== flagship LM train step (benchmarks/lm.py)"
 run_stage "benchmarks/LM_${SUF}.json" python benchmarks/lm.py train
 
+echo "== headline overhead profile (benchmarks/profile_headline.py)"
+run_stage "benchmarks/PROFILE_${SUF}.json" python benchmarks/profile_headline.py primitives
+
 echo "== single-chip compile check (__graft_entry__.entry)"
 python - <<'EOF'
 import json, time

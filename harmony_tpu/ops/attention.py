@@ -164,6 +164,23 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l), lse_ref.shape[1:])
 
 
+def _out_struct(shape, dtype, *refs):
+    """ShapeDtypeStruct whose varying-manual-axes (vma) is the union of the
+    reference arrays' — required when a pallas_call runs INSIDE shard_map
+    (the ring-attention inner): outputs vary over every axis an input
+    does."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # older jax: no vma concept, no vma check either
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = frozenset()
+    for r in refs:
+        vma = vma | getattr(typeof(r), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -194,8 +211,8 @@ def _flash_forward(q, k, v, causal, block_q, block_k, scale, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq, _LANES), jnp.float32),
+            _out_struct((B * H, Sq, D), q.dtype, q, k, v),
+            _out_struct((B * H, Sq, _LANES), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             _vmem((block_q, 128)),   # running row-max m
@@ -284,10 +301,15 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
-                    interpret):
+                    interpret, lse_cotangent=None):
     """Fused flash backward: dK/dV kernel (grid over kv tiles) + dQ kernel
     (grid over q tiles); softmax recomputed per tile from the saved LSE —
-    the O(S) memory trade the forward made, carried into the backward."""
+    the O(S) memory trade the forward made, carried into the backward.
+
+    ``lse_cotangent`` supports callers that consume the LSE output (the
+    ring-attention chunk merge): d lse_r / d s_rc = p_rc, so the extra term
+    is ``g_lse_r * p_rc`` — algebraically it folds into the delta:
+    ds = p * (dp - (delta - g_lse)). The kernels are unchanged."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
@@ -303,6 +325,8 @@ def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
     # delta_i = dO_i . O_i (rowwise), cheap enough to leave to XLA.
     delta = jnp.einsum("bsd,bsd->bs", dof.astype(jnp.float32),
                        out.reshape(B * H, Sq, D).astype(jnp.float32))
+    if lse_cotangent is not None:
+        delta = delta - lse_cotangent.reshape(B * H, Sq).astype(jnp.float32)
     delta = jnp.broadcast_to(delta[:, :, None], (B * H, Sq, _LANES))
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
@@ -320,8 +344,8 @@ def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+            _out_struct((B * H, Sk, D), k.dtype, q, k, v, do),
+            _out_struct((B * H, Sk, D), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[_vmem((block_k, D)), _vmem((block_k, D))],
         interpret=interpret,
@@ -338,7 +362,7 @@ def _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k, scale,
         grid=(B * H, Sq // block_q, Sk // block_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_shape=_out_struct((B * H, Sq, D), q.dtype, q, k, v, do),
         scratch_shapes=[_vmem((block_q, D))],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)
@@ -353,7 +377,6 @@ def _vmem(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -367,23 +390,48 @@ def flash_attention(
     """Fused attention. Forward AND backward are Pallas kernels (interpreter
     off-TPU/tests): the forward saves only O(S) softmax statistics (LSE) and
     the backward recomputes each softmax tile from them — flash attention's
-    memory/FLOPs trade in both directions."""
-    scale, interp = _resolve_defaults(q, scale, interpret)
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
+    memory/FLOPs trade in both directions.
+
+    Thin wrapper over :func:`flash_attention_lse` (the kernel always writes
+    the LSE output; discarding it costs nothing, and a zero LSE cotangent
+    folds to the identical backward) — ONE custom_vjp to maintain."""
+    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k, scale,
+                                 interpret)
     return out
 
 
-def _fa_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """:func:`flash_attention` that ALSO returns the per-row log-sum-exp
+    ([B, H, Sq], fp32) — the composable form: outputs of independent KV
+    chunks merge exactly via their LSEs (``ring_attention``'s flash inner).
+    Differentiable in both outputs; the LSE cotangent folds into the
+    backward kernels' delta term (see ``_flash_backward``)."""
+    scale, interp = _resolve_defaults(q, scale, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
+
+
+def _fa_lse_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
     scale, interp = _resolve_defaults(q, scale, interpret)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, block_q, block_k, scale, interpret, res, g):
+def _fa_lse_bwd(causal, block_q, block_k, scale, interpret, res, g):
     q, k, v, out, lse = res
+    g_out, g_lse = g
     scale, interp = _resolve_defaults(q, scale, interpret)
-    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
-                           scale, interp)
+    return _flash_backward(q, k, v, out, lse, g_out, causal, block_q, block_k,
+                           scale, interp, lse_cotangent=g_lse)
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+flash_attention_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)

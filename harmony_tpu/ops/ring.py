@@ -29,6 +29,20 @@ from jax.sharding import PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _resolve_inner(inner: str) -> str:
+    # "auto" currently resolves to the einsum fold everywhere: the flash
+    # inner is exact (tested in interpret mode with check_vma=False — the
+    # pallas HLO interpreter's internal slices trip shard_map's vma checker,
+    # a jax interpreter limitation) but its COMPILED Mosaic-under-shard_map
+    # path has not yet run on a real chip. Flip to flash-on-TPU once a chip
+    # capture validates it; callers can opt in explicitly meanwhile.
+    if inner == "auto":
+        return "einsum"
+    if inner not in ("flash", "einsum"):
+        raise ValueError(f"unknown ring inner {inner!r}")
+    return inner
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -36,20 +50,39 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    inner: str = "auto",
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     q/k/v: LOCAL shards [B, H, S_local, D] (call inside shard_map).
     Returns the local output shard [B, H, S_local, D].
+
+    ``inner`` picks how each visiting chunk is folded:
+      * "flash"  — the Pallas flash kernel per chunk (scores stay in VMEM;
+        MXU matmuls), merged exactly across chunks via per-row LSE
+        (flash_attention_lse). Causal rings lax.switch three chunk
+        relations — full / diagonal / SKIP — so fully-masked chunks cost
+        nothing (the einsum inner computes-then-masks them).
+      * "einsum" — the original streaming-softmax fold (any backend, any
+        shape).
+      * "auto"   — currently "einsum" everywhere: the flash inner is
+        validated exact in interpret mode, but its compiled
+        Mosaic-under-shard_map path hasn't run on a chip yet (see
+        _resolve_inner); it will become flash-on-TPU once that capture
+        lands.
     """
     B, H, S, D = q.shape
     scale = scale if scale is not None else D ** -0.5
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    inner = _resolve_inner(inner)
 
     qf = q.astype(jnp.float32) * scale
     q_pos = my * S + jnp.arange(S)[:, None]            # global q positions
+
+    if inner == "flash":
+        return _ring_flash(qf, k, v, axis_name, causal, n, my, perm, q.dtype)
 
     def fold(acc, m, l, kb, vb, src):
         """Merge one visiting KV chunk (home shard ``src``) into the online
@@ -92,6 +125,73 @@ def ring_attention(
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+def _ring_flash(qf, k, v, axis_name, causal, n, my, perm, out_dtype):
+    """Flash-inner ring: each visiting chunk through the Pallas kernel
+    (out_t, lse_t), merged via the numerically-safe LSE running max.
+
+    qf is pre-scaled fp32 (the kernel is called with scale=1). The merge
+    carries (num, m, den): num = unnormalized output in the running frame
+    m, den = normalizer. A skipped chunk contributes lse=-inf and weight
+    exactly 0 (guarded — exp(-inf - -inf) would be 1)."""
+    from harmony_tpu.ops.attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        flash_attention_lse,
+    )
+
+    # positional args: custom_vjp + nondiff_argnums and keywords don't mix
+    def full(args):
+        q_, k_, v_ = args
+        return flash_attention_lse(q_, k_, v_, False, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, 1.0)
+
+    def diag(args):
+        q_, k_, v_ = args
+        return flash_attention_lse(q_, k_, v_, True, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, 1.0)
+
+    def skip(args):
+        q_, _, _ = args
+        return (jnp.zeros_like(q_),
+                jnp.full(q_.shape[:-1], _NEG_INF, jnp.float32))
+
+    def fold(num, m, den, kb, vb, src):
+        if causal:
+            rel = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_t, lse_t = lax.switch(
+                rel, (full, diag, skip),
+                (qf, kb.astype(jnp.float32), vb.astype(jnp.float32)),
+            )
+        else:
+            o_t, lse_t = full(
+                (qf, kb.astype(jnp.float32), vb.astype(jnp.float32))
+            )
+        m_new = jnp.maximum(m, lse_t)
+        # exp(x - m_new) with BOTH at the finite floor must be 0, not 1:
+        # a skipped/empty chunk carries no weight.
+        c_prev = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        c_new = jnp.where(lse_t <= _NEG_INF / 2, 0.0, jnp.exp(lse_t - m_new))
+        num_new = num * c_prev[..., None] + o_t * c_new[..., None]
+        den_new = den * c_prev + c_new
+        return num_new, m_new, den_new
+
+    def step(carry, t):
+        num, m, den, kb, vb = carry
+        num, m, den = fold(num, m, den, kb, vb, (my - t) % n)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (num, m, den, kb, vb), None
+
+    num0 = jnp.zeros_like(qf)
+    m0 = jnp.full_like(qf[..., 0], _NEG_INF)
+    den0 = jnp.zeros_like(qf[..., 0])
+    (num, m, den, kb, vb), _ = lax.scan(
+        jax.checkpoint(step), (num0, m0, den0, k, v), jnp.arange(n - 1)
+    )
+    num, _, den = fold(num, m, den, kb, vb, (my - (n - 1)) % n)
+    return (num / jnp.maximum(den, 1e-30)[..., None]).astype(out_dtype)
+
+
 def ring_self_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -100,12 +200,20 @@ def ring_self_attention(
     seq_axis: str,
     batch_axis: Optional[str] = None,
     causal: bool = False,
+    inner: str = "auto",
+    check_vma: bool = True,
 ) -> jnp.ndarray:
     """Host-level wrapper: shard [B,H,S,D] inputs over ``mesh`` with the
     sequence dim on ``seq_axis`` (and optionally batch on ``batch_axis``),
-    run :func:`ring_attention` under shard_map."""
+    run :func:`ring_attention` under shard_map.
+
+    ``check_vma=False`` is needed to run the flash inner in INTERPRET mode
+    (off-TPU tests): the pallas HLO interpreter's internal slicing trips
+    shard_map's varying-axes checker."""
     spec = P(batch_axis, None, seq_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                           inner=inner)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=check_vma,
     )(q, k, v)

@@ -127,6 +127,73 @@ def test_ring_attention_gradients(devices):
         np.testing.assert_allclose(np.asarray(a), b, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_inner_matches_einsum(devices, causal):
+    """The Pallas flash inner (per-chunk kernel + LSE merge) must be exact
+    against the einsum fold — forward AND all three gradients (the LSE
+    cotangent folds into the backward kernels' delta term).
+    check_vma=False: the pallas HLO interpreter trips shard_map's vma
+    checker off-TPU (jax interpreter limitation)."""
+    mesh = build_mesh(devices, data=2, seq=4, model=1)
+    q, k, v = _qkv(B=2, H=2, S=64, D=16, seed=7)
+    kw = dict(batch_axis="data", causal=causal)
+    o_e = ring_self_attention(q, k, v, mesh, seq_axis="seq",
+                              inner="einsum", **kw)
+    o_f = ring_self_attention(q, k, v, mesh, seq_axis="seq",
+                              inner="flash", check_vma=False, **kw)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_e), atol=2e-5)
+
+    def loss_e(q, k, v):
+        return (ring_self_attention(q, k, v, mesh, seq_axis="seq",
+                                    inner="einsum", **kw) ** 2).sum()
+
+    def loss_f(q, k, v):
+        return (ring_self_attention(q, k, v, mesh, seq_axis="seq",
+                                    inner="flash", check_vma=False,
+                                    **kw) ** 2).sum()
+
+    ge = jax.grad(loss_e, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ge, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_attention_lse_matches_reference():
+    """flash_attention_lse: the LSE output must equal the row logsumexp of
+    the scaled scores, and gradients through BOTH outputs must match the
+    direct computation."""
+    from harmony_tpu.ops.attention import flash_attention_lse
+
+    q, k, v = _qkv(B=1, H=2, S=32, D=8, seed=9)
+    scale = q.shape[-1] ** -0.5
+    out, lse = jax.jit(
+        lambda q, k, v: flash_attention_lse(q, k, v, True)
+    )(q, k, v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k).astype(jnp.float32)
+    mask = jnp.tril(jnp.ones((32, 32), bool))
+    s = jnp.where(mask, s, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=1e-4)
+
+    def loss_flash(q, k, v):
+        o, l = flash_attention_lse(q, k, v, True)
+        return (o.astype(jnp.float32) ** 2).sum() + (l * 0.1).sum()
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k).astype(jnp.float32)
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        l = jax.scipy.special.logsumexp(s, axis=-1)
+        return (o ** 2).sum() + (l * 0.1).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
 def test_weighted_histogram_bins_tiling():
     """num_bins > block_bins exercises the VMEM-bounded tiled grid."""
     rng = np.random.default_rng(7)
