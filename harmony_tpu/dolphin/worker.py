@@ -738,16 +738,48 @@ class WorkerTasklet:
                 # divergent orders — on backends with in-process collectives
                 # that inverts a rendezvous and aborts the process
                 # (parallel/dispatch.py). The D2H copies below stay outside.
+                combined = None
                 with self.ctx.model_table._lock:
                     with dispatch_scope(self.mesh) as finish:
                         stacked = finish({
                             k: [jnp.stack([m[k] for m in r]) for r in runs]
                             for k in pending[0]
                         })
-                host = {
-                    k: np.concatenate([np.atleast_1d(np.asarray(s)) for s in v])
-                    for k, v in stacked.items()
-                }
+                        if len(runs) == 1:
+                            # Fold ALL same-dtype keys into one array so the
+                            # epoch drain is ONE device->host transfer per
+                            # dtype, not one per key — on a remote-attached
+                            # chip each transfer is a full network
+                            # round-trip. (Multi-run epochs — a mid-epoch
+                            # reshard — keep the per-key path.)
+                            keys = sorted(stacked)
+                            groups: Dict[Any, List[str]] = {}
+                            for k in keys:
+                                # sharding in the key: sibling metrics may
+                                # land on different device sets, and one
+                                # eager stack over non-colocated arrays
+                                # raises at dispatch
+                                sig = (stacked[k][0].dtype,
+                                       stacked[k][0].shape,
+                                       stacked[k][0].sharding)
+                                groups.setdefault(sig, []).append(k)
+                            combined = {
+                                dt: (ks, finish(jnp.stack(
+                                    [stacked[k][0] for k in ks])))
+                                for dt, ks in groups.items()
+                            }
+                if combined is not None:
+                    host = {}
+                    for ks, arr in combined.values():
+                        mat = np.asarray(arr)          # one D2H per dtype
+                        for i, k in enumerate(ks):
+                            host[k] = np.atleast_1d(mat[i])
+                else:
+                    host = {
+                        k: np.concatenate(
+                            [np.atleast_1d(np.asarray(s)) for s in v])
+                        for k, v in stacked.items()
+                    }
             work_t += time.perf_counter() - t0
             # Async dispatch makes true per-batch device time unobservable
             # without per-step syncs; smear the epoch's work time (barrier
