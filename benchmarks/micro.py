@@ -25,11 +25,14 @@ measures (b) plus the other primitives a capacity-planning reader needs:
   mxupush    the size-gated MXU duplicate-fold push route (one-hot matmul
              fold, table/table.py) vs the scatter route — GB/s both ways
              plus the fold's achieved FLOP/s.
+  ringflash  the ring-attention flash inner compiled under shard_map —
+             correctness + speed vs the einsum inner (gates flipping
+             ring_attention's inner='auto' to flash-on-TPU).
 
 Attention also reports achieved FLOP/s + MFU. MFU is null off-TPU (no
 meaningful peak). Run on the real chip and commit the JSON.
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|all]
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -157,6 +160,54 @@ def bench_attention() -> dict:
 _mfu = mfu
 
 
+def bench_ringflash() -> dict:
+    """The ring-attention flash inner, COMPILED under shard_map.
+
+    ring.py's inner='auto' stays on the einsum fold until this section has
+    run green on a real chip (interpret mode is validated in tests; the
+    compiled Mosaic-under-shard_map path is the open question). Runs on
+    however many devices are visible — on the single chip it exercises the
+    1-device ring (the kernel-under-shard_map mechanics without ppermute);
+    on a virtual mesh it exercises the full rotation. Reports correctness
+    vs the einsum inner plus both times."""
+    from harmony_tpu.ops.ring import ring_self_attention
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = build_mesh(devs, data=1, seq=n, model=1)
+    b, h, d = 2, 4, 64
+    s_loc = 512
+    s = s_loc * n
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(k2, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(k3, (b, h, s, d), jnp.float32)
+
+    from harmony_tpu.utils.platform import tpu_backend
+    vma_kw = {} if tpu_backend() else {"check_vma": False}
+    flash_fn = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh, seq_axis="seq", causal=True, inner="flash", **vma_kw))
+    einsum_fn = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh, seq_axis="seq", causal=True, inner="einsum"))
+    try:
+        # one jitted fn each serves correctness AND timing (its compile is
+        # the timing warmup — the interpret-mode flash path is expensive)
+        err = float(jnp.abs(flash_fn(q, k, v).astype(jnp.float32)
+                            - einsum_fn(q, k, v).astype(jnp.float32)).max())
+        t_f = _time(flash_fn, q, k, v)
+        t_e = _time(einsum_fn, q, k, v)
+    except Exception as e:  # a red section must still be a JSON line
+        return {"metric": "ring flash inner (compiled shard_map)",
+                "value": None, "unit": "x vs einsum inner",
+                "devices": n, "seq": s,
+                "error": f"{type(e).__name__}: {e}"[:400]}
+    return {"metric": "ring flash inner (compiled shard_map)",
+            "value": round(t_e / t_f, 2), "unit": "x vs einsum inner",
+            "devices": n, "seq": s, "max_abs_err": err,
+            "flash_ms": round(t_f * 1e3, 1), "einsum_ms": round(t_e * 1e3, 1),
+            "ok": err < 5e-3}
+
+
 def bench_mxu() -> dict:
     """Dense bf16 matmul MFU — the roofline every MXU op is judged by."""
     n = 4096
@@ -279,10 +330,12 @@ SECTIONS = {
     "sparse": bench_sparse,
     "mxu": bench_mxu,
     "mxupush": bench_mxupush,
+    "ringflash": bench_ringflash,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
 SECTION_METRICS = {
+    "ringflash": ("ring flash inner (compiled shard_map)", "x vs einsum inner"),
     "table": ("table pull+push bandwidth", "GB/s"),
     "reshard": ("reshard bandwidth", "GB/s"),
     "attention": ("flash attention speedup vs naive", "x"),

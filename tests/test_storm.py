@@ -76,14 +76,27 @@ def test_concurrent_heterogeneous_job_storm():
     progcache.clear()
     devcache.clear()
     devcache.host_data.clear()
-    server = JobServer(num_executors=8,
+    # 6 executors over an 8-device pool: the spare capacity is what the
+    # storm's add-one-server job grows into mid-flight
+    server = JobServer(num_executors=6,
                        device_pool=DevicePool(jax.devices()))
     server.start()
     try:
         wave1 = [_mlr("s-mlr-a"), _mlr("s-mlr-b"), _nmf("s-nmf-a"),
                  _fm("s-fm-a"), _mlr("s-mlr-c"), _nmf("s-nmf-b")]
-        futs = [server.submit(c) for c in wave1]
+        # live migration IN the storm: one longer MLR job carries the
+        # canned add-one-server optimizer (the reference's SampleOptimizers
+        # forced-reconfiguration pattern), so a reshard lands while the
+        # other tenants train
+        mig = _mlr("s-mlr-mig")
+        mig = dataclasses.replace(
+            mig, optimizer="add_one_server", optimizer_period=0.2,
+            params=dataclasses.replace(mig.params, num_epochs=6),
+        )
+        futs = [server.submit(c) for c in wave1] + [server.submit(mig)]
+        mig_result = futs.pop().result(timeout=600)
         results = [f.result(timeout=600) for f in futs]
+        assert mig_result.get("reconfigs", 0) >= 1, mig_result
         # resubmit wave: identical configs under fresh ids
         wave2 = [dataclasses.replace(c, job_id=c.job_id + "-r") for c in wave1]
         futs2 = [server.submit(c) for c in wave2]
