@@ -6,16 +6,40 @@ import time
 import jax
 
 
-def timed(fn, *args, repeats: int = 10) -> float:
-    """Mean wall time per call after a warmup/compile dispatch (which also
-    drains the device queue)."""
-    jax.block_until_ready(fn(*args))
+def timed_chain(step, state, repeats: int = 10):
+    """Mean wall time per iteration of ``state = step(state)``.
+
+    The data dependency between iterations makes every one of them part of
+    the final state's graph, so the closing hard_sync provably covers the
+    whole loop even on a lazy-dispatch backend that evaluates only the
+    demanded subgraph (and it avoids per-call re-upload of unchanged
+    operands, which such clients charge to independent calls). Returns
+    (seconds_per_iter, final_state)."""
+    from harmony_tpu.utils.platform import hard_sync
+
+    state = step(state)  # warmup: compile + first execution
+    hard_sync(state)
     t0 = time.perf_counter()
-    out = None
     for _ in range(repeats):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / repeats
+        state = step(state)
+    hard_sync(state)
+    return (time.perf_counter() - t0) / repeats, state
+
+
+def timed_inner(body, state, inner: int = 32, outer: int = 3):
+    """Per-iteration time of ``state = body(state)`` with ``inner``
+    iterations folded into ONE compiled program (lax.fori_loop).
+
+    On a remote-attached chip every program execution pays a tunnel round
+    trip of tens of ms; a sub-ms program timed across dispatches measures
+    the tunnel, not the chip. Folding the loop into the program amortizes
+    that overhead to noise while the data dependency keeps the timing
+    honest. Returns (seconds_per_inner_iter, final_state)."""
+    prog = jax.jit(
+        lambda s: jax.lax.fori_loop(0, inner, lambda i, t: body(t), s)
+    )
+    dt, state = timed_chain(prog, state, repeats=outer)
+    return dt / inner, state
 
 
 def mfu(achieved_flops: float):

@@ -37,11 +37,12 @@ import numpy as np
 
 from harmony_tpu.utils.devices import discover_devices
 
-from common import mfu as _mfu, timed  # noqa: E402 (shared helpers)
+from common import mfu as _mfu, timed_chain  # noqa: E402 (shared helpers)
 
 
-def _time(fn, *args):
-    return timed(fn, *args, repeats=5)
+def _time_chain(step, state):
+    dt, _ = timed_chain(step, state, repeats=5)
+    return dt
 
 
 def _param_count(params) -> int:
@@ -83,22 +84,28 @@ def bench_train() -> dict:
     batch = 8 if on_tpu else 2
     tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
 
-    @jax.jit
-    def step(p, t):
-        loss, grads = jax.value_and_grad(model.loss)(p, t)
-        new = jax.tree.map(lambda w, g: w - 0.1 * g.astype(w.dtype), p, grads)
-        return new, loss
+    def raw_step(p):
+        loss, grads = jax.value_and_grad(model.loss)(p, tokens)
+        return jax.tree.map(lambda w, g: w - 0.1 * g.astype(w.dtype),
+                            p, grads)
 
     # Stderr markers: on a remote-attached chip a big compile can take
     # minutes and a wedged transport hangs forever — make which one it was
-    # visible in the capture log instead of an opaque stall.
+    # visible in the capture log instead of an opaque stall. The ONE
+    # compile is the timed program itself (timed_inner's warmup): an
+    # n-step fori_loop chaining the params — exactly how training runs,
+    # and the dependency chain is what makes the timing honest on lazy
+    # backends while the fold amortizes the remote-attach per-program
+    # round trip to noise.
+    from common import timed_inner
+
     print(f"lm train: compiling (params={_param_count(params)/1e6:.1f}M, "
           f"seq={cfg.max_seq}, batch={batch})...", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    jax.block_until_ready(step(params, tokens)[1])
-    print(f"lm train: compiled+first step in {time.perf_counter() - t0:.1f}s",
+    dt, _ = timed_inner(raw_step, params, inner=8 if on_tpu else 1,
+                        outer=3)
+    print(f"lm train: compiled+timed in {time.perf_counter() - t0:.1f}s",
           file=sys.stderr, flush=True)
-    dt = _time(lambda p, t: step(p, t)[1], params, tokens)
     n_tok = batch * cfg.max_seq
     n_params = _param_count(params)
     flops = _train_flops(n_params, n_tok, cfg)
@@ -133,7 +140,7 @@ def bench_sp() -> dict:
     batch = (2 if on_tpu else 1) * data_ax
     tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
     step = make_sp_train_step(model, mesh, learning_rate=0.1, donate=False)
-    dt = _time(lambda p, t: step(p, t)[1], params, tokens)
+    dt = _time_chain(lambda p: step(p, tokens)[0], params)
     n_tok = batch * cfg.max_seq
     out = {"metric": "lm sp train step", "value": round(n_tok / dt),
            "unit": "tokens/sec", "seq": cfg.max_seq, "batch": batch,
@@ -159,7 +166,9 @@ def bench_decode() -> dict:
     num_new = (cfg.max_seq - prompt_len) // 2
     prompt = jnp.asarray(make_lm_data(batch, prompt_len, cfg.vocab_size))
     gen = make_generate_fn(model, prompt_len, num_new)
-    dt = _time(gen, params, prompt)
+    # chain: the next iteration's prompt is a slice of this one's output
+    # (valid token ids, same shape) — keeps the loop in one device graph
+    dt = _time_chain(lambda pr: gen(params, pr)[:, :prompt_len], prompt)
     # the prefill is per-token decode steps too, so the honest per-token
     # rate divides by ALL steps executed — not just the sampled ones
     # (num_new-only would skew with the prompt/continuation split)

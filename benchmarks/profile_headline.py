@@ -52,18 +52,24 @@ def _t(fn, repeats=10, warmup=1):
 
 
 def bench_primitives() -> dict:
+    from harmony_tpu.utils.platform import hard_sync
+
     dev = jax.devices()[0]
     one = jax.device_put(jnp.float32(1.0), dev)
     add = jax.jit(lambda x: x + 1.0)
-    jax.block_until_ready(add(one))
-    rtt_best, rtt_mean = _t(lambda: jax.block_until_ready(add(one)))
+    float(add(one))
+    # dispatch_only: enqueue + (possibly fake) block — the per-op host
+    # overhead. rtt: dispatch + VALUE read — the true round trip; on a
+    # lazy backend (axon) only the latter includes execution.
+    disp_best, _ = _t(lambda: jax.block_until_ready(add(one)))
+    rtt_best, rtt_mean = _t(lambda: float(add(one)))
 
     arr = jax.device_put(jnp.zeros((256, 256), jnp.float32), dev)
     d2h_best, d2h_mean = _t(lambda: np.asarray(arr))
 
     big = np.zeros((64, 1024, 1024), np.float32)  # 256 MB
     h2d_best, _ = _t(
-        lambda: jax.block_until_ready(jax.device_put(big, dev)),
+        lambda: hard_sync(jax.device_put(big, dev)),
         repeats=3, warmup=1,
     )
     h2d_gbps = big.nbytes / h2d_best / 1e9
@@ -76,7 +82,7 @@ def bench_primitives() -> dict:
 
     def fresh():
         f = jax.jit(lambda a: (a @ a).sum())
-        jax.block_until_ready(f(x))
+        hard_sync(f(x))
 
     t0 = time.perf_counter()
     fresh()
@@ -88,6 +94,7 @@ def bench_primitives() -> dict:
     return {
         "metric": "headline primitives",
         "device": str(dev),
+        "dispatch_only_ms": round(disp_best * 1e3, 2),
         "dispatch_rtt_ms": round(rtt_best * 1e3, 2),
         "dispatch_rtt_mean_ms": round(rtt_mean * 1e3, 2),
         "d2h_small_ms": round(d2h_best * 1e3, 2),

@@ -45,13 +45,14 @@ except RuntimeError as e:
     raise SystemExit(0)
 import jax
 import __graft_entry__ as g
+from harmony_tpu.utils.platform import hard_sync
 fn, args = g.entry()
 jfn = jax.jit(fn)  # ONE wrapper: a second jax.jit(fn) would recompile
 t0 = time.perf_counter()
-jax.block_until_ready(jfn(*args))
+hard_sync(jfn(*args))  # block_until_ready lies on the lazy axon backend
 compile_s = time.perf_counter() - t0
 t0 = time.perf_counter()
-jax.block_until_ready(jfn(*args))
+hard_sync(jfn(*args))
 print(json.dumps({"metric": "entry forward", "device": str(devs[0]),
                   "compile_sec": round(compile_s, 1),
                   "step_ms": round((time.perf_counter() - t0) * 1e3, 2)}))

@@ -46,6 +46,7 @@ from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
 from harmony_tpu.tracing import trace_span
+from harmony_tpu.utils.platform import hard_sync
 
 
 class WorkerTasklet:
@@ -472,7 +473,10 @@ class WorkerTasklet:
                 t0 = time.perf_counter()
                 with dispatch_scope(self.mesh) as fin:
                     out = fin(fn(*args))
-                jax.block_until_ready(out)
+                # hard_sync, not block_until_ready: on the lazy axon
+                # backend the latter is a no-op and the measured split
+                # would be pure dispatch noise
+                hard_sync(out)
                 return time.perf_counter() - t0
 
             once()  # warmup/compile
@@ -709,8 +713,10 @@ class WorkerTasklet:
             if len(pending) >= self.MAX_INFLIGHT:
                 # Sliding window: block on the OLDEST outstanding step so the
                 # device queue stays full (blocking on the newest would drain
-                # it and idle the chip for a host round-trip).
-                jax.block_until_ready(pending[len(pending) - self.MAX_INFLIGHT])
+                # it and idle the chip for a host round-trip). hard_sync so a
+                # lazy backend actually applies backpressure instead of
+                # acking and letting in-flight work grow without bound.
+                hard_sync(pending[len(pending) - self.MAX_INFLIGHT])
             work_t += time.perf_counter() - t0
             batch_sizes.append(batch[0].shape[0])
             epoch_examples += batch[0].shape[0]
@@ -875,7 +881,10 @@ class WorkerTasklet:
                 f"table resharded {self.MAX_RESHARD_RETRIES}x during one "
                 "epoch dispatch; reconfiguration is outpacing training"
             )
-        jax.block_until_ready(stacked_metrics)
+        # hard_sync BEFORE the timer stops: the per-batch times fed to the
+        # optimizer must include device execution, and on a lazy backend
+        # block_until_ready would stop the clock at dispatch
+        hard_sync(stacked_metrics)
         dt = time.perf_counter() - t0
         nb = self.data.num_mini_batches
         host_metrics = {

@@ -32,9 +32,9 @@ class Tracer:
             raise RuntimeError("record() without start()")
         if block_on is not None:
             try:
-                import jax
+                from harmony_tpu.utils.platform import hard_sync
 
-                jax.block_until_ready(block_on)
+                hard_sync(block_on)  # a real sync even on lazy backends
             except ImportError:  # pragma: no cover
                 pass
         dt = time.perf_counter() - self._t0

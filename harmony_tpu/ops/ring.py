@@ -30,14 +30,27 @@ _NEG_INF = -1e30
 
 
 def _resolve_inner(inner: str) -> str:
-    # "auto" currently resolves to the einsum fold everywhere: the flash
-    # inner is exact (tested in interpret mode with check_vma=False — the
-    # pallas HLO interpreter's internal slices trip shard_map's vma checker,
-    # a jax interpreter limitation) but its COMPILED Mosaic-under-shard_map
-    # path has not yet run on a real chip. Flip to flash-on-TPU once a chip
-    # capture validates it; callers can opt in explicitly meanwhile.
+    # "auto" = flash on TPU, einsum elsewhere. Validation status: the
+    # multi-device ring rotation is exact in interpret mode (CPU mesh
+    # tests) and the compiled Mosaic-kernel-under-shard_map path is exact
+    # on a real chip (benchmarks/micro.py ringflash, r02 capture: ok=true,
+    # max_abs_err 7.5e-4, 1.2x vs einsum) — but that capture ran on ONE
+    # chip, so the compiled-kernel-PLUS-rotation composition has not yet
+    # executed on multi-chip hardware (none attached here). Failures in
+    # that composition are loud (Mosaic compile/vma errors, like the one
+    # the skip-branch fix addressed), and HARMONY_RING_INNER=einsum gives
+    # operators a one-var rollback without touching call sites. Off-TPU
+    # the kernel would run in interpret mode (orders of magnitude
+    # slower), so einsum stays the fallback there.
     if inner == "auto":
-        return "einsum"
+        import os
+
+        from harmony_tpu.utils.platform import tpu_backend
+
+        forced = os.environ.get("HARMONY_RING_INNER")
+        if forced in ("flash", "einsum"):
+            return forced
+        return "flash" if tpu_backend() else "einsum"
     if inner not in ("flash", "einsum"):
         raise ValueError(f"unknown ring inner {inner!r}")
     return inner
@@ -152,8 +165,11 @@ def _ring_flash(qf, k, v, axis_name, causal, n, my, perm, out_dtype):
 
     def skip(args):
         q_, _, _ = args
+        # full_like, not full: both outputs must inherit q_'s varying
+        # manual axes or lax.switch rejects the branches under shard_map
+        # (a fresh constant is axis-invariant; the kernel outputs vary)
         return (jnp.zeros_like(q_),
-                jnp.full(q_.shape[:-1], _NEG_INF, jnp.float32))
+                jnp.full_like(q_[..., 0], _NEG_INF, dtype=jnp.float32))
 
     def fold(num, m, den, kb, vb, src):
         if causal:

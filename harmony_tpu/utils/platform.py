@@ -33,6 +33,72 @@ def mirror_env_platform_request() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+_LAZY_CACHE: Optional[bool] = None
+
+
+def lazy_dispatch_backend() -> bool:
+    """True when the active backend ACKS readiness without executing.
+
+    The experimental axon remote client defers enqueued work and returns
+    from ``jax.block_until_ready`` immediately — only demanding a VALUE on
+    the host forces execution (measured on-chip: a blocked timing loop of
+    8k bf16 matmuls implied 49,000 TFLOP/s on a 197-TFLOP/s chip; the
+    drain-by-read timing gave 88). Every timing or backpressure site must
+    go through :func:`hard_sync` instead of ``block_until_ready``."""
+    global _LAZY_CACHE
+    if _LAZY_CACHE is None:
+        try:
+            d = jax.devices()[0]
+            ver = str(getattr(d.client, "platform_version", ""))
+            _LAZY_CACHE = "axon" in ver or d.platform == "axon"
+        except Exception:  # pragma: no cover - backend init failure
+            return False
+        if _LAZY_CACHE:  # pragma: no cover - only on the attached chip
+            import logging
+
+            logging.getLogger(__name__).info(
+                "lazy-dispatch backend detected (axon): block_until_ready "
+                "is a no-op; syncs go through hard_sync value reads"
+            )
+    return _LAZY_CACHE
+
+
+def hard_sync(out):
+    """``block_until_ready`` that cannot be faked; returns ``out``.
+
+    On honest backends this is exactly ``jax.block_until_ready``. On a
+    lazy-dispatch backend (see :func:`lazy_dispatch_backend`) it
+    additionally reduces the first element of every array leaf ON DEVICE
+    and reads the one resulting scalar back to the host — executing a
+    program materializes all its outputs, and the host read is the only
+    synchronization such a client honors. The D2H payload is 4 bytes, not
+    the buffers, so the extra cost is one round-trip."""
+    jax.block_until_ready(out)
+    if not lazy_dispatch_backend():
+        return out
+    import jax.numpy as jnp
+
+    leaves = [
+        l for l in jax.tree_util.tree_leaves(out)
+        if hasattr(l, "dtype") and getattr(l, "size", 0)
+    ]
+    if not leaves:
+        return out
+    try:
+        acc = None
+        for leaf in leaves:
+            v = jnp.ravel(leaf)[0].astype(jnp.float32)
+            acc = v if acc is None else acc + v
+        float(acc)  # ONE read forces every leaf's producer
+    except ValueError:
+        # Leaves committed to different device sets (e.g. metrics
+        # straddling a live reshard) can't be summed into one scalar —
+        # read each leaf separately (one tiny D2H per leaf).
+        for leaf in leaves:
+            float(jnp.ravel(leaf)[0].astype(jnp.float32))
+    return out
+
+
 def device_is_tpu(d: jax.Device) -> bool:
     if d.platform in _TPU_PLATFORMS:
         return True
