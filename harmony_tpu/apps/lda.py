@@ -91,6 +91,9 @@ class LDATrainer(Trainer):
         # restarted run never replays randomness already consumed.
         self._epoch = starting_epoch
 
+    # the PRNG epoch fold depends only on epoch_idx — windowable
+    epoch_hook_windowable = True
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
         self._epoch = epoch_idx + 1
 

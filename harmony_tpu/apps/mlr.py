@@ -62,6 +62,9 @@ class MLRTrainer(Trainer):
 
     # -- lifecycle -------------------------------------------------------
 
+    # decay depends only on epoch_idx — safe between windowed dispatches
+    epoch_hook_windowable = True
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
         # Step-size decay (ref: MLRTrainer decay via DecayRate/DecayPeriod
         # DolphinParameters). Reaches the compiled step via hyperparams().

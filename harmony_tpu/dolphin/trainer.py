@@ -84,11 +84,46 @@ class Trainer:
         with epoch-dependent state (LDA's PRNG fold, decay schedules) must
         seed from here, not assume epoch 0."""
 
+    #: OPT-IN: set True on your subclass when :meth:`on_epoch_finished`
+    #: depends only on ``epoch_idx`` and the trainer's OWN attributes
+    #: (decay schedules, PRNG epoch counters) — never on trained values,
+    #: pulled models, or tables. The worker then may invoke it between the
+    #: dispatches of a multi-epoch fused window, BEFORE that epoch's
+    #: device results have drained (collapsing one host<->device round
+    #: trip per epoch into one per window). Trainers that don't override
+    #: the hook at all are windowable regardless (the no-op reads
+    #: nothing); the flag matters only for overriders.
+    epoch_hook_windowable = False
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
-        """Per-epoch hook (host side; may adjust step size etc.)."""
+        """Per-epoch hook (host side; may adjust step size etc. — see
+        ``epoch_hook_windowable`` if it reads trained state)."""
 
     def cleanup(self, ctx: TrainerContext) -> None:
         """Final hook after the last epoch."""
+
+    @classmethod
+    def _epoch_hook_windowable(cls, trainer: "Trainer") -> bool:
+        """Whether ``trainer``'s on_epoch_finished may run between the
+        dispatches of a multi-epoch window (before results drain).
+
+        True for the base no-op. For overriders, the ``epoch_hook_
+        windowable`` opt-in must be declared AT OR BELOW the class that
+        defines the effective hook — a flag inherited from above describes
+        a different (ancestor) hook, and a subclass replacing the hook
+        must re-opt-in for its own. Instance-level assignment wins."""
+        if "epoch_hook_windowable" in trainer.__dict__:
+            return bool(trainer.__dict__["epoch_hook_windowable"])
+        mro = type(trainer).__mro__
+        hook_owner = next(c for c in mro if "on_epoch_finished" in vars(c))
+        if hook_owner is Trainer:
+            return True  # un-overridden no-op reads nothing
+        flag_owner = next(
+            (c for c in mro if "epoch_hook_windowable" in vars(c)), None
+        )
+        if flag_owner is None or not vars(flag_owner)["epoch_hook_windowable"]:
+            return False
+        return mro.index(flag_owner) <= mro.index(hook_owner)
 
     # -- pure parts (traced into the fused step) ------------------------
 

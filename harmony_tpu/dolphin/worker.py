@@ -66,6 +66,7 @@ class WorkerTasklet:
         starting_epoch: int = 0,
         global_init: bool = True,
         post_init_barrier: Optional[Callable[[], None]] = None,
+        defer_epoch_callback: bool = False,
     ) -> None:
         self.job_id = job_id
         self.ctx = ctx
@@ -78,6 +79,12 @@ class WorkerTasklet:
         self.batch_barrier = batch_barrier
         self.taskunit = taskunit
         self.epoch_callback = epoch_callback
+        # True = the callback only does host accounting off already-drained
+        # values (metric emission) and may run AFTER a multi-epoch fused
+        # window drains, once per epoch in order. False = the callback
+        # observes table state at its epoch boundary (checkpoint chains),
+        # which a window would skip past — windows stay off.
+        self.defer_epoch_callback = defer_epoch_callback
         self.starting_epoch = starting_epoch  # resume (ref: StartingEpochIdx)
         # Multi-worker jobs: exactly ONE worker (the chief) may run the
         # trainer's global init — it writes shared tables, and N identical
@@ -514,6 +521,38 @@ class WorkerTasklet:
             and not self.data.is_shuffling
         )
 
+    # Max fused epochs per drain. Each drained window costs one full
+    # host<->device round trip (~40-90ms over a remote-attach tunnel); on
+    # small PS jobs those round trips, not compute, dominate the epoch
+    # loop. Bounded so donated-buffer chains and metric latency stay short.
+    EPOCH_WINDOW = 8
+
+    def _epoch_window_len(self, epoch: int, num_epochs: int) -> int:
+        """How many consecutive epochs may dispatch before the next drain.
+
+        >1 only when nothing on the host needs to OBSERVE state between
+        epochs: no SSP barrier (its stop decisions are per batch), a
+        windowable trainer hook (see Trainer.epoch_hook_windowable), and
+        an epoch callback that is either absent or declared deferrable
+        (metrics-only). Works over both the fused-epoch and the
+        async-batched dispatch paths (the latter keeps per-batch TaskUnit
+        admission, so multi-tenant interleaving is unchanged). The window
+        never crosses a comm-probe epoch — the probe measures the live
+        table between dispatches."""
+        if self.batch_barrier is not None:
+            return 1
+        # un-overridden hooks are no-ops (windowable by construction);
+        # overriders must OPT IN at the class that defines the hook
+        if not Trainer._epoch_hook_windowable(self.trainer):
+            return 1
+        if self.epoch_callback is not None and not self.defer_epoch_callback:
+            return 1
+        w = min(self.EPOCH_WINDOW, num_epochs - epoch)
+        if self.comm_probe_every and self.global_init:
+            done = (epoch - self.starting_epoch) % self.comm_probe_every
+            w = min(w, self.comm_probe_every - done)
+        return max(1, w)
+
     def _maybe_rebuild(self) -> None:
         """Live re-sharding: if EITHER table's layout changed since compile
         (plan-driven migration), rebuild so out_shardings/donation target the
@@ -620,7 +659,8 @@ class WorkerTasklet:
         global_batch_idx = 0
         epoch_losses: List[float] = []
 
-        for epoch in range(self.starting_epoch, params.num_epochs):
+        epoch = self.starting_epoch
+        while epoch < params.num_epochs and not stop:
             # chief-only (the split is a property of the shared table, not
             # the worker; siblings read the published value). Probe batch
             # is a plain prefix slice — the provider's epoch_batches()
@@ -635,6 +675,51 @@ class WorkerTasklet:
                     with trace_span("dolphin.comm_probe",
                                     job_id=self.job_id, epoch=epoch):
                         self._probe_comm(first)
+            window = self._epoch_window_len(epoch, params.num_epochs)
+            if window > 1:
+                # Multi-epoch window: dispatches chain on the table state
+                # with trainer hooks run between them (declared windowable
+                # = epoch-indexed only), ONE drain at the end, then the
+                # per-epoch host bookkeeping replays in order.
+                with trace_span(
+                    "dolphin.epoch_window",
+                    job_id=self.job_id,
+                    worker_id=self.ctx.worker_id,
+                    epoch=epoch,
+                    epochs=window,
+                    fused=self._use_fused_epoch(),
+                ):
+                    if self._use_fused_epoch():
+                        results, per_epoch_sec = self._run_fused_epochs(
+                            epoch, window
+                        )
+                        global_batch_idx += (
+                            window * self.data.num_mini_batches
+                        )
+                    else:
+                        results, global_batch_idx, per_epoch_sec = (
+                            self._run_batched_epochs_window(
+                                epoch, window, global_batch_idx
+                            )
+                        )
+                for j, (epoch_examples, last_metrics, nb) in enumerate(results):
+                    # account THIS epoch's ops just before its callback
+                    # replays, so the callback's ServerMetrics delta covers
+                    # exactly one epoch
+                    self._account_ops(nb)
+                    self._finish_epoch(
+                        epoch + j,
+                        time.perf_counter() - per_epoch_sec,
+                        epoch_examples,
+                        last_metrics,
+                        epoch_losses,
+                        # all but the window's LAST hook ran between
+                        # dispatches; the last runs here, post-drain, as in
+                        # the unfused loop
+                        call_trainer_hook=(j == len(results) - 1),
+                    )
+                epoch += window
+                continue
             epoch_t0 = time.perf_counter()
             with trace_span(
                 "dolphin.epoch",
@@ -644,7 +729,9 @@ class WorkerTasklet:
                 fused=self._use_fused_epoch(),
             ) as span:
                 if self._use_fused_epoch():
-                    epoch_examples, last_metrics = self._run_fused_epoch(epoch)
+                    results, _ = self._run_fused_epochs(epoch, 1)
+                    epoch_examples, last_metrics, nb1 = results[0]
+                    self._account_ops(nb1)
                     global_batch_idx += self.data.num_mini_batches
                 else:
                     epoch_examples, last_metrics, global_batch_idx, stop = (
@@ -657,8 +744,7 @@ class WorkerTasklet:
             if epoch_examples == 0 and stop:
                 break  # stopped before any batch: not an epoch at all
             self._finish_epoch(epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses)
-            if stop:
-                break
+            epoch += 1
         self.trainer.cleanup(ctx)
         return {
             "job_id": self.job_id,
@@ -694,13 +780,36 @@ class WorkerTasklet:
         add a full tunnel round-trip per batch without changing the
         device-side serialization.
         """
-        epoch_examples = 0
+        pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t = (
+            self._dispatch_epoch_batches(epoch, global_batch_idx)
+        )
         last_metrics: Dict[str, float] = {}
+        if pending:
+            t0 = time.perf_counter()
+            with trace_span("dolphin.metric_drain", job_id=self.job_id,
+                            epoch=epoch, batches=len(pending)):
+                host = self._drain_pending(pending)
+            work_t += time.perf_counter() - t0
+            # Async dispatch makes true per-batch device time unobservable
+            # without per-step syncs; smear the epoch's work time (barrier
+            # waits excluded) evenly — averages feeding the optimizer stay
+            # right, per-batch variance is deliberately given up.
+            last_metrics = self._emit_batch_metrics(
+                epoch, host, batch_sizes, work_t / len(pending)
+            )
+            self._account_ops(len(pending))
+        return epoch_examples, last_metrics, global_batch_idx, stop
+
+    def _dispatch_epoch_batches(self, epoch: int, global_batch_idx: int):
+        """The per-batch dispatch loop of one epoch — async, TaskUnit
+        admission per batch, NO drain. Returns (pending device metrics,
+        batch_sizes, examples, global_batch_idx, stop, dispatch_seconds)."""
+        epoch_examples = 0
         stop = False
         pending: List[Dict[str, jnp.ndarray]] = []
         batch_sizes: List[int] = []
         hyper = self._hyper()
-        work_t = 0.0  # dispatch+drain time, EXCLUDING SSP barrier waits
+        work_t = 0.0  # dispatch time, EXCLUDING SSP barrier waits
         for batch_idx, batch in enumerate(self.data.epoch_batches()):
             if self.batch_barrier is not None:  # SYNC TaskUnit
                 stop = self.batch_barrier(global_batch_idx)
@@ -721,81 +830,119 @@ class WorkerTasklet:
             batch_sizes.append(batch[0].shape[0])
             epoch_examples += batch[0].shape[0]
             global_batch_idx += 1
-        if pending:
-            # One stack-op + one transfer per metric key for the whole epoch.
-            # A mid-epoch reshard leaves metrics on different device sets, so
-            # stack per run of same-sharded values (still O(reshards) ops,
-            # not O(batches)).
+        return pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t
+
+    def _drain_pending(
+        self, pending: "List[Dict[str, jnp.ndarray]]"
+    ) -> Dict[str, np.ndarray]:
+        """Bring a run of per-step device metrics to host: one stack-op +
+        one transfer per metric key (per dtype when possible) for the WHOLE
+        list — on a remote-attached chip each transfer is a full network
+        round-trip. A mid-run reshard leaves metrics on different device
+        sets, so stacking is per run of same-sharded values (still
+        O(reshards) ops, not O(steps))."""
+        runs: List[List[Dict[str, jnp.ndarray]]] = [[pending[0]]]
+        probe = next(iter(pending[0]))
+        for m in pending[1:]:
+            if m[probe].sharding == runs[-1][-1][probe].sharding:
+                runs[-1].append(m)
+            else:
+                runs.append([m])
+        # The eager stacks DISPATCH under the table lock AND the
+        # process-wide dispatch scope: they are multi-device
+        # programs (and can carry an implicit transfer when a metric
+        # landed with a different placement), and a dispatch racing
+        # ANY other job's dispatches enqueues per-device work in
+        # divergent orders — on backends with in-process collectives
+        # that inverts a rendezvous and aborts the process
+        # (parallel/dispatch.py). The D2H copies below stay outside.
+        combined = None
+        with self.ctx.model_table._lock:
+            with dispatch_scope(self.mesh) as finish:
+                stacked = finish({
+                    k: [jnp.stack([m[k] for m in r]) for r in runs]
+                    for k in pending[0]
+                })
+                if len(runs) == 1:
+                    # Fold ALL same-dtype keys into one array so the
+                    # drain is ONE device->host transfer per dtype, not
+                    # one per key. (Multi-run drains — a mid-run reshard
+                    # — keep the per-key path.)
+                    keys = sorted(stacked)
+                    groups: Dict[Any, List[str]] = {}
+                    for k in keys:
+                        # sharding in the key: sibling metrics may
+                        # land on different device sets, and one
+                        # eager stack over non-colocated arrays
+                        # raises at dispatch
+                        sig = (stacked[k][0].dtype,
+                               stacked[k][0].shape,
+                               stacked[k][0].sharding)
+                        groups.setdefault(sig, []).append(k)
+                    combined = {
+                        dt: (ks, finish(jnp.stack(
+                            [stacked[k][0] for k in ks])))
+                        for dt, ks in groups.items()
+                    }
+        if combined is not None:
+            host = {}
+            for ks, arr in combined.values():
+                mat = np.asarray(arr)          # one D2H per dtype
+                for i, k in enumerate(ks):
+                    host[k] = np.atleast_1d(mat[i])
+        else:
+            host = {
+                k: np.concatenate(
+                    [np.atleast_1d(np.asarray(s)) for s in v])
+                for k, v in stacked.items()
+            }
+        return host
+
+    def _run_batched_epochs_window(
+        self, first_epoch: int, k: int, global_batch_idx: int
+    ):
+        """``k`` epochs of async per-batch dispatches (TaskUnit admission
+        per batch is preserved — concurrent tenants still interleave at
+        batch granularity) with ONE metric drain for the whole window.
+        Windowable trainer hooks run between epochs, exactly as in
+        :meth:`_run_fused_epochs`. Returns ([(examples, last_metrics)] per
+        epoch, global_batch_idx, seconds_per_epoch)."""
+        per_epoch = []
+        t_start = time.perf_counter()
+        for j in range(k):
+            pending, sizes, examples, global_batch_idx, _stop, work_t = (
+                self._dispatch_epoch_batches(first_epoch + j, global_batch_idx)
+            )
+            per_epoch.append((pending, sizes, examples, work_t))
+            if j + 1 < k:
+                self.trainer.on_epoch_finished(self.ctx, first_epoch + j)
+        all_pending = [m for p, _, _, _ in per_epoch for m in p]
+        drain_t = 0.0
+        host: Dict[str, np.ndarray] = {}
+        if all_pending:
             t0 = time.perf_counter()
             with trace_span("dolphin.metric_drain", job_id=self.job_id,
-                            epoch=epoch, batches=len(pending)):
-                runs: List[List[Dict[str, jnp.ndarray]]] = [[pending[0]]]
-                probe = next(iter(pending[0]))
-                for m in pending[1:]:
-                    if m[probe].sharding == runs[-1][-1][probe].sharding:
-                        runs[-1].append(m)
-                    else:
-                        runs.append([m])
-                # The eager stacks DISPATCH under the table lock AND the
-                # process-wide dispatch scope: they are multi-device
-                # programs (and can carry an implicit transfer when a metric
-                # landed with a different placement), and a dispatch racing
-                # ANY other job's dispatches enqueues per-device work in
-                # divergent orders — on backends with in-process collectives
-                # that inverts a rendezvous and aborts the process
-                # (parallel/dispatch.py). The D2H copies below stay outside.
-                combined = None
-                with self.ctx.model_table._lock:
-                    with dispatch_scope(self.mesh) as finish:
-                        stacked = finish({
-                            k: [jnp.stack([m[k] for m in r]) for r in runs]
-                            for k in pending[0]
-                        })
-                        if len(runs) == 1:
-                            # Fold ALL same-dtype keys into one array so the
-                            # epoch drain is ONE device->host transfer per
-                            # dtype, not one per key — on a remote-attached
-                            # chip each transfer is a full network
-                            # round-trip. (Multi-run epochs — a mid-epoch
-                            # reshard — keep the per-key path.)
-                            keys = sorted(stacked)
-                            groups: Dict[Any, List[str]] = {}
-                            for k in keys:
-                                # sharding in the key: sibling metrics may
-                                # land on different device sets, and one
-                                # eager stack over non-colocated arrays
-                                # raises at dispatch
-                                sig = (stacked[k][0].dtype,
-                                       stacked[k][0].shape,
-                                       stacked[k][0].sharding)
-                                groups.setdefault(sig, []).append(k)
-                            combined = {
-                                dt: (ks, finish(jnp.stack(
-                                    [stacked[k][0] for k in ks])))
-                                for dt, ks in groups.items()
-                            }
-                if combined is not None:
-                    host = {}
-                    for ks, arr in combined.values():
-                        mat = np.asarray(arr)          # one D2H per dtype
-                        for i, k in enumerate(ks):
-                            host[k] = np.atleast_1d(mat[i])
-                else:
-                    host = {
-                        k: np.concatenate(
-                            [np.atleast_1d(np.asarray(s)) for s in v])
-                        for k, v in stacked.items()
-                    }
-            work_t += time.perf_counter() - t0
-            # Async dispatch makes true per-batch device time unobservable
-            # without per-step syncs; smear the epoch's work time (barrier
-            # waits excluded) evenly — averages feeding the optimizer stay
-            # right, per-batch variance is deliberately given up.
-            last_metrics = self._emit_batch_metrics(
-                epoch, host, batch_sizes, work_t / len(pending)
-            )
-            self._account_ops(len(pending))
-        return epoch_examples, last_metrics, global_batch_idx, stop
+                            epoch=first_epoch, batches=len(all_pending),
+                            epochs=k):
+                host = self._drain_pending(all_pending)
+            drain_t = time.perf_counter() - t0
+        out = []
+        off = 0
+        for pending, sizes, examples, work_t in per_epoch:
+            nb = len(pending)
+            last: Dict[str, float] = {}
+            if nb:
+                epoch_host = {key: v[off:off + nb] for key, v in host.items()}
+                last = self._emit_batch_metrics(
+                    first_epoch + len(out), epoch_host, sizes,
+                    (work_t + drain_t / k) / nb,
+                )
+            off += nb
+            # accounting deferred to run()'s replay loop (see
+            # _run_fused_epochs) so ServerMetrics deltas stay per-epoch
+            out.append((examples, last, nb))
+        per_epoch_sec = (time.perf_counter() - t_start) / k
+        return out, global_batch_idx, per_epoch_sec
 
     def _emit_batch_metrics(
         self,
@@ -845,56 +992,89 @@ class WorkerTasklet:
             )
         return {k: float(v[-1]) for k, v in host.items()}
 
-    def _run_fused_epoch(self, epoch: int) -> Tuple[int, Dict[str, float]]:
-        """One dispatch for the whole epoch (see _build_step)."""
+    def _ensure_stacked_cache(self) -> None:
+        """Device-resident whole-epoch dataset ([num_batches, batch, ...]
+        per array), rebuilt after any reshard cleared it (the stack must
+        live on the table's CURRENT mesh)."""
+        if self._stacked_cache is not None:
+            return
         table = self.ctx.model_table
+        gkey = self._devcache_key("stacked")
+        hit = devcache.get(gkey) if gkey is not None else None
+        if hit is not None:
+            self._stacked_cache = hit
+            return
+        with trace_span("dolphin.dataset_upload", job_id=self.job_id):
+            batches = list(self.data.epoch_batches())
+            stacked_sharding = NamedSharding(table.mesh, P(None, DATA_AXIS))
+            self._stacked_cache = tuple(
+                jax.device_put(np.stack([b[i] for b in batches]),
+                               stacked_sharding)
+                for i in range(len(batches[0]))
+            )
+        devcache.put(gkey, self._stacked_cache)
+
+    def _dispatch_epoch_fn(self):
+        """One whole-epoch dispatch (see _build_step), retried across
+        concurrent reshards. Returns the epoch's stacked device metrics."""
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
-            if self._stacked_cache is None:
-                gkey = self._devcache_key("stacked")
-                hit = devcache.get(gkey) if gkey is not None else None
-                if hit is not None:
-                    self._stacked_cache = hit
-                else:
-                    with trace_span("dolphin.dataset_upload", job_id=self.job_id):
-                        batches = list(self.data.epoch_batches())
-                        stacked_sharding = NamedSharding(table.mesh,
-                                                         P(None, DATA_AXIS))
-                        self._stacked_cache = tuple(
-                            jax.device_put(np.stack([b[i] for b in batches]),
-                                           stacked_sharding)
-                            for i in range(len(batches[0]))
-                        )
-                    devcache.put(gkey, self._stacked_cache)
-            # timer starts AFTER cache build: the one-time dataset stacking/
-            # transfer must not inflate per-batch times fed to the optimizer
-            t0 = time.perf_counter()
+            self._ensure_stacked_cache()
             try:
-                stacked_metrics = self._dispatch_step(self._epoch_fn, self._stacked_cache)
-                break
+                return self._dispatch_step(self._epoch_fn, self._stacked_cache)
             except ValueError as e:
                 if not self._is_layout_race(e):
                     raise
                 self._build_step()  # force-rebuild (see _dispatch_batch)
-        else:
-            raise RuntimeError(
-                f"table resharded {self.MAX_RESHARD_RETRIES}x during one "
-                "epoch dispatch; reconfiguration is outpacing training"
-            )
-        # hard_sync BEFORE the timer stops: the per-batch times fed to the
-        # optimizer must include device execution, and on a lazy backend
-        # block_until_ready would stop the clock at dispatch
-        hard_sync(stacked_metrics)
-        dt = time.perf_counter() - t0
-        nb = self.data.num_mini_batches
-        host_metrics = {
-            k: np.atleast_1d(np.asarray(v)) for k, v in stacked_metrics.items()
-        }
-        last = self._emit_batch_metrics(
-            epoch, host_metrics, [self.data.batch_size] * nb, dt / nb
+        raise RuntimeError(
+            f"table resharded {self.MAX_RESHARD_RETRIES}x during one "
+            "epoch dispatch; reconfiguration is outpacing training"
         )
-        self._account_ops(nb)
-        return self.data.num_examples, last
+
+    def _run_fused_epochs(
+        self, first_epoch: int, k: int
+    ) -> "Tuple[List[Tuple[int, Dict[str, float]]], float]":
+        """``k`` whole-epoch dispatches chained on the table state with ONE
+        drain at the end (k=1 = the plain fused epoch). Windowable trainer
+        hooks run BETWEEN dispatches so epoch-indexed hyperparams (decay,
+        PRNG folds) feed each dispatch exactly as in the unfused loop.
+        Returns ([(examples, last_metrics)] per epoch, seconds_per_epoch)."""
+        # cache build BEFORE the timer starts: the one-time dataset
+        # stacking/transfer must not inflate per-batch times fed to the
+        # optimizer (a mid-window reshard rebuilds it inside the retry
+        # loop and does count — it IS reconfiguration cost)
+        self._ensure_stacked_cache()
+        t0 = time.perf_counter()
+        window_metrics = []
+        for j in range(k):
+            window_metrics.append(self._dispatch_epoch_fn())
+            if j + 1 < k:
+                # windowable by declaration: depends only on the epoch
+                # index, so it may run before the epoch's results drain
+                self.trainer.on_epoch_finished(self.ctx, first_epoch + j)
+        # ONE drain for the whole window, BEFORE the timer stops: the
+        # per-batch times fed to the optimizer must include device
+        # execution, and on a lazy backend block_until_ready would stop
+        # the clock at dispatch
+        hard_sync(window_metrics)
+        per_epoch_sec = (time.perf_counter() - t0) / k
+        nb = self.data.num_mini_batches
+        out = []
+        for j, stacked_metrics in enumerate(window_metrics):
+            host_metrics = {
+                key: np.atleast_1d(np.asarray(v))
+                for key, v in stacked_metrics.items()
+            }
+            last = self._emit_batch_metrics(
+                first_epoch + j, host_metrics,
+                [self.data.batch_size] * nb, per_epoch_sec / nb,
+            )
+            # op accounting happens in run()'s replay loop, interleaved
+            # with the deferred epoch callbacks, so per-epoch ServerMetrics
+            # deltas stay per-epoch instead of lumping onto the window's
+            # first report
+            out.append((self.data.num_examples, last, nb))
+        return out, per_epoch_sec
 
     def _primary_key(self, metrics) -> Optional[str]:
         """The ONE key that is this job's progress scalar: 'loss', else the
@@ -909,7 +1089,8 @@ class WorkerTasklet:
         k = self._primary_key(metrics)
         return float(metrics[k]) if k is not None else 0.0
 
-    def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics, epoch_losses):
+    def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics,
+                      epoch_losses, call_trainer_hook: bool = True):
         progress = self._primary_metric(last_metrics)
         self.collector.add(
             EpochMetrics(
@@ -922,7 +1103,8 @@ class WorkerTasklet:
             )
         )
         epoch_losses.append(progress)
-        self.trainer.on_epoch_finished(self.ctx, epoch)
+        if call_trainer_hook:
+            self.trainer.on_epoch_finished(self.ctx, epoch)
         if self.epoch_callback is not None:
             self.epoch_callback(epoch)
         self.collector.flush()

@@ -293,6 +293,10 @@ class DolphinJobEntity(JobEntity):
                     epoch_callback=(epoch_hook if idx == 0 else None),
                     global_init=(idx == 0),
                     post_init_barrier=init_barrier.wait,
+                    # the metrics hook only reads already-drained counters,
+                    # so fused multi-epoch windows may defer it; checkpoint
+                    # chains snapshot state AT their epoch and disable them
+                    defer_epoch_callback=(params.model_chkp_period <= 0),
                 )
                 self._workers.append(worker)
                 results[wid] = worker.run()

@@ -78,10 +78,21 @@ def hard_sync(out):
         return out
     import jax.numpy as jnp
 
-    leaves = [
-        l for l in jax.tree_util.tree_leaves(out)
-        if hasattr(l, "dtype") and getattr(l, "size", 0)
-    ]
+    import numpy as _np
+
+    leaves = []
+    for leaf in jax.tree_util.tree_leaves(out):
+        if not (hasattr(leaf, "dtype") and getattr(leaf, "size", 0)):
+            continue
+        # extended dtypes (typed PRNG keys) have no astype — unwrap to
+        # their uint32 carrier so they still force execution
+        if not (jnp.issubdtype(leaf.dtype, _np.number)
+                or jnp.issubdtype(leaf.dtype, _np.bool_)):
+            try:
+                leaf = jax.random.key_data(leaf)
+            except Exception:
+                continue  # unreadable exotic leaf: the others still force
+        leaves.append(leaf)
     if not leaves:
         return out
     try:

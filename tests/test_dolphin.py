@@ -95,6 +95,92 @@ class TestMetrics:
         assert manager.aggregate_throughput() > 0
 
 
+class TestEpochWindow:
+    """Multi-epoch fused dispatch windows (WorkerTasklet._run_fused_epochs):
+    one drain per window must change NOTHING observable — same losses, same
+    final model, same per-epoch metric stream — vs the one-drain-per-epoch
+    loop, including epoch-indexed trainer hooks (MLR's LR decay)."""
+
+    def _run(self, mesh8, window):
+        from harmony_tpu.metrics import MetricCollector, MetricManager
+
+        manager = MetricManager()
+        manager.start_collection()
+        x, y = make_synthetic(128, num_features=16, num_classes=2, seed=3)
+        trainer = MLRTrainer(
+            num_classes=2, num_features=16, features_per_partition=4,
+            step_size=0.1, decay_rate=0.5, decay_period=2,
+        )
+        params = TrainerParams(num_epochs=6, num_mini_batches=4,
+                               comm_probe_period=0)
+        spec = TableSpec(trainer.model_table_config())
+        table = DenseTable(spec, mesh8)
+        ctx = TrainerContext(params=params, model_table=table)
+        w = WorkerTasklet(
+            "j", ctx, trainer, TrainingDataProvider([x, y], 4), mesh8,
+            collector=MetricCollector(sink=manager.on_metric),
+        )
+        w.EPOCH_WINDOW = window  # instance override of the class cap
+        result = w.run()
+        return result, manager, np.asarray(table.pull_array())
+
+    def test_window_matches_unwindowed(self, mesh8):
+        r1, m1, t1 = self._run(mesh8, window=1)
+        rw, mw, tw = self._run(mesh8, window=8)
+        np.testing.assert_allclose(r1["losses"], rw["losses"], rtol=0, atol=0)
+        np.testing.assert_allclose(t1, tw, rtol=0, atol=0)
+        assert len(m1.worker_batch_metrics()) == len(mw.worker_batch_metrics()) == 24
+        e1 = sorted(e.epoch_idx for el in m1._epoch.values() for e in el)
+        ew = sorted(e.epoch_idx for el in mw._epoch.values() for e in el)
+        assert e1 == ew == list(range(6))
+
+    def test_window_gating(self, mesh8):
+        x, y = make_synthetic(64, num_features=8, num_classes=2)
+        trainer = MLRTrainer(num_classes=2, num_features=8,
+                             features_per_partition=4)
+        spec = TableSpec(trainer.model_table_config())
+        table = DenseTable(spec, mesh8)
+
+        def worker(probe_period, **kw):
+            params = TrainerParams(num_epochs=12, num_mini_batches=4,
+                                   comm_probe_period=probe_period)
+            ctx = TrainerContext(params=params, model_table=table)
+            return WorkerTasklet("j", ctx, trainer,
+                                 TrainingDataProvider([x, y], 4), mesh8, **kw)
+
+        # probes cap the window at the probe cadence
+        assert worker(4)._epoch_window_len(0, 12) == 4
+        # probes off: the class cap applies
+        assert worker(0)._epoch_window_len(0, 12) == 8
+        # non-deferrable epoch callback (checkpoint chains) disables windows
+        w = worker(0, epoch_callback=lambda e: None)
+        assert w._epoch_window_len(0, 12) == 1
+        # deferrable (metrics-only) callback keeps them
+        w = worker(0, epoch_callback=lambda e: None, defer_epoch_callback=True)
+        assert w._epoch_window_len(0, 12) == 8
+        # a trainer whose hook reads trained state opts out
+        trainer.epoch_hook_windowable = False
+        try:
+            assert worker(0)._epoch_window_len(0, 12) == 1
+        finally:
+            del trainer.epoch_hook_windowable
+        # a subclass overriding the hook WITHOUT opting in is excluded
+        # even though its PARENT opted in — the flag describes the
+        # parent's hook, not the override
+        class PeekingMLR(MLRTrainer):
+            def on_epoch_finished(self, ctx, epoch_idx):
+                pass  # pretend it reads trained state
+
+        trainer_peek = PeekingMLR(num_classes=2, num_features=8,
+                                  features_per_partition=4)
+        params = TrainerParams(num_epochs=12, num_mini_batches=4,
+                               comm_probe_period=0)
+        ctx = TrainerContext(params=params, model_table=table)
+        w = WorkerTasklet("j2", ctx, trainer_peek,
+                          TrainingDataProvider([x, y], 4), mesh8)
+        assert w._epoch_window_len(0, 12) == 1
+
+
 class TestCommProbe:
     def test_probe_feeds_pull_push_split(self, mesh8):
         """The per-epoch comm probe (WorkerTasklet._probe_comm) must emit a

@@ -207,7 +207,11 @@ class TestServerMetricsEmission:
             job_id="met-mlr", app_type="dolphin",
             trainer="harmony_tpu.apps.mlr:MLRTrainer",
             params=TrainerParams(
-                num_epochs=3, num_mini_batches=4,
+                # probes off => the 3 epochs run as ONE fused window; the
+                # per-epoch assertions below then pin that op deltas are
+                # accounted per epoch, not lumped onto the window's first
+                # report (the callbacks replay after the single drain)
+                num_epochs=3, num_mini_batches=4, comm_probe_period=0,
                 app_params={"num_classes": 4, "num_features": 16,
                             "features_per_partition": 4, "step_size": 0.5},
             ),
@@ -231,10 +235,13 @@ class TestServerMetricsEmission:
             assert len(ms) == 2  # both owning executors
             assert sum(m.num_blocks for m in ms) > 0
         # op counters carry real traffic: 4 pulls/pushes per epoch split
-        # across executors (block-proportional shares)
-        epoch0 = by_window[0]
-        assert sum(m.pull_count for m in epoch0) >= 3
-        assert sum(m.pull_bytes for m in epoch0) > 0
+        # across executors (block-proportional shares) — in EVERY epoch
+        # window, not just the first (windowed runs must not lump the
+        # whole window's ops onto its first report)
+        for window in (0, 1, 2):
+            ms = by_window[window]
+            assert sum(m.pull_count for m in ms) >= 3, window
+            assert sum(m.pull_bytes for m in ms) > 0, window
 
     def test_shared_table_jobs_do_not_double_count(self, devices):
         """Two jobs sharing one model table by id: each job's ServerMetrics
