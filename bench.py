@@ -183,12 +183,23 @@ def probe_accelerator(attempts: int = 3, timeout_s: float = 60.0) -> str:
 
 
 def cpu_baseline_rate() -> float:
+    """Best of two measured CPU passes (after a compile warmup).
+
+    A single pass proved fragile: transient host contention (another
+    process hammering the tunnel/cores) once depressed it 5x, which
+    INFLATES vs_baseline. Taking the best CPU rate is the conservative
+    denominator — steady-state capability of this host, not its worst
+    moment."""
     try:
         cpu = jax.devices("cpu")[:1]
         print("cpu warmup (compile) pass:", file=sys.stderr)
         run_concurrent(cpu, scale=0.125, job_timeout=3600.0, epochs=1)
-        print("concurrent MLR+NMF+LDA on cpu (reduced size):", file=sys.stderr)
-        return run_concurrent(cpu, scale=0.125, job_timeout=3600.0)
+        rates = []
+        for i in range(2):
+            print(f"concurrent MLR+NMF+LDA on cpu (reduced size, "
+                  f"pass {i + 1}/2):", file=sys.stderr)
+            rates.append(run_concurrent(cpu, scale=0.125, job_timeout=3600.0))
+        return max(rates)
     except Exception as e:  # pragma: no cover - cpu backend always present
         print(f"cpu baseline unavailable: {e}", file=sys.stderr)
         return 0.0

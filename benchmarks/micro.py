@@ -268,14 +268,20 @@ def bench_mxupush() -> dict:
 
     out = {"metric": "mxu push route", "unit": "GB/s", "keys": nkeys,
            "capacity": capacity, "devices": len(mesh.devices.flat)}
+    # deltas gain a zero-weight dependency on the loop-carried array so
+    # the fold/scatter operand is NOT loop-invariant inside timed_inner's
+    # fori_loop — XLA would hoist the one-hot fold out of the loop and
+    # the section would time a dense add (same defense as bench_sparse)
     t_scatter = _time_inner(
-        lambda a: spec.push(a, keys, deltas, via="scatter"), table.array)
+        lambda a: spec.push(a, keys, deltas + 0.0 * a[0, 0], via="scatter"),
+        table.array)
     out["scatter_gbps"] = round(push_bytes / t_scatter / 1e9, 2)
     from harmony_tpu.utils.platform import tpu_backend
 
     if tpu_backend():
         t_mxu = _time_inner(
-            lambda a: spec.push(a, keys, deltas, via="mxu"), table.array)
+            lambda a: spec.push(a, keys, deltas + 0.0 * a[0, 0], via="mxu"),
+            table.array)
         # the fold is a [capacity, nkeys] x [nkeys, width] one-hot matmul
         fold_flops = 2 * capacity * nkeys * width
         out["value"] = round(push_bytes / t_mxu / 1e9, 2)

@@ -95,19 +95,40 @@ def hard_sync(out):
         leaves.append(leaf)
     if not leaves:
         return out
-    try:
-        acc = None
-        for leaf in leaves:
-            v = jnp.ravel(leaf)[0].astype(jnp.float32)
-            acc = v if acc is None else acc + v
-        float(acc)  # ONE read forces every leaf's producer
-    except ValueError:
-        # Leaves committed to different device sets (e.g. metrics
-        # straddling a live reshard) can't be summed into one scalar —
-        # read each leaf separately (one tiny D2H per leaf).
-        for leaf in leaves:
-            float(jnp.ravel(leaf)[0].astype(jnp.float32))
+    with _multi_device_read_scope(leaves):
+        try:
+            acc = None
+            for leaf in leaves:
+                v = jnp.ravel(leaf)[0].astype(jnp.float32)
+                acc = v if acc is None else acc + v
+            float(acc)  # ONE read forces every leaf's producer
+        except ValueError:
+            # Leaves committed to different device sets (e.g. metrics
+            # straddling a live reshard) can't be summed into one scalar —
+            # read each leaf separately (one tiny D2H per leaf).
+            for leaf in leaves:
+                float(jnp.ravel(leaf)[0].astype(jnp.float32))
     return out
+
+
+def _multi_device_read_scope(leaves):
+    """The scalar-read programs above are themselves dispatches; when a
+    leaf spans multiple devices they MUST enter the process-wide dispatch
+    order (parallel/dispatch.py: unscoped multi-device enqueues racing
+    another job's scoped dispatches can invert a collective rendezvous).
+    Single-device leaves — the whole single-chip path — skip the scope.
+    Nesting matches the framework convention: callers holding a table
+    lock enter this scope inside it, same as worker metric drains."""
+    import contextlib
+
+    for leaf in leaves:
+        mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        if mesh is not None and getattr(mesh, "devices", None) is not None \
+                and mesh.devices.size > 1:
+            from harmony_tpu.parallel.dispatch import dispatch_scope
+
+            return dispatch_scope(mesh)
+    return contextlib.nullcontext()
 
 
 def device_is_tpu(d: jax.Device) -> bool:
