@@ -53,7 +53,7 @@ def job_configs(scale: float, epochs: int = EPOCHS):
         job_id="bench-mlr", app_type="dolphin",
         trainer="harmony_tpu.apps.mlr:MLRTrainer",
         params=TrainerParams(
-            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=4,
+            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"num_classes": 256, "num_features": 8192,
                         "features_per_partition": 512, "step_size": 0.05},
         ),
@@ -66,7 +66,7 @@ def job_configs(scale: float, epochs: int = EPOCHS):
         job_id="bench-nmf", app_type="dolphin",
         trainer="harmony_tpu.apps.nmf:NMFTrainer",
         params=TrainerParams(
-            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=4,
+            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"num_rows": nmf_rows, "num_cols": 4096, "rank": 256,
                         "step_size": 0.01},
         ),
@@ -79,7 +79,7 @@ def job_configs(scale: float, epochs: int = EPOCHS):
         job_id="bench-lda", app_type="dolphin",
         trainer="harmony_tpu.apps.lda:LDATrainer",
         params=TrainerParams(
-            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=4,
+            num_epochs=epochs, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"vocab_size": 8192, "num_topics": 64,
                         "num_docs": lda_docs, "max_doc_len": 128},
         ),

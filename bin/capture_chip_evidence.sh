@@ -14,9 +14,16 @@ run_stage() {  # run_stage <artifact> <cmd...>: a crash still records JSON
     mv "$out.tmp" "$out"
   else
     local rc=$?  # before anything (even a $(substitution)) clobbers it
-    echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
-         "\"error\": \"stage crashed (rc=$rc): $*\"}" > "$out"
     rm -f "$out.tmp"
+    if [ -s "$out" ] && ! grep -q '"error"' "$out"; then
+      # never clobber a prior CLEAN capture with a crash stub — record
+      # the failure beside it instead
+      echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
+           "\"error\": \"stage crashed (rc=$rc): $*\"}" > "${out%.json}.failed.json"
+    else
+      echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
+           "\"error\": \"stage crashed (rc=$rc): $*\"}" > "$out"
+    fi
   fi
   cat "$out"
 }

@@ -13,7 +13,10 @@ POLL="${2:-300}"
 PROBE='import jax; ds = jax.devices(); print("PROBE", ds[0].platform)'
 
 while true; do
-  if timeout 60 python -c "$PROBE" 2>/dev/null | grep -q "PROBE tpu"; then
+  # the axon client reports device platform "tpu"; match "axon" too in
+  # case a future plugin surfaces its registry name instead
+  if timeout 60 python -c "$PROBE" 2>"benchmarks/.watch_probe.log" \
+      | grep -Eq "PROBE (tpu|axon)"; then
     echo "$(date -Is) chip healthy — capturing" >&2
     if timeout 1800 python bench.py > "benchmarks/.BENCH_watch.json" \
         2> "benchmarks/.watch_bench.log" \
@@ -21,6 +24,10 @@ while true; do
       mv "benchmarks/.BENCH_watch.json" "benchmarks/BENCH_${SUF}.json"
       echo "$(date -Is) clean headline captured:" >&2
       cat "benchmarks/BENCH_${SUF}.json" >&2
+      # same window: refresh the rest of the evidence (micro MFU, LM,
+      # profile, entry check); run_stage keeps prior clean artifacts
+      # when a stage crashes
+      bash bin/capture_chip_evidence.sh "${SUF}" >&2 || true
       exit 0
     fi
     echo "$(date -Is) capture not clean; will retry" >&2

@@ -95,19 +95,26 @@ def hard_sync(out):
         leaves.append(leaf)
     if not leaves:
         return out
+    # ENQUEUE the scalar reductions inside the process-wide dispatch
+    # order, but perform the blocking host reads AFTER leaving the scope:
+    # the reads wait out everything queued before them (potentially a
+    # whole epoch window), and holding the global dispatch lock that long
+    # would serialize every other tenant's dispatches behind this drain.
     with _multi_device_read_scope(leaves):
         try:
             acc = None
             for leaf in leaves:
                 v = jnp.ravel(leaf)[0].astype(jnp.float32)
                 acc = v if acc is None else acc + v
-            float(acc)  # ONE read forces every leaf's producer
+            scalars = [acc]
         except ValueError:
             # Leaves committed to different device sets (e.g. metrics
             # straddling a live reshard) can't be summed into one scalar —
-            # read each leaf separately (one tiny D2H per leaf).
-            for leaf in leaves:
-                float(jnp.ravel(leaf)[0].astype(jnp.float32))
+            # one tiny program per leaf instead.
+            scalars = [jnp.ravel(leaf)[0].astype(jnp.float32)
+                       for leaf in leaves]
+    for s in scalars:
+        float(s)  # the reads that force execution
     return out
 
 
