@@ -10,26 +10,33 @@ SUF="${1:-local}"
 
 run_stage() {  # run_stage <artifact> <cmd...>: a crash still records JSON
   local out="$1"; shift
-  if "$@" > "$out.tmp"; then
-    mv "$out.tmp" "$out"
-  else
-    local rc=$?  # before anything (even a $(substitution)) clobbers it
+  local rc=0
+  "$@" > "$out.tmp" || rc=$?
+  if [ "$rc" -ne 0 ]; then
     rm -f "$out.tmp"
-    if [ -s "$out" ] && ! grep -q '"error"' "$out"; then
-      # never clobber a prior CLEAN capture with a crash stub — record
-      # the failure beside it instead
-      echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
-           "\"error\": \"stage crashed (rc=$rc): $*\"}" > "${out%.json}.failed.json"
-    else
-      echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
-           "\"error\": \"stage crashed (rc=$rc): $*\"}" > "$out"
-    fi
+    echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
+         "\"error\": \"stage crashed (rc=$rc): $*\"}" > "$out.tmp"
+  fi
+  # Never clobber a prior CLEAN capture with an error result — stages
+  # that hit a wedged transport exit 0 with an {"error": ...} line (the
+  # graceful path), so the check is on CONTENT, not exit code. Failures
+  # land beside the clean artifact instead.
+  if grep -q '"error"' "$out.tmp" && [ -s "$out" ] \
+      && ! grep -q '"error"' "$out"; then
+    mv "$out.tmp" "${out%.json}.failed.json"
+  else
+    mv "$out.tmp" "$out"
+    rm -f "${out%.json}.failed.json"  # success supersedes old failures
   fi
   cat "$out"
 }
 
 echo "== headline bench (bench.py)"
-run_stage "benchmarks/BENCH_${SUF}.json" python bench.py
+if [ -n "${SKIP_HEADLINE:-}" ]; then
+  echo "(skipped: SKIP_HEADLINE set — caller already captured it)"
+else
+  run_stage "benchmarks/BENCH_${SUF}.json" python bench.py
+fi
 
 echo "== microbenches incl. MFU (benchmarks/micro.py)"
 run_stage "benchmarks/MICRO_${SUF}.json" python benchmarks/micro.py all

@@ -25,9 +25,9 @@ while true; do
       echo "$(date -Is) clean headline captured:" >&2
       cat "benchmarks/BENCH_${SUF}.json" >&2
       # same window: refresh the rest of the evidence (micro MFU, LM,
-      # profile, entry check); run_stage keeps prior clean artifacts
-      # when a stage crashes
-      bash bin/capture_chip_evidence.sh "${SUF}" >&2 || true
+      # profile, entry check) WITHOUT re-running the ~10-min headline we
+      # just landed; run_stage keeps prior clean artifacts on failure
+      SKIP_HEADLINE=1 bash bin/capture_chip_evidence.sh "${SUF}" >&2 || true
       exit 0
     fi
     echo "$(date -Is) capture not clean; will retry" >&2

@@ -43,12 +43,10 @@ def _resolve_inner(inner: str) -> str:
     # the kernel would run in interpret mode (orders of magnitude
     # slower), so einsum stays the fallback there.
     if inner == "auto":
-        import os
+        from harmony_tpu.utils.platform import env_choice, tpu_backend
 
-        from harmony_tpu.utils.platform import tpu_backend
-
-        forced = os.environ.get("HARMONY_RING_INNER")
-        if forced in ("flash", "einsum"):
+        forced = env_choice("HARMONY_RING_INNER", ("flash", "einsum"))
+        if forced:
             return forced
         return "flash" if tpu_backend() else "einsum"
     if inner not in ("flash", "einsum"):

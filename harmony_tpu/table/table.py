@@ -404,9 +404,16 @@ class DenseTable:
     def push_via(self) -> str:
         """Platform-resolved keyed-push route: the size-gated MXU
         duplicate-fold on an all-TPU mesh for additive tables, XLA scatter
-        everywhere else."""
-        from harmony_tpu.utils.platform import device_is_tpu
+        everywhere else. ``HARMONY_PUSH_VIA`` (scatter|mxu|mxu_auto)
+        overrides — the operator rollback knob while on-chip measurements
+        of fold-vs-scatter at real shapes are still settling (the first
+        honest capture had scatter ahead at the bench shape)."""
+        from harmony_tpu.utils.platform import device_is_tpu, env_choice
 
+        forced = env_choice("HARMONY_PUSH_VIA",
+                            ("scatter", "mxu", "mxu_auto"))
+        if forced:
+            return forced
         on_tpu = all(device_is_tpu(d) for d in self._mesh.devices.flat)
         return (
             "mxu_auto"

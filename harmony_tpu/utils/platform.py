@@ -21,6 +21,30 @@ import jax
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
+_WARNED_ENV: set = set()
+
+
+def env_choice(var: str, allowed: tuple) -> Optional[str]:
+    """Value of env ``var`` when it is one of ``allowed``, else None —
+    warning ONCE about unrecognized non-empty values. These vars are
+    operator rollback knobs; a typo silently falling through to the
+    default would leave the operator believing a rollback is in effect."""
+    val = os.environ.get(var)
+    if not val:
+        return None
+    if val in allowed:
+        return val
+    if var not in _WARNED_ENV:
+        _WARNED_ENV.add(var)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not one of %s — IGNORED, default route stays active",
+            var, val, list(allowed),
+        )
+    return None
+
+
 def mirror_env_platform_request() -> None:
     """Honor a ``JAX_PLATFORMS=cpu`` environment request at the CONFIG level.
 
