@@ -136,7 +136,10 @@ def main() -> None:
             "concurrent_wall_s": {j: round(w, 1) for j, w in conc.items()},
         }
     out["value"] = out["share_all"]["jain"]
-    if devices[0].platform == "cpu":
+    if "carve" not in out and len(devices) < len(configs):
+        out["note"] = (f"carve skipped: {len(devices)} device(s) cannot "
+                       f"slice among {len(configs)} jobs")
+    elif devices[0].platform == "cpu":
         out["note"] = (
             "cpu-mesh carve numbers are a FLOOR: the in-process-collective "
             "backend serializes multi-device program execution across "

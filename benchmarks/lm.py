@@ -186,7 +186,79 @@ def bench_decode() -> dict:
     return out
 
 
-SECTIONS = {"train": bench_train, "sp": bench_sp, "decode": bench_decode}
+def bench_pp() -> dict:
+    """Pipeline-parallel train step (GPipe microbatching over a stage
+    mesh, ppermute activations) — tokens/sec at 2 layers per stage."""
+    from harmony_tpu.models import make_lm_data
+    from harmony_tpu.models.transformer import make_pp_train_step
+    from harmony_tpu.utils.platform import tpu_backend
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        return {"metric": "lm pp train step", "value": None,
+                "unit": "tokens/sec", "note": "needs >=2 devices"}
+    on_tpu = tpu_backend()
+    # layers must split evenly into n stages
+    cfg, model = _model(on_tpu, layers=2 * n)
+    mesh = Mesh(np.asarray(devs, dtype=object).reshape(n), ("stage",))
+    params = model.init(jax.random.PRNGKey(0))
+    batch = (8 if on_tpu else 2) * n  # microbatch per stage
+    tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
+    step, shard = make_pp_train_step(model, mesh, learning_rate=0.1,
+                                     donate=False)
+    pp_params = shard(params)
+    dt = _time_chain(lambda p: step(p, tokens)[0], pp_params)
+    n_tok = batch * cfg.max_seq
+    out = {"metric": "lm pp train step", "value": round(n_tok / dt),
+           "unit": "tokens/sec", "seq": cfg.max_seq, "batch": batch,
+           "stages": n, "layers": cfg.n_layers}
+    if not on_tpu:
+        out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+def bench_ep() -> dict:
+    """Expert-parallel MoE train step (experts sharded over the data
+    axis, all_to_all token routing) — tokens/sec."""
+    from harmony_tpu.models import TransformerConfig, TransformerLM, make_lm_data
+    from harmony_tpu.models.transformer import make_ep_train_step
+    from harmony_tpu.parallel import build_mesh
+    from harmony_tpu.utils.platform import tpu_backend
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        return {"metric": "lm ep train step", "value": None,
+                "unit": "tokens/sec", "note": "needs >=2 devices"}
+    on_tpu = tpu_backend()
+    base, _ = _model(on_tpu)
+    cfg = TransformerConfig(
+        vocab_size=base.vocab_size, d_model=base.d_model,
+        n_heads=base.n_heads, n_layers=base.n_layers, d_ff=base.d_ff,
+        max_seq=base.max_seq, attn="auto", dtype=base.dtype,
+        moe_experts=2 * n, moe_every=2,
+    )
+    model = TransformerLM(cfg)
+    mesh = build_mesh(devs, data=n, model=1)
+    step, shard = make_ep_train_step(model, mesh, learning_rate=0.1,
+                                     donate=False)
+    params = shard(model.init(jax.random.PRNGKey(0)))
+    batch = (8 if on_tpu else 2) * n
+    tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
+    dt = _time_chain(lambda p: step(p, tokens)[0], params)
+    n_tok = batch * cfg.max_seq
+    out = {"metric": "lm ep train step", "value": round(n_tok / dt),
+           "unit": "tokens/sec", "seq": cfg.max_seq, "batch": batch,
+           "experts": cfg.moe_experts, "devices": n}
+    if not on_tpu:
+        out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+SECTIONS = {"train": bench_train, "sp": bench_sp, "decode": bench_decode,
+            "pp": bench_pp, "ep": bench_ep}
 
 
 def main() -> None:
@@ -200,7 +272,8 @@ def main() -> None:
         # error lines carry the SAME metric names as success lines so
         # cross-round artifact consumers see one series in two states
         metric_names = {"train": "lm train step", "sp": "lm sp train step",
-                        "decode": "lm decode (kv cache)"}
+                        "decode": "lm decode (kv cache)",
+                        "pp": "lm pp train step", "ep": "lm ep train step"}
         for name in names:
             print(json.dumps({"metric": metric_names[name], "value": None,
                               "error": f"accelerator unreachable: {e}"}))
