@@ -119,34 +119,57 @@ def enable_compile_cache() -> None:
             pass
 
 
+def submit_and_time(server, configs, timeout_s: float):
+    """Submit ``configs`` together; wait for all; returns {job_id:
+    seconds-from-common-start}, stamped by done-callbacks so a job
+    finishing before an earlier-submitted one gets ITS OWN completion
+    time. Shared by bench.py and benchmarks/fairness.py."""
+    job_walls: dict = {}
+    t0 = time.perf_counter()
+
+    def stamp(job_id):
+        return lambda _f: job_walls.setdefault(
+            job_id, round(time.perf_counter() - t0, 2))
+
+    futures = []
+    for c in configs:
+        f = server.submit(c)
+        f.add_done_callback(stamp(c.job_id))
+        futures.append(f)
+    for f in futures:
+        f.result(timeout=timeout_s)
+    return job_walls
+
+
 def run_concurrent(devices, scale: float, job_timeout: float = 900.0,
-                   epochs: int = EPOCHS) -> float:
+                   epochs: int = EPOCHS) -> "tuple[float, dict]":
     """Submit the three jobs concurrently to one JobServer over ``devices``;
-    aggregate samples/sec = total examples / wall. ``job_timeout`` bounds
-    each job: tight for the accelerator pass (a wedged chip must surface as
-    an error line, not a stall), looser for the slow-but-healthy CPU
-    reference pass."""
+    returns (aggregate samples/sec = total examples / wall, per-job wall
+    seconds). ``job_timeout`` bounds each job: tight for the accelerator
+    pass (a wedged chip must surface as an error line, not a stall),
+    looser for the slow-but-healthy CPU reference pass."""
     configs, totals = job_configs(scale, epochs)
     server = JobServer(num_executors=len(devices),
                        device_pool=DevicePool(devices))
     server.start()
     try:
         t0 = time.perf_counter()
-        futures = [server.submit(c) for c in configs]
-        for f in futures:
-            f.result(timeout=job_timeout)
+        job_walls = submit_and_time(server, configs, job_timeout)
         wall = time.perf_counter() - t0
     finally:
         server.shutdown(timeout=120)
     total = sum(totals.values())
     rate = total / wall
+    # per-job completion: the aggregate is bounded by the LAST job, so
+    # the straggler app is the next perf target — make it visible
     print(f"  {len(configs)} jobs, {total} examples, {wall:.1f}s "
-          f"-> {rate:,.0f} samples/sec aggregate", file=sys.stderr)
+          f"-> {rate:,.0f} samples/sec aggregate; per-job {job_walls}",
+          file=sys.stderr)
     from harmony_tpu.data import devcache
     from harmony_tpu.runtime import progcache
     print(f"  progcache {progcache.stats()}  devcache {devcache.stats()}",
           file=sys.stderr)
-    return rate
+    return rate, job_walls
 
 
 def probe_accelerator(attempts: int = 3, timeout_s: float = 60.0) -> str:
@@ -198,14 +221,16 @@ def cpu_baseline_rate() -> float:
         for i in range(2):
             print(f"concurrent MLR+NMF+LDA on cpu (reduced size, "
                   f"pass {i + 1}/2):", file=sys.stderr)
-            rates.append(run_concurrent(cpu, scale=0.125, job_timeout=3600.0))
+            rates.append(run_concurrent(cpu, scale=0.125,
+                                        job_timeout=3600.0)[0])
         return max(rates)
     except Exception as e:  # pragma: no cover - cpu backend always present
         print(f"cpu baseline unavailable: {e}", file=sys.stderr)
         return 0.0
 
 
-def emit(tpu_rate: float, cpu_rate: float, error: str | None = None) -> None:
+def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
+         job_walls: dict | None = None) -> None:
     vs = tpu_rate / cpu_rate if cpu_rate > 0 else 0.0
     line = {
         "metric": METRIC,
@@ -216,6 +241,10 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None) -> None:
         "mode": "3 concurrent jobs, num_workers=1 each (single chip); "
                 "steady-state (compile warmed on both backends)",
     }
+    if job_walls:
+        # the aggregate is bounded by the LAST job: the straggler app
+        # named here is the next perf target
+        line["accel_job_walls_s"] = job_walls
     if error:
         line["error"] = error
         # Provenance for readers of an error line: the most recent committed
@@ -265,12 +294,12 @@ def main():
         print("accelerator warmup (compile) pass:", file=sys.stderr)
         run_concurrent(accel, scale=1.0, epochs=1)
         print("concurrent MLR+NMF+LDA on accelerator:", file=sys.stderr)
-        tpu_rate = run_concurrent(accel, scale=1.0)
+        tpu_rate, tpu_walls = run_concurrent(accel, scale=1.0)
     except Exception as e:  # a half-dead transport must still yield a line
         emit(0.0, cpu_baseline_rate(),
              error=f"accelerator run failed: {type(e).__name__}: {e}")
         return
-    emit(tpu_rate, cpu_baseline_rate())
+    emit(tpu_rate, cpu_baseline_rate(), job_walls=tpu_walls)
 
 
 if __name__ == "__main__":

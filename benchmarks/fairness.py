@@ -23,7 +23,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -42,35 +41,19 @@ EPOCHS = 6  # shorter than the headline: 4 passes of the 3-job set
 
 
 def _run(devices, configs, timeout_s: float = 1800.0, scheduler=None):
-    """Submit ``configs`` together; returns {job_id: wall_seconds}.
+    """Submit ``configs`` together; returns {job_id: wall_seconds} from
+    the common start (bench.submit_and_time: done-callback stamping, so a
+    fast tenant isn't charged a slow one's completion)."""
+    from bench import submit_and_time
 
-    Completion is stamped by a done-callback, not by the await loop —
-    a job finishing before an earlier-submitted one must get ITS OWN
-    completion time (waiting in submission order would inflate it)."""
     server = JobServer(num_executors=len(devices),
                        device_pool=DevicePool(devices),
                        scheduler=scheduler)
     server.start()
-    walls = {}
     try:
-        t0 = time.perf_counter()
-
-        def stamp(job_id):
-            # bind job_id now; the wall captures queueing + interference,
-            # which is what the tenant experiences from submit time
-            return lambda _f: walls.setdefault(
-                job_id, time.perf_counter() - t0)
-
-        futures = []
-        for c in configs:
-            f = server.submit(c)
-            f.add_done_callback(stamp(c.job_id))
-            futures.append(f)
-        for f in futures:
-            f.result(timeout=timeout_s)
+        return submit_and_time(server, configs, timeout_s)
     finally:
         server.shutdown(timeout=120)
-    return walls
 
 
 def main() -> None:

@@ -234,12 +234,11 @@ def bench_ep() -> dict:
                 "unit": "tokens/sec", "note": "needs >=2 devices"}
     on_tpu = tpu_backend()
     base, _ = _model(on_tpu)
-    cfg = TransformerConfig(
-        vocab_size=base.vocab_size, d_model=base.d_model,
-        n_heads=base.n_heads, n_layers=base.n_layers, d_ff=base.d_ff,
-        max_seq=base.max_seq, attn="auto", dtype=base.dtype,
-        moe_experts=2 * n, moe_every=2,
-    )
+    import dataclasses
+
+    # replace, not a field-by-field copy: ep must benchmark exactly the
+    # model the other sections use, plus the MoE fields
+    cfg = dataclasses.replace(base, moe_experts=2 * n, moe_every=2)
     model = TransformerLM(cfg)
     mesh = build_mesh(devs, data=n, model=1)
     step, shard = make_ep_train_step(model, mesh, learning_rate=0.1,
