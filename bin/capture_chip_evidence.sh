@@ -47,6 +47,9 @@ run_stage "benchmarks/LM_${SUF}.json" python benchmarks/lm.py train
 echo "== headline overhead profile (benchmarks/profile_headline.py)"
 run_stage "benchmarks/PROFILE_${SUF}.json" python benchmarks/profile_headline.py primitives
 
+echo "== per-app throughput (benchmarks/apps.py — straggler diagnosis)"
+run_stage "benchmarks/APPS_${SUF}.json" python benchmarks/apps.py all
+
 echo "== single-chip compile check (__graft_entry__.entry)"
 python - <<'EOF'
 import json, time
