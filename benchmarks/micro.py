@@ -28,11 +28,14 @@ measures (b) plus the other primitives a capacity-planning reader needs:
   ringflash  the ring-attention flash inner compiled under shard_map —
              correctness + speed vs the einsum inner (gates flipping
              ring_attention's inner='auto' to flash-on-TPU).
+  stall      job stall during a live migration: an MLR job trains while
+             an executor drains; reports the blocking move, the next
+             epoch's relayout overhead, and bytes moved.
 
 Attention also reports achieved FLOP/s + MFU. MFU is null off-TPU (no
 meaningful peak). Run on the real chip and commit the JSON.
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|all]
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|stall|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -348,6 +351,92 @@ def bench_sparse() -> dict:
             "devices": len(mesh.devices.flat)}
 
 
+def bench_stall() -> dict:
+    """Job stall during a live migration (BASELINE.md measurement plan:
+    're-sharding cost: blocks moved x bytes, job stall time during
+    migration'). An MLR job trains over 2 executors; after a mid epoch,
+    executor 0 DRAINS — all its blocks move to executor 1, shrinking the
+    owning set so the table physically re-materializes on the new layout
+    (a move that keeps the owning set is just an ownership-map edit; see
+    TableHandle.move_blocks). Reported: the blocking move itself, the
+    migrated-vs-clean epoch overhead (the next dispatch rebuilds for the
+    new layout), and bytes moved."""
+    from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+    from harmony_tpu.config.params import TrainerParams
+    from harmony_tpu.dolphin import (
+        TrainerContext, TrainingDataProvider, WorkerTasklet,
+    )
+    from harmony_tpu.metrics.collector import EpochMetrics, MetricCollector
+    from harmony_tpu.parallel.mesh import DevicePool
+    from harmony_tpu.runtime.master import ETMaster
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"metric": "live migration stall", "value": None,
+                "unit": "sec", "note": "needs >=2 devices"}
+    master = ETMaster(DevicePool(devs[:2]))
+    exs = master.add_executors(2)
+    # the headline MLR shape (8 MB model) so the move transfers real bytes
+    trainer = MLRTrainer(num_classes=256, num_features=8192,
+                         features_per_partition=512)
+    handle = master.create_table(trainer.model_table_config(),
+                                 [e.id for e in exs])
+    epochs, nb, mig_epoch = 9, 4, 4
+    x, y = make_synthetic(512, num_features=8192, num_classes=256)
+    spec = handle.table.spec
+    row_bytes = int(np.prod(spec.value_shape)) * spec.dtype.itemsize
+    moved = {}
+
+    def on_epoch(epoch):
+        if epoch != mig_epoch:
+            return
+        # drain ALL of ex0's blocks: the owning set shrinks, forcing the
+        # physical re-materialization a partial move would skip
+        n_move = handle.block_manager.block_counts()[exs[0].id]
+        t0 = time.perf_counter()
+        handle.move_blocks(exs[0].id, exs[1].id, n_move)
+        moved["sec"] = time.perf_counter() - t0
+        moved["blocks"] = n_move
+        moved["bytes"] = n_move * spec.block_size * row_bytes
+        moved["owners_after"] = len(handle.owning_executors())
+
+    walls: dict = {}
+    collector = MetricCollector(
+        sink=lambda m: walls.__setitem__(m.epoch_idx, m.epoch_time_sec)
+        if isinstance(m, EpochMetrics) else None)
+    worker = WorkerTasklet(
+        "stall-bench",
+        TrainerContext(params=TrainerParams(num_epochs=epochs,
+                                            num_mini_batches=nb,
+                                            comm_probe_period=0),
+                       model_table=handle.table),
+        trainer,
+        TrainingDataProvider([x, y], nb),
+        handle.table.mesh,
+        collector=collector,
+        epoch_callback=on_epoch,
+    )
+    worker.run()
+    # epoch AFTER the move pays the relayout (rebuild + recompile); clean
+    # epochs exclude epoch 0 (first-compile) and the two around the move
+    clean = [w for e, w in walls.items()
+             if e not in (0, mig_epoch, mig_epoch + 1)]
+    clean_med = sorted(clean)[len(clean) // 2]
+    relayout = max(walls[mig_epoch + 1] - clean_med, 0.0)
+    assert moved["owners_after"] == 1, "drain must shrink the owning set"
+    return {
+        "metric": "live migration stall",
+        "value": round(moved["sec"] + relayout, 3),
+        "unit": "sec",
+        "move_sec": round(moved["sec"], 3),
+        "relayout_epoch_overhead_sec": round(relayout, 3),
+        "blocks_moved": moved["blocks"],
+        "bytes_moved": moved["bytes"],
+        "clean_epoch_sec": round(clean_med, 3),
+        "devices": 2,
+    }
+
+
 SECTIONS = {
     "table": bench_table,
     "reshard": bench_reshard,
@@ -357,6 +446,7 @@ SECTIONS = {
     "mxu": bench_mxu,
     "mxupush": bench_mxupush,
     "ringflash": bench_ringflash,
+    "stall": bench_stall,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -369,6 +459,7 @@ SECTION_METRICS = {
     "sparse": ("sparse table fused pull+push", "keys/sec"),
     "mxu": ("mxu_dot bf16 achieved", "TFLOP/s"),
     "mxupush": ("mxu push route", "GB/s"),
+    "stall": ("live migration stall", "sec"),
 }
 
 
