@@ -392,9 +392,15 @@ def bench_stall() -> dict:
             return
         # drain ALL of ex0's blocks: the owning set shrinks, forcing the
         # physical re-materialization a partial move would skip
+        from harmony_tpu.utils.platform import hard_sync
+
         n_move = handle.block_manager.block_counts()[exs[0].id]
         t0 = time.perf_counter()
         handle.move_blocks(exs[0].id, exs[1].id, n_move)
+        # sync INSIDE the timed region: device_put returns before bytes
+        # move on async/lazy backends, and the transfer would otherwise
+        # masquerade as the next epoch's relayout overhead
+        hard_sync(handle.table.array)
         moved["sec"] = time.perf_counter() - t0
         moved["blocks"] = n_move
         moved["bytes"] = n_move * spec.block_size * row_bytes

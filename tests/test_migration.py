@@ -181,6 +181,60 @@ class TestLiveMigration:
             np.asarray(handle.table.pull_array()), np.full((16, 2), expected)
         )
 
+    def test_concurrent_migration_during_epoch_window(self, devices):
+        """A reshard landing MID-WINDOW: with probes off and no barrier the
+        worker dispatches multi-epoch fused windows, and a concurrent
+        plan-driven migration must be absorbed by the window's per-dispatch
+        retry (layout race -> rebuild -> redispatch) with exact sums
+        preserved."""
+        pool = DevicePool(devices[:4])
+        master = ETMaster(pool)
+        exs = master.add_executors(2)
+        trainer = AddVectorTrainer(num_keys=16, vector_dim=2, delta=1.0)
+        handle = master.create_table(trainer.model_table_config(),
+                                     [e.id for e in exs])
+        n, epochs, nb = 128, 16, 4
+        params = TrainerParams(num_epochs=epochs, num_mini_batches=nb,
+                               comm_probe_period=0)  # windows active
+        ctx = TrainerContext(params=params, model_table=handle.table)
+        worker = WorkerTasklet(
+            "win-mig", ctx, trainer,
+            TrainingDataProvider(list(make_marks(n)), nb),
+            handle.table.mesh,
+        )
+        assert worker._epoch_window_len(0, epochs) > 1
+        errors = []
+
+        def migrate():
+            try:
+                time.sleep(0.05)
+                plan = ETPlan()
+                alloc = plan.add_op(AllocateOp("wm"))
+                assoc = plan.add_op(
+                    AssociateOp(handle.table_id, "wm"), depends_on=[alloc]
+                )
+                plan.add_op(
+                    MoveOp(handle.table_id, exs[0].id, "wm", 4),
+                    depends_on=[assoc],
+                )
+                r = PlanExecutor(master).execute(plan)
+                if not r.success:
+                    errors.append(r.error)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=migrate)
+        t.start()
+        result = worker.run()
+        t.join(timeout=30)
+        assert not errors, errors
+        assert result["epochs_run"] == epochs
+        expected = trainer.expected_value(n * epochs)
+        np.testing.assert_allclose(
+            np.asarray(handle.table.pull_array()), np.full((16, 2), expected)
+        )
+        assert len(handle.owning_executors()) == 3
+
 
 class TestSparseTableMigration:
     def test_concurrent_migration_during_sparse_training(self, devices):
