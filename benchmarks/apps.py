@@ -43,7 +43,7 @@ def _sparse_jobs():
         job_id="bench-fm", app_type="dolphin",
         trainer="harmony_tpu.apps.widedeep:FMTrainer",
         params=TrainerParams(
-            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            num_epochs=EPOCHS, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"vocab_size": 100_000, "num_slots": 16,
                         "emb_dim": 16, "step_size": 0.1},
         ),
@@ -56,7 +56,7 @@ def _sparse_jobs():
         job_id="bench-widedeep", app_type="dolphin",
         trainer="harmony_tpu.apps.widedeep:WideDeepTrainer",
         params=TrainerParams(
-            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            num_epochs=EPOCHS, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"vocab_size": 100_000, "num_slots": 16,
                         "emb_dim": 16, "hidden": 128, "step_size": 0.1},
         ),
@@ -72,7 +72,7 @@ def _sparse_jobs():
         job_id="bench-fm-hash", app_type="dolphin",
         trainer="harmony_tpu.apps.widedeep:FMTrainer",
         params=TrainerParams(
-            num_epochs=EPOCHS, num_mini_batches=BATCHES,
+            num_epochs=EPOCHS, num_mini_batches=BATCHES, comm_probe_period=6,
             app_params={"vocab_size": 100_000, "num_slots": 16,
                         "emb_dim": 16, "step_size": 0.1, "sparse": True},
         ),

@@ -175,6 +175,7 @@ class TestLiveMigration:
         t.start()
         worker.run()
         t.join(timeout=30)
+        assert not t.is_alive(), "migration thread wedged (>30s)"
         assert not errors, errors
         expected = trainer.expected_value(n * epochs)
         np.testing.assert_allclose(
@@ -227,6 +228,7 @@ class TestLiveMigration:
         t.start()
         result = worker.run()
         t.join(timeout=30)
+        assert not t.is_alive(), "migration thread wedged (>30s)"
         assert not errors, errors
         assert result["epochs_run"] == epochs
         expected = trainer.expected_value(n * epochs)
@@ -283,6 +285,7 @@ class TestSparseTableMigration:
         t.start()
         result = worker.run()
         t.join(timeout=30)
+        assert not t.is_alive(), "migration thread wedged (>30s)"
         assert not errors, errors
         # training remained healthy through the migration
         assert result["losses"][-1] < result["losses"][0], result["losses"]
