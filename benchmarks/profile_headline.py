@@ -146,10 +146,31 @@ def bench_phases(epochs: int = 3) -> dict:
 SECTIONS = {"primitives": bench_primitives, "phases": bench_phases}
 
 
+METRIC_UNITS = {"primitives": ("headline primitives", "ms dispatch RTT"),
+                "phases": ("headline phase profile (1 MLR job)", "s")}
+
+
 def main():
     names = sys.argv[1:] or ["primitives", "phases"]
     if names == ["all"]:
         names = ["primitives", "phases"]
+    unknown = [n for n in names if n not in SECTIONS]
+    if unknown:
+        sys.exit(f"unknown section(s) {unknown}; have {sorted(SECTIONS)} or 'all'")
+    # bounded discovery BEFORE any section touches jax.devices(): on a
+    # wedged transport the first device call blocks forever, and this
+    # file runs unattended inside the capture bundle
+    from harmony_tpu.utils.devices import discover_devices
+
+    try:
+        discover_devices()
+    except RuntimeError as e:
+        for n in names:
+            metric, unit = METRIC_UNITS[n]
+            print(json.dumps({"metric": metric, "value": None, "unit": unit,
+                              "error": f"accelerator unreachable: {e}"}),
+                  flush=True)
+        return
     for n in names:
         print(json.dumps(SECTIONS[n]()), flush=True)
 

@@ -8,10 +8,12 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 SUF="${1:-local}"
 
+STAGE_TIMEOUT="${STAGE_TIMEOUT:-1800}"  # a wedged stage must not hang the bundle
+
 run_stage() {  # run_stage <artifact> <cmd...>: a crash still records JSON
   local out="$1"; shift
   local rc=0
-  "$@" > "$out.tmp" || rc=$?
+  timeout "$STAGE_TIMEOUT" "$@" > "$out.tmp" || rc=$?
   if [ "$rc" -ne 0 ]; then
     rm -f "$out.tmp"
     echo "{\"metric\": \"$(basename "$out" .json)\", \"value\": null," \
@@ -51,7 +53,8 @@ echo "== per-app throughput (benchmarks/apps.py — straggler diagnosis)"
 run_stage "benchmarks/APPS_${SUF}.json" python benchmarks/apps.py all
 
 echo "== single-chip compile check (__graft_entry__.entry)"
-python - <<'EOF'
+entry_rc=0
+timeout "$STAGE_TIMEOUT" python - <<'EOF' || entry_rc=$?
 import json, time
 from harmony_tpu.utils.devices import discover_devices
 try:
@@ -74,3 +77,8 @@ print(json.dumps({"metric": "entry forward", "device": str(devs[0]),
                   "compile_sec": round(compile_s, 1),
                   "step_ms": round((time.perf_counter() - t0) * 1e3, 2)}))
 EOF
+if [ "$entry_rc" -ne 0 ]; then
+  # same contract as run_stage: a killed/crashed stage still records JSON
+  echo "{\"metric\": \"entry forward\", \"value\": null," \
+       "\"error\": \"stage crashed or timed out (rc=$entry_rc)\"}"
+fi
