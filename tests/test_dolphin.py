@@ -152,6 +152,14 @@ class TestEpochWindow:
         assert worker(4)._epoch_window_len(0, 12) == 4
         # probes off: the class cap applies
         assert worker(0)._epoch_window_len(0, 12) == 8
+        # resume: cadence is relative to starting_epoch, so a worker
+        # resumed at epoch 3 still probes at 3, 7, 11 and windows align
+        w = worker(4, starting_epoch=3)
+        assert w._epoch_window_len(3, 12) == 4
+        assert w._epoch_window_len(7, 12) == 4
+        assert w._epoch_window_len(11, 12) == 1  # last epoch
+        # remaining epochs bound the window
+        assert worker(0)._epoch_window_len(10, 12) == 2
         # non-deferrable epoch callback (checkpoint chains) disables windows
         w = worker(0, epoch_callback=lambda e: None)
         assert w._epoch_window_len(0, 12) == 1

@@ -141,3 +141,132 @@ class TestPlatformDetection:
         from harmony_tpu.utils.platform import tpu_backend
 
         assert tpu_backend() is False  # conftest pins the cpu backend
+
+
+class TestHardSync:
+    """hard_sync is the sync primitive every timing/backpressure site
+    relies on: exactly block_until_ready on honest backends, and a
+    device-side scalar read on lazy-dispatch backends (the axon remote
+    client acks block_until_ready without executing)."""
+
+    def test_not_lazy_on_cpu(self):
+        from harmony_tpu.utils import platform as plat
+
+        plat._LAZY_CACHE = None  # force re-detection
+        assert plat.lazy_dispatch_backend() is False
+
+    def test_returns_input_identity(self):
+        import jax.numpy as jnp
+
+        from harmony_tpu.utils.platform import hard_sync
+
+        x = {"a": jnp.ones((3,)), "b": (jnp.arange(2), None)}
+        assert hard_sync(x) is x
+
+    def test_forced_lazy_reads_all_leaf_kinds(self, monkeypatch):
+        """With the lazy path forced, the read must survive floats, ints,
+        bools, typed PRNG keys (no astype), empty leaves, and non-array
+        entries."""
+        import jax
+        import jax.numpy as jnp
+
+        from harmony_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "_LAZY_CACHE", True)
+        out = {
+            "f": jnp.ones((4, 2)),
+            "i": jnp.arange(3),
+            "b": jnp.array([True, False]),
+            "key": jax.random.key(7),
+            "empty": jnp.zeros((0,)),
+            "none": None,
+            "scalar": 3.5,
+        }
+        assert plat.hard_sync(out) is out
+        assert plat.hard_sync(jax.random.key(0)) is not None
+        assert plat.hard_sync({}) == {}
+
+    def test_forced_lazy_fallback_reads_each_leaf(self, monkeypatch):
+        """The cross-device ValueError fallback must read every leaf
+        separately. The fused-sum path can't fail on a CPU mesh, so the
+        failure is injected: the FIRST ravel raises (standing in for the
+        cross-device `acc + v`), and the per-leaf fallback must then
+        ravel each of the leaves."""
+        import jax
+        import jax.numpy as jnp
+
+        from harmony_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "_LAZY_CACHE", True)
+        calls = {"n": 0}
+        real_ravel = jnp.ravel
+
+        def flaky_ravel(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("incompatible devices (injected)")
+            return real_ravel(x)
+
+        monkeypatch.setattr(jax.numpy, "ravel", flaky_ravel)
+        out = {"a": jnp.ones((3,)), "b": jnp.arange(4)}
+        assert plat.hard_sync(out) is out
+        # 1 aborted fused attempt + one ravel per leaf in the fallback
+        assert calls["n"] == 1 + len(out)
+
+    def test_forced_lazy_multi_device_leaves_enter_dispatch_scope(
+        self, monkeypatch, devices
+    ):
+        """Sharded leaves must route the reads through the process-wide
+        dispatch scope — asserted via a spy, not assumed."""
+        import contextlib
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from harmony_tpu.parallel import build_mesh, dispatch
+        from harmony_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "_LAZY_CACHE", True)
+        entered = []
+
+        @contextlib.contextmanager
+        def spy_scope(mesh):
+            entered.append(mesh)
+            yield lambda x: x
+
+        monkeypatch.setattr(dispatch, "dispatch_scope", spy_scope)
+        mesh = build_mesh(devices, data=len(devices))
+        x = jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P("data")))
+        plat.hard_sync({"x": x, "y": jnp.ones((2,))})
+        assert entered == [mesh]
+        # single-device leaves skip the scope entirely
+        entered.clear()
+        plat.hard_sync(jnp.ones((4,)))
+        assert entered == []
+
+
+class TestEnvChoice:
+    """Operator rollback knobs must warn (once) on unrecognized values
+    instead of silently staying on the default."""
+
+    def test_valid_and_missing(self, monkeypatch):
+        from harmony_tpu.utils.platform import env_choice
+
+        monkeypatch.delenv("X_KNOB", raising=False)
+        assert env_choice("X_KNOB", ("a", "b")) is None
+        monkeypatch.setenv("X_KNOB", "b")
+        assert env_choice("X_KNOB", ("a", "b")) == "b"
+
+    def test_invalid_warns_once_and_ignores(self, monkeypatch, caplog):
+        import logging
+
+        from harmony_tpu.utils import platform as plat
+
+        monkeypatch.setattr(plat, "_WARNED_ENV", set())
+        monkeypatch.setenv("Y_KNOB", "Bogus")
+        with caplog.at_level(logging.WARNING):
+            assert plat.env_choice("Y_KNOB", ("a", "b")) is None
+            assert plat.env_choice("Y_KNOB", ("a", "b")) is None
+        warns = [r for r in caplog.records if "Y_KNOB" in r.getMessage()]
+        assert len(warns) == 1  # once, not per call
