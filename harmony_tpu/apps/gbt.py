@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harmony_tpu.config.params import TableConfig
-from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+from harmony_tpu.dolphin.trainer import Trainer
 
 
 class GBTTrainer(Trainer):

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from harmony_tpu.config.params import TableConfig, TrainerParams
+from harmony_tpu.config.params import TableConfig
 from harmony_tpu.ops.mxu import mxu_dot
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
 

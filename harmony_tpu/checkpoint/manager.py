@@ -39,7 +39,6 @@ from harmony_tpu import native
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.runtime.master import ETMaster, TableHandle
-from harmony_tpu.table.table import TableSpec
 
 
 def _write_block(d: str, bid: int, arr: np.ndarray) -> None:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import json
-from typing import Any, Callable, Dict, Type, TypeVar
+from typing import Any, Dict, Type, TypeVar
 
 _REGISTRY: Dict[str, type] = {}
 

@@ -13,7 +13,7 @@ how a fused step genuinely has no separable phases.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

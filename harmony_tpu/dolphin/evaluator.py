@@ -9,7 +9,7 @@ deferred to server shutdown — DolphinMaster.evaluate()).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np

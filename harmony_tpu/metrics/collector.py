@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import field
 from typing import Any, Callable, Dict, List, Optional
 
 from harmony_tpu.config.base import ConfigBase, config

@@ -20,7 +20,7 @@ leading dim of the params is sharded over that mesh axis (inside shard_map).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

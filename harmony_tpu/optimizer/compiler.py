@@ -11,7 +11,7 @@ tasklet_runner is wired.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from harmony_tpu.optimizer.api import DolphinPlan
 from harmony_tpu.plan.ops import (

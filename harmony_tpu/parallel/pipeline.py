@@ -19,7 +19,6 @@ wraps it for host-level use over a mesh.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 from harmony_tpu.plan.ops import Op, PlanContext
 from harmony_tpu.plan.plan import ETPlan

@@ -6,7 +6,7 @@ and marks completion to release dependents.
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Tuple
+from typing import Iterable, List, Optional
 
 from harmony_tpu.plan.ops import Op
 from harmony_tpu.utils.dag import DAG

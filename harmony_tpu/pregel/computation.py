@@ -19,7 +19,7 @@ functions over the whole partition —
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 

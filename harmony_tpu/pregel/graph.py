@@ -7,7 +7,7 @@ message scatter needs; vertex *state* lives in a DenseTable (see master.py).
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -21,8 +21,7 @@ the model axis.
 from __future__ import annotations
 
 import time
-from functools import partial
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -34,7 +34,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Hashable, Optional, Tuple
 
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 
 _MAX_ENTRIES = 32
 _lock = threading.Lock()

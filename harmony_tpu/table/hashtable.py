@@ -43,11 +43,10 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.parallel.dispatch import dispatch_scope
-from harmony_tpu.parallel.mesh import MODEL_AXIS
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 # Stored-key encoding: key k (MIN_KEY <= k <= MAX_KEY) is stored as -(k + 2);

@@ -17,7 +17,7 @@ path.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+from typing import Iterator
 
 from harmony_tpu.tracing.span import trace_span
 

@@ -9,7 +9,7 @@ ops from multiple threads.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Generic, Iterable, List, Set, TypeVar
+from typing import Dict, Generic, List, Set, TypeVar
 
 V = TypeVar("V")
 
