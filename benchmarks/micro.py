@@ -31,11 +31,14 @@ measures (b) plus the other primitives a capacity-planning reader needs:
   stall      job stall during a live migration: an MLR job trains while
              an executor drains; reports the blocking move, the next
              epoch's relayout overhead, and bytes moved.
+  chkp       two-stage checkpoint save/commit/restore throughput on a
+             64 MB table (.blk v2 codec when the native lib is built;
+             commit copies into staging then renames, so it is O(size)).
 
 Attention also reports achieved FLOP/s + MFU. MFU is null off-TPU (no
 meaningful peak). Run on the real chip and commit the JSON.
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|stall|all]
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|stall|chkp|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -443,6 +446,63 @@ def bench_stall() -> dict:
     }
 
 
+def bench_chkp() -> dict:
+    """Two-stage checkpoint save/commit/restore throughput on a 64 MB
+    table (the reference's ChkpManagerSlave temp->HDFS path; here the
+    native .blk v2 codec + posix rename commit — SURVEY §3.5)."""
+    import shutil
+    import tempfile
+
+    from harmony_tpu.checkpoint.manager import CheckpointManager
+    from harmony_tpu.parallel.mesh import DevicePool
+    from harmony_tpu.runtime.master import ETMaster
+
+    devs = jax.devices()
+    master = ETMaster(DevicePool(devs[: min(2, len(devs))]))
+    exs = master.add_executors(min(2, len(devs)))
+    capacity, width = 65536, 256                     # 64 MB fp32
+    handle = master.create_table(
+        TableConfig(table_id="bench-ck", capacity=capacity,
+                    value_shape=(width,), num_blocks=64, update_fn="add"),
+        [e.id for e in exs],
+    )
+    model_mb = capacity * width * 4 / 2**20
+    from harmony_tpu import native
+    from harmony_tpu.utils.platform import hard_sync
+
+    # the table's device-side init must not bill to the stage timer
+    hard_sync(handle.table.array)
+    root = tempfile.mkdtemp(prefix="harmony-chkp-bench-")
+    try:
+        mgr = CheckpointManager(os.path.join(root, "temp"),
+                                os.path.join(root, "commit"))
+        t0 = time.perf_counter()
+        cid = mgr.checkpoint(handle)                 # stage (device->disk)
+        t_stage = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        # durable commit: copies blocks into staging then renames — O(size)
+        mgr.commit(cid)
+        t_commit = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restored = mgr.restore(master, cid, [e.id for e in exs],
+                               table_id="bench-ck-r")
+        np.asarray(restored.table.pull_array())      # force materialization
+        t_restore = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "metric": "checkpoint save/restore",
+        "value": round(model_mb / t_stage, 1),
+        "unit": "MB/s stage",
+        "model_mb": round(model_mb),
+        "codec": "blk" if native.available() else "npy",
+        "stage_s": round(t_stage, 2),
+        "commit_s": round(t_commit, 3),
+        "restore_mbps": round(model_mb / t_restore, 1),
+        "restore_s": round(t_restore, 2),
+    }
+
+
 SECTIONS = {
     "table": bench_table,
     "reshard": bench_reshard,
@@ -453,6 +513,7 @@ SECTIONS = {
     "mxupush": bench_mxupush,
     "ringflash": bench_ringflash,
     "stall": bench_stall,
+    "chkp": bench_chkp,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -466,6 +527,7 @@ SECTION_METRICS = {
     "mxu": ("mxu_dot bf16 achieved", "TFLOP/s"),
     "mxupush": ("mxu push route", "GB/s"),
     "stall": ("live migration stall", "sec"),
+    "chkp": ("checkpoint save/restore", "MB/s stage"),
 }
 
 
