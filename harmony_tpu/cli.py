@@ -298,6 +298,9 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--num-executors", type=int, default=0,
                    help="0 = one per local device")
     p.add_argument("--port", type=int, default=43110)
+    p.add_argument("--dashboard-url", default=None,
+                   help="POST live job metrics to this dashboard "
+                        "(harmony-tpu dashboard prints its URL)")
 
     for name in ("submit", "run"):
         p = sub.add_parser(
@@ -378,7 +381,7 @@ def main(argv: List[str] | None = None) -> int:
     raise SystemExit(f"unknown command {args.cmd}")
 
 
-def _make_server(num_executors: int):
+def _make_server(num_executors: int, dashboard_url=None):
     from harmony_tpu.jobserver.server import JobServer
     from harmony_tpu.utils.devices import discover_devices
 
@@ -387,13 +390,14 @@ def _make_server(num_executors: int):
     # must fail with a diagnosis instead.
     devices = discover_devices()
     n = num_executors or len(devices)
-    server = JobServer(num_executors=n)
+    server = JobServer(num_executors=n, dashboard_url=dashboard_url)
     server.start()
     return server
 
 
 def _cmd_start_jobserver(args: argparse.Namespace) -> int:
-    server = _make_server(args.num_executors)
+    server = _make_server(args.num_executors,
+                          dashboard_url=args.dashboard_url)
     port = server.serve_tcp(args.port)
     print(f"jobserver ready on port {port}", flush=True)
     try:

@@ -37,7 +37,18 @@ class DashboardConnector:
             self.dropped += 1
 
     def metric_sink(self, metric) -> None:
-        """Adapter for MetricCollector sinks: forwards dataclass metrics."""
+        """Adapter for MetricCollector sinks: dataclass metrics forward
+        with their type name; PLAIN-DICT records (custom metrics from
+        add_custom_metric — MetricCollector.flush emits them undecorated,
+        and vars(dict) raises) forward as kind "custom"; anything else is
+        skipped — this sink must never fail the worker's flush path."""
+        if isinstance(metric, dict):
+            payload = {k: v for k, v in metric.items()
+                       if isinstance(v, (int, float, str))}
+            self.post(str(metric.get("job_id", "")), "custom", payload)
+            return
+        if not hasattr(metric, "__dict__"):
+            return
         kind = type(metric).__name__
         job_id = getattr(metric, "job_id", "")
         payload = {

@@ -52,6 +52,7 @@ class JobServer:
         cpu_slots: int = 1,
         net_slots: int = 2,
         chkp_root: Optional[str] = None,
+        dashboard_url: Optional[str] = None,
     ) -> None:
         if isinstance(scheduler, str):
             scheduler = make_scheduler(scheduler)  # the -scheduler flag analogue
@@ -67,6 +68,15 @@ class JobServer:
         self.master = ETMaster(device_pool)
         self.metrics = MetricManager()
         self.metrics.start_collection()
+        # Live metrics to a dashboard (ref: DolphinDriver POSTing to the
+        # Flask dashboard via DashboardConnector.java:30-100): every job
+        # metric tees to the async connector, which drops rather than
+        # blocks when the dashboard is slow or down.
+        self._dashboard = None
+        if dashboard_url:
+            from harmony_tpu.dashboard.connector import DashboardConnector
+
+            self._dashboard = DashboardConnector(dashboard_url)
         self.global_taskunit = GlobalTaskUnitScheduler()
         self.local_taskunit = LocalTaskUnitScheduler(cpu_slots, net_slots)
         self._scheduler = scheduler or ShareAllScheduler()
@@ -83,6 +93,14 @@ class JobServer:
         self._tcp_thread: Optional[threading.Thread] = None
         self._tcp_sock: Optional[socket.socket] = None
         self.port: Optional[int] = None
+
+    def _on_metric(self, record) -> None:
+        """Every job metric lands in the manager AND (when configured)
+        tees to the dashboard connector — the manager is authoritative
+        (optimizer/queries); the dashboard is best-effort observability."""
+        self.metrics.on_metric(record)
+        if self._dashboard is not None:
+            self._dashboard.metric_sink(record)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -140,6 +158,8 @@ class JobServer:
             if t.is_alive():
                 drained = False  # straggler still owns its executors
         self._run_deferred_evals(timeout, drained)
+        if self._dashboard is not None:
+            self._dashboard.close()  # flush the async queue, then stop
         self._state.transition("CLOSED")
 
     def _run_deferred_evals(self, timeout: Optional[float], drained: bool) -> None:
@@ -245,7 +265,7 @@ class JobServer:
                 config,
                 global_taskunit=self.global_taskunit,
                 local_taskunit=self.local_taskunit,
-                metric_sink=self.metrics.on_metric,
+                metric_sink=self._on_metric,
                 chkp_root=self._chkp_root,
                 metric_manager=self.metrics,
             )

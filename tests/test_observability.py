@@ -129,17 +129,62 @@ class TestDashboard:
         try:
             conn.post("j1", "EpochMetrics", {"loss": 1.25})
             conn.metric_sink(BatchMetrics(job_id="j1", loss=0.75))
+            # plain-dict custom metrics (MetricCollector.flush emits them
+            # undecorated) must forward, not crash the sink
+            conn.metric_sink({"job_id": "j1", "bytes_sent": 10.0})
+            conn.metric_sink(object())  # unknown record types are skipped
             deadline = time.time() + 5
-            while time.time() < deadline and conn.sent < 2:
+            while time.time() < deadline and conn.sent < 3:
                 time.sleep(0.02)
-            assert conn.sent == 2
+            assert conn.sent == 3
             rows = json.loads(
                 urllib.request.urlopen(server.url + "/api/metrics?job_id=j1").read()
             )
-            assert len(rows) == 2
+            assert len(rows) == 3
+            assert {r["kind"] for r in rows} == {
+                "EpochMetrics", "BatchMetrics", "custom"}
         finally:
             conn.close()
             server.stop()
+
+    def test_jobserver_tees_metrics_to_dashboard(self, devices):
+        """JobServer(dashboard_url=...) — the reference's DolphinDriver ->
+        Flask dashboard wiring (DashboardConnector.java:30-100): a trained
+        job's metrics must land as queryable rows over HTTP, and the
+        manager (optimizer's source) must still have them too."""
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+        from harmony_tpu.jobserver import JobServer
+        from harmony_tpu.parallel import DevicePool
+
+        dash = DashboardServer().start()
+        server = JobServer(2, device_pool=DevicePool(devices[:2]),
+                           dashboard_url=dash.url)
+        server.start()
+        try:
+            cfg = JobConfig(
+                job_id="dash-mlr", app_type="dolphin",
+                trainer="harmony_tpu.apps.mlr:MLRTrainer",
+                params=TrainerParams(
+                    num_epochs=2, num_mini_batches=2,
+                    app_params={"num_classes": 2, "num_features": 8,
+                                "features_per_partition": 4},
+                ),
+                num_workers=1,
+                user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                      "data_args": {"n": 32, "num_features": 8,
+                                    "num_classes": 2}},
+            )
+            server.submit(cfg).result(timeout=300)
+            assert server.metrics.worker_batch_metrics(job_id="dash-mlr")
+            server.shutdown(timeout=60)  # close() flushes the connector
+            rows = json.loads(urllib.request.urlopen(
+                dash.url + "/api/metrics?job_id=dash-mlr").read())
+            kinds = {r["kind"] for r in rows}
+            assert any("Batch" in k or "Epoch" in k for k in kinds), kinds
+        finally:
+            if server.state != "CLOSED":
+                server.shutdown(timeout=60)
+            dash.stop()
 
     def test_connector_survives_dead_dashboard(self):
         conn = DashboardConnector("http://127.0.0.1:1")  # nothing listens
