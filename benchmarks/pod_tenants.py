@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Concurrent multi-tenant pod: per-tenant slowdown on disjoint carves.
+
+The round-2 verdict's top item: jobs must overlap ACROSS the pod, not
+serialize behind a pod lock. This artifact measures what that buys on a
+virtual 2-process/8-device pod with the pod_carve scheduler (each tenant
+gets one whole process): two MLR tenants run first in isolation, then
+concurrently, all in one pod session (warmup jobs populate both
+processes' program caches first so compile time doesn't masquerade as
+contention). Reported per tenant: wall seconds isolated vs concurrent,
+slowdown, plus Jain's fairness index over the slowdowns, the concurrent
+walls' overlap, and aggregate throughput. CPU-mesh numbers — comparable
+across rounds, not to a chip.
+
+Writes benchmarks/POD_TENANTS_r03.json; prints ONE JSON line.
+Run: python benchmarks/pod_tenants.py
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from common import free_port, sanitized_cpu_env, wait_for_ready  # noqa: E402
+
+EPOCHS = 8
+BATCHES = 4
+N = 16384
+METRIC = "pod concurrent-tenant slowdown (2-process carved pod, MLR x2)"
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "POD_TENANTS_r03.json")
+
+
+def _job(job_id: str, seed: int, epochs: int = EPOCHS):
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs, num_mini_batches=BATCHES,
+            app_params={"num_classes": 32, "num_features": 512,
+                        "features_per_partition": 64, "step_size": 0.05},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": N, "num_features": 512,
+                            "num_classes": 32, "seed": seed}},
+    )
+
+
+def _drain(sender, deadline: float) -> bool:
+    while time.monotonic() < deadline:
+        if not sender.send_status_command().get("running"):
+            return True
+        time.sleep(0.3)
+    return False
+
+
+def main() -> None:
+    worker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "pod_worker.py")
+    env = sanitized_cpu_env(4)
+    coord, pod_port, tcp_port = free_port(), free_port(), free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"127.0.0.1:{coord}", "2", str(pid),
+             str(pod_port), str(tcp_port), "pod_carve:1"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    out = {"metric": METRIC, "unit": "x slowdown (concurrent/isolated)",
+           "processes": 2, "global_devices": 8}
+    try:
+        if not wait_for_ready(procs[0], 240):
+            out.update(value=None, error="leader not ready within 240s")
+            print(json.dumps(out))
+            return
+
+        from harmony_tpu.jobserver.client import CommandSender
+
+        sender = CommandSender(tcp_port)
+        deadline = time.monotonic() + 1800
+
+        def submit(cfgs):
+            for cfg in cfgs:
+                resp = sender.send_job_submit_command(cfg)
+                if not resp.get("ok"):
+                    raise RuntimeError(f"submit failed: {resp}")
+            if not _drain(sender, deadline):
+                raise RuntimeError("drain timed out")
+
+        # 1. concurrent warmups: compile the MLR step on BOTH processes
+        submit([_job("warm-a", seed=9, epochs=1),
+                _job("warm-b", seed=8, epochs=1)])
+        # 2. isolated timed runs (sequential; warm program caches)
+        submit([_job("iso-a", seed=1)])
+        submit([_job("iso-b", seed=2)])
+        # 3. concurrent timed runs
+        submit([_job("conc-a", seed=1), _job("conc-b", seed=2)])
+
+        sender.send_shutdown_command()
+        lead_out, _ = procs[0].communicate(timeout=120)
+        procs[1].communicate(timeout=120)
+    except Exception as e:  # noqa: BLE001 - still print one line
+        out.update(value=None, error=f"{type(e).__name__}: {e}")
+        print(json.dumps(out))
+        return
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    result_lines = [ln for ln in lead_out.splitlines()
+                    if ln.startswith("RESULT ")]
+    if not result_lines:
+        out.update(value=None, error="no RESULT from leader")
+        print(json.dumps(out))
+        return
+    res = json.loads(result_lines[0][len("RESULT "):])
+    for jid in ("iso-a", "iso-b", "conc-a", "conc-b"):
+        job = res.get("local_results", {}).get(jid, {})
+        if "error" in job:
+            out.update(value=None, error=f"{jid} failed: {job['error']}")
+            print(json.dumps(out))
+            return
+    walls = res["job_walls"]
+    iso = {t: walls[f"iso-{t}"][1] - walls[f"iso-{t}"][0] for t in "ab"}
+    conc = {t: walls[f"conc-{t}"][1] - walls[f"conc-{t}"][0] for t in "ab"}
+    slow = {t: conc[t] / iso[t] for t in "ab"}
+    overlap = (min(walls["conc-a"][1], walls["conc-b"][1])
+               - max(walls["conc-a"][0], walls["conc-b"][0]))
+    vals = list(slow.values())
+    jain = sum(vals) ** 2 / (len(vals) * sum(v * v for v in vals))
+    conc_wall = (max(walls["conc-a"][1], walls["conc-b"][1])
+                 - min(walls["conc-a"][0], walls["conc-b"][0]))
+    detail = {
+        "host_cores": os.cpu_count(),
+        "note": (
+            "both pod processes share ONE host's cores in this virtual "
+            "setup, so per-tenant slowdown is floored at ~n_tenants x on a "
+            "saturated host; the signals that transfer to real multi-host "
+            "pods are jain_fairness (equal degradation, no starvation) and "
+            "concurrent_overlap_sec > 0 (true cross-pod overlap)"
+        ),
+        "isolated_wall_sec": {t: round(iso[t], 2) for t in "ab"},
+        "concurrent_wall_sec": {t: round(conc[t], 2) for t in "ab"},
+        "slowdown": {t: round(slow[t], 3) for t in "ab"},
+        "jain_fairness": round(jain, 3),
+        "concurrent_overlap_sec": round(overlap, 2),
+        "aggregate_samples_per_sec_concurrent": round(
+            2 * EPOCHS * N / conc_wall, 1),
+        "epochs": EPOCHS, "examples_per_tenant": N,
+        "scheduler": "pod_carve:1",
+    }
+    out.update(value=round(max(vals), 3), **detail)
+    with open(OUT_PATH, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,22 +13,43 @@ equivalent keeps the same split with JAX's multi-controller SPMD model:
   * every other process runs a :class:`PodFollower` loop.
 
 Control plane (DCN, JSON-over-TCP — same framing as client.py): followers
-JOIN the leader; for each dispatched job the leader broadcasts RUN_JOB with
-the serialized JobConfig and executor grant, every process builds the SAME
-JobEntity and runs it, and the jitted train steps inside are global-mesh
-SPMD programs — their XLA collectives (ICI/DCN) are the data plane and the
-de-facto barrier, exactly the reference's msg-plus-collective split
-(SURVEY.md §5.8). At job end followers report JOB_DONE with their local
-worker metrics, which the leader records per process id — the cross-process
-metric flow the reference routes through its MetricManager msg senders.
+JOIN the leader; for each dispatched job the leader sends RUN_JOB to the
+followers whose processes hold devices of the job's executor grant, every
+participating process builds the SAME JobEntity and runs it, and the jitted
+train steps inside are mesh-wide SPMD programs — their XLA collectives
+(ICI/DCN) are the data plane and the de-facto barrier, exactly the
+reference's msg-plus-collective split (SURVEY.md §5.8). At job end
+participants report JOB_DONE with their local worker metrics, which the
+leader records per process id — the cross-process metric flow the reference
+routes through its MetricManager msg senders.
 
-Determinism contract (what makes lockstep correct): entity construction is
-a pure function of the JobConfig, executor ids are allocated by a fresh
-per-process counter in identical order, and synthetic/file data loading is
-seeded — so all processes issue the same global computations in the same
-order. Pod jobs are serialized by the leader (one RUN_JOB at a time): two
-concurrently-dispatched jobs would interleave their collectives in
-process-dependent order and deadlock the mesh.
+Concurrent multi-tenancy (the reference's defining property —
+SchedulerImpl.java:28-66 runs every job on all executors, the
+GlobalTaskUnitScheduler interleaves them): jobs whose grants land on
+DISJOINT PROCESS SETS dispatch concurrently. Disjointness is what makes it
+safe — a process's per-device XLA streams execute in enqueue order, and a
+multi-process program blocks its process inside collectives until every
+participant arrives, so two multi-process jobs sharing processes can
+enqueue in different orders on different hosts and deadlock the pod
+(a distributed lock-order inversion). The admission rule in ``_dispatch``
+encodes exactly that hazard:
+
+  * disjoint process sets               -> always concurrent;
+  * both jobs confined to one process   -> concurrent even on the same
+    process (the in-process dispatch_scope already serializes their
+    multi-device programs; no cross-process wait exists);
+  * overlapping sets, either spans >1 process -> serialized.
+
+The ``pod_carve`` scheduler (scheduler.ProcessCarveScheduler) produces
+process-disjoint grants by construction, so carved pods run N tenants
+truly concurrently across hosts; share_all pods degrade to the serialized
+behaviour (every grant spans every process).
+
+Determinism contract (what makes per-job lockstep correct): entity
+construction is a pure function of the JobConfig, executor ids are
+allocated by a fresh per-process counter in identical order, and
+synthetic/file data loading is seeded — so all of a job's participants
+issue the same global computations in the same order.
 """
 from __future__ import annotations
 
@@ -36,11 +57,14 @@ import json
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.joblog import job_logger, server_log
+from harmony_tpu.jobserver.scheduler import CarveScheduler, ProcessCarveScheduler
 from harmony_tpu.jobserver.server import JobServer
 
 
@@ -55,6 +79,22 @@ def _recv(f) -> Optional[Dict[str, Any]]:
     return json.loads(line)
 
 
+def _json_sanitize(obj: Any) -> Any:
+    """Best-effort JSON projection of a job result for the wire: plain
+    scalars/containers pass through, numpy scalars coerce, anything else
+    (device arrays, closures) becomes its repr — the chief-report path
+    must never fail on an exotic result value."""
+    if isinstance(obj, dict):
+        return {str(k): _json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sanitize(v) for v in obj]
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return obj
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return repr(obj)
+
+
 class PodJobServer(JobServer):
     """JobServer on process 0 of a pod: adds the follower control plane."""
 
@@ -63,21 +103,51 @@ class PodJobServer(JobServer):
         self._num_followers = num_followers
         self._pod_sock: Optional[socket.socket] = None
         self._followers: Dict[int, Any] = {}  # pid -> (sock, reader file)
-        self._pod_lock = threading.Lock()  # serializes pod job execution
+        self._send_locks: Dict[int, threading.Lock] = {}
+        # One condition guards all pod state: active job->process sets
+        # (admission), the report buffer the reader threads fill, dead
+        # followers, and the broken flag.
+        self._pod_cond = threading.Condition()
+        self._active_procs: Dict[str, frozenset] = {}
+        self._reports: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._dead_followers: set = set()
+        self._readers: List[threading.Thread] = []
+        self._pod_closing = False
         # A partially-delivered RUN_JOB leaves the followers that DID
         # receive it blocked in global collectives (XLA collectives do not
-        # time out); no later job can run on this pod. The flag fails all
-        # subsequent pod dispatches fast instead of hanging them.
+        # time out); no job overlapping those processes can run. The flag
+        # fails subsequent pod dispatches fast instead of hanging them.
         self._pod_broken: Optional[str] = None
         #: job_id -> {pid: follower JOB_DONE payload}
         self.pod_reports: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        #: job_id -> (dispatch start, dispatch end) monotonic times — the
+        #: concurrency evidence (overlapping walls = jobs truly overlapped)
+        self.job_walls: Dict[str, Tuple[float, float]] = {}
+        # Remote deferred evals: job_id -> chief pid holding the closure
+        # (filled from JOB_DONE's has_deferred_eval), and the EVAL_DONE
+        # results the readers collect during shutdown.
+        self._remote_evals: Dict[str, int] = {}
+        self._remote_eval_results: Dict[str, Any] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        if isinstance(self._scheduler, ProcessCarveScheduler):
+            self._scheduler.set_process_map({
+                eid: self.master.executor(eid).device.process_index
+                for eid in self.master.executor_ids()
+            })
 
     # -- follower management --------------------------------------------
 
     def serve_pod(self, port: int = 0, join_timeout: float = 300.0) -> int:
         """Listen for follower JOINs; blocks until all ``num_followers``
         processes have joined (startup is a pod-wide barrier — dispatching
-        before the pod is whole would hang the first collective anyway)."""
+        before the pod is whole would hang the first collective anyway).
+        Once whole, one reader thread per follower demultiplexes its
+        JOB_DONE stream into the report buffer — concurrent jobs each wait
+        only on their own participants' reports."""
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind(("0.0.0.0", port))
@@ -108,46 +178,100 @@ class PodJobServer(JobServer):
             if not hello or hello.get("cmd") != "JOIN" or pid is None:
                 conn.close()
                 continue
-            conn.settimeout(None)  # RUN_JOB/JOB_DONE set their own deadlines
+            conn.settimeout(None)  # the reader thread owns this socket now
             self._followers[pid] = (conn, f)
+            self._send_locks[pid] = threading.Lock()
             server_log.info("pod follower %d joined from %s", pid, addr)
+        for pid, (conn, f) in sorted(self._followers.items()):
+            t = threading.Thread(
+                target=self._reader_loop, args=(pid, f), daemon=True,
+                name=f"pod-reader-{pid}",
+            )
+            t.start()
+            self._readers.append(t)
         return bound
 
-    def _broadcast(self, msg: Dict[str, Any]) -> None:
-        for pid, (conn, _) in sorted(self._followers.items()):
+    def _reader_loop(self, pid: int, f) -> None:
+        """Owns all reads from follower ``pid``: routes JOB_DONE payloads
+        into the report buffer by (job_id, pid). EOF/read errors mark the
+        follower dead and (outside shutdown) poison the pod — a vanished
+        follower may be wedged in a collective no later job can satisfy."""
+        while True:
+            try:
+                msg = _recv(f)
+            except (OSError, ValueError):
+                msg = None
+            if msg is None:
+                with self._pod_cond:
+                    self._dead_followers.add(pid)
+                    if not self._pod_closing and self._pod_broken is None:
+                        self._pod_broken = f"follower {pid} connection lost"
+                        server_log.error("pod broken: %s", self._pod_broken)
+                    self._pod_cond.notify_all()
+                return
+            if msg.get("cmd") == "EVAL_DONE":
+                # Shutdown-stage deferred-eval result from a chief follower
+                # (the remote analogue of _run_deferred_evals' entries).
+                with self._pod_cond:
+                    self._remote_eval_results[str(msg.get("job_id"))] = (
+                        msg.get("result", {"error": "empty EVAL_DONE"})
+                    )
+                    self._pod_cond.notify_all()
+                continue
+            if msg.get("cmd") != "JOB_DONE":
+                server_log.warning(
+                    "pod: unexpected %r from follower %d", msg.get("cmd"), pid
+                )
+                continue
+            with self._pod_cond:
+                self._reports[(str(msg.get("job_id")), pid)] = msg
+                while len(self._reports) > 1024:  # bound leader memory
+                    self._reports.pop(next(iter(self._reports)))
+                self._pod_cond.notify_all()
+
+    def _send_to(self, pid: int, msg: Dict[str, Any]) -> None:
+        conn, _ = self._followers[pid]
+        with self._send_locks[pid]:
             _send(conn, msg)
 
-    def _collect_done(self, job_id: str, timeout: float) -> Dict[int, Dict[str, Any]]:
-        """One JOB_DONE per follower; a silent follower is recorded as an
-        error entry rather than wedging the leader forever. A stale report
-        from an earlier job (its collection timed out; the follower finished
-        late) is skipped, never attributed to this job."""
+    def _wait_report(
+        self, job_id: str, pid: int, deadline: float
+    ) -> Optional[Dict[str, Any]]:
+        """Block until follower ``pid`` reports for ``job_id`` (reader
+        threads fill the buffer); None on death/timeout."""
+        key = (job_id, pid)
+        with self._pod_cond:
+            while key not in self._reports:
+                if pid in self._dead_followers:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._pod_cond.wait(timeout=min(remaining, 5.0))
+            return self._reports[key]
+
+    def _collect_reports(
+        self, job_id: str, participants: List[int], timeout: float
+    ) -> Dict[int, Dict[str, Any]]:
+        """One JOB_DONE per participant; a silent participant is recorded
+        as an infra-error entry rather than wedging the leader forever."""
         deadline = time.monotonic() + timeout
         out: Dict[int, Dict[str, Any]] = {}
-        for pid, (conn, f) in sorted(self._followers.items()):
-            while pid not in out:
-                try:
-                    conn.settimeout(max(0.1, deadline - time.monotonic()))
-                    msg = _recv(f)
-                except (socket.timeout, OSError) as e:
-                    # "infra" marks leader-observed transport failures
-                    # (timeout/hangup) — the follower is gone or wedged —
-                    # as opposed to a follower-REPORTED job error, after
-                    # which the follower is alive and serviceable.
-                    out[pid] = {"ok": False, "infra": True,
-                                "error": f"follower read: {e}"}
-                    continue
-                if msg is None:
-                    out[pid] = {"ok": False, "infra": True,
-                                "error": "follower closed connection"}
-                elif msg.get("job_id") == job_id:
-                    out[pid] = msg
-                else:  # stale report from a timed-out earlier collection
-                    server_log.warning(
-                        "pod: dropping stale report from follower %d "
-                        "(job %s, collecting %s)",
-                        pid, msg.get("job_id"), job_id,
-                    )
+        for pid in participants:
+            rep = self._wait_report(job_id, pid, deadline)
+            if rep is None:
+                # "infra" marks leader-observed transport failures
+                # (timeout/death) — the follower is gone or wedged — as
+                # opposed to a follower-REPORTED job error, after which
+                # the follower is alive and serviceable.
+                why = ("follower lost" if pid in self._dead_followers
+                       else "report timeout")
+                out[pid] = {"ok": False, "infra": True, "error": why}
+            else:
+                out[pid] = rep
+        with self._pod_cond:
+            for pid in participants:
+                self._reports.pop((job_id, pid), None)
         return out
 
     # -- dispatch override ------------------------------------------------
@@ -159,22 +283,31 @@ class PodJobServer(JobServer):
 
     def _status(self) -> Dict[str, Any]:
         out = super()._status()
-        out["pod"] = {
-            "followers": sorted(self._followers),
-            "broken": self._pod_broken,
-        }
+        with self._pod_cond:
+            active = {j: sorted(ps) for j, ps in self._active_procs.items()}
+            out["pod"] = {
+                "followers": sorted(self._followers),
+                "broken": self._pod_broken,
+                "active": active,
+            }
         return out
 
     def submit(self, config: JobConfig):
         # Rejected HERE so TCP submitters see {"ok": false, error} instead
-        # of an ok-then-vanished job. num_workers=0 (the CLI default,
-        # "one per granted executor") is included when the pool holds more
-        # than one executor — the default scheduler grants them all, so 0
-        # resolves to >1 dispatch threads. (A 1-executor pod legally runs
-        # 0; the dispatch-time effective check stays as ground truth.)
-        if self._num_followers and (
-            config.num_workers > 1
-            or (config.num_workers == 0 and self._num_executors > 1)
+        # of an ok-then-vanished job — but only under whole-pool schedulers
+        # (share_all/fifo), whose every grant spans every process. Carve
+        # schedulers may grant a single-process slice where multi-worker is
+        # legal; for them the dispatch-time process-span check is ground
+        # truth. num_workers=0 (the CLI default, "one per granted
+        # executor") resolves to >1 dispatch threads when the pool holds
+        # more than one executor.
+        if (
+            self._num_followers
+            and not isinstance(self._scheduler, CarveScheduler)
+            and (
+                config.num_workers > 1
+                or (config.num_workers == 0 and self._num_executors > 1)
+            )
         ):
             raise ValueError(
                 f"pod jobs need num_workers=1 (got "
@@ -184,94 +317,206 @@ class PodJobServer(JobServer):
             )
         return super().submit(config)
 
+    def _conflicts_locked(self, procs: frozenset) -> Optional[str]:
+        """Admission rule (module doc): a running job blocks ``procs`` iff
+        the sets overlap and either spans more than one process."""
+        for jid, ps in self._active_procs.items():
+            if ps & procs and (len(ps) > 1 or len(procs) > 1):
+                return jid
+        return None
+
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
-        with self._pod_lock:  # one pod job at a time (see module doc)
-            effective_workers = config.num_workers or len(executor_ids)
-            if self._followers and effective_workers != 1:
-                # >1 worker per process = N dispatch threads whose host
-                # scheduling differs across processes -> divergent global
-                # enqueue order -> collective mismatch. Reject loudly
-                # instead of wedging the pod.
-                self._fail_job(
-                    config,
-                    f"pod jobs need one dispatch thread, got "
-                    f"num_workers={config.num_workers} over "
-                    f"{len(executor_ids)} executors: the SPMD lockstep "
-                    "contract cannot hold across multiple dispatch threads",
+        jlog = job_logger(config.job_id)
+        procs = frozenset(
+            self.master.executor(e).device.process_index for e in executor_ids
+        )
+        effective_workers = config.num_workers or len(executor_ids)
+        if len(procs) > 1 and effective_workers != 1:
+            # >1 worker per process = N dispatch threads whose host
+            # scheduling differs across processes -> divergent global
+            # enqueue order -> collective mismatch. Reject loudly
+            # instead of wedging the pod.
+            self._fail_job(
+                config,
+                f"multi-process pod jobs need one dispatch thread, got "
+                f"num_workers={config.num_workers} over "
+                f"{len(executor_ids)} executors spanning {len(procs)} "
+                "processes: the SPMD lockstep contract cannot hold across "
+                "multiple dispatch threads",
+            )
+            return
+        # Admission: wait until no running job conflicts (see module doc).
+        admitted = False
+        with self._pod_cond:
+            while not self._pod_broken:
+                if self._conflicts_locked(procs) is None:
+                    self._active_procs[config.job_id] = procs
+                    admitted = True
+                    break
+                self._pod_cond.wait(timeout=1.0)
+        if not admitted:
+            self._fail_job(
+                config,
+                f"pod is broken ({self._pod_broken}); restart the pod "
+                "processes — followers may be wedged in collectives",
+            )
+            return
+        t0 = time.monotonic()
+        try:
+            participants = sorted(p for p in procs if p != 0)
+            run_local = 0 in procs
+            if participants:
+                jlog.info(
+                    "pod: RUN_JOB to follower(s) %s (chief=%d, local=%s)",
+                    participants, min(procs), run_local,
                 )
-                return
-            if self._followers and self._pod_broken:
-                self._fail_job(
-                    config,
-                    f"pod is broken ({self._pod_broken}); restart the pod "
-                    "processes — followers may be wedged in collectives",
-                )
-                return
-            if self._followers:
-                job_logger(config.job_id).info(
-                    "pod: broadcasting RUN_JOB to %d follower(s)",
-                    len(self._followers),
-                )
+                msg = {
+                    "cmd": "RUN_JOB",
+                    "conf": config.to_dict(),
+                    "executor_ids": list(executor_ids),
+                    "chief_pid": min(procs),
+                    # Followers stage model checkpoints under the same root
+                    # the leader would use, so carved jobs keep the
+                    # checkpoint-chain + deferred-eval features.
+                    "chkp_root": self._chkp_root,
+                    # Participants must build the entity with the SAME aux
+                    # components: the TaskUnit schedulers change how the
+                    # worker phases its device dispatches (fused vs split
+                    # PULL/COMP/PUSH), and any asymmetry there is a
+                    # cross-process collective mismatch.
+                    "cpu_slots": self.local_taskunit.cpu_slots,
+                    "net_slots": self.local_taskunit.net_slots,
+                }
                 try:
-                    self._broadcast({
-                        "cmd": "RUN_JOB",
-                        "conf": config.to_dict(),
-                        "executor_ids": list(executor_ids),
-                        # Followers must build the entity with the SAME aux
-                        # components: the TaskUnit schedulers change how the
-                        # worker phases its device dispatches (fused vs
-                        # split PULL/COMP/PUSH), and any asymmetry there is
-                        # a cross-process collective mismatch.
-                        "cpu_slots": self.local_taskunit.cpu_slots,
-                        "net_slots": self.local_taskunit.net_slots,
-                    })
+                    for pid in participants:
+                        self._send_to(pid, msg)
                 except OSError as e:
                     # A partially-delivered RUN_JOB cannot train (the SPMD
-                    # collectives need every process), and base _dispatch's
-                    # guarantees live inside ITS try-block — so fail the
-                    # job the way the base error path would, and POISON the
-                    # pod: followers that did get the message are now
-                    # blocked in collectives no later job can satisfy.
-                    self._pod_broken = f"RUN_JOB broadcast failed: {e}"
+                    # collectives need every participant) — fail the job
+                    # and POISON the pod: followers that did get the
+                    # message are now blocked in collectives.
+                    with self._pod_cond:
+                        self._pod_broken = f"RUN_JOB send failed: {e}"
+                        self._pod_cond.notify_all()
                     server_log.error("pod broken: %s", self._pod_broken)
-                    self._fail_job(
-                        config, f"pod RUN_JOB broadcast failed: {e}"
-                    )
+                    self._fail_job(config, f"pod RUN_JOB send failed: {e}")
                     return
-            super()._dispatch(config, executor_ids)
-            if self._followers:
-                try:
-                    reports = self._collect_done(config.job_id, timeout=600.0)
-                except Exception as e:  # noqa: BLE001 - job already resolved
-                    reports = {"error": f"report collection failed: {e}"}
-                # A follower that never reported is wedged (likely stuck in
-                # a collective): the next RUN_JOB's collectives could never
-                # complete — poison the pod like the broadcast-failure path.
-                dead = [pid for pid, r in reports.items()
-                        if isinstance(r, dict) and r.get("infra")]
+            if run_local:
+                super()._dispatch(config, executor_ids)
+            else:
+                # The leader holds none of this job's devices: the chief
+                # participant's report is the job result.
+                self._resolve_remote(config, participants)
+            if participants:
+                reports = self._collect_reports(
+                    config.job_id, participants, timeout=600.0
+                )
+                # A participant that never reported is wedged (likely stuck
+                # in a collective): any later job overlapping its process
+                # could never complete — poison the pod.
+                dead = [pid for pid, r in reports.items() if r.get("infra")]
                 if dead:
-                    self._pod_broken = (
-                        f"follower(s) {dead} never reported for "
-                        f"{config.job_id}"
-                    )
+                    with self._pod_cond:
+                        if self._pod_broken is None:
+                            self._pod_broken = (
+                                f"follower(s) {dead} never reported for "
+                                f"{config.job_id}"
+                            )
+                        self._pod_cond.notify_all()
                     server_log.error("pod broken: %s", self._pod_broken)
                 self.pod_reports[config.job_id] = reports
                 while len(self.pod_reports) > 256:  # bound leader memory
                     self.pod_reports.pop(next(iter(self.pod_reports)))
+                for pid, rep in reports.items():
+                    if rep.get("has_deferred_eval"):
+                        with self._pod_cond:
+                            self._remote_evals[config.job_id] = pid
+        finally:
+            self.job_walls[config.job_id] = (t0, time.monotonic())
+            while len(self.job_walls) > 1024:
+                self.job_walls.pop(next(iter(self.job_walls)))
+            with self._pod_cond:
+                self._active_procs.pop(config.job_id, None)
+                self._pod_cond.notify_all()
 
-    def shutdown(self, timeout: Optional[float] = 300.0) -> None:
-        super().shutdown(timeout)
-        # The job futures resolve BEFORE follower reports are collected, so
-        # a client reacting to job completion can reach shutdown while
-        # _dispatch is still reading JOB_DONEs; taking the pod lock here
-        # orders the socket teardown after that collection.
-        with self._pod_lock:
-            pass
+    def _resolve_remote(self, config: JobConfig, participants: List[int]) -> None:
+        """Leader-side completion for a job running wholly on followers:
+        the lowest participating pid is the job chief; its JOB_DONE carries
+        the sanitized result that resolves the leader's future (mirroring
+        what the base _dispatch does for local jobs, including the
+        scheduler.on_job_finish in finally)."""
+        jr = self._jobs[config.job_id]
+        jlog = job_logger(config.job_id)
+        chief = min(participants)
+        t0 = time.monotonic()
+        try:
+            rep = self._wait_report(
+                config.job_id, chief, time.monotonic() + 600.0
+            )
+            if rep is None:
+                raise RuntimeError(
+                    f"chief follower {chief} never reported for "
+                    f"{config.job_id}"
+                )
+            if not rep.get("ok"):
+                raise RuntimeError(
+                    f"remote job failed on follower {chief}: "
+                    f"{rep.get('error', 'unknown error')}"
+                )
+            result = rep.get("result") or {
+                "job_id": config.job_id, "workers": rep.get("workers", {})
+            }
+            jlog.info("finished remotely in %.1fs (chief=%d)",
+                      time.monotonic() - t0, chief)
+            jr.future.set_result(result)
+        except BaseException as e:  # noqa: BLE001 - delivered via future
+            jlog.error("remote job failed: %s: %s", type(e).__name__, e)
+            jr.future.set_exception(e)
+        finally:
+            self._scheduler.on_job_finish(config.job_id)
+
+    def _on_closing(self, timeout: Optional[float] = 300.0) -> None:
+        """Pod teardown, run by the base shutdown BEFORE the CLOSED
+        transition (observers keyed on CLOSED — e.g. the pod worker's exit
+        loop — must see the remote eval results already collected).
+
+        The job futures resolve BEFORE participant reports are collected,
+        so a client reacting to job completion can reach shutdown while
+        _dispatch threads are still reading JOB_DONEs; wait out the
+        active set so socket teardown follows those collections."""
+        deadline = time.monotonic() + 30.0
+        with self._pod_cond:
+            self._pod_cond.wait_for(
+                lambda: not self._active_procs,
+                timeout=max(0.0, deadline - time.monotonic()),
+            )
+            self._pod_closing = True
         if self._followers:
-            try:
-                self._broadcast({"cmd": "SHUTDOWN"})
-            except OSError:
-                pass
+            for pid in sorted(self._followers):
+                try:
+                    self._send_to(pid, {"cmd": "SHUTDOWN"})
+                except OSError:
+                    pass
+            # Chief followers run their jobs' deferred model evals on
+            # SHUTDOWN (the remote leg of _run_deferred_evals); collect
+            # their EVAL_DONEs before tearing the sockets down.
+            with self._pod_cond:
+                pending = dict(self._remote_evals)
+            if pending:
+                deadline = time.monotonic() + (timeout or 300.0)
+                with self._pod_cond:
+                    self._pod_cond.wait_for(
+                        lambda: all(
+                            j in self._remote_eval_results
+                            or pid in self._dead_followers
+                            for j, pid in pending.items()
+                        ),
+                        timeout=max(0.0, deadline - time.monotonic()),
+                    )
+                    for j, pid in pending.items():
+                        self.eval_results[j] = self._remote_eval_results.get(
+                            j, {"error": f"follower {pid} never sent EVAL_DONE"}
+                        )
             for conn, f in self._followers.values():
                 try:
                     conn.close()
@@ -288,7 +533,11 @@ class PodFollower:
 
     Mirrors the leader's job lifecycle against a local ETMaster whose
     executor ids — produced by the same fresh-process allocation order —
-    name the same global devices as the leader's."""
+    name the same global devices as the leader's. RUN_JOBs run on their own
+    threads: a follower may participate in several concurrent jobs (each
+    confined to this process, or process-disjoint multi-process jobs the
+    leader's admission rule lets through), sharing one process-wide
+    GlobalTaskUnitScheduler exactly like the leader's local jobs do."""
 
     def __init__(self, leader_host: str, pod_port: int, pid: int,
                  num_executors: int, join_timeout: float = 300.0) -> None:
@@ -308,6 +557,9 @@ class PodFollower:
                 time.sleep(0.5)
         self._sock.settimeout(None)  # RUN_JOB may arrive much later
         self._file = self._sock.makefile("r")
+        self._send_lock = threading.Lock()
+        self._job_threads: List[threading.Thread] = []
+        self._deferred_evals: Dict[str, Any] = {}  # job_id -> closure
         _send(self._sock, {"cmd": "JOIN", "pid": pid})
 
         from harmony_tpu.metrics.manager import MetricManager
@@ -318,53 +570,105 @@ class PodFollower:
         self.metrics = MetricManager()
         self.metrics.start_collection()
 
+    def _report(self, payload: Dict[str, Any]) -> None:
+        with self._send_lock:
+            _send(self._sock, payload)
+
     def run(self) -> None:
-        """Serve RUN_JOB commands until SHUTDOWN (or leader hangup)."""
-        from harmony_tpu.jobserver.entity import build_entity
-        from harmony_tpu.runtime.taskunit import (
-            GlobalTaskUnitScheduler,
-            LocalTaskUnitScheduler,
-        )
+        """Serve RUN_JOB commands until SHUTDOWN (or leader hangup).
+        Each RUN_JOB executes on its own thread so concurrent jobs the
+        leader admitted (disjoint process sets) truly overlap here."""
+        from harmony_tpu.runtime.taskunit import GlobalTaskUnitScheduler
 
         global_tu = GlobalTaskUnitScheduler()
         while True:
             msg = _recv(self._file)
             if msg is None or msg.get("cmd") == "SHUTDOWN":
+                for t in self._job_threads:
+                    t.join(timeout=60.0)
+                # The shutdown-stage deferred model evals for jobs this
+                # follower chiefed (the leader is waiting on EVAL_DONE).
+                for job_id, fn in list(self._deferred_evals.items()):
+                    try:
+                        result = _json_sanitize(fn(self.master))
+                    except BaseException as e:  # noqa: BLE001 - reported
+                        result = {"error": f"{type(e).__name__}: {e}"}
+                    try:
+                        self._report({"cmd": "EVAL_DONE", "job_id": job_id,
+                                      "result": result})
+                    except OSError:
+                        break  # leader gone; nothing to tell it
                 self._sock.close()
                 return
             assert msg.get("cmd") == "RUN_JOB", msg
-            config = ConfigBase.from_dict(msg["conf"])
-            executor_ids = msg["executor_ids"]
-            report: Dict[str, Any] = {
-                "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,
-            }
-            try:
-                missing = set(executor_ids) - set(self.master.executor_ids())
-                if missing:
-                    raise RuntimeError(
-                        f"follower {self.pid} missing executors {missing} "
-                        "(leader/follower allocation orders diverged)"
-                    )
-                # Mirror the leader's entity EXACTLY (see RUN_JOB comment):
-                # same taskunit phasing, a local metric pipeline of our own.
-                entity = build_entity(
-                    config,
-                    global_taskunit=global_tu,
-                    local_taskunit=LocalTaskUnitScheduler(
-                        msg.get("cpu_slots", 1), msg.get("net_slots", 2)
-                    ),
-                    metric_sink=self.metrics.on_metric,
-                    metric_manager=self.metrics,
+            t = threading.Thread(
+                target=self._run_job, args=(msg, global_tu), daemon=True,
+                name=f"pod-job-{msg.get('conf', {}).get('job_id', '?')}",
+            )
+            self._job_threads = [x for x in self._job_threads if x.is_alive()]
+            self._job_threads.append(t)
+            t.start()
+
+    def _run_job(self, msg: Dict[str, Any], global_tu) -> None:
+        from harmony_tpu.jobserver.entity import build_entity
+        from harmony_tpu.runtime.taskunit import LocalTaskUnitScheduler
+
+        config = ConfigBase.from_dict(msg["conf"])
+        executor_ids = msg["executor_ids"]
+        chief = int(msg.get("chief_pid", 0)) == self.pid
+        report: Dict[str, Any] = {
+            "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,
+        }
+        entity = None
+        try:
+            missing = set(executor_ids) - set(self.master.executor_ids())
+            if missing:
+                raise RuntimeError(
+                    f"follower {self.pid} missing executors {missing} "
+                    "(leader/follower allocation orders diverged)"
                 )
-                entity.setup(self.master, executor_ids)
-                result = entity.run()
-                entity.cleanup()
-                report["ok"] = True
-                report["workers"] = {
-                    wid: {"losses": [float(x) for x in w.get("losses", [])]}
-                    for wid, w in result.get("workers", {}).items()
-                }
-            except BaseException as e:  # noqa: BLE001 - reported to leader
-                report["ok"] = False
-                report["error"] = f"{type(e).__name__}: {e}"
-            _send(self._sock, report)
+            # Mirror the leader's entity EXACTLY (see RUN_JOB comment):
+            # same taskunit phasing, a local metric pipeline of our own.
+            entity = build_entity(
+                config,
+                global_taskunit=global_tu,
+                local_taskunit=LocalTaskUnitScheduler(
+                    msg.get("cpu_slots", 1), msg.get("net_slots", 2)
+                ),
+                metric_sink=self.metrics.on_metric,
+                metric_manager=self.metrics,
+                chkp_root=msg.get("chkp_root"),
+            )
+            entity.setup(self.master, executor_ids)
+            result = entity.run()
+            if chief:
+                # Deferred model evaluation is registered BEFORE cleanup
+                # drops the tables (the eval replays checkpoints from
+                # disk); it runs at SHUTDOWN, exactly like the leader's
+                # _run_deferred_evals stage. Chief-only: one eval per job.
+                deferred = entity.deferred_evaluation()
+                if deferred is not None:
+                    self._deferred_evals[config.job_id] = deferred
+                    report["has_deferred_eval"] = True
+            entity.cleanup()
+            report["ok"] = True
+            report["workers"] = {
+                wid: {"losses": [float(x) for x in w.get("losses", [])]}
+                for wid, w in result.get("workers", {}).items()
+            }
+            if chief:
+                # The chief's result resolves the leader's job future when
+                # the leader holds none of the job's devices.
+                report["result"] = _json_sanitize(result)
+        except BaseException as e:  # noqa: BLE001 - reported to leader
+            # Cleanup on failure, like the leader's _dispatch error path:
+            # a leaked table would make every resubmission of this job_id
+            # fail on this follower with "table exists".
+            if entity is not None:
+                try:
+                    entity.cleanup()
+                except Exception:
+                    pass
+            report["ok"] = False
+            report["error"] = f"{type(e).__name__}: {e}"
+        self._report(report)

@@ -177,10 +177,64 @@ class CarveScheduler(JobScheduler):
             return list(self._slices.get(job_id, []))
 
 
+class ProcessCarveScheduler(CarveScheduler):
+    """Mesh carving in whole-HOST-PROCESS units, for multi-host pods.
+
+    On a pod, two concurrent jobs are hazard-free only when their XLA
+    programs never share a process: disjoint process sets cannot form a
+    cross-process enqueue-order cycle (see jobserver/pod.py's admission
+    rule). This scheduler guarantees that shape by construction — every
+    slice is a set of COMPLETE processes, so the PodJobServer dispatches
+    all carved jobs concurrently. Fair share at arrival = total processes
+    // (running jobs + 1), floored at ``min_procs``.
+
+    The executor->process map is injected by the server after allocation
+    (``set_process_map``); until then the scheduler treats the pool as one
+    process (degenerating to FIFO-exclusive, which is safe)."""
+
+    def __init__(self, min_procs: int = 1, max_procs: Optional[int] = None) -> None:
+        super().__init__(min_slice=1, max_share=None)
+        if min_procs < 1:
+            raise ValueError("min_procs must be >= 1")
+        if max_procs is not None and max_procs < min_procs:
+            raise ValueError("max_procs must be >= min_procs")
+        self.min_procs = min_procs
+        self.max_procs = max_procs
+        self._proc_of: Dict[str, int] = {}
+
+    def set_process_map(self, proc_of: Dict[str, int]) -> None:
+        """executor id -> process index (from Executor.device.process_index)."""
+        with self._lock:
+            self._proc_of = dict(proc_of)
+
+    def _take_slice(self) -> Optional[List[str]]:
+        """Under the lock: carve whole free processes or None to queue."""
+        proc_members: Dict[int, List[str]] = {}
+        for e in self._executors:
+            proc_members.setdefault(self._proc_of.get(e, 0), []).append(e)
+        free = set(self._free)
+        free_procs = sorted(
+            p for p, members in proc_members.items()
+            if all(e in free for e in members)
+        )
+        share = max(
+            self.min_procs, len(proc_members) // (len(self._slices) + 1)
+        )
+        if self.max_procs is not None:
+            share = min(share, self.max_procs)
+        if len(free_procs) < self.min_procs:
+            return None
+        take_procs = free_procs[: min(share, len(free_procs))]
+        take = [e for p in take_procs for e in proc_members[p]]
+        self._free = [e for e in self._free if e not in set(take)]
+        return take
+
+
 _SCHEDULERS: Dict[str, type] = {
     "share_all": ShareAllScheduler,
     "fifo": FifoExclusiveScheduler,
     "carve": CarveScheduler,
+    "pod_carve": ProcessCarveScheduler,
 }
 
 

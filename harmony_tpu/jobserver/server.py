@@ -158,9 +158,18 @@ class JobServer:
             if t.is_alive():
                 drained = False  # straggler still owns its executors
         self._run_deferred_evals(timeout, drained)
-        if self._dashboard is not None:
-            self._dashboard.close()  # flush the async queue, then stop
-        self._state.transition("CLOSED")
+        try:
+            self._on_closing(timeout)
+        finally:
+            if self._dashboard is not None:
+                self._dashboard.close()  # flush the async queue, then stop
+            self._state.transition("CLOSED")
+
+    def _on_closing(self, timeout: Optional[float]) -> None:
+        """Subclass hook running after the drain + deferred evals but
+        BEFORE the CLOSED transition (pod teardown must finish while
+        observers still see CLOSING — anything keyed on CLOSED, like the
+        worker process exit, may run the instant the state flips)."""
 
     def _run_deferred_evals(self, timeout: Optional[float], drained: bool) -> None:
         """The deferred-work stage of graceful shutdown (ref:

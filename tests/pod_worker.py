@@ -1,13 +1,18 @@
-"""Worker process for the multi-host JobServer end-to-end test.
+"""Worker process for the multi-host JobServer end-to-end tests.
 
 Launched N times by tests/test_multihost.py (CPU backend; the harness
 picks the virtual devices per process, e.g. 2x4 or 3x2). Process 0 runs the
 PodJobServer (TCP submit endpoint + pod control plane); the rest run
-PodFollower loops. The parent submits an MLR job to process 0 over TCP,
-every process executes the same SPMD entity over the global mesh, and
-process 0 prints the pod-wide outcome as `RESULT <json>`.
+PodFollower loops. The parent submits jobs to process 0 over TCP, the
+participating processes execute the SPMD entities over their carve of the
+global mesh, and process 0 prints the pod-wide outcome as `RESULT <json>`.
 
-Usage: python pod_worker.py <coordinator> <nprocs> <pid> <pod_port> <tcp_port>
+Usage: python pod_worker.py <coordinator> <nprocs> <pid> <pod_port>
+           <tcp_port> [scheduler]
+
+``scheduler`` is a make_scheduler name, or "pod_carve:K" to cap each job's
+carve at K whole processes (the concurrent-tenant configuration); "-" or
+absent keeps the default (share_all, serialized pod dispatch).
 """
 import json
 import os
@@ -17,11 +22,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _make_scheduler(arg):
+    if not arg or arg == "-":
+        return None
+    if arg.startswith("pod_carve:"):
+        from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
+
+        return ProcessCarveScheduler(max_procs=int(arg.split(":", 1)[1]))
+    return arg  # a make_scheduler name
+
+
 def main() -> None:
     coordinator, nprocs, pid, pod_port, tcp_port = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
         int(sys.argv[5]),
     )
+    sched_arg = sys.argv[6] if len(sys.argv) > 6 else None
 
     from harmony_tpu.parallel import multihost
 
@@ -34,7 +50,11 @@ def main() -> None:
     if pid == 0:
         from harmony_tpu.jobserver.pod import PodJobServer
 
-        server = PodJobServer(num_executors=n_exec, num_followers=nprocs - 1)
+        server = PodJobServer(
+            num_executors=n_exec,
+            num_followers=nprocs - 1,
+            scheduler=_make_scheduler(sched_arg),
+        )
         server.start()
         server.serve_pod(pod_port)
         server.serve_tcp(tcp_port)
@@ -55,6 +75,8 @@ def main() -> None:
             "pid": 0,
             "local_results": local,
             "pod_reports": server.pod_reports,
+            "job_walls": server.job_walls,
+            "eval_results": server.eval_results,
         }), flush=True)
     else:
         from harmony_tpu.jobserver.pod import PodFollower
