@@ -25,6 +25,7 @@ lane where the barrier is the lockstep collective itself.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
@@ -129,6 +130,63 @@ class MiniBatchController:
         WorkerTasklet(batch_barrier=...)."""
         self.register_worker(worker_id)
         return lambda batch_idx: self.on_sync(worker_id, batch_idx)
+
+
+class DispatchTurnstile:
+    """Deterministic cyclic admission of worker dispatch turns — what makes
+    multi-worker SSP legal on a MULTI-PROCESS pod.
+
+    The hazard: a pod job's worker threads dispatch global SPMD programs,
+    and every process must enqueue them in the SAME order (an inversion
+    wedges the collectives — parallel/dispatch.py). Thread timing differs
+    per host, so the order must come from a schedule, not the OS. The
+    turnstile admits exactly one worker "turn" at a time, cycling the
+    worker list in fixed order; every process runs the same cycle, so
+    batch dispatches, metric drains and probes enqueue identically
+    everywhere — and the per-process MiniBatchControllers see sync calls
+    in the same order too, making their stop decisions deterministic
+    (the reference reaches the same property by centralizing the decision
+    in one master and broadcasting it, MiniBatchController.java:28-118;
+    here determinism-by-schedule needs no message round-trip per batch).
+
+    Divergence between workers is bounded by one turn, so an SSP gate with
+    clock_slack >= 1 never blocks INSIDE a turn (a blocked turn-holder
+    would stall the cycle); the entity clamps the slack accordingly.
+    Workers that finish or die ``leave()`` so the cycle skips them.
+    """
+
+    def __init__(self, worker_ids: List[str]) -> None:
+        self._order = list(worker_ids)
+        self._cond = threading.Condition()
+        self._pos = 0
+        self._active: Set[str] = set(worker_ids)
+
+    def _current_locked(self) -> Optional[str]:
+        n = len(self._order)
+        for _ in range(n):
+            wid = self._order[self._pos % n]
+            if wid in self._active:
+                return wid
+            self._pos += 1
+        return None
+
+    @contextlib.contextmanager
+    def turn(self, worker_id: str):
+        """Block until it is ``worker_id``'s turn; the turn ends (and the
+        cycle advances) when the with-block exits."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._current_locked() == worker_id)
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._pos += 1
+                self._cond.notify_all()
+
+    def leave(self, worker_id: str) -> None:
+        with self._cond:
+            self._active.discard(worker_id)
+            self._cond.notify_all()
 
 
 class WorkerStateManager:

@@ -67,6 +67,7 @@ class WorkerTasklet:
         global_init: bool = True,
         post_init_barrier: Optional[Callable[[], None]] = None,
         defer_epoch_callback: bool = False,
+        dispatch_turn: Optional[Callable[[], Any]] = None,
     ) -> None:
         self.job_id = job_id
         self.ctx = ctx
@@ -92,6 +93,14 @@ class WorkerTasklet:
         # a per-JOB setup). post_init_barrier makes the others wait for it.
         self.global_init = global_init
         self.post_init_barrier = post_init_barrier
+        # Pod-lockstep multi-worker: a callable yielding this worker's
+        # admission-turn context manager (dolphin/master.DispatchTurnstile).
+        # Every multi-device dispatch this worker makes — batch steps,
+        # metric drains, probes — happens inside a turn, so concurrent
+        # worker threads enqueue in the SAME deterministic order on every
+        # process of the pod.
+        self.dispatch_turn = dispatch_turn
+        self._pending_probe = None  # probe deferred into the 1st batch turn
         self._step = None
         self._epoch_fn = None
         self._eval_fn = None
@@ -450,7 +459,9 @@ class WorkerTasklet:
 
     @staticmethod
     def _mesh_spans_processes(mesh: Mesh) -> bool:
-        return len({d.process_index for d in mesh.devices.flat}) > 1
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        return mesh_spans_processes(mesh)
 
     def _probe_comm(self, batch: Tuple[np.ndarray, ...]) -> None:
         """Time the probe programs on one batch (warmup dispatch first so
@@ -461,10 +472,13 @@ class WorkerTasklet:
         device round-trips; once per job per epoch is enough). A failed
         probe just skips this epoch's measurement — the previous split
         stays in effect."""
-        if self._mesh_spans_processes(self.ctx.model_table.mesh):
-            # Multi-process mesh: probe programs are global collectives,
-            # and a locally-swallowed failure would desynchronize the pod's
-            # SPMD lockstep. Measurement stays single-host for now.
+        spans = self._mesh_spans_processes(self.ctx.model_table.mesh)
+        if spans and self.dispatch_turn is None and self.ctx.num_workers != 1:
+            # Multi-process mesh with multiple dispatch threads and no
+            # turnstile: probe programs are global collectives and a
+            # divergent dispatch order would wedge the pod. (Unreachable
+            # when the entity wires the turnstile; kept as a guard for
+            # direct WorkerTasklet users.)
             return
         if self._probe_pull is None:
             self._build_comm_probe()
@@ -500,6 +514,13 @@ class WorkerTasklet:
                 t_pull = timed(self._probe_pull, state, batch_dev)
                 t_pp = timed(self._probe_pp, state, batch_dev)
         except Exception:
+            if spans:
+                # A one-sided probe failure on a multi-process mesh has
+                # already desynchronized the pod's dispatch order (this
+                # process dispatched fewer global programs than its
+                # peers). Failing the job fast beats wedging the pod in a
+                # collective that can never complete.
+                raise
             # a probe failure (layout race, donated buffer, transient
             # backend error) must never kill training — skip this epoch's
             # measurement and rebuild the programs next time
@@ -672,9 +693,15 @@ class WorkerTasklet:
                 first = tuple(a[: self.data.batch_size]
                               for a in self.data._arrays)
                 if first and len(first[0]):
-                    with trace_span("dolphin.comm_probe",
-                                    job_id=self.job_id, epoch=epoch):
-                        self._probe_comm(first)
+                    if self.dispatch_turn is not None:
+                        # turnstiled: defer into the first batch turn so
+                        # the probe's dispatches happen inside this
+                        # worker's admission slot
+                        self._pending_probe = first
+                    else:
+                        with trace_span("dolphin.comm_probe",
+                                        job_id=self.job_id, epoch=epoch):
+                            self._probe_comm(first)
             window = self._epoch_window_len(epoch, params.num_epochs)
             if window > 1:
                 # Multi-epoch window: dispatches chain on the table state
@@ -785,10 +812,16 @@ class WorkerTasklet:
         )
         last_metrics: Dict[str, float] = {}
         if pending:
-            t0 = time.perf_counter()
             with trace_span("dolphin.metric_drain", job_id=self.job_id,
                             epoch=epoch, batches=len(pending)):
-                host = self._drain_pending(pending)
+                # the drain's stack programs are multi-device dispatches:
+                # under pod lockstep they take a turn like any batch. The
+                # timer starts INSIDE the turn — waiting for admission is
+                # scheduling, not work, and must not inflate the per-batch
+                # times feeding the optimizer's cost model.
+                with self._turn():
+                    t0 = time.perf_counter()
+                    host = self._drain_pending(pending)
             work_t += time.perf_counter() - t0
             # Async dispatch makes true per-batch device time unobservable
             # without per-step syncs; smear the epoch's work time (barrier
@@ -811,13 +844,22 @@ class WorkerTasklet:
         hyper = self._hyper()
         work_t = 0.0  # dispatch time, EXCLUDING SSP barrier waits
         for batch_idx, batch in enumerate(self.data.epoch_batches()):
-            if self.batch_barrier is not None:  # SYNC TaskUnit
-                stop = self.batch_barrier(global_batch_idx)
-                if stop:
-                    break
-            t0 = time.perf_counter()
-            with self._taskunit_scope("COMP"):
-                metrics = self._dispatch_batch(batch_idx, batch, hyper)
+            with self._turn():
+                if self._pending_probe is not None:
+                    # turnstiled pods probe inside the chief's first batch
+                    # turn (a separate probe turn would skew the cycle by
+                    # one turn per probe epoch, unboundedly across epochs)
+                    first, self._pending_probe = self._pending_probe, None
+                    with trace_span("dolphin.comm_probe",
+                                    job_id=self.job_id, epoch=epoch):
+                        self._probe_comm(first)
+                if self.batch_barrier is not None:  # SYNC TaskUnit
+                    stop = self.batch_barrier(global_batch_idx)
+                    if stop:
+                        break
+                t0 = time.perf_counter()
+                with self._taskunit_scope("COMP"):
+                    metrics = self._dispatch_batch(batch_idx, batch, hyper)
             pending.append(metrics)
             if len(pending) >= self.MAX_INFLIGHT:
                 # Sliding window: block on the OLDEST outstanding step so the
@@ -1124,6 +1166,12 @@ class WorkerTasklet:
         if self.taskunit is None:
             return contextlib.nullcontext()
         return self.taskunit.scope(kind)
+
+    def _turn(self):
+        """This worker's turnstile admission (pod lockstep), else a no-op."""
+        if self.dispatch_turn is None:
+            return contextlib.nullcontext()
+        return self.dispatch_turn()
 
     # -- evaluation (ref: ModelEvaluator over checkpointed models) -------
 

@@ -203,6 +203,19 @@ class DolphinJobEntity(JobEntity):
         # epoch hook — one snapshot per job epoch, async writers.
         epoch_hook = None
         if params.model_chkp_period > 0:
+            from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+            if mesh_spans_processes(self._handle.table.mesh):
+                # Two blockers until the pod checkpoint path lands: the
+                # stage-1 export reads the global array host-side (not
+                # addressable from one process of a multi-process mesh),
+                # and the chief's epoch-hook snapshot gathers would
+                # dispatch outside the turnstile's deterministic order.
+                raise ValueError(
+                    f"job {cfg.job_id}: model_chkp_period > 0 is "
+                    "single-process only; multi-process pod checkpointing "
+                    "is not wired yet"
+                )
             import os
             import tempfile
 
@@ -224,9 +237,44 @@ class DolphinJobEntity(JobEntity):
         tm_hook = self._make_table_metrics_hook()
         epoch_hook = self._compose_epoch_hooks(epoch_hook, tm_hook)
         orchestrator = self._make_orchestrator()
+        # Pod lockstep: a multi-worker job whose grant spans host processes
+        # needs a deterministic dispatch schedule — every process runs the
+        # same worker threads, and their global SPMD programs must enqueue
+        # in the same order everywhere (dolphin/master.DispatchTurnstile).
+        # The SSP slack is clamped to >=1 so the gate never blocks INSIDE a
+        # turn (turnstile divergence is bounded by one turn anyway, which
+        # is stricter than any slack); TaskUnit announcement is dropped —
+        # the pod admission rule gives multi-process jobs exclusive
+        # processes, so there are no tenants to interleave with.
+        # user.force_lockstep opts a single-process job into the same
+        # deterministic schedule — the reproducible-baseline switch pod
+        # tests compare against (same schedule => identical numerics).
+        # NOTE: lockstep jobs drop TaskUnit admission (a quorum wait
+        # inside a turn deadlocks the cycle); on a pod the admission rule
+        # gives multi-process jobs exclusive processes so nothing is lost,
+        # but a force_lockstep job on a SHARED single-process server opts
+        # out of the 1-CPU/2-NET interleaving contract with co-tenants —
+        # it is a determinism knob, not a production scheduling mode.
+        pod_lockstep = num_workers > 1 and (
+            len({
+                self._master.executor(e).device.process_index
+                for e in self._executor_ids
+            }) > 1
+            or bool(cfg.user.get("force_lockstep"))
+        )
+        turnstile = None
+        if pod_lockstep:
+            from harmony_tpu.dolphin.master import DispatchTurnstile
+
+            turnstile = DispatchTurnstile(
+                [f"{cfg.job_id}/w{i}" for i in range(num_workers)]
+            )
         self._ctrl = (
             MiniBatchController(
-                params.clock_slack, params.num_epochs * nb, tracker=self.progress
+                max(params.clock_slack, 1) if pod_lockstep
+                else params.clock_slack,
+                params.num_epochs * nb,
+                tracker=self.progress,
             )
             if num_workers > 1
             else None
@@ -276,7 +324,9 @@ class DolphinJobEntity(JobEntity):
                 )
                 taskunit = (
                     TaskUnitClient(cfg.job_id, wid, self._global_tu, self._local_tu)
-                    if self._global_tu is not None and self._local_tu is not None
+                    if self._global_tu is not None
+                    and self._local_tu is not None
+                    and not pod_lockstep
                     else None
                 )
                 worker = WorkerTasklet(
@@ -293,6 +343,10 @@ class DolphinJobEntity(JobEntity):
                     epoch_callback=(epoch_hook if idx == 0 else None),
                     global_init=(idx == 0),
                     post_init_barrier=init_barrier.wait,
+                    dispatch_turn=(
+                        None if turnstile is None
+                        else (lambda w=wid: turnstile.turn(w))
+                    ),
                     # the metrics hook only reads already-drained counters,
                     # so fused multi-epoch windows may defer it; checkpoint
                     # chains snapshot state AT their epoch and disable them
@@ -307,6 +361,9 @@ class DolphinJobEntity(JobEntity):
                 # reference's driver-kill on evaluator failure).
                 init_barrier.abort()
             finally:
+                if turnstile is not None:
+                    # a finished (or dead) worker must not stall the cycle
+                    turnstile.leave(wid)
                 if self._ctrl is not None:
                     self._ctrl.deregister_worker(wid)
                 if self._global_tu is not None:
@@ -380,6 +437,18 @@ class DolphinJobEntity(JobEntity):
         name = self.config.optimizer
         if not name:
             return None
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        if mesh_spans_processes(self._handle.table.mesh):
+            # Every process would build its own orchestrator and plan
+            # migrations independently — divergent reshard dispatches wedge
+            # the pod. Pod-wide elasticity needs a leader-coordinated plan
+            # path; until then, reject loudly instead of diverging.
+            raise ValueError(
+                f"job {self.config.job_id}: optimizer={name!r} is "
+                "single-process only; a multi-process grant cannot run the "
+                "per-job optimization loop yet"
+            )
         if self._metric_manager is None:
             raise ValueError(
                 f"job {self.config.job_id}: optimizer={name!r} needs the "

@@ -64,7 +64,7 @@ import numpy as np
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.joblog import job_logger, server_log
-from harmony_tpu.jobserver.scheduler import CarveScheduler, ProcessCarveScheduler
+from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
 from harmony_tpu.jobserver.server import JobServer
 
 
@@ -292,31 +292,6 @@ class PodJobServer(JobServer):
             }
         return out
 
-    def submit(self, config: JobConfig):
-        # Rejected HERE so TCP submitters see {"ok": false, error} instead
-        # of an ok-then-vanished job — but only under whole-pool schedulers
-        # (share_all/fifo), whose every grant spans every process. Carve
-        # schedulers may grant a single-process slice where multi-worker is
-        # legal; for them the dispatch-time process-span check is ground
-        # truth. num_workers=0 (the CLI default, "one per granted
-        # executor") resolves to >1 dispatch threads when the pool holds
-        # more than one executor.
-        if (
-            self._num_followers
-            and not isinstance(self._scheduler, CarveScheduler)
-            and (
-                config.num_workers > 1
-                or (config.num_workers == 0 and self._num_executors > 1)
-            )
-        ):
-            raise ValueError(
-                f"pod jobs need num_workers=1 (got "
-                f"{config.num_workers}; 0 means one per executor): the "
-                "SPMD lockstep contract cannot hold across multiple "
-                "dispatch threads — submit with --workers 1"
-            )
-        return super().submit(config)
-
     def _conflicts_locked(self, procs: frozenset) -> Optional[str]:
         """Admission rule (module doc): a running job blocks ``procs`` iff
         the sets overlap and either spans more than one process."""
@@ -330,21 +305,11 @@ class PodJobServer(JobServer):
         procs = frozenset(
             self.master.executor(e).device.process_index for e in executor_ids
         )
-        effective_workers = config.num_workers or len(executor_ids)
-        if len(procs) > 1 and effective_workers != 1:
-            # >1 worker per process = N dispatch threads whose host
-            # scheduling differs across processes -> divergent global
-            # enqueue order -> collective mismatch. Reject loudly
-            # instead of wedging the pod.
-            self._fail_job(
-                config,
-                f"multi-process pod jobs need one dispatch thread, got "
-                f"num_workers={config.num_workers} over "
-                f"{len(executor_ids)} executors spanning {len(procs)} "
-                "processes: the SPMD lockstep contract cannot hold across "
-                "multiple dispatch threads",
-            )
-            return
+        # Multi-worker multi-process jobs are legal: the entity wires a
+        # DispatchTurnstile so every process's worker threads enqueue
+        # their global programs in the same deterministic order
+        # (dolphin/master.py), and the per-process SSP controllers see
+        # identical sync orders — identical decisions, no broadcast.
         # Admission: wait until no running job conflicts (see module doc).
         admitted = False
         with self._pod_cond:

@@ -77,6 +77,13 @@ def build_mesh(
     return Mesh(arr, names)
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live in more than one host process —
+    the predicate gating single-controller-only paths (local probes,
+    per-job optimizer loops, host-side snapshot reads)."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def _device_matches(
     d: jax.Device,
     device_kind: Optional[str],

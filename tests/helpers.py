@@ -30,3 +30,22 @@ class ExplodingTrainer(AddIntegerTrainer):
 
     def init_global_settings(self, ctx) -> None:
         raise RuntimeError("injected failure")
+
+
+class LaggyMLRTrainer:
+    """MLR with a host-side per-epoch sleep on ONE worker — the straggler
+    for SSP gating tests (the sleep is pure host delay: identical on every
+    pod process, no device dispatch)."""
+
+    def __new__(cls, lag_sec: float = 0.0, lag_worker: str = "/w1", **kw):
+        from harmony_tpu.apps.mlr import MLRTrainer
+
+        class _Laggy(MLRTrainer):
+            def on_epoch_finished(self, ctx, epoch) -> None:
+                import time
+
+                if lag_sec and ctx.worker_id.endswith(lag_worker):
+                    time.sleep(lag_sec)
+                super().on_epoch_finished(ctx, epoch)
+
+        return _Laggy(**kw)

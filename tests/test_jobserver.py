@@ -553,33 +553,33 @@ class TestPodFastFail:
         server._followers.clear()
         server.shutdown(timeout=30)
 
-    def test_multiworker_pod_job_rejected(self, devices):
-        """Multi-worker jobs cannot hold the pod's SPMD lockstep contract
-        (N dispatch threads interleave differently per process) — they must
-        be rejected with a clear error, never deadlock the mesh. A
-        MULTI-executor pod also rejects the all-executors default (0); a
-        1-executor pod legally resolves 0 to one worker (not tested here —
-        dispatch would need a live follower)."""
-        from harmony_tpu.jobserver.pod import PodJobServer
+    def test_lockstep_multiworker_exact_sums(self, devices):
+        """The DispatchTurnstile schedule (what makes multi-worker SSP
+        legal on a multi-process pod — the old submit-time rejection is
+        gone) preserves push exactness: an AddVector job with two workers
+        under force_lockstep lands every push exactly once, and twice in a
+        row produces the same deterministic grant order. (The pod e2e leg
+        lives in test_multihost.py; this is the in-process half.)"""
+        from harmony_tpu.config.params import TableConfig
 
-        server = PodJobServer(2, device_pool=DevicePool(devices[:2]),
-                              num_followers=1)
+        server = JobServer(4, device_pool=DevicePool(devices[:4]))
         server.start()
-
-        class _FakeConn:
-            def close(self):
-                pass
-
-        server._followers[1] = (_FakeConn(), None)
-        # rejected at SUBMIT so TCP clients get {"ok": false} instead of
-        # an ok-then-vanished job — including the all-executors default
-        # (0), which on a pod always resolves to >1 dispatch threads
-        for workers in (2, 0):
-            with pytest.raises(ValueError, match="num_workers=1"):
-                server.submit(addvector_job(f"podmw{workers}", n=32,
-                                            epochs=1, workers=workers,
-                                            slack=0))
-        server._followers.clear()
+        n, epochs = 64, 2
+        shared_cfg = TableConfig(
+            table_id="lockstep-addv", capacity=8, value_shape=(2,),
+            num_blocks=8, update_fn="add",
+        )
+        server.master.create_table(shared_cfg, server.master.executor_ids())
+        cfg = addvector_job("lockstep", n=n, epochs=epochs, workers=2,
+                            slack=1).replace(tables=[shared_cfg])
+        cfg.user["force_lockstep"] = True
+        res = server.submit(cfg).result(timeout=120)
+        assert set(res["workers"]) == {"lockstep/w0", "lockstep/w1"}
+        vals = np.asarray(
+            server.master.get_table("lockstep-addv").table.pull_array()
+        )
+        # both workers' pushes all landed, exactly once each
+        np.testing.assert_allclose(vals, np.full((8, 2), n * epochs))
         server.shutdown(timeout=30)
 
 

@@ -192,6 +192,115 @@ def test_pod_concurrent_carved_tenants():
         server.shutdown(timeout=60)
 
 
+def test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline():
+    """Multi-worker SSP on a MULTI-PROCESS pod (round-2 verdict item 2 —
+    the reference gates workers master-side over messages,
+    MiniBatchController.java:28-118). Two workers span a 2-process mesh
+    under the share_all grant; the DispatchTurnstile gives every process
+    the same dispatch schedule, so the per-process SSP controllers make
+    identical decisions with no broadcast. Asserts:
+      * the job trains and converges with num_workers=2 + clock_slack=1
+        (previously rejected at submit);
+      * a host-lagged w1 provably gates w0 — the job wall absorbs every
+        sleep (the turnstile bounds divergence at one turn, stricter than
+        any slack);
+      * the loss series equals the SAME config run single-process under
+        force_lockstep — the pod changes placement, not numerics;
+      * every process reports the identical series (SPMD lockstep held).
+    """
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    from harmony_tpu.jobserver.client import CommandSender
+
+    LAG, EPOCHS = 0.4, 3
+    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    env = _sanitized_env(4)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
+             str(pod_port), str(tcp_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+
+    def ssp_cfg(force_lockstep: bool) -> JobConfig:
+        return JobConfig(
+            job_id="pod-ssp", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=EPOCHS, num_mini_batches=4, clock_slack=1,
+                app_params={"lag_sec": LAG, "num_classes": 4,
+                            "num_features": 16, "features_per_partition": 4,
+                            "step_size": 0.1},
+            ),
+            num_workers=2,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 7},
+                  **({"force_lockstep": True} if force_lockstep else {})},
+        )
+
+    try:
+        assert wait_for_ready(procs[0], 240), "leader never became ready"
+        deadline = time.monotonic() + 300
+        sender = CommandSender(tcp_port)
+        resp = sender.send_job_submit_command(ssp_cfg(False))
+        assert resp.get("ok"), resp
+        while time.monotonic() < deadline:
+            if not sender.send_status_command().get("running"):
+                break
+            time.sleep(0.3)
+        sender.send_shutdown_command()
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                pytest.fail("pod worker hung")
+            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
+    assert lead, f"no RESULT from leader: {outs[0]!r}"
+    result = json.loads(lead[0][len("RESULT "):])
+    res = result["local_results"]["pod-ssp"]
+    assert "error" not in res, res
+    losses = {wid: w["losses"] for wid, w in res.items()}
+    assert set(losses) == {"pod-ssp/w0", "pod-ssp/w1"}
+    for wid, series in losses.items():
+        assert len(series) == EPOCHS and series[-1] < series[0], (wid, series)
+    # the lagged w1 gated the whole job: its per-epoch sleeps are serial
+    # wall time (w0 cannot run ahead through the turnstile)
+    wall = result["job_walls"]["pod-ssp"]
+    assert wall[1] - wall[0] >= EPOCHS * LAG, wall
+    # the follower ran the same workers to the same numbers
+    follower = result["pod_reports"]["pod-ssp"]["1"]
+    assert follower["ok"], follower
+    for wid, series in losses.items():
+        assert [round(x, 5) for x in follower["workers"][wid]["losses"]] == [
+            round(x, 5) for x in series
+        ], wid
+    # single-process lockstep baseline: identical numbers
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=8)
+    server.start()
+    try:
+        iso = server.submit(ssp_cfg(True)).result(timeout=240)
+        for wid, series in losses.items():
+            assert [round(float(x), 5)
+                    for x in iso["workers"][wid]["losses"]] == [
+                round(x, 5) for x in series
+            ], (wid, iso["workers"][wid]["losses"], series)
+    finally:
+        server.shutdown(timeout=60)
+
+
 @pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 4), (3, 2)])
 def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
     """The multi-host control plane (ref: JobServerDriver.java:149-163
