@@ -241,9 +241,124 @@ class CheckpointManager:
         reference (AllocatedTable doc); here the per-block snapshot already
         dispatches under the table lock, so a concurrent reshard simply
         orders before or after the whole export.
+
+        On a MULTI-PROCESS mesh this is an SPMD-collective call: every
+        process of the table's mesh must call it with the same arguments
+        (see _pod_checkpoint).
         """
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        if mesh_spans_processes(handle.table.mesh):
+            return self._pod_checkpoint(handle, sampling_ratio, commit)
         chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
         self._write(info, snap, handle.table.spec.block_size, commit)
+        return chkp_id
+
+    def _pod_checkpoint(
+        self, handle: TableHandle, sampling_ratio: float, commit: bool
+    ) -> str:
+        """Pod-mode two-stage checkpoint (ref: ChkpManagerSlave.java:50-63
+        staging per-executor local files + ChkpManagerMaster.java:49-61
+        coordinating the commit): each process stages ITS owned blocks from
+        addressable shards — no process ever touches a non-addressable
+        byte — then the mesh-lowest process writes the manifest, renames
+        the staging dir into place, and runs the stage-2 commit, fenced by
+        mesh barriers.
+
+        Requirements: ``temp_root`` must be shared storage across the
+        mesh's processes (the virtual-pod tests share one FS; real pods
+        point temp_root at NFS/GCS-fuse — per-host-private temp dirs need
+        a per-process commit protocol this round does not ship), and the
+        call is SPMD-collective: every participating process calls with
+        identical arguments in its deterministic call sequence (the chkp
+        id is derived from the per-process counter, NOT a timestamp, so
+        all processes name the same checkpoint)."""
+        from harmony_tpu.parallel.multihost import mesh_sum
+
+        if sampling_ratio != 1.0:
+            raise ValueError(
+                "sampling is single-process only: a sampled pod restore "
+                "would need the cross-process pad path"
+            )
+        with self._lock:
+            # Deterministic-but-unique id: no timestamps (every process
+            # must derive the SAME id without talking), so bump the
+            # counter past ids already present in shared storage — a
+            # resubmitted job's fresh manager would otherwise reuse
+            # '<table>-1-pod' and commit() would silently keep the stale
+            # run's blocks. All processes scan the same shared roots at
+            # the same logical point, so they agree.
+            while True:
+                self._counter += 1
+                chkp_id = f"{handle.table_id}-{self._counter}-pod"
+                if not self._backend.exists(chkp_id) and not os.path.isdir(
+                    os.path.join(self.temp_root, chkp_id)
+                ):
+                    break
+        mesh = handle.table.mesh
+        leader = min(d.process_index for d in mesh.devices.flat)
+        import jax as _jax
+
+        info = CheckpointInfo(
+            chkp_id=chkp_id,
+            table_config=handle.table.spec.config,
+            block_ids=list(range(handle.table.spec.num_blocks)),
+            ownership=handle.block_manager.ownership_vector(),
+            executors=handle.block_manager.executors,
+            sampling_ratio=1.0,
+            committed=False,
+            created_at=time.time(),
+        )
+        tdir = os.path.join(self.temp_root, chkp_id)
+        staging = tdir + ".writing"
+        # Failure containment: a one-sided staging error must not strand
+        # peers in the fence (a psum never times out) — every process
+        # reports its error flag THROUGH the fence, and all raise together
+        # if anyone failed.
+        err: Optional[BaseException] = None
+        try:
+            os.makedirs(staging, exist_ok=True)  # processes race; shared FS
+            sparse = info.table_config.sparse
+            mine = handle.table.addressable_blocks()
+            for bid in sorted(mine):
+                item = mine[bid]
+                if sparse:
+                    arr = _pack_hash_block(
+                        np.asarray(item[0]), np.asarray(item[1])
+                    )
+                else:
+                    arr = np.asarray(item)
+                _write_block(staging, bid, arr)
+        except BaseException as e:  # noqa: BLE001 - reported via the fence
+            err = e
+        failures = mesh_sum(mesh, 1.0 if err else 0.0,
+                            f"chkp-staged:{chkp_id}")
+        if failures:
+            if _jax.process_index() == leader:
+                shutil.rmtree(staging, ignore_errors=True)
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"{int(failures)} process(es) failed staging {chkp_id}"
+            )
+        if _jax.process_index() == leader:
+            try:
+                with open(os.path.join(staging, "manifest.json"), "w") as f:
+                    f.write(info.to_json())
+                os.rename(staging, tdir)
+                if commit:
+                    self.commit(chkp_id)
+            except BaseException as e:  # noqa: BLE001 - fenced below
+                err = e
+                shutil.rmtree(staging, ignore_errors=True)
+        failures = mesh_sum(mesh, 1.0 if err else 0.0,
+                            f"chkp-done:{chkp_id}")
+        if failures:
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"leader failed finalizing {chkp_id} (manifest/commit)"
+            )
         return chkp_id
 
     def checkpoint_async(
@@ -258,6 +373,16 @@ class CheckpointManager:
         :class:`PendingCheckpoint`; the checkpoint id resolves to a readable
         directory only once ``wait()`` returns (the manifest is written
         last, so an in-flight id never restores partially)."""
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        if mesh_spans_processes(handle.table.mesh):
+            # The pod path fences with mesh-collective barriers; running
+            # those on a background thread would race the pod's lockstep
+            # dispatch order. Pod checkpoints are synchronous collectives.
+            raise ValueError(
+                "checkpoint_async is single-process only; call "
+                "checkpoint() collectively on a multi-process mesh"
+            )
         chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
         pending = PendingCheckpoint(chkp_id)
         block_size = handle.table.spec.block_size
@@ -355,6 +480,15 @@ class CheckpointManager:
                     blocks[bid] = _unpack_hash_block(arr, spec)
                     continue
                 if arr.shape[0] < spec.block_size:
+                    from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+                    if mesh_spans_processes(handle.table.mesh):
+                        raise ValueError(
+                            f"checkpoint {chkp_id} is sampled; the init-pad "
+                            "path reads whole blocks host-side and is "
+                            "single-process only — restore onto a "
+                            "single-process mesh"
+                        )
                     # sampled: pad with the block's existing init values
                     full = np.array(handle.table.export_blocks([bid])[bid])
                     full[: arr.shape[0]] = arr

@@ -57,6 +57,16 @@ class ModelChkpManager:
         WorkerTasklet(epoch_callback=...)."""
         if (epoch_idx + 1) % self._period:
             return None
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        if mesh_spans_processes(self._handle.table.mesh):
+            # Pod: the checkpoint is a synchronous mesh collective (every
+            # process's chief worker reaches this hook at the same point in
+            # its deterministic schedule; checkpoint_async's background
+            # barriers would race the lockstep dispatch order).
+            cid = self._mgr.checkpoint(self._handle, commit=self._commit)
+            self.chkp_ids.append(cid)
+            return cid
         while len(self._pending) >= self.MAX_PENDING:
             oldest = self._pending.pop(0)  # backpressure: join the oldest
             try:

@@ -205,17 +205,33 @@ class DolphinJobEntity(JobEntity):
         if params.model_chkp_period > 0:
             from harmony_tpu.parallel.mesh import mesh_spans_processes
 
-            if mesh_spans_processes(self._handle.table.mesh):
-                # Two blockers until the pod checkpoint path lands: the
-                # stage-1 export reads the global array host-side (not
-                # addressable from one process of a multi-process mesh),
-                # and the chief's epoch-hook snapshot gathers would
-                # dispatch outside the turnstile's deterministic order.
-                raise ValueError(
-                    f"job {cfg.job_id}: model_chkp_period > 0 is "
-                    "single-process only; multi-process pod checkpointing "
-                    "is not wired yet"
-                )
+            spans = mesh_spans_processes(self._handle.table.mesh)
+            if spans:
+                # Pod checkpoint chains ride the synchronous collective
+                # path (ModelChkpManager.on_epoch -> CheckpointManager
+                # pod branch). Legal only with ONE dispatch thread per
+                # process — under a turnstile the hook runs outside turns
+                # and its collective would race the schedule — and only
+                # with a SHARED chkp root (each process stages its own
+                # blocks into one checkpoint directory).
+                if num_workers != 1:
+                    raise ValueError(
+                        f"job {cfg.job_id}: model_chkp_period > 0 on a "
+                        "multi-process grant needs num_workers=1 (the "
+                        "epoch hook dispatches outside turnstile turns)"
+                    )
+                if self.chkp_root is None:
+                    raise ValueError(
+                        f"job {cfg.job_id}: pod checkpoint chains need a "
+                        "SHARED chkp_root (per-process temp dirs would "
+                        "each hold only a fragment of every checkpoint)"
+                    )
+                if params.offline_model_eval:
+                    raise ValueError(
+                        f"job {cfg.job_id}: offline_model_eval is "
+                        "single-process only (the shutdown-stage restore "
+                        "is not a pod collective yet)"
+                    )
             import os
             import tempfile
 

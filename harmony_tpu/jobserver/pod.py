@@ -389,18 +389,18 @@ class PodJobServer(JobServer):
                             )
                         self._pod_cond.notify_all()
                     server_log.error("pod broken: %s", self._pod_broken)
-                self.pod_reports[config.job_id] = reports
-                while len(self.pod_reports) > 256:  # bound leader memory
-                    self.pod_reports.pop(next(iter(self.pod_reports)))
-                for pid, rep in reports.items():
-                    if rep.get("has_deferred_eval"):
-                        with self._pod_cond:
+                with self._pod_cond:  # concurrent dispatch threads trim too
+                    self.pod_reports[config.job_id] = reports
+                    while len(self.pod_reports) > 256:  # bound leader memory
+                        self.pod_reports.pop(next(iter(self.pod_reports)))
+                    for pid, rep in reports.items():
+                        if rep.get("has_deferred_eval"):
                             self._remote_evals[config.job_id] = pid
         finally:
-            self.job_walls[config.job_id] = (t0, time.monotonic())
-            while len(self.job_walls) > 1024:
-                self.job_walls.pop(next(iter(self.job_walls)))
             with self._pod_cond:
+                self.job_walls[config.job_id] = (t0, time.monotonic())
+                while len(self.job_walls) > 1024:
+                    self.job_walls.pop(next(iter(self.job_walls)))
                 self._active_procs.pop(config.job_id, None)
                 self._pod_cond.notify_all()
 

@@ -118,6 +118,55 @@ def global_mesh(data=None, model=None, seq=None):
     return build_mesh(global_devices(), data=data, model=model, seq=seq)
 
 
+_MESH_SUM_CACHE: dict = {}
+
+
+def mesh_sum(mesh, value: float, tag: str = "") -> float:
+    """Sum a per-PROCESS scalar over ONLY the processes holding devices of
+    ``mesh`` (each process contributes its value once, via its first
+    addressable mesh device; the rest contribute zero). Doubles as the
+    mesh-scoped barrier: the psum completes only when every participating
+    process has dispatched it — unlike sync_global_devices this is safe
+    for a CARVED mesh (the global barrier would wait on processes that
+    never call it). ``tag`` is documentation/trace only: collectives match
+    by the deterministic call sequence, not by name.
+
+    Single-process meshes return ``value`` immediately."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+    if not mesh_spans_processes(mesh):
+        return value
+    axes = tuple(mesh.axis_names)
+    fn = _MESH_SUM_CACHE.get(mesh)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(v, axes), mesh=mesh,
+            in_specs=P(axes), out_specs=P(),
+        ))
+        _MESH_SUM_CACHE[mesh] = fn
+        while len(_MESH_SUM_CACHE) > 64:  # long-lived servers, many meshes
+            _MESH_SUM_CACHE.pop(next(iter(_MESH_SUM_CACHE)))
+    sharding = NamedSharding(mesh, P(axes))
+    imap = sharding.addressable_devices_indices_map((mesh.devices.size,))
+    shards = []
+    first = True
+    for d, idx in sorted(imap.items(), key=lambda kv: kv[1][0].start or 0):
+        v = float(value) if first else 0.0
+        first = False
+        shards.append(jax.device_put(np.asarray([v], np.float32), d))
+    arr = jax.make_array_from_single_device_arrays(
+        (mesh.devices.size,), sharding, shards
+    )
+    return float(np.asarray(fn(arr)))  # replicated out: addressable D2H
+
+
+def mesh_barrier(mesh, tag: str = "barrier") -> None:
+    """Mesh-scoped barrier (see mesh_sum)."""
+    mesh_sum(mesh, 0.0, tag)
+
+
 def sync_global_devices(tag: str = "barrier") -> None:
     """Cross-host barrier: a tiny psum over every device; returns when all
     processes reached it (the analogue of the reference's driver-mediated

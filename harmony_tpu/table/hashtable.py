@@ -618,17 +618,42 @@ class DeviceHashTable:
     def import_blocks(
         self, blocks: Dict[int, Tuple[np.ndarray, np.ndarray]]
     ) -> None:
+        """Install block payloads via a jitted scatter (not a host
+        round-trip of the whole state): works unchanged on a multi-process
+        mesh, where np.asarray of the global state would be illegal —
+        every process dispatches the same program with the same host
+        payload (the pod restore path)."""
+        if not blocks:
+            return
+        ids_sorted = sorted(blocks)
+        ids = jnp.asarray(ids_sorted, jnp.int32)
+        pk = jnp.asarray(np.stack([np.asarray(blocks[b][0]) for b in ids_sorted]))
+        pv = jnp.asarray(np.stack([np.asarray(blocks[b][1]) for b in ids_sorted]))
         with self._lock:
             self._check()
-            sk = np.asarray(self._state[0]).copy()
-            v = np.asarray(self._state[1]).copy()
-            for b, (bk, bv) in blocks.items():
-                sk[b] = bk
-                v[b] = bv
-            self._state = (
-                jax.device_put(jnp.asarray(sk), self._ksh),
-                jax.device_put(jnp.asarray(v), self._vsh),
+            set_fn = jax.jit(
+                lambda sk, v, i, nk, nv: (
+                    sk.at[i].set(nk.astype(sk.dtype)),
+                    v.at[i].set(nv.astype(v.dtype)),
+                ),
+                out_shardings=(self._ksh, self._vsh),
             )
+            self._state = set_fn(self._state[0], self._state[1], ids, pk, pv)
+
+    def addressable_blocks(
+        self,
+    ) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """THIS process's owned (slot_keys, values) block pairs (the
+        stage-1 pod checkpoint source; both arrays share the block
+        sharding, so the owner sets coincide)."""
+        from harmony_tpu.table.table import owned_addressable_blocks
+
+        with self._lock:
+            self._check()
+            sk, v = self._state
+        ks = owned_addressable_blocks(sk)
+        vs = owned_addressable_blocks(v)
+        return {b: (ks[b], vs[b]) for b in ks if b in vs}
 
     def items(self) -> Dict[int, np.ndarray]:
         """All present (key, value) pairs — test/debug surface."""

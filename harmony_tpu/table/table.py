@@ -54,6 +54,37 @@ from harmony_tpu.table.partition import (
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 
+def owned_addressable_blocks(arr: jax.Array) -> "Dict[int, np.ndarray]":
+    """Blocks of a block-major global array whose bytes live on THIS
+    process — deduped across replicas by the lowest-owner-process rule, so
+    on a multi-process mesh every block is returned by exactly one process
+    (the pod checkpoint's stage-1 contract: each process stages its own
+    blocks from addressable shards, ref ChkpManagerSlave.java:50-63)."""
+    pid = jax.process_index()
+    nb = arr.shape[0]
+
+    def _bounds(idx) -> "Tuple[int, int]":
+        sl = idx[0] if idx else slice(None)
+        return sl.start or 0, nb if sl.stop is None else sl.stop
+
+    owners: Dict[int, int] = {}
+    for d, idx in arr.sharding.devices_indices_map(arr.shape).items():
+        start, stop = _bounds(idx)
+        for b in range(start, stop):
+            if owners.get(b, d.process_index + 1) > d.process_index:
+                owners[b] = d.process_index
+    out: Dict[int, np.ndarray] = {}
+    for shard in arr.addressable_shards:
+        start, stop = _bounds(shard.index)
+        data = None
+        for b in range(start, stop):
+            if owners.get(b) == pid and b not in out:
+                if data is None:
+                    data = np.asarray(shard.data)  # one D2H per shard
+                out[b] = data[b - start]
+    return out
+
+
 def block_sharding(mesh: Mesh, num_blocks: int) -> NamedSharding:
     """Placement policy for block-major table storage, shared by dense and
     hash tables: shard the leading (block) axis over the mesh model axis
@@ -526,8 +557,17 @@ class DenseTable:
 
     def export_blocks(self, block_ids: Optional[Sequence[int]] = None) -> Dict[int, np.ndarray]:
         """Materialize blocks to host memory (ref: ChkpManagerSlave writes
-        local blocks to per-block files, evaluator/impl/ChkpManagerSlave.java)."""
+        local blocks to per-block files, evaluator/impl/ChkpManagerSlave.java).
+        Single-controller only — on a multi-process mesh use
+        :meth:`addressable_blocks` (each process reads its own shards)."""
         return {b: np.asarray(a) for b, a in self.snapshot_blocks(block_ids).items()}
+
+    def addressable_blocks(self) -> Dict[int, np.ndarray]:
+        """THIS process's owned blocks as host arrays (the stage-1 pod
+        checkpoint source; see owned_addressable_blocks)."""
+        with self._lock:
+            arr = self._arr
+        return owned_addressable_blocks(arr)
 
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> None:
         """Install block payloads (restore path; tolerates any topology —

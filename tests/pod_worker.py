@@ -54,6 +54,7 @@ def main() -> None:
             num_executors=n_exec,
             num_followers=nprocs - 1,
             scheduler=_make_scheduler(sched_arg),
+            chkp_root=os.environ.get("HARMONY_POD_CHKP_ROOT"),
         )
         server.start()
         server.serve_pod(pod_port)
@@ -69,6 +70,8 @@ def main() -> None:
                     wid: {"losses": [float(x) for x in w.get("losses", [])]}
                     for wid, w in res.get("workers", {}).items()
                 }
+                if "model_chkp_ids" in res:
+                    local[job_id]["model_chkp_ids"] = res["model_chkp_ids"]
             except Exception as e:  # noqa: BLE001 - reported in RESULT
                 local[job_id] = {"error": f"{type(e).__name__}: {e}"}
         print("RESULT " + json.dumps({
