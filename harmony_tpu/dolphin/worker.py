@@ -107,7 +107,16 @@ class WorkerTasklet:
         self._program_cache_key = None  # set by _build_step
         self._built_once = False
         # Comm/comp split probe (see _probe_comm): period in epochs; 0 = off.
+        # Cadence: the split is a property of the (layout, shapes) pair, so
+        # the probe runs on FIRST use and again after any rebuild/reshard
+        # (which clears the programs), plus a slow drift refresh every
+        # 8x period epochs — NOT every period epochs (an every-epoch probe
+        # both blocked multi-epoch dispatch windows for default jobs and,
+        # under multi-tenancy, serialized ~8 dispatches per epoch behind
+        # other tenants' steps, dominating cheap jobs' wall time).
         self.comm_probe_every = getattr(ctx.params, "comm_probe_period", 1)
+        self._next_probe = 0  # epochs-since-start of the next drift refresh
+        self._own_batch_cost = 0.0  # EWMA of own dispatch seconds per batch
         self._probe_pull = None
         self._probe_pp = None
         self._comm_probe_times = (0.0, 0.0)
@@ -483,10 +492,17 @@ class WorkerTasklet:
         if self._probe_pull is None:
             self._build_comm_probe()
 
+        # min-of-3 after a warmup/compile dispatch: these programs run
+        # sub-millisecond on small tables and the split comes from a
+        # SUBTRACTION, so single-shot jitter would routinely invert it.
+        # Under multi-tenant contention each dispatch waits behind other
+        # tenants' steps at the dispatch lock, so the sample count drops
+        # to 1 — a noisier split beats stalling a cheap tenant for eight
+        # serialized waits.
+        samples = (1 if self.taskunit is not None and self.taskunit.contended()
+                   else 3)
+
         def timed(fn, *args) -> float:
-            # min-of-3 after a warmup/compile dispatch: these programs run
-            # sub-millisecond on small tables and the split comes from a
-            # SUBTRACTION, so single-shot jitter would routinely invert it.
             # The global dispatch scope wraps each DISPATCH, not the whole
             # loop — on async backends the wait happens outside the lock, so
             # other tenants never stall behind a probe's round-trips.
@@ -501,7 +517,7 @@ class WorkerTasklet:
                 return time.perf_counter() - t0
 
             once()  # warmup/compile
-            return min(once() for _ in range(3))
+            return min(once() for _ in range(samples))
 
         try:
             # Under the table lock: another worker's DONATING step must not
@@ -570,8 +586,14 @@ class WorkerTasklet:
             return 1
         w = min(self.EPOCH_WINDOW, num_epochs - epoch)
         if self.comm_probe_every and self.global_init:
-            done = (epoch - self.starting_epoch) % self.comm_probe_every
-            w = min(w, self.comm_probe_every - done)
+            if self._probe_pull is None:
+                # a probe (re)build is due at this epoch boundary — keep
+                # per-epoch until it has run (first epoch / after reshard)
+                w = min(w, 1)
+            else:
+                until = self._next_probe - (epoch - self.starting_epoch)
+                if until > 0:
+                    w = min(w, until)
         return max(1, w)
 
     def _maybe_rebuild(self) -> None:
@@ -687,9 +709,11 @@ class WorkerTasklet:
             # is a plain prefix slice — the provider's epoch_batches()
             # would consume a shuffle from its RNG and change seeded batch
             # order relative to a probe-free run.
+            since = epoch - self.starting_epoch
             if self.comm_probe_every and self.global_init and (
-                (epoch - self.starting_epoch) % self.comm_probe_every == 0
+                self._probe_pull is None or since >= self._next_probe
             ):
+                self._next_probe = since + 8 * self.comm_probe_every
                 first = tuple(a[: self.data.batch_size]
                               for a in self.data._arrays)
                 if first and len(first[0]):
@@ -783,6 +807,16 @@ class WorkerTasklet:
     # Bound on steps enqueued without a device sync (keeps the dispatch
     # queue and donated-buffer chain short on long epochs).
     MAX_INFLIGHT = 32
+    # Under multi-tenant contention the deep window becomes the UNFAIRNESS:
+    # another tenant's next unit waits behind this job's whole enqueued
+    # backlog (measured 15x slowdown for the cheapest tenant, FAIRNESS_r02)
+    # — so contended jobs keep the device queue shallow.
+    CONTENDED_INFLIGHT = 2
+
+    def _inflight_cap(self) -> int:
+        if self.taskunit is not None and self.taskunit.contended():
+            return self.CONTENDED_INFLIGHT
+        return self.MAX_INFLIGHT
 
     def _run_batched_epoch(
         self, epoch: int, global_batch_idx: int
@@ -833,17 +867,37 @@ class WorkerTasklet:
             self._account_ops(len(pending))
         return epoch_examples, last_metrics, global_batch_idx, stop
 
+    # Target span of one admitted TaskUnit under contention: a cheap job
+    # pays ~one residual big-unit wait per OWN unit (non-preemptive slot),
+    # so per-batch units make its slowdown scale with the PEERS' batch
+    # time. Grouping consecutive batches until a unit spans ~this many
+    # seconds normalizes unit granularity in TIME across tenants.
+    UNIT_SPAN_TARGET = 0.1
+
+    def _units_per_scope(self) -> int:
+        if self.taskunit is None or not self.taskunit.contended():
+            return 1
+        if self.batch_barrier is not None:
+            return 1  # the SSP gate is per batch; never hold a slot on it
+        c = self._own_batch_cost
+        if not c:
+            return 1
+        return max(1, min(8, int(self.UNIT_SPAN_TARGET / max(c, 1e-6))))
+
     def _dispatch_epoch_batches(self, epoch: int, global_batch_idx: int):
         """The per-batch dispatch loop of one epoch — async, TaskUnit
-        admission per batch, NO drain. Returns (pending device metrics,
-        batch_sizes, examples, global_batch_idx, stop, dispatch_seconds)."""
+        admission per batch group (see _units_per_scope), NO drain.
+        Returns (pending device metrics, batch_sizes, examples,
+        global_batch_idx, stop, dispatch_seconds)."""
         epoch_examples = 0
         stop = False
         pending: List[Dict[str, jnp.ndarray]] = []
         batch_sizes: List[int] = []
         hyper = self._hyper()
-        work_t = 0.0  # dispatch time, EXCLUDING SSP barrier waits
-        for batch_idx, batch in enumerate(self.data.epoch_batches()):
+        work_t = 0.0  # dispatch time, EXCLUDING admission/barrier waits
+        it = enumerate(self.data.epoch_batches())
+        nxt = next(it, None)
+        while nxt is not None and not stop:
             with self._turn():
                 if self._pending_probe is not None:
                     # turnstiled pods probe inside the chief's first batch
@@ -857,21 +911,61 @@ class WorkerTasklet:
                     stop = self.batch_barrier(global_batch_idx)
                     if stop:
                         break
-                t0 = time.perf_counter()
+                group = self._units_per_scope()
                 with self._taskunit_scope("COMP"):
-                    metrics = self._dispatch_batch(batch_idx, batch, hyper)
-            pending.append(metrics)
-            if len(pending) >= self.MAX_INFLIGHT:
-                # Sliding window: block on the OLDEST outstanding step so the
-                # device queue stays full (blocking on the newest would drain
-                # it and idle the chip for a host round-trip). hard_sync so a
-                # lazy backend actually applies backpressure instead of
-                # acking and letting in-flight work grow without bound.
-                hard_sync(pending[len(pending) - self.MAX_INFLIGHT])
-            work_t += time.perf_counter() - t0
-            batch_sizes.append(batch[0].shape[0])
-            epoch_examples += batch[0].shape[0]
-            global_batch_idx += 1
+                    # timer starts AFTER admission: the grant wait is
+                    # scheduling, not work — counting it would both skew
+                    # the optimizer's comm/comp split and feed an
+                    # inflated unit cost back into the fair-queue deficit
+                    # (a starved cheap job would look expensive and be
+                    # starved harder)
+                    t_scope = time.perf_counter()
+                    done = 0
+                    while nxt is not None and done < group:
+                        batch_idx, batch = nxt
+                        t0 = time.perf_counter()
+                        metrics = self._dispatch_batch(batch_idx, batch, hyper)
+                        pending.append(metrics)
+                        cap = self._inflight_cap()
+                        if len(pending) >= cap:
+                            # Sliding window: block on the OLDEST
+                            # outstanding step so the device queue stays
+                            # full. hard_sync so a lazy backend actually
+                            # applies backpressure.
+                            hard_sync(pending[len(pending) - cap])
+                        # dt spans dispatch AND the backpressure sync: on
+                        # async backends the sync absorbs real device time
+                        # that would otherwise land in neither work_t nor
+                        # the drain (those steps are complete by then)
+                        dt = time.perf_counter() - t0
+                        # own per-batch EWMA sizes future groups
+                        self._own_batch_cost = (
+                            dt if not self._own_batch_cost
+                            else 0.5 * self._own_batch_cost + 0.5 * dt
+                        )
+                        work_t += dt
+                        batch_sizes.append(batch[0].shape[0])
+                        epoch_examples += batch[0].shape[0]
+                        global_batch_idx += 1
+                        done += 1
+                        if done < group:
+                            nxt = next(it, None)
+                        else:
+                            nxt = None  # refetched below
+                    if self.taskunit is not None:
+                        # live per-UNIT cost for the weighted-fair queue:
+                        # the drain-time report (authoritative on async
+                        # backends) can be a whole multi-epoch window
+                        # away, and a blind WFQ degenerates to 1:1
+                        # pacing. Under the metered global slot the
+                        # in-scope elapsed is ~this unit's own execution
+                        # (blocking backends) or its enqueue cost
+                        # (async) — either way job-relative.
+                        self.taskunit.report_unit_cost(
+                            time.perf_counter() - t_scope
+                        )
+            if not stop:
+                nxt = next(it, None)
         return pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t
 
     def _drain_pending(
@@ -1017,6 +1111,10 @@ class WorkerTasklet:
             self.ctx.model_table, "_comm_split", self._comm_probe_times
         )
         comp = max(per_batch_time - t_pull - t_push, 0.0)
+        # NOTE: the weighted-fair-queue unit cost is reported from the
+        # dispatch scope only (per granted UNIT) — reporting the drain's
+        # per-BATCH smear here would mix scales differing by the group
+        # factor and undercharge grouped jobs.
         for b, n in enumerate(batch_sizes):
             self.collector.add(
                 BatchMetrics(

@@ -58,7 +58,19 @@ class TaskUnitInfo:
 
 
 class GlobalTaskUnitScheduler:
-    """Driver-side: one global grant order across concurrent jobs."""
+    """Driver-side: one global grant order across concurrent jobs.
+
+    Fairness: grants are DEFICIT-ORDERED and, under contention, METERED.
+    The reference's pure quorum broadcast produces *an* order, not a fair
+    one — measured on the multi-tenant bench, the cheapest job's units
+    queued behind the other tenants' device backlogs for a 15x slowdown
+    (FAIRNESS_r02). Here, when more than one job is waiting, each job may
+    hold at most one un-finished granted unit per resource kind (the
+    TaskUnitClient reports scope exit — the reference's
+    onTaskUnitFinished), and ready units are granted lowest-deficit-first
+    (deficit = units granted so far), so tenants alternate enqueues
+    instead of flooding. A lone job keeps the zero-overhead
+    grant-everything path."""
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
@@ -66,6 +78,23 @@ class GlobalTaskUnitScheduler:
         # (job_id, seq, kind) -> executors currently waiting
         self._waiting: Dict[Tuple[str, int, str], Set[str]] = {}
         self._granted: Set[Tuple[str, int, str]] = set()
+        # arrival order of wait keys (deficit ties break by arrival)
+        self._arrival: Dict[Tuple[str, int, str], int] = {}
+        self._arrival_counter = 0
+        # fairness metering (see class doc). Deficit is DEVICE-TIME
+        # weighted: charging grants by unit count would pace every tenant
+        # 1:1 — exactly what makes a cheap job finish with the most
+        # expensive one (the 15x). Jobs report their measured per-unit
+        # seconds (report_unit_cost); until a job has a measurement its
+        # units charge the mean known cost (neutral).
+        self._deficit: Dict[str, float] = {}
+        self._unit_cost: Dict[str, float] = {}
+        self._outstanding: Dict[Tuple[str, str], int] = {}  # (job, kind)
+        # granted key -> executors that have NOT yet finished it (a SET,
+        # not a count: an executor may both finish a unit and then leave
+        # the job — counting would double-decrement and release the
+        # contention meter while a peer is still inside the scope)
+        self._finishes: Dict[Tuple[str, int, str], Set[str]] = {}
         # Bounded: a long-lived server grants one entry per phase per batch
         # forever; keep a recent window for tests/metrics, not full history.
         self._grant_log: deque = deque(maxlen=100_000)
@@ -73,15 +102,79 @@ class GlobalTaskUnitScheduler:
     def on_job_start(self, job_id: str, executor_ids: List[str]) -> None:
         with self._cond:
             self._job_executors[job_id] = set(executor_ids)
+            # WFQ virtual-time start: a late arrival begins at the lowest
+            # active deficit, not zero — zero would let it monopolize
+            # grants until it "caught up" with long-running tenants.
+            active = [self._deficit[j] for j in self._job_executors
+                      if j != job_id and j in self._deficit]
+            self._deficit.setdefault(job_id, min(active) if active else 0.0)
 
     def on_job_finish(self, job_id: str) -> None:
         with self._cond:
             self._job_executors.pop(job_id, None)
+            self._deficit.pop(job_id, None)
             for key in [k for k in self._waiting if k[0] == job_id]:
                 del self._waiting[key]
+                self._arrival.pop(key, None)
             for key in [k for k in self._granted if k[0] == job_id]:
                 self._granted.discard(key)
+            for key in [k for k in self._finishes if k[0] == job_id]:
+                del self._finishes[key]
+            for jk in [k for k in self._outstanding if k[0] == job_id]:
+                del self._outstanding[jk]
+            self._maybe_grant_locked()  # departed meter may unblock peers
             self._cond.notify_all()
+
+    def num_jobs(self) -> int:
+        """Registered jobs — workers use >1 as the contention signal to
+        shrink their in-flight dispatch windows."""
+        with self._cond:
+            return len(self._job_executors)
+
+    def report_unit_cost(self, job_id: str, seconds: float) -> None:
+        """Measured per-unit device seconds for a job (workers report the
+        smeared per-batch time at each metric drain); EWMA-smoothed."""
+        if seconds <= 0:
+            return
+        with self._cond:
+            prev = self._unit_cost.get(job_id)
+            self._unit_cost[job_id] = (
+                seconds if prev is None else 0.5 * prev + 0.5 * seconds
+            )
+            while len(self._unit_cost) > 4096:  # long-lived server bound
+                self._unit_cost.pop(next(iter(self._unit_cost)))
+
+    def _charge_locked(self, job: str) -> float:
+        cost = self._unit_cost.get(job)
+        if cost is None:
+            known = [self._unit_cost[j] for j in self._job_executors
+                     if j in self._unit_cost]
+            cost = sum(known) / len(known) if known else 1.0
+        return cost
+
+    def _release_meter_locked(self, job_id: str, kind: str) -> None:
+        jk = (job_id, kind)
+        n = self._outstanding.get(jk, 0)
+        if n <= 1:
+            self._outstanding.pop(jk, None)
+        else:
+            self._outstanding[jk] = n - 1
+
+    def on_unit_finished(self, unit: "TaskUnitInfo") -> None:
+        """Scope exit (the reference's onTaskUnitFinished): releases this
+        job's meter for the unit's kind so the next lowest-deficit tenant
+        can be granted."""
+        key = (unit.job_id, unit.seq, unit.kind)
+        with self._cond:
+            pending = self._finishes.get(key)
+            if pending is None:
+                return
+            pending.discard(unit.executor_id)
+            if not pending:
+                del self._finishes[key]
+                self._release_meter_locked(unit.job_id, unit.kind)
+                self._maybe_grant_locked()
+                self._cond.notify_all()
 
     def update_job_executors(self, job_id: str, executor_ids: List[str]) -> None:
         """Reconfiguration adjusts the wait quorum."""
@@ -93,13 +186,23 @@ class GlobalTaskUnitScheduler:
         """A worker that stopped (finished, early-stopped, or crashed) must
         leave the quorum, or every surviving worker of the job deadlocks in
         wait_ready forever (the analogue of the reference keeping barrier
-        counts consistent when executors leave)."""
+        counts consistent when executors leave). Its pending finishes are
+        force-released so its job's meter never sticks."""
         with self._cond:
             quorum = self._job_executors.get(job_id)
             if quorum is not None:
                 quorum.discard(executor_id)
             for waiters in self._waiting.values():
                 waiters.discard(executor_id)
+            # a departed executor can never report on_unit_finished:
+            # remove it from every pending finish set it appears in
+            # (idempotent with its own earlier on_unit_finished calls)
+            for key in [k for k in self._finishes if k[0] == job_id]:
+                pending = self._finishes[key]
+                pending.discard(executor_id)
+                if not pending:
+                    del self._finishes[key]
+                    self._release_meter_locked(job_id, key[2])
             self._maybe_grant_locked()
 
     def wait_ready(self, unit: TaskUnitInfo, timeout: Optional[float] = None) -> bool:
@@ -109,20 +212,57 @@ class GlobalTaskUnitScheduler:
         with self._cond:
             if unit.job_id not in self._job_executors:
                 return True  # job not registered: scheduling disabled for it
+            if key not in self._waiting:
+                self._arrival_counter += 1
+                self._arrival[key] = self._arrival_counter
             self._waiting.setdefault(key, set()).add(unit.executor_id)
             self._maybe_grant_locked()
             ok = self._cond.wait_for(lambda: key in self._granted, timeout=timeout)
             return ok
 
     def _maybe_grant_locked(self) -> None:
-        for key, waiters in list(self._waiting.items()):
-            job = key[0]
-            quorum = self._job_executors.get(job)
+        ready = []
+        for key, waiters in self._waiting.items():
+            quorum = self._job_executors.get(key[0])
             if quorum is not None and waiters and quorum <= waiters:
-                del self._waiting[key]
-                self._granted.add(key)
-                self._grant_log.append(key)
-                self._cond.notify_all()
+                ready.append(key)
+        if not ready:
+            return
+        # contention = more than one job REGISTERED (not "currently
+        # waiting": grants are near-instant, so the wait set rarely holds
+        # two jobs at once and a wait-set test would never engage the
+        # meter)
+        contended = len(self._job_executors) > 1
+        # lowest-deficit job first; arrival order breaks ties (and is the
+        # whole order for a lone job — the legacy behavior)
+        ready.sort(key=lambda k: (self._deficit.get(k[0], 0),
+                                  self._arrival.get(k, 0)))
+        granted_any = False
+        for key in ready:
+            job, _seq, kind = key
+            if contended and kind != VOID and self._outstanding:
+                # Metered: the device is ONE resource — under contention
+                # at most one un-finished non-VOID unit is outstanding
+                # ACROSS jobs, so the deficit-ordered grant sequence IS
+                # the device schedule (per-job slots would degenerate to
+                # 1:1 alternation in whatever order threads hit the
+                # dispatch lock).
+                continue
+            waiters = self._waiting.pop(key)
+            self._arrival.pop(key, None)
+            self._granted.add(key)
+            self._grant_log.append(key)
+            self._deficit[job] = (
+                self._deficit.get(job, 0.0) + self._charge_locked(job)
+            )
+            if kind != VOID:
+                self._outstanding[(job, kind)] = (
+                    self._outstanding.get((job, kind), 0) + 1
+                )
+                self._finishes[key] = set(waiters)
+            granted_any = True
+        if granted_any:
+            self._cond.notify_all()
 
     def grant_order(self) -> List[Tuple[str, int, str]]:
         """The single global TaskUnit order (for tests/metrics)."""
@@ -183,3 +323,17 @@ class TaskUnitClient:
             yield
         finally:
             self._local.release(kind)
+            # onTaskUnitFinished: releases the fairness meter (see
+            # GlobalTaskUnitScheduler.on_unit_finished)
+            self._global.on_unit_finished(unit)
+
+    def contended(self) -> bool:
+        """More than one tenant registered — workers shrink their
+        in-flight dispatch windows so no tenant's units queue behind a
+        deep single-job device backlog."""
+        return self._global.num_jobs() > 1
+
+    def report_unit_cost(self, seconds: float) -> None:
+        """Forward this job's measured per-unit seconds to the fair-queue
+        deficit accounting."""
+        self._global.report_unit_cost(self.job_id, seconds)

@@ -631,14 +631,21 @@ class DeviceHashTable:
         pv = jnp.asarray(np.stack([np.asarray(blocks[b][1]) for b in ids_sorted]))
         with self._lock:
             self._check()
-            set_fn = jax.jit(
-                lambda sk, v, i, nk, nv: (
-                    sk.at[i].set(nk.astype(sk.dtype)),
-                    v.at[i].set(nv.astype(v.dtype)),
-                ),
-                out_shardings=(self._ksh, self._vsh),
-            )
-            self._state = set_fn(self._state[0], self._state[1], ids, pk, pv)
+            # one jitted wrapper per layout (a fresh jax.jit each call
+            # would retrace+recompile under the lock every import; the
+            # wrapper's own cache handles varying block counts)
+            cached = getattr(self, "_import_jit", None)
+            if cached is None or cached[1] != (self._ksh, self._vsh):
+                fn = jax.jit(
+                    lambda sk, v, i, nk, nv: (
+                        sk.at[i].set(nk.astype(sk.dtype)),
+                        v.at[i].set(nv.astype(v.dtype)),
+                    ),
+                    out_shardings=(self._ksh, self._vsh),
+                )
+                cached = (fn, (self._ksh, self._vsh))
+                self._import_jit = cached
+            self._state = cached[0](self._state[0], self._state[1], ids, pk, pv)
 
     def addressable_blocks(
         self,

@@ -148,16 +148,21 @@ class TestEpochWindow:
             return WorkerTasklet("j", ctx, trainer,
                                  TrainingDataProvider([x, y], 4), mesh8, **kw)
 
-        # probes cap the window at the probe cadence
-        assert worker(4)._epoch_window_len(0, 12) == 4
+        # a probe (re)build is due before the first probe ran: per-epoch
+        assert worker(4)._epoch_window_len(0, 12) == 1
         # probes off: the class cap applies
         assert worker(0)._epoch_window_len(0, 12) == 8
-        # resume: cadence is relative to starting_epoch, so a worker
-        # resumed at epoch 3 still probes at 3, 7, 11 and windows align
+        # after the first probe, windows open up to the drift-refresh
+        # horizon (8x period), clamped by the class cap
+        w = worker(4)
+        w._probe_pull = object()  # probe ran
+        w._next_probe = 8 * 4
+        assert w._epoch_window_len(0, 12) == 8
+        w._next_probe = 5  # drift refresh near: window must not cross it
+        assert w._epoch_window_len(0, 12) == 5
+        # resume: the horizon is relative to starting_epoch
         w = worker(4, starting_epoch=3)
-        assert w._epoch_window_len(3, 12) == 4
-        assert w._epoch_window_len(7, 12) == 4
-        assert w._epoch_window_len(11, 12) == 1  # last epoch
+        assert w._epoch_window_len(3, 12) == 1  # first probe still due
         # remaining epochs bound the window
         assert worker(0)._epoch_window_len(10, 12) == 2
         # non-deferrable epoch callback (checkpoint chains) disables windows

@@ -74,6 +74,49 @@ def addvector_job(job_id="addv", n=128, epochs=2, workers=2, slack=1):
 
 
 class TestTaskUnits:
+    def test_weighted_fair_grants_favor_cheap_job(self):
+        """Under contention the scheduler meters ONE non-VOID unit at a
+        time across jobs, and when several units wait, the lowest
+        DEVICE-TIME deficit wins — measured unit seconds, not unit counts
+        (count-pacing was the 15x starvation of FAIRNESS_r02)."""
+        g = GlobalTaskUnitScheduler()
+        g.on_job_start("cheap", ["c0"])
+        g.on_job_start("dear", ["d0"])
+        g.report_unit_cost("cheap", 0.01)
+        g.report_unit_cost("dear", 0.10)
+        # one grant each: deficits are now cheap=0.01, dear=0.10 — equal
+        # unit COUNTS, very different device-time charges
+        u_d0 = TaskUnitInfo("dear", "d0", CPU, 0)
+        assert g.wait_ready(u_d0, timeout=5)
+        g.on_unit_finished(u_d0)
+        # occupy the meter with cheap's unit 0...
+        u_c0 = TaskUnitInfo("cheap", "c0", CPU, 0)
+        assert g.wait_ready(u_c0, timeout=5)
+        granted = []
+
+        def waiter(job, eid, seq):
+            u = TaskUnitInfo(job, eid, CPU, seq)
+            assert g.wait_ready(u, timeout=10)
+            granted.append((job, u))
+
+        # ...then queue dear FIRST (earlier arrival), cheap second
+        td = threading.Thread(target=waiter, args=("dear", "d0", 1))
+        td.start()
+        time.sleep(0.1)
+        tc = threading.Thread(target=waiter, args=("cheap", "c0", 1))
+        tc.start()
+        time.sleep(0.1)
+        assert granted == []  # meter: nothing granted while u_c0 runs
+        g.on_unit_finished(u_c0)
+        tc.join(timeout=10)
+        assert [j for j, _ in granted] == ["cheap"]  # deficit beats arrival
+        assert td.is_alive()  # dear still metered out
+        g.on_unit_finished(granted[0][1])
+        td.join(timeout=10)
+        assert [j for j, _ in granted] == ["cheap", "dear"]
+        g.on_job_finish("cheap")
+        g.on_job_finish("dear")
+
     def test_quorum_grant_and_global_order(self):
         g = GlobalTaskUnitScheduler()
         g.on_job_start("j", ["e0", "e1"])
