@@ -117,6 +117,7 @@ class WorkerTasklet:
         self.comm_probe_every = getattr(ctx.params, "comm_probe_period", 1)
         self._next_probe = 0  # epochs-since-start of the next drift refresh
         self._own_batch_cost = 0.0  # EWMA of own dispatch seconds per batch
+        self._prewarmed_stacked = None  # (sharding, stacked) from prewarm
         self._probe_pull = None
         self._probe_pp = None
         self._comm_probe_times = (0.0, 0.0)
@@ -293,22 +294,10 @@ class WorkerTasklet:
                 getattr(self.ctx.model_table, "push_via", None),
                 self.data.num_mini_batches if self._use_fused_epoch() else None)
 
-    def _build_step(self) -> None:
-        table = self.ctx.model_table
-        data_ax = table.mesh.shape.get(DATA_AXIS, 1)
-        if self.data.batch_size % max(data_ax, 1):
-            raise ValueError(
-                f"mini-batch size {self.data.batch_size} not divisible by the "
-                f"mesh data axis ({data_ax}); pick num_mini_batches so that "
-                "each batch splits evenly across data-parallel shards"
-            )
-        # ONE locked read of each table's layout, used for BOTH the cache
-        # key and the compiled out_shardings (see _program_key docstring).
-        tsh = table.sharding
-        lsh = self.ctx.local_table.sharding if self.trainer.uses_local_table else None
-        prev_key = self._program_cache_key if self._built_once else None
-        self._program_cache_key = self._program_key(tsh, lsh)
-        key = self._program_cache_key
+    def _program_builders(self, tsh, lsh):
+        """The step/epoch jit-wrapper constructors for a GIVEN layout
+        snapshot — shared by _build_step (live layout) and _prewarm_layout
+        (announced target layout)."""
 
         def build_step():
             step = self._step_core()
@@ -337,6 +326,110 @@ class WorkerTasklet:
 
             return jax.jit(_epoch, out_shardings=(tsh, None), donate_argnums=0)
 
+        return build_step, build_epoch
+
+    def _prewarm_layout(self, new_mesh: Mesh) -> None:
+        """Layout-announcement listener (TableHandle._reshard_to_owners
+        announces the TARGET mesh before flipping ownership): build the
+        step/epoch programs for the target layout under their progcache
+        key and run ONE zero-input dispatch so XLA compiles NOW, while
+        training still runs on the old layout — the post-flip rebuild then
+        finds warm wrappers and the migrated epoch costs ~the move instead
+        of a recompile (ref: the access-latch-only stall of
+        MigrationExecutor.java:163-253). Best-effort: any failure falls
+        back to the ordinary rebuild."""
+        try:
+            from harmony_tpu.table.hashtable import DeviceHashTable
+
+            table = self.ctx.model_table
+            if isinstance(table, DeviceHashTable):
+                return  # dense-only prewarm for now
+            if self.trainer.uses_local_table:
+                return  # the (model, local) pair reshards independently
+            if (self.dispatch_turn is not None
+                    or self._mesh_spans_processes(table.mesh)
+                    or self._mesh_spans_processes(new_mesh)):
+                # Multi-process / turnstiled: the prewarm would dispatch a
+                # global program from ONE process outside the deterministic
+                # schedule — the other processes never join its collectives
+                # and the move wedges (same hazard class as _probe_comm's
+                # guard). Pod reshard pre-warming needs a collective
+                # protocol; fall back to the ordinary rebuild.
+                return
+            tsh_new = table._make_sharding(new_mesh)
+            if tsh_new == self._step_sharding:
+                return  # announced layout == live layout: nothing to warm
+            key = self._program_key(tsh_new, None)
+            if key is None:
+                return  # uncacheable trainer: a throwaway warm helps nobody
+            fused = self._use_fused_epoch()
+            stacked = None
+            if fused:
+                # EVERY worker pre-uploads its own stacked slice to the
+                # target layout (pure H2D, no collectives) — the re-upload
+                # is part of the relayout stall
+                batches = list(self.data.epoch_batches())
+                st_sh = NamedSharding(new_mesh, P(None, DATA_AXIS))
+                stacked = tuple(
+                    jax.device_put(np.stack([b[i] for b in batches]), st_sh)
+                    for i in range(len(batches[0]))
+                )
+                self._prewarmed_stacked = (tsh_new, stacked)
+                gkey = self._devcache_key_for_sig(
+                    "stacked", progcache.sharding_signature(
+                        NamedSharding(new_mesh, P(DATA_AXIS))
+                    )
+                )
+                if gkey is not None:
+                    devcache.put(gkey, stacked)
+            if not self.global_init:
+                return  # program warm is chief-only: progcache is shared,
+                # so one worker's warm serves the whole job (N duplicate
+                # zero-table epochs would tax the very devices training on)
+            build_step, build_epoch = self._program_builders(tsh_new, None)
+            step = progcache.get_or_build((key, "step"), build_step)
+            epoch_fn = (progcache.get_or_build((key, "epoch"), build_epoch)
+                        if fused else None)
+            spec = table.spec
+            arr0 = jax.device_put(
+                np.zeros(spec.storage_shape, spec.dtype), tsh_new
+            )
+            hyper = self._hyper()
+            if fused:
+                with dispatch_scope(new_mesh) as fin:
+                    out = fin(epoch_fn(arr0, stacked, hyper))
+            else:
+                batch_sh = NamedSharding(new_mesh, P(DATA_AXIS))
+                dummy = tuple(
+                    jax.device_put(
+                        np.zeros((self.data.batch_size, *a.shape[1:]),
+                                 a.dtype), batch_sh)
+                    for a in self.data._arrays
+                )
+                with dispatch_scope(new_mesh) as fin:
+                    out = fin(step(arr0, dummy, hyper))
+            hard_sync(out)  # compile fully done BEFORE the flip
+        except Exception:
+            return
+
+    def _build_step(self) -> None:
+        table = self.ctx.model_table
+        data_ax = table.mesh.shape.get(DATA_AXIS, 1)
+        if self.data.batch_size % max(data_ax, 1):
+            raise ValueError(
+                f"mini-batch size {self.data.batch_size} not divisible by the "
+                f"mesh data axis ({data_ax}); pick num_mini_batches so that "
+                "each batch splits evenly across data-parallel shards"
+            )
+        # ONE locked read of each table's layout, used for BOTH the cache
+        # key and the compiled out_shardings (see _program_key docstring).
+        tsh = table.sharding
+        lsh = self.ctx.local_table.sharding if self.trainer.uses_local_table else None
+        prev_key = self._program_cache_key if self._built_once else None
+        self._program_cache_key = self._program_key(tsh, lsh)
+        key = self._program_cache_key
+
+        build_step, build_epoch = self._program_builders(tsh, lsh)
         self._step = progcache.get_or_build(
             None if key is None else (key, "step"), build_step
         )
@@ -373,6 +466,12 @@ class WorkerTasklet:
         self._batch_sharding = NamedSharding(mesh_now, P(DATA_AXIS))
         self._batch_cache.clear()   # cached batches live on the old mesh
         self._stacked_cache = None
+        pw = self._prewarmed_stacked
+        self._prewarmed_stacked = None
+        if pw is not None and pw[0] == tsh:
+            # the announcement listener already uploaded the dataset to
+            # this exact layout — skip the re-upload half of the stall
+            self._stacked_cache = pw[1]
         self._probe_pull = None     # probe programs target the old layout
         # memoized: _devcache_key needs it per batch, and the signature
         # enumerates every mesh device
@@ -611,12 +710,17 @@ class WorkerTasklet:
     def _shard_batch(self, batch: Tuple[np.ndarray, ...]):
         return tuple(jax.device_put(a, self._batch_sharding) for a in batch)
 
+    def _devcache_key_for_sig(self, tag, sig) -> "tuple | None":
+        """devcache key under an EXPLICIT layout signature (the prewarm
+        path registers uploads for a layout that is not live yet)."""
+        if self.data.dataset_key is None:
+            return None
+        return (self.data.dataset_key, tag, sig)
+
     def _devcache_key(self, tag) -> "tuple | None":
         """Key into the process-level device data cache (data/devcache) —
         None unless the provider carries a data-source identity."""
-        if self.data.dataset_key is None:
-            return None
-        return (self.data.dataset_key, tag, self._batch_sig)
+        return self._devcache_key_for_sig(tag, self._batch_sig)
 
     def _cached_batch(self, batch_idx: int, batch):
         """Device copy of one batch. The global cache (when the dataset has
@@ -697,6 +801,20 @@ class WorkerTasklet:
         if self.post_init_barrier is not None:
             self.post_init_barrier()
         self.trainer.on_training_start(ctx, self.starting_epoch)
+        # subscribe to reshard announcements: the target layout's programs
+        # compile WHILE training still runs on the old one (_prewarm_layout)
+        add_listener = getattr(ctx.model_table, "add_layout_listener", None)
+        if add_listener is not None:
+            add_listener(self._prewarm_layout)
+        try:
+            return self._run_epoch_loop(params)
+        finally:
+            remove = getattr(ctx.model_table, "remove_layout_listener", None)
+            if remove is not None:
+                remove(self._prewarm_layout)
+
+    def _run_epoch_loop(self, params) -> Dict[str, Any]:
+        ctx = self.ctx
         self._build_step()
         stop = False
         global_batch_idx = 0

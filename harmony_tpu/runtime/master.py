@@ -95,6 +95,9 @@ class TableHandle:
     def unassociate(self, executor_id: str) -> None:
         """Remove an executor (must own no blocks); physically reshards off
         its device (ref: AllocatedTable.unassociate + sync protocol)."""
+        self._announce_target(
+            [e for e in self.owning_executors() if e != executor_id]
+        )
         self.block_manager.unassociate(executor_id)
         self._reshard_to_owners()
 
@@ -104,13 +107,24 @@ class TableHandle:
 
         Ownership-first semantics: the BlockManager map flips before the
         bytes move (reads routed by the new map block on the table lock for
-        the duration of the device_put — the reference's access latch)."""
+        the duration of the device_put — the reference's access latch).
+        The target layout is ANNOUNCED before the flip (workers prewarm
+        their programs) so the flip->reshard gap stays one locked
+        device_put, not an announcement's compile time."""
+        counts = self.block_manager.block_counts()
+        n = min(num_blocks, counts.get(src, 0))
+        counts[src] = counts.get(src, 0) - n
+        counts[dst] = counts.get(dst, 0) + n
+        self._announce_target(
+            [e for e in self.block_manager.executors if counts.get(e, 0) > 0]
+        )
         moved = self.block_manager.move(src, dst, num_blocks)
         self._reshard_to_owners()
         return moved
 
     def rebalance(self, executor_ids: Sequence[str]) -> None:
         """Even repartition across ``executor_ids`` + physical resharding."""
+        self._announce_target(list(executor_ids))
         self.block_manager.rebalance(list(executor_ids))
         self._reshard_to_owners()
 
@@ -187,11 +201,27 @@ class TableHandle:
         counts = self.block_manager.block_counts()
         return [e for e in self.block_manager.executors if counts.get(e, 0) > 0]
 
-    def _reshard_to_owners(self) -> None:
-        owners = self.owning_executors()
+    def _mesh_for(self, owners: Sequence[str]):
         devices = [self._master.executor(e).device for e in owners]
         data_ax = self._master.data_axis_of(self.table_id)
-        self.table.reshard(_mesh_over(devices, data_ax))
+        return _mesh_over(devices, data_ax)
+
+    def _announce_target(self, target_owners: Sequence[str]) -> None:
+        """Announce the post-mutation layout BEFORE the logical flip:
+        subscribed workers compile their target-layout programs while
+        training continues on the old layout AND the ownership map still
+        matches the physical bytes (announcing between flip and reshard
+        would widen the latch window to the prewarm's compile time —
+        concurrent checkpoints would pair a new ownership vector with an
+        old-layout snapshot)."""
+        if not target_owners:
+            return
+        announce = getattr(self.table, "announce_reshard", None)
+        if announce is not None:
+            announce(self._mesh_for(target_owners))
+
+    def _reshard_to_owners(self) -> None:
+        self.table.reshard(self._mesh_for(self.owning_executors()))
 
 
 class ETMaster:

@@ -284,6 +284,7 @@ class DenseTable:
         self.spec = spec
         self._lock = threading.RLock()
         self._mesh = mesh
+        self._layout_listeners: list = []
         self._sharding = self._make_sharding(mesh)
         if arr is None:
             # Route the init program through the process-level program cache:
@@ -310,6 +311,35 @@ class DenseTable:
 
     def _make_sharding(self, mesh: Mesh) -> NamedSharding:
         return block_sharding(mesh, self.spec.num_blocks)
+
+    # -- layout announcements (reshard pre-warming) ----------------------
+
+    def add_layout_listener(self, fn) -> None:
+        """Subscribe to reshard ANNOUNCEMENTS: ``fn(target_mesh)`` runs
+        before the ownership flip, so subscribers (workers) can compile
+        their programs for the target layout while the current one still
+        trains — the stall then costs ~the move, not a recompile (the
+        reference's access-latch-only stall, MigrationExecutor.java:
+        163-253)."""
+        with self._lock:
+            self._layout_listeners.append(fn)
+
+    def remove_layout_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._layout_listeners:
+                self._layout_listeners.remove(fn)
+
+    def announce_reshard(self, new_mesh: Mesh) -> None:
+        """Run listeners with the target mesh (outside the table lock —
+        listeners dispatch device programs). Best-effort: a failing
+        listener never blocks the migration."""
+        with self._lock:
+            listeners = list(self._layout_listeners)
+        for fn in listeners:
+            try:
+                fn(new_mesh)
+            except Exception:
+                pass
 
     @property
     def mesh(self) -> Mesh:

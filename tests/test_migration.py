@@ -238,6 +238,63 @@ class TestLiveMigration:
         assert len(handle.owning_executors()) == 3
 
 
+class TestReshardPrewarm:
+    def test_announce_prewarms_target_layout(self, devices):
+        """The reshard announcement compiles the target layout's programs
+        and pre-uploads the stacked dataset BEFORE the ownership flip
+        (TableHandle._reshard_to_owners -> announce_reshard ->
+        WorkerTasklet._prewarm_layout), so the post-move rebuild installs
+        the pre-uploaded dataset instead of re-transferring — and exact
+        sums still hold through the move."""
+        from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+        from harmony_tpu.runtime import progcache
+
+        pool = DevicePool(devices[:2])
+        master = ETMaster(pool)
+        exs = master.add_executors(2)
+        trainer = MLRTrainer(num_classes=8, num_features=32,
+                             features_per_partition=8, step_size=0.1)
+        handle = master.create_table(
+            trainer.model_table_config(), [e.id for e in exs]
+        )
+        x, y = make_synthetic(64, num_features=32, num_classes=8)
+        params = TrainerParams(num_epochs=6, num_mini_batches=4,
+                               comm_probe_period=0)
+        seen = {}
+
+        def on_epoch(epoch):
+            if epoch == 2:
+                n = handle.block_manager.block_counts()[exs[0].id]
+                before = progcache.stats()["misses"]
+                handle.move_blocks(exs[0].id, exs[1].id, n)
+                seen["stacked"] = worker._prewarmed_stacked
+                seen["misses_during_move"] = (
+                    progcache.stats()["misses"] - before
+                )
+
+        worker = WorkerTasklet(
+            "prewarm-job",
+            TrainerContext(params=params, model_table=handle.table),
+            trainer,
+            TrainingDataProvider([x, y], 4),
+            handle.table.mesh,
+            epoch_callback=on_epoch,
+        )
+        result = worker.run()
+        # the move itself built the target programs (progcache misses
+        # happened INSIDE move_blocks, via the announcement listener)...
+        assert seen["misses_during_move"] >= 1, seen
+        # ...and staged the dataset for the target layout
+        assert seen["stacked"] is not None
+        assert seen["stacked"][0] == handle.table.sharding
+        # ...which the post-move rebuild consumed
+        assert worker._prewarmed_stacked is None
+        assert worker._stacked_cache is seen["stacked"][1]
+        # training stayed healthy across the move
+        assert result["losses"][-1] < result["losses"][0], result["losses"]
+        assert len(handle.owning_executors()) == 1
+
+
 class TestSparseTableMigration:
     def test_concurrent_migration_during_sparse_training(self, devices):
         """Live plan-driven migration of a HASH-backED model table while a
