@@ -147,10 +147,13 @@ class WorkerTasklet:
             return metrics
         return {"_sync": jnp.ravel(arr)[0]}
 
-    def _step_core(self):
+    def _step_core(self, push_route: str):
         """The fused PULL/COMP/PUSH body shared by per-batch and per-epoch
         compilation. ``hyper`` is a dict of scalars (lr etc.) passed fresh
-        each dispatch so host-side decay is honored."""
+        each dispatch so host-side decay is honored. ``push_route`` is the
+        RESOLVED keyed-push lowering (resolved once per build and threaded
+        here AND into the program key, so the cached executable always
+        matches its key)."""
         from harmony_tpu.table.hashtable import DeviceHashTable
 
         spec = self.ctx.model_table.spec
@@ -247,10 +250,7 @@ class WorkerTasklet:
                 return new_arr, sync(metrics, new_arr)
 
         else:
-            # Resolve the push lowering from the table's ACTUAL devices at
-            # build time (rebuilt on reshard): MXU duplicate-fold on TPU,
-            # XLA scatter elsewhere.
-            push_via = self.ctx.model_table.push_via
+            push_via = push_route
 
             def _step(arr, batch, hyper):
                 keys = trainer.pull_keys(batch)
@@ -261,7 +261,40 @@ class WorkerTasklet:
 
         return _step
 
-    def _program_key(self, table_sharding, local_sharding) -> "tuple | None":
+    def _resolve_push_route(self) -> str:
+        """The table's keyed-push route with "mxu_auto" resolved by a
+        one-time MEASUREMENT at this job's actual push shape (the static
+        capacity//256 gate picked the measured-slower route on chip —
+        table/autotune.py). Cached process-wide per shape signature.
+
+        The measurement is an ad-hoc device dispatch, so the same guards
+        as _prewarm_layout apply: turnstiled or multi-process meshes keep
+        the static gate (shape-derived, deterministic on every process —
+        a noisy local timing could bake DIFFERENT lowerings into the same
+        SPMD step across processes)."""
+        table = self.ctx.model_table
+        via = getattr(table, "push_via", None)
+        if via != "mxu_auto" or self.trainer.pull_mode != "keys":
+            return via
+        if (self.dispatch_turn is not None
+                or self._mesh_spans_processes(table.mesh)):
+            return via  # static gate resolves deterministically in-trace
+        try:
+            sample = tuple(
+                jax.ShapeDtypeStruct(
+                    (self.data.batch_size, *a.shape[1:]), a.dtype)
+                for a in self.data._arrays
+            )
+            nkeys = int(jax.eval_shape(self.trainer.pull_keys, sample).shape[0])
+            from harmony_tpu.table.autotune import choose_push_route
+
+            return choose_push_route(table.spec, table.mesh, nkeys,
+                                     table=table)
+        except Exception:
+            return via  # static mxu_auto gate as the fallback
+
+    def _program_key(self, table_sharding, local_sharding,
+                     push_route) -> "tuple | None":
         """Structural signature of everything the jitted step traces, for the
         process-level program cache (runtime/progcache) — None opts out.
         Components: trainer behavior, table schema + layout SNAPSHOT (the
@@ -291,23 +324,23 @@ class WorkerTasklet:
         )
         hyper_sig = tuple(sorted(self.trainer.hyperparams().keys()))
         return (tsig, table_sig, local_sig, batch_sig, hyper_sig,
-                getattr(self.ctx.model_table, "push_via", None),
+                push_route,  # the BAKED lowering (measured; see caller)
                 self.data.num_mini_batches if self._use_fused_epoch() else None)
 
-    def _program_builders(self, tsh, lsh):
+    def _program_builders(self, tsh, lsh, push_route):
         """The step/epoch jit-wrapper constructors for a GIVEN layout
         snapshot — shared by _build_step (live layout) and _prewarm_layout
         (announced target layout)."""
 
         def build_step():
-            step = self._step_core()
+            step = self._step_core(push_route)
             if self.trainer.uses_local_table:
                 return jax.jit(step, out_shardings=((tsh, lsh), None),
                                donate_argnums=(0, 1))
             return jax.jit(step, out_shardings=(tsh, None), donate_argnums=0)
 
         def build_epoch():
-            step = self._step_core()
+            step = self._step_core(push_route)
             if self.trainer.uses_local_table:
 
                 def _epoch2(arr, larr, stacked, hyper):
@@ -359,7 +392,8 @@ class WorkerTasklet:
             tsh_new = table._make_sharding(new_mesh)
             if tsh_new == self._step_sharding:
                 return  # announced layout == live layout: nothing to warm
-            key = self._program_key(tsh_new, None)
+            route = self._resolve_push_route()
+            key = self._program_key(tsh_new, None, route)
             if key is None:
                 return  # uncacheable trainer: a throwaway warm helps nobody
             fused = self._use_fused_epoch()
@@ -386,7 +420,8 @@ class WorkerTasklet:
                 return  # program warm is chief-only: progcache is shared,
                 # so one worker's warm serves the whole job (N duplicate
                 # zero-table epochs would tax the very devices training on)
-            build_step, build_epoch = self._program_builders(tsh_new, None)
+            build_step, build_epoch = self._program_builders(
+                tsh_new, None, route)
             step = progcache.get_or_build((key, "step"), build_step)
             epoch_fn = (progcache.get_or_build((key, "epoch"), build_epoch)
                         if fused else None)
@@ -426,10 +461,15 @@ class WorkerTasklet:
         tsh = table.sharding
         lsh = self.ctx.local_table.sharding if self.trainer.uses_local_table else None
         prev_key = self._program_cache_key if self._built_once else None
-        self._program_cache_key = self._program_key(tsh, lsh)
+        # ONE route resolution per build, shared by the key and the traced
+        # body (two resolutions could drift across a transient failure and
+        # cache an executable under a key claiming a different lowering)
+        self._push_route = self._resolve_push_route()
+        self._program_cache_key = self._program_key(tsh, lsh, self._push_route)
         key = self._program_cache_key
 
-        build_step, build_epoch = self._program_builders(tsh, lsh)
+        build_step, build_epoch = self._program_builders(
+            tsh, lsh, self._push_route)
         self._step = progcache.get_or_build(
             None if key is None else (key, "step"), build_step
         )
@@ -545,7 +585,7 @@ class WorkerTasklet:
                 return spec.push_all(arr, jnp.zeros_like(model))
 
         else:
-            push_via = self.ctx.model_table.push_via
+            push_via = self._push_route  # resolved by _build_step
 
             def pull_fn(arr, batch):
                 return spec.pull(arr, trainer.pull_keys(batch))

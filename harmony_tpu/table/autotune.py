@@ -1,0 +1,142 @@
+"""Measurement-driven push-route selection.
+
+The keyed additive push has two lowerings (TableSpec.push): XLA scatter
+(duplicate keys serialise on TPU) and the MXU duplicate-fold (one-hot
+segment-sum matmul + one dense add). Which wins depends on (capacity,
+value width, dtype, key count, device) in ways a static heuristic gets
+wrong — the round-2 on-chip capture measured scatter 1.3x FASTER at the
+very shape the old ``capacity // 256`` gate routed to the MXU. So the
+gate is now a one-time MEASUREMENT per shape signature: both routes run
+on the table's actual mesh with representative operands, the faster one
+is cached process-wide, and the chosen route is never the one the
+measurement says is slower. ``HARMONY_PUSH_VIA`` still force-overrides
+upstream (DenseTable.push_via) as the operator rollback.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOCK = threading.Lock()
+_ROUTES: Dict[Tuple, str] = {}
+_MEASUREMENTS: Dict[Tuple, Dict[str, float]] = {}  # observability/tests
+
+
+def _signature(spec, mesh, nkeys: int) -> Tuple:
+    devs = list(mesh.devices.flat)
+    return (
+        spec.config.capacity,
+        spec.block_size,
+        tuple(spec.value_shape),
+        str(spec.dtype),
+        int(nkeys),
+        len(devs),
+        devs[0].platform,
+        tuple(mesh.shape.items()),
+    )
+
+
+def _measure(fn, args, mesh) -> float:
+    """min-of-3 after a compile dispatch, each dispatch inside the global
+    order scope, synced with hard_sync (block_until_ready is a no-op on
+    lazy remote backends)."""
+    from harmony_tpu.parallel.dispatch import dispatch_scope
+    from harmony_tpu.utils.platform import hard_sync
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        with dispatch_scope(mesh) as fin:
+            out = fin(fn(*args))
+        hard_sync(out)
+        return time.perf_counter() - t0
+
+    once()  # compile
+    return min(once() for _ in range(3))
+
+
+def reset() -> None:
+    with _LOCK:
+        _ROUTES.clear()
+        _MEASUREMENTS.clear()
+
+
+def measurements() -> Dict[Tuple, Dict[str, float]]:
+    with _LOCK:
+        return dict(_MEASUREMENTS)
+
+
+def _static_gate(spec, nkeys: int) -> str:
+    """The pre-measurement density heuristic — the fallback when a
+    measurement fails, and the deterministic choice on meshes where an
+    ad-hoc measurement dispatch is a hazard."""
+    dense_enough = nkeys >= max(32, spec.config.capacity // 256)
+    return "mxu" if dense_enough else "scatter"
+
+
+def choose_push_route(spec, mesh, nkeys: int, table=None) -> str:
+    """The measured-faster keyed-push route for this shape on this mesh
+    ("scatter" | "mxu"), cached per signature for the process lifetime.
+
+    Non-additive update fns are always "scatter" (the fold needs
+    commutative adds). When ``table`` (a DenseTable living on ``mesh``)
+    is given, measurement runs NON-DONATING against its live array —
+    no second table-sized allocation; without it a zero array is
+    device-allocated. A failed measurement caches the static-gate
+    fallback (retrying a multi-GB allocation on every build would be
+    worse than one wrong route) and never raises into a step build.
+    """
+    if spec.update_fn.scatter_mode != "add":
+        return "scatter"
+    sig = _signature(spec, mesh, nkeys)
+    with _LOCK:
+        hit = _ROUTES.get(sig)
+    if hit is not None:
+        return hit
+    try:
+        if table is not None:
+            with table._lock:
+                arr = table._arr
+        else:
+            from harmony_tpu.table.table import block_sharding
+
+            sharding = block_sharding(mesh, spec.num_blocks)
+            arr = jax.jit(
+                lambda: jnp.zeros(spec.storage_shape, spec.dtype),
+                out_shardings=sharding,
+            )()
+        rng = np.random.default_rng(0)
+        keys = jnp.asarray(
+            rng.integers(0, spec.config.capacity, int(nkeys)), jnp.int32
+        )
+        deltas = jnp.zeros((int(nkeys), *spec.value_shape), spec.dtype)
+
+        def route_fn(via):
+            # deltas depend on the array so neither XLA nor a cached
+            # constant can fold the push away; non-donating (the live
+            # table array must survive)
+            return jax.jit(
+                lambda a, k, d: spec.push(
+                    a, k, d + 0.0 * jnp.ravel(a)[0], via=via
+                )
+            )
+
+        t_scatter = _measure(route_fn("scatter"), (arr, keys, deltas), mesh)
+        t_mxu = _measure(route_fn("mxu"), (arr, keys, deltas), mesh)
+        route = "mxu" if t_mxu < t_scatter else "scatter"
+        meas = {"scatter_sec": t_scatter, "mxu_sec": t_mxu}
+    except Exception:
+        route = _static_gate(spec, nkeys)
+        meas = {"error": "measurement failed; static gate cached"}
+    with _LOCK:
+        _ROUTES[sig] = route
+        _MEASUREMENTS[sig] = meas
+        while len(_ROUTES) > 1024:
+            _ROUTES.pop(next(iter(_ROUTES)))
+        while len(_MEASUREMENTS) > 1024:
+            _MEASUREMENTS.pop(next(iter(_MEASUREMENTS)))
+    return route

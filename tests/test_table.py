@@ -300,3 +300,91 @@ class TestRandomizedOpEquivalence:
         for k in range(capacity):
             np.testing.assert_allclose(final[k], expect(k), rtol=1e-4,
                                        atol=1e-5)
+
+
+class TestPushRouteAutotune:
+    """table/autotune.py: the measured route replaces the static
+    capacity//256 gate (round-2 on-chip capture: the static gate picked
+    the measured-slower route at its own bench shape)."""
+
+    def test_chooses_measured_faster_and_caches(self, mesh8):
+        from harmony_tpu.table import autotune
+
+        autotune.reset()
+        spec = TableSpec(TableConfig(
+            table_id="at-t", capacity=512, value_shape=(16,),
+            num_blocks=16, update_fn="add",
+        ))
+        route = autotune.choose_push_route(spec, mesh8, 256)
+        assert route in ("scatter", "mxu")
+        sig, meas = next(iter(autotune.measurements().items()))
+        best = "mxu" if meas["mxu_sec"] < meas["scatter_sec"] else "scatter"
+        assert route == best  # never the measured-slower route
+        # cached: the second call measures nothing new
+        n = len(autotune.measurements())
+        assert autotune.choose_push_route(spec, mesh8, 256) == route
+        assert len(autotune.measurements()) == n
+
+    def test_non_additive_is_always_scatter(self, mesh8):
+        from harmony_tpu.table import autotune
+
+        spec = TableSpec(TableConfig(
+            table_id="at-a", capacity=512, value_shape=(16,),
+            num_blocks=16, update_fn="assign",
+        ))
+        assert autotune.choose_push_route(spec, mesh8, 256) == "scatter"
+
+    def test_worker_bakes_resolved_route(self, mesh8, monkeypatch):
+        """_build_step resolves mxu_auto through the autotune and bakes
+        the choice into both the program and its cache key."""
+        from harmony_tpu.apps.mlr import make_synthetic
+        from harmony_tpu.config.params import TrainerParams
+        from harmony_tpu.dolphin import (
+            TrainerContext, TrainingDataProvider, WorkerTasklet,
+        )
+        from harmony_tpu.dolphin.trainer import Trainer
+        from harmony_tpu.table import autotune
+
+        class KeyedTrainer(Trainer):
+            pull_mode = "keys"
+
+            def model_table_config(self, table_id="kt-model"):
+                return TableConfig(table_id=table_id, capacity=64,
+                                   value_shape=(4,), num_blocks=8,
+                                   update_fn="add")
+
+            def pull_keys(self, batch):
+                import jax.numpy as jnp
+                return jnp.arange(32, dtype=jnp.int32)
+
+            def compute(self, model, batch, hyper):
+                import jax.numpy as jnp
+                return -0.1 * model, {"loss": jnp.sum(model * model)}
+
+        trainer = KeyedTrainer()
+        table = DenseTable(TableSpec(trainer.model_table_config()), mesh8)
+        monkeypatch.setattr(
+            type(table), "push_via", property(lambda self: "mxu_auto"))
+        calls = {}
+
+        def fake_choose(spec, mesh, nkeys, table=None):
+            calls["nkeys"] = nkeys
+            return "mxu"
+
+        monkeypatch.setattr(autotune, "choose_push_route", fake_choose)
+        x, y = make_synthetic(32, num_features=4, num_classes=2)
+        w = WorkerTasklet(
+            "at-job",
+            TrainerContext(
+                params=TrainerParams(num_epochs=1, num_mini_batches=2,
+                                     comm_probe_period=0),
+                model_table=table,
+            ),
+            trainer,
+            TrainingDataProvider([x], 2),
+            mesh8,
+        )
+        assert w._resolve_push_route() == "mxu"
+        assert calls["nkeys"] == 32  # measured at the job's real push shape
+        route = w._resolve_push_route()
+        assert w._program_key(table.sharding, None, route)[5] == "mxu"
