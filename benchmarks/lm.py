@@ -74,17 +74,17 @@ def _model(on_tpu: bool, seq: int | None = None, layers: int | None = None):
     return cfg, TransformerLM(cfg)
 
 
-def bench_train() -> dict:
+def _run_train_bench(cfg, model, batch, inner, metric, on_tpu) -> dict:
+    """Shared single-device train-step timing: one raw SGD step chained
+    through timed_inner's fori_loop (the ONE compile is the timed program
+    itself; the dependency chain keeps the timing honest on lazy
+    backends, and the fold amortizes remote-attach round trips to noise).
+    Stderr markers make compile-vs-wedge visible in capture logs."""
     from harmony_tpu.models import make_lm_data
-    from harmony_tpu.utils.platform import tpu_backend
 
-    on_tpu = tpu_backend()
-    cfg, model = _model(on_tpu)
+    from common import timed_inner
+
     params = model.init(jax.random.PRNGKey(0))
-    # realistic training batch: at batch 8 the 512-wide matmuls leave the
-    # MXU mostly idle and the measured MFU reflects launch overhead, not
-    # the model; 32x1024 tokens/step is a normal operating point
-    batch = 32 if on_tpu else 2
     tokens = jnp.asarray(make_lm_data(batch, cfg.max_seq, cfg.vocab_size))
 
     def raw_step(p):
@@ -92,32 +92,67 @@ def bench_train() -> dict:
         return jax.tree.map(lambda w, g: w - 0.1 * g.astype(w.dtype),
                             p, grads)
 
-    # Stderr markers: on a remote-attached chip a big compile can take
-    # minutes and a wedged transport hangs forever — make which one it was
-    # visible in the capture log instead of an opaque stall. The ONE
-    # compile is the timed program itself (timed_inner's warmup): an
-    # n-step fori_loop chaining the params — exactly how training runs,
-    # and the dependency chain is what makes the timing honest on lazy
-    # backends while the fold amortizes the remote-attach per-program
-    # round trip to noise.
-    from common import timed_inner
-
-    print(f"lm train: compiling (params={_param_count(params)/1e6:.1f}M, "
+    n_params = _param_count(params)
+    print(f"{metric}: compiling (params={n_params/1e6:.1f}M, "
           f"seq={cfg.max_seq}, batch={batch})...", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    dt, _ = timed_inner(raw_step, params, inner=8 if on_tpu else 1,
-                        outer=3)
-    print(f"lm train: compiled+timed in {time.perf_counter() - t0:.1f}s",
+    dt, _ = timed_inner(raw_step, params, inner=inner, outer=3)
+    print(f"{metric}: compiled+timed in {time.perf_counter() - t0:.1f}s",
           file=sys.stderr, flush=True)
     n_tok = batch * cfg.max_seq
-    n_params = _param_count(params)
     flops = _train_flops(n_params, n_tok, cfg)
-    out = {"metric": "lm train step", "value": round(n_tok / dt),
+    out = {"metric": metric, "value": round(n_tok / dt),
            "unit": "tokens/sec", "params_m": round(n_params / 1e6, 1),
            "seq": cfg.max_seq, "batch": batch,
            "tflops": round(flops / dt / 1e12, 2), "mfu": _mfu(flops / dt)}
     if not on_tpu:
         out["note"] = "cpu sanity shapes — not a chip number"
+    return out
+
+
+def bench_train() -> dict:
+    from harmony_tpu.utils.platform import tpu_backend
+
+    on_tpu = tpu_backend()
+    cfg, model = _model(on_tpu)
+    # realistic training batch: at batch 8 the 512-wide matmuls leave the
+    # MXU mostly idle and the measured MFU reflects launch overhead, not
+    # the model; 32x1024 tokens/step is a normal operating point
+    return _run_train_bench(cfg, model, batch=32 if on_tpu else 2,
+                            inner=8 if on_tpu else 1,
+                            metric="lm train step", on_tpu=on_tpu)
+
+
+def bench_train_100m() -> dict:
+    """The SCALED flagship evidence (round-3): a ~190M-param decoder at
+    seq 2048, bf16, head_dim 128, per-layer remat — the operating point
+    where matmuls are large enough that MFU reflects the model, not
+    launch overhead (the 29.9M/seq-1024 config measured 10.3%)."""
+    from harmony_tpu.models import TransformerConfig, TransformerLM
+    from harmony_tpu.utils.platform import tpu_backend
+
+    on_tpu = tpu_backend()
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=1024, n_heads=8, n_layers=12,
+            d_ff=4096, max_seq=2048, attn="auto", dtype=jnp.bfloat16,
+            remat=True,
+        )
+        batch = 8
+    else:
+        # CPU sanity shape: validates the config path, not the number
+        cfg = TransformerConfig(
+            vocab_size=2048, d_model=256, n_heads=2, n_layers=2,
+            d_ff=1024, max_seq=512, attn="auto", dtype=jnp.float32,
+            remat=True,
+        )
+        batch = 2
+    model = TransformerLM(cfg)
+    out = _run_train_bench(cfg, model, batch=batch,
+                           inner=4 if on_tpu else 1,
+                           metric="lm train step (100M-class)",
+                           on_tpu=on_tpu)
+    out["remat"] = True
     return out
 
 
@@ -256,7 +291,8 @@ def bench_ep() -> dict:
     return out
 
 
-SECTIONS = {"train": bench_train, "sp": bench_sp, "decode": bench_decode,
+SECTIONS = {"train": bench_train, "train100m": bench_train_100m,
+            "sp": bench_sp, "decode": bench_decode,
             "pp": bench_pp, "ep": bench_ep}
 
 
@@ -270,7 +306,9 @@ def main() -> None:
     except RuntimeError as e:
         # error lines carry the SAME metric names as success lines so
         # cross-round artifact consumers see one series in two states
-        metric_names = {"train": "lm train step", "sp": "lm sp train step",
+        metric_names = {"train": "lm train step",
+                        "train100m": "lm train step (100M-class)",
+                        "sp": "lm sp train step",
                         "decode": "lm decode (kv cache)",
                         "pp": "lm pp train step", "ep": "lm ep train step"}
         for name in names:

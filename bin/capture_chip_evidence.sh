@@ -46,6 +46,9 @@ run_stage "benchmarks/MICRO_${SUF}.json" python benchmarks/micro.py all
 echo "== flagship LM train step (benchmarks/lm.py)"
 run_stage "benchmarks/LM_${SUF}.json" python benchmarks/lm.py train
 
+echo "== 100M-class LM train step (benchmarks/lm.py train100m)"
+run_stage "benchmarks/LM100M_${SUF}.json" python benchmarks/lm.py train100m
+
 echo "== headline overhead profile (benchmarks/profile_headline.py)"
 run_stage "benchmarks/PROFILE_${SUF}.json" python benchmarks/profile_headline.py primitives
 

@@ -42,18 +42,30 @@ class DashboardConnector:
         add_custom_metric — MetricCollector.flush emits them undecorated,
         and vars(dict) raises) forward as kind "custom"; anything else is
         skipped — this sink must never fail the worker's flush path."""
+        import numbers
+
+        def coerce(v):
+            # numpy scalars (np.float32 etc.) are numbers.Real but not
+            # int/float — silently dropping them loses real metrics
+            if isinstance(v, bool) or isinstance(v, str):
+                return v
+            if isinstance(v, numbers.Integral):
+                return int(v)
+            if isinstance(v, numbers.Real):
+                return float(v)
+            return None
+
         if isinstance(metric, dict):
-            payload = {k: v for k, v in metric.items()
-                       if isinstance(v, (int, float, str))}
+            payload = {k: c for k, v in metric.items()
+                       if (c := coerce(v)) is not None}
             self.post(str(metric.get("job_id", "")), "custom", payload)
             return
         if not hasattr(metric, "__dict__"):
             return
         kind = type(metric).__name__
         job_id = getattr(metric, "job_id", "")
-        payload = {
-            k: v for k, v in vars(metric).items() if isinstance(v, (int, float, str))
-        }
+        payload = {k: c for k, v in vars(metric).items()
+                   if (c := coerce(v)) is not None}
         self.post(job_id, kind, payload)
 
     def _drain(self) -> None:

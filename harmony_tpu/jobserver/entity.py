@@ -351,7 +351,9 @@ class DolphinJobEntity(JobEntity):
                     self._trainer_factory(),
                     data,
                     self._handle.table.mesh,
-                    collector=MetricCollector(sink=self._metric_sink),
+                    collector=MetricCollector(sink=self._metric_sink,
+                                              job_id=cfg.job_id,
+                                              worker_id=wid),
                     batch_barrier=(
                         self._ctrl.make_barrier(wid) if self._ctrl is not None else None
                     ),

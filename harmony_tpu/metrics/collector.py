@@ -69,8 +69,18 @@ class MetricCollector:
     """Executor-side collector: add custom metrics, flush to a sink callback
     (ref: MetricCollector.addCustomMetric()/flush())."""
 
-    def __init__(self, sink: Optional[Callable[[Any], None]] = None) -> None:
+    def __init__(
+        self,
+        sink: Optional[Callable[[Any], None]] = None,
+        job_id: str = "",
+        worker_id: str = "",
+    ) -> None:
         self._sink = sink
+        # Job context stamped onto custom-metric dicts at flush: without
+        # it they post with job_id="" and are invisible to per-job
+        # dashboard queries (typed records carry their own ids).
+        self.job_id = job_id
+        self.worker_id = worker_id
         self._lock = threading.Lock()
         self._pending: List[Any] = []
         self._custom: Dict[str, float] = {}
@@ -87,7 +97,11 @@ class MetricCollector:
         with self._lock:
             out, self._pending = self._pending, []
             if self._custom:
-                out.append(dict(self._custom))
+                rec = dict(self._custom)
+                # never clobber user keys of the same name
+                rec.setdefault("job_id", self.job_id)
+                rec.setdefault("worker_id", self.worker_id)
+                out.append(rec)
                 self._custom = {}
         if self._sink is not None:
             for r in out:

@@ -139,8 +139,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale     # (bq, d)
-        s = _dot_f32(q, k_ref[0].astype(jnp.float32), trans_b=True)  # (bq, bk)
+        # NATIVE-dtype operand feeds: a bf16 q/k/v runs the MXU at bf16
+        # throughput with fp32 accumulation (preferred_element_type) —
+        # casting operands to fp32 first (the old code) forfeited most of
+        # the MXU for no accuracy the fp32 accumulator wasn't already
+        # providing. The scale applies to the fp32 product, exactly.
+        s = _dot_f32(q_ref[0], k_ref[0], trans_b=True) * scale  # (bq, bk)
         if causal:
             s = _apply_causal_mask(s, iq, ik, block_q, block_k)
         m_prev = m_ref[:, :1]                        # (bq, 1)
@@ -148,7 +152,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)              # (bq, 1)
         l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + _dot_f32(p, v_ref[0].astype(jnp.float32))
+        # p feeds the MXU in v's dtype (bf16 weights => bf16 p, the
+        # standard flash trade; fp32 v keeps p fp32 so tests/CPU are exact)
+        acc_ref[:] = acc_ref[:] * alpha + _dot_f32(
+            p.astype(v_ref.dtype), v_ref[0])
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(ik == nk - 1)
@@ -230,12 +237,12 @@ def _bwd_p_ds(q, k, v, do, lse, delta, iq, ik, scale, causal,
     (p [bq,bk], ds [bq,bk]) with p the normalized softmax block.
     ``lse``/``delta`` arrive as (bq, 1) column tiles (lane 0 of the
     lane-replicated stats)."""
-    qf = q.astype(jnp.float32) * scale
-    s = _dot_f32(qf, k.astype(jnp.float32), trans_b=True)     # (bq, bk)
+    # native-dtype MXU feeds with fp32 accumulation (see _fa_kernel)
+    s = _dot_f32(q, k, trans_b=True) * scale                  # (bq, bk)
     if causal:
         s = _apply_causal_mask(s, iq, ik, block_q, block_k)
     p = jnp.exp(s - lse)                                      # normalized
-    dp = _dot_f32(do.astype(jnp.float32), v.astype(jnp.float32), trans_b=True)
+    dp = _dot_f32(do, v, trans_b=True)
     ds = p * (dp - delta)
     return p, ds
 
@@ -262,10 +269,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             iq, ik, scale, causal, block_q, block_k,
         )
         dv_acc[:] += jax.lax.dot_general(
-            p, do_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
         dk_acc[:] += scale * jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bk, d)
 
     @pl.when(iq == nq - 1)
@@ -293,7 +300,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             lse_ref[0, :, :1], delta_ref[0, :, :1],
             iq, ik, scale, causal, block_q, block_k,
         )
-        dq_acc[:] += scale * _dot_f32(ds, k_ref[0].astype(jnp.float32))
+        dq_acc[:] += scale * _dot_f32(ds.astype(k_ref.dtype), k_ref[0])
 
     @pl.when(ik == nk - 1)
     def _write():
@@ -416,6 +423,12 @@ def flash_attention_lse(
     chunks merge exactly via their LSEs (``ring_attention``'s flash inner).
     Differentiable in both outputs; the LSE cotangent folds into the
     backward kernels' delta term (see ``_flash_backward``)."""
+    if not (q.dtype == k.dtype == v.dtype):
+        raise TypeError(
+            f"flash attention feeds the MXU in the operands' dtype, so "
+            f"q/k/v must share one dtype (got {q.dtype}/{k.dtype}/"
+            f"{v.dtype}); cast the operands before the call"
+        )
     scale, interp = _resolve_defaults(q, scale, interpret)
     return _flash_forward(q, k, v, causal, block_q, block_k, scale, interp)
 

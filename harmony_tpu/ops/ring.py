@@ -48,7 +48,15 @@ def _resolve_inner(inner: str) -> str:
         forced = env_choice("HARMONY_RING_INNER", ("flash", "einsum"))
         if forced:
             return forced
-        return "flash" if tpu_backend() else "einsum"
+        # flash only where the composition has been captured: a single
+        # attached chip (r02 ringflash capture: exact, 1.2x). On MULTI-chip
+        # deployments the compiled-Mosaic-plus-ring-rotation composition
+        # has never executed — a loud mid-training Mosaic/vma failure on
+        # the default path is worse than the einsum fold until a
+        # multi-chip capture lands; HARMONY_RING_INNER=flash opts in.
+        return ("flash"
+                if tpu_backend() and jax.device_count() == 1
+                else "einsum")
     if inner not in ("flash", "einsum"):
         raise ValueError(f"unknown ring inner {inner!r}")
     return inner
@@ -76,11 +84,10 @@ def ring_attention(
         nothing (the einsum inner computes-then-masks them).
       * "einsum" — the original streaming-softmax fold (any backend, any
         shape).
-      * "auto"   — currently "einsum" everywhere: the flash inner is
-        validated exact in interpret mode, but its compiled
-        Mosaic-under-shard_map path hasn't run on a chip yet (see
-        _resolve_inner); it will become flash-on-TPU once that capture
-        lands.
+      * "auto"   — flash on a SINGLE attached TPU chip (the composition
+        captured exact on chip, r02 ringflash); einsum on multi-chip
+        deployments (compiled-Mosaic-plus-rotation is uncaptured there)
+        and off-TPU. HARMONY_RING_INNER overrides (see _resolve_inner).
     """
     B, H, S, D = q.shape
     scale = scale if scale is not None else D ** -0.5

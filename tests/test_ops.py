@@ -317,3 +317,47 @@ class TestA2AAttention:
         x = jnp.ones((2, 3, 64, 8))  # 3 heads, 8-way seq axis
         with pytest.raises(ValueError):
             a2a_self_attention(x, x, x, mesh, seq_axis="seq")
+
+
+def test_flash_bf16_operands_match_f32_reference():
+    """The kernel feeds the MXU in the OPERANDS' dtype (bf16 on hardware)
+    with fp32 accumulation; on bf16 inputs it must track the fp32
+    reference computed from the same (bf16-rounded) inputs within bf16
+    tolerance — forward and gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from harmony_tpu.ops.attention import blockwise_attention, flash_attention
+
+    b, h, s, d = 1, 2, 256, 64
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32).astype(jnp.bfloat16)
+    k = jax.random.normal(k2, (b, h, s, d), jnp.float32).astype(jnp.bfloat16)
+    v = jax.random.normal(k3, (b, h, s, d), jnp.float32).astype(jnp.bfloat16)
+
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    ref = blockwise_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32), causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=0.05, atol=0.05)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=128,
+                                       block_k=128, interpret=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(blockwise_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf, np.float32),
+                                   np.asarray(gr, np.float32),
+                                   rtol=0.1, atol=0.1)
