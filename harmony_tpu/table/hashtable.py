@@ -593,14 +593,21 @@ class DeviceHashTable:
     def reshard(self, new_mesh: Mesh) -> None:
         """Live migration to a new mesh: one XLA resharding transfer under
         the lock (ownership-first semantics collapse to the commit)."""
+        from harmony_tpu.table.table import reshard_array
+
         with self._lock:
             self._check()
-            self._mesh = new_mesh
-            self._ksh, self._vsh = self._make_shardings(new_mesh)
-            self._state = (
-                jax.device_put(self._state[0], self._ksh),
-                jax.device_put(self._state[1], self._vsh),
+            # transfer FIRST, mutate after (see DenseTable.reshard): a
+            # rejected transfer must not leave mesh/shardings pointing at
+            # a layout the state never reached
+            ksh, vsh = self._make_shardings(new_mesh)
+            new_state = (
+                reshard_array(self._state[0], self._mesh, ksh),
+                reshard_array(self._state[1], self._mesh, vsh),
             )
+            self._mesh = new_mesh
+            self._ksh, self._vsh = ksh, vsh
+            self._state = new_state
             # cached host-op wrappers pin the OLD mesh into their
             # dispatch_scope decision (and their compiled layouts)
             self._jit_cache.clear()

@@ -54,6 +54,74 @@ from harmony_tpu.table.partition import (
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 
+def cross_set_reshard(arr: jax.Array, old_mesh: Mesh,
+                      new_sharding: NamedSharding) -> jax.Array:
+    """Reshard onto a DIFFERENT device set across hosts — the case
+    multi-controller jax.device_put refuses ("input and target sharding
+    should have the same set of devices"; direct transfers exist only
+    experimentally on the TFRT TPU runtime).
+
+    Supported direction: SHRINK/REORDER — every process of the union
+    still holds old-mesh shards, so the route is: replicate on the OLD
+    mesh (one collective all participants dispatch in lockstep), read the
+    now-ADDRESSABLE local copy, rebuild on the new sharding via
+    make_array_from_callback (each process fills only its own shards; a
+    process losing all its devices contributes none). Costs one
+    full-table host round-trip plus a transient per-device replica.
+
+    GROW from a process subset is rejected loudly: a process gaining
+    devices holds no bytes to fill its new shards from, and the
+    host-level broadcast primitives are not reliable across runtimes —
+    route a grow through the pod checkpoint instead (stage on the small
+    topology, restore onto the large one: checkpoint/manager.py supports
+    exactly that cross-topology restore)."""
+    old_procs = {d.process_index for d in old_mesh.devices.flat}
+    new_procs = {d.process_index for d in new_sharding.mesh.devices.flat}
+    if not new_procs <= old_procs:
+        raise NotImplementedError(
+            f"cross-device-set reshard GROWING onto processes "
+            f"{sorted(new_procs - old_procs)} that hold none of the data: "
+            "checkpoint on the current topology and restore onto the "
+            "target one (pod checkpoint/restore is cross-topology)"
+        )
+    rep = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(old_mesh, P())
+    )(arr)
+    # replicated => every ADDRESSABLE shard is the full value; the global
+    # handle itself still refuses np.asarray (spans non-local devices).
+    # A lockstep participant with no old-mesh devices has no shards — and
+    # needs none: the callback is never invoked for it (new <= old procs).
+    shards = rep.addressable_shards
+    host = np.asarray(shards[0].data) if shards else None
+    return jax.make_array_from_callback(
+        arr.shape, new_sharding, lambda idx: host[idx],
+        dtype=arr.dtype,  # required when a process has no shards at all
+    )
+
+
+def reshard_array(arr: jax.Array, old_mesh: Mesh,
+                  new_sharding: NamedSharding) -> jax.Array:
+    """Route an array onto a new sharding, choosing the transfer path UP
+    FRONT (never by catching exceptions — a deleted/donated buffer must
+    surface as itself, not vanish into a fallback):
+
+      * same device set, or everything single-process -> jax.device_put
+        (XLA moves bytes directly);
+      * device set changes across processes -> cross_set_reshard (the
+        case multi-controller device_put refuses)."""
+    from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+    same_set = (
+        {d.id for d in old_mesh.devices.flat}
+        == {d.id for d in new_sharding.mesh.devices.flat}
+    )
+    multiproc = (mesh_spans_processes(old_mesh)
+                 or mesh_spans_processes(new_sharding.mesh))
+    if same_set or not multiproc:
+        return jax.device_put(arr, new_sharding)
+    return cross_set_reshard(arr, old_mesh, new_sharding)
+
+
 def owned_addressable_blocks(arr: jax.Array) -> "Dict[int, np.ndarray]":
     """Blocks of a block-major global array whose bytes live on THIS
     process — deduped across replicas by the lowest-owner-process rule, so
@@ -378,7 +446,16 @@ class DenseTable:
         """
         with self._lock:
             if new_arr.sharding != self._sharding:
-                new_arr = jax.device_put(new_arr, self._sharding)
+                # same routed transfer as reshard: an in-flight step's
+                # result re-homes across whatever device-set change the
+                # reshard made (raw device_put would refuse cross-process
+                # set changes). Non-mesh shardings (single-device results)
+                # are process-local by construction — plain device_put.
+                src_mesh = getattr(new_arr.sharding, "mesh", None)
+                if src_mesh is None:
+                    new_arr = jax.device_put(new_arr, self._sharding)
+                else:
+                    new_arr = reshard_array(new_arr, src_mesh, self._sharding)
             self._arr = new_arr
 
     @staticmethod
@@ -562,9 +639,15 @@ class DenseTable:
                 None if self.spec.custom_update_fn
                 else progcache.table_signature(self)
             )
+            # transfer FIRST, mutate after: a rejected transfer (e.g. a
+            # cross-process grow) must leave mesh/sharding/array
+            # consistent, not a mesh pointing at a layout the array never
+            # reached
+            new_sharding = self._make_sharding(new_mesh)
+            new_arr = reshard_array(self._arr, self._mesh, new_sharding)
             self._mesh = new_mesh
-            self._sharding = self._make_sharding(new_mesh)
-            self._arr = jax.device_put(self._arr, self._sharding)
+            self._sharding = new_sharding
+            self._arr = new_arr
             self._jit_cache.clear()
             if old_sig is not None:
                 # The departed layout's init executable can never hit again

