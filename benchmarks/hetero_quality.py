@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Heterogeneous-solver plan quality beyond the exact-enumeration limit.
+
+The ILPSolver enumerates owner sets exhaustively up to
+``exact_enum_limit`` executors and switches to greedy-seed + swap local
+search above (the Gurobi replacement's scale path — round-2 verdict:
+"beyond-12 plan quality is unmeasured"). This artifact measures it: for
+random heterogeneous profiles at several pool sizes, the heuristic's
+predicted mini-batch time is compared against the TRUE optimum from full
+enumeration (feasible offline up to n=16: 65k owner sets of cheap host
+math). Reported per size: worst and mean quality ratio
+(heuristic / exact; 1.0 = optimal) over trials, plus the seed-only ratio
+showing what the local search buys.
+
+Pure host math — no devices. Writes benchmarks/HETERO_QUALITY_r03.json;
+prints ONE JSON line. Run: python benchmarks/hetero_quality.py
+"""
+import itertools
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from harmony_tpu.optimizer.hetero import ExecutorProfile, ILPSolver  # noqa: E402
+
+SIZES = (12, 14, 16)
+TRIALS = 20
+DATA_BLOCKS, MODEL_BLOCKS, COMM = 256, 64, 0.004
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "HETERO_QUALITY_r03.json")
+
+
+def _profiles(rng, n):
+    return [
+        ExecutorProfile(
+            executor_id=f"e{i}",
+            rate=float(rng.uniform(0.5, 4.0)),
+            bandwidth=float(rng.uniform(0.2, 8.0)),
+        )
+        for i in range(n)
+    ]
+
+
+def _exact(solver, profiles):
+    best = None
+    n = len(profiles)
+    for k in range(1, n):
+        for owner_ids in itertools.combinations(range(n), k):
+            a = solver._eval_owner_set(
+                owner_ids, profiles, DATA_BLOCKS, MODEL_BLOCKS, COMM
+            )
+            if a and (best is None or a.predicted_time < best.predicted_time):
+                best = a
+    return best
+
+
+def main() -> None:
+    rng = np.random.default_rng(7)
+    heuristic = ILPSolver(exact_enum_limit=2)   # force the scale path
+    exact_solver = ILPSolver(exact_enum_limit=64)
+    rows = []
+    for n in SIZES:
+        ratios, seed_ratios = [], []
+        for _ in range(TRIALS):
+            profiles = _profiles(rng, n)
+            opt = _exact(exact_solver, profiles).predicted_time
+            heur = heuristic.solve(
+                profiles, DATA_BLOCKS, MODEL_BLOCKS, COMM
+            ).predicted_time
+            # seed-only baseline: greedy prefix sweep without local search
+            seed = None
+            order = sorted(range(n),
+                           key=lambda i: -profiles[i].bandwidth)
+            for k in range(1, n):
+                a = heuristic._eval_owner_set(
+                    tuple(sorted(order[:k])), profiles,
+                    DATA_BLOCKS, MODEL_BLOCKS, COMM)
+                if a and (seed is None
+                          or a.predicted_time < seed.predicted_time):
+                    seed = a
+            ratios.append(heur / opt)
+            seed_ratios.append(seed.predicted_time / opt)
+        rows.append({
+            "n": n, "trials": TRIALS,
+            "quality_mean": round(float(np.mean(ratios)), 4),
+            "quality_worst": round(float(np.max(ratios)), 4),
+            "seed_only_mean": round(float(np.mean(seed_ratios)), 4),
+            "seed_only_worst": round(float(np.max(seed_ratios)), 4),
+        })
+    out = {
+        "metric": "hetero solver plan quality beyond exact limit",
+        "unit": "heuristic/exact predicted time (1.0 = optimal)",
+        "value": max(r["quality_worst"] for r in rows),
+        "sizes": rows,
+        "note": ("exact = full owner-set enumeration (the offline optimum); "
+                 "heuristic = greedy seed + swap local search, the path "
+                 "used for pools above exact_enum_limit"),
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -137,3 +137,38 @@ class TestProfileFiles:
         assert ps["hostA"].cores == 8 and ps["hostA"].bandwidth == 10.0
         assert ps["hostB"].cores == 2
         assert ps["hostC"].bandwidth == 5.0
+
+
+class TestScalePathQuality:
+    def test_local_search_beats_seed_and_tracks_exact(self):
+        """Beyond exact_enum_limit the solver is greedy seed + swap local
+        search; on random heterogeneous profiles it must never be worse
+        than the seed sweep and must stay within a few percent of the
+        exact optimum (the round-2 verdict's unmeasured ceiling)."""
+        import itertools
+
+        import numpy as np
+
+        rng = np.random.default_rng(11)
+        n = 13
+        profiles = [
+            ExecutorProfile(executor_id=f"e{i}",
+                            rate=float(rng.uniform(0.5, 4.0)),
+                            bandwidth=float(rng.uniform(0.2, 8.0)))
+            for i in range(n)
+        ]
+        args = (256, 64, 0.004)
+        heur = ILPSolver(exact_enum_limit=2)
+        t_heur = heur.solve(profiles, *args).predicted_time
+        # exact optimum by full enumeration
+        exact = ILPSolver(exact_enum_limit=64)
+        t_exact = exact.solve(profiles, *args).predicted_time
+        # seed-only baseline (the pre-local-search scale path)
+        t_seed = None
+        order = sorted(range(n), key=lambda i: -profiles[i].bandwidth)
+        for k in range(1, n):
+            a = heur._eval_owner_set(tuple(sorted(order[:k])), profiles, *args)
+            if a and (t_seed is None or a.predicted_time < t_seed):
+                t_seed = a.predicted_time
+        assert t_exact <= t_heur <= t_seed + 1e-12
+        assert t_heur <= 1.05 * t_exact, (t_heur, t_exact)
