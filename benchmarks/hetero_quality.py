@@ -70,14 +70,11 @@ def main() -> None:
             heur = heuristic.solve(
                 profiles, DATA_BLOCKS, MODEL_BLOCKS, COMM
             ).predicted_time
-            # seed-only baseline: greedy prefix sweep without local search
+            # seed-only baseline: the solver's OWN seed sets, no search
             seed = None
-            order = sorted(range(n),
-                           key=lambda i: -profiles[i].bandwidth)
-            for k in range(1, n):
+            for owner_ids in ILPSolver.seed_sweep_sets(profiles):
                 a = heuristic._eval_owner_set(
-                    tuple(sorted(order[:k])), profiles,
-                    DATA_BLOCKS, MODEL_BLOCKS, COMM)
+                    owner_ids, profiles, DATA_BLOCKS, MODEL_BLOCKS, COMM)
                 if a and (seed is None
                           or a.predicted_time < seed.predicted_time):
                     seed = a

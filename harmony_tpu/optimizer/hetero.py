@@ -196,11 +196,15 @@ class ILPSolver:
         # until a pass finds none (or the eval budget runs out). Measured
         # against exact enumeration on random heterogeneous profiles this
         # closes the seed's gap to ~optimal (benchmarks/hetero_quality.py).
-        order = sorted(range(n), key=lambda i: -profiles[i].bandwidth)
-        for k in range(1, n):
-            consider(tuple(sorted(order[:k])))
+        seen = set()
+        for owner_ids in self.seed_sweep_sets(profiles):
+            seen.add(owner_ids)
+            consider(owner_ids)
         assert best is not None
-        budget = 64 * n  # evals; each is O(n) host math
+        # evals; each _eval_owner_set is O(|owners|*|trainers|) host math
+        # (the nested per-trainer pull sum), so the search is O(n^3) worst
+        # case — still microseconds-scale per eval at realistic pool sizes
+        budget = 64 * n
         improved = True
         while improved and budget > 0:
             improved = False
@@ -218,11 +222,23 @@ class ILPSolver:
             for cand in moves:
                 if budget <= 0:
                     break
+                if cand in seen:  # neighborhoods overlap pass to pass:
+                    continue      # spend the budget on UNIQUE sets only
+                seen.add(cand)
                 budget -= 1
                 consider(cand)
             if best.predicted_time < cur.predicted_time - 1e-12:
                 improved = True
         return best
+
+    @staticmethod
+    def seed_sweep_sets(profiles) -> "list[Tuple[int, ...]]":
+        """The greedy-seed owner sets (highest-bandwidth prefix per owner
+        count) — the scale path's starting points, exposed so benchmarks
+        and tests measure the SAME seed the solver uses."""
+        n = len(profiles)
+        order = sorted(range(n), key=lambda i: -profiles[i].bandwidth)
+        return [tuple(sorted(order[:k])) for k in range(1, n)]
 
 
 class HeterogeneousOptimizer(Optimizer):

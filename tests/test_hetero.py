@@ -163,11 +163,10 @@ class TestScalePathQuality:
         # exact optimum by full enumeration
         exact = ILPSolver(exact_enum_limit=64)
         t_exact = exact.solve(profiles, *args).predicted_time
-        # seed-only baseline (the pre-local-search scale path)
+        # seed-only baseline (the solver's OWN seed sets, no search)
         t_seed = None
-        order = sorted(range(n), key=lambda i: -profiles[i].bandwidth)
-        for k in range(1, n):
-            a = heur._eval_owner_set(tuple(sorted(order[:k])), profiles, *args)
+        for owner_ids in ILPSolver.seed_sweep_sets(profiles):
+            a = heur._eval_owner_set(owner_ids, profiles, *args)
             if a and (t_seed is None or a.predicted_time < t_seed):
                 t_seed = a.predicted_time
         assert t_exact <= t_heur <= t_seed + 1e-12
