@@ -72,6 +72,73 @@ def test_two_process_distributed_job():
     assert a["hash_sum"] == b["hash_sum"]
 
 
+class PodHarness:
+    """Shared launch/teardown for the PodJobServer e2e tests: N worker
+    processes (process 0 = leader with the TCP submit endpoint), bounded
+    READY wait, drain polling, and leader-RESULT parsing — the harness
+    every pod test shares so fixes land once."""
+
+    def __init__(self, nprocs, devs_per_proc, scheduler=None, env_extra=None):
+        self.nprocs = nprocs
+        coord, self.pod_port, self.tcp_port = (
+            _free_port(), _free_port(), _free_port())
+        env = _sanitized_env(devs_per_proc)
+        env.update(env_extra or {})
+        args_tail = [str(self.pod_port), str(self.tcp_port)]
+        if scheduler:
+            args_tail.append(scheduler)
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, POD_WORKER, f"127.0.0.1:{coord}",
+                 str(nprocs), str(pid), *args_tail],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            )
+            for pid in range(nprocs)
+        ]
+        self._sender = None
+
+    @property
+    def sender(self):
+        from harmony_tpu.jobserver.client import CommandSender
+
+        if self._sender is None:
+            self._sender = CommandSender(self.tcp_port)
+        return self._sender
+
+    def wait_ready(self, timeout=240):
+        assert wait_for_ready(self.procs[0], timeout), "leader never ready"
+
+    def drain(self, timeout=300, poll=0.3):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.sender.send_status_command().get("running"):
+                return
+            time.sleep(poll)
+        raise AssertionError("pod jobs never drained")
+
+    def finish(self, timeout=240):
+        """SHUTDOWN, reap every worker, return the leader's RESULT dict."""
+        self.sender.send_shutdown_command()
+        outs = []
+        for p in self.procs:
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pytest.fail("pod worker hung")
+            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
+            outs.append(out)
+        lead = [ln for ln in outs[0].splitlines()
+                if ln.startswith("RESULT ")]
+        assert lead, f"no RESULT from leader: {outs[0]!r}"
+        return json.loads(lead[0][len("RESULT "):])
+
+    def kill(self):
+        for q in self.procs:
+            if q.poll() is None:
+                q.kill()
+
+
 def _mlr_job(job_id: str, seed: int, num_workers: int = 1, epochs: int = 3):
     from harmony_tpu.config.params import JobConfig, TrainerParams
 
@@ -90,6 +157,35 @@ def _mlr_job(job_id: str, seed: int, num_workers: int = 1, epochs: int = 3):
     )
 
 
+def test_pod_smoke_default_tier():
+    """DEFAULT-TIER pod coverage (round-2 verdict: ~all pod e2e lived in
+    the slow tier, so a pod regression would ship green under the
+    driver's default run). Minimal but real: a 2-process pod (2 virtual
+    devices each), one tiny MLR job over TCP, loss series identical on
+    both processes. ~15-20s."""
+    pod = PodHarness(2, 2)
+    try:
+        pod.wait_ready(180)
+        cfg = _mlr_job("pod-smoke", seed=5, epochs=1)
+        cfg.params.num_mini_batches = 2
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        pod.drain(timeout=180, poll=0.2)
+        result = pod.finish(timeout=120)
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-smoke"]
+    assert "error" not in res, res
+    (losses,) = [w["losses"] for w in res.values()
+                 if isinstance(w, dict) and "losses" in w]
+    assert len(losses) == 1
+    follower = result["pod_reports"]["pod-smoke"]["1"]
+    assert follower["ok"], follower
+    assert [round(x, 5) for x in
+            follower["workers"]["pod-smoke/w0"]["losses"]] == [
+        round(x, 5) for x in losses]
+
+
 def test_pod_concurrent_carved_tenants():
     """Concurrent multi-tenancy ACROSS the pod (the reference's defining
     property — SchedulerImpl.java:28-66 overlapping jobs on shared
@@ -100,24 +196,10 @@ def test_pod_concurrent_carved_tenants():
     path). Dispatch walls must overlap, and each job's loss series must
     equal the same config trained alone on a 4-device single-process
     server (carving changes placement, never semantics)."""
-    from harmony_tpu.jobserver.client import CommandSender
-
-    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
-    coordinator = f"127.0.0.1:{coord_port}"
-    env = _sanitized_env(4)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
-             str(pod_port), str(tcp_port), "pod_carve:1"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
+    pod = PodHarness(2, 4, scheduler="pod_carve:1")
     try:
-        assert wait_for_ready(procs[0], 240), "leader never became ready"
+        pod.wait_ready()
         deadline = time.monotonic() + 300
-        sender = CommandSender(tcp_port)
         cfg_a, cfg_b = _mlr_job("pod-a", seed=1), _mlr_job("pod-b", seed=2)
         # pod-b lands wholly on the follower: exercise the remote leg of
         # checkpoint chaining + shutdown-stage deferred evaluation (the
@@ -125,13 +207,13 @@ def test_pod_concurrent_carved_tenants():
         cfg_b.params.model_chkp_period = 1
         cfg_b.params.offline_model_eval = True
         for cfg in (cfg_a, cfg_b):
-            resp = sender.send_job_submit_command(cfg)
+            resp = pod.sender.send_job_submit_command(cfg)
             assert resp.get("ok"), resp
         # Both jobs must be ADMITTED at once (disjoint single-process
         # carves): watch the status until the active sets overlap in time.
         saw_concurrent = False
         while time.monotonic() < deadline:
-            status = sender.send_status_command()
+            status = pod.sender.send_status_command()
             active = status.get("pod", {}).get("active", {})
             if len(active) == 2:
                 saw_concurrent = True
@@ -139,22 +221,9 @@ def test_pod_concurrent_carved_tenants():
             if not status.get("running"):
                 break
             time.sleep(0.2)
-        sender.send_shutdown_command()
-        outs = []
-        for p in procs:
-            try:
-                out, err = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                pytest.fail("pod worker hung")
-            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
-            outs.append(out)
+        result = pod.finish()
     finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
-    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
-    assert lead, f"no RESULT from leader: {outs[0]!r}"
-    result = json.loads(lead[0][len("RESULT "):])
+        pod.kill()
     # dispatch walls overlapped — the jobs genuinely ran at the same time
     walls = result["job_walls"]
     overlap = min(walls["pod-a"][1], walls["pod-b"][1]) - max(
@@ -282,43 +351,17 @@ def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
     from harmony_tpu.jobserver.client import CommandSender
 
     root = str(tmp_path)
-    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
-    coordinator = f"127.0.0.1:{coord_port}"
-    env = _sanitized_env(4)
-    env["HARMONY_POD_CHKP_ROOT"] = root
-    procs = [
-        subprocess.Popen(
-            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
-             str(pod_port), str(tcp_port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
+    pod = PodHarness(2, 4, env_extra={"HARMONY_POD_CHKP_ROOT": root})
     try:
-        assert wait_for_ready(procs[0], 240), "leader never became ready"
+        pod.wait_ready()
         cfg = _mlr_job("pod-chkp", seed=3, epochs=2)
         cfg.params.model_chkp_period = 1
-        sender = CommandSender(tcp_port)
-        resp = sender.send_job_submit_command(cfg)
+        resp = pod.sender.send_job_submit_command(cfg)
         assert resp.get("ok"), resp
-        deadline = time.monotonic() + 300
-        while time.monotonic() < deadline:
-            if not sender.send_status_command().get("running"):
-                break
-            time.sleep(0.3)
-        sender.send_shutdown_command()
-        outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
-            outs.append(out)
+        pod.drain()
+        result = pod.finish()
     finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
-    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
-    result = json.loads(lead[0][len("RESULT "):])
+        pod.kill()
     res = result["local_results"]["pod-chkp"]
     assert "error" not in res, res
     chkp_ids = res["model_chkp_ids"]
@@ -366,18 +409,7 @@ def test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline():
     from harmony_tpu.jobserver.client import CommandSender
 
     LAG, EPOCHS = 0.4, 3
-    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
-    coordinator = f"127.0.0.1:{coord_port}"
-    env = _sanitized_env(4)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, POD_WORKER, coordinator, "2", str(pid),
-             str(pod_port), str(tcp_port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
+    pod = PodHarness(2, 4)
 
     def ssp_cfg(force_lockstep: bool) -> JobConfig:
         return JobConfig(
@@ -397,31 +429,13 @@ def test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline():
         )
 
     try:
-        assert wait_for_ready(procs[0], 240), "leader never became ready"
-        deadline = time.monotonic() + 300
-        sender = CommandSender(tcp_port)
-        resp = sender.send_job_submit_command(ssp_cfg(False))
+        pod.wait_ready()
+        resp = pod.sender.send_job_submit_command(ssp_cfg(False))
         assert resp.get("ok"), resp
-        while time.monotonic() < deadline:
-            if not sender.send_status_command().get("running"):
-                break
-            time.sleep(0.3)
-        sender.send_shutdown_command()
-        outs = []
-        for p in procs:
-            try:
-                out, err = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                pytest.fail("pod worker hung")
-            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
-            outs.append(out)
+        pod.drain()
+        result = pod.finish()
     finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
-    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
-    assert lead, f"no RESULT from leader: {outs[0]!r}"
-    result = json.loads(lead[0][len("RESULT "):])
+        pod.kill()
     res = result["local_results"]["pod-ssp"]
     assert "error" not in res, res
     losses = {wid: w["losses"] for wid, w in res.items()}
@@ -466,24 +480,9 @@ def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
     from harmony_tpu.config.params import JobConfig, TrainerParams
     from harmony_tpu.jobserver.client import CommandSender
 
-    coord_port, pod_port, tcp_port = _free_port(), _free_port(), _free_port()
-    coordinator = f"127.0.0.1:{coord_port}"
-    env = _sanitized_env(devs_per_proc)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, POD_WORKER, coordinator, str(nprocs), str(pid),
-             str(pod_port), str(tcp_port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for pid in range(nprocs)
-    ]
+    pod = PodHarness(nprocs, devs_per_proc)
     try:
-        # bounded READY wait (helper-thread readlines: a silently-wedged
-        # leader hits the deadline instead of hanging the suite)
-        assert wait_for_ready(procs[0], 240), "leader never became ready"
-        deadline = time.monotonic() + 240
-
+        pod.wait_ready()
         cfg = JobConfig(
             job_id="pod-mlr", app_type="dolphin",
             trainer="harmony_tpu.apps.mlr:MLRTrainer",
@@ -497,37 +496,15 @@ def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
                   "data_args": {"n": 64, "num_features": 16,
                                 "num_classes": 4}},
         )
-        sender = CommandSender(tcp_port)
-        status = sender.send_status_command()
+        status = pod.sender.send_status_command()
         assert status["pod"]["followers"] == list(range(1, nprocs)), status
         assert status["pod"]["broken"] is None, status
-        resp = sender.send_job_submit_command(cfg)
+        resp = pod.sender.send_job_submit_command(cfg)
         assert resp.get("ok"), resp
-        # poll until the job drains, then shut the pod down
-        while time.monotonic() < deadline:
-            status = sender.send_status_command()
-            if not status.get("running"):
-                break
-            time.sleep(0.5)
-        sender.send_shutdown_command()
-
-        outs = []
-        for p in procs:
-            try:
-                out, err = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                pytest.fail("pod worker hung")
-            assert p.returncode == 0, f"pod worker failed:\n{err[-3000:]}"
-            outs.append(out)
+        pod.drain(timeout=240, poll=0.5)
+        result = pod.finish()
     finally:
-        for q in procs:
-            if q.poll() is None:
-                q.kill()
-    # leader's stdout was partially consumed by the READY loop; RESULT is
-    # in what communicate() returned afterwards
-    lead = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
-    assert lead, f"no RESULT from leader: {outs[0]!r}"
-    result = json.loads(lead[0][len("RESULT "):])
+        pod.kill()
     # local (process 0) training happened and converged
     losses = result["local_results"]["pod-mlr"]["pod-mlr/w0"]["losses"]
     assert len(losses) == 2 and losses[-1] < losses[0], losses
