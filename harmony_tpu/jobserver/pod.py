@@ -546,6 +546,13 @@ class PodFollower:
         from harmony_tpu.runtime.taskunit import GlobalTaskUnitScheduler
 
         global_tu = GlobalTaskUnitScheduler()
+        # same platform-derived policy as JobServer.start: execution
+        # metering is a blocking-backend concept; follower and leader
+        # must agree or their grant policies diverge
+        global_tu.meter_execution = all(
+            self.master.executor(e).device.platform == "cpu"
+            for e in self.master.executor_ids()
+        )
         while True:
             msg = _recv(self._file)
             if msg is None or msg.get("cmd") == "SHUTDOWN":

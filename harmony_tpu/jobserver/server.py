@@ -107,6 +107,11 @@ class JobServer:
     def start(self) -> None:
         """Acquire the executor pool; become ready for submissions."""
         executors = self.master.add_executors(self._num_executors)
+        # execution metering is a blocking-backend concept (see
+        # GlobalTaskUnitScheduler.meter_execution)
+        self.global_taskunit.meter_execution = all(
+            e.device.platform == "cpu" for e in executors
+        )
         self._scheduler.bind([e.id for e in executors], self._launch)
         self._state.transition("INIT")
         server_log.info("jobserver up: %d executors, scheduler=%s",

@@ -73,6 +73,16 @@ class GlobalTaskUnitScheduler:
     grant-everything path."""
 
     def __init__(self) -> None:
+        # Meter EXECUTION only where scope-exit means execution finished
+        # (blocking backends — CPU's in-process collectives): there the
+        # single global slot IS the device schedule. On async backends
+        # (real TPU) scope exit is just enqueue-complete; serializing
+        # enqueues across tenants would tax throughput (each enqueue can
+        # cost a remote-attach round trip) without governing device time —
+        # fairness there comes from the deficit-ordered grants plus the
+        # contended in-flight cap bounding every tenant's queue depth.
+        # The JobServer flips this from its device pool at start.
+        self.meter_execution = True
         self._cond = threading.Condition()
         self._job_executors: Dict[str, Set[str]] = {}
         # (job_id, seq, kind) -> executors currently waiting
@@ -240,7 +250,8 @@ class GlobalTaskUnitScheduler:
         granted_any = False
         for key in ready:
             job, _seq, kind = key
-            if contended and kind != VOID and self._outstanding:
+            if (contended and kind != VOID and self.meter_execution
+                    and self._outstanding):
                 # Metered: the device is ONE resource — under contention
                 # at most one un-finished non-VOID unit is outstanding
                 # ACROSS jobs, so the deficit-ordered grant sequence IS

@@ -348,8 +348,6 @@ def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
     different-topology) test process restores every chained checkpoint
     from the shared root and checks shape + commit state."""
     from harmony_tpu.config.params import JobConfig, TrainerParams
-    from harmony_tpu.jobserver.client import CommandSender
-
     root = str(tmp_path)
     pod = PodHarness(2, 4, env_extra={"HARMONY_POD_CHKP_ROOT": root})
     try:
@@ -406,8 +404,6 @@ def test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline():
       * every process reports the identical series (SPMD lockstep held).
     """
     from harmony_tpu.config.params import JobConfig, TrainerParams
-    from harmony_tpu.jobserver.client import CommandSender
-
     LAG, EPOCHS = 0.4, 3
     pod = PodHarness(2, 4)
 
@@ -478,8 +474,6 @@ def test_pod_jobserver_end_to_end(nprocs, devs_per_proc):
     follower worker metrics land back on process 0. Two topologies: the
     8-device pair and a 3-process/6-device pod."""
     from harmony_tpu.config.params import JobConfig, TrainerParams
-    from harmony_tpu.jobserver.client import CommandSender
-
     pod = PodHarness(nprocs, devs_per_proc)
     try:
         pod.wait_ready()
