@@ -68,6 +68,7 @@ class WorkerTasklet:
         post_init_barrier: Optional[Callable[[], None]] = None,
         defer_epoch_callback: bool = False,
         dispatch_turn: Optional[Callable[[], Any]] = None,
+        pending_plan_epoch: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         self.job_id = job_id
         self.ctx = ctx
@@ -100,6 +101,15 @@ class WorkerTasklet:
         # worker threads enqueue in the SAME deterministic order on every
         # process of the pod.
         self.dispatch_turn = dispatch_turn
+        # Pod reshard plans: callable returning the next scheduled plan
+        # epoch (or None). Multi-epoch windows must END at a plan epoch so
+        # its application (via the deferred epoch-hook replay) lands right
+        # after that epoch's dispatches, not after the whole window.
+        # Deterministic across pod processes by the scheduling contract:
+        # plans carry multi-epoch lead, so by the time any process makes
+        # the window decision covering the plan epoch, the plan has
+        # arrived everywhere (jobserver/podplan.py).
+        self.pending_plan_epoch = pending_plan_epoch
         self._pending_probe = None  # probe deferred into the 1st batch turn
         self._step = None
         self._epoch_fn = None
@@ -724,6 +734,10 @@ class WorkerTasklet:
         if self.epoch_callback is not None and not self.defer_epoch_callback:
             return 1
         w = min(self.EPOCH_WINDOW, num_epochs - epoch)
+        if self.pending_plan_epoch is not None:
+            due = self.pending_plan_epoch()
+            if due is not None and due >= epoch:
+                w = min(w, due - epoch + 1)  # window ends AT the plan epoch
         if self.comm_probe_every and self.global_init:
             if self._probe_pull is None:
                 # a probe (re)build is due at this epoch boundary — keep

@@ -88,6 +88,7 @@ class DolphinJobEntity(JobEntity):
         self._workers: List[WorkerTasklet] = []
         self._ctrl: Optional[MiniBatchController] = None
         self.progress: Optional[BatchProgressTracker] = None
+        self._applied_plans: List[Dict[str, Any]] = []  # pod reshard log
 
     # -- setup -----------------------------------------------------------
 
@@ -251,7 +252,12 @@ class DolphinJobEntity(JobEntity):
             )
             epoch_hook = self._chkp_chain.on_epoch
         tm_hook = self._make_table_metrics_hook()
-        epoch_hook = self._compose_epoch_hooks(epoch_hook, tm_hook)
+        epoch_hook = self._compose_epoch_hooks(
+            epoch_hook, tm_hook, self._make_pod_plan_hook()
+        )
+        from harmony_tpu.jobserver import podplan
+
+        plan_epoch_fn = (lambda: podplan.next_epoch(cfg.job_id))
         orchestrator = self._make_orchestrator()
         # Pod lockstep: a multi-worker job whose grant spans host processes
         # needs a deterministic dispatch schedule — every process runs the
@@ -365,6 +371,7 @@ class DolphinJobEntity(JobEntity):
                         None if turnstile is None
                         else (lambda w=wid: turnstile.turn(w))
                     ),
+                    pending_plan_epoch=(plan_epoch_fn if idx == 0 else None),
                     # the metrics hook only reads already-drained counters,
                     # so fused multi-epoch windows may defer it; checkpoint
                     # chains snapshot state AT their epoch and disable them
@@ -415,6 +422,8 @@ class DolphinJobEntity(JobEntity):
             # their tail ops land in this closing window
             tm_hook(params.num_epochs)
         out: Dict[str, Any] = {"job_id": cfg.job_id, "workers": results}
+        if self._applied_plans:
+            out["applied_plans"] = list(self._applied_plans)
         if orchestrator is not None:
             out["reconfigs"] = len(orchestrator.reconfig_log)
             if orchestrator.errors:
@@ -495,6 +504,46 @@ class DolphinJobEntity(JobEntity):
             # forever and make every resubmission train unoptimized
             self._master.release_optimizer_lease(self._handle.table_id)
             raise
+
+    def _make_pod_plan_hook(self):
+        """Apply pod-scheduled reshard plans at the chief's epoch hook —
+        the deterministic lockstep point every process reaches at the same
+        logical epoch (see jobserver/podplan.py and
+        PodJobServer.schedule_pod_reshard). Deferrable: under multi-epoch
+        windows the hook replays post-drain in epoch order, identically on
+        every process, so the move still lands at one consistent point.
+        Single-process servers never schedule plans; the hook is a dict
+        lookup per epoch there."""
+        from harmony_tpu.jobserver import podplan
+
+        job_id = self.config.job_id
+
+        def hook(epoch_idx: int) -> None:
+            for p in podplan.take(job_id, epoch_idx):
+                # clamp to what src actually owns (deterministic: every
+                # process sees the same block map) so "drain" plans can
+                # just pass a large count
+                owned = self._handle.block_manager.block_counts().get(
+                    p["src"], 0
+                )
+                n = min(int(p["num_blocks"]), owned)
+                if n:
+                    self._handle.move_blocks(p["src"], p["dst"], n)
+                self._applied_plans.append({
+                    "epoch": epoch_idx, "src": p["src"], "dst": p["dst"],
+                    "moved": n,
+                    "owners_after": len(self._handle.owning_executors()),
+                })
+
+        return hook
+
+    def cleanup(self) -> None:
+        """Table teardown (_cleanup_tables) + drop any unapplied pod
+        reshard plans (a resubmitted job id must not inherit them)."""
+        from harmony_tpu.jobserver import podplan
+
+        podplan.clear(self.config.job_id)
+        self._cleanup_tables()
 
     @staticmethod
     def _compose_epoch_hooks(*hooks):
@@ -608,7 +657,7 @@ class DolphinJobEntity(JobEntity):
 
     # -- teardown --------------------------------------------------------
 
-    def cleanup(self) -> None:
+    def _cleanup_tables(self) -> None:
         """Release job tables (ref: JobDispatcher drops tables at job end;
         shared/reused tables survive). The master refcounts shared tables:
         every tenant releases its reference and storage is freed only when

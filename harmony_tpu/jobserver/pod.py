@@ -128,6 +128,9 @@ class PodJobServer(JobServer):
         # results the readers collect during shutdown.
         self._remote_evals: Dict[str, int] = {}
         self._remote_eval_results: Dict[str, Any] = {}
+        # job_id -> (follower participants, effective workers): what
+        # schedule_pod_reshard needs to target PLAN broadcasts
+        self._job_info: Dict[str, Tuple[List[int], int]] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -330,6 +333,12 @@ class PodJobServer(JobServer):
         try:
             participants = sorted(p for p in procs if p != 0)
             run_local = 0 in procs
+            with self._pod_cond:
+                self._job_info[config.job_id] = (
+                    participants, config.num_workers or len(executor_ids)
+                )
+                while len(self._job_info) > 1024:
+                    self._job_info.pop(next(iter(self._job_info)))
             if participants:
                 jlog.info(
                     "pod: RUN_JOB to follower(s) %s (chief=%d, local=%s)",
@@ -397,12 +406,79 @@ class PodJobServer(JobServer):
                         if rep.get("has_deferred_eval"):
                             self._remote_evals[config.job_id] = pid
         finally:
+            from harmony_tpu.jobserver import podplan
+
+            podplan.clear(config.job_id)  # unapplied plans die with the job
             with self._pod_cond:
+                # deregister so schedule_pod_reshard on a finished job
+                # raises KeyError instead of accreting stale plans
+                self._job_info.pop(config.job_id, None)
                 self.job_walls[config.job_id] = (t0, time.monotonic())
                 while len(self.job_walls) > 1024:
                     self.job_walls.pop(next(iter(self.job_walls)))
                 self._active_procs.pop(config.job_id, None)
                 self._pod_cond.notify_all()
+
+    def schedule_pod_reshard(
+        self, job_id: str, src: str, dst: str, num_blocks: int, epoch: int
+    ) -> None:
+        """Plan-driven migration on a RUNNING pod job (ref: the driver's
+        MoveInitMsg flow): broadcast the move to every participant; each
+        process — leader included — applies it at its chief worker's
+        epoch-``epoch`` hook, the deterministic lockstep point (see
+        jobserver/podplan.py, including the multi-epoch-lead contract).
+        Single-dispatch-thread jobs only: a turnstiled multi-worker job's
+        hook runs outside admission turns."""
+        from harmony_tpu.dolphin.worker import WorkerTasklet
+        from harmony_tpu.jobserver import podplan
+
+        with self._pod_cond:
+            info = self._job_info.get(job_id)
+        if info is None:
+            raise KeyError(f"unknown (or finished) pod job {job_id}")
+        participants, workers = info
+        if workers != 1:
+            raise ValueError(
+                f"pod reshard plans need num_workers=1 jobs (got {workers}):"
+                " the epoch hook dispatches outside turnstile turns"
+            )
+        # Enforce the multi-epoch-lead contract structurally where the
+        # leader can observe progress: the window decision COVERING the
+        # plan epoch must happen after every process holds the plan, so
+        # the epoch needs at least a full window horizon of lead. (For
+        # jobs whose progress the leader cannot observe — remote-only,
+        # single-worker trackers — the observed epoch floor is 0, which
+        # makes the check conservative at job start and advisory later.)
+        with self._lock:
+            ent = self._entities.get(job_id)
+        cur = 0
+        if ent is not None and getattr(ent, "progress", None) is not None:
+            cur = ent.progress.starting_epoch()
+        horizon = WorkerTasklet.EPOCH_WINDOW + 1
+        if epoch < cur + horizon:
+            raise ValueError(
+                f"plan epoch {epoch} is inside the window horizon (job at "
+                f"~epoch {cur}; need >= {cur + horizon}): a plan landing "
+                "mid-window would apply at divergent points across "
+                "processes"
+            )
+        plan = {"epoch": int(epoch), "src": src, "dst": dst,
+                "num_blocks": int(num_blocks)}
+        try:
+            for pid in participants:
+                self._send_to(pid, {"cmd": "PLAN", "job_id": job_id,
+                                    "plan": plan})
+        except OSError as e:
+            # a PARTIALLY delivered plan is the divergence hazard itself:
+            # some processes would apply the move, others never — poison
+            # like the RUN_JOB path so nothing later wedges silently
+            with self._pod_cond:
+                if self._pod_broken is None:
+                    self._pod_broken = f"PLAN broadcast failed: {e}"
+                self._pod_cond.notify_all()
+            server_log.error("pod broken: %s", self._pod_broken)
+            raise
+        podplan.schedule(job_id, plan)
 
     def _resolve_remote(self, config: JobConfig, participants: List[int]) -> None:
         """Leader-side completion for a job running wholly on followers:
@@ -572,6 +648,11 @@ class PodFollower:
                         break  # leader gone; nothing to tell it
                 self._sock.close()
                 return
+            if msg.get("cmd") == "PLAN":
+                from harmony_tpu.jobserver import podplan
+
+                podplan.schedule(msg["job_id"], msg["plan"])
+                continue
             assert msg.get("cmd") == "RUN_JOB", msg
             t = threading.Thread(
                 target=self._run_job, args=(msg, global_tu), daemon=True,

@@ -59,6 +59,26 @@ def main() -> None:
         server.start()
         server.serve_pod(pod_port)
         server.serve_tcp(tcp_port)
+        # test hook: schedule a pod reshard plan against a named job as
+        # soon as it is running (HARMONY_POD_TEST_PLAN = JSON with
+        # job_id/src/dst/num_blocks/epoch)
+        plan_env = os.environ.get("HARMONY_POD_TEST_PLAN")
+        if plan_env:
+            import threading
+
+            def arm_plan():
+                plan = json.loads(plan_env)
+                deadline = time.monotonic() + 240
+                while time.monotonic() < deadline:
+                    if plan["job_id"] in server.running_jobs():
+                        try:
+                            server.schedule_pod_reshard(**plan)
+                            return
+                        except KeyError:
+                            pass  # submitted but not yet dispatched
+                    time.sleep(0.1)
+
+            threading.Thread(target=arm_plan, daemon=True).start()
         print("READY", flush=True)
         while server.state != "CLOSED":
             time.sleep(0.2)
@@ -72,6 +92,8 @@ def main() -> None:
                 }
                 if "model_chkp_ids" in res:
                     local[job_id]["model_chkp_ids"] = res["model_chkp_ids"]
+                if "applied_plans" in res:
+                    local[job_id]["applied_plans"] = res["applied_plans"]
             except Exception as e:  # noqa: BLE001 - reported in RESULT
                 local[job_id] = {"error": f"{type(e).__name__}: {e}"}
         print("RESULT " + json.dumps({

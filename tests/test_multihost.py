@@ -340,6 +340,45 @@ def test_pod_live_reshard_across_process_subsets(tmp_path):
     assert len(owners_shrunk) == 1, results
 
 
+def test_pod_plan_driven_migration_mid_training():
+    """Plan-driven migration of a RUNNING pod job (ref: the driver's
+    MoveInitMsg flow, MigrationExecutor.java:107-253): the leader
+    broadcasts a PLAN over the control plane; every process applies the
+    same move_blocks at the same deterministic epoch hook (lockstep), so
+    the cross-process resharding transfer dispatches in lockstep and
+    training continues on the shrunk 7-executor mesh. Loss series stay
+    identical on both processes THROUGH the migration — the strongest
+    no-divergence evidence — and converge."""
+    plan = {"job_id": "pod-plan", "src": "executor-4", "dst": "executor-0",
+            "num_blocks": 1024, "epoch": 9}  # >= EPOCH_WINDOW+1 lead
+    pod = PodHarness(2, 4, env_extra={
+        "HARMONY_POD_TEST_PLAN": json.dumps(plan)})
+    try:
+        pod.wait_ready()
+        cfg = _mlr_job("pod-plan", seed=9, epochs=12)
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-plan"]
+    assert "error" not in res, res
+    # the plan really applied MID-training, drained executor-4, and the
+    # owning set shrank to 7 (the cross-process transfer ran)
+    (applied,) = res["applied_plans"]
+    assert applied["epoch"] == 9 and applied["moved"] > 0, applied
+    assert applied["owners_after"] == 7, applied
+    (losses,) = [w["losses"] for w in res.values()
+                 if isinstance(w, dict) and "losses" in w]
+    assert len(losses) == 12 and losses[-1] < losses[0], losses
+    follower = result["pod_reports"]["pod-plan"]["1"]
+    assert follower["ok"], follower
+    assert [round(x, 5) for x in
+            follower["workers"]["pod-plan/w0"]["losses"]] == [
+        round(x, 5) for x in losses]
+
+
 def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
     """Checkpoint chains DURING pod training (the ModelChkpManager leg of
     the pod checkpoint path): a single-worker MLR job spanning a
