@@ -73,12 +73,17 @@ class DolphinJobEntity(JobEntity):
         metric_sink=None,
         chkp_root: Optional[str] = None,
         metric_manager=None,
+        pod_plan_sink=None,
     ) -> None:
         super().__init__(config, chkp_root)
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
         self._metric_sink = metric_sink
         self._metric_manager = metric_manager
+        # Leader-side pod plan channel (PodJobServer.schedule_pod_reshard):
+        # present only on the pod leader for single-dispatch-thread jobs —
+        # it is what lets the optimizer loop run on multi-process grants.
+        self._pod_plan_sink = pod_plan_sink
         self._chkp_mgr = None
         self._chkp_chain = None
         self._chkp_dir: Optional[str] = None
@@ -466,16 +471,32 @@ class DolphinJobEntity(JobEntity):
             return None
         from harmony_tpu.parallel.mesh import mesh_spans_processes
 
+        plan_sink = None
         if mesh_spans_processes(self._handle.table.mesh):
-            # Every process would build its own orchestrator and plan
-            # migrations independently — divergent reshard dispatches wedge
-            # the pod. Pod-wide elasticity needs a leader-coordinated plan
-            # path; until then, reject loudly instead of diverging.
-            raise ValueError(
-                f"job {self.config.job_id}: optimizer={name!r} is "
-                "single-process only; a multi-process grant cannot run the "
-                "per-job optimization loop yet"
+            # Multi-process grant: ONLY the leader runs the optimization
+            # loop, and its plans are HANDED to the pod control plane for
+            # epoch-aligned lockstep application (followers return None —
+            # they apply plans, never produce them). A leader-process
+            # entity without the pod sink is a misconfiguration: an
+            # orchestrator executing reshard collectives from its own
+            # thread would wedge the pod.
+            import jax as _jax
+
+            leader_proc = min(
+                d.process_index
+                for d in self._handle.table.mesh.devices.flat
             )
+            if _jax.process_index() != leader_proc:
+                return None
+            if self._pod_plan_sink is None:
+                raise ValueError(
+                    f"job {self.config.job_id}: optimizer={name!r} on a "
+                    "multi-process grant is supported only for "
+                    "num_workers=1 jobs whose grant includes the pod "
+                    "LEADER process (the plan channel lives there); this "
+                    "configuration has no pod plan channel"
+                )
+            plan_sink = self._make_pod_plan_adapter()
         if self._metric_manager is None:
             raise ValueError(
                 f"job {self.config.job_id}: optimizer={name!r} needs the "
@@ -497,6 +518,7 @@ class DolphinJobEntity(JobEntity):
                 self._metric_manager,
                 period_sec=self.config.optimizer_period,
                 job_id=self.config.job_id,
+                plan_sink=plan_sink,
             )
         except BaseException:
             # run()'s finally only releases through the orchestrator; a
@@ -504,6 +526,54 @@ class DolphinJobEntity(JobEntity):
             # forever and make every resubmission train unoptimized
             self._master.release_optimizer_lease(self._handle.table_id)
             raise
+
+    def _make_pod_plan_adapter(self):
+        """Adapt a DolphinPlan to the pod plan channel: move-only plans
+        (the pod's reconfiguration unit) are scheduled at the earliest
+        epoch clearing the window-horizon lead past this leader's observed
+        progress; executor add/delete plans are declined (pod topology
+        changes are a process-lifecycle operation, not a table move)."""
+        from harmony_tpu.dolphin.worker import WorkerTasklet
+
+        job_id = self.config.job_id
+        sink = self._pod_plan_sink
+        metrics = self._metric_manager
+        # Monotonic high-water mark of observed epochs: run_once clears
+        # job metrics after an accepted plan, and a later round reading
+        # EMPTY metrics must not regress its epoch estimate to 0 and
+        # schedule a plan BEHIND the job's real progress (the divergent-
+        # application hazard; the pod-side progress-tracker check is
+        # vacuous for single-worker jobs).
+        seen = {"hi": 0}
+
+        def apply(dplan) -> bool:
+            if dplan.evaluators_to_add or dplan.evaluators_to_delete:
+                from harmony_tpu.jobserver.joblog import job_logger
+
+                job_logger(job_id).warning(
+                    "pod optimization declined a plan with executor "
+                    "add/delete (move-only plans are supported on pods)"
+                )
+                return False
+            wm = metrics.worker_batch_metrics(job_id=job_id)
+            cur = max((m.epoch_idx for m in wm), default=0)
+            cur = seen["hi"] = max(cur, seen["hi"])
+            epoch = cur + WorkerTasklet.EPOCH_WINDOW + 2
+            if epoch >= self.config.params.num_epochs:
+                from harmony_tpu.jobserver.joblog import job_logger
+
+                job_logger(job_id).warning(
+                    "pod optimization declined: earliest safe apply epoch "
+                    "%d is past the job's end (%d epochs) — too few "
+                    "epochs remain for a lockstep migration",
+                    epoch, self.config.params.num_epochs,
+                )
+                return False
+            for step in dplan.transfer_steps:
+                sink(job_id, step.src, step.dst, step.num_blocks, epoch)
+            return bool(dplan.transfer_steps)
+
+        return apply
 
     def _make_pod_plan_hook(self):
         """Apply pod-scheduled reshard plans at the chief's epoch hook —
@@ -698,6 +768,8 @@ class PregelJobEntity(JobEntity):
         metric_sink=None,
         chkp_root: Optional[str] = None,
         metric_manager=None,  # no per-table optimizer loop for graphs
+        pod_plan_sink=None,   # accepted for interface parity; graphs have
+                              # no model table to migrate by plan
     ) -> None:
         super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit

@@ -480,6 +480,21 @@ class PodJobServer(JobServer):
             raise
         podplan.schedule(job_id, plan)
 
+    def _entity_extras(self, config: JobConfig,
+                       executor_ids: List[str]) -> Dict[str, Any]:
+        """Wire the pod plan channel into multi-process single-thread
+        entities: their optimizer loop hands plans to
+        schedule_pod_reshard instead of executing reshard collectives
+        from its own thread."""
+        procs = {
+            self.master.executor(e).device.process_index
+            for e in executor_ids
+        }
+        workers = config.num_workers or len(executor_ids)
+        if len(procs) > 1 and workers == 1:
+            return {"pod_plan_sink": self.schedule_pod_reshard}
+        return {}
+
     def _resolve_remote(self, config: JobConfig, participants: List[int]) -> None:
         """Leader-side completion for a job running wholly on followers:
         the lowest participating pid is the job chief; its JOB_DONE carries

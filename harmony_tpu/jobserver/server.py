@@ -282,6 +282,7 @@ class JobServer:
                 metric_sink=self._on_metric,
                 chkp_root=self._chkp_root,
                 metric_manager=self.metrics,
+                **self._entity_extras(config, executor_ids),
             )
             with self._lock:
                 self._entities[config.job_id] = entity
@@ -310,6 +311,12 @@ class JobServer:
             with self._lock:
                 self._entities.pop(config.job_id, None)
             self._scheduler.on_job_finish(config.job_id)
+
+    def _entity_extras(self, config: JobConfig,
+                       executor_ids: List[str]) -> Dict[str, Any]:
+        """Subclass hook: extra build_entity kwargs (the pod server wires
+        its plan channel for multi-process grants here)."""
+        return {}
 
     def running_jobs(self) -> List[str]:
         with self._lock:

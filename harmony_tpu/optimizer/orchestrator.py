@@ -35,6 +35,7 @@ class OptimizationOrchestrator:
         period_sec: float = 5.0,
         available_fn: Optional[Callable[[], int]] = None,
         job_id: Optional[str] = None,
+        plan_sink: Optional[Callable[..., bool]] = None,
     ) -> None:
         """``job_id`` scopes a multi-tenant deployment: the optimizer sees
         ONLY this job's metrics (another tenant's throughput must not steer
@@ -49,6 +50,13 @@ class OptimizationOrchestrator:
         self.period_sec = period_sec
         self.job_id = job_id
         self._available_fn = available_fn
+        # Pod mode: plans are HANDED OFF (plan_sink(dplan) -> bool) instead
+        # of executed from this thread — on a multi-process mesh a reshard
+        # is a lockstep collective, so the leader routes moves through the
+        # pod control plane for epoch-aligned application on every process
+        # (jobserver/podplan.py). The sink returns True when it accepted
+        # the plan.
+        self._plan_sink = plan_sink
         self._compiler = PlanCompiler()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -104,6 +112,18 @@ class OptimizationOrchestrator:
         )
         dplan = self.optimizer.optimize(params, avail)
         if dplan.empty:
+            return None
+        if self._plan_sink is not None:
+            accepted = self._plan_sink(dplan)
+            if accepted:
+                # skewed mid-decision samples must not feed the next round
+                # (the migration itself lands later, epoch-aligned). A
+                # DECLINED plan migrated nothing: clearing would starve
+                # metric-driven optimizers of history every period.
+                self.metrics.clear(job_id=self.job_id)
+                result = PlanResult()  # handed off; application is async
+                self.reconfig_log.append(result)
+                return result
             return None
         plan = self._compiler.compile(dplan, self.handle.table_id)
         if self.job_id is not None:

@@ -49,3 +49,27 @@ class LaggyMLRTrainer:
                 super().on_epoch_finished(ctx, epoch)
 
         return _Laggy(**kw)
+
+
+class MoveOncePodOptimizer:
+    """Optimizer SPI impl that emits ONE move-only plan (drain half of
+    executor-4 onto executor-0) as soon as worker metrics exist — the
+    canned optimizer for pod elasticity tests (the SampleOptimizers
+    analogue for the pod plan channel)."""
+
+    def __init__(self) -> None:
+        self.fired = False
+
+    def optimize(self, params, num_available_evaluators):
+        from harmony_tpu.optimizer.api import DolphinPlan, TransferStep
+
+        if self.fired or not params.worker_metrics:
+            return DolphinPlan()
+        src = "executor-4"
+        n = params.block_counts.get(src, 0)
+        if not n:
+            return DolphinPlan()
+        self.fired = True
+        return DolphinPlan(transfer_steps=[
+            TransferStep(params.table_id, src, "executor-0", n)
+        ])

@@ -94,6 +94,9 @@ def main() -> None:
                     local[job_id]["model_chkp_ids"] = res["model_chkp_ids"]
                 if "applied_plans" in res:
                     local[job_id]["applied_plans"] = res["applied_plans"]
+                for k in ("reconfigs", "optimizer_errors"):
+                    if k in res:
+                        local[job_id][k] = res[k]
             except Exception as e:  # noqa: BLE001 - reported in RESULT
                 local[job_id] = {"error": f"{type(e).__name__}: {e}"}
         print("RESULT " + json.dumps({

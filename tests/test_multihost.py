@@ -379,6 +379,43 @@ def test_pod_plan_driven_migration_mid_training():
         round(x, 5) for x in losses]
 
 
+def test_pod_optimizer_loop_elasticity():
+    """The full elasticity feedback loop ON a pod (metrics -> Optimizer ->
+    plan -> epoch-aligned lockstep migration): the LEADER runs the
+    orchestrator (ref ETOptimizationOrchestrator.java:50-140) fed by its
+    lockstep-local metrics; its move-only plan rides the pod control
+    plane (schedule_pod_reshard) and every process applies it at the same
+    epoch hook — elastic pods, end to end. Followers never produce plans.
+    Evidence: applied_plans in the leader's result (owners shrank), at
+    least one reconfig logged, and identical loss series on both
+    processes through the migration."""
+    pod = PodHarness(2, 4)
+    try:
+        pod.wait_ready()
+        cfg = _mlr_job("pod-opt", seed=4, epochs=28)
+        cfg.optimizer = "tests.helpers:MoveOncePodOptimizer"
+        cfg.optimizer_period = 0.5
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-opt"]
+    assert "error" not in res, res
+    assert res.get("reconfigs") == 1 and "optimizer_errors" not in res, res
+    (applied,) = res["applied_plans"]
+    assert applied["moved"] > 0 and applied["owners_after"] == 7, applied
+    (losses,) = [w["losses"] for w in res.values()
+                 if isinstance(w, dict) and "losses" in w]
+    assert len(losses) == 28 and losses[-1] < losses[0], losses
+    follower = result["pod_reports"]["pod-opt"]["1"]
+    assert follower["ok"], follower
+    assert [round(x, 5) for x in
+            follower["workers"]["pod-opt/w0"]["losses"]] == [
+        round(x, 5) for x in losses]
+
+
 def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
     """Checkpoint chains DURING pod training (the ModelChkpManager leg of
     the pod checkpoint path): a single-worker MLR job spanning a
