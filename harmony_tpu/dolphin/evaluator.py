@@ -112,6 +112,34 @@ class ModelChkpManager:
         return list(self.chkp_ids)
 
 
+def resolve_eval_inputs(config):
+    """(trainer, batch) for a job's offline model evaluation, resolved
+    from the serializable JobConfig — THE one resolution shared by the
+    leader's deferred-eval closure and the pod follower's collective leg
+    (they must issue byte-identical restore/evaluate collectives; two
+    hand-copied resolutions would silently desynchronize them). fn and
+    args fall back TOGETHER: pairing a custom test_data_fn with the
+    training data_args would call it with foreign kwargs."""
+    import numpy as np
+
+    from harmony_tpu.config.base import resolve_symbol
+
+    user = config.user
+    if "test_data_fn" in user:
+        fn = resolve_symbol(user["test_data_fn"])
+        args = user.get("test_data_args", {})
+    else:
+        fn = resolve_symbol(user["data_fn"])
+        args = user.get("test_data_args", user.get("data_args", {}))
+    out = fn(**args)
+    batch = tuple(
+        np.asarray(a)
+        for a in (out if isinstance(out, (tuple, list)) else (out,))
+    )
+    trainer = resolve_symbol(config.trainer)(**config.params.app_params)
+    return trainer, batch
+
+
 class ModelEvaluator:
     """Replays checkpoints against a trainer's evaluate() on test data.
 

@@ -74,16 +74,21 @@ class DolphinJobEntity(JobEntity):
         chkp_root: Optional[str] = None,
         metric_manager=None,
         pod_plan_sink=None,
+        pod_eval_channel=None,
     ) -> None:
         super().__init__(config, chkp_root)
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
         self._metric_sink = metric_sink
         self._metric_manager = metric_manager
-        # Leader-side pod plan channel (PodJobServer.schedule_pod_reshard):
-        # present only on the pod leader for single-dispatch-thread jobs —
-        # it is what lets the optimizer loop run on multi-process grants.
+        # Leader-side pod channels (present only on the pod leader for
+        # single-dispatch-thread jobs): the plan channel lets the
+        # optimizer loop run on multi-process grants; the eval channel
+        # turns the shutdown-stage deferred model eval into a pod
+        # collective (followers replay the same restores/evaluations in
+        # lockstep).
         self._pod_plan_sink = pod_plan_sink
+        self._pod_eval_channel = pod_eval_channel
         self._chkp_mgr = None
         self._chkp_chain = None
         self._chkp_dir: Optional[str] = None
@@ -232,12 +237,24 @@ class DolphinJobEntity(JobEntity):
                         "SHARED chkp_root (per-process temp dirs would "
                         "each hold only a fragment of every checkpoint)"
                     )
-                if params.offline_model_eval:
-                    raise ValueError(
-                        f"job {cfg.job_id}: offline_model_eval is "
-                        "single-process only (the shutdown-stage restore "
-                        "is not a pod collective yet)"
+                if params.offline_model_eval and self._pod_eval_channel is None:
+                    # only the LEADER process holds the eval channel;
+                    # follower entities legitimately lack it (they replay
+                    # the collective on the leader's EVAL_COLLECTIVE
+                    # broadcast) — the guard is a leader-side check
+                    import jax as _jax
+
+                    leader_proc = min(
+                        d.process_index
+                        for d in self._handle.table.mesh.devices.flat
                     )
+                    if _jax.process_index() == leader_proc:
+                        raise ValueError(
+                            f"job {cfg.job_id}: offline_model_eval on a "
+                            "multi-process grant needs the pod eval "
+                            "channel (a leader-held num_workers=1 grant "
+                            "under a PodJobServer)"
+                        )
             import os
             import tempfile
 
@@ -696,30 +713,45 @@ class DolphinJobEntity(JobEntity):
             return None
         cfg = self.config
         mgr = self._chkp_mgr
-        trainer_factory = self._trainer_factory
         executor_ids = list(self._executor_ids)
-        user = cfg.user
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        eval_channel = (
+            self._pod_eval_channel
+            if mesh_spans_processes(self._handle.table.mesh)
+            else None
+        )
 
         def run_eval(master: ETMaster) -> List[Dict[str, float]]:
-            from harmony_tpu.dolphin.evaluator import ModelEvaluator
+            from harmony_tpu.dolphin.evaluator import (
+                ModelEvaluator,
+                resolve_eval_inputs,
+            )
 
-            # fn and args fall back TOGETHER: pairing a custom test_data_fn
-            # with the training data_args would call it with foreign kwargs.
-            if "test_data_fn" in user:
-                fn = resolve_symbol(user["test_data_fn"])
-                args = user.get("test_data_args", {})
+            # the SHARED resolution (leader and pod followers must issue
+            # byte-identical collectives — see resolve_eval_inputs)
+            trainer, batch = resolve_eval_inputs(cfg)
+            if eval_channel is None:
+                metrics = ModelEvaluator(master, mgr).evaluate_checkpoints(
+                    chkp_ids, trainer, batch, executor_ids
+                )
             else:
-                fn = resolve_symbol(user["data_fn"])
-                args = user.get("test_data_args", user.get("data_args", {}))
-            out = fn(**args)
-            batch = tuple(
-                np.asarray(a)
-                for a in (out if isinstance(out, (tuple, list)) else (out,))
-            )
-            metrics = ModelEvaluator(master, mgr).evaluate_checkpoints(
-                chkp_ids, trainer_factory(), batch, executor_ids
-            )
-            for cid in chkp_ids:  # consumed: reclaim the disk
+                # pod collective: followers must enter the SAME restore +
+                # evaluate collectives — broadcast first, evaluate
+                # together, then await their acks. A leader-side failure
+                # AFTER the broadcast leaves followers inside collectives
+                # nothing will complete: the finally still collects what
+                # it can (bounded) and the channel poisons the pod on a
+                # missing/failed ack.
+                eval_channel("start", cfg.job_id, {"chkp_ids": chkp_ids})
+                try:
+                    metrics = ModelEvaluator(master, mgr).evaluate_checkpoints(
+                        chkp_ids, trainer, batch, executor_ids
+                    )
+                finally:
+                    eval_channel("finish", cfg.job_id)
+            for cid in chkp_ids:  # consumed: reclaim the disk (the
+                # LEADER owns shared-root cleanup; followers never delete)
                 mgr.delete(cid)
             return metrics
 
@@ -769,7 +801,7 @@ class PregelJobEntity(JobEntity):
         chkp_root: Optional[str] = None,
         metric_manager=None,  # no per-table optimizer loop for graphs
         pod_plan_sink=None,   # accepted for interface parity; graphs have
-                              # no model table to migrate by plan
+        pod_eval_channel=None,  # no model table to migrate/evaluate by plan
     ) -> None:
         super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit

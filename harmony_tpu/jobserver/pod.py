@@ -131,6 +131,9 @@ class PodJobServer(JobServer):
         # job_id -> (follower participants, effective workers): what
         # schedule_pod_reshard needs to target PLAN broadcasts
         self._job_info: Dict[str, Tuple[List[int], int]] = {}
+        # retained past job end (deferred evals run at shutdown):
+        # job_id -> follower participants for the collective eval
+        self._eval_participants: Dict[str, List[int]] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -212,6 +215,13 @@ class PodJobServer(JobServer):
                         server_log.error("pod broken: %s", self._pod_broken)
                     self._pod_cond.notify_all()
                 return
+            if msg.get("cmd") == "EVAL_COLLECTIVE_DONE":
+                with self._pod_cond:
+                    self._reports[
+                        (f"__evalc__{msg.get('job_id')}", pid)
+                    ] = msg
+                    self._pod_cond.notify_all()
+                continue
             if msg.get("cmd") == "EVAL_DONE":
                 # Shutdown-stage deferred-eval result from a chief follower
                 # (the remote analogue of _run_deferred_evals' entries).
@@ -482,18 +492,86 @@ class PodJobServer(JobServer):
 
     def _entity_extras(self, config: JobConfig,
                        executor_ids: List[str]) -> Dict[str, Any]:
-        """Wire the pod plan channel into multi-process single-thread
-        entities: their optimizer loop hands plans to
-        schedule_pod_reshard instead of executing reshard collectives
-        from its own thread."""
+        """Wire the pod channels into multi-process single-thread
+        entities: the optimizer loop hands plans to schedule_pod_reshard
+        instead of executing reshard collectives from its own thread, and
+        the shutdown-stage deferred model eval runs as a pod collective
+        through the eval channel."""
         procs = {
             self.master.executor(e).device.process_index
             for e in executor_ids
         }
         workers = config.num_workers or len(executor_ids)
         if len(procs) > 1 and workers == 1:
-            return {"pod_plan_sink": self.schedule_pod_reshard}
+            if (config.params.offline_model_eval
+                    and config.params.model_chkp_period > 0):
+                # registered ONLY for jobs that will actually run the
+                # collective eval at shutdown — unconditional registration
+                # would let unrelated jobs FIFO-evict a live entry and
+                # turn its broadcast into a silent no-op (the leader would
+                # then evaluate alone and wedge in its collectives)
+                participants = sorted(p for p in procs if p != 0)
+                with self._pod_cond:
+                    self._eval_participants[config.job_id] = participants
+                    while len(self._eval_participants) > 1024:
+                        self._eval_participants.pop(
+                            next(iter(self._eval_participants)))
+            return {"pod_plan_sink": self.schedule_pod_reshard,
+                    "pod_eval_channel": self._pod_eval_channel}
         return {}
+
+    def _pod_eval_channel(self, phase: str, job_id: str,
+                          payload: Optional[Dict[str, Any]] = None,
+                          timeout: float = 180.0) -> None:
+        """Two-phase channel for the collective deferred eval:
+        phase "start" broadcasts EVAL_COLLECTIVE so followers enter the
+        restore+evaluate collectives in lockstep with the leader's eval;
+        phase "finish" waits (bounded) for their EVAL_COLLECTIVE_DONE
+        acks — a silent follower is recorded, never waited on forever."""
+        with self._pod_cond:
+            participants = self._eval_participants.get(job_id, [])
+        if not participants:
+            return
+        if phase == "start":
+            try:
+                for pid in participants:
+                    self._send_to(pid, {"cmd": "EVAL_COLLECTIVE",
+                                        "job_id": job_id, **(payload or {})})
+            except OSError as e:
+                # a PARTIAL broadcast strands the followers that did
+                # receive it inside collectives the rest never join —
+                # poison like the RUN_JOB/PLAN paths
+                with self._pod_cond:
+                    if self._pod_broken is None:
+                        self._pod_broken = (
+                            f"EVAL_COLLECTIVE broadcast failed: {e}"
+                        )
+                    self._pod_cond.notify_all()
+                server_log.error("pod broken: %s", self._pod_broken)
+                raise
+            return
+        deadline = time.monotonic() + timeout
+        for pid in participants:
+            rep = self._wait_report(f"__evalc__{job_id}", pid, deadline)
+            if rep is None or not rep.get("ok"):
+                # silence = wedged in a collective; ok=False = it bailed
+                # BEFORE the collectives while the others entered them.
+                # Either way the eval collectives cannot all complete:
+                # record the one diagnosable fact and poison.
+                why = ("never acked" if rep is None
+                       else f"failed: {rep.get('error')}")
+                with self._pod_cond:
+                    if self._pod_broken is None:
+                        self._pod_broken = (
+                            f"collective eval for {job_id}: follower "
+                            f"{pid} {why}"
+                        )
+                    self._pod_cond.notify_all()
+                server_log.error("pod broken: %s", self._pod_broken)
+        with self._pod_cond:
+            for pid in participants:
+                self._reports.pop((f"__evalc__{job_id}", pid), None)
+            self._eval_participants.pop(job_id, None)
 
     def _resolve_remote(self, config: JobConfig, participants: List[int]) -> None:
         """Leader-side completion for a job running wholly on followers:
@@ -616,6 +694,9 @@ class PodFollower:
         self._send_lock = threading.Lock()
         self._job_threads: List[threading.Thread] = []
         self._deferred_evals: Dict[str, Any] = {}  # job_id -> closure
+        # job_id -> (config, executor_ids, chkp_root): what the collective
+        # deferred eval rebuilds its evaluator from at shutdown
+        self._job_confs: Dict[str, Any] = {}
         _send(self._sock, {"cmd": "JOIN", "pid": pid})
 
         from harmony_tpu.metrics.manager import MetricManager
@@ -668,6 +749,13 @@ class PodFollower:
 
                 podplan.schedule(msg["job_id"], msg["plan"])
                 continue
+            if msg.get("cmd") == "EVAL_COLLECTIVE":
+                # the leader's deferred model eval is a lockstep collective
+                # (restore + evaluate over the multi-process mesh): run the
+                # identical evaluation here, inline (shutdown-stage; no
+                # jobs are running), then ack
+                self._run_collective_eval(msg)
+                continue
             assert msg.get("cmd") == "RUN_JOB", msg
             t = threading.Thread(
                 target=self._run_job, args=(msg, global_tu), daemon=True,
@@ -677,12 +765,58 @@ class PodFollower:
             self._job_threads.append(t)
             t.start()
 
+    def _run_collective_eval(self, msg: Dict[str, Any]) -> None:
+        """The follower leg of the pod-collective deferred model eval:
+        rebuild the SAME trainer/test-data/checkpoint-manager the leader's
+        closure resolves (everything derives from the job config, which
+        lockstep already requires be identical) and replay the chain —
+        the restores and evaluate steps join the leader's collectives.
+        Results are discarded (identical to the leader's, which records
+        them); the ack unblocks the leader's bounded wait."""
+        import os
+
+        job_id = str(msg.get("job_id"))
+        report = {"cmd": "EVAL_COLLECTIVE_DONE", "pid": self.pid,
+                  "job_id": job_id, "ok": False}
+        try:
+            config, executor_ids, chkp_root = self._job_confs[job_id]
+            from harmony_tpu.checkpoint.manager import CheckpointManager
+            from harmony_tpu.dolphin.evaluator import (
+                ModelEvaluator,
+                resolve_eval_inputs,
+            )
+
+            mgr = CheckpointManager(
+                os.path.join(chkp_root, job_id, "temp"),
+                os.path.join(chkp_root, job_id, "commit"),
+            )
+            # the SHARED resolution — byte-identical collectives with the
+            # leader's closure (see resolve_eval_inputs)
+            trainer, batch = resolve_eval_inputs(config)
+            ModelEvaluator(self.master, mgr).evaluate_checkpoints(
+                list(msg.get("chkp_ids", [])), trainer, batch, executor_ids
+            )
+            report["ok"] = True
+        except BaseException as e:  # noqa: BLE001 - acked to leader
+            report["error"] = f"{type(e).__name__}: {e}"
+        self._report(report)
+
     def _run_job(self, msg: Dict[str, Any], global_tu) -> None:
         from harmony_tpu.jobserver.entity import build_entity
         from harmony_tpu.runtime.taskunit import LocalTaskUnitScheduler
 
         config = ConfigBase.from_dict(msg["conf"])
         executor_ids = msg["executor_ids"]
+        if (config.params.offline_model_eval
+                and config.params.model_chkp_period > 0):
+            # retained for the shutdown-stage collective eval — ONLY for
+            # jobs that will run one (unconditional retention would let
+            # unrelated jobs evict a config still needed at shutdown)
+            self._job_confs[config.job_id] = (
+                config, list(executor_ids), msg.get("chkp_root")
+            )
+            while len(self._job_confs) > 1024:
+                self._job_confs.pop(next(iter(self._job_confs)))
         chief = int(msg.get("chief_pid", 0)) == self.pid
         report: Dict[str, Any] = {
             "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,

@@ -379,6 +379,35 @@ def test_pod_plan_driven_migration_mid_training():
         round(x, 5) for x in losses]
 
 
+def test_pod_collective_deferred_eval(tmp_path):
+    """Shutdown-stage deferred model evaluation as a POD COLLECTIVE (the
+    last single-process-only leg of §5.4): a whole-pod job chains
+    checkpoints; at graceful shutdown the leader broadcasts
+    EVAL_COLLECTIVE and every process replays the same restore+evaluate
+    collectives in lockstep; the leader's eval_results carries one metric
+    dict per chained checkpoint and every worker process exits cleanly
+    (a wedged follower would hang the reap)."""
+    root = str(tmp_path)
+    pod = PodHarness(2, 4, env_extra={"HARMONY_POD_CHKP_ROOT": root})
+    try:
+        pod.wait_ready()
+        cfg = _mlr_job("pod-ev", seed=6, epochs=2)
+        cfg.params.model_chkp_period = 1
+        cfg.params.offline_model_eval = True
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-ev"]
+    assert "error" not in res, res
+    evals = result["eval_results"]["pod-ev"]
+    assert not (isinstance(evals, dict) and "error" in evals), evals
+    assert len(evals) == 2, evals  # one metric dict per epoch checkpoint
+    assert all("loss" in m or m for m in evals), evals
+
+
 def test_pod_optimizer_loop_elasticity():
     """The full elasticity feedback loop ON a pod (metrics -> Optimizer ->
     plan -> epoch-aligned lockstep migration): the LEADER runs the
