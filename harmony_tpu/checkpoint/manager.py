@@ -146,6 +146,17 @@ class CheckpointManager:
     block IO collapsed in (single-controller: the master can reach every
     shard directly via the table's export/import)."""
 
+    @classmethod
+    def for_job(cls, chkp_root: str, job_id: str,
+                backend=None) -> "CheckpointManager":
+        """The per-job layout (<root>/<job>/temp, <root>/<job>/commit) —
+        THE one place it is defined: the job entity and the pod
+        followers' collective-eval leg must construct byte-identical
+        managers or their restores diverge."""
+        return cls(os.path.join(chkp_root, job_id, "temp"),
+                   os.path.join(chkp_root, job_id, "commit"),
+                   backend=backend)
+
     def __init__(self, temp_root: str, commit_root: str, backend=None) -> None:
         """``commit_root`` names the durable store: a directory (posix
         backend), or an object-store URL like ``gs://bucket/chkps`` (orbax/

@@ -338,6 +338,19 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=43110)
     p = sub.add_parser("shutdown", help="graceful jobserver shutdown")
     p.add_argument("--port", type=int, default=43110)
+    p = sub.add_parser(
+        "pod-reshard",
+        help="live-migrate table blocks of a RUNNING pod job "
+             "(applied at the given epoch on every process in lockstep)",
+    )
+    p.add_argument("--port", type=int, default=43110)
+    p.add_argument("--job", required=True)
+    p.add_argument("--src", required=True, help="source executor id")
+    p.add_argument("--dst", required=True, help="destination executor id")
+    p.add_argument("--blocks", type=int, required=True)
+    p.add_argument("--epoch", type=int, required=True,
+                   help="apply epoch; needs a full window horizon of lead")
+
     p = sub.add_parser("dashboard", help="metrics dashboard HTTP server")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--db", default=":memory:")
@@ -357,6 +370,14 @@ def main(argv: List[str] | None = None) -> int:
         return 0 if resp.get("ok") else 1
     if args.cmd == "run":
         return _cmd_run(args)
+    if args.cmd == "pod-reshard":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        resp = CommandSender(args.port).send_pod_reshard_command(
+            args.job, args.src, args.dst, args.blocks, args.epoch
+        )
+        print(json.dumps(resp))
+        return 0 if resp.get("ok") else 1
     if args.cmd in ("status", "shutdown"):
         from harmony_tpu.jobserver.client import CommandSender
 

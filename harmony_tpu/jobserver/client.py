@@ -44,6 +44,16 @@ class CommandSender:
     def send_status_command(self) -> Dict[str, Any]:
         return self._roundtrip({"command": "STATUS"})
 
+    def send_pod_reshard_command(
+        self, job_id: str, src: str, dst: str, num_blocks: int, epoch: int
+    ) -> Dict[str, Any]:
+        """Operator-initiated live migration of a running pod job (the
+        reference's driver-side moveBlocks, reachable from ops tooling)."""
+        return self._roundtrip({
+            "command": "POD_RESHARD", "job_id": job_id, "src": src,
+            "dst": dst, "num_blocks": num_blocks, "epoch": epoch,
+        })
+
     def send_shutdown_command(self) -> Dict[str, Any]:
         return self._roundtrip({"command": "SHUTDOWN"})
 

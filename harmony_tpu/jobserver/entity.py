@@ -237,23 +237,33 @@ class DolphinJobEntity(JobEntity):
                         "SHARED chkp_root (per-process temp dirs would "
                         "each hold only a fragment of every checkpoint)"
                     )
-                if params.offline_model_eval and self._pod_eval_channel is None:
-                    # only the LEADER process holds the eval channel;
-                    # follower entities legitimately lack it (they replay
-                    # the collective on the leader's EVAL_COLLECTIVE
-                    # broadcast) — the guard is a leader-side check
+                if params.offline_model_eval:
+                    # Guards must be SYMMETRIC across processes — one
+                    # process raising while its peers proceed into the
+                    # job's collectives wedges the pod. Every process can
+                    # evaluate the structural support condition itself:
+                    # the grant must include the pod leader (process 0 —
+                    # the only holder of the eval channel). Followers of
+                    # a supported grant legitimately lack the channel
+                    # (they replay on the EVAL_COLLECTIVE broadcast).
                     import jax as _jax
 
-                    leader_proc = min(
+                    procs = {
                         d.process_index
                         for d in self._handle.table.mesh.devices.flat
-                    )
-                    if _jax.process_index() == leader_proc:
+                    }
+                    if 0 not in procs:
+                        raise ValueError(
+                            f"job {cfg.job_id}: offline_model_eval needs "
+                            "the grant to include the pod leader "
+                            "(process 0), which runs the collective eval"
+                        )
+                    if (_jax.process_index() == 0
+                            and self._pod_eval_channel is None):
                         raise ValueError(
                             f"job {cfg.job_id}: offline_model_eval on a "
                             "multi-process grant needs the pod eval "
-                            "channel (a leader-held num_workers=1 grant "
-                            "under a PodJobServer)"
+                            "channel (running outside a PodJobServer?)"
                         )
             import os
             import tempfile
@@ -265,10 +275,7 @@ class DolphinJobEntity(JobEntity):
                 prefix=f"harmony-chkp-{cfg.job_id}-"
             )
             self._chkp_dir = root
-            self._chkp_mgr = CheckpointManager(
-                os.path.join(root, cfg.job_id, "temp"),
-                os.path.join(root, cfg.job_id, "commit"),
-            )
+            self._chkp_mgr = CheckpointManager.for_job(root, cfg.job_id)
             self._chkp_chain = ModelChkpManager(
                 self._chkp_mgr, self._handle, period=params.model_chkp_period
             )

@@ -215,10 +215,14 @@ class PodJobServer(JobServer):
                         server_log.error("pod broken: %s", self._pod_broken)
                     self._pod_cond.notify_all()
                 return
-            if msg.get("cmd") == "EVAL_COLLECTIVE_DONE":
+            if msg.get("cmd") in ("EVAL_COLLECTIVE_DONE",
+                                  "EVAL_COLLECTIVE_READY"):
+                prefix = ("__evalc__"
+                          if msg["cmd"] == "EVAL_COLLECTIVE_DONE"
+                          else "__evalr__")
                 with self._pod_cond:
                     self._reports[
-                        (f"__evalc__{msg.get('job_id')}", pid)
+                        (f"{prefix}{msg.get('job_id')}", pid)
                     ] = msg
                     self._pod_cond.notify_all()
                 continue
@@ -520,6 +524,28 @@ class PodJobServer(JobServer):
                     "pod_eval_channel": self._pod_eval_channel}
         return {}
 
+    def _broadcast_eval_decision(self, participants: List[int],
+                                 job_id: str, go: bool) -> None:
+        cmd = "EVAL_GO" if go else "EVAL_ABORT"
+        for pid in participants:
+            try:
+                self._send_to(pid, {"cmd": cmd, "job_id": job_id})
+            except OSError as e:
+                if not go:
+                    continue  # an unreachable follower cannot be aborted
+                    # harder; it is already out of the protocol
+                # a PARTIAL GO is unrecoverable: recipients enter
+                # collectives the rest never join — poison, and the
+                # caller must NOT enter its own collectives
+                with self._pod_cond:
+                    if self._pod_broken is None:
+                        self._pod_broken = f"EVAL_GO send failed: {e}"
+                    self._pod_cond.notify_all()
+                server_log.error("pod broken: %s", self._pod_broken)
+                raise RuntimeError(
+                    f"EVAL_GO broadcast failed: {e}"
+                ) from None
+
     def _pod_eval_channel(self, phase: str, job_id: str,
                           payload: Optional[Dict[str, Any]] = None,
                           timeout: float = 180.0) -> None:
@@ -533,22 +559,44 @@ class PodJobServer(JobServer):
         if not participants:
             return
         if phase == "start":
+            # Three-phase handshake: broadcast -> collect READINESS acks
+            # (followers stage everything fallible HOST-SIDE first) ->
+            # GO only when every participant is ready, else ABORT. A
+            # follower failing BEFORE the collectives therefore aborts
+            # the whole eval cleanly — nobody enters collectives that
+            # cannot complete. Only a failure AFTER GO (mid-collective,
+            # the finish phase's domain) poisons the pod.
             try:
                 for pid in participants:
                     self._send_to(pid, {"cmd": "EVAL_COLLECTIVE",
                                         "job_id": job_id, **(payload or {})})
             except OSError as e:
-                # a PARTIAL broadcast strands the followers that did
-                # receive it inside collectives the rest never join —
-                # poison like the RUN_JOB/PLAN paths
+                # partial broadcast: recipients sit in the READY wait (a
+                # bounded socket read, not a collective) — abort them
+                self._broadcast_eval_decision(participants, job_id, go=False)
+                raise RuntimeError(
+                    f"EVAL_COLLECTIVE broadcast failed: {e}"
+                ) from None
+            deadline = time.monotonic() + timeout
+            failures = []
+            for pid in participants:
+                rep = self._wait_report(f"__evalr__{job_id}", pid, deadline)
+                if rep is None or not rep.get("ok"):
+                    failures.append(
+                        (pid, "no readiness ack" if rep is None
+                         else rep.get("error")))
+            with self._pod_cond:
+                for pid in participants:
+                    self._reports.pop((f"__evalr__{job_id}", pid), None)
+            if failures:
+                self._broadcast_eval_decision(participants, job_id, go=False)
                 with self._pod_cond:
-                    if self._pod_broken is None:
-                        self._pod_broken = (
-                            f"EVAL_COLLECTIVE broadcast failed: {e}"
-                        )
-                    self._pod_cond.notify_all()
-                server_log.error("pod broken: %s", self._pod_broken)
-                raise
+                    self._eval_participants.pop(job_id, None)
+                raise RuntimeError(
+                    f"collective eval aborted (followers not ready): "
+                    f"{failures}"
+                )
+            self._broadcast_eval_decision(participants, job_id, go=True)
             return
         deadline = time.monotonic() + timeout
         for pid in participants:
@@ -773,11 +821,10 @@ class PodFollower:
         the restores and evaluate steps join the leader's collectives.
         Results are discarded (identical to the leader's, which records
         them); the ack unblocks the leader's bounded wait."""
-        import os
-
         job_id = str(msg.get("job_id"))
-        report = {"cmd": "EVAL_COLLECTIVE_DONE", "pid": self.pid,
-                  "job_id": job_id, "ok": False}
+        ready = {"cmd": "EVAL_COLLECTIVE_READY", "pid": self.pid,
+                 "job_id": job_id, "ok": False}
+        staged = None
         try:
             config, executor_ids, chkp_root = self._job_confs[job_id]
             from harmony_tpu.checkpoint.manager import CheckpointManager
@@ -786,13 +833,26 @@ class PodFollower:
                 resolve_eval_inputs,
             )
 
-            mgr = CheckpointManager(
-                os.path.join(chkp_root, job_id, "temp"),
-                os.path.join(chkp_root, job_id, "commit"),
-            )
-            # the SHARED resolution — byte-identical collectives with the
-            # leader's closure (see resolve_eval_inputs)
-            trainer, batch = resolve_eval_inputs(config)
+            # HOST-ONLY staging before the readiness ack: anything that
+            # can fail must fail HERE, where aborting is clean — once the
+            # collectives start, a one-sided failure wedges the pod
+            mgr = CheckpointManager.for_job(chkp_root, job_id)
+            trainer, batch = resolve_eval_inputs(config)  # the SHARED
+            # resolution — byte-identical collectives with the leader
+            staged = (mgr, trainer, batch, executor_ids)
+            ready["ok"] = True
+        except BaseException as e:  # noqa: BLE001 - acked to leader
+            ready["error"] = f"{type(e).__name__}: {e}"
+        self._report(ready)
+        # the leader decides GO (all ready) or ABORT (anyone failed —
+        # including this process); only GO enters the collectives
+        decision = _recv(self._file)
+        if not decision or decision.get("cmd") != "EVAL_GO":
+            return  # aborted (or leader hung up): nothing dispatched
+        report = {"cmd": "EVAL_COLLECTIVE_DONE", "pid": self.pid,
+                  "job_id": job_id, "ok": False}
+        try:
+            mgr, trainer, batch, executor_ids = staged
             ModelEvaluator(self.master, mgr).evaluate_checkpoints(
                 list(msg.get("chkp_ids", [])), trainer, batch, executor_ids
             )

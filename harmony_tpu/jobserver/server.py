@@ -192,10 +192,22 @@ class JobServer:
         if not evals:
             return
         stage_deadline = None if timeout is None else time.monotonic() + timeout
+        abandoned = False
         for job_id, fn in evals.items():
             if not drained:
                 self.eval_results[job_id] = {
                     "error": "skipped: job drain timed out"
+                }
+                continue
+            if abandoned:
+                # an abandoned (timed-out) eval thread may still be
+                # running; evals can be multi-process COLLECTIVES, and a
+                # second one interleaving with it enqueues programs in
+                # orders the followers (strictly sequential) cannot match
+                # — skip the rest instead of deadlocking the pod
+                self.eval_results[job_id] = {
+                    "error": "skipped: a previous eval timed out and may "
+                             "still be running"
                 }
                 continue
             box: Dict[str, Any] = {}
@@ -217,6 +229,7 @@ class JobServer:
             t.join(timeout=remaining)
             if t.is_alive():
                 self.eval_results[job_id] = {"error": "timed out"}
+                abandoned = True  # its thread may still be mid-collective
             elif "error" in box:
                 self.eval_results[job_id] = {"error": box["error"]}
             else:
@@ -378,6 +391,20 @@ class JobServer:
                     reply = {"ok": True, "job_id": config.job_id}
                 elif cmd == "STATUS":
                     reply = self._status()
+                elif cmd == "POD_RESHARD":
+                    # operator-initiated live migration of a running pod
+                    # job (PodJobServer.schedule_pod_reshard; plain
+                    # servers reject — the attribute is pod-only)
+                    fn = getattr(self, "schedule_pod_reshard", None)
+                    if fn is None:
+                        reply = {"ok": False,
+                                 "error": "not a pod server"}
+                    else:
+                        fn(job_id=str(msg["job_id"]), src=str(msg["src"]),
+                           dst=str(msg["dst"]),
+                           num_blocks=int(msg["num_blocks"]),
+                           epoch=int(msg["epoch"]))
+                        reply = {"ok": True}
                 elif cmd == "SHUTDOWN":
                     threading.Thread(target=self.shutdown, daemon=True).start()
                     reply = {"ok": True}

@@ -59,26 +59,6 @@ def main() -> None:
         server.start()
         server.serve_pod(pod_port)
         server.serve_tcp(tcp_port)
-        # test hook: schedule a pod reshard plan against a named job as
-        # soon as it is running (HARMONY_POD_TEST_PLAN = JSON with
-        # job_id/src/dst/num_blocks/epoch)
-        plan_env = os.environ.get("HARMONY_POD_TEST_PLAN")
-        if plan_env:
-            import threading
-
-            def arm_plan():
-                plan = json.loads(plan_env)
-                deadline = time.monotonic() + 240
-                while time.monotonic() < deadline:
-                    if plan["job_id"] in server.running_jobs():
-                        try:
-                            server.schedule_pod_reshard(**plan)
-                            return
-                        except KeyError:
-                            pass  # submitted but not yet dispatched
-                    time.sleep(0.1)
-
-            threading.Thread(target=arm_plan, daemon=True).start()
         print("READY", flush=True)
         while server.state != "CLOSED":
             time.sleep(0.2)

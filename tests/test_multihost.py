@@ -349,15 +349,24 @@ def test_pod_plan_driven_migration_mid_training():
     training continues on the shrunk 7-executor mesh. Loss series stay
     identical on both processes THROUGH the migration — the strongest
     no-divergence evidence — and converge."""
-    plan = {"job_id": "pod-plan", "src": "executor-4", "dst": "executor-0",
-            "num_blocks": 1024, "epoch": 9}  # >= EPOCH_WINDOW+1 lead
-    pod = PodHarness(2, 4, env_extra={
-        "HARMONY_POD_TEST_PLAN": json.dumps(plan)})
+    pod = PodHarness(2, 4)
     try:
         pod.wait_ready()
         cfg = _mlr_job("pod-plan", seed=9, epochs=12)
         resp = pod.sender.send_job_submit_command(cfg)
         assert resp.get("ok"), resp
+        # operator-initiated migration over the TCP command plane (the
+        # CLI pod-reshard surface), retried until the job is dispatched
+        deadline = time.monotonic() + 120
+        while True:
+            r = pod.sender.send_pod_reshard_command(
+                "pod-plan", "executor-4", "executor-0",
+                num_blocks=1024, epoch=9,  # >= EPOCH_WINDOW+1 lead
+            )
+            if r.get("ok"):
+                break
+            assert time.monotonic() < deadline, r
+            time.sleep(0.1)
         pod.drain()
         result = pod.finish()
     finally:
