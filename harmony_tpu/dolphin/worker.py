@@ -157,13 +157,15 @@ class WorkerTasklet:
             return metrics
         return {"_sync": jnp.ravel(arr)[0]}
 
-    def _step_core(self, push_route: str):
+    def _step_core(self, push_route: str, mesh: Mesh):
         """The fused PULL/COMP/PUSH body shared by per-batch and per-epoch
         compilation. ``hyper`` is a dict of scalars (lr etc.) passed fresh
         each dispatch so host-side decay is honored. ``push_route`` is the
-        RESOLVED keyed-push lowering (resolved once per build and threaded
-        here AND into the program key, so the cached executable always
-        matches its key)."""
+        RESOLVED keyed-push lowering and ``mesh`` the LAYOUT SNAPSHOT's
+        mesh — both threaded from the caller so the traced program is
+        fully determined by its program-cache key (reading the live
+        table's mesh here let a prewarm cache a target-key program whose
+        sharding constraints pinned the OLD mesh)."""
         from harmony_tpu.table.hashtable import DeviceHashTable
 
         spec = self.ctx.model_table.spec
@@ -183,7 +185,7 @@ class WorkerTasklet:
             defense). Returns (state, compute's aux, metrics with the
             mandatory _dropped count — drops are drained into
             table.overflow_count at epoch end, never silent)."""
-            replicated = NamedSharding(self.ctx.model_table.mesh, P())
+            replicated = NamedSharding(mesh, P())
             keys = jax.lax.with_sharding_constraint(
                 trainer.pull_keys(batch), replicated
             )
@@ -341,16 +343,17 @@ class WorkerTasklet:
         """The step/epoch jit-wrapper constructors for a GIVEN layout
         snapshot — shared by _build_step (live layout) and _prewarm_layout
         (announced target layout)."""
+        mesh = (tsh[0] if isinstance(tsh, tuple) else tsh).mesh
 
         def build_step():
-            step = self._step_core(push_route)
+            step = self._step_core(push_route, mesh)
             if self.trainer.uses_local_table:
                 return jax.jit(step, out_shardings=((tsh, lsh), None),
                                donate_argnums=(0, 1))
             return jax.jit(step, out_shardings=(tsh, None), donate_argnums=0)
 
         def build_epoch():
-            step = self._step_core(push_route)
+            step = self._step_core(push_route, mesh)
             if self.trainer.uses_local_table:
 
                 def _epoch2(arr, larr, stacked, hyper):
@@ -385,8 +388,7 @@ class WorkerTasklet:
             from harmony_tpu.table.hashtable import DeviceHashTable
 
             table = self.ctx.model_table
-            if isinstance(table, DeviceHashTable):
-                return  # dense-only prewarm for now
+            is_hash = isinstance(table, DeviceHashTable)
             if self.trainer.uses_local_table:
                 return  # the (model, local) pair reshards independently
             if (self.dispatch_turn is not None
@@ -399,7 +401,8 @@ class WorkerTasklet:
                 # guard). Pod reshard pre-warming needs a collective
                 # protocol; fall back to the ordinary rebuild.
                 return
-            tsh_new = table._make_sharding(new_mesh)
+            tsh_new = (tuple(table._make_shardings(new_mesh)) if is_hash
+                       else table._make_sharding(new_mesh))
             if tsh_new == self._step_sharding:
                 return  # announced layout == live layout: nothing to warm
             route = self._resolve_push_route()
@@ -436,9 +439,19 @@ class WorkerTasklet:
             epoch_fn = (progcache.get_or_build((key, "epoch"), build_epoch)
                         if fused else None)
             spec = table.spec
-            arr0 = jax.device_put(
-                np.zeros(spec.storage_shape, spec.dtype), tsh_new
-            )
+            if is_hash:
+                # an all-EMPTY hash state (slot_keys == 0) is a valid
+                # table; the dummy step's inserts are discarded
+                arr0 = (
+                    jax.device_put(
+                        np.zeros(spec.keys_shape, np.int32), tsh_new[0]),
+                    jax.device_put(
+                        np.zeros(spec.values_shape, spec.dtype), tsh_new[1]),
+                )
+            else:
+                arr0 = jax.device_put(
+                    np.zeros(spec.storage_shape, spec.dtype), tsh_new
+                )
             hyper = self._hyper()
             if fused:
                 with dispatch_scope(new_mesh) as fin:

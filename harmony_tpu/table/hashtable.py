@@ -47,6 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from harmony_tpu.config.params import TableConfig
 from harmony_tpu.parallel.dispatch import dispatch_scope
+from harmony_tpu.table.table import LayoutAnnouncerMixin
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 # Stored-key encoding: key k (MIN_KEY <= k <= MAX_KEY) is stored as -(k + 2);
@@ -404,7 +405,7 @@ class HashTableSpec:
         return (slot_keys, values)
 
 
-class DeviceHashTable:
+class DeviceHashTable(LayoutAnnouncerMixin):
     """Host-side handle: sharded state, serialized commits, re-sharding,
     block export/import — the DenseTable facade for sparse key domains."""
 
@@ -418,6 +419,7 @@ class DeviceHashTable:
         self._lock = threading.RLock()
         self._mesh = mesh
         self._jit_cache: Dict[str, object] = {}
+        self._layout_listeners: list = []
         self._ksh, self._vsh = self._make_shardings(mesh)
         if state is None:
             sk, v = spec.init_state()

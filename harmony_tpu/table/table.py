@@ -163,6 +163,37 @@ def block_sharding(mesh: Mesh, num_blocks: int) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+class LayoutAnnouncerMixin:
+    """Reshard announcements, shared by dense AND hash tables: the caller
+    (TableHandle._announce_target) announces the TARGET mesh before the
+    ownership flip so subscribers (workers) compile their programs for
+    the target layout while the current one still trains — the stall then
+    costs ~the move, not a recompile (the reference's access-latch-only
+    stall, MigrationExecutor.java:163-253). Hosts must init
+    ``self._layout_listeners = []`` and hold ``self._lock``."""
+
+    def add_layout_listener(self, fn) -> None:
+        with self._lock:
+            self._layout_listeners.append(fn)
+
+    def remove_layout_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._layout_listeners:
+                self._layout_listeners.remove(fn)
+
+    def announce_reshard(self, new_mesh: Mesh) -> None:
+        """Run listeners with the target mesh (outside the table lock —
+        listeners dispatch device programs). Best-effort: a failing
+        listener never blocks the migration."""
+        with self._lock:
+            listeners = list(self._layout_listeners)
+        for fn in listeners:
+            try:
+                fn(new_mesh)
+            except Exception:
+                pass
+
+
 class TableSpec:
     """Static description of a table + its pure on-device ops.
 
@@ -339,7 +370,7 @@ class TableSpec:
         return arr.at[b, o].set(values.astype(self.dtype))
 
 
-class DenseTable:
+class DenseTable(LayoutAnnouncerMixin):
     """Host-side handle: stateful commits, sharding, re-sharding, checkpoint.
 
     Mirrors the union of the reference's ``Table`` (evaluator/api/Table.java:
@@ -379,35 +410,6 @@ class DenseTable:
 
     def _make_sharding(self, mesh: Mesh) -> NamedSharding:
         return block_sharding(mesh, self.spec.num_blocks)
-
-    # -- layout announcements (reshard pre-warming) ----------------------
-
-    def add_layout_listener(self, fn) -> None:
-        """Subscribe to reshard ANNOUNCEMENTS: ``fn(target_mesh)`` runs
-        before the ownership flip, so subscribers (workers) can compile
-        their programs for the target layout while the current one still
-        trains — the stall then costs ~the move, not a recompile (the
-        reference's access-latch-only stall, MigrationExecutor.java:
-        163-253)."""
-        with self._lock:
-            self._layout_listeners.append(fn)
-
-    def remove_layout_listener(self, fn) -> None:
-        with self._lock:
-            if fn in self._layout_listeners:
-                self._layout_listeners.remove(fn)
-
-    def announce_reshard(self, new_mesh: Mesh) -> None:
-        """Run listeners with the target mesh (outside the table lock —
-        listeners dispatch device programs). Best-effort: a failing
-        listener never blocks the migration."""
-        with self._lock:
-            listeners = list(self._layout_listeners)
-        for fn in listeners:
-            try:
-                fn(new_mesh)
-            except Exception:
-                pass
 
     @property
     def mesh(self) -> Mesh:

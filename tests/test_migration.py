@@ -295,6 +295,53 @@ class TestReshardPrewarm:
         assert len(handle.owning_executors()) == 1
 
 
+class TestReshardPrewarmSparse:
+    def test_hash_table_announce_prewarms(self, devices):
+        """Round-3 parity: the reshard announcement pre-warms HASH-backed
+        jobs too (the sparse FM/LDA shape) — the announced layout's step
+        compiles via progcache before the flip, and training stays exact
+        through the move."""
+        from harmony_tpu.apps.widedeep import FMTrainer, make_synthetic_sparse
+        from harmony_tpu.runtime import progcache
+
+        pool = DevicePool(devices[:2])
+        master = ETMaster(pool)
+        exs = master.add_executors(2)
+        tr = FMTrainer(vocab_size=64, num_slots=4, emb_dim=4, step_size=0.5,
+                       sparse=True)
+        cfg = tr.model_table_config().replace(num_blocks=16)
+        handle = master.create_table(cfg, [e.id for e in exs])
+        ids, y = make_synthetic_sparse(256, vocab_size=64, num_slots=4,
+                                       seed=3)
+        params = TrainerParams(num_epochs=6, num_mini_batches=4,
+                               comm_probe_period=0)
+        seen = {}
+
+        def on_epoch(epoch):
+            if epoch == 2:
+                n = handle.block_manager.block_counts()[exs[0].id]
+                before = progcache.stats()["misses"]
+                handle.move_blocks(exs[0].id, exs[1].id, n)
+                seen["misses_during_move"] = (
+                    progcache.stats()["misses"] - before
+                )
+
+        worker = WorkerTasklet(
+            "sp-prewarm",
+            TrainerContext(params=params, model_table=handle.table),
+            tr,
+            TrainingDataProvider([ids, y], 4),
+            handle.table.mesh,
+            epoch_callback=on_epoch,
+        )
+        result = worker.run()
+        # the announcement built the target-layout programs INSIDE the move
+        assert seen["misses_during_move"] >= 1, seen
+        assert result["losses"][-1] < result["losses"][0], result["losses"]
+        assert len(handle.owning_executors()) == 1
+        assert handle.table.overflow_count == 0
+
+
 class TestSparseTableMigration:
     def test_concurrent_migration_during_sparse_training(self, devices):
         """Live plan-driven migration of a HASH-backED model table while a
