@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shutil
 import threading
@@ -298,12 +299,28 @@ class CheckpointManager:
             # resubmitted job's fresh manager would otherwise reuse
             # '<table>-1-pod' and commit() would silently keep the stale
             # run's blocks. All processes scan the same shared roots at
-            # the same logical point, so they agree.
+            # the same logical point, so they agree. The scan covers the
+            # .writing staging dir too: a crashed prior run leaves one
+            # behind, and reusing it would rename the dead run's stale
+            # block files wholesale into the new checkpoint.
             while True:
                 self._counter += 1
                 chkp_id = f"{handle.table_id}-{self._counter}-pod"
+                tdir_probe = os.path.join(self.temp_root, chkp_id)
+                if os.path.isdir(tdir_probe + ".writing"):
+                    # NOT auto-deleted: peers run this same scan at the
+                    # same logical point, and a delete racing a peer's
+                    # probe would flip its id choice (divergent chkp ids
+                    # across the pod). Skipping is deterministic; the
+                    # leak is surfaced for operator cleanup.
+                    logging.getLogger("harmony.checkpoint").warning(
+                        "orphaned staging dir from a crashed run: %s — "
+                        "safe to delete once no pod job is checkpointing",
+                        tdir_probe + ".writing",
+                    )
+                    continue
                 if not self._backend.exists(chkp_id) and not os.path.isdir(
-                    os.path.join(self.temp_root, chkp_id)
+                    tdir_probe
                 ):
                     break
         mesh = handle.table.mesh

@@ -500,25 +500,37 @@ class DolphinJobEntity(JobEntity):
             # Multi-process grant: ONLY the leader runs the optimization
             # loop, and its plans are HANDED to the pod control plane for
             # epoch-aligned lockstep application (followers return None —
-            # they apply plans, never produce them). A leader-process
-            # entity without the pod sink is a misconfiguration: an
-            # orchestrator executing reshard collectives from its own
-            # thread would wedge the pod.
+            # they apply plans, never produce them). Rejections here must
+            # be SYMMETRIC across processes (one process raising while its
+            # peers proceed into the job's collectives wedges the pod), so
+            # the support condition is derived purely from config + mesh:
+            # the grant must include the pod leader (process 0), the only
+            # holder of the plan channel. Every participant evaluates the
+            # same predicate and raises together.
             import jax as _jax
 
-            leader_proc = min(
+            procs = {
                 d.process_index
                 for d in self._handle.table.mesh.devices.flat
-            )
-            if _jax.process_index() != leader_proc:
-                return None
-            if self._pod_plan_sink is None:
+            }
+            if 0 not in procs:
                 raise ValueError(
                     f"job {self.config.job_id}: optimizer={name!r} on a "
-                    "multi-process grant is supported only for "
-                    "num_workers=1 jobs whose grant includes the pod "
-                    "LEADER process (the plan channel lives there); this "
-                    "configuration has no pod plan channel"
+                    "multi-process grant needs the grant to include the "
+                    "pod leader (process 0), which runs the optimization "
+                    "loop and owns the plan channel"
+                )
+            if _jax.process_index() != 0:
+                return None
+            if self._pod_plan_sink is None:
+                # Only reachable OUTSIDE a PodJobServer (which wires the
+                # sink for every multi-process grant): there are no pod
+                # followers to desynchronize from in that case, so a
+                # one-sided raise is safe.
+                raise ValueError(
+                    f"job {self.config.job_id}: optimizer={name!r} on a "
+                    "multi-process grant has no pod plan channel "
+                    "(running outside a PodJobServer?)"
                 )
             plan_sink = self._make_pod_plan_adapter()
         if self._metric_manager is None:

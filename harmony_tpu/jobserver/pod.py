@@ -322,6 +322,32 @@ class PodJobServer(JobServer):
         procs = frozenset(
             self.master.executor(e).device.process_index for e in executor_ids
         )
+        if config.optimizer and len(procs) > 1 and 0 not in procs:
+            # Reject HERE, before any RUN_JOB is sent: the optimizer loop
+            # needs the pod plan channel, which only exists where process 0
+            # participates. (The entity guard is symmetric too — this is
+            # the clean-failure layer that keeps followers out of it
+            # entirely.)
+            self._fail_job(
+                config,
+                f"optimizer={config.optimizer!r} on a multi-process grant "
+                "needs the grant to include the pod leader (process 0), "
+                "which owns the plan channel",
+            )
+            return
+        if (config.optimizer and len(procs) > 1
+                and (config.num_workers or len(executor_ids)) != 1):
+            # schedule_pod_reshard serves single-dispatch-thread jobs;
+            # admitting this config would start an orchestrator whose
+            # every plan dies in the plan channel (a permanently dead
+            # optimizer loop) — fail it up front instead.
+            self._fail_job(
+                config,
+                f"optimizer={config.optimizer!r} on a multi-process grant "
+                "currently needs num_workers=1 (pod reshard plans apply at "
+                "the single dispatch thread's epoch hook)",
+            )
+            return
         # Multi-worker multi-process jobs are legal: the entity wires a
         # DispatchTurnstile so every process's worker threads enqueue
         # their global programs in the same deterministic order
@@ -506,22 +532,29 @@ class PodJobServer(JobServer):
             for e in executor_ids
         }
         workers = config.num_workers or len(executor_ids)
-        if len(procs) > 1 and workers == 1:
-            if (config.params.offline_model_eval
-                    and config.params.model_chkp_period > 0):
-                # registered ONLY for jobs that will actually run the
-                # collective eval at shutdown — unconditional registration
-                # would let unrelated jobs FIFO-evict a live entry and
-                # turn its broadcast into a silent no-op (the leader would
-                # then evaluate alone and wedge in its collectives)
-                participants = sorted(p for p in procs if p != 0)
-                with self._pod_cond:
-                    self._eval_participants[config.job_id] = participants
-                    while len(self._eval_participants) > 1024:
-                        self._eval_participants.pop(
-                            next(iter(self._eval_participants)))
-            return {"pod_plan_sink": self.schedule_pod_reshard,
-                    "pod_eval_channel": self._pod_eval_channel}
+        if len(procs) > 1:
+            extras: Dict[str, Any] = {
+                "pod_plan_sink": self.schedule_pod_reshard,
+            }
+            if workers == 1:
+                # The collective deferred eval stays single-dispatch-
+                # thread-only (the checkpoint chain it replays is).
+                extras["pod_eval_channel"] = self._pod_eval_channel
+                if (config.params.offline_model_eval
+                        and config.params.model_chkp_period > 0):
+                    # registered ONLY for jobs that will actually run the
+                    # collective eval at shutdown — unconditional
+                    # registration would let unrelated jobs FIFO-evict a
+                    # live entry and turn its broadcast into a silent
+                    # no-op (the leader would then evaluate alone and
+                    # wedge in its collectives)
+                    participants = sorted(p for p in procs if p != 0)
+                    with self._pod_cond:
+                        self._eval_participants[config.job_id] = participants
+                        while len(self._eval_participants) > 1024:
+                            self._eval_participants.pop(
+                                next(iter(self._eval_participants)))
+            return extras
         return {}
 
     def _broadcast_eval_decision(self, participants: List[int],

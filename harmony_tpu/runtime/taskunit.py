@@ -251,13 +251,17 @@ class GlobalTaskUnitScheduler:
         for key in ready:
             job, _seq, kind = key
             if (contended and kind != VOID and self.meter_execution
-                    and self._outstanding):
-                # Metered: the device is ONE resource — under contention
-                # at most one un-finished non-VOID unit is outstanding
-                # ACROSS jobs, so the deficit-ordered grant sequence IS
-                # the device schedule (per-job slots would degenerate to
-                # 1:1 alternation in whatever order threads hit the
-                # dispatch lock).
+                    and any(jk[1] == kind for jk in self._outstanding)):
+                # Metered PER KIND: the device is one CPU resource — under
+                # contention at most one un-finished CPU unit is
+                # outstanding ACROSS jobs, so the deficit-ordered grant
+                # sequence IS the device schedule (per-job slots would
+                # degenerate to 1:1 alternation in whatever order threads
+                # hit the dispatch lock). NET units are host-driven
+                # transfers, not device compute: gating them behind an
+                # outstanding COMP unit would collapse the 1-CPU/2-NET
+                # compute/transfer overlap into full serialization, so
+                # each kind meters only against itself.
                 continue
             waiters = self._waiting.pop(key)
             self._arrival.pop(key, None)
