@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import logging
 import os
 import shutil
 import threading
@@ -299,28 +298,16 @@ class CheckpointManager:
             # resubmitted job's fresh manager would otherwise reuse
             # '<table>-1-pod' and commit() would silently keep the stale
             # run's blocks. All processes scan the same shared roots at
-            # the same logical point, so they agree. The scan covers the
-            # .writing staging dir too: a crashed prior run leaves one
-            # behind, and reusing it would rename the dead run's stale
-            # block files wholesale into the new checkpoint.
+            # the same logical point, so they agree. The scan must NOT
+            # read '.writing' staging state — peers of THIS checkpoint
+            # create it mid-scan, so probing it would race into divergent
+            # ids; stale staging from a crashed run is handled by the
+            # leader's fenced pre-clear below instead.
             while True:
                 self._counter += 1
                 chkp_id = f"{handle.table_id}-{self._counter}-pod"
-                tdir_probe = os.path.join(self.temp_root, chkp_id)
-                if os.path.isdir(tdir_probe + ".writing"):
-                    # NOT auto-deleted: peers run this same scan at the
-                    # same logical point, and a delete racing a peer's
-                    # probe would flip its id choice (divergent chkp ids
-                    # across the pod). Skipping is deterministic; the
-                    # leak is surfaced for operator cleanup.
-                    logging.getLogger("harmony.checkpoint").warning(
-                        "orphaned staging dir from a crashed run: %s — "
-                        "safe to delete once no pod job is checkpointing",
-                        tdir_probe + ".writing",
-                    )
-                    continue
                 if not self._backend.exists(chkp_id) and not os.path.isdir(
-                    tdir_probe
+                    os.path.join(self.temp_root, chkp_id)
                 ):
                     break
         mesh = handle.table.mesh
@@ -343,7 +330,27 @@ class CheckpointManager:
         # peers in the fence (a psum never times out) — every process
         # reports its error flag THROUGH the fence, and all raise together
         # if anyone failed.
+        # Fenced pre-clear: a crashed prior run of the same job id can
+        # leave stale block files in '<id>.writing'; makedirs(exist_ok)
+        # would adopt them and the leader's wholesale rename would commit
+        # dead-run payloads into a fresh checkpoint. The LEADER clears the
+        # staging dir before ANY process writes — behind a mesh fence so
+        # no peer's write can race the clear.
         err: Optional[BaseException] = None
+        try:
+            if _jax.process_index() == leader:
+                shutil.rmtree(staging, ignore_errors=True)
+                os.makedirs(staging, exist_ok=True)
+        except BaseException as e:  # noqa: BLE001 - reported via the fence
+            err = e
+        failures = mesh_sum(mesh, 1.0 if err else 0.0,
+                            f"chkp-cleared:{chkp_id}")
+        if failures:
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"leader failed clearing the staging dir for {chkp_id}"
+            )
         try:
             os.makedirs(staging, exist_ok=True)  # processes race; shared FS
             sparse = info.table_config.sparse

@@ -69,6 +69,7 @@ class WorkerTasklet:
         defer_epoch_callback: bool = False,
         dispatch_turn: Optional[Callable[[], Any]] = None,
         pending_plan_epoch: Optional[Callable[[], Optional[int]]] = None,
+        pod_contended: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.job_id = job_id
         self.ctx = ctx
@@ -101,6 +102,11 @@ class WorkerTasklet:
         # worker threads enqueue in the SAME deterministic order on every
         # process of the pod.
         self.dispatch_turn = dispatch_turn
+        # Cross-job pod tenancy: returns the contended flag of this job's
+        # last COMPLETED dispatch unit (runtime/podunits.py) — a value
+        # every process reads at the same logical point, so dispatch-
+        # window decisions branched on it stay deterministic pod-wide.
+        self.pod_contended = pod_contended
         # Pod reshard plans: callable returning the next scheduled plan
         # epoch (or None). Multi-epoch windows must END at a plan epoch so
         # its application (via the deferred epoch-hook replay) lands right
@@ -740,6 +746,13 @@ class WorkerTasklet:
         table between dispatches."""
         if self.batch_barrier is not None:
             return 1
+        if self.pod_contended is not None and self.pod_contended():
+            # Cross-job pod tenancy: a multi-epoch window is one dispatch
+            # UNIT, and co-tenants wait out whole units — contended jobs
+            # interleave at single-epoch granularity instead. The flag is
+            # read at the last completed unit's exit (deterministic
+            # pod-wide), so every process shrinks at the same epoch.
+            return 1
         # un-overridden hooks are no-ops (windowable by construction);
         # overriders must OPT IN at the class that defines the hook
         if not Trainer._epoch_hook_windowable(self.trainer):
@@ -863,8 +876,20 @@ class WorkerTasklet:
 
     def run(self) -> Dict[str, Any]:
         ctx, params = self.ctx, self.ctx.params
+        # Global init writes shared tables (multi-device programs): under
+        # pod tenancy that region holds a dispatch turn/unit like any
+        # batch (siblings are parked at the init barrier, but OTHER jobs'
+        # units must not interleave mid-init). Turn BALANCE: the cyclic
+        # turnstile admits workers in strict rotation, so chief-only
+        # turns would skew the alternation and walk the SSP gate past its
+        # slack INSIDE a turn (deadlock) — every worker takes the turn,
+        # no-op for non-chiefs.
         if self.global_init:
-            self.trainer.init_global_settings(ctx)
+            with self._turn():
+                self.trainer.init_global_settings(ctx)
+        elif self._balanced_turns():
+            with self._turn():
+                pass
         if self.post_init_barrier is not None:
             self.post_init_barrier()
         self.trainer.on_training_start(ctx, self.starting_epoch)
@@ -902,15 +927,20 @@ class WorkerTasklet:
                 first = tuple(a[: self.data.batch_size]
                               for a in self.data._arrays)
                 if first and len(first[0]):
-                    if self.dispatch_turn is not None:
-                        # turnstiled: defer into the first batch turn so
-                        # the probe's dispatches happen inside this
-                        # worker's admission slot
+                    if (self.dispatch_turn is not None
+                            and not self._use_fused_epoch()):
+                        # turnstiled/batched: defer into the first batch
+                        # turn so the probe's dispatches happen inside
+                        # this worker's admission slot (a separate CYCLIC
+                        # turn would skew the turnstile unboundedly)
                         self._pending_probe = first
                     else:
+                        # fused path (pod units are request/grant, not a
+                        # cycle — an extra unit is harmless) or no turns
                         with trace_span("dolphin.comm_probe",
                                         job_id=self.job_id, epoch=epoch):
-                            self._probe_comm(first)
+                            with self._turn():
+                                self._probe_comm(first)
             window = self._epoch_window_len(epoch, params.num_epochs)
             if window > 1:
                 # Multi-epoch window: dispatches chain on the table state
@@ -1060,14 +1090,24 @@ class WorkerTasklet:
     UNIT_SPAN_TARGET = 0.1
 
     def _units_per_scope(self) -> int:
-        if self.taskunit is None or not self.taskunit.contended():
-            return 1
         if self.batch_barrier is not None:
             return 1  # the SSP gate is per batch; never hold a slot on it
-        c = self._own_batch_cost
-        if not c:
-            return 1
-        return max(1, min(8, int(self.UNIT_SPAN_TARGET / max(c, 1e-6))))
+        if self.taskunit is not None:
+            if not self.taskunit.contended():
+                return 1
+            c = self._own_batch_cost
+            if not c:
+                return 1
+            return max(1, min(8, int(self.UNIT_SPAN_TARGET / max(c, 1e-6))))
+        if self.pod_contended is not None and self.dispatch_turn is not None:
+            # Pod units on the batched path: group a FIXED batch count per
+            # unit so an uncontended job does not pay a leader round trip
+            # per mini-batch. Fixed, not UNIT_SPAN_TARGET-measured — the
+            # group size must be identical on every process (a local
+            # timing would diverge the unit sequence and wedge the pod);
+            # the contended flag is deterministic (read at unit exit).
+            return 1 if self.pod_contended() else 8
+        return 1
 
     def _dispatch_epoch_batches(self, epoch: int, global_batch_idx: int):
         """The per-batch dispatch loop of one epoch — async, TaskUnit
@@ -1241,11 +1281,14 @@ class WorkerTasklet:
         drain_t = 0.0
         host: Dict[str, np.ndarray] = {}
         if all_pending:
-            t0 = time.perf_counter()
             with trace_span("dolphin.metric_drain", job_id=self.job_id,
                             epoch=first_epoch, batches=len(all_pending),
                             epochs=k):
-                host = self._drain_pending(all_pending)
+                # the drain's stacks are dispatches; timer starts INSIDE
+                # the turn (admission wait is scheduling, not work)
+                with self._turn():
+                    t0 = time.perf_counter()
+                    host = self._drain_pending(all_pending)
             drain_t = time.perf_counter() - t0
         out = []
         off = 0
@@ -1369,20 +1412,30 @@ class WorkerTasklet:
         # optimizer (a mid-window reshard rebuilds it inside the retry
         # loop and does count — it IS reconfiguration cost)
         self._ensure_stacked_cache()
-        t0 = time.perf_counter()
+        work_t = 0.0  # dispatch+device seconds, EXCLUDING admission waits
         window_metrics = []
         for j in range(k):
-            window_metrics.append(self._dispatch_epoch_fn())
+            # each whole-epoch dispatch is one admission turn / pod unit:
+            # its enqueues must not interleave with another tenant's. The
+            # timer starts INSIDE the turn — a co-tenant's unit wait is
+            # scheduling, not work, and must not inflate the per-batch
+            # times feeding the optimizer's cost model (same rule as the
+            # batched path's scopes).
+            with self._turn():
+                t0 = time.perf_counter()
+                window_metrics.append(self._dispatch_epoch_fn())
+                work_t += time.perf_counter() - t0
             if j + 1 < k:
                 # windowable by declaration: depends only on the epoch
                 # index, so it may run before the epoch's results drain
                 self.trainer.on_epoch_finished(self.ctx, first_epoch + j)
-        # ONE drain for the whole window, BEFORE the timer stops: the
-        # per-batch times fed to the optimizer must include device
-        # execution, and on a lazy backend block_until_ready would stop
-        # the clock at dispatch
+        # ONE drain for the whole window, counted as work: the per-batch
+        # times fed to the optimizer must include device execution, and on
+        # a lazy backend block_until_ready would stop the clock at dispatch
+        t_sync = time.perf_counter()
         hard_sync(window_metrics)
-        per_epoch_sec = (time.perf_counter() - t0) / k
+        work_t += time.perf_counter() - t_sync
+        per_epoch_sec = work_t / k
         nb = self.data.num_mini_batches
         out = []
         for j, stacked_metrics in enumerate(window_metrics):
@@ -1430,8 +1483,16 @@ class WorkerTasklet:
         epoch_losses.append(progress)
         if call_trainer_hook:
             self.trainer.on_epoch_finished(self.ctx, epoch)
+        # The callback may dispatch global programs (pod checkpoint
+        # chains, plan-driven block moves) — under pod tenancy it holds a
+        # turn/unit. Turn balance: non-chief workers take a matching
+        # no-op turn so the strict rotation stays aligned (see run()).
         if self.epoch_callback is not None:
-            self.epoch_callback(epoch)
+            with self._turn():
+                self.epoch_callback(epoch)
+        elif self._balanced_turns():
+            with self._turn():
+                pass
         self.collector.flush()
 
     def _account_ops(self, num_steps: int) -> None:
@@ -1455,6 +1516,13 @@ class WorkerTasklet:
         if self.dispatch_turn is None:
             return contextlib.nullcontext()
         return self.dispatch_turn()
+
+    def _balanced_turns(self) -> bool:
+        """True when this worker must take no-op turns to keep the cyclic
+        turnstile's strict rotation aligned with its siblings' chief-only
+        turns (multi-worker turnstiled jobs; single-thread jobs have no
+        rotation to balance)."""
+        return self.dispatch_turn is not None and self.ctx.num_workers > 1
 
     # -- evaluation (ref: ModelEvaluator over checkpointed models) -------
 

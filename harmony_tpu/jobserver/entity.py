@@ -75,6 +75,8 @@ class DolphinJobEntity(JobEntity):
         metric_manager=None,
         pod_plan_sink=None,
         pod_eval_channel=None,
+        pod_unit_scope=None,
+        pod_unit_contended=None,
     ) -> None:
         super().__init__(config, chkp_root)
         self._global_tu = global_taskunit
@@ -89,6 +91,12 @@ class DolphinJobEntity(JobEntity):
         # lockstep).
         self._pod_plan_sink = pod_plan_sink
         self._pod_eval_channel = pod_eval_channel
+        # Cross-job unit protocol (EVERY participating process of a
+        # multi-process pod job — runtime/podunits.py): all of this job's
+        # global-dispatch regions run inside leader-granted units so
+        # overlapping tenants enqueue in one pod-wide order.
+        self._pod_unit_scope = pod_unit_scope
+        self._pod_unit_contended = pod_unit_contended
         self._chkp_mgr = None
         self._chkp_chain = None
         self._chkp_dir: Optional[str] = None
@@ -159,6 +167,18 @@ class DolphinJobEntity(JobEntity):
         return arrays
 
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
+        # Table creation dispatches multi-device init programs — under
+        # cross-job pod tenancy that region must hold a dispatch unit like
+        # any other (a concurrent tenant's enqueue interleaving with it
+        # would diverge across processes).
+        import contextlib
+
+        scope = (self._pod_unit_scope() if self._pod_unit_scope is not None
+                 else contextlib.nullcontext())
+        with scope:
+            self._setup_inner(master, executor_ids)
+
+    def _setup_inner(self, master: ETMaster, executor_ids: List[str]) -> None:
         self._master = master
         cfg = self.config
         data_axis = max(1, cfg.user.get("data_axis", 1))
@@ -281,8 +301,21 @@ class DolphinJobEntity(JobEntity):
             )
             epoch_hook = self._chkp_chain.on_epoch
         tm_hook = self._make_table_metrics_hook()
+        # Single-worker jobs have no MiniBatchController to feed the
+        # progress tracker; feed it from the epoch hook so the pod plan
+        # horizon check (schedule_pod_reshard) has a REAL observed floor
+        # instead of a vacuous 0. Deferrable (host accounting only): under
+        # multi-epoch windows the replay feeds it post-drain in order, so
+        # the floor lags at most one window — conservative, never ahead.
+        tracker_hook = None
+        if num_workers == 1:
+            _tracker, _wid0 = self.progress, f"{cfg.job_id}/w0"
+
+            def tracker_hook(e: int) -> None:
+                _tracker.on_batch(_wid0, (e + 1) * nb - 1)
+
         epoch_hook = self._compose_epoch_hooks(
-            epoch_hook, tm_hook, self._make_pod_plan_hook()
+            tracker_hook, epoch_hook, tm_hook, self._make_pod_plan_hook()
         )
         from harmony_tpu.jobserver import podplan
 
@@ -373,11 +406,17 @@ class DolphinJobEntity(JobEntity):
                     worker_id=wid,
                     num_workers=num_workers,
                 )
+                # Pod-unit jobs drop local TaskUnit admission: ordering
+                # AND cross-tenant fairness come from the pod arbiter (a
+                # local quorum wait inside a granted unit would deadlock
+                # the grant discipline the same way it would a turnstile
+                # turn).
                 taskunit = (
                     TaskUnitClient(cfg.job_id, wid, self._global_tu, self._local_tu)
                     if self._global_tu is not None
                     and self._local_tu is not None
                     and not pod_lockstep
+                    and self._pod_unit_scope is None
                     else None
                 )
                 worker = WorkerTasklet(
@@ -396,10 +435,8 @@ class DolphinJobEntity(JobEntity):
                     epoch_callback=(epoch_hook if idx == 0 else None),
                     global_init=(idx == 0),
                     post_init_barrier=init_barrier.wait,
-                    dispatch_turn=(
-                        None if turnstile is None
-                        else (lambda w=wid: turnstile.turn(w))
-                    ),
+                    dispatch_turn=self._make_dispatch_turn(turnstile, wid),
+                    pod_contended=self._pod_unit_contended,
                     pending_plan_epoch=(plan_epoch_fn if idx == 0 else None),
                     # the metrics hook only reads already-drained counters,
                     # so fused multi-epoch windows may defer it; checkpoint
@@ -476,6 +513,31 @@ class DolphinJobEntity(JobEntity):
             # can replay or delete it.
             out["model_chkp_root"] = self._chkp_dir
         return out
+
+    def _make_dispatch_turn(self, turnstile, wid: str):
+        """The worker's per-dispatch admission context: the job-internal
+        turnstile turn (multi-worker determinism), the cross-job pod unit
+        (share-all ordering), their COMPOSITION (turn outside, unit
+        inside — the turnstile serializes this process's threads so unit
+        sequence numbers stay deterministic), or None (single-process
+        single-thread jobs need neither)."""
+        import contextlib
+
+        scope = self._pod_unit_scope
+        if turnstile is None and scope is None:
+            return None
+        if turnstile is None:
+            return scope
+        if scope is None:
+            return lambda: turnstile.turn(wid)
+
+        @contextlib.contextmanager
+        def composed():
+            with turnstile.turn(wid):
+                with scope():
+                    yield
+
+        return composed
 
     _OPTIMIZERS = {
         "homogeneous": "harmony_tpu.optimizer:HomogeneousOptimizer",
@@ -821,6 +883,8 @@ class PregelJobEntity(JobEntity):
         metric_manager=None,  # no per-table optimizer loop for graphs
         pod_plan_sink=None,   # accepted for interface parity; graphs have
         pod_eval_channel=None,  # no model table to migrate/evaluate by plan
+        pod_unit_scope=None,    # pregel is NOT pod_ordered: multi-process
+        pod_unit_contended=None,  # pregel grants serialize at admission
     ) -> None:
         super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit

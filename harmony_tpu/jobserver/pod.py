@@ -25,25 +25,32 @@ routes through its MetricManager msg senders.
 
 Concurrent multi-tenancy (the reference's defining property —
 SchedulerImpl.java:28-66 runs every job on all executors, the
-GlobalTaskUnitScheduler interleaves them): jobs whose grants land on
-DISJOINT PROCESS SETS dispatch concurrently. Disjointness is what makes it
-safe — a process's per-device XLA streams execute in enqueue order, and a
-multi-process program blocks its process inside collectives until every
-participant arrives, so two multi-process jobs sharing processes can
-enqueue in different orders on different hosts and deadlock the pod
-(a distributed lock-order inversion). The admission rule in ``_dispatch``
-encodes exactly that hazard:
+GlobalTaskUnitScheduler interleaves them): the hazard is that a process's
+per-device XLA streams execute in enqueue order, and a multi-process
+program blocks its process inside collectives until every participant
+arrives — so two multi-process jobs sharing processes that enqueue in
+different orders on different hosts deadlock the pod (a distributed
+lock-order inversion). Two mechanisms make tenancy safe:
 
-  * disjoint process sets               -> always concurrent;
-  * both jobs confined to one process   -> concurrent even on the same
-    process (the in-process dispatch_scope already serializes their
-    multi-device programs; no cross-process wait exists);
-  * overlapping sets, either spans >1 process -> serialized.
+  * the CROSS-JOB UNIT PROTOCOL (runtime/podunits.py): every multi-process
+    dolphin job wraps its global-dispatch regions in leader-granted units;
+    the leader's arbiter never leaves units of two process-overlapping
+    jobs outstanding at once, so every process's cross-job enqueue order
+    IS the grant order. SHARE-ALL grants (every job on all executors — the
+    reference's default) therefore run truly concurrently, interleaved in
+    one pod-wide weighted-fair order;
+  * the admission rule in ``_dispatch`` for everything else: disjoint
+    process sets are always concurrent; single-process jobs are always
+    concurrent (their shared-device pairs live in one process, whose
+    dispatch lock enqueues each program atomically — no pair can invert);
+    a multi-process job OUTSIDE the unit protocol (pregel) serializes
+    against any other overlapping multi-process job, and a job waiting on
+    admission holds a FIFO ticket reserving its processes against later
+    arrivals so a stream of small jobs cannot starve it.
 
-The ``pod_carve`` scheduler (scheduler.ProcessCarveScheduler) produces
-process-disjoint grants by construction, so carved pods run N tenants
-truly concurrently across hosts; share_all pods degrade to the serialized
-behaviour (every grant spans every process).
+The ``pod_carve`` scheduler (scheduler.ProcessCarveScheduler) still
+produces process-disjoint grants for tenants that want isolation (no
+cross-job unit round-trips at all).
 
 Determinism contract (what makes per-job lockstep correct): entity
 construction is a pure function of the JobConfig, executor ids are
@@ -66,6 +73,12 @@ from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.joblog import job_logger, server_log
 from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
 from harmony_tpu.jobserver.server import JobServer
+from harmony_tpu.runtime.podunits import (
+    FollowerUnits,
+    PodUnitArbiter,
+    follower_client,
+    leader_client,
+)
 
 
 def _send(sock: socket.socket, msg: Dict[str, Any]) -> None:
@@ -108,7 +121,18 @@ class PodJobServer(JobServer):
         # (admission), the report buffer the reader threads fill, dead
         # followers, and the broken flag.
         self._pod_cond = threading.Condition()
-        self._active_procs: Dict[str, frozenset] = {}
+        #: job_id -> (process set, pod_ordered) — pod_ordered jobs run the
+        #: cross-job unit protocol and may overlap other pod_ordered jobs
+        self._active_procs: Dict[str, Tuple[frozenset, bool]] = {}
+        # FIFO admission tickets: a waiting job reserves its processes
+        # against LATER arrivals (ticketless candidates rank newest) so a
+        # stream of small jobs cannot starve a pod-spanning one
+        # (job_id -> (ticket, procs, pod_ordered) while waiting)
+        self._admission_ticket = 0
+        self._admission_waiting: Dict[str, Tuple[int, frozenset, bool]] = {}
+        # Cross-job dispatch-order arbiter (share-all multi-tenancy):
+        # see runtime/podunits.py
+        self.pod_units = PodUnitArbiter(send_to=self._send_to)
         self._reports: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._dead_followers: set = set()
         self._readers: List[threading.Thread] = []
@@ -197,11 +221,30 @@ class PodJobServer(JobServer):
             self._readers.append(t)
         return bound
 
+    def _mark_broken(self, reason: str) -> None:
+        """One poison path: record the reason, wake every pod waiter,
+        force-grant the unit arbiter (blocked dispatch threads proceed and
+        fail through normal error paths instead of wedging), and tell the
+        followers' unit trackers the same (best-effort — a dead socket's
+        reader poisons independently)."""
+        with self._pod_cond:
+            if self._pod_broken is None:
+                self._pod_broken = reason
+                server_log.error("pod broken: %s", reason)
+            self._pod_cond.notify_all()
+        self.pod_units.poison()
+        for pid in sorted(self._followers):
+            try:
+                self._send_to(pid, {"cmd": "TU_POISON"})
+            except OSError:
+                pass
+
     def _reader_loop(self, pid: int, f) -> None:
         """Owns all reads from follower ``pid``: routes JOB_DONE payloads
-        into the report buffer by (job_id, pid). EOF/read errors mark the
-        follower dead and (outside shutdown) poison the pod — a vanished
-        follower may be wedged in a collective no later job can satisfy."""
+        into the report buffer by (job_id, pid), and drives the unit
+        arbiter from TU_WAIT/TU_DONE. EOF/read errors mark the follower
+        dead and (outside shutdown) poison the pod — a vanished follower
+        may be wedged in a collective no later job can satisfy."""
         while True:
             try:
                 msg = _recv(f)
@@ -210,11 +253,22 @@ class PodJobServer(JobServer):
             if msg is None:
                 with self._pod_cond:
                     self._dead_followers.add(pid)
-                    if not self._pod_closing and self._pod_broken is None:
-                        self._pod_broken = f"follower {pid} connection lost"
-                        server_log.error("pod broken: %s", self._pod_broken)
+                    closing = self._pod_closing
                     self._pod_cond.notify_all()
+                self.pod_units.proc_done(pid)
+                if not closing:
+                    self._mark_broken(f"follower {pid} connection lost")
                 return
+            if msg.get("cmd") == "TU_WAIT":
+                self.pod_units.on_wait(
+                    str(msg.get("job_id")), int(msg.get("seq", 0)), pid
+                )
+                continue
+            if msg.get("cmd") == "TU_DONE":
+                self.pod_units.on_done(
+                    str(msg.get("job_id")), int(msg.get("seq", 0)), pid
+                )
+                continue
             if msg.get("cmd") in ("EVAL_COLLECTIVE_DONE",
                                   "EVAL_COLLECTIVE_READY"):
                 prefix = ("__evalc__"
@@ -223,6 +277,13 @@ class PodJobServer(JobServer):
                 with self._pod_cond:
                     self._reports[
                         (f"{prefix}{msg.get('job_id')}", pid)
+                    ] = msg
+                    self._pod_cond.notify_all()
+                continue
+            if msg.get("cmd") == "PROGRESS_REP":
+                with self._pod_cond:
+                    self._reports[
+                        (f"__prog__{msg.get('job_id')}", pid)
                     ] = msg
                     self._pod_cond.notify_all()
                 continue
@@ -301,7 +362,8 @@ class PodJobServer(JobServer):
     def _status(self) -> Dict[str, Any]:
         out = super()._status()
         with self._pod_cond:
-            active = {j: sorted(ps) for j, ps in self._active_procs.items()}
+            active = {j: sorted(ps)
+                      for j, (ps, _) in self._active_procs.items()}
             out["pod"] = {
                 "followers": sorted(self._followers),
                 "broken": self._pod_broken,
@@ -309,12 +371,40 @@ class PodJobServer(JobServer):
             }
         return out
 
-    def _conflicts_locked(self, procs: frozenset) -> Optional[str]:
+    @staticmethod
+    def _blocks(ps: frozenset, their_ordered: bool, procs: frozenset,
+                ordered: bool) -> bool:
+        """One conflict predicate for running AND waiting peers: overlap,
+        both multi-process, and not both under the unit arbiter."""
+        return bool(ps & procs) and len(ps) > 1 and len(procs) > 1 and not (
+            ordered and their_ordered
+        )
+
+    def _conflicts_locked(self, job_id: str, procs: frozenset,
+                          ordered: bool) -> Optional[str]:
         """Admission rule (module doc): a running job blocks ``procs`` iff
-        the sets overlap and either spans more than one process."""
-        for jid, ps in self._active_procs.items():
-            if ps & procs and (len(ps) > 1 or len(procs) > 1):
+        the sets overlap, BOTH span more than one process, and the pair is
+        not covered by the cross-job unit protocol (both pod_ordered).
+
+        Why single-process jobs never conflict: a deadlock needs two
+        multi-device programs enqueued in OPPOSITE orders on two shared
+        devices, and a single-process job's shared-device pairs all live
+        in one process, whose dispatch lock enqueues each program
+        atomically across its devices — every shared pair sees the same
+        order. FIFO fairness: a job WAITING on admission reserves its
+        processes against every LATER arrival it would conflict with —
+        including brand-new candidates that hold no ticket yet (they rank
+        newest) — so a stream of small jobs cannot starve a pod-spanning
+        one."""
+        for jid, (ps, their_ordered) in self._active_procs.items():
+            if self._blocks(ps, their_ordered, procs, ordered):
                 return jid
+        mine = self._admission_waiting.get(job_id)
+        my_ticket = mine[0] if mine is not None else float("inf")
+        for jid, (ticket, ps, their_ordered) in self._admission_waiting.items():
+            if (jid != job_id and ticket < my_ticket
+                    and self._blocks(ps, their_ordered, procs, ordered)):
+                return jid  # older waiter holds these processes
         return None
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
@@ -335,33 +425,39 @@ class PodJobServer(JobServer):
                 "which owns the plan channel",
             )
             return
-        if (config.optimizer and len(procs) > 1
-                and (config.num_workers or len(executor_ids)) != 1):
-            # schedule_pod_reshard serves single-dispatch-thread jobs;
-            # admitting this config would start an orchestrator whose
-            # every plan dies in the plan channel (a permanently dead
-            # optimizer loop) — fail it up front instead.
-            self._fail_job(
-                config,
-                f"optimizer={config.optimizer!r} on a multi-process grant "
-                "currently needs num_workers=1 (pod reshard plans apply at "
-                "the single dispatch thread's epoch hook)",
-            )
-            return
         # Multi-worker multi-process jobs are legal: the entity wires a
         # DispatchTurnstile so every process's worker threads enqueue
         # their global programs in the same deterministic order
         # (dolphin/master.py), and the per-process SSP controllers see
         # identical sync orders — identical decisions, no broadcast.
-        # Admission: wait until no running job conflicts (see module doc).
+        # Multi-process DOLPHIN jobs additionally run the cross-job unit
+        # protocol (runtime/podunits.py), so they may OVERLAP each other —
+        # the reference's share-all default. user.pod_isolated opts a job
+        # OUT (exclusive execution, serialized at admission — no unit
+        # round-trips, no co-tenant interleaving). Admission: wait until
+        # no running job conflicts (see _conflicts_locked); while waiting,
+        # the job's FIFO ticket reserves its processes against later
+        # arrivals.
+        pod_ordered = (config.app_type == "dolphin" and len(procs) > 1
+                       and not bool(config.user.get("pod_isolated")))
         admitted = False
         with self._pod_cond:
             while not self._pod_broken:
-                if self._conflicts_locked(procs) is None:
-                    self._active_procs[config.job_id] = procs
+                if self._conflicts_locked(
+                        config.job_id, procs, pod_ordered) is None:
+                    self._active_procs[config.job_id] = (procs, pod_ordered)
+                    self._admission_waiting.pop(config.job_id, None)
                     admitted = True
+                    self._pod_cond.notify_all()  # ticket holders re-check
                     break
+                if config.job_id not in self._admission_waiting:
+                    self._admission_ticket += 1
+                    self._admission_waiting[config.job_id] = (
+                        self._admission_ticket, procs, pod_ordered
+                    )
                 self._pod_cond.wait(timeout=1.0)
+            if not admitted:
+                self._admission_waiting.pop(config.job_id, None)
         if not admitted:
             self._fail_job(
                 config,
@@ -370,6 +466,10 @@ class PodJobServer(JobServer):
             )
             return
         t0 = time.monotonic()
+        if pod_ordered:
+            # the arbiter must know the job BEFORE any participant's first
+            # TU_WAIT can arrive (i.e. before RUN_JOB is sent)
+            self.pod_units.register_job(config.job_id, procs)
         try:
             participants = sorted(p for p in procs if p != 0)
             run_local = 0 in procs
@@ -389,6 +489,9 @@ class PodJobServer(JobServer):
                     "conf": config.to_dict(),
                     "executor_ids": list(executor_ids),
                     "chief_pid": min(procs),
+                    # Participate in the cross-job unit protocol (share-all
+                    # overlap safety — runtime/podunits.py).
+                    "pod_ordered": pod_ordered,
                     # Followers stage model checkpoints under the same root
                     # the leader would use, so carved jobs keep the
                     # checkpoint-chain + deferred-eval features.
@@ -409,10 +512,7 @@ class PodJobServer(JobServer):
                     # collectives need every participant) — fail the job
                     # and POISON the pod: followers that did get the
                     # message are now blocked in collectives.
-                    with self._pod_cond:
-                        self._pod_broken = f"RUN_JOB send failed: {e}"
-                        self._pod_cond.notify_all()
-                    server_log.error("pod broken: %s", self._pod_broken)
+                    self._mark_broken(f"RUN_JOB send failed: {e}")
                     self._fail_job(config, f"pod RUN_JOB send failed: {e}")
                     return
             if run_local:
@@ -430,14 +530,10 @@ class PodJobServer(JobServer):
                 # could never complete — poison the pod.
                 dead = [pid for pid, r in reports.items() if r.get("infra")]
                 if dead:
-                    with self._pod_cond:
-                        if self._pod_broken is None:
-                            self._pod_broken = (
-                                f"follower(s) {dead} never reported for "
-                                f"{config.job_id}"
-                            )
-                        self._pod_cond.notify_all()
-                    server_log.error("pod broken: %s", self._pod_broken)
+                    self._mark_broken(
+                        f"follower(s) {dead} never reported for "
+                        f"{config.job_id}"
+                    )
                 with self._pod_cond:  # concurrent dispatch threads trim too
                     self.pod_reports[config.job_id] = reports
                     while len(self.pod_reports) > 256:  # bound leader memory
@@ -449,6 +545,11 @@ class PodJobServer(JobServer):
             from harmony_tpu.jobserver import podplan
 
             podplan.clear(config.job_id)  # unapplied plans die with the job
+            if pod_ordered:
+                # after report collection: every participant's TU_DONEs
+                # precede its JOB_DONE on the same socket, so nothing of
+                # this job is still in flight at the arbiter
+                self.pod_units.deregister_job(config.job_id)
             with self._pod_cond:
                 # deregister so schedule_pod_reshard on a finished job
                 # raises KeyError instead of accreting stale plans
@@ -459,6 +560,30 @@ class PodJobServer(JobServer):
                 self._active_procs.pop(config.job_id, None)
                 self._pod_cond.notify_all()
 
+    def _query_remote_epoch(self, job_id: str, chief: int,
+                            timeout: float = 30.0) -> int:
+        """Ask the chief follower for its observed epoch floor (jobs the
+        leader does not participate in have no local entity to read). A
+        silent or unreachable chief FAILS the query — a guessed floor of 0
+        is exactly the divergence hazard the horizon check exists to
+        prevent."""
+        key = f"__prog__{job_id}"
+        try:
+            self._send_to(chief, {"cmd": "PROGRESS_REQ", "job_id": job_id})
+        except OSError as e:
+            raise RuntimeError(
+                f"progress query to follower {chief} failed: {e}"
+            ) from None
+        rep = self._wait_report(key, chief, time.monotonic() + timeout)
+        with self._pod_cond:
+            self._reports.pop((key, chief), None)
+        if rep is None:
+            raise RuntimeError(
+                f"follower {chief} did not answer the progress query for "
+                f"{job_id}; rejecting the plan (no observed epoch floor)"
+            )
+        return int(rep.get("epoch", 0))
+
     def schedule_pod_reshard(
         self, job_id: str, src: str, dst: str, num_blocks: int, epoch: int
     ) -> None:
@@ -467,8 +592,9 @@ class PodJobServer(JobServer):
         process — leader included — applies it at its chief worker's
         epoch-``epoch`` hook, the deterministic lockstep point (see
         jobserver/podplan.py, including the multi-epoch-lead contract).
-        Single-dispatch-thread jobs only: a turnstiled multi-worker job's
-        hook runs outside admission turns."""
+        Multi-worker jobs apply inside the chief's turnstile turn, so any
+        worker count is legal (ref: PlanExecutorImpl.java:41-130 — plans
+        apply regardless of worker count)."""
         from harmony_tpu.dolphin.worker import WorkerTasklet
         from harmony_tpu.jobserver import podplan
 
@@ -477,23 +603,20 @@ class PodJobServer(JobServer):
         if info is None:
             raise KeyError(f"unknown (or finished) pod job {job_id}")
         participants, workers = info
-        if workers != 1:
-            raise ValueError(
-                f"pod reshard plans need num_workers=1 jobs (got {workers}):"
-                " the epoch hook dispatches outside turnstile turns"
-            )
-        # Enforce the multi-epoch-lead contract structurally where the
-        # leader can observe progress: the window decision COVERING the
-        # plan epoch must happen after every process holds the plan, so
-        # the epoch needs at least a full window horizon of lead. (For
-        # jobs whose progress the leader cannot observe — remote-only,
-        # single-worker trackers — the observed epoch floor is 0, which
-        # makes the check conservative at job start and advisory later.)
+        # Enforce the multi-epoch-lead contract against an OBSERVED epoch
+        # floor: the window decision COVERING the plan epoch must happen
+        # after every process holds the plan, so the epoch needs at least
+        # a full window horizon of lead. The floor comes from the leader's
+        # own entity when it participates (its tracker is fed per epoch
+        # for every worker count), else from the chief follower — queried,
+        # never guessed.
         with self._lock:
             ent = self._entities.get(job_id)
         cur = 0
         if ent is not None and getattr(ent, "progress", None) is not None:
             cur = ent.progress.starting_epoch()
+        elif participants:
+            cur = self._query_remote_epoch(job_id, min(participants))
         horizon = WorkerTasklet.EPOCH_WINDOW + 1
         if epoch < cur + horizon:
             raise ValueError(
@@ -512,11 +635,7 @@ class PodJobServer(JobServer):
             # a PARTIALLY delivered plan is the divergence hazard itself:
             # some processes would apply the move, others never — poison
             # like the RUN_JOB path so nothing later wedges silently
-            with self._pod_cond:
-                if self._pod_broken is None:
-                    self._pod_broken = f"PLAN broadcast failed: {e}"
-                self._pod_cond.notify_all()
-            server_log.error("pod broken: %s", self._pod_broken)
+            self._mark_broken(f"PLAN broadcast failed: {e}")
             raise
         podplan.schedule(job_id, plan)
 
@@ -536,6 +655,14 @@ class PodJobServer(JobServer):
             extras: Dict[str, Any] = {
                 "pod_plan_sink": self.schedule_pod_reshard,
             }
+            if (config.app_type == "dolphin"
+                    and not bool(config.user.get("pod_isolated"))):
+                # Leader-local leg of the cross-job unit protocol: the
+                # entity wraps every global-dispatch region in a unit so
+                # overlapping tenants enqueue in the arbiter's one order.
+                client = leader_client(self.pod_units, config.job_id)
+                extras["pod_unit_scope"] = client.scope
+                extras["pod_unit_contended"] = client.contended
             if workers == 1:
                 # The collective deferred eval stays single-dispatch-
                 # thread-only (the checkpoint chain it replays is).
@@ -570,11 +697,7 @@ class PodJobServer(JobServer):
                 # a PARTIAL GO is unrecoverable: recipients enter
                 # collectives the rest never join — poison, and the
                 # caller must NOT enter its own collectives
-                with self._pod_cond:
-                    if self._pod_broken is None:
-                        self._pod_broken = f"EVAL_GO send failed: {e}"
-                    self._pod_cond.notify_all()
-                server_log.error("pod broken: %s", self._pod_broken)
+                self._mark_broken(f"EVAL_GO send failed: {e}")
                 raise RuntimeError(
                     f"EVAL_GO broadcast failed: {e}"
                 ) from None
@@ -641,14 +764,9 @@ class PodJobServer(JobServer):
                 # record the one diagnosable fact and poison.
                 why = ("never acked" if rep is None
                        else f"failed: {rep.get('error')}")
-                with self._pod_cond:
-                    if self._pod_broken is None:
-                        self._pod_broken = (
-                            f"collective eval for {job_id}: follower "
-                            f"{pid} {why}"
-                        )
-                    self._pod_cond.notify_all()
-                server_log.error("pod broken: %s", self._pod_broken)
+                self._mark_broken(
+                    f"collective eval for {job_id}: follower {pid} {why}"
+                )
         with self._pod_cond:
             for pid in participants:
                 self._reports.pop((f"__evalc__{job_id}", pid), None)
@@ -773,7 +891,10 @@ class PodFollower:
         self._sock.settimeout(None)  # RUN_JOB may arrive much later
         self._file = self._sock.makefile("r")
         self._send_lock = threading.Lock()
+        self._pod_units = FollowerUnits(report=self._report)
         self._job_threads: List[threading.Thread] = []
+        #: job_id -> live JobEntity, for leader progress queries
+        self._entities: Dict[str, Any] = {}
         self._deferred_evals: Dict[str, Any] = {}  # job_id -> closure
         # job_id -> (config, executor_ids, chkp_root): what the collective
         # deferred eval rebuilds its evaluator from at shutdown
@@ -825,10 +946,30 @@ class PodFollower:
                         break  # leader gone; nothing to tell it
                 self._sock.close()
                 return
+            if msg.get("cmd") == "TU_GRANT":
+                self._pod_units.on_grant(
+                    str(msg.get("job_id")), int(msg.get("seq", 0)),
+                    bool(msg.get("contended", False)),
+                )
+                continue
+            if msg.get("cmd") == "TU_POISON":
+                self._pod_units.on_poison()
+                continue
             if msg.get("cmd") == "PLAN":
                 from harmony_tpu.jobserver import podplan
 
                 podplan.schedule(msg["job_id"], msg["plan"])
+                continue
+            if msg.get("cmd") == "PROGRESS_REQ":
+                # the leader's observed-epoch-floor query for plan
+                # validation (schedule_pod_reshard on remote-only jobs)
+                jid = str(msg.get("job_id"))
+                ent = self._entities.get(jid)
+                ep = 0
+                if ent is not None and getattr(ent, "progress", None) is not None:
+                    ep = ent.progress.starting_epoch()
+                self._report({"cmd": "PROGRESS_REP", "job_id": jid,
+                              "epoch": int(ep)})
                 continue
             if msg.get("cmd") == "EVAL_COLLECTIVE":
                 # the leader's deferred model eval is a lockstep collective
@@ -914,6 +1055,13 @@ class PodFollower:
         report: Dict[str, Any] = {
             "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,
         }
+        unit_extras: Dict[str, Any] = {}
+        if msg.get("pod_ordered"):
+            # this process's leg of the cross-job unit protocol (the
+            # leader's arbiter orders overlapping tenants' dispatches)
+            client = follower_client(self._pod_units, config.job_id)
+            unit_extras = {"pod_unit_scope": client.scope,
+                           "pod_unit_contended": client.contended}
         entity = None
         try:
             missing = set(executor_ids) - set(self.master.executor_ids())
@@ -933,7 +1081,9 @@ class PodFollower:
                 metric_sink=self.metrics.on_metric,
                 metric_manager=self.metrics,
                 chkp_root=msg.get("chkp_root"),
+                **unit_extras,
             )
+            self._entities[config.job_id] = entity
             entity.setup(self.master, executor_ids)
             result = entity.run()
             if chief:
@@ -966,4 +1116,6 @@ class PodFollower:
                     pass
             report["ok"] = False
             report["error"] = f"{type(e).__name__}: {e}"
+        self._entities.pop(config.job_id, None)
+        self._pod_units.forget(config.job_id)
         self._report(report)

@@ -42,6 +42,10 @@ jax.config.update("jax_platforms", "cpu")
 _SLOW_TESTS = {
     "test_multihost.py::test_two_process_distributed_job",
     "test_multihost.py::test_pod_concurrent_carved_tenants",
+    "test_multihost.py::test_pod_share_all_overlapping_tenants",
+    "test_multihost.py::test_pod_reshard_multiworker_ssp",
+    "test_multihost.py::test_pod_remote_only_plan_epoch_floor",
+    "test_multihost.py::test_pod_admission_fifo_no_starvation",
     "test_multihost.py::test_pod_checkpoint_restore_cross_topology",
     "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent",
     "test_multihost.py::test_pod_live_reshard_across_process_subsets",

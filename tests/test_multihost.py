@@ -262,6 +262,83 @@ def test_pod_concurrent_carved_tenants():
         server.shutdown(timeout=60)
 
 
+def test_pod_share_all_overlapping_tenants():
+    """SHARE-ALL multi-tenancy on a pod (round-3 verdict item 1 — the last
+    reference capability with no pod equivalent): with the DEFAULT
+    scheduler, two jobs both span the SAME 2-process 8-device mesh and
+    train CONCURRENTLY. Safety comes from the cross-job unit protocol
+    (runtime/podunits.py): the leader grants every multi-process job's
+    dispatch regions in one pod-wide order, so overlapping tenants'
+    enqueues never invert across processes (the hazard that previously
+    forced the admission rule to serialize them — pod.py). Matches:
+    SchedulerImpl.java:28-66 (every job on ALL executors) +
+    GlobalTaskUnitScheduler.java:29-92 (one global unit order). Asserts:
+      * both jobs are ACTIVE at once on identical process sets, and their
+        dispatch walls overlap — true concurrency, not queueing;
+      * each job's loss series equals the same config trained ALONE on an
+        8-device single-process server — interleaving changes timing,
+        never semantics;
+      * every process reports identical series (SPMD lockstep held under
+        cross-job interleaving)."""
+    pod = PodHarness(2, 4)
+    try:
+        pod.wait_ready()
+        deadline = time.monotonic() + 300
+        cfg_a = _mlr_job("share-a", seed=11, epochs=4)
+        cfg_b = _mlr_job("share-b", seed=12, epochs=4)
+        for cfg in (cfg_a, cfg_b):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        saw_concurrent = False
+        while time.monotonic() < deadline:
+            status = pod.sender.send_status_command()
+            active = status.get("pod", {}).get("active", {})
+            if len(active) == 2:
+                saw_concurrent = True
+                # share_all: BOTH jobs hold BOTH processes simultaneously
+                assert set(active["share-a"]) == set(active["share-b"]) == {
+                    0, 1}, active
+            if not status.get("running"):
+                break
+            time.sleep(0.1)
+        result = pod.finish()
+    finally:
+        pod.kill()
+    walls = result["job_walls"]
+    overlap = min(walls["share-a"][1], walls["share-b"][1]) - max(
+        walls["share-a"][0], walls["share-b"][0]
+    )
+    assert saw_concurrent and overlap > 0, (walls, saw_concurrent)
+    pod_losses = {}
+    for jid in ("share-a", "share-b"):
+        res = result["local_results"][jid]
+        assert "error" not in res, res
+        (losses,) = [w["losses"] for w in res.values()
+                     if isinstance(w, dict) and "losses" in w]
+        assert len(losses) == 4 and losses[-1] < losses[0], (jid, losses)
+        pod_losses[jid] = losses
+        # the follower ran the same interleaved schedule to the same numbers
+        follower = result["pod_reports"][jid]["1"]
+        assert follower["ok"], follower
+        assert [round(x, 5)
+                for x in follower["workers"][f"{jid}/w0"]["losses"]] == [
+            round(x, 5) for x in losses], jid
+    # isolated baseline: same configs, one at a time, single-process server
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=8)
+    server.start()
+    try:
+        for jid, cfg in (("share-a", cfg_a), ("share-b", cfg_b)):
+            res = server.submit(cfg).result(timeout=240)
+            (iso,) = [w["losses"] for w in res["workers"].values()]
+            assert [round(float(x), 5) for x in iso] == [
+                round(float(x), 5) for x in pod_losses[jid]
+            ], (jid, iso, pod_losses[jid])
+    finally:
+        server.shutdown(timeout=60)
+
+
 CHKP_WORKER = os.path.join(os.path.dirname(__file__), "chkp_pod_worker.py")
 
 
@@ -386,6 +463,176 @@ def test_pod_plan_driven_migration_mid_training():
     assert [round(x, 5) for x in
             follower["workers"]["pod-plan/w0"]["losses"]] == [
         round(x, 5) for x in losses]
+
+
+def test_pod_reshard_multiworker_ssp():
+    """Pod reshard plans for MULTI-worker jobs (round-3 verdict item 4;
+    ref: PlanExecutorImpl.java:41-130 — plans apply regardless of worker
+    count): a 2-worker SSP job spans the 2-process share_all mesh; an
+    operator plan drains executor-4 at epoch 9. The move applies inside
+    the chief's turnstile turn — the deterministic cross-process point —
+    so every process reshards at the same cycle slot, and the loss series
+    still matches the force_lockstep single-process baseline WITHOUT any
+    plan (block moves change placement, never values; the balanced turn
+    schedule is identical with and without the callback's move)."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    EPOCHS = 12
+
+    def cfg_of(force_lockstep: bool) -> JobConfig:
+        return JobConfig(
+            job_id="pod-mw-plan", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=EPOCHS, num_mini_batches=4, clock_slack=1,
+                app_params={"lag_sec": 0.25, "num_classes": 4,
+                            "num_features": 16, "features_per_partition": 4,
+                            "step_size": 0.1},
+            ),
+            num_workers=2,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 21},
+                  **({"force_lockstep": True} if force_lockstep else {})},
+        )
+
+    pod = PodHarness(2, 4)
+    try:
+        pod.wait_ready()
+        resp = pod.sender.send_job_submit_command(cfg_of(False))
+        assert resp.get("ok"), resp
+        deadline = time.monotonic() + 120
+        while True:
+            r = pod.sender.send_pod_reshard_command(
+                "pod-mw-plan", "executor-4", "executor-0",
+                num_blocks=1024, epoch=9,  # >= observed floor + horizon
+            )
+            if r.get("ok"):
+                break
+            assert time.monotonic() < deadline, r
+            time.sleep(0.1)
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-mw-plan"]
+    assert "error" not in res, res
+    (applied,) = res["applied_plans"]
+    assert applied["epoch"] == 9 and applied["moved"] > 0, applied
+    assert applied["owners_after"] == 7, applied
+    losses = {wid: w["losses"] for wid, w in res.items()
+              if isinstance(w, dict) and "losses" in w}
+    assert set(losses) == {"pod-mw-plan/w0", "pod-mw-plan/w1"}
+    for wid, series in losses.items():
+        assert len(series) == EPOCHS and series[-1] < series[0], (wid, series)
+        follower = result["pod_reports"]["pod-mw-plan"]["1"]
+        assert [round(x, 5)
+                for x in follower["workers"][wid]["losses"]] == [
+            round(x, 5) for x in series], wid
+    # force_lockstep single-process baseline, NO plan: identical numbers
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=8)
+    server.start()
+    try:
+        iso = server.submit(cfg_of(True)).result(timeout=240)
+        for wid, series in losses.items():
+            assert [round(float(x), 5)
+                    for x in iso["workers"][wid]["losses"]] == [
+                round(x, 5) for x in series
+            ], (wid, iso["workers"][wid]["losses"], series)
+    finally:
+        server.shutdown(timeout=60)
+
+
+def test_pod_remote_only_plan_epoch_floor():
+    """Late plans on a REMOTE-only job are REJECTED (round-3 verdict item
+    8 / advisor item 2 — the horizon check was vacuous when the leader
+    could not observe progress): schedule_pod_reshard now queries the
+    chief follower's observed epoch (PROGRESS_REQ/REP) and validates the
+    window-horizon lead against that floor. The probe plan moves 0 blocks,
+    so early acceptances (floor still 0) are harmless; the test passes
+    when the floor RISES and the same plan epoch starts being rejected."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    pod = PodHarness(2, 2, scheduler="pod_carve:1")
+    try:
+        pod.wait_ready()
+        # floor-a occupies the leader's process so floor-b (the target)
+        # lands wholly on the follower — no leader-local entity to read
+        cfg_a = _mlr_job("floor-a", seed=1, epochs=2)
+        cfg_b = JobConfig(
+            job_id="floor-b", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=40, num_mini_batches=2, clock_slack=1,
+                app_params={"lag_sec": 0.3, "num_classes": 4,
+                            "num_features": 16, "features_per_partition": 4,
+                            "step_size": 0.1},
+            ),
+            num_workers=2,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 22}},
+        )
+        for cfg in (cfg_a, cfg_b):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        rejected = None
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            r = pod.sender.send_pod_reshard_command(
+                "floor-b", "executor-2", "executor-3",
+                num_blocks=0, epoch=9,  # passes ONLY while the floor is 0
+            )
+            if not r.get("ok") and "window horizon" in r.get("error", ""):
+                rejected = r
+                break
+            time.sleep(0.2)
+        result = pod.finish(timeout=240)
+    finally:
+        pod.kill()
+    # the queried follower floor rose past 0 and enforced the horizon
+    assert rejected is not None, "late plan was never rejected"
+    assert "window horizon" in rejected["error"], rejected
+    res = result["local_results"]["floor-b"]
+    assert "error" not in res, res
+
+
+def test_pod_admission_fifo_no_starvation():
+    """Admission fairness (round-3 verdict item 6): serialized pod-
+    spanning jobs (user.pod_isolated opts out of the unit protocol into
+    exclusive execution) admit in FIFO ticket order — a waiting job
+    reserves its processes against every later arrival it conflicts with,
+    so a stream of later jobs cannot starve it. Five isolated spanning
+    jobs submitted R, W, X1, X2, X3 must START in exactly that order."""
+    pod = PodHarness(2, 2)
+    try:
+        pod.wait_ready()
+        names = ["fifo-r", "fifo-w", "fifo-x1", "fifo-x2", "fifo-x3"]
+        for i, jid in enumerate(names):
+            cfg = _mlr_job(jid, seed=30 + i, epochs=1)
+            cfg.params.num_mini_batches = 2
+            cfg.user["pod_isolated"] = True
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+            # let the dispatch thread take its admission ticket before the
+            # next submission's thread can race it to the conflict check
+            time.sleep(0.3)
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    walls = result["job_walls"]
+    starts = [walls[j][0] for j in names]
+    assert starts == sorted(starts), dict(zip(names, starts))
+    # serialized: no two isolated jobs ever overlapped
+    for a in range(len(names)):
+        for b in range(a + 1, len(names)):
+            wa, wb = walls[names[a]], walls[names[b]]
+            assert min(wa[1], wb[1]) <= max(wa[0], wb[0]) + 1e-6, (
+                names[a], names[b], wa, wb)
+    for jid in names:
+        res = result["local_results"][jid]
+        assert "error" not in res, (jid, res)
 
 
 def test_pod_collective_deferred_eval(tmp_path):
