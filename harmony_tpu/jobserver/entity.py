@@ -691,17 +691,51 @@ class DolphinJobEntity(JobEntity):
                 # clamp to what src actually owns (deterministic: every
                 # process sees the same block map) so "drain" plans can
                 # just pass a large count
-                owned = self._handle.block_manager.block_counts().get(
-                    p["src"], 0
-                )
+                counts = self._handle.block_manager.block_counts()
+                owned = counts.get(p["src"], 0)
                 n = min(int(p["num_blocks"]), owned)
+                skipped = None
+                if n:
+                    # Process-set guard: a plan that would change WHICH
+                    # PROCESSES own blocks mid-training is skipped (every
+                    # process computes the same decision from the shared
+                    # block map). A worker whose process left the table
+                    # mesh would keep dispatching programs over devices
+                    # it no longer shares — on multi-controller runtimes
+                    # that wedges collective-context setup. Executor-level
+                    # moves (including cross-process grows while the
+                    # process still owns other blocks) are unrestricted;
+                    # table-level process grow/shrink outside a training
+                    # loop is fully supported (cross_set_reshard).
+                    def owner_procs(cmap):
+                        return {
+                            self._master.executor(e).device.process_index
+                            for e, c in cmap.items() if c > 0
+                        }
+
+                    after = dict(counts)
+                    after[p["src"]] = owned - n
+                    after[p["dst"]] = after.get(p["dst"], 0) + n
+                    if owner_procs(after) != owner_procs(counts):
+                        from harmony_tpu.jobserver.joblog import job_logger
+
+                        skipped = "process-set change mid-training"
+                        job_logger(job_id).warning(
+                            "pod plan %s->%s (%d blocks) skipped: it "
+                            "would change the owning PROCESS set of a "
+                            "running job", p["src"], p["dst"], n,
+                        )
+                        n = 0
                 if n:
                     self._handle.move_blocks(p["src"], p["dst"], n)
-                self._applied_plans.append({
+                entry = {
                     "epoch": epoch_idx, "src": p["src"], "dst": p["dst"],
                     "moved": n,
                     "owners_after": len(self._handle.owning_executors()),
-                })
+                }
+                if skipped:
+                    entry["skipped"] = skipped
+                self._applied_plans.append(entry)
 
         return hook
 

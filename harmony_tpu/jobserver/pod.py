@@ -61,6 +61,7 @@ issue the same global computations in the same order.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -133,6 +134,17 @@ class PodJobServer(JobServer):
         # Cross-job dispatch-order arbiter (share-all multi-tenancy):
         # see runtime/podunits.py
         self.pod_units = PodUnitArbiter(send_to=self._send_to)
+        # Liveness, not duration: followers HEARTBEAT every few seconds,
+        # and the leader declares a follower infra-dead only on heartbeat
+        # SILENCE — never because a healthy job ran long (real training
+        # runs hours; the reference's driver waits on tasklet status
+        # indefinitely, TaskletRepresenter.java).
+        self.hb_timeout = float(os.environ.get("HARMONY_POD_HB_TIMEOUT",
+                                               "60"))
+        self._last_seen: Dict[int, float] = {}
+        #: pid -> set of job ids the follower's latest heartbeat listed —
+        #: catches a job thread that died without ever reporting
+        self._hb_jobs: Dict[int, set] = {}
         self._reports: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._dead_followers: set = set()
         self._readers: List[threading.Thread] = []
@@ -211,6 +223,7 @@ class PodJobServer(JobServer):
             conn.settimeout(None)  # the reader thread owns this socket now
             self._followers[pid] = (conn, f)
             self._send_locks[pid] = threading.Lock()
+            self._last_seen[pid] = time.monotonic()
             server_log.info("pod follower %d joined from %s", pid, addr)
         for pid, (conn, f) in sorted(self._followers.items()):
             t = threading.Thread(
@@ -259,6 +272,15 @@ class PodJobServer(JobServer):
                 if not closing:
                     self._mark_broken(f"follower {pid} connection lost")
                 return
+            # ANY traffic proves the process alive; HEARTBEATs exist so a
+            # follower busy inside a long job still produces traffic
+            with self._pod_cond:
+                self._last_seen[pid] = time.monotonic()
+                if msg.get("cmd") == "HEARTBEAT":
+                    self._hb_jobs[pid] = set(msg.get("jobs", []))
+                    self._pod_cond.notify_all()
+            if msg.get("cmd") == "HEARTBEAT":
+                continue
             if msg.get("cmd") == "TU_WAIT":
                 self.pod_units.on_wait(
                     str(msg.get("job_id")), int(msg.get("seq", 0)), pid
@@ -316,7 +338,9 @@ class PodJobServer(JobServer):
         self, job_id: str, pid: int, deadline: float
     ) -> Optional[Dict[str, Any]]:
         """Block until follower ``pid`` reports for ``job_id`` (reader
-        threads fill the buffer); None on death/timeout."""
+        threads fill the buffer); None on death/timeout. For job-duration
+        waits use :meth:`_wait_report_live` — this bounded variant serves
+        short protocol acks (eval readiness, progress queries)."""
         key = (job_id, pid)
         with self._pod_cond:
             while key not in self._reports:
@@ -328,22 +352,60 @@ class PodJobServer(JobServer):
                 self._pod_cond.wait(timeout=min(remaining, 5.0))
             return self._reports[key]
 
+    def _wait_report_live(
+        self, job_id: str, pid: int
+    ) -> Optional[Dict[str, Any]]:
+        """Block until follower ``pid`` reports for ``job_id``, as long as
+        the follower stays LIVE. None only when (a) the connection is
+        lost, (b) heartbeats go silent past ``hb_timeout``, or (c) fresh
+        heartbeats stop LISTING the job for ``hb_timeout`` without a
+        report arriving — a job thread that died without reporting. A
+        healthy job may run for hours without tripping anything (the old
+        fixed 600s wall declared long remote jobs infra-dead and poisoned
+        the pod); a job thread WEDGED in a collective keeps being listed
+        and is waited on indefinitely — reference parity (the driver
+        waits on tasklet status indefinitely, TaskletRepresenter.java)."""
+        key = (job_id, pid)
+        missing_since: Optional[float] = None
+        with self._pod_cond:
+            while key not in self._reports:
+                if pid in self._dead_followers:
+                    return None
+                now = time.monotonic()
+                last = self._last_seen.get(pid, 0.0)
+                if now - last > self.hb_timeout:
+                    return None
+                hb = self._hb_jobs.get(pid)
+                if hb is not None and job_id not in hb:
+                    # generous grace: RUN_JOB delivery and the follower's
+                    # registration race the beacon, and a JOB_DONE may be
+                    # in flight right behind a beat that dropped the job
+                    if missing_since is None:
+                        missing_since = now
+                    elif now - missing_since > self.hb_timeout:
+                        return None
+                else:
+                    missing_since = None
+                self._pod_cond.wait(timeout=2.0)
+            return self._reports[key]
+
     def _collect_reports(
-        self, job_id: str, participants: List[int], timeout: float
+        self, job_id: str, participants: List[int]
     ) -> Dict[int, Dict[str, Any]]:
-        """One JOB_DONE per participant; a silent participant is recorded
-        as an infra-error entry rather than wedging the leader forever."""
-        deadline = time.monotonic() + timeout
+        """One JOB_DONE per participant; a DEAD-or-silent participant is
+        recorded as an infra-error entry rather than wedging the leader
+        forever. Liveness-gated, not duration-gated: heartbeats keep the
+        wait open for as long as the job actually runs."""
         out: Dict[int, Dict[str, Any]] = {}
         for pid in participants:
-            rep = self._wait_report(job_id, pid, deadline)
+            rep = self._wait_report_live(job_id, pid)
             if rep is None:
                 # "infra" marks leader-observed transport failures
-                # (timeout/death) — the follower is gone or wedged — as
+                # (silence/death) — the follower is gone or wedged — as
                 # opposed to a follower-REPORTED job error, after which
                 # the follower is alive and serviceable.
                 why = ("follower lost" if pid in self._dead_followers
-                       else "report timeout")
+                       else "heartbeat silence")
                 out[pid] = {"ok": False, "infra": True, "error": why}
             else:
                 out[pid] = rep
@@ -522,9 +584,7 @@ class PodJobServer(JobServer):
                 # participant's report is the job result.
                 self._resolve_remote(config, participants)
             if participants:
-                reports = self._collect_reports(
-                    config.job_id, participants, timeout=600.0
-                )
+                reports = self._collect_reports(config.job_id, participants)
                 # A participant that never reported is wedged (likely stuck
                 # in a collective): any later job overlapping its process
                 # could never complete — poison the pod.
@@ -783,13 +843,12 @@ class PodJobServer(JobServer):
         chief = min(participants)
         t0 = time.monotonic()
         try:
-            rep = self._wait_report(
-                config.job_id, chief, time.monotonic() + 600.0
-            )
+            rep = self._wait_report_live(config.job_id, chief)
             if rep is None:
                 raise RuntimeError(
                     f"chief follower {chief} never reported for "
-                    f"{config.job_id}"
+                    f"{config.job_id} (connection lost or heartbeat "
+                    "silence)"
                 )
             if not rep.get("ok"):
                 raise RuntimeError(
@@ -908,6 +967,31 @@ class PodFollower:
         self.master.add_executors(num_executors)
         self.metrics = MetricManager()
         self.metrics.start_collection()
+        # Liveness beacon: the leader gates its job-report waits on
+        # heartbeat freshness (never job duration), so a follower whose
+        # job threads are busy inside hours-long collectives must still
+        # produce traffic. Dedicated daemon thread; dies silently with
+        # the socket at shutdown.
+        self._hb_period = float(os.environ.get("HARMONY_POD_HB_PERIOD",
+                                               "5"))
+        self._hb_stop = threading.Event()
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name=f"pod-hb-{pid}").start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self._hb_period):
+            try:
+                jobs = sorted(self._entities)
+            except RuntimeError:
+                # a job thread resized the dict mid-iteration; the next
+                # beat catches up — the beacon must NEVER die while the
+                # process is healthy (its silence poisons the pod)
+                continue
+            try:
+                self._report({"cmd": "HEARTBEAT", "pid": self.pid,
+                              "jobs": jobs})
+            except OSError:
+                return  # leader gone; the main loop handles shutdown
 
     def _report(self, payload: Dict[str, Any]) -> None:
         with self._send_lock:
@@ -944,6 +1028,7 @@ class PodFollower:
                                       "result": result})
                     except OSError:
                         break  # leader gone; nothing to tell it
+                self._hb_stop.set()
                 self._sock.close()
                 return
             if msg.get("cmd") == "TU_GRANT":

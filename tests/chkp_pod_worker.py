@@ -48,6 +48,33 @@ def verify_dense_blocks(table, errors, tag):
     return sorted(mine)
 
 
+def verify_dense_shards(table, errors, tag):
+    """Check EVERY addressable shard byte (no lowest-owner dedup): proves
+    THIS process's devices physically hold correct values — the grow
+    leg's point is that a data-less process's devices received the bytes.
+    Returns the number of (block, shard) rows checked."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    part = table.spec.partitioner
+    bs = table.spec.block_size
+    checked = 0
+    for shard in table.array.addressable_shards:
+        sl = shard.index[0] if shard.index else slice(None)
+        start = sl.start or 0
+        data = np.asarray(shard.data)
+        for i in range(data.shape[0]):
+            bid = start + i
+            for off in range(bs):
+                key = int(np.asarray(part.key_of(
+                    jnp.asarray(bid), jnp.asarray(off))))
+                if key < DENSE_CAP and not np.allclose(
+                        data[i, off], dense_value(key)):
+                    errors.append(f"{tag}: shard block {bid} off {off}")
+            checked += 1
+    return checked
+
+
 def main() -> None:
     phase, coordinator, nprocs, pid, root = (
         sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
@@ -104,14 +131,20 @@ def main() -> None:
         report["owners_after"] = len(dh.owning_executors())
         report["blocks_shrunk"] = verify_dense_blocks(
             dh.table, errors, "shrunk")
-        # growing back onto processes that hold none of the data must
-        # reject LOUDLY, pointing at the cross-topology checkpoint route
-        # (a wedge or silent corruption here would take down the pod)
-        try:
-            dh.rebalance(execs)
-            report["grow_error"] = None
-        except NotImplementedError as e:
-            report["grow_error"] = str(e)[:240]
+        # GROW back onto processes that hold none of the data — live,
+        # symmetric to the shrink (ref MigrationExecutor.java:107-253:
+        # blocks move in either direction on a running table). The bytes
+        # ride the internal staging exchange (cross_set_reshard's fenced
+        # publish/read), NOT an operator-visible checkpoint round-trip.
+        dh.rebalance(execs)
+        report["owners_regrown"] = len(dh.owning_executors())
+        report["blocks_regrown"] = verify_dense_blocks(
+            dh.table, errors, "regrown")
+        # raw-shard verification: THIS process's devices physically hold
+        # the regrown bytes (the deduped per-block view attributes
+        # replicated blocks to the lowest process only)
+        report["shards_regrown_checked"] = verify_dense_shards(
+            dh.table, errors, "regrown-shards")
         report["ok"] = not errors
         report["errors"] = errors[:5]
     elif phase == "save":

