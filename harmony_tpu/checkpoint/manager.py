@@ -92,6 +92,10 @@ class CheckpointInfo:
     sampling_ratio: float
     committed: bool
     created_at: float
+    #: application-level tag (e.g. the chain's {"epoch": N}) — optional,
+    #: absent in older manifests; the resume path derives the restart
+    #: epoch from it instead of guessing from id counters
+    app_meta: Optional[Dict[str, float]] = None
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -171,9 +175,17 @@ class CheckpointManager:
         self._lock = threading.Lock()
         self._counter = 0
 
+    def advance_counter(self, base: int) -> None:
+        """Start id counters past ``base`` — a RESUMED job's chain manager
+        continues the original chain's numbering, keeping chain ids (and
+        the counter->epoch mapping a later resume derives) monotonic."""
+        with self._lock:
+            self._counter = max(self._counter, int(base))
+
     # -- write path ------------------------------------------------------
 
-    def _snapshot(self, handle: TableHandle, sampling_ratio: float):
+    def _snapshot(self, handle: TableHandle, sampling_ratio: float,
+                  app_meta: Optional[Dict[str, float]] = None):
         """The synchronous prefix shared by sync and async checkpointing:
         id allocation + an atomic device-side snapshot (O(dispatch); the
         table lock is held for microseconds)."""
@@ -197,6 +209,7 @@ class CheckpointManager:
             sampling_ratio=sampling_ratio,
             committed=False,
             created_at=time.time(),
+            app_meta=app_meta,
         )
         return chkp_id, snap, info
 
@@ -243,6 +256,7 @@ class CheckpointManager:
         handle: TableHandle,
         sampling_ratio: float = 1.0,
         commit: bool = False,
+        app_meta: Optional[Dict[str, float]] = None,
     ) -> str:
         """Stage blocks to temp storage; optionally commit immediately.
         Returns the checkpoint id (``tableId-seq-timestamp``, mirroring the
@@ -260,13 +274,15 @@ class CheckpointManager:
         from harmony_tpu.parallel.mesh import mesh_spans_processes
 
         if mesh_spans_processes(handle.table.mesh):
-            return self._pod_checkpoint(handle, sampling_ratio, commit)
-        chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
+            return self._pod_checkpoint(handle, sampling_ratio, commit,
+                                        app_meta)
+        chkp_id, snap, info = self._snapshot(handle, sampling_ratio, app_meta)
         self._write(info, snap, handle.table.spec.block_size, commit)
         return chkp_id
 
     def _pod_checkpoint(
-        self, handle: TableHandle, sampling_ratio: float, commit: bool
+        self, handle: TableHandle, sampling_ratio: float, commit: bool,
+        app_meta: Optional[Dict[str, float]] = None,
     ) -> str:
         """Pod-mode two-stage checkpoint (ref: ChkpManagerSlave.java:50-63
         staging per-executor local files + ChkpManagerMaster.java:49-61
@@ -323,6 +339,7 @@ class CheckpointManager:
             sampling_ratio=1.0,
             committed=False,
             created_at=time.time(),
+            app_meta=app_meta,
         )
         tdir = os.path.join(self.temp_root, chkp_id)
         staging = tdir + ".writing"
@@ -401,6 +418,7 @@ class CheckpointManager:
         handle: TableHandle,
         sampling_ratio: float = 1.0,
         commit: bool = False,
+        app_meta: Optional[Dict[str, float]] = None,
     ) -> "PendingCheckpoint":
         """Non-blocking checkpoint: the device-side snapshot is taken NOW
         (atomic w.r.t. training steps), the D2H transfer and file IO run on
@@ -418,7 +436,7 @@ class CheckpointManager:
                 "checkpoint_async is single-process only; call "
                 "checkpoint() collectively on a multi-process mesh"
             )
-        chkp_id, snap, info = self._snapshot(handle, sampling_ratio)
+        chkp_id, snap, info = self._snapshot(handle, sampling_ratio, app_meta)
         pending = PendingCheckpoint(chkp_id)
         block_size = handle.table.spec.block_size
 

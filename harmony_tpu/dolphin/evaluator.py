@@ -59,12 +59,14 @@ class ModelChkpManager:
             return None
         from harmony_tpu.parallel.mesh import mesh_spans_processes
 
+        meta = {"epoch": float(epoch_idx)}  # the resume path's restart key
         if mesh_spans_processes(self._handle.table.mesh):
             # Pod: the checkpoint is a synchronous mesh collective (every
             # process's chief worker reaches this hook at the same point in
             # its deterministic schedule; checkpoint_async's background
             # barriers would race the lockstep dispatch order).
-            cid = self._mgr.checkpoint(self._handle, commit=self._commit)
+            cid = self._mgr.checkpoint(self._handle, commit=self._commit,
+                                       app_meta=meta)
             self.chkp_ids.append(cid)
             return cid
         while len(self._pending) >= self.MAX_PENDING:
@@ -77,7 +79,8 @@ class ModelChkpManager:
                 if oldest.chkp_id in self.chkp_ids:
                     self.chkp_ids.remove(oldest.chkp_id)
                 raise
-        p = self._mgr.checkpoint_async(self._handle, commit=self._commit)
+        p = self._mgr.checkpoint_async(self._handle, commit=self._commit,
+                                       app_meta=meta)
         self._pending.append(p)
         self.chkp_ids.append(p.chkp_id)
         return p.chkp_id

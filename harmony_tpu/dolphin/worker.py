@@ -885,10 +885,17 @@ class WorkerTasklet:
         # slack INSIDE a turn (deadlock) — every worker takes the turn,
         # no-op for non-chiefs.
         if self.global_init:
-            with self._turn():
+            # also a TaskUnit under tenancy: un-gated init dispatches
+            # collide FIFO at the raw dispatch lock behind peers' units —
+            # both delaying this job's start and jittering the peers
+            with self._turn(), self._taskunit_scope("CPU"):
                 self.trainer.init_global_settings(ctx)
-        elif self._balanced_turns():
-            with self._turn():
+        elif self._balanced_turns() or self.taskunit is not None:
+            # siblings announce the SAME init unit (empty region): the
+            # TaskUnit quorum needs every worker to wait on each (seq,
+            # kind), and the cyclic turnstile needs matching turn counts —
+            # a chief-only unit would misalign both for the whole job
+            with self._turn(), self._taskunit_scope("CPU"):
                 pass
         if self.post_init_barrier is not None:
             self.post_init_barrier()
@@ -936,10 +943,18 @@ class WorkerTasklet:
                         self._pending_probe = first
                     else:
                         # fused path (pod units are request/grant, not a
-                        # cycle — an extra unit is harmless) or no turns
+                        # cycle — an extra unit is harmless) or no turns;
+                        # a TaskUnit under tenancy for the same raw-lock
+                        # reason as global init — but ONLY single-worker
+                        # jobs: the probe is chief-only, and a chief-only
+                        # unit would misalign the multi-worker quorum's
+                        # per-worker seq streams
+                        scope = (self._taskunit_scope("CPU")
+                                 if self.ctx.num_workers == 1
+                                 else contextlib.nullcontext())
                         with trace_span("dolphin.comm_probe",
                                         job_id=self.job_id, epoch=epoch):
-                            with self._turn():
+                            with self._turn(), scope:
                                 self._probe_comm(first)
             window = self._epoch_window_len(epoch, params.num_epochs)
             if window > 1:
@@ -1064,11 +1079,15 @@ class WorkerTasklet:
             with trace_span("dolphin.metric_drain", job_id=self.job_id,
                             epoch=epoch, batches=len(pending)):
                 # the drain's stack programs are multi-device dispatches:
-                # under pod lockstep they take a turn like any batch. The
-                # timer starts INSIDE the turn — waiting for admission is
-                # scheduling, not work, and must not inflate the per-batch
-                # times feeding the optimizer's cost model.
-                with self._turn():
+                # under pod lockstep they take a turn like any batch, and
+                # under TaskUnit tenancy they are a NET unit (a transfer
+                # phase, like the reference's PULL/PUSH typing) so they
+                # ride the fair queue instead of colliding FIFO at the
+                # raw dispatch lock behind peers' compute units. The
+                # timer starts INSIDE — admission wait is scheduling, not
+                # work, and must not inflate the per-batch times feeding
+                # the optimizer's cost model.
+                with self._turn(), self._taskunit_scope("NET"):
                     t0 = time.perf_counter()
                     host = self._drain_pending(pending)
             work_t += time.perf_counter() - t0
@@ -1086,8 +1105,11 @@ class WorkerTasklet:
     # pays ~one residual big-unit wait per OWN unit (non-preemptive slot),
     # so per-batch units make its slowdown scale with the PEERS' batch
     # time. Grouping consecutive batches until a unit spans ~this many
-    # seconds normalizes unit granularity in TIME across tenants.
-    UNIT_SPAN_TARGET = 0.1
+    # seconds normalizes unit granularity in TIME across tenants. 60ms:
+    # the residual a cheap tenant eats per grant scales with THIS number
+    # (FAIRNESS max-slowdown was the cheapest job at 0.1), while grants
+    # themselves are in-process condition-variable ops — near free.
+    UNIT_SPAN_TARGET = 0.06
 
     def _units_per_scope(self) -> int:
         if self.batch_barrier is not None:
@@ -1098,7 +1120,17 @@ class WorkerTasklet:
             c = self._own_batch_cost
             if not c:
                 return 1
-            return max(1, min(8, int(self.UNIT_SPAN_TARGET / max(c, 1e-6))))
+            # A tenant pays ~one residual PEER-unit wait per own unit
+            # (non-preemptive slot), so the dominant slowdown term for a
+            # cheap job is its UNIT COUNT, not its unit size: stretch the
+            # span target toward the largest peer unit (bounded — never
+            # hold the slot longer than half a second) so a cheap job
+            # crosses the schedule few times instead of once per batch.
+            target = self.UNIT_SPAN_TARGET
+            peer = self.taskunit.peer_unit_cost()
+            if peer:
+                target = max(target, min(peer, 0.5))
+            return max(1, min(8, int(target / max(c, 1e-6))))
         if self.pod_contended is not None and self.dispatch_turn is not None:
             # Pod units on the batched path: group a FIXED batch count per
             # unit so an uncontended job does not pay a leader round trip
@@ -1285,8 +1317,9 @@ class WorkerTasklet:
                             epoch=first_epoch, batches=len(all_pending),
                             epochs=k):
                 # the drain's stacks are dispatches; timer starts INSIDE
-                # the turn (admission wait is scheduling, not work)
-                with self._turn():
+                # the turn (admission wait is scheduling, not work); NET
+                # unit under tenancy — see _run_batched_epoch's drain
+                with self._turn(), self._taskunit_scope("NET"):
                     t0 = time.perf_counter()
                     host = self._drain_pending(all_pending)
             drain_t = time.perf_counter() - t0
@@ -1410,8 +1443,14 @@ class WorkerTasklet:
         # cache build BEFORE the timer starts: the one-time dataset
         # stacking/transfer must not inflate per-batch times fed to the
         # optimizer (a mid-window reshard rebuilds it inside the retry
-        # loop and does count — it IS reconfiguration cost)
-        self._ensure_stacked_cache()
+        # loop and does count — it IS reconfiguration cost). Inside a
+        # TURN: on multi-process backends a device_put onto a sharding
+        # that replicates across processes is itself collective-backed
+        # (gloo pairs the transfers), so two tenants' uploads
+        # interleaving with steps produce a cross-process collective
+        # mismatch — any global placement must hold the dispatch unit.
+        with self._turn():
+            self._ensure_stacked_cache()
         work_t = 0.0  # dispatch+device seconds, EXCLUDING admission waits
         window_metrics = []
         for j in range(k):

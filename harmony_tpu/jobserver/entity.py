@@ -107,6 +107,10 @@ class DolphinJobEntity(JobEntity):
         self._ctrl: Optional[MiniBatchController] = None
         self.progress: Optional[BatchProgressTracker] = None
         self._applied_plans: List[Dict[str, Any]] = []  # pod reshard log
+        # resume_from_chain: epoch to resume at + the restored chain's
+        # global counter (so the continued chain keeps monotonic ids)
+        self._starting_epoch = 0
+        self._chkp_counter_base = 0
 
     # -- setup -----------------------------------------------------------
 
@@ -190,6 +194,21 @@ class DolphinJobEntity(JobEntity):
             self._handle, _ = master.get_or_create_table(
                 cfg.tables[0], executor_ids, data_axis
             )
+        elif cfg.user.get("resume_from_chain"):
+            # Auto-resume: rebuild the model table from the job's LAST
+            # committed chain checkpoint (restore-by-state, ref:
+            # ETMaster.createTable(chkpId, associators)) and continue from
+            # the epoch it covers. The restore is cross-topology, so the
+            # grant may be a different executor set than the one that
+            # wrote the chain (a shrunk pod after a follower death).
+            if getattr(probe, "uses_local_table", False):
+                raise ValueError(
+                    f"job {cfg.job_id}: resume_from_chain does not cover "
+                    "worker-local tables (their state is not chained)"
+                )
+            self._handle, self._starting_epoch, self._chkp_counter_base = (
+                self._restore_chain(master, executor_ids, data_axis)
+            )
         else:
             # Trainer-default schema => PRIVATE model table: namespace by job
             # id so two concurrent jobs of the same app never collide on the
@@ -214,6 +233,54 @@ class DolphinJobEntity(JobEntity):
         self._data_arrays = self._make_data()
 
     # -- run (the DolphinMaster.start analogue) --------------------------
+
+    def _restore_chain(self, master: ETMaster, executor_ids: List[str],
+                       data_axis: int):
+        """Rebuild the model table from the MOST RECENTLY WRITTEN chain
+        checkpoint (by manifest created_at — id counters are NOT a
+        reliable epoch clock: the pod id scan skips past a stale run's
+        ids, and a resubmitted single-process chain restarts its counter)
+        and resume at the EPOCH the manifest records (chain entries carry
+        app_meta={"epoch": e}; the snapshot covers epoch e, so training
+        resumes at e+1). Returns (handle, starting_epoch, counter_base)."""
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        cfg = self.config
+        if self.chkp_root is None:
+            raise ValueError(
+                f"job {cfg.job_id}: resume_from_chain needs the server's "
+                "chkp_root (the chain lives there)"
+            )
+        mgr = CheckpointManager.for_job(self.chkp_root, cfg.job_id)
+        prefix = f"{cfg.job_id}:"
+        infos = []
+        for cid in mgr.list_checkpoints():
+            if not cid.startswith(prefix):
+                continue
+            info = mgr.info(cid)
+            if info.app_meta is None or "epoch" not in info.app_meta:
+                continue  # not a chain entry (no epoch tag)
+            infos.append(info)
+        if not infos:
+            raise ValueError(
+                f"job {cfg.job_id}: resume_from_chain found no epoch-"
+                f"tagged chain checkpoints under {self.chkp_root}"
+            )
+        latest = max(infos, key=lambda i: i.created_at)
+        handle = mgr.restore(master, latest.chkp_id, executor_ids, data_axis)
+        starting_epoch = int(latest.app_meta["epoch"]) + 1
+
+        def counter_of(cid: str) -> int:
+            try:
+                return int(cid.rsplit("-", 2)[1])
+            except (ValueError, IndexError):
+                return 0
+
+        # keep the continued chain's id counters monotonic past EVERY
+        # existing entry (ids stay unique/ordered; the epoch clock is the
+        # manifest tag, never the counter)
+        base = max(counter_of(i.chkp_id) for i in infos)
+        return handle, starting_epoch, base
 
     def run(self) -> Dict[str, Any]:
         cfg = self.config
@@ -296,6 +363,11 @@ class DolphinJobEntity(JobEntity):
             )
             self._chkp_dir = root
             self._chkp_mgr = CheckpointManager.for_job(root, cfg.job_id)
+            if self._chkp_counter_base:
+                # a RESUMED job continues its chain: counters (and the
+                # epoch mapping a future resume derives from them) stay
+                # monotonic across the restart
+                self._chkp_mgr.advance_counter(self._chkp_counter_base)
             self._chkp_chain = ModelChkpManager(
                 self._chkp_mgr, self._handle, period=params.model_chkp_period
             )
@@ -433,7 +505,11 @@ class DolphinJobEntity(JobEntity):
                     ),
                     taskunit=taskunit,
                     epoch_callback=(epoch_hook if idx == 0 else None),
-                    global_init=(idx == 0),
+                    starting_epoch=self._starting_epoch,
+                    # resumed jobs must NOT re-run global init: the
+                    # restored table already holds trained state, and an
+                    # additive init would corrupt it
+                    global_init=(idx == 0 and self._starting_epoch == 0),
                     post_init_barrier=init_barrier.wait,
                     dispatch_turn=self._make_dispatch_turn(turnstile, wid),
                     pod_contended=self._pod_unit_contended,

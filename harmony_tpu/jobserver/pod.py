@@ -145,6 +145,17 @@ class PodJobServer(JobServer):
         #: pid -> set of job ids the follower's latest heartbeat listed —
         #: catches a job thread that died without ever reporting
         self._hb_jobs: Dict[int, set] = {}
+        # Failure confinement (beyond the reference's fail-fast stubs,
+        # JobServerDriver.java:271-298): a follower death marks only the
+        # dead process AND processes sharing a running job with it as
+        # unusable ("partial" poison scope) — jobs wholly on other
+        # processes keep dispatching, and auto_resume-flagged jobs
+        # resubmit from their checkpoint chains onto survivors. Non-death
+        # poisons (partial broadcasts) stay TOTAL.
+        self._unusable_procs: set = set()
+        self._poison_scope: Optional[str] = None  # "partial" | "total"
+        #: job ids this server auto-resumed (observability + tests)
+        self.auto_resumed: List[str] = []
         self._reports: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._dead_followers: set = set()
         self._readers: List[threading.Thread] = []
@@ -234,23 +245,57 @@ class PodJobServer(JobServer):
             self._readers.append(t)
         return bound
 
-    def _mark_broken(self, reason: str) -> None:
-        """One poison path: record the reason, wake every pod waiter,
-        force-grant the unit arbiter (blocked dispatch threads proceed and
-        fail through normal error paths instead of wedging), and tell the
-        followers' unit trackers the same (best-effort — a dead socket's
-        reader poisons independently)."""
+    def _mark_broken(self, reason: str, scope: str = "total") -> None:
+        """One poison path: record the reason and wake every pod waiter.
+        TOTAL scope (protocol failures — partial broadcasts, eval
+        divergence) additionally force-grants the unit arbiter and tells
+        the followers' unit trackers (blocked threads proceed and fail
+        through normal error paths instead of wedging). PARTIAL scope
+        (a follower death whose damage is confined by _on_follower_death)
+        keeps the arbiter intact — surviving overlapping tenants still
+        need its ordering."""
         with self._pod_cond:
             if self._pod_broken is None:
                 self._pod_broken = reason
-                server_log.error("pod broken: %s", reason)
+                server_log.error("pod broken (%s): %s", scope, reason)
+            if self._poison_scope != "total":
+                self._poison_scope = scope
+            total = self._poison_scope == "total"
             self._pod_cond.notify_all()
+        if not total:
+            return
         self.pod_units.poison()
         for pid in sorted(self._followers):
             try:
                 self._send_to(pid, {"cmd": "TU_POISON"})
             except OSError:
                 pass
+
+    def _on_follower_death(self, pid: int) -> None:
+        """Confine the damage: the dead process — and every process
+        sharing a RUNNING job with it (their threads may be wedged in
+        collectives the dead devices will never join) — becomes unusable;
+        its executors retire from future grants. Everything else stays
+        schedulable, so surviving jobs keep running and flagged jobs can
+        auto-resume."""
+        with self._pod_cond:
+            if pid in self._unusable_procs:
+                return  # already confined (reader-EOF + report paths race)
+            wedged = {pid}
+            for jid, (ps, _) in self._active_procs.items():
+                if pid in ps:
+                    wedged |= ps
+            self._unusable_procs |= wedged
+        retired = [
+            eid for eid in self.master.executor_ids()
+            if self.master.executor(eid).device.process_index in wedged
+        ]
+        if retired:
+            self._scheduler.retire(retired)
+            server_log.warning(
+                "retired executors %s (unusable processes %s)",
+                retired, sorted(wedged),
+            )
 
     def _reader_loop(self, pid: int, f) -> None:
         """Owns all reads from follower ``pid``: routes JOB_DONE payloads
@@ -270,7 +315,9 @@ class PodJobServer(JobServer):
                     self._pod_cond.notify_all()
                 self.pod_units.proc_done(pid)
                 if not closing:
-                    self._mark_broken(f"follower {pid} connection lost")
+                    self._on_follower_death(pid)
+                    self._mark_broken(f"follower {pid} connection lost",
+                                      scope="partial")
                 return
             # ANY traffic proves the process alive; HEARTBEATs exist so a
             # follower busy inside a long job still produces traffic
@@ -504,7 +551,16 @@ class PodJobServer(JobServer):
                        and not bool(config.user.get("pod_isolated")))
         admitted = False
         with self._pod_cond:
-            while not self._pod_broken:
+            while True:
+                # TOTAL poison fails everything; PARTIAL (a confined
+                # follower death) fails only jobs touching the unusable
+                # processes — survivors and auto-resumes keep running.
+                # A broken flag with UNKNOWN scope (set outside
+                # _mark_broken) is conservatively total.
+                if self._pod_broken and self._poison_scope != "partial":
+                    break
+                if procs & self._unusable_procs:
+                    break
                 if self._conflicts_locked(
                         config.job_id, procs, pod_ordered) is None:
                     self._active_procs[config.job_id] = (procs, pod_ordered)
@@ -523,8 +579,9 @@ class PodJobServer(JobServer):
         if not admitted:
             self._fail_job(
                 config,
-                f"pod is broken ({self._pod_broken}); restart the pod "
-                "processes — followers may be wedged in collectives",
+                f"pod is broken ({self._pod_broken}); the job's processes "
+                f"{sorted(procs & self._unusable_procs) or ''} are "
+                "unusable — followers may be wedged in collectives",
             )
             return
         t0 = time.monotonic()
@@ -590,9 +647,14 @@ class PodJobServer(JobServer):
                 # could never complete — poison the pod.
                 dead = [pid for pid, r in reports.items() if r.get("infra")]
                 if dead:
+                    # death-driven: confine the damage (idempotent with
+                    # the reader-EOF path) and poison PARTIALLY so
+                    # unaffected jobs and auto-resumes keep running
+                    for pid in dead:
+                        self._on_follower_death(pid)
                     self._mark_broken(
                         f"follower(s) {dead} never reported for "
-                        f"{config.job_id}"
+                        f"{config.job_id}", scope="partial",
                     )
                 with self._pod_cond:  # concurrent dispatch threads trim too
                     self.pod_reports[config.job_id] = reports
@@ -619,6 +681,55 @@ class PodJobServer(JobServer):
                     self.job_walls.pop(next(iter(self.job_walls)))
                 self._active_procs.pop(config.job_id, None)
                 self._pod_cond.notify_all()
+        self._maybe_auto_resume(config, executor_ids)
+
+    def _maybe_auto_resume(self, config: JobConfig,
+                           executor_ids: List[str]) -> None:
+        """Auto-resume (beyond the reference's fail-fast stubs,
+        JobServerDriver.java:271-298): a ``user.auto_resume`` job with a
+        checkpoint chain that just FAILED because its processes became
+        unusable (a follower died) is resubmitted with
+        ``resume_from_chain`` — the scheduler, whose dead executors were
+        retired, grants surviving ones, and the entity restores the last
+        committed chain checkpoint and continues from its epoch."""
+        jr = self._jobs.get(config.job_id)
+        if jr is None or not jr.future.done() or jr.future.exception() is None:
+            return
+        if not (config.user.get("auto_resume")
+                and config.params.model_chkp_period > 0
+                and self._chkp_root
+                and not config.user.get("resume_from_chain")):
+            return
+        procs = {
+            self.master.executor(e).device.process_index
+            for e in executor_ids
+        }
+        with self._pod_cond:
+            infra = bool(procs & self._unusable_procs)
+        if not infra:
+            return  # the job failed on its own terms, not infra death
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        mgr = CheckpointManager.for_job(self._chkp_root, config.job_id)
+        prefix = f"{config.job_id}:"
+        if not any(c.startswith(prefix) for c in mgr.list_checkpoints()):
+            server_log.warning(
+                "auto-resume of %s skipped: no chain checkpoints yet",
+                config.job_id,
+            )
+            return
+        new_cfg = ConfigBase.from_dict(config.to_dict())
+        new_cfg.user["resume_from_chain"] = True
+        server_log.info(
+            "auto-resuming %s from its checkpoint chain on surviving "
+            "executors", config.job_id,
+        )
+        self.auto_resumed.append(config.job_id)
+        try:
+            self.submit(new_cfg)
+        except Exception as e:  # noqa: BLE001 - the original failure stands
+            server_log.error("auto-resume submit for %s failed: %s",
+                             config.job_id, e)
 
     def _query_remote_epoch(self, job_id: str, chief: int,
                             timeout: float = 30.0) -> int:

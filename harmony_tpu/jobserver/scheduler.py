@@ -38,6 +38,13 @@ class JobScheduler:
     def on_resource_change(self, executor_ids: List[str]) -> None:
         self._executors = list(executor_ids)
 
+    def retire(self, executor_ids: List[str]) -> None:
+        """Permanently remove executors from future grants (a pod follower
+        died; its devices can never serve again this session). Running
+        grants are untouched — their jobs fail through their own paths."""
+        gone = set(executor_ids)
+        self._executors = [e for e in self._executors if e not in gone]
+
 
 class ShareAllScheduler(JobScheduler):
     """Default: every job starts immediately on ALL executors (the
@@ -104,6 +111,15 @@ class CarveScheduler(JobScheduler):
     def bind(self, executor_ids: List[str], launch: LaunchFn) -> None:
         super().bind(executor_ids, launch)
         self._free = list(executor_ids)
+
+    def retire(self, executor_ids: List[str]) -> None:
+        """Dead executors must leave the FREE pool too (under the lock,
+        against concurrent slice grants), or the next _take_slice hands
+        them to a job that can only fail pod admission."""
+        gone = set(executor_ids)
+        with self._lock:
+            super().retire(executor_ids)
+            self._free = [e for e in self._free if e not in gone]
 
     def _take_slice(self) -> Optional[List[str]]:
         """Under the lock: carve the next job's slice or None to queue."""

@@ -25,6 +25,7 @@ import contextlib
 import dataclasses
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -100,6 +101,8 @@ class GlobalTaskUnitScheduler:
         self._deficit: Dict[str, float] = {}
         self._unit_cost: Dict[str, float] = {}
         self._outstanding: Dict[Tuple[str, str], int] = {}  # (job, kind)
+        # last grant/finish per job — the anticipatory-hold recency signal
+        self._last_activity: Dict[str, float] = {}
         # granted key -> executors that have NOT yet finished it (a SET,
         # not a count: an executor may both finish a unit and then leave
         # the job — counting would double-decrement and release the
@@ -123,6 +126,7 @@ class GlobalTaskUnitScheduler:
         with self._cond:
             self._job_executors.pop(job_id, None)
             self._deficit.pop(job_id, None)
+            self._last_activity.pop(job_id, None)
             for key in [k for k in self._waiting if k[0] == job_id]:
                 del self._waiting[key]
                 self._arrival.pop(key, None)
@@ -140,6 +144,19 @@ class GlobalTaskUnitScheduler:
         shrink their in-flight dispatch windows."""
         with self._cond:
             return len(self._job_executors)
+
+    def peer_unit_cost(self, job_id: str) -> float:
+        """Largest measured per-unit cost among OTHER registered jobs
+        (0.0 when unknown) — workers size their batch groups toward it: a
+        cheap tenant pays ~one residual peer-unit wait per OWN unit, so
+        matching its unit span to the peers' cuts its unit count (and
+        with it the dominant term of its slowdown) without lengthening
+        anyone's residual beyond what the big tenants already impose."""
+        with self._cond:
+            return max(
+                (self._unit_cost.get(j, 0.0) for j in self._job_executors
+                 if j != job_id), default=0.0,
+            )
 
     def report_unit_cost(self, job_id: str, seconds: float) -> None:
         """Measured per-unit device seconds for a job (workers report the
@@ -183,6 +200,7 @@ class GlobalTaskUnitScheduler:
             if not pending:
                 del self._finishes[key]
                 self._release_meter_locked(unit.job_id, unit.kind)
+                self._last_activity[unit.job_id] = time.monotonic()
                 self._maybe_grant_locked()
                 self._cond.notify_all()
 
@@ -217,8 +235,12 @@ class GlobalTaskUnitScheduler:
 
     def wait_ready(self, unit: TaskUnitInfo, timeout: Optional[float] = None) -> bool:
         """TaskUnitWaitMsg: block until the whole job's quorum waits on this
-        seq and the grant is broadcast (TaskUnitReadyMsg)."""
+        seq and the grant is broadcast (TaskUnitReadyMsg). The wait wakes
+        periodically to re-evaluate grants — an anticipatory hold (see
+        _maybe_grant_locked) lapses by TIME, and no event fires when it
+        does."""
         key = (unit.job_id, unit.seq, unit.kind)
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             if unit.job_id not in self._job_executors:
                 return True  # job not registered: scheduling disabled for it
@@ -227,8 +249,32 @@ class GlobalTaskUnitScheduler:
                 self._arrival[key] = self._arrival_counter
             self._waiting.setdefault(key, set()).add(unit.executor_id)
             self._maybe_grant_locked()
-            ok = self._cond.wait_for(lambda: key in self._granted, timeout=timeout)
-            return ok
+            while key not in self._granted:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                # periodic re-evaluation only where an anticipatory hold
+                # can exist (contended + metered): elsewhere grants are
+                # purely notify-driven and polling is pure overhead
+                holds_possible = (self.meter_execution
+                                  and len(self._job_executors) > 1)
+                step = remaining
+                if holds_possible:
+                    step = (self.RESERVE_WINDOW if remaining is None
+                            else min(remaining, self.RESERVE_WINDOW))
+                if not self._cond.wait_for(
+                        lambda: key in self._granted, timeout=step):
+                    if holds_possible:
+                        self._maybe_grant_locked()  # a hold may have lapsed
+            return True
+
+    # Anticipatory-hold window (seconds): how long after the least-served
+    # tenant's last grant/finish the slot is held for its RETURN before
+    # peers may take it. Covers the microscopic host gaps between a
+    # streaming tenant's consecutive units (loop bookkeeping, sub-ms) and
+    # short drains — far below any real unit span.
+    RESERVE_WINDOW = 0.05
 
     def _maybe_grant_locked(self) -> None:
         ready = []
@@ -243,6 +289,24 @@ class GlobalTaskUnitScheduler:
         # two jobs at once and a wait-set test would never engage the
         # meter)
         contended = len(self._job_executors) > 1
+        # Anticipatory hold (the disk-scheduler trick, applied to tenant
+        # fairness): the least-served tenant streams its units through
+        # microscopic host gaps; a work-conserving grant into such a gap
+        # would charge it one full peer-unit residual per OWN unit — the
+        # measured ~4x cheapest-tenant slowdown. If the least-served job
+        # was active within RESERVE_WINDOW and a candidate's deficit is
+        # comfortably ahead of it, the slot is held for its return (the
+        # hold lapses by time; wait_ready re-evaluates periodically).
+        fav = fav_d = None
+        fav_hold = False
+        if contended and self.meter_execution and self._job_executors:
+            fav = min(self._job_executors,
+                      key=lambda j: self._deficit.get(j, 0.0))
+            fav_d = self._deficit.get(fav, 0.0)
+            fav_hold = (
+                time.monotonic() - self._last_activity.get(fav, 0.0)
+                < self.RESERVE_WINDOW
+            )
         # lowest-deficit job first; arrival order breaks ties (and is the
         # whole order for a lone job — the legacy behavior)
         ready.sort(key=lambda k: (self._deficit.get(k[0], 0),
@@ -250,19 +314,21 @@ class GlobalTaskUnitScheduler:
         granted_any = False
         for key in ready:
             job, _seq, kind = key
-            if (contended and kind != VOID and self.meter_execution
-                    and any(jk[1] == kind for jk in self._outstanding)):
-                # Metered PER KIND: the device is one CPU resource — under
-                # contention at most one un-finished CPU unit is
-                # outstanding ACROSS jobs, so the deficit-ordered grant
-                # sequence IS the device schedule (per-job slots would
-                # degenerate to 1:1 alternation in whatever order threads
-                # hit the dispatch lock). NET units are host-driven
-                # transfers, not device compute: gating them behind an
-                # outstanding COMP unit would collapse the 1-CPU/2-NET
-                # compute/transfer overlap into full serialization, so
-                # each kind meters only against itself.
-                continue
+            if contended and kind != VOID and self.meter_execution:
+                if any(jk[1] == kind for jk in self._outstanding):
+                    # Metered PER KIND: the device is one CPU resource —
+                    # under contention at most one un-finished CPU unit
+                    # is outstanding ACROSS jobs, so the deficit-ordered
+                    # grant sequence IS the device schedule. NET units
+                    # are host-driven transfers: gating them behind an
+                    # outstanding COMP unit would collapse the
+                    # 1-CPU/2-NET compute/transfer overlap, so each kind
+                    # meters only against itself.
+                    continue
+                if (fav_hold and job != fav
+                        and fav_d + 2 * self._charge_locked(fav)
+                        < self._deficit.get(job, 0.0)):
+                    continue  # hold the slot for the least-served tenant
             waiters = self._waiting.pop(key)
             self._arrival.pop(key, None)
             self._granted.add(key)
@@ -270,6 +336,7 @@ class GlobalTaskUnitScheduler:
             self._deficit[job] = (
                 self._deficit.get(job, 0.0) + self._charge_locked(job)
             )
+            self._last_activity[job] = time.monotonic()
             if kind != VOID:
                 self._outstanding[(job, kind)] = (
                     self._outstanding.get((job, kind), 0) + 1
@@ -352,3 +419,8 @@ class TaskUnitClient:
         """Forward this job's measured per-unit seconds to the fair-queue
         deficit accounting."""
         self._global.report_unit_cost(self.job_id, seconds)
+
+    def peer_unit_cost(self) -> float:
+        """Largest peer unit cost (see GlobalTaskUnitScheduler) — the
+        group-sizing hint for cheap tenants."""
+        return self._global.peer_unit_cost(self.job_id)

@@ -151,7 +151,15 @@ def _grow_stage_exchange(host: "np.ndarray | None", old_mesh: Mesh,
     err: "BaseException | None" = None
     if pid == source:
         try:
-            os.makedirs(stage + ".writing", exist_ok=True)
+            # pre-clear: a crashed prior session's staging under the same
+            # deterministic name must not be adopted (stale payload) or
+            # collide with the publish rename. Safe pre-fence: only the
+            # source ever touches these paths before the publish fence.
+            # (Two CONCURRENT pods must not share a stage root — point
+            # HARMONY_POD_STAGE_ROOT per pod, like the chkp root.)
+            shutil.rmtree(stage + ".writing", ignore_errors=True)
+            shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage + ".writing")
             np.save(os.path.join(stage + ".writing", "table.npy"), host)
             os.rename(stage + ".writing", stage)  # atomic publish
         except BaseException as e:  # noqa: BLE001 - reported via the fence
