@@ -266,7 +266,12 @@ class DolphinJobEntity(JobEntity):
                 f"job {cfg.job_id}: resume_from_chain found no epoch-"
                 f"tagged chain checkpoints under {self.chkp_root}"
             )
-        latest = max(infos, key=lambda i: i.created_at)
+        # primary key: the MONOTONIC epoch tag (wall clock can regress
+        # across hosts/NTP steps and must never discard newer progress);
+        # created_at only tie-breaks entries claiming the same epoch
+        # (a resubmitted-from-scratch chain re-covering old epochs)
+        latest = max(infos,
+                     key=lambda i: (int(i.app_meta["epoch"]), i.created_at))
         handle = mgr.restore(master, latest.chkp_id, executor_ids, data_axis)
         starting_epoch = int(latest.app_meta["epoch"]) + 1
 
@@ -993,16 +998,21 @@ class PregelJobEntity(JobEntity):
         metric_manager=None,  # no per-table optimizer loop for graphs
         pod_plan_sink=None,   # accepted for interface parity; graphs have
         pod_eval_channel=None,  # no model table to migrate/evaluate by plan
-        pod_unit_scope=None,    # pregel is NOT pod_ordered: multi-process
-        pod_unit_contended=None,  # pregel grants serialize at admission
+        pod_unit_scope=None,
+        pod_unit_contended=None,  # supersteps have no window to shrink
     ) -> None:
         super().__init__(config, chkp_root)  # no model table: root unused
         self._global_tu = global_taskunit
         self._local_tu = local_taskunit
+        # Cross-job pod units (share-all tenancy): the master wraps every
+        # superstep dispatch — and setup wraps table creation — in
+        # leader-granted units, exactly like dolphin entities.
+        self._pod_unit_scope = pod_unit_scope
         self._pregel_master = None
         self._registered = False
 
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
+        import contextlib
         import inspect
 
         from harmony_tpu.parallel.mesh import build_mesh
@@ -1021,20 +1031,27 @@ class PregelJobEntity(JobEntity):
         devices = [master.executor(e).device for e in executor_ids]
         mesh = build_mesh(devices, data=1)
         taskunit = None
-        if self._global_tu is not None and self._local_tu is not None:
+        if (self._global_tu is not None and self._local_tu is not None
+                and self._pod_unit_scope is None):
+            # local TaskUnit admission, like dolphin: dropped under pod
+            # units (ordering + fairness come from the arbiter)
             wid = f"{cfg.job_id}/w0"
             self._global_tu.on_job_start(cfg.job_id, [wid])
             self._registered = True
             taskunit = TaskUnitClient(cfg.job_id, wid, self._global_tu, self._local_tu)
+        scope = (self._pod_unit_scope() if self._pod_unit_scope is not None
+                 else contextlib.nullcontext())
         try:
-            self._pregel_master = PregelMaster(
-                graph,
-                computation,
-                mesh,
-                max_supersteps=int(user.get("max_supersteps", 100)),
-                taskunit=taskunit,
-                job_id=cfg.job_id,
-            )
+            with scope:  # table creation + seeds dispatch global programs
+                self._pregel_master = PregelMaster(
+                    graph,
+                    computation,
+                    mesh,
+                    max_supersteps=int(user.get("max_supersteps", 100)),
+                    taskunit=taskunit,
+                    job_id=cfg.job_id,
+                    dispatch_turn=self._pod_unit_scope,
+                )
         except BaseException:
             self._deregister()  # a failed setup must not leave a stale quorum
             raise

@@ -33,20 +33,21 @@ different orders on different hosts deadlock the pod (a distributed
 lock-order inversion). Two mechanisms make tenancy safe:
 
   * the CROSS-JOB UNIT PROTOCOL (runtime/podunits.py): every multi-process
-    dolphin job wraps its global-dispatch regions in leader-granted units;
-    the leader's arbiter never leaves units of two process-overlapping
-    jobs outstanding at once, so every process's cross-job enqueue order
-    IS the grant order. SHARE-ALL grants (every job on all executors — the
-    reference's default) therefore run truly concurrently, interleaved in
-    one pod-wide weighted-fair order;
+    dolphin AND pregel job wraps its global-dispatch regions in
+    leader-granted units; the leader's arbiter never leaves units of two
+    process-overlapping jobs outstanding at once, so every process's
+    cross-job enqueue order IS the grant order. SHARE-ALL grants (every
+    job on all executors — the reference's default) therefore run truly
+    concurrently, interleaved in one pod-wide weighted-fair order;
   * the admission rule in ``_dispatch`` for everything else: disjoint
     process sets are always concurrent; single-process jobs are always
     concurrent (their shared-device pairs live in one process, whose
     dispatch lock enqueues each program atomically — no pair can invert);
-    a multi-process job OUTSIDE the unit protocol (pregel) serializes
-    against any other overlapping multi-process job, and a job waiting on
-    admission holds a FIFO ticket reserving its processes against later
-    arrivals so a stream of small jobs cannot starve it.
+    a multi-process job OUTSIDE the unit protocol (``user.pod_isolated``
+    opt-outs) serializes against any other overlapping multi-process
+    job, and a job waiting on admission holds a FIFO ticket reserving
+    its processes against later arrivals so a stream of small jobs
+    cannot starve it.
 
 The ``pod_carve`` scheduler (scheduler.ProcessCarveScheduler) still
 produces process-disjoint grants for tenants that want isolation (no
@@ -154,6 +155,10 @@ class PodJobServer(JobServer):
         # poisons (partial broadcasts) stay TOTAL.
         self._unusable_procs: set = set()
         self._poison_scope: Optional[str] = None  # "partial" | "total"
+        #: jobs whose FAILURE was infra-observed (a participant died or
+        #: went silent DURING the job) — the auto-resume eligibility
+        #: evidence; a job failing on its own terms never lands here
+        self._infra_failed: set = set()
         #: job ids this server auto-resumed (observability + tests)
         self.auto_resumed: List[str] = []
         self._reports: Dict[Tuple[str, int], Dict[str, Any]] = {}
@@ -547,7 +552,8 @@ class PodJobServer(JobServer):
         # no running job conflicts (see _conflicts_locked); while waiting,
         # the job's FIFO ticket reserves its processes against later
         # arrivals.
-        pod_ordered = (config.app_type == "dolphin" and len(procs) > 1
+        pod_ordered = (config.app_type in ("dolphin", "pregel")
+                       and len(procs) > 1
                        and not bool(config.user.get("pod_isolated")))
         admitted = False
         with self._pod_cond:
@@ -650,6 +656,10 @@ class PodJobServer(JobServer):
                     # death-driven: confine the damage (idempotent with
                     # the reader-EOF path) and poison PARTIALLY so
                     # unaffected jobs and auto-resumes keep running
+                    with self._pod_cond:
+                        self._infra_failed.add(config.job_id)
+                        while len(self._infra_failed) > 1024:
+                            self._infra_failed.pop()
                     for pid in dead:
                         self._on_follower_death(pid)
                     self._mark_broken(
@@ -700,12 +710,13 @@ class PodJobServer(JobServer):
                 and self._chkp_root
                 and not config.user.get("resume_from_chain")):
             return
-        procs = {
-            self.master.executor(e).device.process_index
-            for e in executor_ids
-        }
         with self._pod_cond:
-            infra = bool(procs & self._unusable_procs)
+            # evidence that THIS job's failure was infra-observed (a
+            # participant died/went silent while it ran) — a job failing
+            # on its own terms after some unrelated earlier death must
+            # NOT be resubmitted to fail identically again
+            infra = config.job_id in self._infra_failed
+            self._infra_failed.discard(config.job_id)
         if not infra:
             return  # the job failed on its own terms, not infra death
         from harmony_tpu.checkpoint.manager import CheckpointManager
@@ -826,7 +837,7 @@ class PodJobServer(JobServer):
             extras: Dict[str, Any] = {
                 "pod_plan_sink": self.schedule_pod_reshard,
             }
-            if (config.app_type == "dolphin"
+            if (config.app_type in ("dolphin", "pregel")
                     and not bool(config.user.get("pod_isolated"))):
                 # Leader-local leg of the cross-job unit protocol: the
                 # entity wraps every global-dispatch region in a unit so
@@ -956,6 +967,8 @@ class PodJobServer(JobServer):
         try:
             rep = self._wait_report_live(config.job_id, chief)
             if rep is None:
+                with self._pod_cond:  # infra-observed: resume-eligible
+                    self._infra_failed.add(config.job_id)
                 raise RuntimeError(
                     f"chief follower {chief} never reported for "
                     f"{config.job_id} (connection lost or heartbeat "

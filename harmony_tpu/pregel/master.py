@@ -43,6 +43,7 @@ class PregelMaster:
         max_supersteps: int = 100,
         taskunit: Optional[Any] = None,
         job_id: str = "pregel",
+        dispatch_turn: Optional[Any] = None,
     ) -> None:
         if getattr(computation, "undirected", False):
             graph = graph.undirected()
@@ -52,6 +53,12 @@ class PregelMaster:
         self.max_supersteps = max_supersteps
         self.taskunit = taskunit
         self.job_id = job_id
+        # Cross-job pod unit scope (runtime/podunits.py): under share-all
+        # tenancy every superstep dispatch holds a leader-granted unit so
+        # its enqueues cannot interleave with another tenant's (the
+        # single dispatch thread keeps the per-process unit sequence
+        # deterministic). None outside pods.
+        self.dispatch_turn = dispatch_turn
         V = graph.num_vertices
         update = {"add": "add", "min": "min", "max": "max"}[computation.combiner]
 
@@ -168,19 +175,33 @@ class PregelMaster:
                 self._msg_tables[nxt],
                 self._has_msg[nxt],
             ]
-            with self._tu("COMP"):
+            with self._turn(), self._tu("COMP"):
                 all_halted, num_msgs = DenseTable.apply_step_multi(
                     tables, self._superstep, jnp.int32(step)
                 )
             self.superstep_count = step + 1
             self._cur = nxt  # the table swap (MessageManager.swap)
+            # D2H reads of replicated scalars: every process reads the
+            # SAME values, so the loop-break decision stays lockstep
             if bool(all_halted) and float(num_msgs) == 0.0:
                 break
         return {
             "supersteps": self.superstep_count,
             "wall_sec": time.perf_counter() - t0,
-            "vertex_values": np.asarray(self.vertex_table.pull_array()),
+            "vertex_values": self._collect_values(),
         }
+
+    def _collect_values(self) -> np.ndarray:
+        """Final vertex values on the host. On a multi-process mesh the
+        table's shards span hosts, so the pull replicates first (one
+        all-gather every process dispatches in lockstep, inside a unit);
+        single-process meshes read the sharded pull directly."""
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        spans = mesh_spans_processes(self.mesh)
+        with self._turn():
+            arr = self.vertex_table.pull_array(replicated=spans)
+        return np.asarray(arr)
 
     def close(self) -> None:
         """Release every device-resident table (vertex + both message
@@ -195,3 +216,10 @@ class PregelMaster:
 
             return contextlib.nullcontext()
         return self.taskunit.scope(kind)
+
+    def _turn(self):
+        if self.dispatch_turn is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self.dispatch_turn()

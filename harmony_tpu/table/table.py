@@ -618,10 +618,12 @@ class DenseTable(LayoutAnnouncerMixin):
 
     # -- op surface (host-level; parity with Table.java) ----------------
 
-    def _jitted(self, name: str, fn: Callable) -> Callable:
+    def _jitted(self, name: str, fn: Callable,
+                out_shardings=None) -> Callable:
         with self._lock:
             if name not in self._jit_cache:
-                jf = jax.jit(fn)
+                jf = (jax.jit(fn) if out_shardings is None
+                      else jax.jit(fn, out_shardings=out_shardings))
                 mesh = self._mesh  # stable: cache cleared on reshard
 
                 def wrapped(*args, _jf=jf, _mesh=mesh, **kw):
@@ -723,9 +725,19 @@ class DenseTable(LayoutAnnouncerMixin):
         ) if init_v.ndim == 1 and self.spec.value_shape else init_v
         return self.put(key, np.asarray(init_v[0]))
 
-    def pull_array(self) -> jax.Array:
-        """Full table in key order (device array; stays sharded until used)."""
+    def pull_array(self, replicated: bool = False) -> jax.Array:
+        """Full table in key order (device array; stays sharded until
+        used). ``replicated=True`` all-gathers so EVERY process holds the
+        full value addressable — the multi-process read path (a sharded
+        result spans hosts and np.asarray refuses it); the collective is
+        dispatched under the same lock/dispatch discipline as any other
+        host op, so callers on pods must hold their dispatch unit."""
         with self._lock:  # dispatch under lock: see `array` docstring
+            if replicated:
+                return self._jitted(
+                    "pull_all_rep", self.spec.pull_all,
+                    out_shardings=NamedSharding(self._mesh, P()),
+                )(self._arr)
             return self._jitted("pull_all", self.spec.pull_all)(self._arr)
 
     # -- re-sharding (the migration path) --------------------------------

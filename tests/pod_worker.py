@@ -77,6 +77,16 @@ def main() -> None:
                 for k in ("reconfigs", "optimizer_errors"):
                     if k in res:
                         local[job_id][k] = res[k]
+                if "supersteps" in res:  # pregel jobs
+                    import numpy as _np
+
+                    local[job_id]["supersteps"] = int(res["supersteps"])
+                    vv = res.get("vertex_values")
+                    if vv is not None:
+                        local[job_id]["vertex_sum"] = float(_np.sum(vv))
+                        local[job_id]["vertex_head"] = [
+                            float(x) for x in _np.ravel(vv)[:6]
+                        ]
             except Exception as e:  # noqa: BLE001 - reported in RESULT
                 local[job_id] = {"error": f"{type(e).__name__}: {e}"}
         print("RESULT " + json.dumps({
