@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CPU virtual-mesh evidence bundle — ONLY the signals that transfer from a
+# 1-core host: table/data-plane bandwidths, migration stall, checkpoint IO,
+# multi-worker aggregate, pod throughput, and pointers to the fairness /
+# pod-tenant artifacts. Kernel sections (flash/mxu_dot/mxu push/ringflash)
+# are DELIBERATELY EXCLUDED: on a 1-core CPU host they measure interpreter
+# noise, not the kernel (round-3 verdict: "noise rows ... could mislead a
+# reader skimming the bundle"); kernels are judged on chip captures only.
+#
+# Usage: bin/capture_cpu_mesh.sh [suffix]   (default r04)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+SUF="${1:-r04}"
+OUT="benchmarks/CPU_MESH_${SUF}.jsonl"
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+{
+  echo "# CPU virtual-mesh evidence bundle — ${SUF}. Transferable signals"
+  echo "# only; kernel rows are excluded by design (1-core CPU timings of"
+  echo "# MXU/flash kernels are noise — see chip captures for kernels)."
+  run_row() {  # a crashed/timed-out section records an ERROR row, never
+    local name="$1"; shift  # silently vanishes (silent truncation reads
+    local row                # as "covered everything" — round-3 verdict)
+    row="$(timeout "$1" python "${@:2}" 2>/dev/null | tail -1)"
+    if [ -n "$row" ]; then
+      echo "$row"
+    else
+      echo "{\"metric\": \"${name}\", \"value\": null," \
+           "\"error\": \"section crashed or timed out\"}"
+    fi
+  }
+  for sec in table reshard multiget sparse stall chkp; do
+    run_row "micro:${sec}" 900 benchmarks/micro.py "$sec"
+  done
+  run_row "multiworker aggregate" 900 benchmarks/multiworker.py
+  run_row "pod throughput" 1800 benchmarks/pod.py
+  echo "# companion artifacts: FAIRNESS_${SUF}.json (N-run fairness series)," \
+       "POD_TENANTS_${SUF}.json (carve + share_all pod tenancy)"
+} > "$OUT"
+echo "wrote $OUT" >&2
+cat "$OUT"

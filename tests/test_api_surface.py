@@ -311,3 +311,111 @@ class TestRound3Surfaces:
         from harmony_tpu.dolphin.evaluator import resolve_eval_inputs
 
         assert callable(resolve_eval_inputs)
+
+
+class TestRound4Surfaces:
+    """Round-4 public surface pins: the cross-job pod unit protocol,
+    heartbeat liveness knobs, auto-resume, symmetric grow-reshard, and
+    the fairness mechanics."""
+
+    def test_podunits_surface(self):
+        from harmony_tpu.runtime.podunits import (
+            FollowerUnits,
+            PodUnitArbiter,
+            follower_client,
+            leader_client,
+        )
+
+        sent = []
+        arb = PodUnitArbiter(send_to=lambda pid, msg: sent.append((pid, msg)))
+        arb.register_job("api-j", frozenset({0, 1}))
+        client = leader_client(arb, "api-j")
+        arb.on_wait("api-j", 0, 1)  # follower announces first
+        with client.scope():  # leader joins; unit grants
+            pass
+        assert any(m.get("cmd") == "TU_GRANT" for _, m in sent)
+        arb.on_done("api-j", 0, 1)
+        assert client.contended() is False  # lone job
+        arb.deregister_job("api-j")
+        # follower side: grants may arrive before the wait
+        fu = FollowerUnits(report=lambda m: None)
+        fu.on_grant("api-k", 0, contended=True)
+        fc = follower_client(fu, "api-k")
+        with fc.scope():
+            pass
+        assert fc.contended() is True
+        fu.forget("api-k")
+
+    def test_scheduler_retire(self):
+        from harmony_tpu.jobserver.scheduler import (
+            CarveScheduler,
+            ShareAllScheduler,
+        )
+
+        s = ShareAllScheduler()
+        s.bind(["e0", "e1", "e2"], lambda c, ex: None)
+        s.retire(["e1"])
+        assert s._executors == ["e0", "e2"]
+        c = CarveScheduler()
+        c.bind(["e0", "e1", "e2", "e3"], lambda cfg, ex: None)
+        c.retire(["e3"])
+        assert "e3" not in c._free and "e3" not in c._executors
+
+    def test_pod_server_round4_surface(self):
+        import inspect
+
+        from harmony_tpu.jobserver.pod import PodFollower, PodJobServer
+
+        src = inspect.getsource(PodJobServer.__init__)
+        for name in ("pod_units", "auto_resumed", "hb_timeout"):
+            assert f"self.{name}" in src, name
+        for name in ("_mark_broken", "_on_follower_death",
+                     "_maybe_auto_resume", "_wait_report_live",
+                     "_query_remote_epoch"):
+            assert hasattr(PodJobServer, name), name
+        assert hasattr(PodFollower, "_heartbeat_loop")
+
+    def test_pull_array_replicated(self, mesh8):
+        import numpy as np
+
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.table import DenseTable, TableSpec
+
+        t = DenseTable(
+            TableSpec(TableConfig(table_id="api-rep", capacity=16,
+                                  value_shape=(2,), num_blocks=4)),
+            mesh8,
+        )
+        t.multi_update(list(range(16)), np.ones((16, 2), np.float32))
+        rep = t.pull_array(replicated=True)
+        assert np.allclose(np.asarray(rep), 1.0)
+
+    def test_chain_checkpoint_epoch_tag(self, tmp_path, mesh8):
+        import numpy as np
+
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.runtime.master import ETMaster
+
+        master = ETMaster()
+        execs = [e.id for e in master.add_executors(4)]
+        h = master.create_table(
+            TableConfig(table_id="api-meta", capacity=8, value_shape=(2,),
+                        num_blocks=4), execs)
+        mgr = CheckpointManager.for_job(str(tmp_path), "api-meta-job")
+        cid = mgr.checkpoint(h, commit=True, app_meta={"epoch": 3.0})
+        assert mgr.info(cid).app_meta == {"epoch": 3.0}
+        mgr.advance_counter(7)
+        cid2 = mgr.checkpoint(h, commit=True)
+        assert int(cid2.rsplit("-", 2)[1]) >= 8  # counters stay monotonic
+
+    def test_peer_unit_cost_and_hold_constants(self):
+        from harmony_tpu.runtime.taskunit import GlobalTaskUnitScheduler
+
+        g = GlobalTaskUnitScheduler()
+        g.on_job_start("cheap", ["w0"])
+        g.on_job_start("pricey", ["w0"])
+        g.report_unit_cost("pricey", 0.5)
+        assert g.peer_unit_cost("cheap") == 0.5
+        assert g.peer_unit_cost("pricey") == 0.0  # cheap unmeasured
+        assert 0.0 < GlobalTaskUnitScheduler.RESERVE_WINDOW < 1.0
