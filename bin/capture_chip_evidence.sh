@@ -55,6 +55,12 @@ run_stage "benchmarks/PROFILE_${SUF}.json" python benchmarks/profile_headline.py
 echo "== per-app throughput (benchmarks/apps.py — straggler diagnosis)"
 run_stage "benchmarks/APPS_${SUF}.json" python benchmarks/apps.py all
 
+echo "== ON-CHIP multi-tenant fairness (benchmarks/fairness.py — the"
+echo "   round-3 verdict's unmeasured arm: share_all WFQ on async dispatch)"
+# distinct name: FAIRNESS_<round>.json is the committed N-run CPU series
+# (fairness_series.py) — a single chip run must never clobber it
+run_stage "benchmarks/FAIRNESS_CHIP_${SUF}.json" python benchmarks/fairness.py
+
 echo "== single-chip compile check (__graft_entry__.entry)"
 entry_rc=0
 timeout "$STAGE_TIMEOUT" python - <<'EOF' || entry_rc=$?

@@ -44,6 +44,7 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_concurrent_carved_tenants",
     "test_multihost.py::test_pod_share_all_overlapping_tenants",
     "test_multihost.py::test_pod_share_all_pregel_and_dolphin_overlap",
+    "test_multihost.py::test_pod_share_all_tenant_storm",
     "test_multihost.py::test_pod_reshard_multiworker_ssp",
     "test_multihost.py::test_pod_remote_only_plan_epoch_floor",
     "test_multihost.py::test_pod_admission_fifo_no_starvation",

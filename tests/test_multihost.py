@@ -755,6 +755,77 @@ def test_pod_share_all_pregel_and_dolphin_overlap():
         round(x, 5) for x in losses]
 
 
+def test_pod_share_all_tenant_storm():
+    """Chaos coverage for the cross-job unit protocol: SIX heterogeneous
+    tenants at once on one 2-process share_all pod — single-worker MLR x2,
+    a 2-worker SSP job (turnstile + units composed), PageRank (pregel
+    units), a pod_isolated job (exclusive execution via FIFO admission),
+    and a NMF local-table job. Every job must complete, converge, and
+    report IDENTICAL numbers from both processes (lockstep held under
+    arbitrary cross-tenant interleaving) — the wedge, if any dispatch
+    site escaped the unit discipline, shows up as a drain timeout."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    pod = PodHarness(2, 2)
+    cfgs = []
+    cfgs.append(_mlr_job("storm-m1", seed=51, epochs=3))
+    cfgs.append(_mlr_job("storm-m2", seed=52, epochs=3))
+    ssp = _mlr_job("storm-ssp", seed=53, epochs=3, num_workers=2)
+    ssp.params.clock_slack = 1
+    cfgs.append(ssp)
+    cfgs.append(JobConfig(
+        job_id="storm-pr", app_type="pregel",
+        trainer="harmony_tpu.apps.pagerank:PageRankComputation",
+        params=TrainerParams(app_params={"num_iterations": 6}),
+        user={"graph_fn": "harmony_tpu.pregel.graph:random_graph",
+              "graph_args": {"num_vertices": 48, "avg_degree": 4,
+                             "seed": 5},
+              "max_supersteps": 10},
+    ))
+    iso = _mlr_job("storm-iso", seed=54, epochs=2)
+    iso.user["pod_isolated"] = True
+    cfgs.append(iso)
+    cfgs.append(JobConfig(
+        job_id="storm-nmf", app_type="dolphin",
+        trainer="harmony_tpu.apps.nmf:NMFTrainer",
+        params=TrainerParams(
+            num_epochs=3, num_mini_batches=2,
+            app_params={"num_rows": 32, "num_cols": 16, "rank": 4,
+                        "step_size": 0.05},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.nmf:make_synthetic",
+              "data_args": {"num_rows": 32, "num_cols": 16, "rank": 4,
+                            "seed": 55}},
+    ))
+    try:
+        pod.wait_ready()
+        for cfg in cfgs:
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        pod.drain(timeout=420)
+        result = pod.finish()
+    finally:
+        pod.kill()
+    for cfg in cfgs:
+        res = result["local_results"][cfg.job_id]
+        assert "error" not in res, (cfg.job_id, res)
+    # dolphin jobs: converged, and the follower reports identical series
+    for jid in ("storm-m1", "storm-m2", "storm-ssp", "storm-iso",
+                "storm-nmf"):
+        res = result["local_results"][jid]
+        series = {wid: w["losses"] for wid, w in res.items()
+                  if isinstance(w, dict) and "losses" in w}
+        assert series, (jid, res)
+        follower = result["pod_reports"][jid]["1"]
+        assert follower["ok"], (jid, follower)
+        for wid, losses in series.items():
+            assert losses[-1] <= losses[0] + 1e-6, (jid, wid, losses)
+            assert [round(x, 5)
+                    for x in follower["workers"][wid]["losses"]] == [
+                round(x, 5) for x in losses], (jid, wid)
+    assert result["local_results"]["storm-pr"]["supersteps"] > 1
+
+
 def test_pod_admission_fifo_no_starvation():
     """Admission fairness (round-3 verdict item 6): serialized pod-
     spanning jobs (user.pod_isolated opts out of the unit protocol into
