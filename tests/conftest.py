@@ -42,7 +42,8 @@ jax.config.update("jax_platforms", "cpu")
 _SLOW_TESTS = {
     "test_multihost.py::test_two_process_distributed_job",
     "test_multihost.py::test_pod_concurrent_carved_tenants",
-    "test_multihost.py::test_pod_share_all_overlapping_tenants",
+    "test_multihost.py::test_pod_share_all_overlapping_tenants[2-4]",
+    "test_multihost.py::test_pod_share_all_overlapping_tenants[3-2]",
     "test_multihost.py::test_pod_share_all_pregel_and_dolphin_overlap",
     "test_multihost.py::test_pod_share_all_tenant_storm",
     "test_multihost.py::test_pod_reshard_multiworker_ssp",
