@@ -216,16 +216,27 @@ def build_config(app: str, args: argparse.Namespace) -> JobConfig:
     # work (same client-side validation stance as the --set overrides).
     if preset["app_type"] == "pregel" and (
         args.optimizer or args.model_chkp_period or args.offline_eval
+        or getattr(args, "auto_resume", False)
     ):
         raise SystemExit(
-            "--optimizer / --model-chkp-period / --offline-eval apply to "
-            "dolphin (training) apps only; pregel jobs ignore them"
+            "--optimizer / --model-chkp-period / --offline-eval / "
+            "--auto-resume apply to dolphin (training) apps only; pregel "
+            "jobs have no model table or checkpoint chain"
         )
     if args.offline_eval and args.model_chkp_period <= 0:
         raise SystemExit(
             "--offline-eval needs --model-chkp-period > 0: deferred "
             "evaluation replays the checkpoint chain, and 0 chains nothing"
         )
+    if getattr(args, "auto_resume", False):
+        if args.model_chkp_period <= 0:
+            raise SystemExit(
+                "--auto-resume needs --model-chkp-period > 0: resume "
+                "restores the last chain checkpoint, and 0 chains nothing"
+            )
+        user["auto_resume"] = True
+    if getattr(args, "pod_isolated", False):
+        user["pod_isolated"] = True
     if args.optimizer:
         from harmony_tpu.config.base import resolve_symbol
         from harmony_tpu.jobserver.entity import DolphinJobEntity
@@ -285,6 +296,14 @@ def _common_job_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--offline-eval", action="store_true",
                    help="defer model evaluation over the checkpoint chain to"
                         " jobserver shutdown")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="pod: on follower death, resubmit this job from its"
+                        " last chain checkpoint onto surviving executors"
+                        " (needs --model-chkp-period > 0)")
+    p.add_argument("--pod-isolated", action="store_true",
+                   help="pod: exclusive execution — opt out of the cross-job"
+                        " unit interleaving (serialized behind FIFO"
+                        " admission)")
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -357,6 +376,15 @@ def main(argv: List[str] | None = None) -> int:
 
     args = ap.parse_args(argv)
 
+    if args.cmd in ("start-jobserver", "start-pod", "run", "dashboard"):
+        # JAX_PLATFORMS=cpu must mean cpu even where an accelerator
+        # plugin hijacks backend init (and hangs on a wedged transport)
+        # — same entry-point rule the benchmarks follow. ONLY the
+        # jax-using commands: the thin TCP submit/status path must never
+        # import jax (platform.py imports it at module top).
+        from harmony_tpu.utils.platform import mirror_env_platform_request
+
+        mirror_env_platform_request()
     if args.cmd == "start-jobserver":
         return _cmd_start_jobserver(args)
     if args.cmd == "start-pod":

@@ -162,6 +162,14 @@ def test_cli_flags_reach_job_config():
     assert cfg.optimizer_period == 1.5
     assert cfg.params.model_chkp_period == 2
     assert cfg.params.offline_model_eval is True
+    # pod knobs: --auto-resume / --pod-isolated land in user{}
+    d = _Args(epochs=2, batches=2, workers=1)
+    d.model_chkp_period = 1
+    d.auto_resume = True
+    d.pod_isolated = True
+    cfg = build_config("mlr", d)
+    assert cfg.user["auto_resume"] is True
+    assert cfg.user["pod_isolated"] is True
 
 
 def test_cli_rejects_misconfigured_flags():
@@ -180,6 +188,10 @@ def test_cli_rejects_misconfigured_flags():
     c.optimizer = "homogeneous"  # dolphin-only flag on a graph app
     with pytest.raises(SystemExit, match="dolphin"):
         build_config("pagerank", c)
+    d = _Args()
+    d.auto_resume = True  # no chain to restore from
+    with pytest.raises(SystemExit, match="model-chkp-period"):
+        build_config("mlr", d)
 
 
 def test_lm_preset_with_file_corpus(tmp_path):
