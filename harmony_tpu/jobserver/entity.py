@@ -312,17 +312,12 @@ class DolphinJobEntity(JobEntity):
             if spans:
                 # Pod checkpoint chains ride the synchronous collective
                 # path (ModelChkpManager.on_epoch -> CheckpointManager
-                # pod branch). Legal only with ONE dispatch thread per
-                # process — under a turnstile the hook runs outside turns
-                # and its collective would race the schedule — and only
-                # with a SHARED chkp root (each process stages its own
-                # blocks into one checkpoint directory).
-                if num_workers != 1:
-                    raise ValueError(
-                        f"job {cfg.job_id}: model_chkp_period > 0 on a "
-                        "multi-process grant needs num_workers=1 (the "
-                        "epoch hook dispatches outside turnstile turns)"
-                    )
+                # pod branch). Legal for ANY worker count: the epoch hook
+                # runs INSIDE the chief's turnstile turn (_finish_epoch),
+                # the same deterministic cycle slot on every process —
+                # the same argument that admits pod reshard plans. Needs
+                # a SHARED chkp root (each process stages its own blocks
+                # into one checkpoint directory).
                 if self.chkp_root is None:
                     raise ValueError(
                         f"job {cfg.job_id}: pod checkpoint chains need a "

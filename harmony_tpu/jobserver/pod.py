@@ -832,7 +832,6 @@ class PodJobServer(JobServer):
             self.master.executor(e).device.process_index
             for e in executor_ids
         }
-        workers = config.num_workers or len(executor_ids)
         if len(procs) > 1:
             extras: Dict[str, Any] = {
                 "pod_plan_sink": self.schedule_pod_reshard,
@@ -845,24 +844,25 @@ class PodJobServer(JobServer):
                 client = leader_client(self.pod_units, config.job_id)
                 extras["pod_unit_scope"] = client.scope
                 extras["pod_unit_contended"] = client.contended
-            if workers == 1:
-                # The collective deferred eval stays single-dispatch-
-                # thread-only (the checkpoint chain it replays is).
-                extras["pod_eval_channel"] = self._pod_eval_channel
-                if (config.params.offline_model_eval
-                        and config.params.model_chkp_period > 0):
-                    # registered ONLY for jobs that will actually run the
-                    # collective eval at shutdown — unconditional
-                    # registration would let unrelated jobs FIFO-evict a
-                    # live entry and turn its broadcast into a silent
-                    # no-op (the leader would then evaluate alone and
-                    # wedge in its collectives)
-                    participants = sorted(p for p in procs if p != 0)
-                    with self._pod_cond:
-                        self._eval_participants[config.job_id] = participants
-                        while len(self._eval_participants) > 1024:
-                            self._eval_participants.pop(
-                                next(iter(self._eval_participants)))
+            # The collective deferred eval runs at SHUTDOWN on one thread
+            # per process — worker-count independent (the chain it
+            # replays is now written for any worker count too: the
+            # snapshot hook rides the chief's turnstile turn).
+            extras["pod_eval_channel"] = self._pod_eval_channel
+            if (config.params.offline_model_eval
+                    and config.params.model_chkp_period > 0):
+                # registered ONLY for jobs that will actually run the
+                # collective eval at shutdown — unconditional
+                # registration would let unrelated jobs FIFO-evict a
+                # live entry and turn its broadcast into a silent
+                # no-op (the leader would then evaluate alone and
+                # wedge in its collectives)
+                participants = sorted(p for p in procs if p != 0)
+                with self._pod_cond:
+                    self._eval_participants[config.job_id] = participants
+                    while len(self._eval_participants) > 1024:
+                        self._eval_participants.pop(
+                            next(iter(self._eval_participants)))
             return extras
         return {}
 

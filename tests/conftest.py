@@ -55,10 +55,12 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_auto_resume_after_follower_death",
     "test_multihost.py::test_pod_checkpoint_restore_cross_topology",
     "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent",
+    "test_multihost.py::test_pod_multiworker_chkp_chain_matches_lockstep",
     "test_multihost.py::test_pod_live_reshard_across_process_subsets",
     "test_multihost.py::test_pod_plan_driven_migration_mid_training",
     "test_multihost.py::test_pod_optimizer_loop_elasticity",
-    "test_multihost.py::test_pod_collective_deferred_eval",
+    "test_multihost.py::test_pod_collective_deferred_eval[1]",
+    "test_multihost.py::test_pod_collective_deferred_eval[2]",
     "test_multihost.py::test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline",
     "test_multihost.py::test_pod_jobserver_end_to_end[2-4]",
     "test_multihost.py::test_pod_jobserver_end_to_end[3-2]",

@@ -1077,19 +1077,24 @@ def test_pod_auto_resume_after_follower_death(tmp_path):
         server.shutdown(timeout=60)
 
 
-def test_pod_collective_deferred_eval(tmp_path):
+@pytest.mark.parametrize("workers", [1, 2])
+def test_pod_collective_deferred_eval(tmp_path, workers):
     """Shutdown-stage deferred model evaluation as a POD COLLECTIVE (the
     last single-process-only leg of §5.4): a whole-pod job chains
     checkpoints; at graceful shutdown the leader broadcasts
     EVAL_COLLECTIVE and every process replays the same restore+evaluate
     collectives in lockstep; the leader's eval_results carries one metric
     dict per chained checkpoint and every worker process exits cleanly
-    (a wedged follower would hang the reap)."""
+    (a wedged follower would hang the reap). Parametrized over worker
+    counts: the round-4 guard lift means multi-worker (turnstiled) jobs
+    chain AND collectively evaluate too."""
     root = str(tmp_path)
     pod = PodHarness(2, 4, env_extra={"HARMONY_POD_CHKP_ROOT": root})
     try:
         pod.wait_ready()
-        cfg = _mlr_job("pod-ev", seed=6, epochs=2)
+        cfg = _mlr_job("pod-ev", seed=6, epochs=2, num_workers=workers)
+        if workers > 1:
+            cfg.params.clock_slack = 1
         cfg.params.model_chkp_period = 1
         cfg.params.offline_model_eval = True
         resp = pod.sender.send_job_submit_command(cfg)
@@ -1188,6 +1193,84 @@ def test_pod_training_chkp_chain_restores_in_parent(tmp_path):
         assert arr.shape[0] == h.table.spec.config.capacity
         assert np.isfinite(arr).all()
         h.drop()
+
+
+def test_pod_multiworker_chkp_chain_matches_lockstep(tmp_path):
+    """Checkpoint chains for MULTI-worker pod jobs (the last worker-count
+    restriction, now lifted: the snapshot hook rides the chief's
+    turnstile turn — the same deterministic cycle slot on every process
+    that admits reshard plans). A 2-worker SSP job spanning the
+    2-process mesh chains its model table every 2 epochs; the LAST chain
+    checkpoint's restored values must EXACTLY equal those of the same
+    config run single-process under force_lockstep (identical schedule
+    => identical table at the snapshot's cycle slot)."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    root = str(tmp_path)
+    pod = PodHarness(2, 4, env_extra={"HARMONY_POD_CHKP_ROOT": root})
+
+    def cfg_of(job_id: str, force_lockstep: bool) -> JobConfig:
+        return JobConfig(
+            job_id=job_id, app_type="dolphin",
+            trainer="harmony_tpu.apps.mlr:MLRTrainer",
+            params=TrainerParams(
+                num_epochs=4, num_mini_batches=4, clock_slack=1,
+                model_chkp_period=2,
+                app_params={"num_classes": 4, "num_features": 16,
+                            "features_per_partition": 4, "step_size": 0.1},
+            ),
+            num_workers=2,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 27},
+                  **({"force_lockstep": True} if force_lockstep else {})},
+        )
+
+    try:
+        pod.wait_ready()
+        resp = pod.sender.send_job_submit_command(cfg_of("mw-chain", False))
+        assert resp.get("ok"), resp
+        pod.drain()
+        result = pod.finish()
+    finally:
+        pod.kill()
+    res = result["local_results"]["mw-chain"]
+    assert "error" not in res, res
+    chkp_ids = res["model_chkp_ids"]
+    assert len(chkp_ids) == 2 and all(c.endswith("-pod") for c in chkp_ids), (
+        chkp_ids)
+    # lockstep baseline in THIS process, chaining to its own root
+    import numpy as np
+
+    from harmony_tpu.checkpoint.manager import CheckpointManager
+    from harmony_tpu.jobserver.server import JobServer
+    from harmony_tpu.runtime.master import ETMaster
+
+    base_root = os.path.join(root, "baseline")
+    server = JobServer(num_executors=8, chkp_root=base_root)
+    server.start()
+    try:
+        iso = server.submit(cfg_of("mw-chain", True)).result(timeout=240)
+    finally:
+        server.shutdown(timeout=60)
+    iso_ids = iso["model_chkp_ids"]
+    assert len(iso_ids) == 2, iso_ids
+    # restore BOTH final checkpoints here and compare values exactly
+    master = ETMaster()
+    execs = [e.id for e in master.add_executors(4)]
+    pod_mgr = CheckpointManager.for_job(root, "mw-chain")
+    iso_mgr = CheckpointManager.for_job(base_root, "mw-chain")
+    hp = pod_mgr.restore(master, chkp_ids[-1], execs, table_id="pod-last")
+    hi = iso_mgr.restore(master, iso_ids[-1], execs, table_id="iso-last")
+    ap = np.asarray(hp.table.pull_array())
+    ai = np.asarray(hi.table.pull_array())
+    assert np.allclose(ap, ai, atol=1e-6), float(np.abs(ap - ai).max())
+    # both tagged with the same snapshot epoch (the resume key)
+    assert (pod_mgr.info(chkp_ids[-1]).app_meta
+            == iso_mgr.info(iso_ids[-1]).app_meta), (
+        pod_mgr.info(chkp_ids[-1]).app_meta,
+        iso_mgr.info(iso_ids[-1]).app_meta)
+    hp.drop()
+    hi.drop()
 
 
 def test_pod_ssp_multiworker_gates_and_matches_lockstep_baseline():
