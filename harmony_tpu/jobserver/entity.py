@@ -237,12 +237,23 @@ class DolphinJobEntity(JobEntity):
     def _restore_chain(self, master: ETMaster, executor_ids: List[str],
                        data_axis: int):
         """Rebuild the model table from the MOST RECENTLY WRITTEN chain
-        checkpoint (by manifest created_at — id counters are NOT a
-        reliable epoch clock: the pod id scan skips past a stale run's
-        ids, and a resubmitted single-process chain restarts its counter)
-        and resume at the EPOCH the manifest records (chain entries carry
-        app_meta={"epoch": e}; the snapshot covers epoch e, so training
-        resumes at e+1). Returns (handle, starting_epoch, counter_base)."""
+        checkpoint (by the monotonic epoch tag; created_at tie-breaks —
+        id counters are NOT a reliable epoch clock: the pod id scan skips
+        past a stale run's ids, and a resubmitted single-process chain
+        restarts its counter) and resume at the EPOCH the manifest
+        records (chain entries carry app_meta={"epoch": e}; the snapshot
+        covers epoch e, so training resumes at e+1).
+
+        Exactness: single-worker resume is numerically identical to an
+        uninterrupted run (the snapshot is a clean epoch cut). For
+        multi-worker SSP jobs the snapshot is a CONSISTENT table state at
+        the chief's hook slot that may already contain sibling pushes
+        from their in-flight epoch; resuming replays those — approximate,
+        exactly like the reference's StartingEpochIdx resume (workers
+        restart from global MIN progress and re-apply beyond it), and
+        acceptable under bounded-staleness semantics.
+
+        Returns (handle, starting_epoch, counter_base)."""
         from harmony_tpu.checkpoint.manager import CheckpointManager
 
         cfg = self.config
