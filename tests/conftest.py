@@ -53,6 +53,7 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_killed_follower_poisons_fast",
     "test_multihost.py::test_pod_live_grow_mid_training",
     "test_multihost.py::test_pod_auto_resume_after_follower_death",
+    "test_multihost.py::test_pod_auto_resume_multiworker_completes",
     "test_multihost.py::test_pod_checkpoint_restore_cross_topology",
     "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent",
     "test_multihost.py::test_pod_multiworker_chkp_chain_matches_lockstep",
