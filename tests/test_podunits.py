@@ -1,0 +1,203 @@
+"""Direct tests for the cross-job pod unit arbiter (runtime/podunits.py)
+— the protocol the share-all pod e2e tests exercise end to end, pinned
+here at the unit level: serialization of process-overlapping jobs,
+concurrency of disjoint ones, deficit-fair ordering with hold-back,
+deregistration/poison release paths, and the contended flag's
+read-at-exit semantics. Pure host-side threading; no jax."""
+import threading
+import time
+
+import pytest
+
+from harmony_tpu.runtime.podunits import (
+    FollowerUnits,
+    PodUnitArbiter,
+    follower_client,
+    leader_client,
+)
+
+
+class _Wire:
+    """Captures leader->follower sends; exposes per-pid grant lists."""
+
+    def __init__(self):
+        self.sent = []
+
+    def __call__(self, pid, msg):
+        self.sent.append((pid, dict(msg)))
+
+    def grants(self, pid=None):
+        return [(p, m["job_id"], m["seq"]) for p, m in self.sent
+                if m.get("cmd") == "TU_GRANT"
+                and (pid is None or p == pid)]
+
+
+def test_overlapping_jobs_serialize_units():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("B", frozenset({1, 2}))
+    arb.on_wait("A", 0, 1)
+    arb.on_wait("B", 0, 1)
+    # A granted (first arrival at equal deficits); B must NOT be granted
+    # while A's unit is outstanding on overlapping processes
+    assert ("A", 0) in [(j, s) for _, j, s in w.grants()]
+    assert ("B", 0) not in [(j, s) for _, j, s in w.grants()]
+    arb.on_done("A", 0, 1)
+    assert ("B", 0) not in [(j, s) for _, j, s in w.grants()]  # pid 2 left
+    arb.on_done("A", 0, 2)
+    assert ("B", 0) in [(j, s) for _, j, s in w.grants()]
+
+
+def test_disjoint_jobs_grant_concurrently():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1}))
+    arb.register_job("B", frozenset({2}))
+    arb.on_wait("A", 0, 1)
+    arb.on_wait("B", 0, 2)
+    granted = [(j, s) for _, j, s in w.grants()]
+    assert ("A", 0) in granted and ("B", 0) in granted  # no serialization
+
+
+def test_same_job_units_pipeline_without_full_done():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.on_wait("A", 0, 1)
+    arb.on_done("A", 0, 1)   # pid 2 still inside unit 0
+    arb.on_wait("A", 1, 1)   # pid 1 announces its next unit
+    # intra-job skew is legal: unit 1 grants while unit 0 is not fully
+    # done (same program order per process; collectives self-order)
+    assert ("A", 1) in [(j, s) for _, j, s in w.grants()]
+
+
+def test_deficit_orders_grants_lowest_served_first():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1}))
+    arb.register_job("B", frozenset({1}))
+    # A consumes a long unit; B a short one — then both ask again
+    arb.on_wait("A", 0, 1)
+    time.sleep(0.05)
+    arb.on_done("A", 0, 1)
+    arb.on_wait("B", 0, 1)
+    arb.on_done("B", 0, 1)
+    # next round: a blocker queues BOTH, then releases — the grant must
+    # go to B (lower grant-to-done deficit) first, and A only after B's
+    # unit completes (overlapping jobs never overlap units)
+    arb.register_job("C", frozenset({1}))
+    arb.on_wait("C", 0, 1)
+    arb.on_wait("A", 1, 1)
+    arb.on_wait("B", 1, 1)
+    arb.on_done("C", 0, 1)
+    granted = [(j, s) for _, j, s in w.grants()]
+    assert ("B", 1) in granted and ("A", 1) not in granted
+    arb.on_done("B", 1, 1)
+    assert ("A", 1) in [(j, s) for _, j, s in w.grants()]
+
+
+def test_holdback_reserves_processes_for_lowest_deficit_waiter():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("B", frozenset({1, 2}))
+    arb.on_wait("A", 0, 1)            # A outstanding on {1,2}
+    # B waits (blocked by A); C — overlapping B's procs, HIGHER deficit
+    # by later arrival — must not jump B when A finishes
+    arb.register_job("C", frozenset({2}))
+    arb.on_wait("B", 0, 1)
+    arb.on_wait("C", 0, 2)
+    arb.on_done("A", 0, 1)
+    arb.on_done("A", 0, 2)
+    granted = [(j, s) for _, j, s in w.grants()]
+    assert ("B", 0) in granted
+    # C overlaps B; with B blocked first at equal deficit, B's reservation
+    # held process 2 — C grants only after B's unit completes
+    if ("C", 0) in granted:
+        assert granted.index(("B", 0)) < granted.index(("C", 0))
+
+
+def test_deregister_releases_peers():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1}))
+    arb.register_job("B", frozenset({1}))
+    arb.on_wait("A", 0, 1)            # A outstanding
+    arb.on_wait("B", 0, 1)            # B blocked behind it
+    assert ("B", 0) not in [(j, s) for _, j, s in w.grants()]
+    arb.deregister_job("A")           # A died without DONE
+    assert ("B", 0) in [(j, s) for _, j, s in w.grants()]
+
+
+def test_proc_done_unsticks_outstanding():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("B", frozenset({3}))
+    arb.on_wait("A", 0, 1)
+    arb.on_done("A", 0, 1)            # pid 2 vanishes before its DONE
+    arb.register_job("C", frozenset({1, 2}))
+    arb.on_wait("C", 0, 1)
+    assert ("C", 0) not in [(j, s) for _, j, s in w.grants()]
+    arb.proc_done(2)                  # reader-EOF path clears dead pid
+    assert ("C", 0) in [(j, s) for _, j, s in w.grants()]
+
+
+def test_poison_grants_everything_and_future_waits():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("B", frozenset({1, 2}))
+    arb.on_wait("A", 0, 1)
+    arb.on_wait("B", 0, 1)            # blocked
+    arb.poison()
+    assert ("B", 0) in [(j, s) for _, j, s in w.grants()]
+    # post-poison waits grant immediately too (unknown-or-poisoned path)
+    arb.on_wait("B", 1, 2)
+    assert ("B", 1) in [(j, s) for _, j, s in w.grants(pid=2)]
+
+
+def test_leader_client_contended_flag_reads_at_exit():
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({0}))
+    c = leader_client(arb, "A")
+    with c.scope():
+        pass
+    assert c.contended() is False
+    arb.register_job("B", frozenset({0}))
+    with c.scope():
+        pass
+    assert c.contended() is True      # flag rode THIS unit's grant
+
+
+def test_local_wait_timeout_raises():
+    arb = PodUnitArbiter(send_to=lambda p, m: None)
+    arb.register_job("A", frozenset({0, 1}))
+    arb.register_job("B", frozenset({0, 1}))
+    arb.on_wait("A", 0, 1)            # A outstanding forever
+    with pytest.raises(RuntimeError, match="not granted"):
+        arb.local_wait("B", 0, timeout=0.2)
+
+
+def test_follower_units_grant_before_wait_and_poison():
+    fu = FollowerUnits(report=lambda m: None)
+    fu.on_grant("J", 0, contended=True)  # grant arrives first
+    c = follower_client(fu, "J")
+    with c.scope():                       # passes immediately
+        pass
+    assert c.contended() is True
+    done = {}
+
+    def waiter():
+        done["flag"] = fu.wait("J", 5, timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive()                   # seq 5 not granted yet
+    fu.on_poison()
+    t.join(5.0)
+    assert not t.is_alive() and done["flag"] is False
+    fu.forget("J")
