@@ -5,7 +5,6 @@ concurrency of disjoint ones, deficit-fair ordering with hold-back,
 deregistration/poison release paths, and the contended flag's
 read-at-exit semantics. Pure host-side threading; no jax."""
 import threading
-import time
 
 import pytest
 
@@ -77,16 +76,19 @@ def test_deficit_orders_grants_lowest_served_first():
     arb = PodUnitArbiter(send_to=w)
     arb.register_job("A", frozenset({1}))
     arb.register_job("B", frozenset({1}))
-    # A consumes a long unit; B a short one — then both ask again
     arb.on_wait("A", 0, 1)
-    time.sleep(0.05)
     arb.on_done("A", 0, 1)
     arb.on_wait("B", 0, 1)
     arb.on_done("B", 0, 1)
-    # next round: a blocker queues BOTH, then releases — the grant must
-    # go to B (lower grant-to-done deficit) first, and A only after B's
-    # unit completes (overlapping jobs never overlap units)
+    # pin the accumulated deficits DETERMINISTICALLY (wall-clock charges
+    # on a loaded 1-core host are flaky): A far ahead of B
+    arb._jobs["A"].deficit = 1.0
+    arb._jobs["B"].deficit = 0.0
+    # a blocker queues BOTH, then releases — the grant must go to B
+    # (lower deficit) first, and A only after B's unit completes
+    # (overlapping jobs never overlap units)
     arb.register_job("C", frozenset({1}))
+    arb._jobs["C"].deficit = 0.0  # late arrival starts at min active
     arb.on_wait("C", 0, 1)
     arb.on_wait("A", 1, 1)
     arb.on_wait("B", 1, 1)
@@ -100,22 +102,22 @@ def test_deficit_orders_grants_lowest_served_first():
 def test_holdback_reserves_processes_for_lowest_deficit_waiter():
     w = _Wire()
     arb = PodUnitArbiter(send_to=w)
-    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("A", frozenset({1}))
     arb.register_job("B", frozenset({1, 2}))
-    arb.on_wait("A", 0, 1)            # A outstanding on {1,2}
-    # B waits (blocked by A); C — overlapping B's procs, HIGHER deficit
-    # by later arrival — must not jump B when A finishes
     arb.register_job("C", frozenset({2}))
-    arb.on_wait("B", 0, 1)
-    arb.on_wait("C", 0, 2)
-    arb.on_done("A", 0, 1)
-    arb.on_done("A", 0, 2)
+    arb.on_wait("A", 0, 1)            # A outstanding on {1} only
+    arb.on_wait("B", 0, 1)            # blocked by A; RESERVES {1,2}
+    arb.on_wait("C", 0, 2)            # disjoint from A's outstanding —
     granted = [(j, s) for _, j, s in w.grants()]
-    assert ("B", 0) in granted
-    # C overlaps B; with B blocked first at equal deficit, B's reservation
-    # held process 2 — C grants only after B's unit completes
-    if ("C", 0) in granted:
-        assert granted.index(("B", 0)) < granted.index(("C", 0))
+    # — but held back: without the reservation C would stream over the
+    # blocked lower-deficit B and starve it
+    assert ("B", 0) not in granted and ("C", 0) not in granted
+    arb.on_done("A", 0, 1)
+    granted = [(j, s) for _, j, s in w.grants()]
+    assert ("B", 0) in granted and ("C", 0) not in granted
+    arb.on_done("B", 0, 1)
+    arb.on_done("B", 0, 2)
+    assert ("C", 0) in [(j, s) for _, j, s in w.grants()]
 
 
 def test_deregister_releases_peers():
@@ -134,7 +136,6 @@ def test_proc_done_unsticks_outstanding():
     w = _Wire()
     arb = PodUnitArbiter(send_to=w)
     arb.register_job("A", frozenset({1, 2}))
-    arb.register_job("B", frozenset({3}))
     arb.on_wait("A", 0, 1)
     arb.on_done("A", 0, 1)            # pid 2 vanishes before its DONE
     arb.register_job("C", frozenset({1, 2}))
