@@ -335,7 +335,8 @@ class PodJobServer(JobServer):
                 continue
             if msg.get("cmd") == "TU_WAIT":
                 self.pod_units.on_wait(
-                    str(msg.get("job_id")), int(msg.get("seq", 0)), pid
+                    str(msg.get("job_id")), int(msg.get("seq", 0)), pid,
+                    retry=bool(msg.get("retry", False)),
                 )
                 continue
             if msg.get("cmd") == "TU_DONE":

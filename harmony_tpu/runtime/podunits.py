@@ -59,9 +59,35 @@ def _default_timeout() -> float:
     return float(os.environ.get("HARMONY_POD_UNIT_TIMEOUT", "600"))
 
 
+def _retry_interval() -> float:
+    """How long a blocked follower waits before re-announcing TU_WAIT with
+    ``retry=True``. The retry announce forces the leader to re-send the
+    grant even when its original broadcast send succeeded — the one
+    self-healing path that covers BOTH loss modes (a send that failed
+    after the announce arrived, and a delivered grant the follower since
+    evicted). Cold path only: the hot path stays at one grant message per
+    (unit, pid). ``<= 0`` disables retries; tiny values clamp to 0.1s so a
+    misconfiguration cannot busy-spin the wait loop."""
+    v = float(os.environ.get("HARMONY_POD_UNIT_RETRY", "10"))
+    if v <= 0:
+        return float("inf")
+    return max(v, 0.1)
+
+
+def _cap_evict(d: Dict[int, Any], outstanding: Dict[int, Any],
+               cap: int) -> None:
+    """Evict oldest entries of ``d`` past ``cap``, but never one whose seq
+    is still outstanding — the repair path may yet need it."""
+    if len(d) > cap:
+        stale = [s for s in d if s not in outstanding]
+        for s in stale[:len(d) - cap]:
+            d.pop(s)
+
+
 class _JobState:
     __slots__ = ("procs", "next_grant", "pending", "outstanding",
-                 "granted_hi", "deficit", "grant_t0", "flags", "arrival")
+                 "granted_hi", "deficit", "grant_t0", "flags", "arrival",
+                 "unsent")
 
     def __init__(self, procs: frozenset, deficit: float, arrival: int) -> None:
         self.procs = procs
@@ -73,6 +99,7 @@ class _JobState:
         self.grant_t0: Dict[int, float] = {}
         self.flags: Dict[int, bool] = {}  # seq -> contended (local reads)
         self.arrival = arrival
+        self.unsent: Dict[int, Set[int]] = {}  # seq -> pids whose send failed
 
 
 class PodUnitArbiter:
@@ -120,7 +147,8 @@ class PodUnitArbiter:
 
     # -- protocol ---------------------------------------------------------
 
-    def on_wait(self, job_id: str, seq: int, pid: int) -> None:
+    def on_wait(self, job_id: str, seq: int, pid: int,
+                retry: bool = False) -> None:
         with self._cond:
             st = self._jobs.get(job_id)
             if st is None or self._poisoned:
@@ -135,7 +163,24 @@ class PodUnitArbiter:
                 return
             seq = int(seq)
             if seq <= st.granted_hi:
-                return  # already granted (this process arrived late)
+                # Already granted — this process announced late. Repair
+                # (re-send the grant) when the original broadcast send to
+                # this pid FAILED, or when the follower explicitly asks
+                # (``retry=True``: it has been blocked past the retry
+                # interval, so whatever we sent it is lost to it — e.g.
+                # a grant it received early and then evicted). A normal
+                # late announce after a SUCCEEDED send is not repaired:
+                # TCP orders that grant ahead of anything the announce
+                # could race with, so the steady-state path stays at one
+                # grant message per (unit, pid).
+                if pid != 0 and (retry or pid in st.unsent.get(seq, ())):
+                    if self._send_grant(pid, job_id, seq,
+                                        bool(st.flags.get(seq, False))):
+                        if seq in st.unsent:
+                            st.unsent[seq].discard(pid)
+                            if not st.unsent[seq]:
+                                del st.unsent[seq]
+                return
             st.pending.add(seq)
             self._maybe_grant_locked()
 
@@ -169,6 +214,10 @@ class PodUnitArbiter:
                     if not st.outstanding[seq]:
                         del st.outstanding[seq]
                         st.grant_t0.pop(seq, None)
+                for seq in list(st.unsent):
+                    st.unsent[seq].discard(pid)  # dead pid never announces
+                    if not st.unsent[seq]:
+                        del st.unsent[seq]
             self._maybe_grant_locked()
             self._cond.notify_all()
 
@@ -180,12 +229,13 @@ class PodUnitArbiter:
         )
 
     def _send_grant(self, pid: int, job_id: str, seq: int,
-                    contended: bool) -> None:
+                    contended: bool) -> bool:
         try:
             self._send_to(pid, {"cmd": "TU_GRANT", "job_id": job_id,
                                 "seq": seq, "contended": contended})
+            return True
         except OSError:
-            pass  # dead follower: the reader loop poisons the pod
+            return False  # dead follower: the reader loop poisons the pod
 
     def _grant_locked(self, job_id: str, st: _JobState, seq: int,
                       contended: bool) -> None:
@@ -195,11 +245,11 @@ class PodUnitArbiter:
         st.outstanding[seq] = set(st.procs)
         st.grant_t0[seq] = time.monotonic()
         st.flags[seq] = contended
-        while len(st.flags) > 1024:
-            st.flags.pop(next(iter(st.flags)))
+        _cap_evict(st.flags, st.outstanding, 1024)
         for pid in sorted(st.procs):
-            if pid != 0:
-                self._send_grant(pid, job_id, seq, contended)
+            if pid != 0 and not self._send_grant(pid, job_id, seq, contended):
+                st.unsent.setdefault(seq, set()).add(pid)
+        _cap_evict(st.unsent, st.outstanding, 1024)
         # pid 0 (leader-local client) reads granted_hi under the condition
 
     def _maybe_grant_locked(self) -> None:
@@ -274,14 +324,23 @@ class FollowerUnits:
         self._report = report
         self._cond = threading.Condition()
         self._states: Dict[str, Dict[str, Any]] = {}
+        self._waiting: Dict[str, int] = {}  # job_id -> active wait() count
         self._poisoned = False
 
     def _state(self, job_id: str) -> Dict[str, Any]:
         st = self._states.get(job_id)
         if st is None:
             st = self._states[job_id] = {"hi": -1, "flags": {}}
-            while len(self._states) > self._MAX_STATES:
-                self._states.pop(next(iter(self._states)))
+            if len(self._states) > self._MAX_STATES:
+                # Evict oldest states, but NEVER one a local thread is
+                # actively waiting on — dropping a live job's grant
+                # watermark would turn an already-arrived grant into a
+                # deadlock. If every state is live the map runs over the
+                # cap (bounded by thread count, a correctness-first trade).
+                evictable = [j for j in self._states
+                             if j != job_id and not self._waiting.get(j)]
+                for j in evictable[:len(self._states) - self._MAX_STATES]:
+                    self._states.pop(j)
         return st
 
     def on_grant(self, job_id: str, seq: int, contended: bool) -> None:
@@ -304,26 +363,52 @@ class FollowerUnits:
 
     def wait(self, job_id: str, seq: int,
              timeout: Optional[float] = None) -> bool:
-        self._report({"cmd": "TU_WAIT", "job_id": job_id, "seq": int(seq)})
-        deadline = time.monotonic() + (
-            _default_timeout() if timeout is None else timeout
-        )
+        # Register as a waiter BEFORE the TU_WAIT report goes out: the
+        # report can trigger the grant (and a flood of other jobs' grants)
+        # on the reader thread, and the eviction guard in _state() must
+        # already see this job as live by then.
         with self._cond:
+            self._waiting[job_id] = self._waiting.get(job_id, 0) + 1
+        try:
+            self._report({"cmd": "TU_WAIT", "job_id": job_id,
+                          "seq": int(seq)})
+            deadline = time.monotonic() + (
+                _default_timeout() if timeout is None else timeout
+            )
+            retry_s = _retry_interval()
+            next_retry = time.monotonic() + retry_s
             while True:
-                st = self._states.get(job_id)
-                if self._poisoned:
-                    return False
-                if st is not None and st["hi"] >= seq:
-                    return bool(st["flags"].get(int(seq), False))
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise RuntimeError(
-                        f"pod unit ({job_id}, {seq}) not granted after "
-                        f"{_default_timeout() if timeout is None else timeout}"
-                        "s — a dispatch site outside the unit discipline, "
-                        "or a wedged tenant"
-                    )
-                self._cond.wait(timeout=min(remaining, 5.0))
+                with self._cond:
+                    st = self._states.get(job_id)
+                    if self._poisoned:
+                        return False
+                    if st is not None and st["hi"] >= seq:
+                        return bool(st["flags"].get(int(seq), False))
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise RuntimeError(
+                            f"pod unit ({job_id}, {seq}) not granted after "
+                            f"{_default_timeout() if timeout is None else timeout}"
+                            "s — a dispatch site outside the unit discipline, "
+                            "or a wedged tenant"
+                        )
+                    self._cond.wait(timeout=min(
+                        remaining, next_retry - time.monotonic(), 5.0))
+                # blocked past the retry interval: re-announce with
+                # retry=True (outside the lock — socket IO) so the leader
+                # force-resends the grant; self-heals a failed broadcast
+                # send AND a delivered-then-evicted grant state
+                if time.monotonic() >= next_retry:
+                    self._report({"cmd": "TU_WAIT", "job_id": job_id,
+                                  "seq": int(seq), "retry": True})
+                    next_retry = time.monotonic() + retry_s
+        finally:
+            with self._cond:
+                n = self._waiting.get(job_id, 1) - 1
+                if n <= 0:
+                    self._waiting.pop(job_id, None)
+                else:
+                    self._waiting[job_id] = n
 
     def done(self, job_id: str, seq: int) -> None:
         self._report({"cmd": "TU_DONE", "job_id": job_id, "seq": int(seq)})

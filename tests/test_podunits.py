@@ -202,3 +202,116 @@ def test_follower_units_grant_before_wait_and_poison():
     t.join(5.0)
     assert not t.is_alive() and done["flag"] is False
     fu.forget("J")
+
+
+def test_follower_eviction_never_drops_actively_waited_job():
+    """Cap-pressure eviction (>_MAX_STATES grant states) must skip a job a
+    local thread is blocked in wait() on — dropping its watermark would
+    turn an already-arrived grant into a missed wakeup. Regression: the
+    old insertion-order eviction popped the oldest state unconditionally."""
+    fu = FollowerUnits(report=lambda m: None)
+    done = {}
+
+    def waiter():
+        done["flag"] = fu.wait("LIVE", 0, timeout=30.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # wait until the waiter has registered itself (state may not exist yet
+    # — grant-side creates it — but the waiting count must)
+    for _ in range(100):
+        with fu._cond:
+            if fu._waiting.get("LIVE"):
+                break
+        t.join(0.02)
+    assert fu._waiting.get("LIVE") == 1
+    # flood the tracker far past the cap with dead-job grants
+    for i in range(FollowerUnits._MAX_STATES + 64):
+        fu.on_grant(f"dead-{i}", 0, contended=False)
+    # the LIVE job's grant now arrives; the waiter must see it even though
+    # hundreds of grants passed through since it started waiting
+    fu.on_grant("LIVE", 0, contended=True)
+    t.join(5.0)
+    assert not t.is_alive() and done["flag"] is True
+    # and the cap still bounds the map (only non-waited states evicted)
+    assert len(fu._states) <= FollowerUnits._MAX_STATES + 1
+
+
+class _FlakyWire(_Wire):
+    """Wire that drops (raises OSError for) sends to pids in ``down``."""
+
+    def __init__(self):
+        super().__init__()
+        self.down = set()
+
+    def __call__(self, pid, msg):
+        if pid in self.down:
+            raise OSError("transient send failure")
+        super().__call__(pid, msg)
+
+
+def test_on_wait_repairs_grant_whose_broadcast_send_failed():
+    """If the grant broadcast's send to a pid FAILED, that pid's late
+    TU_WAIT must get the grant re-sent (with the original contended flag)
+    — the arbiter may not assume the broadcast reached it. Succeeded sends
+    are NOT duplicated: steady-state stays one grant message per
+    (unit, pid)."""
+    w = _FlakyWire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.register_job("B", frozenset({1, 2}))  # makes A contended
+    w.down = {2}
+    arb.on_wait("A", 0, 1)               # broadcast: pid 1 ok, pid 2 FAILS
+    assert w.grants(pid=1) == [(1, "A", 0)]
+    assert w.grants(pid=2) == []
+    w.down = set()                       # transport heals
+    arb.on_wait("A", 0, 2)               # pid 2 announces late
+    assert w.grants(pid=2) == [(2, "A", 0)]
+    # the repair carried the unit's original contended flag
+    repaired = [m for p, m in w.sent if p == 2 and m["cmd"] == "TU_GRANT"]
+    assert repaired[-1]["contended"] is True
+    # a pid whose send SUCCEEDED gets no duplicate on a late announce
+    before = len(w.grants(pid=1))
+    arb.on_wait("A", 0, 1)               # duplicate announce, seq granted
+    assert len(w.grants(pid=1)) == before
+
+
+def test_retry_announce_forces_regrant_even_after_successful_send():
+    """A retry=True announce means the follower has been blocked past the
+    retry interval — whatever the leader sent is lost to it (e.g. a grant
+    delivered early and then evicted follower-side). The leader must
+    re-send unconditionally on retry, even though its original broadcast
+    send succeeded."""
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    arb.register_job("A", frozenset({1, 2}))
+    arb.on_wait("A", 0, 2)               # broadcast reaches both pids
+    assert w.grants(pid=2) == [(2, "A", 0)]
+    arb.on_wait("A", 0, 2, retry=True)   # follower says it never saw it
+    assert w.grants(pid=2) == [(2, "A", 0), (2, "A", 0)]
+
+
+def test_blocked_follower_reannounces_with_retry(monkeypatch):
+    """A follower blocked past HARMONY_POD_UNIT_RETRY re-sends its
+    TU_WAIT with retry=True — the self-healing half of the repair path
+    (covers a grant lost between leader send and local wakeup)."""
+    monkeypatch.setenv("HARMONY_POD_UNIT_RETRY", "0.2")
+    reports = []
+    fu = FollowerUnits(report=reports.append)
+    done = {}
+
+    def waiter():
+        done["flag"] = fu.wait("J", 0, timeout=30.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    for _ in range(200):                 # ~4s ceiling; retry due at 0.2s
+        t.join(0.02)
+        if any(m.get("retry") for m in reports):
+            break
+    retries = [m for m in reports if m.get("retry")]
+    assert retries and retries[0]["cmd"] == "TU_WAIT"
+    assert retries[0]["job_id"] == "J" and retries[0]["seq"] == 0
+    fu.on_grant("J", 0, contended=False)  # leader repairs; waiter exits
+    t.join(5.0)
+    assert not t.is_alive() and done["flag"] is False
