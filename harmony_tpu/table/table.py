@@ -34,10 +34,6 @@ Architecture (deliberately NOT a translation):
 """
 from __future__ import annotations
 
-import itertools
-import os
-import shutil
-import tempfile
 import threading
 from functools import partial
 from typing import Callable, Dict, Optional, Sequence, Tuple
@@ -58,22 +54,6 @@ from harmony_tpu.table.partition import (
 from harmony_tpu.table.update import UpdateFunction, get_update_fn
 
 
-# One counter per process: cross_set_reshard runs in LOCKSTEP (every
-# participating process calls it at the same logical point, serialized
-# across jobs by the pod unit protocol), so the per-process counters agree
-# and name the same staging dir everywhere without any message exchange.
-_GROW_STAGE_SEQ = itertools.count()
-
-
-def _grow_stage_root() -> str:
-    """Shared staging location for grow-reshards. Real pods point this (or
-    the chkp root) at storage every host mounts — the same requirement the
-    pod checkpoint documents; virtual pods share the host tmpdir."""
-    return (os.environ.get("HARMONY_POD_STAGE_ROOT")
-            or os.environ.get("HARMONY_POD_CHKP_ROOT")
-            or tempfile.gettempdir())
-
-
 def cross_set_reshard(arr: jax.Array, old_mesh: Mesh,
                       new_sharding: NamedSharding) -> jax.Array:
     """Reshard onto a DIFFERENT device set across hosts — the case
@@ -81,130 +61,17 @@ def cross_set_reshard(arr: jax.Array, old_mesh: Mesh,
     should have the same set of devices"; direct transfers exist only
     experimentally on the TFRT TPU runtime).
 
-    SHRINK/REORDER — every new-mesh process still holds old-mesh shards:
-    replicate on the OLD mesh (one collective all participants dispatch in
-    lockstep), read the now-ADDRESSABLE local copy, rebuild on the new
-    sharding via make_array_from_callback (each process fills only its own
-    shards; a process losing all its devices contributes none). Costs one
-    full-table host round-trip plus a transient per-device replica.
+    Block-granular and point-to-point (table/blockmove.py): each process
+    stages only the blocks LEAVING it, moves them over the DCN host
+    channel (TCP; KV-store rendezvous) or per-block staged files, and
+    rebuilds its own new shards from local-plus-received blocks — the
+    reference's O(moved bytes) cost model (MigrationExecutor.java:107-253,
+    AllocatedTable.moveBlocks), with no full replica at any point. Works
+    LIVE in either direction (shrink AND grow) on a running table; every
+    participating process calls in lockstep."""
+    from harmony_tpu.table.blockmove import migrate_blocks
 
-    GROW — processes gaining devices hold no bytes to fill their new
-    shards from, and neither direct device transfers (multi-controller
-    device_put refuses differing device sets) nor host collectives
-    (process_allgather is runtime-dependent) are reliable here. The move
-    is still LIVE and symmetric to the reference's MigrationExecutor
-    (MigrationExecutor.java:107-253 — blocks move in either direction on a
-    running table): after the old-mesh replicate, the lowest old-mesh
-    process publishes the host copy into shared staging (write + atomic
-    rename), a union-mesh fence orders the publish before any read, the
-    joining processes load it, everyone rebuilds on the new sharding in
-    lockstep, and a second fence lets the source reclaim the staging. No
-    operator-visible checkpoint round-trip — the staging is internal and
-    deleted before return."""
-    old_procs = {d.process_index for d in old_mesh.devices.flat}
-    new_procs = {d.process_index for d in new_sharding.mesh.devices.flat}
-    rep = jax.jit(
-        lambda a: a, out_shardings=NamedSharding(old_mesh, P())
-    )(arr)
-    # replicated => every ADDRESSABLE shard is the full value; the global
-    # handle itself still refuses np.asarray (spans non-local devices).
-    # A lockstep participant with no old-mesh devices has no shards — and
-    # needs none unless it GAINS devices (the grow staging below).
-    shards = rep.addressable_shards
-    host = np.asarray(shards[0].data) if shards else None
-    if not new_procs <= old_procs:
-        host = _grow_stage_exchange(host, old_mesh, new_sharding.mesh)
-    return jax.make_array_from_callback(
-        arr.shape, new_sharding, lambda idx: host[idx],
-        dtype=arr.dtype,  # required when a process has no shards at all
-    )
-
-
-def _grow_stage_exchange(host: "np.ndarray | None", old_mesh: Mesh,
-                         new_mesh: Mesh) -> "np.ndarray | None":
-    """The grow leg's host-copy exchange (see cross_set_reshard): the
-    lowest old-mesh process publishes ``host`` into shared staging; fenced
-    so joining processes read only after the atomic publish, and the
-    source deletes only after every reader rebuilt. Processes outside the
-    old∪new union (lockstep participants owning no shard of either
-    layout) skip the fences — they neither write nor read, and the union
-    collective must be dispatched by exactly its member processes."""
-    from jax.sharding import Mesh as _Mesh
-
-    from harmony_tpu.parallel.multihost import mesh_sum
-
-    pid = jax.process_index()
-    union_devices = sorted(
-        set(old_mesh.devices.flat) | set(new_mesh.devices.flat),
-        key=lambda d: d.id,
-    )
-    union_procs = {d.process_index for d in union_devices}
-    member = pid in union_procs
-    union_mesh = _Mesh(np.array(union_devices), ("bcast",))
-    source = min(d.process_index for d in old_mesh.devices.flat)
-    seq = next(_GROW_STAGE_SEQ)
-    stage = os.path.join(
-        _grow_stage_root(),
-        f"harmony-grow-{seq}-" + "-".join(
-            str(d.id) for d in union_devices[:8]),
-    )
-    err: "BaseException | None" = None
-    if pid == source:
-        try:
-            # pre-clear: a crashed prior session's staging under the same
-            # deterministic name must not be adopted (stale payload) or
-            # collide with the publish rename. Safe pre-fence: only the
-            # source ever touches these paths before the publish fence.
-            # (Two CONCURRENT pods must not share a stage root — point
-            # HARMONY_POD_STAGE_ROOT per pod, like the chkp root.)
-            shutil.rmtree(stage + ".writing", ignore_errors=True)
-            shutil.rmtree(stage, ignore_errors=True)
-            os.makedirs(stage + ".writing")
-            np.save(os.path.join(stage + ".writing", "table.npy"), host)
-            os.rename(stage + ".writing", stage)  # atomic publish
-        except BaseException as e:  # noqa: BLE001 - reported via the fence
-            err = e
-    # Publish fence: error-carrying so a one-sided write failure raises
-    # on EVERY union member instead of stranding readers (non-members
-    # cannot learn of it — they proceed and the job's fail-fast paths
-    # handle the asymmetry, as for any one-sided host failure).
-    if member:
-        failures = mesh_sum(union_mesh, 1.0 if err else 0.0,
-                            f"grow-staged:{seq}")
-        if failures:
-            if pid == source:  # failure path must not leak a table copy
-                shutil.rmtree(stage + ".writing", ignore_errors=True)
-                shutil.rmtree(stage, ignore_errors=True)
-            if err is not None:
-                raise err
-            raise RuntimeError(
-                f"grow-reshard staging failed on the source process "
-                f"(stage {stage})"
-            )
-    needs_read = host is None and any(
-        d.process_index == pid for d in new_mesh.devices.flat
-    )
-    if needs_read:
-        try:
-            host = np.load(os.path.join(stage, "table.npy"))
-        except BaseException as e:  # noqa: BLE001 - reported via the fence
-            err = e
-    if member:
-        # Reader fence: the source must not reclaim the staging while a
-        # joiner is still loading it; also surfaces read failures on all
-        # members (same rationale as above).
-        failures = mesh_sum(union_mesh, 1.0 if err else 0.0,
-                            f"grow-read:{seq}")
-        if pid == source:
-            shutil.rmtree(stage, ignore_errors=True)
-        if failures:
-            if err is not None:
-                raise err
-            raise RuntimeError(
-                f"grow-reshard staging read failed on a joining process "
-                f"(stage {stage})"
-            )
-    return host
+    return migrate_blocks(arr, old_mesh, new_sharding)
 
 
 def reshard_array(arr: jax.Array, old_mesh: Mesh,
@@ -235,23 +102,18 @@ def owned_addressable_blocks(arr: jax.Array) -> "Dict[int, np.ndarray]":
     process — deduped across replicas by the lowest-owner-process rule, so
     on a multi-process mesh every block is returned by exactly one process
     (the pod checkpoint's stage-1 contract: each process stages its own
-    blocks from addressable shards, ref ChkpManagerSlave.java:50-63)."""
+    blocks from addressable shards, ref ChkpManagerSlave.java:50-63).
+    Ownership comes from blockmove.block_owners — the ONE copy of the
+    rule, so checkpoint staging and migration sourcing always agree on
+    who holds a block's authoritative bytes."""
+    from harmony_tpu.table.blockmove import axis0_bounds, block_owners
+
     pid = jax.process_index()
     nb = arr.shape[0]
-
-    def _bounds(idx) -> "Tuple[int, int]":
-        sl = idx[0] if idx else slice(None)
-        return sl.start or 0, nb if sl.stop is None else sl.stop
-
-    owners: Dict[int, int] = {}
-    for d, idx in arr.sharding.devices_indices_map(arr.shape).items():
-        start, stop = _bounds(idx)
-        for b in range(start, stop):
-            if owners.get(b, d.process_index + 1) > d.process_index:
-                owners[b] = d.process_index
+    owners = block_owners(arr.sharding, arr.shape)
     out: Dict[int, np.ndarray] = {}
     for shard in arr.addressable_shards:
-        start, stop = _bounds(shard.index)
+        start, stop = axis0_bounds(shard.index, nb)
         data = None
         for b in range(start, stop):
             if owners.get(b) == pid and b not in out:

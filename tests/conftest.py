@@ -57,7 +57,10 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_checkpoint_restore_cross_topology",
     "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent",
     "test_multihost.py::test_pod_multiworker_chkp_chain_matches_lockstep",
-    "test_multihost.py::test_pod_live_reshard_across_process_subsets",
+    "test_multihost.py::test_pod_live_reshard_across_process_subsets[tcp]",
+    "test_multihost.py::test_pod_live_reshard_across_process_subsets[file]",
+    "test_multihost.py::test_pod_block_migration_moves_only_moved_bytes[tcp]",
+    "test_multihost.py::test_pod_block_migration_moves_only_moved_bytes[file]",
     "test_multihost.py::test_pod_plan_driven_migration_mid_training",
     "test_multihost.py::test_pod_optimizer_loop_elasticity",
     "test_multihost.py::test_pod_collective_deferred_eval[1]",
