@@ -44,12 +44,17 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_concurrent_carved_tenants",
     "test_multihost.py::test_pod_share_all_overlapping_tenants[2-4]",
     "test_multihost.py::test_pod_share_all_overlapping_tenants[3-2]",
+    "test_multihost.py::test_pod_share_all_overlapping_tenants[6-1]",
     "test_multihost.py::test_pod_share_all_pregel_and_dolphin_overlap",
-    "test_multihost.py::test_pod_share_all_tenant_storm",
+    "test_multihost.py::test_pod_share_all_tenant_storm[2-2]",
+    "test_multihost.py::test_pod_share_all_tenant_storm[4-1]",
+    "test_multihost.py::test_pod_many_tenant_mixed_admission",
     "test_multihost.py::test_pod_reshard_multiworker_ssp",
     "test_multihost.py::test_pod_remote_only_plan_epoch_floor",
-    "test_multihost.py::test_pod_admission_fifo_no_starvation",
-    "test_multihost.py::test_pod_long_job_survives_heartbeat_window",
+    "test_multihost.py::test_pod_admission_fifo_no_starvation[2-2]",
+    "test_multihost.py::test_pod_admission_fifo_no_starvation[6-1]",
+    "test_multihost.py::test_pod_long_job_survives_heartbeat_window[2-2-3]",
+    "test_multihost.py::test_pod_long_job_survives_heartbeat_window[6-1-6]",
     "test_multihost.py::test_pod_killed_follower_poisons_fast",
     "test_multihost.py::test_pod_live_grow_mid_training",
     "test_multihost.py::test_pod_auto_resume_after_follower_death",

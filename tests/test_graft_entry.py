@@ -1,0 +1,20 @@
+"""Driver entry-point coverage at cluster width: dryrun_multichip — the
+full framework training-step suite (PS step, sparse FM, SP ring, dp x sp
+x tp, pipeline, expert-parallel) — must compile AND execute on a
+32-virtual-device mesh (the driver itself runs it at 8; this pins the
+wider dp x sp x tp regime the reference's cluster scheduler served,
+SchedulerImpl.java:28-66). The dryrun spawns its own sanitized
+subprocess, so ambient accelerator health is irrelevant."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_32_devices():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(32, timeout_s=900.0)

@@ -262,13 +262,15 @@ def test_pod_concurrent_carved_tenants():
         server.shutdown(timeout=60)
 
 
-@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 4), (3, 2)])
+@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 4), (3, 2), (6, 1)])
 def test_pod_share_all_overlapping_tenants(nprocs, devs_per_proc):
     """SHARE-ALL multi-tenancy on a pod (round-3 verdict item 1 — the last
     reference capability with no pod equivalent): with the DEFAULT
     scheduler, two jobs both span the SAME multi-process mesh and
-    train CONCURRENTLY. Two topologies: 2x4 and 3x2 (three processes =
-    grants/DONEs from two followers interleave at the arbiter). Safety
+    train CONCURRENTLY. Three topologies: 2x4, 3x2, and 6x1 (six
+    processes = grants/DONEs from FIVE followers interleave at the
+    arbiter — the reference's driver was built for real cluster widths,
+    SchedulerImpl.java:28-66). Safety
     comes from the cross-job unit protocol (runtime/podunits.py): the
     leader grants every multi-process job's
     dispatch regions in one pod-wide order, so overlapping tenants'
@@ -800,17 +802,20 @@ def test_pod_share_all_pregel_and_dolphin_overlap():
         round(x, 5) for x in losses]
 
 
-def test_pod_share_all_tenant_storm():
+@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 2), (4, 1)])
+def test_pod_share_all_tenant_storm(nprocs, devs_per_proc):
     """Chaos coverage for the cross-job unit protocol: SIX heterogeneous
-    tenants at once on one 2-process share_all pod — single-worker MLR x2,
+    tenants at once on one share_all pod — single-worker MLR x2,
     a 2-worker SSP job (turnstile + units composed), PageRank (pregel
     units), a pod_isolated job (exclusive execution via FIFO admission),
-    and a NMF local-table job. Every job must complete, converge, and
-    report IDENTICAL numbers from both processes (lockstep held under
-    arbitrary cross-tenant interleaving) — the wedge, if any dispatch
-    site escaped the unit discipline, shows up as a drain timeout."""
+    and a NMF local-table job. Run at 2x2 AND 4x1 (four processes: grant
+    storms from three followers interleave at the arbiter). Every job
+    must complete, converge, and report IDENTICAL numbers from every
+    process (lockstep held under arbitrary cross-tenant interleaving) —
+    the wedge, if any dispatch site escaped the unit discipline, shows
+    up as a drain timeout."""
     from harmony_tpu.config.params import JobConfig, TrainerParams
-    pod = PodHarness(2, 2)
+    pod = PodHarness(nprocs, devs_per_proc)
     cfgs = []
     cfgs.append(_mlr_job("storm-m1", seed=51, epochs=3))
     cfgs.append(_mlr_job("storm-m2", seed=52, epochs=3))
@@ -854,9 +859,105 @@ def test_pod_share_all_tenant_storm():
     for cfg in cfgs:
         res = result["local_results"][cfg.job_id]
         assert "error" not in res, (cfg.job_id, res)
-    # dolphin jobs: converged, and the follower reports identical series
+    # dolphin jobs: converged, and EVERY follower reports identical series
     for jid in ("storm-m1", "storm-m2", "storm-ssp", "storm-iso",
                 "storm-nmf"):
+        res = result["local_results"][jid]
+        series = {wid: w["losses"] for wid, w in res.items()
+                  if isinstance(w, dict) and "losses" in w}
+        assert series, (jid, res)
+        for fpid in range(1, nprocs):
+            follower = result["pod_reports"][jid][str(fpid)]
+            assert follower["ok"], (jid, fpid, follower)
+            for wid, losses in series.items():
+                assert losses[-1] <= losses[0] + 1e-6, (jid, wid, losses)
+                assert [round(x, 5)
+                        for x in follower["workers"][wid]["losses"]] == [
+                    round(x, 5) for x in losses], (jid, fpid, wid)
+    assert result["local_results"]["storm-pr"]["supersteps"] > 1
+
+
+def test_pod_many_tenant_mixed_admission():
+    """Admission at reference-cluster tenant counts (the regime the
+    reference's driver handled by design, SchedulerImpl.java:28-66): TEN
+    mixed jobs hit a 2-process pod at once — six share-all dolphin
+    tenants (MLR x4, a 2-worker SSP job, NMF), a pregel job, and three
+    pod_isolated jobs. Every job completes and converges; the isolated
+    jobs never overlap each other and start in FIFO ticket order; the
+    share-all tenants genuinely ran concurrently."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+    pod = PodHarness(2, 2)
+    share_ids, iso_ids = [], []
+    cfgs = []
+    for i in range(4):
+        cfgs.append(_mlr_job(f"mt-m{i}", seed=60 + i, epochs=2))
+        share_ids.append(f"mt-m{i}")
+    ssp = _mlr_job("mt-ssp", seed=65, epochs=2, num_workers=2)
+    ssp.params.clock_slack = 1
+    cfgs.append(ssp)
+    share_ids.append("mt-ssp")
+    cfgs.append(JobConfig(
+        job_id="mt-nmf", app_type="dolphin",
+        trainer="harmony_tpu.apps.nmf:NMFTrainer",
+        params=TrainerParams(
+            num_epochs=2, num_mini_batches=2,
+            app_params={"num_rows": 32, "num_cols": 16, "rank": 4,
+                        "step_size": 0.05},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.nmf:make_synthetic",
+              "data_args": {"num_rows": 32, "num_cols": 16, "rank": 4,
+                            "seed": 66}},
+    ))
+    share_ids.append("mt-nmf")
+    cfgs.append(JobConfig(
+        job_id="mt-pr", app_type="pregel",
+        trainer="harmony_tpu.apps.pagerank:PageRankComputation",
+        params=TrainerParams(app_params={"num_iterations": 4}),
+        user={"graph_fn": "harmony_tpu.pregel.graph:random_graph",
+              "graph_args": {"num_vertices": 32, "avg_degree": 4,
+                             "seed": 6},
+              "max_supersteps": 8},
+    ))
+    for i in range(3):
+        iso = _mlr_job(f"mt-iso{i}", seed=70 + i, epochs=1)
+        iso.params.num_mini_batches = 2
+        iso.user["pod_isolated"] = True
+        cfgs.append(iso)
+        iso_ids.append(f"mt-iso{i}")
+    try:
+        pod.wait_ready()
+        for cfg in cfgs:
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+            time.sleep(0.1)  # keep isolated-job ticket order deterministic
+        saw_multi = 0
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            status = pod.sender.send_status_command()
+            active = status.get("pod", {}).get("active", {})
+            saw_multi = max(saw_multi,
+                            len([j for j in active if j in share_ids]))
+            if not status.get("running"):
+                break
+            time.sleep(0.1)
+        pod.drain(timeout=120)
+        result = pod.finish()
+    finally:
+        pod.kill()
+    for cfg in cfgs:
+        res = result["local_results"][cfg.job_id]
+        assert "error" not in res, (cfg.job_id, res)
+    assert saw_multi >= 2, saw_multi  # share-all tenants truly overlapped
+    walls = result["job_walls"]
+    iso_starts = [walls[j][0] for j in iso_ids]
+    assert iso_starts == sorted(iso_starts), dict(zip(iso_ids, iso_starts))
+    for a in range(len(iso_ids)):
+        for b in range(a + 1, len(iso_ids)):
+            wa, wb = walls[iso_ids[a]], walls[iso_ids[b]]
+            assert min(wa[1], wb[1]) <= max(wa[0], wb[0]) + 1e-6, (
+                iso_ids[a], iso_ids[b], wa, wb)
+    for jid in share_ids:
         res = result["local_results"][jid]
         series = {wid: w["losses"] for wid, w in res.items()
                   if isinstance(w, dict) and "losses" in w}
@@ -864,21 +965,21 @@ def test_pod_share_all_tenant_storm():
         follower = result["pod_reports"][jid]["1"]
         assert follower["ok"], (jid, follower)
         for wid, losses in series.items():
-            assert losses[-1] <= losses[0] + 1e-6, (jid, wid, losses)
             assert [round(x, 5)
                     for x in follower["workers"][wid]["losses"]] == [
                 round(x, 5) for x in losses], (jid, wid)
-    assert result["local_results"]["storm-pr"]["supersteps"] > 1
 
 
-def test_pod_admission_fifo_no_starvation():
+@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 2), (6, 1)])
+def test_pod_admission_fifo_no_starvation(nprocs, devs_per_proc):
     """Admission fairness (round-3 verdict item 6): serialized pod-
     spanning jobs (user.pod_isolated opts out of the unit protocol into
     exclusive execution) admit in FIFO ticket order — a waiting job
     reserves its processes against every later arrival it conflicts with,
     so a stream of later jobs cannot starve it. Five isolated spanning
-    jobs submitted R, W, X1, X2, X3 must START in exactly that order."""
-    pod = PodHarness(2, 2)
+    jobs submitted R, W, X1, X2, X3 must START in exactly that order.
+    Run at 2x2 and 6x1 (ticket bookkeeping across five followers)."""
+    pod = PodHarness(nprocs, devs_per_proc)
     try:
         pod.wait_ready()
         names = ["fifo-r", "fifo-w", "fifo-x1", "fifo-x2", "fifo-x3"]
@@ -909,17 +1010,27 @@ def test_pod_admission_fifo_no_starvation():
         assert "error" not in res, (jid, res)
 
 
-def test_pod_long_job_survives_heartbeat_window():
+@pytest.mark.parametrize("nprocs,devs_per_proc,hb_timeout", [
+    (2, 2, "3"),
+    # six 1-core-contended processes: a wider window (still far below the
+    # job's runtime) keeps the liveness claim honest without making host
+    # scheduling jitter masquerade as heartbeat death
+    (6, 1, "6"),
+])
+def test_pod_long_job_survives_heartbeat_window(nprocs, devs_per_proc,
+                                                hb_timeout):
     """Liveness, not duration (round-3 verdict item 5): the leader's
     job-report waits are gated on follower HEARTBEATS, never on a fixed
-    wall. With the heartbeat timeout forced to 3s, a healthy job running
-    well past 3s completes normally — under any duration-based gate at
-    that timeout it would be declared infra-dead and poison the pod (the
-    old code had exactly that wall at 600s; the reference waits on
-    tasklet status indefinitely, TaskletRepresenter.java)."""
+    wall. With the heartbeat timeout forced well below the job's
+    duration, a healthy job running past it completes normally — under
+    any duration-based gate at that timeout it would be declared
+    infra-dead and poison the pod (the old code had exactly that wall at
+    600s; the reference waits on tasklet status indefinitely,
+    TaskletRepresenter.java)."""
     from harmony_tpu.config.params import JobConfig, TrainerParams
-    pod = PodHarness(2, 2, env_extra={"HARMONY_POD_HB_TIMEOUT": "3",
-                                      "HARMONY_POD_HB_PERIOD": "0.5"})
+    pod = PodHarness(nprocs, devs_per_proc,
+                     env_extra={"HARMONY_POD_HB_TIMEOUT": hb_timeout,
+                                "HARMONY_POD_HB_PERIOD": "0.5"})
     try:
         pod.wait_ready()
         cfg = JobConfig(
@@ -945,9 +1056,11 @@ def test_pod_long_job_survives_heartbeat_window():
     res = result["local_results"]["long-job"]
     assert "error" not in res, res
     wall = result["job_walls"]["long-job"]
-    assert wall[1] - wall[0] > 3.0, wall  # it really outlived the window
-    follower = result["pod_reports"]["long-job"]["1"]
-    assert follower["ok"] and not follower.get("infra"), follower
+    # it really outlived the heartbeat window
+    assert wall[1] - wall[0] > float(hb_timeout), (wall, hb_timeout)
+    for fpid in range(1, nprocs):
+        follower = result["pod_reports"]["long-job"][str(fpid)]
+        assert follower["ok"] and not follower.get("infra"), (fpid, follower)
 
 
 def test_pod_killed_follower_poisons_fast():
