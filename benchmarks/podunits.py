@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Unit-protocol cost under injected DCN latency (round-4 verdict item 4).
+
+The cross-job unit protocol pays one control-plane round trip per unit —
+the same bill the reference's per-TaskUnit wait/ready message pair pays
+(GlobalTaskUnitScheduler.java:64-85). On localhost pods that RTT is
+microseconds; this bench prices it at REAL DCN RTTs by sweeping the
+HARMONY_POD_UNIT_LAT_MS injection knob (runtime/podunits.py) at one-way
+0 / 0.5 / 2.5 ms == RTT 0 / 1 / 5 ms, two ways:
+
+  * MICRO — the protocol alone over real sockets: a leader arbiter and
+    two follower processes' worth of FollowerUnits wired over socketpairs
+    with the pod's JSON-line framing, two CONTENDED jobs cycling units
+    (overlapping process sets => units fully serialize, the worst case).
+    Reports per-serialized-unit acquisition cost at each RTT.
+  * E2E — a real 2-process virtual pod with two overlapping share-all MLR
+    tenants at RTT 0 and 5 ms: wall time, the leader's units_granted
+    counter, and the implied overhead/unit (noisy on a 1-core host; the
+    micro numbers are the load-bearing ones).
+
+From those it JUSTIFIES the default unit coarseness: uncontended jobs
+fuse multi-epoch dispatch windows into ONE unit (epoch-window default:
+up to 4 epochs/unit); the contended flag shrinks windows to one epoch
+per unit so tenants interleave at epoch granularity. The artifact
+records overhead-per-unit next to the measured per-epoch compute time,
+i.e. the fraction of an epoch the protocol costs at each RTT.
+
+Writes benchmarks/PODUNITS_<suffix>.json and prints one JSON line.
+Run: python benchmarks/podunits.py [suffix]   (default r05)
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import free_port, sanitized_cpu_env, wait_for_ready  # noqa: E402
+
+ONE_WAY_MS = [0.0, 0.5, 2.5]  # RTT 0 / 1 / 5 ms
+MICRO_UNITS = 300             # units per job per sweep point
+E2E_EPOCHS = 4
+
+
+# -- micro: the protocol over real sockets -------------------------------
+
+
+def _serve_follower(arbiter, pid, conn):
+    """Leader-side reader for one follower socket (the pod reader loop's
+    TU_* subset)."""
+    f = conn.makefile("r")
+    for line in f:
+        msg = json.loads(line)
+        if msg["cmd"] == "TU_WAIT":
+            arbiter.on_wait(msg["job_id"], msg["seq"], pid,
+                            retry=bool(msg.get("retry", False)))
+        elif msg["cmd"] == "TU_DONE":
+            arbiter.on_done(msg["job_id"], msg["seq"], pid)
+        elif msg["cmd"] == "BYE":
+            return
+
+
+def _follower_loop(units, conn):
+    """Follower-side reader: feed TU_GRANTs into FollowerUnits."""
+    f = conn.makefile("r")
+    for line in f:
+        msg = json.loads(line)
+        if msg["cmd"] == "TU_GRANT":
+            units.on_grant(msg["job_id"], msg["seq"], msg["contended"])
+        elif msg["cmd"] == "BYE":
+            return
+
+
+def micro_point(one_way_ms: float) -> dict:
+    """Two followers (pids 1,2), two jobs BOTH on {1,2} (fully contended:
+    their units serialize pod-wide), MICRO_UNITS units per job; returns
+    per-serialized-unit wall cost at the injected latency."""
+    from harmony_tpu.runtime.podunits import (
+        FollowerUnits, PodUnitArbiter, follower_client,
+    )
+
+    os.environ["HARMONY_POD_UNIT_LAT_MS"] = str(one_way_ms)
+    try:
+        # leader<->follower socketpairs with the pod's JSON-line framing
+        pairs = {pid: socket.socketpair() for pid in (1, 2)}
+        wfiles = {pid: pairs[pid][0].makefile("w") for pid in (1, 2)}
+        send_lock = threading.Lock()
+
+        def send_to(pid, msg):
+            with send_lock:
+                wfiles[pid].write(json.dumps(msg) + "\n")
+                wfiles[pid].flush()
+
+        arbiter = PodUnitArbiter(send_to=send_to)
+        followers = {}
+        threads = []
+        for pid in (1, 2):
+            fw = pairs[pid][1].makefile("w")
+            flock = threading.Lock()
+
+            def report(msg, _fw=fw, _l=flock):
+                with _l:
+                    _fw.write(json.dumps(msg) + "\n")
+                    _fw.flush()
+
+            units = FollowerUnits(report=report)
+            followers[pid] = units
+            threads.append(threading.Thread(
+                target=_serve_follower, args=(arbiter, pid, pairs[pid][0]),
+                daemon=True))
+            threads.append(threading.Thread(
+                target=_follower_loop, args=(units, pairs[pid][1]),
+                daemon=True))
+        for t in threads:
+            t.start()
+        for job in ("A", "B"):
+            arbiter.register_job(job, frozenset({1, 2}))
+
+        def run_job(pid, job):
+            client = follower_client(followers[pid], job)
+            for _ in range(MICRO_UNITS):
+                with client.scope(timeout=120):
+                    pass
+
+        t0 = time.perf_counter()
+        workers = [threading.Thread(target=run_job, args=(pid, job))
+                   for pid in (1, 2) for job in ("A", "B")]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        wall = time.perf_counter() - t0
+        serialized_units = 2 * MICRO_UNITS  # A and B never overlap units
+        return {
+            "one_way_ms": one_way_ms,
+            "rtt_ms": 2 * one_way_ms,
+            "units": serialized_units,
+            "wall_s": round(wall, 4),
+            "per_unit_ms": round(wall / serialized_units * 1000, 4),
+            "grants": arbiter.grants_total,
+        }
+    finally:
+        os.environ.pop("HARMONY_POD_UNIT_LAT_MS", None)
+        for a, b in pairs.values():
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+# -- e2e: a real virtual pod under latency -------------------------------
+
+
+def _mlr_cfg(job_id, seed):
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=E2E_EPOCHS, num_mini_batches=4,
+            app_params={"num_classes": 16, "num_features": 256,
+                        "features_per_partition": 64, "step_size": 0.05},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 2048, "num_features": 256,
+                            "num_classes": 16, "seed": seed}},
+    )
+
+
+def e2e_point(one_way_ms: float) -> dict:
+    from harmony_tpu.jobserver.client import CommandSender
+
+    worker = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "pod_worker.py")
+    env = sanitized_cpu_env(2)
+    if one_way_ms:
+        env["HARMONY_POD_UNIT_LAT_MS"] = str(one_way_ms)
+    coord, pod_port, tcp_port = free_port(), free_port(), free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"127.0.0.1:{coord}", "2", str(pid),
+             str(pod_port), str(tcp_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    try:
+        if not wait_for_ready(procs[0], 240):
+            raise RuntimeError("pod leader not ready")
+        sender = CommandSender(tcp_port)
+        t0 = time.perf_counter()
+        for seed, jid in ((21, "lat-a"), (22, "lat-b")):
+            resp = sender.send_job_submit_command(_mlr_cfg(jid, seed))
+            if not resp.get("ok"):
+                raise RuntimeError(f"submit failed: {resp}")
+        units = 0
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline:
+            status = sender.send_status_command()
+            units = status.get("pod", {}).get("units_granted", units)
+            if not status.get("running"):
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("e2e pod never drained")
+        wall = time.perf_counter() - t0
+        sender.send_shutdown_command()
+        for p in procs:
+            p.communicate(timeout=120)
+        return {
+            "one_way_ms": one_way_ms,
+            "rtt_ms": 2 * one_way_ms,
+            "wall_s": round(wall, 3),
+            "units_granted": units,
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def main() -> None:
+    suffix = sys.argv[1] if len(sys.argv) > 1 else "r05"
+    micro = [micro_point(ms) for ms in ONE_WAY_MS]
+    base = micro[0]["per_unit_ms"]
+    for row in micro:
+        row["overhead_vs_rtt0_ms"] = round(row["per_unit_ms"] - base, 4)
+    e2e = [e2e_point(ms) for ms in (0.0, 2.5)]
+    d_wall = e2e[1]["wall_s"] - e2e[0]["wall_s"]
+    units5 = max(e2e[1]["units_granted"], 1)
+    protocol_cost_s = units5 * micro[-1]["per_unit_ms"] / 1000
+    epochs_total = 2 * E2E_EPOCHS
+    epoch_ms = e2e[0]["wall_s"] / epochs_total * 1000
+    out = {
+        "metric": "pod unit-protocol overhead under injected DCN RTT",
+        "micro": micro,
+        "e2e": e2e,
+        "e2e_wall_delta_s": round(d_wall, 3),
+        "e2e_predicted_protocol_cost_s": round(protocol_cost_s, 3),
+        "e2e_note": (
+            "the predicted protocol cost at RTT 5 ms "
+            f"({units5} units x {micro[-1]['per_unit_ms']:.2f} ms = "
+            f"{protocol_cost_s:.2f}s) is smaller than 1-core host wall "
+            "noise, so the e2e delta sits inside noise — the default "
+            "coarseness amortizes real DCN RTTs to invisibility; micro "
+            "rows carry the per-unit price"),
+        "coarseness_defaults": {
+            "uncontended": "multi-epoch dispatch window fused into ONE "
+                           "unit (up to 4 epochs/unit)",
+            "contended": "window shrinks to 1 epoch/unit so tenants "
+                         "interleave at epoch granularity",
+            "justification": (
+                f"at RTT 5 ms the protocol costs "
+                f"{micro[-1]['per_unit_ms']:.2f} ms per serialized unit "
+                f"(micro); one CPU-bench epoch costs ~{epoch_ms:.0f} ms, "
+                f"so even the finest default unit (1 epoch) keeps "
+                f"protocol overhead at "
+                f"{micro[-1]['per_unit_ms'] / epoch_ms * 100:.1f}% — and "
+                f"real steps on a chip are larger. Sub-epoch units would "
+                f"multiply the RTT bill for no interleaving gain beyond "
+                f"the SSP slack already provided."
+            ),
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"PODUNITS_{suffix}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": out["metric"],
+        "per_unit_ms_at_rtt": {
+            str(r["rtt_ms"]): r["per_unit_ms"] for r in micro},
+        "artifact": path,
+    }))
+
+
+if __name__ == "__main__":
+    main()

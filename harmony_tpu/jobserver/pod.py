@@ -483,6 +483,9 @@ class PodJobServer(JobServer):
                 "followers": sorted(self._followers),
                 "broken": self._pod_broken,
                 "active": active,
+                "units_granted": self.pod_units.grants_total,
+                "units_grant_to_done_s": round(
+                    self.pod_units.grant_to_done_s, 4),
             }
         return out
 

@@ -74,6 +74,20 @@ def _retry_interval() -> float:
     return max(v, 0.1)
 
 
+def _inject_latency() -> None:
+    """Bench/test-only DCN latency injection: HARMONY_POD_UNIT_LAT_MS
+    (one-way milliseconds, default 0) sleeps before each unit-protocol
+    message leg — TU_WAIT and TU_DONE on the follower's send side,
+    TU_GRANT on the follower's processing side — so a unit acquisition
+    pays ~one injected RTT (WAIT leg + GRANT leg), the same bill the
+    reference's per-TaskUnit wait/ready round trip pays over a real
+    network (GlobalTaskUnitScheduler.java:64-85). benchmarks/podunits.py
+    sweeps this to price unit coarseness; production leaves it unset."""
+    ms = float(os.environ.get("HARMONY_POD_UNIT_LAT_MS", "0") or 0)
+    if ms > 0:
+        time.sleep(ms / 1000.0)
+
+
 def _cap_evict(d: Dict[int, Any], outstanding: Dict[int, Any],
                cap: int) -> None:
     """Evict oldest entries of ``d`` past ``cap``, but never one whose seq
@@ -113,6 +127,10 @@ class PodUnitArbiter:
         self._jobs: Dict[str, _JobState] = {}
         self._arrival = itertools.count()
         self._poisoned = False
+        # protocol telemetry (survives job deregistration; read by the
+        # pod STATUS surface for benchmarks/podunits.py)
+        self.grants_total = 0
+        self.grant_to_done_s = 0.0
 
     # -- registry ---------------------------------------------------------
 
@@ -199,7 +217,9 @@ class PodUnitArbiter:
                 if t0 is not None:
                     # charge the serial resource actually consumed:
                     # grant -> last enqueue-done wall seconds
-                    st.deficit += time.monotonic() - t0
+                    dt = time.monotonic() - t0
+                    st.deficit += dt
+                    self.grant_to_done_s += dt
                 self._maybe_grant_locked()
                 self._cond.notify_all()
 
@@ -242,6 +262,7 @@ class PodUnitArbiter:
         st.pending.discard(seq)
         st.granted_hi = max(st.granted_hi, seq)
         st.next_grant = max(st.next_grant, seq + 1)
+        self.grants_total += 1
         st.outstanding[seq] = set(st.procs)
         st.grant_t0[seq] = time.monotonic()
         st.flags[seq] = contended
@@ -344,6 +365,7 @@ class FollowerUnits:
         return st
 
     def on_grant(self, job_id: str, seq: int, contended: bool) -> None:
+        _inject_latency()  # the grant's network leg (bench knob, no-op off)
         with self._cond:
             st = self._state(job_id)
             st["hi"] = max(st["hi"], int(seq))
@@ -370,6 +392,7 @@ class FollowerUnits:
         with self._cond:
             self._waiting[job_id] = self._waiting.get(job_id, 0) + 1
         try:
+            _inject_latency()  # the announce's network leg (bench knob)
             self._report({"cmd": "TU_WAIT", "job_id": job_id,
                           "seq": int(seq)})
             deadline = time.monotonic() + (
@@ -411,6 +434,7 @@ class FollowerUnits:
                     self._waiting[job_id] = n
 
     def done(self, job_id: str, seq: int) -> None:
+        _inject_latency()  # the DONE's network leg (bench knob, no-op off)
         self._report({"cmd": "TU_DONE", "job_id": job_id, "seq": int(seq)})
 
 

@@ -49,6 +49,7 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_share_all_tenant_storm[2-2]",
     "test_multihost.py::test_pod_share_all_tenant_storm[4-1]",
     "test_multihost.py::test_pod_many_tenant_mixed_admission",
+    "test_multihost.py::test_pod_units_tolerate_dcn_latency",
     "test_multihost.py::test_pod_reshard_multiworker_ssp",
     "test_multihost.py::test_pod_remote_only_plan_epoch_floor",
     "test_multihost.py::test_pod_admission_fifo_no_starvation[2-2]",

@@ -877,6 +877,49 @@ def test_pod_share_all_tenant_storm(nprocs, devs_per_proc):
     assert result["local_results"]["storm-pr"]["supersteps"] > 1
 
 
+def test_pod_units_tolerate_dcn_latency():
+    """The unit protocol under realistic cross-host RTT (round-4 verdict
+    item 4): with HARMONY_POD_UNIT_LAT_MS injecting 2.5 ms per message
+    leg (RTT ~5 ms — a generous DCN figure), two overlapping share-all
+    tenants still train concurrently, complete within the normal drain
+    window (throughput does not collapse: coarse units amortize the RTT),
+    and every process reports identical loss series (correctness is
+    latency-independent). benchmarks/podunits.py prices the same knob."""
+    pod = PodHarness(2, 2, env_extra={"HARMONY_POD_UNIT_LAT_MS": "2.5"})
+    try:
+        pod.wait_ready()
+        cfg_a = _mlr_job("lat-a", seed=81, epochs=3)
+        cfg_b = _mlr_job("lat-b", seed=82, epochs=3)
+        for cfg in (cfg_a, cfg_b):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        saw_concurrent = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            status = pod.sender.send_status_command()
+            if len(status.get("pod", {}).get("active", {})) == 2:
+                saw_concurrent = True
+            if not status.get("running"):
+                break
+            time.sleep(0.1)
+        pod.drain(timeout=120)
+        result = pod.finish()
+    finally:
+        pod.kill()
+    assert saw_concurrent
+    for jid in ("lat-a", "lat-b"):
+        res = result["local_results"][jid]
+        assert "error" not in res, (jid, res)
+        (losses,) = [w["losses"] for w in res.values()
+                     if isinstance(w, dict) and "losses" in w]
+        assert losses[-1] < losses[0], (jid, losses)
+        follower = result["pod_reports"][jid]["1"]
+        assert follower["ok"], (jid, follower)
+        for wid, w in follower["workers"].items():
+            assert [round(x, 5) for x in w["losses"]] == [
+                round(x, 5) for x in losses], (jid, wid)
+
+
 def test_pod_many_tenant_mixed_admission():
     """Admission at reference-cluster tenant counts (the regime the
     reference's driver handled by design, SchedulerImpl.java:28-66): TEN
