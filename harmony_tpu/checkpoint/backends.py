@@ -116,6 +116,19 @@ class OrbaxCommitBackend(CommitBackend):
     trailer of ``.blk``-coded blocks survives the round trip and torn
     objects still fail loudly at restore. Orbax's own finalize step makes
     the object-store write atomic (a crashed save never lists).
+
+    MULTI-PROCESS runtimes: orbax's save/restore run cross-process
+    barriers when ``jax.process_count() > 1`` — but this backend's
+    commits are LEADER-ONLY (the pod checkpoint protocol's stage-2,
+    ChkpManagerSlave.java:50-63), so an in-process orbax call would
+    block forever waiting for followers that never call it. In that
+    case save/restore are routed through ONE persistent isolated
+    single-process worker (sanitized env, CPU platform) serving ops
+    over a pipe: pure host file IO either side, and the interpreter +
+    jax/orbax import cost is paid once per backend instance, not per
+    commit — chain checkpoints at period=1 stay cheap (a per-commit
+    subprocess pushed a pod auto-resume past the jax coordination
+    service's peer-death kill window in testing).
     """
 
     def __init__(self, root: str, cache_root: Optional[str] = None) -> None:
@@ -144,7 +157,75 @@ class OrbaxCommitBackend(CommitBackend):
         # a finalized orbax dir always carries its metadata file
         return os.path.isdir(path)
 
+    @staticmethod
+    def _in_multiprocess() -> bool:
+        try:
+            import jax
+
+            return jax.process_count() > 1
+        except Exception:  # pragma: no cover - jax not importable
+            return False
+
+    def _run_isolated(self, op: str, chkp_id: str, arg: str) -> None:
+        """Run _commit_here/_fetch_here in the persistent isolated
+        worker (see class docstring), (re)spawning it if absent/dead.
+        The worker's env strips every TPU-claim and distributed-runtime
+        var so its jax initializes as a plain CPU single process."""
+        import subprocess
+        import sys
+
+        proc = getattr(self, "_iso_proc", None)
+        if proc is None or proc.poll() is not None:
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env = dict(os.environ)
+            for var in list(env):
+                if (var == "PALLAS_AXON_POOL_IPS" or var.startswith("AXON_")
+                        or var in ("JAX_COORDINATOR_ADDRESS",
+                                   "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")):
+                    env.pop(var)
+            env["JAX_PLATFORMS"] = "cpu"
+            code = ("import sys; sys.path.insert(0, sys.argv[1]); "
+                    "from harmony_tpu.checkpoint.backends import "
+                    "_orbax_isolated_serve; _orbax_isolated_serve()")
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code, repo_root, self.root,
+                 self.cache_root or ""],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, env=env,
+            )
+            self._iso_proc = proc
+        try:
+            proc.stdin.write(json.dumps(
+                {"op": op, "chkp_id": chkp_id, "arg": arg}) + "\n")
+            proc.stdin.flush()
+            line = proc.stdout.readline()
+        except (OSError, ValueError) as e:
+            self._iso_proc = None
+            raise RuntimeError(f"isolated orbax worker died: {e}") from e
+        if not line:
+            self._iso_proc = None
+            err = ""
+            try:
+                err = proc.stderr.read() or ""
+            except Exception:
+                pass
+            raise RuntimeError(
+                f"isolated orbax {op} crashed the worker:\n{err[-2000:]}"
+            )
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"isolated orbax {op} failed: {resp.get('error')}"
+            )
+
     def commit(self, chkp_id: str, src_dir: str) -> None:
+        if self._in_multiprocess():
+            self._run_isolated("commit", chkp_id, src_dir)
+            return
+        self._commit_here(chkp_id, src_dir)
+
+    def _commit_here(self, chkp_id: str, src_dir: str) -> None:
         with open(os.path.join(src_dir, "manifest.json")) as f:
             info = json.load(f)
         info["committed"] = True
@@ -199,17 +280,33 @@ class OrbaxCommitBackend(CommitBackend):
                 pass  # torn sidecar: fall through to the full fetch
         return super().fetch_manifest(chkp_id)  # absent/torn sidecar
 
+    def _fetch_dir(self, chkp_id: str) -> str:
+        base = self.cache_root or os.path.join(
+            os.path.expanduser("~"), ".cache", "harmony_tpu", "chkp-fetch"
+        )
+        return os.path.join(base, chkp_id)
+
     def fetch(self, chkp_id: str) -> Optional[str]:
         cached = self._fetched.get(chkp_id)
         if cached and os.path.isdir(cached):
             return cached
         if not self.exists(chkp_id):
             return None
+        if self._in_multiprocess():
+            # the child materializes into the SAME deterministic cache dir
+            # both sides compute (isolation rationale: class docstring)
+            self._run_isolated("fetch", chkp_id, "")
+            d = self._fetch_dir(chkp_id)
+            if not os.path.isdir(d):
+                raise RuntimeError(
+                    f"isolated orbax fetch produced no dir at {d}")
+            self._fetched[chkp_id] = d
+            return d
+        return self._fetch_here(chkp_id)
+
+    def _fetch_here(self, chkp_id: str) -> Optional[str]:
         tree = self._checkpointer().restore(self._path(chkp_id))
-        base = self.cache_root or os.path.join(
-            os.path.expanduser("~"), ".cache", "harmony_tpu", "chkp-fetch"
-        )
-        d = os.path.join(base, chkp_id)
+        d = self._fetch_dir(chkp_id)
         staging = d + ".writing"
         os.makedirs(staging, exist_ok=True)
         try:
@@ -268,6 +365,32 @@ class OrbaxCommitBackend(CommitBackend):
 
 def _is_url(path: str) -> bool:
     return "://" in path
+
+
+def _orbax_isolated_serve() -> None:
+    """Persistent child for OrbaxCommitBackend._run_isolated: argv =
+    [repo_root(consumed), root, cache_root]; serves JSON-line ops
+    {"op": commit|fetch, "chkp_id", "arg"} on stdin until EOF."""
+    import sys
+
+    root, cache_root = sys.argv[2:4]
+    b = OrbaxCommitBackend(root, cache_root or None)
+    for line in sys.stdin:
+        req = json.loads(line)
+        try:
+            if req["op"] == "commit":
+                b._commit_here(req["chkp_id"], req["arg"])
+            elif req["op"] == "fetch":
+                if b._fetch_here(req["chkp_id"]) is None:
+                    raise RuntimeError(
+                        f"no committed checkpoint {req['chkp_id']}")
+            else:
+                raise RuntimeError(f"unknown op {req['op']}")
+            resp = {"ok": True}
+        except Exception as e:  # noqa: BLE001 - reported to the parent
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        sys.stdout.write(json.dumps(resp) + "\n")
+        sys.stdout.flush()
 
 
 def make_commit_backend(commit_root: str, backend=None) -> CommitBackend:

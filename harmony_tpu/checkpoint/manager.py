@@ -156,7 +156,13 @@ class CheckpointManager:
         """The per-job layout (<root>/<job>/temp, <root>/<job>/commit) —
         THE one place it is defined: the job entity and the pod
         followers' collective-eval leg must construct byte-identical
-        managers or their restores diverge."""
+        managers or their restores diverge. ``HARMONY_CHKP_BACKEND``
+        (posix|orbax) forces the commit backend when no explicit one is
+        given — an env knob precisely so every pod process inherits the
+        same choice (the reference's equivalent deployment switch is the
+        HDFS vs local fs config, ChkpManagerSlave.java:50-63)."""
+        if backend is None:
+            backend = os.environ.get("HARMONY_CHKP_BACKEND") or None
         return cls(os.path.join(chkp_root, job_id, "temp"),
                    os.path.join(chkp_root, job_id, "commit"),
                    backend=backend)

@@ -61,7 +61,8 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_auto_resume_after_follower_death",
     "test_multihost.py::test_pod_auto_resume_multiworker_completes",
     "test_multihost.py::test_pod_checkpoint_restore_cross_topology",
-    "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent",
+    "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent[posix]",
+    "test_multihost.py::test_pod_training_chkp_chain_restores_in_parent[orbax]",
     "test_multihost.py::test_pod_multiworker_chkp_chain_matches_lockstep",
     "test_multihost.py::test_pod_live_reshard_across_process_subsets[tcp]",
     "test_multihost.py::test_pod_live_reshard_across_process_subsets[file]",
