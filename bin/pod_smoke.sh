@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Post-bring-up smoke validation for a harmony_tpu pod (docs/DEPLOY.md §5).
+# Run from host 0 (or anywhere that reaches its submit port): submits one
+# tiny MLR job with a checkpoint snapshot, polls to completion, verifies
+# the server answers and the job drained. Exit 0 = the pod trains.
+#
+# Usage: bin/pod_smoke.sh [--port 43110] [--chkp]
+#   --chkp  also exercise the model-checkpoint path (needs the pod
+#           started with a --chkp-root / HARMONY_POD_CHKP_ROOT)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT=43110
+CHKP=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --port) PORT="$2"; shift 2 ;;
+    --chkp) CHKP="--model-chkp-period 1"; shift ;;
+    *) echo "unknown arg $1" >&2; exit 2 ;;
+  esac
+done
+
+echo "== pod status" >&2
+python -m harmony_tpu.cli status --port "$PORT"
+
+echo "== submitting smoke job" >&2
+# shellcheck disable=SC2086
+python -m harmony_tpu.cli submit mlr --port "$PORT" \
+  --job-id "smoke-$$" --epochs 2 --batches 2 $CHKP
+
+echo "== waiting for drain" >&2
+for _ in $(seq 1 600); do
+  if ! python -m harmony_tpu.cli status --port "$PORT" \
+      | grep -q '"running": *true'; then
+    echo "POD_SMOKE_OK" >&2
+    exit 0
+  fi
+  sleep 1
+done
+echo "POD_SMOKE_TIMEOUT: job never drained" >&2
+exit 1

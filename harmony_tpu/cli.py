@@ -320,6 +320,9 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--dashboard-url", default=None,
                    help="POST live job metrics to this dashboard "
                         "(harmony-tpu dashboard prints its URL)")
+    p.add_argument("--chkp-root", default=None,
+                   help="root for model-checkpoint chains / auto-resume "
+                        "(default: $HARMONY_POD_CHKP_ROOT)")
 
     for name in ("submit", "run"):
         p = sub.add_parser(
@@ -352,6 +355,10 @@ def main(argv: List[str] | None = None) -> int:
                    help="default: $JAX_NUM_PROCESSES")
     p.add_argument("--process-id", type=int, default=-1,
                    help="default: $JAX_PROCESS_ID")
+    p.add_argument("--chkp-root", default=None,
+                   help="shared/gs:// root for model-checkpoint chains, "
+                        "auto-resume, deferred eval "
+                        "(default: $HARMONY_POD_CHKP_ROOT; docs/DEPLOY.md)")
 
     p = sub.add_parser("status", help="query a running jobserver")
     p.add_argument("--port", type=int, default=43110)
@@ -430,7 +437,18 @@ def main(argv: List[str] | None = None) -> int:
     raise SystemExit(f"unknown command {args.cmd}")
 
 
-def _make_server(num_executors: int, dashboard_url=None):
+def _chkp_root_of(args: argparse.Namespace) -> "str | None":
+    """--chkp-root flag, else HARMONY_POD_CHKP_ROOT — the server-side
+    root for model-checkpoint chains / auto-resume / deferred eval
+    (docs/DEPLOY.md §4). Without it those features refuse per-job with a
+    clear error instead of writing nowhere."""
+    import os
+
+    return getattr(args, "chkp_root", None) or os.environ.get(
+        "HARMONY_POD_CHKP_ROOT")
+
+
+def _make_server(num_executors: int, dashboard_url=None, chkp_root=None):
     from harmony_tpu.jobserver.server import JobServer
     from harmony_tpu.utils.devices import discover_devices
 
@@ -439,14 +457,16 @@ def _make_server(num_executors: int, dashboard_url=None):
     # must fail with a diagnosis instead.
     devices = discover_devices()
     n = num_executors or len(devices)
-    server = JobServer(num_executors=n, dashboard_url=dashboard_url)
+    server = JobServer(num_executors=n, dashboard_url=dashboard_url,
+                       chkp_root=chkp_root)
     server.start()
     return server
 
 
 def _cmd_start_jobserver(args: argparse.Namespace) -> int:
     server = _make_server(args.num_executors,
-                          dashboard_url=args.dashboard_url)
+                          dashboard_url=args.dashboard_url,
+                          chkp_root=_chkp_root_of(args))
     port = server.serve_tcp(args.port)
     print(f"jobserver ready on port {port}", flush=True)
     try:
@@ -489,7 +509,8 @@ def _cmd_start_pod(args: argparse.Namespace) -> int:
         from harmony_tpu.jobserver.pod import PodJobServer
 
         server = PodJobServer(num_executors=n_exec,
-                              num_followers=nprocs - 1)
+                              num_followers=nprocs - 1,
+                              chkp_root=_chkp_root_of(args))
         server.start()
         server.serve_pod(args.pod_port)
         port = server.serve_tcp(args.port)
