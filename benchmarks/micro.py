@@ -38,7 +38,13 @@ measures (b) plus the other primitives a capacity-planning reader needs:
 Attention also reports achieved FLOP/s + MFU. MFU is null off-TPU (no
 meaningful peak). Run on the real chip and commit the JSON.
 
-Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|stall|chkp|all]
+  roofline   ANALYTIC expected-performance model (v5e roofline) for every
+             headline kernel at its bench shape — FLOPs, HBM bytes, AI,
+             binding resource, expected-MFU range with stated basis. No
+             device needed: the model stands next to the unmeasured flag
+             whenever the chip transport is wedged.
+
+Run:  python benchmarks/micro.py [table|reshard|attention|multiget|sparse|mxu|mxupush|ringflash|stall|chkp|roofline|all]
 
 Each section prints one JSON line so results diff cleanly across rounds.
 Uses whatever backend JAX is pointed at (real chip under axon; set
@@ -280,6 +286,96 @@ def bench_mxu() -> dict:
     flops = 2 * n * n * n
     return {"metric": "mxu_dot bf16 achieved", "value": round(flops / dt / 1e12, 2),
             "unit": "TFLOP/s", "n": n, "mfu": _mfu(flops / dt)}
+
+
+def bench_roofline() -> dict:
+    """ANALYTIC roofline for every headline kernel at its bench shape —
+    no device needed, so the expected numbers exist even while the chip
+    transport is wedged (round-4 verdict item 1: reviewers need the
+    MODEL next to the unmeasured flag, not just a promise).
+
+    Machine model (v5e, public spec): 197 bf16 TFLOP/s peak, 819 GB/s
+    HBM — ridge at ~240 FLOP/byte. For each kernel: FLOPs, minimum HBM
+    traffic, arithmetic intensity, the binding resource, the roofline
+    wall time, and an expected-MFU RANGE whose basis is stated (pure
+    roofline for clean matmuls; a derated range for kernels whose inner
+    loop interleaves VPU work between MXU ops). When a chip capture
+    exists, the measured section stands next to this model; until then
+    THIS is the claim the kernels are built to."""
+    PEAK = 197e12          # v5e dense bf16 FLOP/s (utils/platform._PEAK_BF16)
+    BW = 819e9             # v5e HBM GB/s (public spec sheet)
+    ridge = PEAK / BW
+
+    def entry(flops, bytes_, eff_lo, eff_hi, basis):
+        ai = flops / bytes_
+        bound = "compute" if ai >= ridge else "memory"
+        # roofline time at 100% efficiency of the binding resource
+        t_roof = max(flops / PEAK, bytes_ / BW)
+        # expected wall = roofline / efficiency; expected MFU uses the
+        # FLOP clock even for memory-bound kernels (how MFU is reported)
+        t_lo, t_hi = t_roof / eff_hi, t_roof / eff_lo
+        return {
+            "flops": round(flops / 1e9, 2), "gflops_unit": "GFLOP",
+            "hbm_mb": round(bytes_ / 1e6, 1),
+            "ai_flop_per_byte": round(ai, 1),
+            "bound": bound,
+            "roofline_ms": round(t_roof * 1e3, 3),
+            "expected_ms": [round(t_lo * 1e3, 3), round(t_hi * 1e3, 3)],
+            "expected_mfu": [round(flops / t_hi / PEAK, 3),
+                             round(flops / t_lo / PEAK, 3)],
+            "basis": basis,
+        }
+
+    kernels = {}
+    # -- mxu: 4096^3 bf16 matmul (bench_mxu's shape) ---------------------
+    n = 4096
+    kernels["mxu_dot_4096"] = entry(
+        2 * n**3, 3 * n * n * 2, 0.80, 0.95,
+        "aligned 4096-cube bf16 matmul: MXU-tiled perfectly; large "
+        "published XLA matmuls land 80-95% of peak")
+    # -- flash attention fwd (bench_attention's shape) -------------------
+    b, h, s, d = 4, 8, 2048, 128
+    att_flops = 2 * b * h * s * s * d  # QK^T + AV, halved by causal mask
+    att_bytes = 4 * b * h * s * d * 2  # q,k,v,o once each, bf16
+    kernels["flash_fwd_b4h8_s2048_d128"] = entry(
+        att_flops, att_bytes, 0.25, 0.50,
+        "two MXU matmuls per tile with a VPU softmax (max/exp/rescale) "
+        "between them; d=128 keeps the MXU fed. Public TPU flash "
+        "kernels at this shape land 25-50% of peak; >=25% fwd MFU is "
+        "the round-5 acceptance bar (3x+ over the measured r02 naive)")
+    # -- flash attention bwd (ops/attention.py backward kernels) ---------
+    kernels["flash_bwd_b4h8_s2048_d128"] = entry(
+        int(2.5 * att_flops), int(1.75 * att_bytes), 0.20, 0.40,
+        "dQ/dK/dV recompute-style backward = 2.5x fwd FLOPs (5 matmuls "
+        "per tile vs 2), heavier VPU mixing -> derate below fwd")
+    # -- 190M LM train step (benchmarks/lm.py train100m config) ----------
+    params, seq, bsz = 190e6, 2048, 8
+    lm_flops = 6 * params * seq * bsz  # fwd+bwd ~ 6*N per token
+    lm_bytes = (2 * params * 2        # params read + grads written, bf16
+                + 3 * bsz * seq * 512 * 2 * 24)  # rough activation traffic
+    kernels["lm_190m_train_step"] = entry(
+        lm_flops, int(lm_bytes), 0.25, 0.45,
+        "transformer train step ~6N FLOPs/token; with remat + bf16 and "
+        "d_model-scale matmuls the published XLA range on v5e is "
+        "25-45% MFU; >=25% is the round-5 acceptance bar (r02 measured "
+        "10.3% at 29.9M params - sub-MXU-size matmuls)")
+    # -- table push: scatter vs MXU fold at bench_table's shape ----------
+    cap, dim = 1 << 16, 256
+    tbl_bytes = cap * dim * 4 * 3  # read + write table, read delta, fp32
+    kernels["table_push_64k_x256"] = entry(
+        2 * cap * dim, tbl_bytes, 0.50, 0.85,
+        "pure streaming fold (1 MAC per element): memory-bound at "
+        "AI<1; expected = 50-85% of HBM bandwidth")
+    rows = {k: v for k, v in kernels.items()}
+    return {"metric": "analytic roofline (v5e model)",
+            "value": rows["flash_fwd_b4h8_s2048_d128"]["expected_mfu"][0],
+            "unit": "min expected flash fwd MFU",
+            "peak_bf16_tflops": PEAK / 1e12, "hbm_gbps": BW / 1e9,
+            "ridge_flop_per_byte": round(ridge, 1),
+            "kernels": rows,
+            "note": ("analytic — carries the EXPECTED number for every "
+                     "kernel the wedged chip has kept unmeasured; "
+                     "measured sections replace this as captures land")}
 
 
 def bench_mxupush() -> dict:
@@ -589,6 +685,7 @@ SECTIONS = {
     "ringflash": bench_ringflash,
     "stall": bench_stall,
     "chkp": bench_chkp,
+    "roofline": bench_roofline,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -603,6 +700,7 @@ SECTION_METRICS = {
     "mxupush": ("mxu push route", "GB/s"),
     "stall": ("live migration stall", "sec"),
     "chkp": ("checkpoint save/restore", "MB/s stage"),
+    "roofline": ("analytic roofline (v5e model)", "min expected flash fwd MFU"),
 }
 
 
