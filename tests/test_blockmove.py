@@ -121,6 +121,55 @@ def test_migrate_blocks_single_process_disjoint_devices():
     assert st["bytes_sent"] == 0 and st["bytes_received"] == 0
 
 
+def test_tcp_receiver_collects_expected_blocks_and_times_out():
+    """_TcpReceiver: frames from multiple connections land by block id;
+    a missing block raises TimeoutError naming it (the diagnosis a dead
+    source must produce, not a hang)."""
+    import socket
+    import time as _time
+
+    from harmony_tpu.table.blockmove import _TcpReceiver, _send_frame
+
+    rx = _TcpReceiver({3, 7})
+    try:
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        b = np.full((2, 4), 9.5, dtype=np.float32)
+        with socket.create_connection(("127.0.0.1", rx.port)) as s1:
+            _send_frame(s1, 3, a)
+        with socket.create_connection(("127.0.0.1", rx.port)) as s2:
+            _send_frame(s2, 7, b)
+        got = rx.wait(_time.monotonic() + 10)
+        np.testing.assert_array_equal(got[3], a)
+        np.testing.assert_array_equal(got[7], b)
+    finally:
+        rx.close()
+    # timeout path: expected block never arrives
+    rx2 = _TcpReceiver({42})
+    try:
+        with pytest.raises(TimeoutError, match="42"):
+            rx2.wait(_time.monotonic() + 0.3)
+    finally:
+        rx2.close()
+
+
+def test_tcp_receiver_preserves_dtype_and_shape():
+    import socket
+    import time as _time
+
+    from harmony_tpu.table.blockmove import _TcpReceiver, _send_frame
+
+    rx = _TcpReceiver({0})
+    try:
+        payload = np.arange(12, dtype=np.int16).reshape(3, 2, 2)
+        with socket.create_connection(("127.0.0.1", rx.port)) as s:
+            _send_frame(s, 0, payload)
+        got = rx.wait(_time.monotonic() + 10)[0]
+        assert got.dtype == np.int16 and got.shape == (3, 2, 2)
+        np.testing.assert_array_equal(got, payload)
+    finally:
+        rx.close()
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_migrate_blocks_to_replicated_layout():
     devs = jax.devices()

@@ -276,6 +276,85 @@ def test_on_wait_repairs_grant_whose_broadcast_send_failed():
     assert len(w.grants(pid=1)) == before
 
 
+def test_grant_storm_never_overlaps_conflicting_units():
+    """Stress invariant at cluster-ish width: 8 jobs over 6 pids with
+    randomized overlapping process sets, hundreds of interleaved
+    WAIT/DONE events — at EVERY grant instant, no two process-overlapping
+    jobs may have units outstanding together (the safety property all
+    share-all correctness rests on), and every announced unit is
+    eventually granted (liveness)."""
+    import random
+
+    rng = random.Random(7)
+    pids = [1, 2, 3, 4, 5, 6]
+    jobs = {}
+    for i in range(8):
+        procs = frozenset(rng.sample(pids, rng.randint(1, 4)))
+        jobs[f"J{i}"] = procs
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    for jid, procs in jobs.items():
+        arb.register_job(jid, procs)
+
+    def check_no_overlap():
+        outstanding = [(jid, st.procs) for jid, st in arb._jobs.items()
+                       if st.outstanding]
+        for i in range(len(outstanding)):
+            for j in range(i + 1, len(outstanding)):
+                (ja, pa), (jb, pb) = outstanding[i], outstanding[j]
+                assert not (pa & pb), (
+                    f"jobs {ja} and {jb} share procs {pa & pb} with "
+                    "units outstanding together")
+
+    next_seq = {jid: 0 for jid in jobs}
+    inflight = {}  # (jid, seq) -> procs yet to DONE
+    granted_events = 0
+    for _ in range(600):
+        move = rng.random()
+        if move < 0.5 and inflight:
+            key = rng.choice(sorted(inflight))
+            jid, seq = key
+            pid = inflight[key].pop()
+            arb.on_done(jid, seq, pid)
+            if not inflight[key]:
+                del inflight[key]
+        else:
+            jid = rng.choice(sorted(jobs))
+            seq = next_seq[jid]
+            next_seq[jid] += 1
+            # every participant announces (order shuffled)
+            for pid in rng.sample(sorted(jobs[jid]), len(jobs[jid])):
+                arb.on_wait(jid, seq, pid)
+        # verify the invariant at every step; register newly granted
+        # units' DONE obligations
+        check_no_overlap()
+        granted = {(j, s) for _, j, s in w.grants()}
+        granted_events = len(granted)
+        for (j, s) in granted:
+            st = arb._jobs[j]
+            if s in st.outstanding and (j, s) not in inflight:
+                inflight[(j, s)] = set(st.outstanding[s])
+    # drain: DONE everything outstanding; every announced unit must grant
+    for _ in range(10000):
+        if not inflight:
+            break
+        key = sorted(inflight)[0]
+        jid, seq = key
+        pid = inflight[key].pop()
+        arb.on_done(jid, seq, pid)
+        if not inflight[key]:
+            del inflight[key]
+        for (j, s) in {(j, s) for _, j, s in w.grants()}:
+            st = arb._jobs[j]
+            if s in st.outstanding and (j, s) not in inflight:
+                inflight[(j, s)] = set(st.outstanding[s])
+        check_no_overlap()
+    for jid in jobs:
+        st = arb._jobs[jid]
+        assert not st.pending, (jid, st.pending)  # liveness: all granted
+    assert granted_events > 100  # the storm actually exercised grants
+
+
 def test_retry_announce_forces_regrant_even_after_successful_send():
     """A retry=True announce means the follower has been blocked past the
     retry interval — whatever the leader sent is lost to it (e.g. a grant
