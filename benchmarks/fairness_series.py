@@ -9,9 +9,9 @@ EVERY run, and embeds the MEDIAN-max_slowdown run as the representative
 — median, never min — plus the full per-run (jain, max_slowdown) series
 so the spread is visible in the artifact itself.
 
-Writes benchmarks/FAIRNESS_r04.json; prints ONE JSON line (the summary).
+Writes benchmarks/FAIRNESS_<suffix>.json; prints ONE JSON line (summary).
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     python benchmarks/fairness_series.py [N]
+     python benchmarks/fairness_series.py [N] [suffix]
 """
 import json
 import os
@@ -20,11 +20,12 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-OUT_PATH = os.path.join(HERE, "FAIRNESS_r04.json")
 
 
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    suffix = sys.argv[2] if len(sys.argv) > 2 else "r05"
+    out_path = os.path.join(HERE, f"FAIRNESS_{suffix}.json")
     runs = []
     for i in range(n):
         proc = subprocess.run(
@@ -73,7 +74,7 @@ def main() -> None:
             "(was 15x in round 2, 4.0x in round 3)."
         ),
     }
-    with open(OUT_PATH, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
 

@@ -12,7 +12,7 @@ mode: per-tenant walls, slowdowns, Jain's index, concurrent overlap, and
 aggregate throughput. CPU-mesh numbers — comparable across rounds, not
 to a chip.
 
-Writes benchmarks/POD_TENANTS_r04.json; prints ONE JSON line.
+Writes benchmarks/POD_TENANTS_<suffix>.json (argv[1], default r05); prints ONE JSON line.
 Run: python benchmarks/pod_tenants.py
 """
 import json
@@ -29,8 +29,9 @@ EPOCHS = 8
 BATCHES = 4
 N = 16384
 METRIC = "pod concurrent-tenant slowdown (2-process pod, MLR x2)"
-OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "POD_TENANTS_r04.json")
+OUT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    f"POD_TENANTS_{sys.argv[1] if len(sys.argv) > 1 else 'r05'}.json")
 
 
 def _job(job_id: str, seed: int, epochs: int = EPOCHS):

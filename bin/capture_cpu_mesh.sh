@@ -7,10 +7,10 @@
 # noise, not the kernel (round-3 verdict: "noise rows ... could mislead a
 # reader skimming the bundle"); kernels are judged on chip captures only.
 #
-# Usage: bin/capture_cpu_mesh.sh [suffix]   (default r04)
+# Usage: bin/capture_cpu_mesh.sh [suffix]   (default r05)
 set -uo pipefail
 cd "$(dirname "$0")/.."
-SUF="${1:-r04}"
+SUF="${1:-r05}"
 OUT="benchmarks/CPU_MESH_${SUF}.jsonl"
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
@@ -36,7 +36,9 @@ export JAX_PLATFORMS=cpu
   run_row "multiworker aggregate" 900 benchmarks/multiworker.py
   run_row "pod throughput" 1800 benchmarks/pod.py
   echo "# companion artifacts: FAIRNESS_${SUF}.json (N-run fairness series)," \
-       "POD_TENANTS_${SUF}.json (carve + share_all pod tenancy)"
+       "POD_TENANTS_${SUF}.json (carve + share_all pod tenancy)," \
+       "POD_SHAREALL_${SUF}.json (share_all vs serialized aggregate A/B)," \
+       "PODUNITS_${SUF}.json (unit-protocol cost at DCN RTTs)"
 } > "$OUT"
 echo "wrote $OUT" >&2
 cat "$OUT"
