@@ -152,9 +152,43 @@ def main() -> None:
         t.reshard(mesh_a)
         grow = dict(blockmove.last_move_stats)
         check("regrown", errors)
+        # sparse leg: a DeviceHashTable's (keys, values) pair rides the
+        # SAME cross-process path (two lockstep migrate_blocks calls);
+        # values must survive shrink AND grow exactly
+        from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+        hcfg = TableConfig(table_id="bshash", capacity=256,
+                           value_shape=(2,), num_blocks=8, sparse=True)
+        ht = DeviceHashTable(HashTableSpec(hcfg), mesh_a)
+        hkeys = np.asarray(HASH_KEYS, np.int64)
+        hvals = np.stack([[k * 2.0, k * 3.0]
+                          for k in HASH_KEYS]).astype(np.float32)
+        ht.multi_put(hkeys, hvals)
+
+        def hash_check(tag):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(ht.mesh, P())
+            kk = jax.device_put(hkeys, rep)
+
+            def pull(state, k):
+                _, rows, _ = ht.spec.pull(state, k)
+                return rows
+
+            rows = np.asarray(jax.jit(pull, out_shardings=rep)(
+                ht._state, kk))
+            if not np.allclose(rows, hvals):
+                errors.append(f"hash-{tag}: values diverged")
+
+        ht.reshard(mesh_b)
+        hash_shrink = dict(blockmove.last_move_stats)
+        hash_check("shrunk")
+        ht.reshard(mesh_a)
+        hash_check("regrown")
         report.update(
             ok=not errors, errors=errors[:5], block_bytes=block_bytes,
             table_bytes=NB2 * block_bytes, shrink=shrink, grow=grow,
+            hash_shrink_transport=hash_shrink.get("transport"),
         )
     elif phase == "reshard":
         # Live cross-process resharding: the table migrates between

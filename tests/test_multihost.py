@@ -460,6 +460,8 @@ def test_pod_block_migration_moves_only_moved_bytes(tmp_path, transport):
                              extra_env=extra)
     for r in results:
         assert r["ok"], r
+        # the sparse (keys, values) pair rode the same transport
+        assert r["hash_shrink_transport"] == transport, r
     by_pid = {r["pid"]: r for r in results}
     bb, table_bytes = results[0]["block_bytes"], results[0]["table_bytes"]
     for direction in ("shrink", "grow"):
