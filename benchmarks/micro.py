@@ -288,6 +288,64 @@ def bench_mxu() -> dict:
             "unit": "TFLOP/s", "n": n, "mfu": _mfu(flops / dt)}
 
 
+def bench_attnbwd() -> dict:
+    """Flash attention BACKWARD — the Pallas dQ/dK/dV kernels
+    (ops/attention.py custom_vjp) vs autodiff through the naive O(S^2)
+    reference, same shape/dtype policy as the forward section. Times a
+    full grad step (fwd + bwd) for both; the bwd-only cost is the grad
+    time minus the matching forward time. Roofline expectation:
+    benchmarks/micro.py roofline 'flash_bwd' (20-40% MFU)."""
+    from harmony_tpu.ops import flash_attention
+    from harmony_tpu.utils.platform import tpu_backend
+
+    b, h, s, d = 4, 8, 2048, 128
+    if not tpu_backend():
+        # interpreted Pallas backward at s=2048 costs minutes of python
+        # grid loops; keep the section runnable everywhere (numbers off
+        # TPU are mechanics-smoke only — the bundle excludes them)
+        b, h, s = 1, 2, 512
+    dt = jnp.bfloat16 if tpu_backend() else jnp.float32
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32).astype(dt)
+    k = jax.random.normal(k2, (b, h, s, d), jnp.float32).astype(dt)
+    v = jax.random.normal(k3, (b, h, s, d), jnp.float32).astype(dt)
+
+    def naive(q, k, v):
+        a = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        a = jnp.where(mask, a, -jnp.inf)
+        p = jax.nn.softmax(a, -1).astype(v.dtype)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v)
+
+    def loss_of(fn):
+        # mean keeps the cotangent O(1) so bf16 grads stay in range
+        return lambda qq, kk, vv: jnp.mean(
+            fn(qq, kk, vv).astype(jnp.float32))
+
+    grad_naive = jax.grad(loss_of(naive), argnums=(0, 1, 2))
+    grad_flash = jax.grad(
+        loss_of(lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True)),
+        argnums=(0, 1, 2))
+
+    def chain(gfn):
+        # chain q through its own grad so iterations stay in-graph
+        return lambda qq: gfn(qq, k, v)[0].astype(dt)
+
+    t_naive = _time_inner(chain(grad_naive), q, inner=8)
+    t_flash = _time_inner(chain(grad_flash), q, inner=8)
+    # grad step = fwd + bwd; standard accounting: bwd = 2.5x fwd FLOPs
+    fwd_flops = 2 * b * h * s * s * d
+    step_flops = int(3.5 * fwd_flops)
+    return {"metric": "flash attention BACKWARD (grad step) vs naive",
+            "seq": s, "head_dim": d, "dtype": str(dt.__name__),
+            "value": round(t_naive / t_flash, 2), "unit": "x",
+            "naive_grad_ms": round(t_naive * 1e3, 1),
+            "flash_grad_ms": round(t_flash * 1e3, 1),
+            "flash_grad_tflops": round(step_flops / t_flash / 1e12, 2),
+            "flash_grad_mfu": _mfu(step_flops / t_flash)}
+
+
 def bench_roofline() -> dict:
     """ANALYTIC roofline for every headline kernel at its bench shape —
     no device needed, so the expected numbers exist even while the chip
@@ -686,6 +744,7 @@ SECTIONS = {
     "stall": bench_stall,
     "chkp": bench_chkp,
     "roofline": bench_roofline,
+    "attnbwd": bench_attnbwd,
 }
 # reported metric name + unit per section, so ERROR lines land in the same
 # metric series a success would (same keys a tracker would index on)
@@ -701,6 +760,7 @@ SECTION_METRICS = {
     "stall": ("live migration stall", "sec"),
     "chkp": ("checkpoint save/restore", "MB/s stage"),
     "roofline": ("analytic roofline (v5e model)", "min expected flash fwd MFU"),
+    "attnbwd": ("flash attention BACKWARD (grad step) vs naive", "x"),
 }
 
 
