@@ -251,6 +251,52 @@ class TestCommitBackends:
                           table_id="ob-restored")
         np.testing.assert_allclose(np.asarray(h2.table.pull_array()), vals)
 
+    def test_orbax_isolated_worker_commit_fetch_and_respawn(
+            self, tmp_path, monkeypatch):
+        """The multi-process route (class docstring in backends.py):
+        commits/fetches run in ONE persistent isolated worker subprocess.
+        Forced on here (single-process, so the worker itself is safe):
+        commit -> fetch round-trips through the child, the SAME worker
+        serves consecutive ops (persistence), a killed worker respawns
+        transparently, and a child-side failure surfaces as a parent
+        RuntimeError instead of a hang."""
+        import json
+
+        from harmony_tpu.checkpoint.backends import OrbaxCommitBackend
+
+        b = OrbaxCommitBackend(str(tmp_path / "root"),
+                               cache_root=str(tmp_path / "cache"))
+        monkeypatch.setattr(OrbaxCommitBackend, "_in_multiprocess",
+                            staticmethod(lambda: True))
+        src = tmp_path / "staged"
+        src.mkdir()
+        (src / "manifest.json").write_text(json.dumps(
+            {"chkp_id": "iso-1", "committed": False}))
+        (src / "b0.blk").write_bytes(b"\x01\x02\x03\x04")
+        b.commit("iso-1", str(src))
+        worker1 = b._iso_proc
+        assert worker1 is not None and worker1.poll() is None
+        d = b.fetch("iso-1")
+        assert d is not None
+        assert (open(os.path.join(d, "b0.blk"), "rb").read()
+                == b"\x01\x02\x03\x04")
+        assert json.loads(open(os.path.join(d, "manifest.json")).read())[
+            "committed"] is True
+        assert b._iso_proc is worker1  # same worker served both ops
+        # kill the worker: the next op must respawn, not hang/crash
+        worker1.kill()
+        worker1.wait(timeout=30)
+        (src / "manifest.json").write_text(json.dumps(
+            {"chkp_id": "iso-2", "committed": False}))
+        b.commit("iso-2", str(src))
+        assert b._iso_proc is not worker1 and b._iso_proc.poll() is None
+        assert b.exists("iso-2")
+        # child-side failure (fetch of a missing id forced through the
+        # worker) surfaces as a parent error naming the op
+        with pytest.raises(RuntimeError, match="fetch"):
+            b._run_isolated("fetch", "never-committed", "")
+        b._iso_proc.kill()
+
     def test_orbax_commit_idempotent(self, omgr, master):
         h, _ = make_handle(master, tid="ob-idem")
         cid = omgr.checkpoint(h, commit=True)
