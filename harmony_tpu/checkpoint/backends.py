@@ -132,9 +132,13 @@ class OrbaxCommitBackend(CommitBackend):
     """
 
     def __init__(self, root: str, cache_root: Optional[str] = None) -> None:
+        import threading
+
         self.root = root if _is_url(root) else os.path.abspath(root)
         self.cache_root = cache_root  # local materialization dir for fetch
         self._fetched: dict = {}
+        self._iso_proc = None       # persistent isolated worker (lazy)
+        self._iso_lock = threading.Lock()  # serializes its pipe exchanges
 
     def _path(self, chkp_id: str) -> str:
         return (f"{self.root.rstrip('/')}/{chkp_id}" if _is_url(self.root)
@@ -171,10 +175,17 @@ class OrbaxCommitBackend(CommitBackend):
         worker (see class docstring), (re)spawning it if absent/dead.
         The worker's env strips every TPU-claim and distributed-runtime
         var so its jax initializes as a plain CPU single process."""
+        # one exchange at a time on the worker's pipe: concurrent commits
+        # (async snapshot thread + a sync commit) would interleave writes
+        # and misattribute the response lines
+        with self._iso_lock:
+            self._run_isolated_locked(op, chkp_id, arg)
+
+    def _run_isolated_locked(self, op: str, chkp_id: str, arg: str) -> None:
         import subprocess
         import sys
 
-        proc = getattr(self, "_iso_proc", None)
+        proc = self._iso_proc
         if proc is None or proc.poll() is not None:
             repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))))
