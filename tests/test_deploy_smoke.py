@@ -1,0 +1,72 @@
+"""The deploy recipe, kept true by test: docs/DEPLOY.md §6's virtual-pod
+bring-up — two `bin/launch_pod.sh` processes wired by the three JAX_*
+variables — followed by §5's `bin/pod_smoke.sh --chkp` validation. This
+is exactly what a fresh operator runs; if it breaks, the doc is lying."""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from benchmarks.common import free_port, sanitized_cpu_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_launch_pod_and_smoke_script(tmp_path):
+    env = sanitized_cpu_env(2)
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{free_port()}",
+        "JAX_NUM_PROCESSES": "2",
+        "HARMONY_POD_CHKP_ROOT": str(tmp_path / "chkp"),
+    })
+    port, pod_port = free_port(), free_port()  # parallel-safe, no 43110 clash
+    procs = []
+    for i in (0, 1):
+        e = dict(env)
+        e["JAX_PROCESS_ID"] = str(i)
+        procs.append(subprocess.Popen(
+            [os.path.join(REPO, "bin", "launch_pod.sh"),
+             "--port", str(port), "--pod-port", str(pod_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=e, cwd=REPO,
+        ))
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:  # leader's submit port
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=1).close()
+                break
+            except OSError:
+                if procs[0].poll() is not None:
+                    pytest.fail("leader died:\n"
+                                + procs[0].stdout.read()[-2000:])
+                time.sleep(1)
+        else:
+            pytest.fail("leader submit port never opened")
+        r = subprocess.run(
+            [os.path.join(REPO, "bin", "pod_smoke.sh"),
+             "--port", str(port), "--chkp"],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "POD_SMOKE_OK" in r.stderr, r.stderr[-2000:]
+        # the --chkp leg really wrote a committed chain checkpoint
+        import glob
+
+        entries = glob.glob(str(tmp_path / "chkp" / "*" / "commit" / "*"))
+        assert entries, "no committed chain checkpoint after --chkp smoke"
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "harmony_tpu.cli", "shutdown",
+             "--port", str(port)],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=60,
+        )
+        time.sleep(2)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
