@@ -96,6 +96,52 @@ def test_plan_uncovered_old_layout_raises():
         plan_moves(old, new, (12, 4), 8)
 
 
+def test_plan_moves_invariants_randomized():
+    """Planner property sweep over random block->process layouts: every
+    block a process needs and lacks is received exactly once from a
+    process that owns it; no self-moves; sends and recvs agree; covered
+    layouts never raise. 200 random (old, new) layout pairs."""
+    import random
+
+    rng = random.Random(11)
+    for trial in range(200):
+        nb = rng.choice([6, 12, 24])
+        nprocs = rng.randint(1, 5)
+
+        def layout():
+            # each process holds a random union of block ranges; ensure
+            # full coverage by granting every block to >= 1 process
+            dev_slices = []
+            for p in range(nprocs):
+                a = rng.randrange(nb)
+                b = rng.randrange(a + 1, nb + 1)
+                dev_slices.append((p, a, b))
+            for blk in range(nb):
+                if not any(a <= blk < b for _, a, b in dev_slices):
+                    dev_slices.append((rng.randrange(nprocs), blk, blk + 1))
+            return _sh(*dev_slices)
+
+        old, new = layout(), layout()
+        plan = plan_moves(old, new, (nb, 4), 4)
+        old_blocks = process_blocks(old, (nb, 4))
+        new_blocks = process_blocks(new, (nb, 4))
+        owners = block_owners(old, (nb, 4))
+        sent = {}
+        for src, legs in plan.sends.items():
+            for blk, dst in legs:
+                assert src != dst, (trial, blk, src)
+                assert blk in old_blocks[src], (trial, blk, src)
+                assert owners[blk] == src, (trial, blk, src)
+                sent.setdefault(dst, []).append(blk)
+        for pid, need in new_blocks.items():
+            missing = sorted(need - old_blocks.get(pid, set()))
+            got = sorted(sent.get(pid, []))
+            assert got == missing, (trial, pid, got, missing)
+            assert sorted(plan.recvs.get(pid, set())) == missing, (
+                trial, pid)
+        assert plan.total_moves == sum(len(v) for v in sent.values())
+
+
 def test_contiguous_runs():
     assert _contiguous_runs([]) == []
     assert _contiguous_runs([3]) == [(3, 4)]
