@@ -119,8 +119,14 @@ def main() -> None:
 
         NB2, CAP2, DIM2 = 24, 96, 5
         devs = jax.devices()
-        mesh_a = build_mesh(devs, data=1, model=8)       # 3 blocks/dev
-        mesh_b = build_mesh(devs[:6], data=1, model=6)   # 4 blocks/dev
+        mesh_a = build_mesh(devs, data=1, model=len(devs))
+        if nprocs == 3:
+            # 3 procs x 2 devs: mesh_b drops proc 0 entirely — the shrink
+            # has a follower->follower leg (pid1 ships blocks to pid2
+            # WHILE receiving pid0's) and the grow resurrects proc 0
+            mesh_b = build_mesh(devs[2:], data=1, model=len(devs) - 2)
+        else:
+            mesh_b = build_mesh(devs[:6], data=1, model=6)
         cfg = TableConfig(table_id="bstats", capacity=CAP2,
                           value_shape=(DIM2,), num_blocks=NB2)
         t = DenseTable(TableSpec(cfg), mesh_a)
@@ -168,6 +174,12 @@ def main() -> None:
         def hash_check(tag):
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            # only MEMBER processes of the current mesh dispatch the pull
+            # (a dropped process holds no devices of it — the replicated
+            # upload/collective would span non-addressable devices there)
+            if not any(d.process_index == pid
+                       for d in ht.mesh.devices.flat):
+                return
             rep = NamedSharding(ht.mesh, P())
             kk = jax.device_put(hkeys, rep)
 

@@ -68,6 +68,7 @@ _SLOW_TESTS = {
     "test_multihost.py::test_pod_live_reshard_across_process_subsets[file]",
     "test_multihost.py::test_pod_block_migration_moves_only_moved_bytes[tcp]",
     "test_multihost.py::test_pod_block_migration_moves_only_moved_bytes[file]",
+    "test_multihost.py::test_pod_block_migration_follower_to_follower",
     "test_multihost.py::test_pod_plan_driven_migration_mid_training",
     "test_multihost.py::test_pod_optimizer_loop_elasticity",
     "test_multihost.py::test_pod_collective_deferred_eval[1]",

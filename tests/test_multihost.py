@@ -483,6 +483,38 @@ def test_pod_block_migration_moves_only_moved_bytes(tmp_path, transport):
         assert senders != receivers, (direction, by_pid)
 
 
+def test_pod_block_migration_follower_to_follower(tmp_path):
+    """Point-to-point means point-to-point: on a 3-process pod the shrink
+    (drop process 0) plans pid0→pid1 AND pid1→pid2 legs — pid1 ships
+    blocks to a FELLOW FOLLOWER while receiving the leader's, nothing
+    relays through a coordinator — and the grow resurrects the emptied
+    process. Values verified exact after both moves; totals O(moved)."""
+    results = _run_pod_phase("blockstats", 3, 2, str(tmp_path),
+                             extra_env={"HARMONY_POD_BLOCKMOVE": "tcp"})
+    for r in results:
+        assert r["ok"], r
+    by_pid = {r["pid"]: r for r in results}
+    bb, table_bytes = results[0]["block_bytes"], results[0]["table_bytes"]
+    # mesh A (6 devs): pid0 0-7, pid1 8-15, pid2 16-23. mesh B (4 devs,
+    # procs 1,2): pid1 0-11, pid2 12-23 -> shrink: pid0 sends 0-7 to
+    # pid1; pid1 sends 12-15 to pid2 (while receiving) = 12 moves.
+    sh = {p: by_pid[p]["shrink"] for p in (0, 1, 2)}
+    assert all(s["total_moves"] == 12 for s in sh.values()), sh
+    assert sh[0]["bytes_sent"] == 8 * bb and sh[0]["bytes_received"] == 0
+    assert sh[1]["bytes_sent"] == 4 * bb      # the follower->follower leg
+    assert sh[1]["bytes_received"] == 8 * bb  # ...while receiving pid0's
+    assert sh[2]["bytes_sent"] == 0 and sh[2]["bytes_received"] == 4 * bb
+    # grow back: pid1 returns 0-7 to pid0, pid2 returns 12-15 to pid1
+    gr = {p: by_pid[p]["grow"] for p in (0, 1, 2)}
+    assert all(g["total_moves"] == 12 for g in gr.values()), gr
+    assert gr[0]["bytes_received"] == 8 * bb and gr[0]["bytes_sent"] == 0
+    assert gr[1]["bytes_sent"] == 8 * bb and gr[1]["bytes_received"] == 4 * bb
+    assert gr[2]["bytes_sent"] == 4 * bb and gr[2]["bytes_received"] == 0
+    # and still O(moved): total wire traffic = 12 blocks, half the table
+    total = sum(s["bytes_sent"] for s in sh.values())
+    assert total == 12 * bb < table_bytes, (total, table_bytes)
+
+
 def test_pod_plan_driven_migration_mid_training():
     """Plan-driven migration of a RUNNING pod job (ref: the driver's
     MoveInitMsg flow, MigrationExecutor.java:107-253): the leader
