@@ -74,19 +74,21 @@ def _follower_loop(units, conn):
             return
 
 
-def micro_point(one_way_ms: float) -> dict:
-    """Two followers (pids 1,2), two jobs BOTH on {1,2} (fully contended:
-    their units serialize pod-wide), MICRO_UNITS units per job; returns
-    per-serialized-unit wall cost at the injected latency."""
+def micro_point(one_way_ms: float, n_followers: int = 2) -> dict:
+    """``n_followers`` follower pids, two jobs BOTH spanning all of them
+    (fully contended: their units serialize pod-wide; every unit needs a
+    grant broadcast to N pids and N DONEs back), MICRO_UNITS units per
+    job; returns per-serialized-unit wall cost at the injected latency."""
     from harmony_tpu.runtime.podunits import (
         FollowerUnits, PodUnitArbiter, follower_client,
     )
 
+    pids = list(range(1, n_followers + 1))
     os.environ["HARMONY_POD_UNIT_LAT_MS"] = str(one_way_ms)
     try:
         # leader<->follower socketpairs with the pod's JSON-line framing
-        pairs = {pid: socket.socketpair() for pid in (1, 2)}
-        wfiles = {pid: pairs[pid][0].makefile("w") for pid in (1, 2)}
+        pairs = {pid: socket.socketpair() for pid in pids}
+        wfiles = {pid: pairs[pid][0].makefile("w") for pid in pids}
         send_lock = threading.Lock()
 
         def send_to(pid, msg):
@@ -97,7 +99,7 @@ def micro_point(one_way_ms: float) -> dict:
         arbiter = PodUnitArbiter(send_to=send_to)
         followers = {}
         threads = []
-        for pid in (1, 2):
+        for pid in pids:
             fw = pairs[pid][1].makefile("w")
             flock = threading.Lock()
 
@@ -117,7 +119,7 @@ def micro_point(one_way_ms: float) -> dict:
         for t in threads:
             t.start()
         for job in ("A", "B"):
-            arbiter.register_job(job, frozenset({1, 2}))
+            arbiter.register_job(job, frozenset(pids))
 
         def run_job(pid, job):
             client = follower_client(followers[pid], job)
@@ -127,7 +129,7 @@ def micro_point(one_way_ms: float) -> dict:
 
         t0 = time.perf_counter()
         workers = [threading.Thread(target=run_job, args=(pid, job))
-                   for pid in (1, 2) for job in ("A", "B")]
+                   for pid in pids for job in ("A", "B")]
         for w in workers:
             w.start()
         for w in workers:
@@ -137,6 +139,7 @@ def micro_point(one_way_ms: float) -> dict:
         return {
             "one_way_ms": one_way_ms,
             "rtt_ms": 2 * one_way_ms,
+            "followers": n_followers,
             "units": serialized_units,
             "wall_s": round(wall, 4),
             "per_unit_ms": round(wall / serialized_units * 1000, 4),
@@ -232,6 +235,11 @@ def main() -> None:
     base = micro[0]["per_unit_ms"]
     for row in micro:
         row["overhead_vs_rtt0_ms"] = round(row["per_unit_ms"] - base, 4)
+    # follower-count scaling at the worst RTT: a unit's critical path is
+    # one grant leg + the slowest DONE leg, so per-unit cost should stay
+    # ~flat as followers widen (the legs are concurrent, the arbiter's
+    # work is O(followers) socket writes)
+    scale = [micro_point(ONE_WAY_MS[-1], n) for n in (2, 4, 6)]
     e2e = [e2e_point(ms) for ms in (0.0, 2.5)]
     d_wall = e2e[1]["wall_s"] - e2e[0]["wall_s"]
     units5 = max(e2e[1]["units_granted"], 1)
@@ -241,6 +249,7 @@ def main() -> None:
     out = {
         "metric": "pod unit-protocol overhead under injected DCN RTT",
         "micro": micro,
+        "follower_scaling_at_rtt5": scale,
         "e2e": e2e,
         "e2e_wall_delta_s": round(d_wall, 3),
         "e2e_predicted_protocol_cost_s": round(protocol_cost_s, 3),
