@@ -148,6 +148,33 @@ def test_contiguous_runs():
     assert _contiguous_runs([5, 1, 2, 0, 7]) == [(0, 3), (5, 6), (7, 8)]
 
 
+def test_file_exchange_overwrites_stale_staging(tmp_path, monkeypatch):
+    """A crashed prior session's staged block under the SAME deterministic
+    name must never be adopted: the writer pre-clears and republishes, so
+    the reader gets the fresh payload. Exercised single-process with a
+    synthetic plan (mesh fences no-op; the file protocol is identical)."""
+    from jax.sharding import Mesh
+
+    from harmony_tpu.table.blockmove import MovePlan, _file_exchange
+
+    monkeypatch.setenv("HARMONY_POD_STAGE_ROOT", str(tmp_path))
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("model",))
+    seq = 7777
+    stale_dir = tmp_path / (
+        f"harmony-move-{seq}-" + "-".join(str(d.id) for d in devs))
+    stale_dir.mkdir()
+    (stale_dir / "b3.npy").write_bytes(b"torn garbage from a dead run")
+    fresh = np.full((4, 2), 42.0, dtype=np.float32)
+    plan = MovePlan(sends={0: [(3, 0)]}, recvs={0: {3}},
+                    block_nbytes=fresh.nbytes)
+    received, written = _file_exchange(plan, {3: fresh}, seq, mesh, mesh)
+    np.testing.assert_array_equal(received[3], fresh)
+    assert written == fresh.nbytes
+    # the lowest union process reclaimed the staging after the read fence
+    assert not stale_dir.exists()
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_migrate_blocks_single_process_disjoint_devices():
     """Same-process device-set change: the plan has NO cross-process moves
