@@ -35,6 +35,8 @@ export JAX_PLATFORMS=cpu
   done
   run_row "multiworker aggregate" 900 benchmarks/multiworker.py
   run_row "pod throughput" 1800 benchmarks/pod.py
+  run_row "cross-process block migration bandwidth" 900 \
+    benchmarks/blockmove_bench.py
   echo "# companion artifacts: FAIRNESS_${SUF}.json (N-run fairness series)," \
        "POD_TENANTS_${SUF}.json (carve + share_all pod tenancy)," \
        "POD_SHAREALL_${SUF}.json (share_all vs serialized aggregate A/B)," \
