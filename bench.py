@@ -265,8 +265,21 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
             # only a clean capture of THIS metric counts as evidence —
             # never a crashed-stage stub or a nested error line
             if data.get("metric") == METRIC and "error" not in data:
-                line["prior_chip_capture"] = dict(
-                    data, source=os.path.basename(prior))
+                prior_line = dict(data, source=os.path.basename(prior))
+                # honesty note rides WITH the stale capture: its embedded
+                # vs_baseline used that session's (depressed) CPU rate
+                # (ROUNDLOG round-2/4); recompute against THIS session's
+                # measured denominator so no reader takes 8.75x at face
+                # value
+                if cpu_rate > 0 and data.get("value"):
+                    prior_line["vs_this_sessions_cpu_rate"] = round(
+                        data["value"] / cpu_rate, 2)
+                    prior_line["note"] = (
+                        "embedded vs_baseline used the capture session's "
+                        "own CPU denominator, later found depressed; "
+                        "vs_this_sessions_cpu_rate is the honest multiple "
+                        "against today's measured CPU rate")
+                line["prior_chip_capture"] = prior_line
                 break
     print(json.dumps(line))
 
