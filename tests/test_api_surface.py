@@ -295,6 +295,44 @@ class TestRound3Surfaces:
         assert seen == [mesh8]
         t.remove_layout_listener(seen.append)
 
+    def test_blockmove_surface(self):
+        """The block-granular migration module's public surface (round 5):
+        the planner is pure and deterministic; telemetry and knobs exist
+        under their documented names."""
+        import inspect
+
+        from harmony_tpu.table import blockmove
+
+        assert callable(blockmove.migrate_blocks)
+        assert callable(blockmove.plan_moves)
+        assert callable(blockmove.process_blocks)
+        assert callable(blockmove.block_owners)
+        assert isinstance(blockmove.last_move_stats, dict)
+        assert blockmove._transport_mode() in ("tcp", "file")
+        # the documented knobs resolve through these exact env names
+        src = inspect.getsource(blockmove)
+        for knob in ("HARMONY_POD_BLOCKMOVE", "HARMONY_POD_STAGE_ROOT",
+                     "HARMONY_POD_DCN_HOST", "HARMONY_POD_MOVE_TIMEOUT"):
+            assert knob in src, knob
+
+    def test_chkp_backend_env_knob(self, tmp_path, monkeypatch):
+        """HARMONY_CHKP_BACKEND forces the commit backend uniformly in
+        CheckpointManager.for_job (the pod deployment switch)."""
+        from harmony_tpu.checkpoint.backends import (
+            OrbaxCommitBackend, PosixCommitBackend,
+        )
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        monkeypatch.setenv("HARMONY_CHKP_BACKEND", "orbax")
+        m = CheckpointManager.for_job(str(tmp_path), "j1")
+        assert isinstance(m._backend, OrbaxCommitBackend)
+        monkeypatch.setenv("HARMONY_CHKP_BACKEND", "posix")
+        m = CheckpointManager.for_job(str(tmp_path), "j2")
+        assert isinstance(m._backend, PosixCommitBackend)
+        # explicit argument beats the env
+        m = CheckpointManager.for_job(str(tmp_path), "j3", backend="posix")
+        assert isinstance(m._backend, PosixCommitBackend)
+
     def test_client_pod_commands(self):
         from harmony_tpu.jobserver.client import CommandSender
 
