@@ -231,16 +231,32 @@ def cpu_baseline_rate() -> float:
 
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
          job_walls: dict | None = None) -> None:
-    vs = tpu_rate / cpu_rate if cpu_rate > 0 else 0.0
-    line = {
-        "metric": METRIC,
-        "value": round(tpu_rate, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(vs, 2),
-        "cpu_rate": round(cpu_rate, 1),
-        "mode": "3 concurrent jobs, num_workers=1 each (single chip); "
-                "steady-state (compile warmed on both backends)",
-    }
+    if error:
+        # Accelerator unreachable/failed: the CPU measurement IS the run's
+        # primary result. A "value": 0.0 / "vs_baseline": 0.0 line polluted
+        # the perf trajectory (readers plotting `value` saw throughput
+        # collapse to zero whenever the transport wedged); the explicit
+        # "accelerator": "unreachable" field carries that state instead.
+        line = {
+            "metric": METRIC,
+            "value": round(cpu_rate, 1),
+            "unit": "samples/sec",
+            "accelerator": "unreachable",
+            "cpu_rate": round(cpu_rate, 1),
+            "mode": "cpu fallback: 3 concurrent jobs, num_workers=1 each; "
+                    "steady-state (compile warmed); accelerator pass did "
+                    "not run",
+        }
+    else:
+        line = {
+            "metric": METRIC,
+            "value": round(tpu_rate, 1),
+            "unit": "samples/sec",
+            "vs_baseline": round(tpu_rate / cpu_rate if cpu_rate > 0 else 0.0, 2),
+            "cpu_rate": round(cpu_rate, 1),
+            "mode": "3 concurrent jobs, num_workers=1 each (single chip); "
+                    "steady-state (compile warmed on both backends)",
+        }
     if job_walls:
         # the aggregate is bounded by the LAST job: the straggler app
         # named here is the next perf target

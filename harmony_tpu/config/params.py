@@ -130,6 +130,17 @@ class TrainerParams(ConfigBase):
     # that feed an elasticity optimizer want 1; latency-sensitive jobs can
     # raise the period or disable with 0 (the last split stays in effect).
     comm_probe_period: int = 1
+    # Asynchronous host->device input pipeline (dolphin/prefetch.py): a
+    # producer thread assembles batches and stages their device transfers
+    # ahead of the compute loop, overlapping host input work with device
+    # compute. Ring depth follows the worker's in-flight cap (shallow
+    # under TaskUnit contention). Default ON; disable for A/B parity runs
+    # — losses are bit-identical either way for a fixed seed — or on
+    # hosts where the extra thread is unwelcome. Ignored (synchronous
+    # path kept) under pod lockstep / multi-process meshes, where a
+    # background thread's device_puts would break the deterministic
+    # pod-wide dispatch order.
+    input_prefetch: bool = True
     app_params: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -53,6 +53,15 @@ class ByteLRU:
             self.hits += 1
             return hit[0]
 
+    def contains(self, key: Optional[Hashable]) -> bool:
+        """Presence probe that perturbs NEITHER the LRU order nor the
+        hit/miss counters — planning queries (e.g. "can this epoch bypass
+        host work?") must not masquerade as cache traffic."""
+        if key is None:
+            return False
+        with self._lock:
+            return key in self._cache
+
     def put(self, key: Optional[Hashable], value: Any) -> None:
         if key is None:
             return
@@ -101,6 +110,10 @@ host_data = ByteLRU(4 << 30)
 
 def get(key: Optional[Hashable]):
     return _device.get(key)
+
+
+def contains(key: Optional[Hashable]) -> bool:
+    return _device.contains(key)
 
 
 def put(key: Optional[Hashable], value: Any) -> None:

@@ -5,6 +5,7 @@ from harmony_tpu.dolphin.accessor import (
     ModelAccessor,
     make_accessor,
 )
+from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
 from harmony_tpu.dolphin.worker import WorkerTasklet
 
 __all__ = [
@@ -14,5 +15,7 @@ __all__ = [
     "ModelAccessor",
     "CachedModelAccessor",
     "make_accessor",
+    "PrefetchPipeline",
+    "StagedBatch",
     "WorkerTasklet",
 ]

@@ -60,10 +60,39 @@ class TrainingDataProvider:
         return self._shuffle
 
     def epoch_batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
-        """Yield ``num_mini_batches`` tuples of per-batch arrays."""
-        idx = np.arange(self.num_examples)
+        """Yield ``num_mini_batches`` tuples of per-batch arrays.
+
+        The permutation gather is applied ONCE per array per epoch (one
+        contiguous pass), then batches are sliced as views — per-batch
+        fancy indexing re-walked the whole index array for every batch and
+        dominated host-side input cost on large datasets. Non-shuffling
+        epochs skip the gather entirely and yield pure views (consumers
+        never mutate batches: they feed ``np.stack``/``device_put``).
+
+        Memory: a shuffling epoch holds ONE dataset-sized permuted copy
+        for the epoch's duration (the same total bytes the per-batch
+        gathers allocated, resident at once instead of batch-at-a-time),
+        and the prefetcher's cross-epoch overlap can briefly keep two
+        epochs' copies alive — hosts sized tightly to the dataset should
+        disable shuffling or ``input_prefetch``."""
         if self._shuffle:
+            idx = np.arange(self.num_examples)
             self._rng.shuffle(idx)
+            epoch_arrays = [a[idx] for a in self._arrays]
+        else:
+            epoch_arrays = self._arrays
         for b in range(self.num_mini_batches):
-            sl = idx[b * self.batch_size : (b + 1) * self.batch_size]
-            yield tuple(a[sl] for a in self._arrays)
+            sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
+            yield tuple(a[sl] for a in epoch_arrays)
+
+    def batch_at(self, b: int) -> Tuple[np.ndarray, ...]:
+        """Batch ``b`` of the STABLE epoch order — only defined for
+        non-shuffling providers (shuffled order lives in the epoch
+        iterator's RNG draw). Used to re-materialize a host batch when a
+        device cache entry was invalidated by a live reshard."""
+        if self._shuffle:
+            raise ValueError("batch_at is undefined for shuffling providers")
+        if not 0 <= b < self.num_mini_batches:
+            raise IndexError(f"batch {b} out of range")
+        sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
+        return tuple(a[sl] for a in self._arrays)

@@ -1,0 +1,241 @@
+"""Asynchronous host→device input pipeline for the training hot loop.
+
+The dolphin loop dispatches steps asynchronously, but every batch used to
+be *produced* synchronously on the hot path: the per-batch numpy gather in
+``TrainingDataProvider.epoch_batches()`` and the blocking ``device_put``
+in ``WorkerTasklet._shard_batch`` both ran inside the TaskUnit COMP scope,
+so host assembly and H2D transfer serialized with device dispatch and
+inflated the per-unit cost fed to the fair queue. This module disaggregates
+input production from the training step — the in-process analogue of
+tf.data service's case for disaggregating ML input processing (PAPERS.md):
+
+  * a PRODUCER thread owns one epoch of ``epoch_batches()`` — the epoch
+    RNG draw and per-batch assembly happen off the training thread, in the
+    same order as the synchronous path, so a fixed seed yields the same
+    batch sequence bit-for-bit;
+  * each assembled batch is STAGED with a sharding-aware ``device_put``
+    into a bounded :class:`~harmony_tpu.data.loader.StageRing` whose depth
+    tracks the worker's live in-flight cap (shallow under TaskUnit
+    contention so no tenant's staged backlog taxes HBM or fairness, deep
+    otherwise), overlapping H2D transfer with device compute;
+  * under multi-tenancy the staging transfers are typed as NET TaskUnits
+    (the reference's PULL/PUSH resource class) so they ride the fair queue
+    instead of colliding with peers' COMP units at the dispatch lock;
+  * a :class:`LayoutAnnouncerMixin` reshard announcement invalidates the
+    in-flight staged device copies — the host copies stay, and the
+    consumer re-places them on the live mesh at consume time (a staged
+    batch also self-invalidates if its sharding no longer matches the
+    step's, so a flip the announcement missed is still safe).
+
+Instrumented end to end: ``dolphin.prefetch.produce`` / ``.stage`` /
+``.wait`` trace spans plus the ring's staged/hit/stall/idle counters, which
+the worker reports per epoch as ``InputPipelineMetrics`` through the
+existing metric collector (and so the dashboard connector).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from harmony_tpu.data.loader import StageRing
+from harmony_tpu.runtime.taskunit import TaskUnitAborted
+from harmony_tpu.tracing import trace_span
+
+
+@dataclasses.dataclass
+class StagedBatch:
+    """One prefetched batch: the host tuple plus (optionally) its staged
+    device copy and the sharding it was placed with."""
+
+    index: int
+    host: Tuple[np.ndarray, ...]
+    device: Optional[Tuple[Any, ...]]
+    sharding: Any
+
+    def take(self, live_sharding: Any) -> Optional[Tuple[Any, ...]]:
+        """The staged device copy iff it still matches the live batch
+        sharding; None means the consumer must re-place ``host``."""
+        if self.device is None or self.sharding != live_sharding:
+            return None
+        return self.device
+
+
+class PrefetchPipeline:
+    """One epoch's background input producer.
+
+    Construction starts the producer thread immediately; iterate the
+    pipeline to consume staged batches in order; ``close()`` (idempotent,
+    also run when iteration ends) stops the producer and joins it.
+
+    ``sharding_fn`` is read per batch so stages follow a live reshard;
+    ``depth_fn`` is read per put so the ring tracks the worker's in-flight
+    cap; ``net_scope`` (optional) is called with an abort predicate (true
+    once the ring is closed) and must return a context manager — staging
+    rides the TaskUnit fair queue as a NET unit whose admission wait stays
+    interruptible, so teardown never hangs on a grant that cannot arrive;
+    ``skip_stage_fn`` (optional) suppresses the ``device_put`` for batches
+    that are already device-resident (one evicted cache entry must not
+    re-transfer the whole epoch) — those flow through host-only and the
+    consumer's cache lookup serves them.
+    """
+
+    JOIN_TIMEOUT = 10.0
+
+    def __init__(
+        self,
+        provider: Any,
+        sharding_fn: Callable[[], Any],
+        depth_fn: Callable[[], int],
+        *,
+        epoch: int = 0,
+        job_id: str = "",
+        net_scope: Optional[Callable[[Callable[[], bool]], Any]] = None,
+        skip_stage_fn: Optional[Callable[[int], bool]] = None,
+    ) -> None:
+        self._provider = provider
+        self._sharding_fn = sharding_fn
+        self._net_scope = net_scope
+        self._skip_stage_fn = skip_stage_fn
+        self._ring = StageRing(depth_fn)
+        self._epoch = epoch
+        self._job_id = job_id
+        self._host_only = False  # see stop_staging()
+        self.produce_sec = 0.0  # host assembly (gather/stack) seconds
+        self.stage_sec = 0.0    # device_put seconds (incl. NET admission)
+        self._thread = threading.Thread(
+            target=self._produce,
+            name=f"prefetch-{job_id or 'job'}-e{epoch}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer side ---------------------------------------------------
+
+    def _produce(self) -> None:
+        ring = self._ring
+        try:
+            with trace_span(
+                "dolphin.prefetch.produce",
+                job_id=self._job_id, epoch=self._epoch,
+            ) as span:
+                it = enumerate(self._provider.epoch_batches())
+                while True:
+                    t0 = time.perf_counter()
+                    nxt = next(it, None)
+                    self.produce_sec += time.perf_counter() - t0
+                    if nxt is None:
+                        break
+                    idx, host = nxt
+                    if self._host_only or (
+                        self._skip_stage_fn is not None
+                        and self._skip_stage_fn(idx)
+                    ):
+                        # host-only: demoted (assembly continues — it owns
+                        # the epoch RNG — but transfers stop) or the batch
+                        # is already device-resident (consumer's cache
+                        # lookup serves it; re-transfer would be waste)
+                        if not ring.put(StagedBatch(idx, host, None, None)):
+                            return
+                        continue
+                    sharding = self._sharding_fn()
+                    scope = (self._net_scope(self._closed)
+                             if self._net_scope is not None
+                             else contextlib.nullcontext())
+                    t0 = time.perf_counter()
+                    with trace_span(
+                        "dolphin.prefetch.stage",
+                        job_id=self._job_id, epoch=self._epoch, batch=idx,
+                    ):
+                        with scope:
+                            device = tuple(
+                                jax.device_put(a, sharding) for a in host
+                            )
+                    self.stage_sec += time.perf_counter() - t0
+                    if not ring.put(StagedBatch(idx, host, device, sharding)):
+                        return  # consumer closed the epoch early
+                if span is not None:
+                    span.annotate("staged", ring.staged)
+                    span.annotate("produce_sec", round(self.produce_sec, 6))
+                    span.annotate("stage_sec", round(self.stage_sec, 6))
+                    span.annotate("idle_sec", round(ring.producer_idle_sec, 6))
+        except TaskUnitAborted:
+            return  # ring closed mid-admission-wait: quiet teardown
+        except BaseException as e:  # noqa: BLE001 - re-raised on the consumer
+            ring.set_error(e)
+        else:
+            ring.finish()
+
+    def _closed(self) -> bool:
+        """Abort predicate handed to the NET admission wait."""
+        return self._ring.closed
+
+    # -- consumer side ---------------------------------------------------
+
+    def __iter__(self) -> Iterator[StagedBatch]:
+        ring = self._ring
+        while True:
+            if ring.depth() == 0 and self._thread.is_alive():
+                # about to block on the producer: that is the stall the
+                # pipeline exists to eliminate — make it visible
+                with trace_span(
+                    "dolphin.prefetch.wait",
+                    job_id=self._job_id, epoch=self._epoch,
+                ):
+                    item = ring.get()
+            else:
+                item = ring.get()
+            if item is StageRing.DONE:
+                return
+            yield item
+
+    def invalidate(self) -> int:
+        """Reshard announcement hook: drop the staged device copies (host
+        copies stay — the consumer re-places them on the live mesh), and
+        let new stages pick up the new sharding from ``sharding_fn``.
+        Returns the number of staged batches invalidated."""
+
+        def drop(item: StagedBatch) -> None:
+            item.device = None
+
+        return self._ring.apply(drop)
+
+    def stop_staging(self) -> int:
+        """Demote the pipeline to host-only production: the producer keeps
+        assembling batches (it owns the epoch RNG draw, so abandoning it
+        would double-advance a seeded shuffle) but stops issuing
+        ``device_put``s — the consumer places every batch on the live mesh
+        itself. Used when background transfers become unsafe mid-epoch
+        (a reshard onto a process-spanning mesh, where a device_put is
+        collective-backed and must not race the training thread's
+        dispatches). Also invalidates already-staged copies; returns the
+        invalidated count."""
+        self._host_only = True
+        return self.invalidate()
+
+    def close(self) -> None:
+        """Stop the producer (idempotent) and join it — no leaked thread.
+        Safe from the consumer thread at any point, including after a
+        producer exception already surfaced."""
+        self._ring.close()
+        self._thread.join(timeout=self.JOIN_TIMEOUT)
+
+    @property
+    def thread_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stats(self) -> dict:
+        r = self._ring
+        return {
+            "staged": r.staged,
+            "max_depth": r.max_depth,
+            "producer_idle_sec": r.producer_idle_sec,
+            "consumer_stall_sec": r.consumer_stall_sec,
+            "produce_sec": self.produce_sec,
+            "stage_sec": self.stage_sec,
+        }

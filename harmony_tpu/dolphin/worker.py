@@ -40,8 +40,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from harmony_tpu.data import devcache
 from harmony_tpu.dolphin.data import TrainingDataProvider
+from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
-from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, MetricCollector
+from harmony_tpu.metrics.collector import (
+    BatchMetrics,
+    EpochMetrics,
+    InputPipelineMetrics,
+    MetricCollector,
+)
 from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
@@ -132,7 +138,11 @@ class WorkerTasklet:
         # other tenants' steps, dominating cheap jobs' wall time).
         self.comm_probe_every = getattr(ctx.params, "comm_probe_period", 1)
         self._next_probe = 0  # epochs-since-start of the next drift refresh
-        self._own_batch_cost = 0.0  # EWMA of own dispatch seconds per batch
+        # EWMA of own dispatch seconds per batch. None = unseeded — a
+        # legitimately measured 0.0 must count as a measurement (0.0 is
+        # reachable on sub-resolution timers), so seeding tests use the
+        # sentinel, never truthiness.
+        self._own_batch_cost: Optional[float] = None
         self._prewarmed_stacked = None  # (sharding, stacked) from prewarm
         self._probe_pull = None
         self._probe_pp = None
@@ -146,6 +156,21 @@ class WorkerTasklet:
         self.cache_device_batches = not data.is_shuffling
         self._batch_cache: Dict[int, Any] = {}
         self._stacked_cache = None
+        # Async input pipeline (dolphin/prefetch.py): batch assembly + H2D
+        # staging on a producer thread, overlapping device compute. Config
+        # default ON; _prefetch_usable() gates it off where a background
+        # device_put would break pod-deterministic dispatch order.
+        self._prefetch_on = bool(getattr(ctx.params, "input_prefetch", True))
+        self._active_pipeline: Optional[PrefetchPipeline] = None
+        # (epoch, pipeline) spawned ahead of its epoch (see
+        # _spawn_next_pipeline) — consumed by _epoch_batch_stream
+        self._next_pipeline: Optional[Tuple[int, PrefetchPipeline]] = None
+        # set by _on_layout_announcement when the ANNOUNCED target mesh
+        # spans processes — self.mesh lags the flip, so this is what stops
+        # new staging producers from spawning in the announce->flip window
+        self._staging_unsafe = False
+        self._prefetch_hits = 0
+        self._prefetch_misses = 0
         # This worker's own op counters (single-threaded; per-job metric
         # attribution sums these across the job's workers).
         self.op_stats: Dict[str, int] = {"pulls": 0, "pushes": 0, "pull_bytes": 0}
@@ -790,6 +815,210 @@ class WorkerTasklet:
     def _shard_batch(self, batch: Tuple[np.ndarray, ...]):
         return tuple(jax.device_put(a, self._batch_sharding) for a in batch)
 
+    def _host_batch(self, batch_idx: int, batch):
+        """The host arrays for ``batch_idx`` — ``batch`` when the caller
+        carried them, else re-materialized from the provider (only reached
+        on stable-batch paths: a devcache-bypass epoch whose cache a live
+        reshard just cleared)."""
+        if batch is not None:
+            return batch
+        return self.data.batch_at(batch_idx)
+
+    def _prefetch_usable(self) -> bool:
+        """Background staging is safe only where this worker's device_puts
+        may interleave freely with dispatches: pod-lockstep turnstiles
+        need every multi-device operation inside an admission turn, and on
+        multi-process meshes a device_put that replicates across processes
+        is itself collective-backed — both would wedge under a producer
+        thread. The TaskUnit fair queue is fine (staging rides it as NET
+        units when single-worker — see _epoch_batch_stream)."""
+        return (
+            self._prefetch_on
+            and self.dispatch_turn is None
+            and not self._staging_unsafe  # announced spanning target
+            and not self._mesh_spans_processes(self.mesh)
+        )
+
+    def _devcache_epoch_ready(self) -> bool:
+        """True when EVERY batch of the (stable) epoch already has a
+        device-resident copy — the epoch then bypasses host assembly and
+        staging entirely (the devcache-hit fast path)."""
+        if not self.cache_device_batches:
+            return False
+        nb = self.data.num_mini_batches
+        if len(self._batch_cache) == nb:
+            return True
+        return all(
+            i in self._batch_cache or devcache.contains(self._devcache_key(i))
+            for i in range(nb)
+        )
+
+    def _epoch_batch_stream(self, epoch: int):
+        """One epoch's input as (batch_idx, host_batch | None, StagedBatch
+        | None) triples — the three input regimes behind one iterator:
+
+          * devcache-hit epoch: every batch is device-resident already;
+            host assembly is bypassed entirely (host_batch is None);
+          * prefetched epoch: a PrefetchPipeline producer assembles and
+            stages batches ahead of the compute loop;
+          * synchronous fallback (config off / pod lockstep /
+            multi-process mesh): the pre-pipeline behavior, unchanged.
+
+        Callers MUST close() the returned generator (the dispatch loop's
+        finally does) so an early stop tears the producer down."""
+        # ONE ready evaluation for both the handoff decision and the
+        # branch below: a sibling worker devcache.put-ing the last batch
+        # between two evaluations could flip it and strand the handoff
+        # unclosed (leaked producer thread + staged device buffers)
+        ready = self._devcache_epoch_ready()
+        handoff, self._next_pipeline = self._next_pipeline, None
+        if handoff is not None and (handoff[0] != epoch or ready):
+            # wrong-epoch (defensive; epochs stream in order) or the cache
+            # filled (stable batches only — no RNG was drawn): tear the
+            # pre-spawn down before any fallback path
+            handoff[1].close()
+            handoff = None
+        if ready:
+            for i in range(self.data.num_mini_batches):
+                yield i, None, None
+            return
+        if not self._prefetch_usable():
+            if handoff is not None:
+                # usability flipped AFTER the spawn (reshard onto a
+                # spanning mesh): the producer already drew this epoch's
+                # shuffle, so abandoning it would double-advance the RNG
+                # and break seeded parity — consume it in host-only mode
+                # (no background device_puts) instead
+                handoff[1].stop_staging()
+            else:
+                for i, b in enumerate(self.data.epoch_batches()):
+                    yield i, b, None
+                return
+        if handoff is not None:
+            # pre-spawned during the previous epoch's drain: batch 0 is
+            # (usually) already staged — no epoch-start input stall
+            pipeline = handoff[1]
+        else:
+            pipeline = self._make_pipeline(epoch)
+        self._active_pipeline = pipeline
+        if self._staging_unsafe:
+            # an announcement may have raced pipeline construction (the
+            # listener demotes only pipelines it can SEE); recheck after
+            # the assignment so one side always lands — idempotent
+            pipeline.stop_staging()
+        self._prefetch_hits = 0
+        self._prefetch_misses = 0
+        try:
+            for staged in pipeline:
+                yield staged.index, staged.host, staged
+        finally:
+            self._active_pipeline = None
+            pipeline.close()
+            self._emit_prefetch_metrics(epoch, pipeline)
+
+    def _make_pipeline(self, epoch: int) -> PrefetchPipeline:
+        net_scope = None
+        if self.taskunit is not None and self.ctx.num_workers == 1:
+            # staging transfers ride the fair queue as NET units (the
+            # reference's PULL/PUSH resource class) with an interruptible
+            # admission wait (teardown must not hang on a grant that can
+            # no longer arrive) — but only for single-worker jobs:
+            # TaskUnit quorum matches per-worker seq streams, and
+            # producer-timed units would misalign them across a
+            # multi-worker job's executors
+            net_scope = lambda abort: self.taskunit.scope(  # noqa: E731
+                "NET", abort=abort)
+        skip_staged = None
+        if self.cache_device_batches:
+            # partial-cache epochs (one LRU-evicted batch) re-stage only
+            # what is actually missing; resident batches flow host-only
+            skip_staged = lambda i: (  # noqa: E731
+                i in self._batch_cache
+                or devcache.contains(self._devcache_key(i))
+            )
+        return PrefetchPipeline(
+            self.data,
+            lambda: self._batch_sharding,
+            self._inflight_cap,
+            epoch=epoch,
+            job_id=self.job_id,
+            net_scope=net_scope,
+            skip_stage_fn=skip_staged,
+        )
+
+    def _spawn_next_pipeline(self, next_epoch: int) -> None:
+        """Cross-epoch overlap: spawned right BEFORE this epoch's metric
+        drain (its blocking device round-trips are the one host-idle
+        window of the batched loop), so the next epoch's gather and
+        staging run during the drain and batch 0 is ready when the next
+        stream opens. Only called after the current epoch's stream fully
+        drained, so the provider's per-epoch RNG draws stay in epoch
+        order — seeded shuffles match the synchronous path exactly."""
+        if self._next_pipeline is not None:
+            return
+        if next_epoch >= self.ctx.params.num_epochs:
+            return
+        if not self._prefetch_usable() or self._devcache_epoch_ready():
+            return
+        pipeline = self._make_pipeline(next_epoch)
+        self._next_pipeline = (next_epoch, pipeline)
+        if self._staging_unsafe:
+            # announcement raced the spawn (see _epoch_batch_stream)
+            pipeline.stop_staging()
+
+    def _close_next_pipeline(self) -> None:
+        if self._next_pipeline is not None:
+            self._next_pipeline[1].close()
+            self._next_pipeline = None
+
+    def _emit_prefetch_metrics(self, epoch: int, pipeline: PrefetchPipeline) -> None:
+        s = pipeline.stats()
+        self.collector.add(
+            InputPipelineMetrics(
+                job_id=self.job_id,
+                worker_id=self.ctx.worker_id,
+                epoch_idx=epoch,
+                staged_batches=s["staged"],
+                prefetch_hits=self._prefetch_hits,
+                prefetch_misses=self._prefetch_misses,
+                max_depth=s["max_depth"],
+                produce_sec=s["produce_sec"],
+                stage_sec=s["stage_sec"],
+                producer_idle_sec=s["producer_idle_sec"],
+                consumer_stall_sec=s["consumer_stall_sec"],
+            )
+        )
+
+    def _on_layout_announcement(self, new_mesh: Mesh) -> None:
+        """Reshard announcement listener: staged input batches target the
+        departing layout — drop their device copies (the consumer
+        re-places the retained host arrays on the live mesh), THEN prewarm
+        the target layout's programs. A target mesh that SPANS processes
+        makes background device_puts collective-backed, so there the
+        producers are demoted to host-only assembly (they keep the epoch
+        RNG draw; the consumer places on the live mesh) instead of merely
+        invalidated."""
+        unsafe = self._mesh_spans_processes(new_mesh)
+        # sticky until a later announcement says otherwise: the worker's
+        # own mesh view (self.mesh) only updates at the post-flip rebuild,
+        # so _prefetch_usable would otherwise green-light one more staging
+        # producer in the announcement->flip window
+        self._staging_unsafe = unsafe
+        # snapshot both attributes: the training thread concurrently
+        # hands off / nulls them (this listener runs on the master thread)
+        nxt = self._next_pipeline
+        for pipeline in (
+            self._active_pipeline,
+            nxt[1] if nxt is not None else None,
+        ):
+            if pipeline is None:
+                continue
+            if unsafe:
+                pipeline.stop_staging()
+            else:
+                pipeline.invalidate()
+        self._prewarm_layout(new_mesh)
+
     def _devcache_key_for_sig(self, tag, sig) -> "tuple | None":
         """devcache key under an EXPLICIT layout signature (the prewarm
         path registers uploads for a layout that is not live yet)."""
@@ -814,7 +1043,7 @@ class WorkerTasklet:
         gkey = self._devcache_key(batch_idx)
         batch_dev = devcache.get(gkey) if gkey is not None else None
         if batch_dev is None:
-            batch_dev = self._shard_batch(batch)
+            batch_dev = self._shard_batch(self._host_batch(batch_idx, batch))
             if gkey is not None:
                 devcache.put(gkey, batch_dev)
         self._batch_cache[batch_idx] = batch_dev
@@ -830,16 +1059,33 @@ class WorkerTasklet:
     def _is_layout_race(e: ValueError) -> bool:
         return "incompatible devices" in str(e)
 
-    def _dispatch_batch(self, batch_idx: int, batch, hyper):
+    def _dispatch_batch(self, batch_idx: int, batch, hyper,
+                        staged: "Optional[StagedBatch]" = None):
         """Rebuild-check + batch placement + dispatch, retried across
         concurrent reshards (the batch cache re-populates on the new mesh
-        after a rebuild clears it)."""
+        after a rebuild clears it). ``staged`` is a prefetched device copy;
+        it is used only while its sharding still matches the live step's
+        (a reshard invalidates it and the host copy is re-placed)."""
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
-            if self.cache_device_batches:
+            batch_dev = staged.take(self._batch_sharding) if staged is not None else None
+            if batch_dev is not None:
+                self._prefetch_hits += 1
+                if self.cache_device_batches and batch_idx not in self._batch_cache:
+                    # seed the caches with the prefetched copy so later
+                    # epochs (and resubmissions) bypass host work entirely
+                    self._batch_cache[batch_idx] = batch_dev
+                    gkey = self._devcache_key(batch_idx)
+                    if gkey is not None:
+                        devcache.put(gkey, batch_dev)
+            elif self.cache_device_batches:
+                if staged is not None:
+                    self._prefetch_misses += 1
                 batch_dev = self._cached_batch(batch_idx, batch)
             else:
-                batch_dev = self._shard_batch(batch)
+                if staged is not None:
+                    self._prefetch_misses += 1
+                batch_dev = self._shard_batch(self._host_batch(batch_idx, batch))
             try:
                 return self._dispatch_step(self._step, batch_dev, hyper)
             except ValueError as e:
@@ -847,7 +1093,9 @@ class WorkerTasklet:
                     raise
                 # FORCE a rebuild: the race proves something layout-derived
                 # is stale even if the cheap sharding compare above missed
-                # it (every cache repopulates on the current mesh)
+                # it (every cache repopulates on the current mesh). The
+                # staged copy targets the departed layout — drop it.
+                staged = None
                 self._build_step()
         raise RuntimeError(
             f"table resharded {self.MAX_RESHARD_RETRIES}x during one batch "
@@ -900,17 +1148,21 @@ class WorkerTasklet:
         if self.post_init_barrier is not None:
             self.post_init_barrier()
         self.trainer.on_training_start(ctx, self.starting_epoch)
-        # subscribe to reshard announcements: the target layout's programs
-        # compile WHILE training still runs on the old one (_prewarm_layout)
+        # subscribe to reshard announcements: staged input batches drop
+        # their device copies and the target layout's programs compile
+        # WHILE training still runs on the old one (_on_layout_announcement)
         add_listener = getattr(ctx.model_table, "add_layout_listener", None)
         if add_listener is not None:
-            add_listener(self._prewarm_layout)
+            add_listener(self._on_layout_announcement)
         try:
             return self._run_epoch_loop(params)
         finally:
+            # a pre-spawned next-epoch producer must not outlive the run
+            # (early stop / exception): join it before reporting back
+            self._close_next_pipeline()
             remove = getattr(ctx.model_table, "remove_layout_listener", None)
             if remove is not None:
-                remove(self._prewarm_layout)
+                remove(self._on_layout_announcement)
 
     def _run_epoch_loop(self, params) -> Dict[str, Any]:
         ctx = self.ctx
@@ -1074,6 +1326,13 @@ class WorkerTasklet:
         pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t = (
             self._dispatch_epoch_batches(epoch, global_batch_idx)
         )
+        if not stop:
+            # next epoch's host assembly runs while the drain below blocks
+            # (under TaskUnit contention its STAGING still queues behind
+            # the drain's NET unit — per-kind metering admits one NET unit
+            # at a time across tenants, by design; the gather/shuffle work
+            # overlaps regardless)
+            self._spawn_next_pipeline(epoch + 1)
         last_metrics: Dict[str, float] = {}
         if pending:
             with trace_span("dolphin.metric_drain", job_id=self.job_id,
@@ -1118,7 +1377,7 @@ class WorkerTasklet:
             if not self.taskunit.contended():
                 return 1
             c = self._own_batch_cost
-            if not c:
+            if c is None:
                 return 1
             # A tenant pays ~one residual PEER-unit wait per own unit
             # (non-preemptive slot), so the dominant slowdown term for a
@@ -1152,77 +1411,89 @@ class WorkerTasklet:
         batch_sizes: List[int] = []
         hyper = self._hyper()
         work_t = 0.0  # dispatch time, EXCLUDING admission/barrier waits
-        it = enumerate(self.data.epoch_batches())
-        nxt = next(it, None)
-        while nxt is not None and not stop:
-            with self._turn():
-                if self._pending_probe is not None:
-                    # turnstiled pods probe inside the chief's first batch
-                    # turn (a separate probe turn would skew the cycle by
-                    # one turn per probe epoch, unboundedly across epochs)
-                    first, self._pending_probe = self._pending_probe, None
-                    with trace_span("dolphin.comm_probe",
-                                    job_id=self.job_id, epoch=epoch):
-                        self._probe_comm(first)
-                if self.batch_barrier is not None:  # SYNC TaskUnit
-                    stop = self.batch_barrier(global_batch_idx)
-                    if stop:
-                        break
-                group = self._units_per_scope()
-                with self._taskunit_scope("COMP"):
-                    # timer starts AFTER admission: the grant wait is
-                    # scheduling, not work — counting it would both skew
-                    # the optimizer's comm/comp split and feed an
-                    # inflated unit cost back into the fair-queue deficit
-                    # (a starved cheap job would look expensive and be
-                    # starved harder)
-                    t_scope = time.perf_counter()
-                    done = 0
-                    while nxt is not None and done < group:
-                        batch_idx, batch = nxt
-                        t0 = time.perf_counter()
-                        metrics = self._dispatch_batch(batch_idx, batch, hyper)
-                        pending.append(metrics)
-                        cap = self._inflight_cap()
-                        if len(pending) >= cap:
-                            # Sliding window: block on the OLDEST
-                            # outstanding step so the device queue stays
-                            # full. hard_sync so a lazy backend actually
-                            # applies backpressure.
-                            hard_sync(pending[len(pending) - cap])
-                        # dt spans dispatch AND the backpressure sync: on
-                        # async backends the sync absorbs real device time
-                        # that would otherwise land in neither work_t nor
-                        # the drain (those steps are complete by then)
-                        dt = time.perf_counter() - t0
-                        # own per-batch EWMA sizes future groups
-                        self._own_batch_cost = (
-                            dt if not self._own_batch_cost
-                            else 0.5 * self._own_batch_cost + 0.5 * dt
-                        )
-                        work_t += dt
-                        batch_sizes.append(batch[0].shape[0])
-                        epoch_examples += batch[0].shape[0]
-                        global_batch_idx += 1
-                        done += 1
-                        if done < group:
-                            nxt = next(it, None)
-                        else:
-                            nxt = None  # refetched below
-                    if self.taskunit is not None:
-                        # live per-UNIT cost for the weighted-fair queue:
-                        # the drain-time report (authoritative on async
-                        # backends) can be a whole multi-epoch window
-                        # away, and a blind WFQ degenerates to 1:1
-                        # pacing. Under the metered global slot the
-                        # in-scope elapsed is ~this unit's own execution
-                        # (blocking backends) or its enqueue cost
-                        # (async) — either way job-relative.
-                        self.taskunit.report_unit_cost(
-                            time.perf_counter() - t_scope
-                        )
-            if not stop:
-                nxt = next(it, None)
+        it = self._epoch_batch_stream(epoch)
+        try:
+            nxt = next(it, None)
+            while nxt is not None and not stop:
+                with self._turn():
+                    if self._pending_probe is not None:
+                        # turnstiled pods probe inside the chief's first batch
+                        # turn (a separate probe turn would skew the cycle by
+                        # one turn per probe epoch, unboundedly across epochs)
+                        first, self._pending_probe = self._pending_probe, None
+                        with trace_span("dolphin.comm_probe",
+                                        job_id=self.job_id, epoch=epoch):
+                            self._probe_comm(first)
+                    if self.batch_barrier is not None:  # SYNC TaskUnit
+                        stop = self.batch_barrier(global_batch_idx)
+                        if stop:
+                            break
+                    group = self._units_per_scope()
+                    with self._taskunit_scope("COMP"):
+                        # timer starts AFTER admission: the grant wait is
+                        # scheduling, not work — counting it would both skew
+                        # the optimizer's comm/comp split and feed an
+                        # inflated unit cost back into the fair-queue deficit
+                        # (a starved cheap job would look expensive and be
+                        # starved harder)
+                        t_scope = time.perf_counter()
+                        done = 0
+                        while nxt is not None and done < group:
+                            batch_idx, batch, staged = nxt
+                            t0 = time.perf_counter()
+                            metrics = self._dispatch_batch(
+                                batch_idx, batch, hyper, staged
+                            )
+                            pending.append(metrics)
+                            cap = self._inflight_cap()
+                            if len(pending) >= cap:
+                                # Sliding window: block on the OLDEST
+                                # outstanding step so the device queue stays
+                                # full. hard_sync so a lazy backend actually
+                                # applies backpressure.
+                                hard_sync(pending[len(pending) - cap])
+                            # dt spans dispatch AND the backpressure sync: on
+                            # async backends the sync absorbs real device time
+                            # that would otherwise land in neither work_t nor
+                            # the drain (those steps are complete by then)
+                            dt = time.perf_counter() - t0
+                            # own per-batch EWMA sizes future groups (None =
+                            # unseeded; a measured 0.0 is a real sample)
+                            self._own_batch_cost = (
+                                dt if self._own_batch_cost is None
+                                else 0.5 * self._own_batch_cost + 0.5 * dt
+                            )
+                            work_t += dt
+                            # bypass epochs carry no host arrays; the
+                            # provider's equal split fixes the batch size
+                            n_ex = (batch[0].shape[0] if batch is not None
+                                    else self.data.batch_size)
+                            batch_sizes.append(n_ex)
+                            epoch_examples += n_ex
+                            global_batch_idx += 1
+                            done += 1
+                            if done < group:
+                                nxt = next(it, None)
+                            else:
+                                nxt = None  # refetched below
+                        if self.taskunit is not None:
+                            # live per-UNIT cost for the weighted-fair queue:
+                            # the drain-time report (authoritative on async
+                            # backends) can be a whole multi-epoch window
+                            # away, and a blind WFQ degenerates to 1:1
+                            # pacing. Under the metered global slot the
+                            # in-scope elapsed is ~this unit's own execution
+                            # (blocking backends) or its enqueue cost
+                            # (async) — either way job-relative.
+                            self.taskunit.report_unit_cost(
+                                time.perf_counter() - t_scope
+                            )
+                if not stop:
+                    nxt = next(it, None)
+        finally:
+            # an early stop (SSP gate) or a raising dispatch must tear the
+            # prefetch producer down NOW, not at GC time
+            it.close()
         return pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t
 
     def _drain_pending(
@@ -1307,6 +1578,9 @@ class WorkerTasklet:
                 self._dispatch_epoch_batches(first_epoch + j, global_batch_idx)
             )
             per_epoch.append((pending, sizes, examples, work_t))
+            # next epoch's producer overlaps either the next dispatch run
+            # (j+1 < k) or the window drain below
+            self._spawn_next_pipeline(first_epoch + j + 1)
             if j + 1 < k:
                 self.trainer.on_epoch_finished(self.ctx, first_epoch + j)
         all_pending = [m for p, _, _, _ in per_epoch for m in p]

@@ -2,6 +2,7 @@ from harmony_tpu.metrics.tracer import Tracer
 from harmony_tpu.metrics.collector import (
     BatchMetrics,
     EpochMetrics,
+    InputPipelineMetrics,
     MetricCollector,
     ServerMetrics,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "Tracer",
     "BatchMetrics",
     "EpochMetrics",
+    "InputPipelineMetrics",
     "ServerMetrics",
     "MetricCollector",
     "MetricManager",

@@ -52,6 +52,31 @@ class EpochMetrics(ConfigBase):
 
 
 @config
+class InputPipelineMetrics(ConfigBase):
+    """Per-epoch input-pipeline report from the async prefetcher
+    (dolphin/prefetch.py). ``consumer_stall_sec`` > 0 means the pipeline
+    was the bottleneck (the training thread waited on input);
+    ``producer_idle_sec`` > 0 means it ran ahead and parked on the ring
+    cap (the healthy state). ``prefetch_misses`` counts batches consumed
+    WITHOUT a usable staged device copy — re-placed after a mid-flight
+    layout change, or deliberately flowed host-only because they were
+    already device-resident (partial-cache epochs) or staging was demoted
+    (process-spanning reshard)."""
+
+    job_id: str = ""
+    worker_id: str = ""
+    epoch_idx: int = 0
+    staged_batches: int = 0
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    max_depth: int = 0
+    produce_sec: float = 0.0
+    stage_sec: float = 0.0
+    producer_idle_sec: float = 0.0
+    consumer_stall_sec: float = 0.0
+
+
+@config
 class ServerMetrics(ConfigBase):
     """Table-owner-side report (ref: metrics.avsc ServerMetrics + ET
     MetricReportMsg built-ins: block counts, pull counts/bytes)."""

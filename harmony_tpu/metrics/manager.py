@@ -11,7 +11,12 @@ import threading
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
-from harmony_tpu.metrics.collector import BatchMetrics, EpochMetrics, ServerMetrics
+from harmony_tpu.metrics.collector import (
+    BatchMetrics,
+    EpochMetrics,
+    InputPipelineMetrics,
+    ServerMetrics,
+)
 
 
 class MetricManager:
@@ -21,6 +26,7 @@ class MetricManager:
         self._batch: Dict[str, List[BatchMetrics]] = defaultdict(list)
         self._epoch: Dict[str, List[EpochMetrics]] = defaultdict(list)
         self._server: Dict[str, List[ServerMetrics]] = defaultdict(list)
+        self._pipeline: Dict[str, List[InputPipelineMetrics]] = defaultdict(list)
 
     # -- lifecycle (ref: pause/resume around reconfig) -------------------
 
@@ -40,8 +46,9 @@ class MetricManager:
                 self._batch.clear()
                 self._epoch.clear()
                 self._server.clear()
+                self._pipeline.clear()
                 return
-            for store in (self._batch, self._epoch, self._server):
+            for store in (self._batch, self._epoch, self._server, self._pipeline):
                 for key in list(store):
                     store[key] = [m for m in store[key] if m.job_id != job_id]
                     if not store[key]:
@@ -59,6 +66,8 @@ class MetricManager:
                 self._epoch[record.worker_id].append(record)
             elif isinstance(record, ServerMetrics):
                 self._server[record.executor_id].append(record)
+            elif isinstance(record, InputPipelineMetrics):
+                self._pipeline[record.worker_id].append(record)
             # dict custom metrics are accepted but unindexed
 
     # -- queries (optimizer inputs) --------------------------------------
@@ -78,6 +87,21 @@ class MetricManager:
     def server_metrics(self, job_id: Optional[str] = None) -> List[ServerMetrics]:
         with self._lock:
             ms = [m for mlist in self._server.values() for m in mlist]
+        if job_id is not None:
+            ms = [m for m in ms if m.job_id == job_id]
+        return ms
+
+    def input_pipeline_metrics(
+        self, worker_id: Optional[str] = None, job_id: Optional[str] = None
+    ) -> List[InputPipelineMetrics]:
+        """Per-epoch prefetch reports (dolphin/prefetch.py) — the input to
+        "is input the bottleneck?" queries: a worker whose
+        consumer_stall_sec dominates its epoch time is input-bound."""
+        with self._lock:
+            if worker_id is not None:
+                ms = list(self._pipeline.get(worker_id, []))
+            else:
+                ms = [m for mlist in self._pipeline.values() for m in mlist]
         if job_id is not None:
             ms = [m for m in ms if m.job_id == job_id]
         return ms

@@ -48,6 +48,12 @@ PHASE_RESOURCE = {
 }
 
 
+class TaskUnitAborted(RuntimeError):
+    """An interruptible admission wait (scope(abort=...)) was withdrawn —
+    the caller's work is being torn down and the grant is no longer
+    wanted. Never raised for ordinary scheduling waits."""
+
+
 @dataclasses.dataclass(frozen=True)
 class TaskUnitInfo:
     """Identity of one schedulable unit (ref: evaluator/impl/TaskUnitInfo)."""
@@ -244,6 +250,13 @@ class GlobalTaskUnitScheduler:
         with self._cond:
             if unit.job_id not in self._job_executors:
                 return True  # job not registered: scheduling disabled for it
+            if key in self._granted:
+                # an abortable wait re-entering after its poll timeout,
+                # whose grant landed in the unlocked gap: re-registering
+                # the key in _waiting would leave a stale quorum-complete
+                # entry that a later grant pass hands to NOBODY — pinning
+                # the per-kind meter and wedging every tenant's admission
+                return True
             if key not in self._waiting:
                 self._arrival_counter += 1
                 self._arrival[key] = self._arrival_counter
@@ -346,6 +359,25 @@ class GlobalTaskUnitScheduler:
         if granted_any:
             self._cond.notify_all()
 
+    def cancel_wait(self, unit: TaskUnitInfo) -> bool:
+        """Withdraw a pending wait (the abort path of an interruptible
+        scope). Returns True when the unit was ALREADY granted — the
+        caller then owns the grant and must balance the meter (finish it,
+        empty or not). A withdrawn wait must not linger in ``_waiting``:
+        for a single-executor quorum a stale complete entry would be
+        granted to nobody and pin the job's per-kind meter forever."""
+        key = (unit.job_id, unit.seq, unit.kind)
+        with self._cond:
+            if key in self._granted:
+                return True
+            waiters = self._waiting.get(key)
+            if waiters is not None:
+                waiters.discard(unit.executor_id)
+                if not waiters:
+                    del self._waiting[key]
+                    self._arrival.pop(key, None)
+            return False
+
     def grant_order(self) -> List[Tuple[str, int, str]]:
         """The single global TaskUnit order (for tests/metrics)."""
         with self._cond:
@@ -395,11 +427,28 @@ class TaskUnitClient:
         self._seq = itertools.count()
 
     @contextlib.contextmanager
-    def scope(self, phase: str):
-        """Accepts a phase name (PULL/COMP/PUSH/SYNC) or a raw resource kind."""
+    def scope(self, phase: str, abort=None, poll: float = 0.25):
+        """Accepts a phase name (PULL/COMP/PUSH/SYNC) or a raw resource
+        kind. ``abort`` (optional callable) makes the admission wait
+        interruptible: polled every ``poll`` seconds; when it returns True
+        the wait is withdrawn and :class:`TaskUnitAborted` raised (a grant
+        that raced the abort is finished empty so the meter stays
+        balanced). Background producers use it so their teardown never
+        hangs on a grant that can no longer arrive (e.g. the job's
+        executor already left the quorum)."""
         kind = PHASE_RESOURCE[phase]
         unit = TaskUnitInfo(self.job_id, self.executor_id, kind, next(self._seq))
-        self._global.wait_ready(unit)
+        if abort is None:
+            self._global.wait_ready(unit)
+        else:
+            while not self._global.wait_ready(unit, timeout=poll):
+                if abort():
+                    if self._global.cancel_wait(unit):
+                        self._global.on_unit_finished(unit)  # raced grant
+                    raise TaskUnitAborted(
+                        f"{self.job_id}/{self.executor_id} {kind} admission "
+                        "wait aborted"
+                    )
         self._local.acquire(kind)
         try:
             yield

@@ -151,12 +151,23 @@ class LayoutAnnouncerMixin:
             if fn in self._layout_listeners:
                 self._layout_listeners.remove(fn)
 
+    @property
+    def layout_version(self) -> int:
+        """Monotonic count of reshard announcements — an observability
+        token for tests and dashboards ("did an announcement reach this
+        table, and how many?"). Staleness of layout-derived state is
+        decided by sharding comparison (StagedBatch.take, _maybe_rebuild),
+        not by this counter."""
+        with self._lock:
+            return getattr(self, "_layout_version", 0)
+
     def announce_reshard(self, new_mesh: Mesh) -> None:
         """Run listeners with the target mesh (outside the table lock —
         listeners dispatch device programs). Best-effort: a failing
         listener never blocks the migration."""
         with self._lock:
             listeners = list(self._layout_listeners)
+            self._layout_version = getattr(self, "_layout_version", 0) + 1
         for fn in listeners:
             try:
                 fn(new_mesh)
