@@ -65,6 +65,17 @@ class MLRTrainer(Trainer):
     # decay depends only on epoch_idx — safe between windowed dispatches
     epoch_hook_windowable = True
 
+    def on_training_start(self, ctx: TrainerContext,
+                          starting_epoch: int) -> None:
+        # Resume contract (Trainer.on_training_start): the decay schedule
+        # is epoch-indexed state, so a checkpoint-resumed run must seed
+        # _lr to what an uninterrupted run had at this epoch — a fresh
+        # step_size past a decay boundary breaks the resumed run's loss
+        # parity (found by the fault-injection auto-resume tests).
+        decays = (starting_epoch // self.decay_period
+                  if self.decay_period else 0)
+        self._lr = self.step_size * (self.decay_rate ** decays)
+
     def on_epoch_finished(self, ctx: TrainerContext, epoch_idx: int) -> None:
         # Step-size decay (ref: MLRTrainer decay via DecayRate/DecayPeriod
         # DolphinParameters). Reaches the compiled step via hyperparams().

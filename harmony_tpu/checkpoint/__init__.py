@@ -5,6 +5,7 @@ from harmony_tpu.checkpoint.backends import (
     make_commit_backend,
 )
 from harmony_tpu.checkpoint.manager import (
+    CheckpointCorruptError,
     CheckpointInfo,
     CheckpointManager,
     CheckpointStillWriting,
@@ -13,6 +14,7 @@ from harmony_tpu.checkpoint.manager import (
 
 __all__ = [
     "CheckpointManager",
+    "CheckpointCorruptError",
     "CheckpointInfo",
     "CheckpointStillWriting",
     "PendingCheckpoint",

@@ -27,6 +27,63 @@ from typing import List, Optional
 
 import numpy as np
 
+from harmony_tpu import faults
+from harmony_tpu.faults.retry import InfraTransientError
+
+#: sentinel prefix tagging the isolated worker's PROTOCOL lines on stdout
+#: — any library the child imports may print (absl, orbax deprecation
+#: notices), and an untagged line must be skipped, never parsed as a
+#: response (the stale-response misattribution bug, advisor round 5)
+_PROTO_PREFIX = "@harmony-chkp@ "
+
+
+class IsolatedWorkerError(InfraTransientError):
+    """The isolated orbax worker died, wedged past its deadline, or
+    desynchronized its protocol stream — after the in-flight op was
+    already retried once on a fresh worker. ``infra_suspect``: the
+    helper process failed, not the checkpoint's own content."""
+
+
+def quarantine_dir(path: str) -> None:
+    """Move a damaged checkpoint directory aside as ``<path>.quarantined``
+    (out of every listing/scan, evidence preserved). Idempotent and
+    race-tolerant: pod peers on a shared FS may quarantine concurrently."""
+    if not os.path.isdir(path):
+        return
+    q = path + ".quarantined"
+    if os.path.isdir(q):
+        shutil.rmtree(q, ignore_errors=True)  # a reused id's older one
+    try:
+        os.rename(path, q)
+    except FileNotFoundError:
+        pass  # a pod peer on the shared FS quarantined it first
+
+
+def _iso_deadline() -> float:
+    """Bound on ONE isolated-worker exchange (request write -> response
+    line) against a WARM worker. Finite on purpose: a wedged worker must
+    be detected, killed, and respawned instead of hanging the pod's
+    checkpoint chain forever."""
+    return float(os.environ.get("HARMONY_CHKP_ISO_TIMEOUT", "120"))
+
+
+def _iso_spawn_grace() -> float:
+    """Extra allowance added to the exchange deadline when the worker was
+    freshly spawned for it: a cold worker pays the jax+orbax import
+    before it can even read the request, and that cost must not be
+    misread as a wedge (it would kill/respawn in a loop forever)."""
+    return float(os.environ.get("HARMONY_CHKP_ISO_SPAWN_GRACE", "60"))
+
+
+def _iso_max_op() -> float:
+    """HARD ceiling on one isolated-worker op, keepalives included. The
+    keepalive beat proves the worker process is alive, not that the op
+    inside it progresses — a save wedged on a dead NFS mount beats
+    forever — so silence-extension is bounded by this cap: legitimately
+    long saves get an hour by default, true op-level wedges are still
+    detected, killed, and respawned."""
+    return float(os.environ.get("HARMONY_CHKP_ISO_MAX_OP", "3600"))
+
 
 class CommitBackend:
     """SPI: durable storage for committed checkpoints."""
@@ -58,6 +115,14 @@ class CommitBackend:
 
     def delete(self, chkp_id: str) -> None:
         raise NotImplementedError
+
+    def quarantine(self, chkp_id: str) -> None:
+        """Remove a DAMAGED checkpoint from the restorable namespace.
+        Stores that can rename keep the bytes for post-mortem (posix);
+        the default deletes — object-store rename is a full copy, and a
+        corrupt checkpoint must never stay listable either way."""
+        if self.exists(chkp_id):
+            self.delete(chkp_id)
 
     def list_ids(self) -> List[str]:
         raise NotImplementedError
@@ -99,10 +164,14 @@ class PosixCommitBackend(CommitBackend):
         if os.path.isdir(d):
             shutil.rmtree(d)
 
+    def quarantine(self, chkp_id: str) -> None:
+        quarantine_dir(os.path.join(self.root, chkp_id))
+
     def list_ids(self) -> List[str]:
         return sorted(
             d for d in os.listdir(self.root)
             if not d.endswith(".staging") and not d.endswith(".writing")
+            and not d.endswith(".quarantined")
             and os.path.isdir(os.path.join(self.root, d))
         )
 
@@ -139,6 +208,12 @@ class OrbaxCommitBackend(CommitBackend):
         self._fetched: dict = {}
         self._iso_proc = None       # persistent isolated worker (lazy)
         self._iso_lock = threading.Lock()  # serializes its pipe exchanges
+        self._iso_queue = None      # stdout lines (reader thread -> ops)
+        self._iso_stderr_path: Optional[str] = None
+        self._iso_stderr_file = None
+        #: respawns forced by supervision (deadline expiry / desync / death)
+        #: — observability for tests and the fault counters
+        self.iso_respawns = 0
 
     def _path(self, chkp_id: str) -> str:
         return (f"{self.root.rstrip('/')}/{chkp_id}" if _is_url(self.root)
@@ -181,54 +256,213 @@ class OrbaxCommitBackend(CommitBackend):
         with self._iso_lock:
             self._run_isolated_locked(op, chkp_id, arg)
 
-    def _run_isolated_locked(self, op: str, chkp_id: str, arg: str) -> None:
+    # -- worker supervision ----------------------------------------------
+    #
+    # The worker is a SUPERVISED child, not a trusted peer:
+    #   * its stderr goes to a FILE, never a pipe — absl/jax/orbax logging
+    #     over a long period=1 chain used to fill the 64KB pipe buffer,
+    #     block the child on a write, and hang the parent's readline
+    #     forever (a silent pod-wide checkpoint hang);
+    #   * its stdout is drained by a dedicated reader thread into a queue,
+    #     so every response wait is DEADLINE-BOUNDED (_iso_deadline);
+    #   * protocol lines carry a sentinel prefix; unrecognized lines
+    #     (library prints) are skipped, and a garbled TAGGED line is a
+    #     protocol desync — the worker is killed, never re-read;
+    #   * expiry/desync/death kill + respawn the worker and retry the
+    #     in-flight op ONCE (commit/fetch are idempotent); a second
+    #     failure surfaces as IsolatedWorkerError (infra_suspect), with
+    #     the stderr file's tail in the message.
+
+    def _spawn_isolated(self):
         import subprocess
         import sys
+        import tempfile
+        import threading
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        for var in list(env):
+            if (var == "PALLAS_AXON_POOL_IPS" or var.startswith("AXON_")
+                    or var in ("JAX_COORDINATOR_ADDRESS",
+                               "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")):
+                env.pop(var)
+        env["JAX_PLATFORMS"] = "cpu"
+        if self._iso_stderr_path is None:
+            base = self.cache_root or tempfile.gettempdir()
+            os.makedirs(base, exist_ok=True)
+            self._iso_stderr_path = os.path.join(
+                base, f"harmony-orbax-iso-{os.getpid()}-{id(self):x}.stderr"
+            )
+        if self._iso_stderr_file is not None:
+            try:
+                self._iso_stderr_file.close()
+            except OSError:
+                pass
+        # truncate per spawn: only the current incarnation's tail is ever
+        # surfaced, and append mode would grow the file without bound on
+        # a long-lived pod (period=1 chains log >64KB per chain — the
+        # volume that motivated moving stderr off the pipe)
+        self._iso_stderr_file = open(self._iso_stderr_path, "wb")
+        code = ("import sys; sys.path.insert(0, sys.argv[1]); "
+                "from harmony_tpu.checkpoint.backends import "
+                "_orbax_isolated_serve; _orbax_isolated_serve()")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, repo_root, self.root,
+             self.cache_root or ""],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._iso_stderr_file, text=True, env=env,
+        )
+        self._iso_proc = proc
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        self._iso_queue = q
+
+        def drain(stdout=proc.stdout, q=q):
+            # EOF sentinel None tells the waiter the worker died; a fresh
+            # queue per spawn means a stale thread can never feed a new
+            # worker's waiter
+            try:
+                for line in stdout:
+                    q.put(line)
+            except (OSError, ValueError):
+                pass
+            q.put(None)
+
+        threading.Thread(target=drain, daemon=True,
+                         name="orbax-iso-stdout").start()
+        return proc
+
+    def _stderr_tail(self, n: int = 2000) -> str:
+        if not self._iso_stderr_path:
+            return ""
+        try:
+            if self._iso_stderr_file is not None:
+                self._iso_stderr_file.flush()
+            with open(self._iso_stderr_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def _kill_isolated(self) -> None:
+        import subprocess
+
+        proc, self._iso_proc = self._iso_proc, None
+        self._iso_queue = None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=30)
+            except (OSError, subprocess.TimeoutExpired):
+                # a SIGKILLed child stuck in uninterruptible IO reaps
+                # later (or never); supervision must still classify this
+                # as IsolatedWorkerError, not leak TimeoutExpired past
+                # the retry contract
+                pass
+        if self._iso_stderr_file is not None:
+            try:
+                self._iso_stderr_file.close()
+            except OSError:
+                pass
+            self._iso_stderr_file = None
+
+    def _exchange_once(self, op: str, chkp_id: str, arg: str) -> dict:
+        """One request/response on the live worker. Raises
+        IsolatedWorkerError for every supervision failure (caller decides
+        whether to retry); returns the parsed protocol response."""
+        import time as _time
 
         proc = self._iso_proc
-        if proc is None or proc.poll() is not None:
-            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))))
-            env = dict(os.environ)
-            for var in list(env):
-                if (var == "PALLAS_AXON_POOL_IPS" or var.startswith("AXON_")
-                        or var in ("JAX_COORDINATOR_ADDRESS",
-                                   "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")):
-                    env.pop(var)
-            env["JAX_PLATFORMS"] = "cpu"
-            code = ("import sys; sys.path.insert(0, sys.argv[1]); "
-                    "from harmony_tpu.checkpoint.backends import "
-                    "_orbax_isolated_serve; _orbax_isolated_serve()")
-            proc = subprocess.Popen(
-                [sys.executable, "-c", code, repo_root, self.root,
-                 self.cache_root or ""],
-                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, text=True, env=env,
-            )
-            self._iso_proc = proc
+        fresh = proc is None or proc.poll() is not None
+        if fresh:
+            proc = self._spawn_isolated()
+        q = self._iso_queue  # after the spawn: one queue per worker
         try:
             proc.stdin.write(json.dumps(
                 {"op": op, "chkp_id": chkp_id, "arg": arg}) + "\n")
             proc.stdin.flush()
-            line = proc.stdout.readline()
         except (OSError, ValueError) as e:
-            self._iso_proc = None
-            raise RuntimeError(f"isolated orbax worker died: {e}") from e
-        if not line:
-            self._iso_proc = None
-            err = ""
+            self._kill_isolated()
+            raise IsolatedWorkerError(
+                f"isolated orbax worker died taking {op}: {e}\n"
+                f"stderr tail:\n{self._stderr_tail()}") from e
+        import queue as _queue
+
+        start = _time.monotonic()
+        deadline = (start + _iso_deadline()
+                    + (_iso_spawn_grace() if fresh else 0.0))
+        hard_deadline = start + _iso_max_op()
+        while True:
             try:
-                err = proc.stderr.read() or ""
-            except Exception:
-                pass
-            raise RuntimeError(
-                f"isolated orbax {op} crashed the worker:\n{err[-2000:]}"
-            )
-        resp = json.loads(line)
-        if not resp.get("ok"):
-            raise RuntimeError(
-                f"isolated orbax {op} failed: {resp.get('error')}"
-            )
+                line = q.get(timeout=max(
+                    0.0, min(deadline, hard_deadline) - _time.monotonic()))
+            except _queue.Empty:
+                self._kill_isolated()
+                why = ("op ceiling" if _time.monotonic() >= hard_deadline
+                       else "silence deadline")
+                raise IsolatedWorkerError(
+                    f"isolated orbax {op} exceeded its {why} "
+                    f"({_iso_deadline():.0f}s silent / "
+                    f"{_iso_max_op():.0f}s total); worker killed for "
+                    f"respawn\nstderr tail:\n"
+                    f"{self._stderr_tail()}") from None
+            if line is None:  # EOF: the worker crashed mid-op
+                self._kill_isolated()
+                raise IsolatedWorkerError(
+                    f"isolated orbax {op} crashed the worker\n"
+                    f"stderr tail:\n{self._stderr_tail()}")
+            if not line.startswith(_PROTO_PREFIX):
+                continue  # library print on stdout: skip, never parse
+            try:
+                resp = json.loads(line[len(_PROTO_PREFIX):])
+            except ValueError:
+                # a TAGGED but unparseable line is a genuine protocol
+                # desync: responses can no longer be attributed — kill
+                # the worker so the next op starts from a clean stream
+                self._kill_isolated()
+                raise IsolatedWorkerError(
+                    f"isolated orbax {op}: protocol desync "
+                    f"(unparseable tagged line {line[:120]!r}); worker "
+                    "killed") from None
+            if resp.get("keepalive"):
+                # the worker process is ALIVE inside a long op (multi-GB
+                # save to slow storage): extend the SILENCE deadline —
+                # but only up to the hard op ceiling, because a beat
+                # proves the process lives, not that the op progresses
+                # (an orbax save wedged on a dead mount beats forever).
+                deadline = _time.monotonic() + _iso_deadline()
+                continue
+            return resp
+
+    def _run_isolated_locked(self, op: str, chkp_id: str, arg: str) -> None:
+        last: Optional[BaseException] = None
+        for attempt in range(2):
+            try:
+                resp = self._exchange_once(op, chkp_id, arg)
+            except IsolatedWorkerError as e:
+                # supervision failure: the op never completed (commit and
+                # fetch are idempotent) — retry ONCE on a fresh worker
+                self.iso_respawns += bool(attempt == 0)
+                last = e
+                faults.site("chkp.iso.supervise", op=op, attempt=attempt)
+                continue
+            if not resp.get("ok"):
+                # child-REPORTED failure: often deterministic (bad path,
+                # missing id) but also how a transient storage blip (an
+                # object-store 503 inside the child's save) surfaces —
+                # retry ONCE (idempotent ops, cheap round-trip), then
+                # raise plainly: we cannot tell the two apart, and a
+                # false infra_suspect would trigger pointless auto-resume
+                # churn on genuinely deterministic errors
+                last = RuntimeError(
+                    f"isolated orbax {op} failed: {resp.get('error')}")
+                continue
+            return
+        raise last  # type: ignore[misc]
 
     def commit(self, chkp_id: str, src_dir: str) -> None:
         if self._in_multiprocess():
@@ -381,14 +615,48 @@ def _is_url(path: str) -> bool:
 def _orbax_isolated_serve() -> None:
     """Persistent child for OrbaxCommitBackend._run_isolated: argv =
     [repo_root(consumed), root, cache_root]; serves JSON-line ops
-    {"op": commit|fetch, "chkp_id", "arg"} on stdin until EOF."""
+    {"op": commit|fetch, "chkp_id", "arg"} on stdin until EOF.
+    Responses are tagged with the protocol sentinel so the parent can
+    tell them from library prints on stdout; stderr is a parent-owned
+    FILE, so logging however verbose can never block this process on a
+    full pipe. While an op is being handled a keepalive beat ticks on
+    stdout, so the parent's deadline bounds SILENCE (a wedge), never the
+    duration of a legitimately long save. Fault sites ("chkp.iso.serve")
+    arm from the inherited HARMONY_FAULT_PLAN env, so supervision tests
+    can wedge/crash/flood a REAL worker deterministically."""
     import sys
+    import threading
 
     root, cache_root = sys.argv[2:4]
     b = OrbaxCommitBackend(root, cache_root or None)
+    out_lock = threading.Lock()  # beat + response lines must not interleave
+
+    def emit(text: str) -> None:
+        with out_lock:
+            sys.stdout.write(_PROTO_PREFIX + text + "\n")
+            sys.stdout.flush()
+
     for line in sys.stdin:
         req = json.loads(line)
+        stop_beat = threading.Event()
+
+        def beat(stop=stop_beat) -> None:
+            while not stop.wait(10.0):
+                emit(json.dumps({"keepalive": True}))
+
+        beat_thread = threading.Thread(target=beat, daemon=True)
         try:
+            # fault site BEFORE the beat starts: an injected wedge must
+            # look like a real one (silent), not a long healthy op
+            action = None
+            if faults.armed():
+                action = faults.site("chkp.iso.serve", op=req.get("op"),
+                                     chkp_id=req.get("chkp_id"))
+            if action == "corrupt":
+                # protocol-desync injection: a TAGGED but garbled line
+                emit("not json at all")
+                continue
+            beat_thread.start()
             if req["op"] == "commit":
                 b._commit_here(req["chkp_id"], req["arg"])
             elif req["op"] == "fetch":
@@ -400,8 +668,11 @@ def _orbax_isolated_serve() -> None:
             resp = {"ok": True}
         except Exception as e:  # noqa: BLE001 - reported to the parent
             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-        sys.stdout.write(json.dumps(resp) + "\n")
-        sys.stdout.flush()
+        finally:
+            stop_beat.set()
+            if beat_thread.is_alive():
+                beat_thread.join(timeout=15.0)
+        emit(json.dumps(resp))
 
 
 def make_commit_backend(commit_root: str, backend=None) -> CommitBackend:

@@ -35,26 +35,101 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from harmony_tpu import native
+from harmony_tpu import faults, native
 from harmony_tpu.config.base import ConfigBase
-from harmony_tpu.config.params import TableConfig
+from harmony_tpu.config.params import RetryPolicy, TableConfig
+from harmony_tpu.faults.retry import call_with_retry
 from harmony_tpu.runtime.master import ETMaster, TableHandle
 
 
-def _write_block(d: str, bid: int, arr: np.ndarray) -> None:
-    if native.available():
-        native.blk_write(os.path.join(d, f"{bid}.blk"), arr)
-    else:
-        np.save(os.path.join(d, f"{bid}.npy"), arr)
+class CheckpointCorruptError(native.BlockCorruptError):
+    """A checkpoint failed an integrity check on restore: a block's bytes
+    don't match the manifest checksum, a block file is torn (codec CRC),
+    or the manifest itself is unreadable. Subclasses the native codec's
+    BlockCorruptError so existing corrupt-block handlers keep matching.
+    NOT retryable — re-reading corrupt bytes cannot help — but
+    RECOVERABLE: the chain-resume path quarantines the damaged checkpoint
+    and falls back to the previous committed entry
+    (jobserver/entity._restore_chain)."""
 
 
-def _read_block(d: str, bid: int) -> np.ndarray:
-    """Read a block in either format (native.BlockCorruptError propagates —
-    a corrupt committed block must abort the restore, not feed garbage)."""
-    blk = os.path.join(d, f"{bid}.blk")
-    if os.path.exists(blk):
-        return native.blk_read(blk)
-    return np.load(os.path.join(d, f"{bid}.npy"))
+def _block_crc(arr: np.ndarray) -> int:
+    """Integrity checksum of a block's LOGICAL bytes (dtype-ordered array
+    content, not the container file) — the same digest whether the block
+    was staged as .blk or .npy, by this process or a pod peer. Zero-copy:
+    zlib.crc32 over a memoryview (identical polynomial/result to the
+    native codec's CRC) — materializing tobytes() would add a full copy
+    of every multi-hundred-MB block on both save and restore."""
+    import zlib
+
+    a = np.ascontiguousarray(arr)
+    try:
+        buf = memoryview(a).cast("B")
+    except (TypeError, ValueError):
+        buf = a.tobytes()  # extension dtypes lack the buffer protocol
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+def _write_block(d: str, bid: int, arr: np.ndarray,
+                 policy: Optional[RetryPolicy] = None) -> int:
+    """Write one block (CRC-trailed .blk when the native codec is up,
+    .npy otherwise), retrying transient IO errors under ``policy``
+    (callers writing many blocks hoist RetryPolicy.from_env() once).
+    Returns the block's content checksum for the manifest."""
+
+    def attempt() -> None:
+        if faults.armed():
+            faults.site("chkp.block_write", block=bid)
+        if native.available():
+            native.blk_write(os.path.join(d, f"{bid}.blk"), arr)
+        else:
+            np.save(os.path.join(d, f"{bid}.npy"), arr)
+
+    call_with_retry(attempt, policy or RetryPolicy.from_env(),
+                    op="chkp.block_write")
+    return _block_crc(arr)
+
+
+def _read_block(d: str, bid: int,
+                expected_crc: Optional[int] = None,
+                policy: Optional[RetryPolicy] = None) -> np.ndarray:
+    """Read a block in either format, retrying transient IO. Corruption is
+    FATAL to the read, never retried: the native codec's CRC trailer
+    catches torn container files, and ``expected_crc`` (from the
+    manifest) catches everything else — a silently truncated .npy, a
+    block swapped between files, bit rot under a valid container. Both
+    raise :class:`CheckpointCorruptError`."""
+
+    def attempt() -> np.ndarray:
+        if faults.armed():
+            faults.site("chkp.block_read", block=bid)
+        blk = os.path.join(d, f"{bid}.blk")
+        try:
+            if os.path.exists(blk):
+                return native.blk_read(blk)
+            return np.load(os.path.join(d, f"{bid}.npy"))
+        except native.BlockCorruptError as e:
+            raise CheckpointCorruptError(str(e)) from e
+        except (ValueError, EOFError) as e:
+            # np.load on a torn/garbled .npy raises ValueError, and on a
+            # ZERO-LENGTH file (power loss before the data flushed)
+            # EOFError — same diagnosis as a CRC failure: the container
+            # is corrupt, and the chain fallback must engage
+            raise CheckpointCorruptError(
+                f"unreadable block {bid} under {d}: {e}") from e
+
+    arr = call_with_retry(
+        attempt, policy or RetryPolicy.from_env(), op="chkp.block_read",
+        fatal=(CheckpointCorruptError, FileNotFoundError),
+    )
+    if expected_crc is not None:
+        got = _block_crc(arr)
+        if got != expected_crc:
+            raise CheckpointCorruptError(
+                f"block {bid} under {d} fails its manifest checksum "
+                f"(expected {expected_crc}, got {got})"
+            )
+    return arr
 
 
 def _pack_hash_block(sk: np.ndarray, v: np.ndarray) -> np.ndarray:
@@ -96,6 +171,10 @@ class CheckpointInfo:
     #: absent in older manifests; the resume path derives the restart
     #: epoch from it instead of guessing from id counters
     app_meta: Optional[Dict[str, float]] = None
+    #: per-block content checksums (str(block_id) -> CRC32 of the block's
+    #: logical bytes — JSON keys are strings). Optional: absent in older
+    #: manifests; restore verifies blocks only when present
+    block_checksums: Optional[Dict[str, int]] = None
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -104,9 +183,25 @@ class CheckpointInfo:
 
     @staticmethod
     def from_json(s: str) -> "CheckpointInfo":
+        # Forward compatibility, at BOTH nesting levels: a NEWER writer's
+        # extra fields (on the manifest or on its embedded table config)
+        # are dropped, not raised on — a TypeError here would be
+        # misclassified as a torn manifest and the chain-resume scan
+        # would quarantine (on object stores: delete) a perfectly valid
+        # checkpoint after a version rollback. Missing REQUIRED fields
+        # still raise (genuinely torn/foreign manifests).
+        from harmony_tpu.config import base as _cfg_base
+
         d = json.loads(s)
-        d["table_config"] = ConfigBase.from_dict(d["table_config"])
-        return CheckpointInfo(**d)
+        tc = d["table_config"]
+        if isinstance(tc, dict):
+            cls = _cfg_base._REGISTRY.get(tc.get("_type"))
+            if cls is not None and dataclasses.is_dataclass(cls):
+                keep = {f.name for f in dataclasses.fields(cls)} | {"_type"}
+                tc = {k: v for k, v in tc.items() if k in keep}
+        d["table_config"] = ConfigBase.from_dict(tc)
+        known = {f.name for f in dataclasses.fields(CheckpointInfo)}
+        return CheckpointInfo(**{k: v for k, v in d.items() if k in known})
 
 
 class CheckpointStillWriting(TimeoutError):
@@ -237,6 +332,8 @@ class CheckpointManager:
             sparse = info.table_config.sparse
             # pop as we go: each device block is released right after its
             # D2H transfer instead of pinning the snapshot until the end.
+            checksums: Dict[str, int] = {}
+            policy = RetryPolicy.from_env()
             for bid in sorted(snap):
                 item = snap.pop(bid)
                 if sparse:
@@ -245,7 +342,9 @@ class CheckpointManager:
                 else:
                     arr = np.asarray(item)
                     arr = arr[:keep] if keep else arr
-                _write_block(staging, bid, arr)
+                checksums[str(bid)] = _write_block(staging, bid, arr,
+                                                   policy)
+            info.block_checksums = checksums
             with open(os.path.join(staging, "manifest.json"), "w") as f:
                 f.write(info.to_json())
             os.rename(staging, tdir)
@@ -378,6 +477,8 @@ class CheckpointManager:
             os.makedirs(staging, exist_ok=True)  # processes race; shared FS
             sparse = info.table_config.sparse
             mine = handle.table.addressable_blocks()
+            my_crcs: Dict[str, int] = {}
+            policy = RetryPolicy.from_env()
             for bid in sorted(mine):
                 item = mine[bid]
                 if sparse:
@@ -386,7 +487,18 @@ class CheckpointManager:
                     )
                 else:
                     arr = np.asarray(item)
-                _write_block(staging, bid, arr)
+                my_crcs[str(bid)] = _write_block(staging, bid, arr, policy)
+            # Per-process checksum sidecar: only THIS process knows the
+            # digests of the blocks it staged; the leader merges every
+            # sidecar into the manifest's block_checksums after the
+            # staged fence (which orders all sidecar writes before the
+            # leader's read) and removes them before the rename.
+            side_tmp = os.path.join(staging,
+                                    f"_crc.{_jax.process_index()}.json.tmp")
+            with open(side_tmp, "w") as f:
+                json.dump(my_crcs, f, sort_keys=True)
+            os.replace(side_tmp, os.path.join(
+                staging, f"_crc.{_jax.process_index()}.json"))
         except BaseException as e:  # noqa: BLE001 - reported via the fence
             err = e
         failures = mesh_sum(mesh, 1.0 if err else 0.0,
@@ -401,6 +513,18 @@ class CheckpointManager:
             )
         if _jax.process_index() == leader:
             try:
+                # merge every process's checksum sidecar into the manifest
+                # (duplicate block ids across sidecars — replicated blocks
+                # staged by their lowest owner only — cannot conflict:
+                # identical content, identical digest)
+                merged: Dict[str, int] = {}
+                for name in sorted(os.listdir(staging)):
+                    if name.startswith("_crc.") and name.endswith(".json"):
+                        p = os.path.join(staging, name)
+                        with open(p) as f:
+                            merged.update(json.load(f))
+                        os.remove(p)
+                info.block_checksums = merged or None
                 with open(os.path.join(staging, "manifest.json"), "w") as f:
                     f.write(info.to_json())
                 os.rename(staging, tdir)
@@ -468,6 +592,8 @@ class CheckpointManager:
         mid-commit leaves the temp copy restorable. Idempotent: a retry
         after a crash between the durable write and the temp cleanup just
         finishes the cleanup."""
+        if faults.armed():
+            faults.site("chkp.commit", chkp_id=chkp_id)
         src = os.path.join(self.temp_root, chkp_id)
         if self._backend.exists(chkp_id):
             shutil.rmtree(src, ignore_errors=True)
@@ -476,6 +602,19 @@ class CheckpointManager:
             raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
         self._backend.commit(chkp_id, src)
         shutil.rmtree(src)
+
+    def quarantine(self, chkp_id: str) -> None:
+        """Move a DAMAGED checkpoint out of the restorable namespace
+        without destroying the evidence: the temp copy is renamed to
+        ``<id>.quarantined`` (filtered from every listing/scan), and the
+        durable copy is quarantined by its backend (rename where the
+        store supports it, delete where it doesn't). Idempotent. Called
+        by the chain-resume fallback so a corrupt newest entry can never
+        be picked again — by this resume or any later one."""
+        from harmony_tpu.checkpoint.backends import quarantine_dir
+
+        self._backend.quarantine(chkp_id)
+        quarantine_dir(os.path.join(self.temp_root, chkp_id))
 
     # -- read path -------------------------------------------------------
 
@@ -490,15 +629,33 @@ class CheckpointManager:
 
     @staticmethod
     def _load_manifest(d: str) -> CheckpointInfo:
-        with open(os.path.join(d, "manifest.json")) as f:
-            return CheckpointInfo.from_json(f.read())
+        """Torn-commit detection: a checkpoint directory whose manifest is
+        missing or unparseable is a torn commit (the manifest is written
+        LAST), surfaced as CheckpointCorruptError so the chain-resume
+        fallback can quarantine it and try the previous entry."""
+        path = os.path.join(d, "manifest.json")
+        try:
+            with open(path) as f:
+                return CheckpointInfo.from_json(f.read())
+        except FileNotFoundError as e:
+            raise CheckpointCorruptError(
+                f"torn checkpoint at {d}: no manifest.json") from e
+        except (ValueError, KeyError, TypeError) as e:
+            raise CheckpointCorruptError(
+                f"torn/corrupt manifest at {path}: "
+                f"{type(e).__name__}: {e}") from e
 
     def info(self, chkp_id: str) -> CheckpointInfo:
         """Manifest only — never materializes block data (a remote backend's
         full fetch can be GBs; metadata reads must stay cheap)."""
         text = self._backend.fetch_manifest(chkp_id)
         if text is not None:
-            return CheckpointInfo.from_json(text)
+            try:
+                return CheckpointInfo.from_json(text)
+            except (ValueError, KeyError, TypeError) as e:
+                raise CheckpointCorruptError(
+                    f"torn/corrupt manifest for {chkp_id}: "
+                    f"{type(e).__name__}: {e}") from e
         temp = os.path.join(self.temp_root, chkp_id)
         if os.path.isdir(temp):
             return self._load_manifest(temp)
@@ -508,6 +665,7 @@ class CheckpointManager:
         temp = set(
             d for d in os.listdir(self.temp_root)
             if not d.endswith(".staging") and not d.endswith(".writing")
+            and not d.endswith(".quarantined")
             and os.path.isdir(os.path.join(self.temp_root, d))
         )
         return sorted(temp | set(self._backend.list_ids()))
@@ -533,8 +691,11 @@ class CheckpointManager:
         try:
             spec = handle.table.spec
             blocks: Dict[int, np.ndarray] = {}
+            crcs = info.block_checksums or {}
+            policy = RetryPolicy.from_env()
             for bid in info.block_ids:
-                arr = _read_block(d, bid)
+                arr = _read_block(d, bid, expected_crc=crcs.get(str(bid)),
+                                  policy=policy)
                 if cfg.sparse:
                     blocks[bid] = _unpack_hash_block(arr, spec)
                     continue

@@ -60,6 +60,60 @@ class TableConfig(ConfigBase):
 
 
 @config
+class RetryPolicy(ConfigBase):
+    """Bounded retry with exponential backoff + jitter for transient
+    infrastructure faults (block-migration transport legs, checkpoint
+    block I/O, the isolated orbax worker's pipe ops — see
+    harmony_tpu.faults.retry.call_with_retry).
+
+    The schedule: attempt, sleep ``base_delay_sec``, attempt, sleep
+    ``base_delay_sec * multiplier`` ... capped at ``max_delay_sec``, for
+    at most ``max_attempts`` attempts; each sleep is stretched by up to
+    ``jitter`` (fraction) of itself so retrying peers don't stampede a
+    recovering endpoint in sync. Exhaustion raises RetryError, which
+    carries the ``infra_suspect`` marker the pod's auto-resume keys on.
+    """
+
+    max_attempts: int = 4
+    base_delay_sec: float = 0.05
+    max_delay_sec: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.base_delay_sec < 0 or self.max_delay_sec < 0:
+            raise ValueError("delays must be non-negative")
+        if self.multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1 (backoff, not decay)")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError("jitter is a fraction in [0, 1]")
+
+    _ENV_FIELDS = (
+        ("max_attempts", "HARMONY_RETRY_MAX_ATTEMPTS", int),
+        ("base_delay_sec", "HARMONY_RETRY_BASE_DELAY", float),
+        ("max_delay_sec", "HARMONY_RETRY_MAX_DELAY", float),
+        ("multiplier", "HARMONY_RETRY_MULTIPLIER", float),
+        ("jitter", "HARMONY_RETRY_JITTER", float),
+    )
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """Defaults overridden by ``HARMONY_RETRY_*`` env vars — an env
+        knob (like HARMONY_CHKP_BACKEND) precisely so every pod process
+        inherits the same policy without per-layer plumbing."""
+        import os
+
+        kv = {}
+        for field_name, var, cast in cls._ENV_FIELDS:
+            raw = os.environ.get(var)
+            if raw is not None:
+                kv[field_name] = cast(raw)
+        return cls(**kv)
+
+
+@config
 class RemoteAccessConfig(ConfigBase):
     """Host-side op-queue knobs (ref: RemoteAccessConfiguration: CommQueueSize,
     NumCommThreads). On TPU the data plane is XLA collectives, but the host

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from harmony_tpu import faults
 from harmony_tpu.data import devcache
 from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
@@ -1066,6 +1067,16 @@ class WorkerTasklet:
         after a rebuild clears it). ``staged`` is a prefetched device copy;
         it is used only while its sharding still matches the live step's
         (a reshard invalidates it and the host copy is re-placed)."""
+        # step-boundary fault site (armed()-guarded: disarmed cost is one
+        # global read — no ctx dict, no site dispatch). A "crash" rule
+        # here kills THIS process mid-epoch like a SIGKILL'd follower —
+        # the deterministic trigger the pod recovery tests arm via the
+        # env-serialized plan (match on proc to pick the victim).
+        if faults.armed():
+            faults.site(
+                "worker.step", job=self.job_id, worker=self.ctx.worker_id,
+                batch=batch_idx, proc=jax.process_index(),
+            )
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
             batch_dev = staged.take(self._batch_sharding) if staged is not None else None
@@ -1782,6 +1793,14 @@ class WorkerTasklet:
 
     def _finish_epoch(self, epoch, epoch_t0, epoch_examples, last_metrics,
                       epoch_losses, call_trainer_hook: bool = True):
+        # epoch-boundary fault site: the fused/windowed paths dispatch
+        # whole epochs without per-batch host steps, so this is the
+        # boundary every path crosses (checkpoint hooks fire right after)
+        if faults.armed():
+            faults.site(
+                "worker.epoch", job=self.job_id, worker=self.ctx.worker_id,
+                epoch=epoch, proc=jax.process_index(),
+            )
         progress = self._primary_metric(last_metrics)
         self.collector.add(
             EpochMetrics(

@@ -1,0 +1,62 @@
+"""Deterministic fault injection + hardened-recovery primitives.
+
+Two halves:
+  * :mod:`harmony_tpu.faults.plan` — named injection sites threaded
+    through the transports/checkpoint/pod layers, armed by a
+    :class:`FaultPlan` (env-serializable, so plans cross process
+    boundaries into pod followers and the isolated orbax worker);
+  * :mod:`harmony_tpu.faults.retry` — the one bounded-backoff retry idiom
+    those layers use, with give-up errors marked ``infra_suspect`` so the
+    pod's auto-resume machinery treats them as infrastructure faults.
+
+See docs/FAULT_TOLERANCE.md for the failure model, the site registry, and
+the recovery matrix.
+"""
+from harmony_tpu.faults.plan import (
+    ENV_VAR,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    arm,
+    arm_from_env,
+    armed,
+    counters,
+    disarm,
+    reset_counters,
+    site,
+)
+from harmony_tpu.faults.retry import (
+    InfraTransientError,
+    RetryError,
+    backoff_delays,
+    call_with_retry,
+    retry_counters,
+)
+
+
+def all_counters() -> dict:
+    """Fault-fire + retry counters merged (metrics surface)."""
+    out = dict(counters())
+    out.update(retry_counters())
+    return out
+
+
+__all__ = [
+    "ENV_VAR",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "InfraTransientError",
+    "RetryError",
+    "all_counters",
+    "arm",
+    "arm_from_env",
+    "armed",
+    "backoff_delays",
+    "call_with_retry",
+    "counters",
+    "disarm",
+    "reset_counters",
+    "retry_counters",
+    "site",
+]

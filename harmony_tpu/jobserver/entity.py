@@ -262,13 +262,26 @@ class DolphinJobEntity(JobEntity):
                 f"job {cfg.job_id}: resume_from_chain needs the server's "
                 "chkp_root (the chain lives there)"
             )
+        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
+        from harmony_tpu.jobserver.joblog import job_logger
+
         mgr = CheckpointManager.for_job(self.chkp_root, cfg.job_id)
         prefix = f"{cfg.job_id}:"
         infos = []
         for cid in mgr.list_checkpoints():
             if not cid.startswith(prefix):
                 continue
-            info = mgr.info(cid)
+            try:
+                info = mgr.info(cid)
+            except CheckpointCorruptError as e:
+                # torn manifest: this entry can never restore — quarantine
+                # it NOW so no later scan trips on it either
+                job_logger(cfg.job_id).warning(
+                    "chain entry %s has a torn manifest (%s); quarantined",
+                    cid, e,
+                )
+                mgr.quarantine(cid)
+                continue
             if info.app_meta is None or "epoch" not in info.app_meta:
                 continue  # not a chain entry (no epoch tag)
             infos.append(info)
@@ -277,14 +290,6 @@ class DolphinJobEntity(JobEntity):
                 f"job {cfg.job_id}: resume_from_chain found no epoch-"
                 f"tagged chain checkpoints under {self.chkp_root}"
             )
-        # primary key: the MONOTONIC epoch tag (wall clock can regress
-        # across hosts/NTP steps and must never discard newer progress);
-        # created_at only tie-breaks entries claiming the same epoch
-        # (a resubmitted-from-scratch chain re-covering old epochs)
-        latest = max(infos,
-                     key=lambda i: (int(i.app_meta["epoch"]), i.created_at))
-        handle = mgr.restore(master, latest.chkp_id, executor_ids, data_axis)
-        starting_epoch = int(latest.app_meta["epoch"]) + 1
 
         def counter_of(cid: str) -> int:
             try:
@@ -296,7 +301,41 @@ class DolphinJobEntity(JobEntity):
         # existing entry (ids stay unique/ordered; the epoch clock is the
         # manifest tag, never the counter)
         base = max(counter_of(i.chkp_id) for i in infos)
-        return handle, starting_epoch, base
+        # primary key: the MONOTONIC epoch tag (wall clock can regress
+        # across hosts/NTP steps and must never discard newer progress);
+        # created_at only tie-breaks entries claiming the same epoch
+        # (a resubmitted-from-scratch chain re-covering old epochs).
+        # Newest-first with CORRUPTION FALLBACK: a chain entry that fails
+        # restore integrity (manifest-checksum mismatch, torn block file,
+        # missing block) is quarantined and the PREVIOUS committed entry
+        # is tried — losing one epoch of progress beats failing the
+        # resume outright. Only corruption-class errors fall through;
+        # anything else (bad grant, schema mismatch) aborts immediately:
+        # it would fail identically on every entry.
+        ordered = sorted(
+            infos,
+            key=lambda i: (int(i.app_meta["epoch"]), i.created_at),
+            reverse=True,
+        )
+        failures = []
+        for info in ordered:
+            try:
+                handle = mgr.restore(master, info.chkp_id, executor_ids,
+                                     data_axis)
+            except (CheckpointCorruptError, FileNotFoundError) as e:
+                job_logger(cfg.job_id).warning(
+                    "chain entry %s is corrupt/torn (%s: %s); quarantining "
+                    "and falling back to the previous committed entry",
+                    info.chkp_id, type(e).__name__, e,
+                )
+                failures.append((info.chkp_id, f"{type(e).__name__}: {e}"))
+                mgr.quarantine(info.chkp_id)
+                continue
+            return handle, int(info.app_meta["epoch"]) + 1, base
+        raise ValueError(
+            f"job {cfg.job_id}: every chain checkpoint failed integrity "
+            f"on restore (all quarantined): {failures}"
+        )
 
     def run(self) -> Dict[str, Any]:
         cfg = self.config

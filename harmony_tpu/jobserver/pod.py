@@ -276,6 +276,16 @@ class PodJobServer(JobServer):
             except OSError:
                 pass
 
+    def _record_infra_failed_locked(self, job_id: str) -> None:
+        """Record auto-resume evidence for ``job_id`` (caller holds
+        _pod_cond). Trim BEFORE adding: evicting an arbitrary set element
+        after the add could evict the id just recorded and silently lose
+        the evidence (ids for jobs without auto_resume are never consumed
+        by _maybe_auto_resume, so the set does grow on long-lived pods)."""
+        while len(self._infra_failed) >= 1024:
+            self._infra_failed.pop()
+        self._infra_failed.add(job_id)
+
     def _on_follower_death(self, pid: int) -> None:
         """Confine the damage: the dead process — and every process
         sharing a RUNNING job with it (their threads may be wedged in
@@ -652,6 +662,15 @@ class PodJobServer(JobServer):
                 self._resolve_remote(config, participants)
             if participants:
                 reports = self._collect_reports(config.job_id, participants)
+                # Give-up escalation: a follower that FAILED the job on an
+                # exhausted-retry infra error (transport/storage — its
+                # report carries infra_suspect, the follower itself is
+                # alive and serviceable) feeds the same auto-resume
+                # evidence a death would, WITHOUT retiring any process.
+                if any(not r.get("ok") and r.get("infra_suspect")
+                       for r in reports.values()):
+                    with self._pod_cond:
+                        self._record_infra_failed_locked(config.job_id)
                 # A participant that never reported is wedged (likely stuck
                 # in a collective): any later job overlapping its process
                 # could never complete — poison the pod.
@@ -661,9 +680,7 @@ class PodJobServer(JobServer):
                     # the reader-EOF path) and poison PARTIALLY so
                     # unaffected jobs and auto-resumes keep running
                     with self._pod_cond:
-                        self._infra_failed.add(config.job_id)
-                        while len(self._infra_failed) > 1024:
-                            self._infra_failed.pop()
+                        self._record_infra_failed_locked(config.job_id)
                     for pid in dead:
                         self._on_follower_death(pid)
                     self._mark_broken(
@@ -716,11 +733,18 @@ class PodJobServer(JobServer):
             return
         with self._pod_cond:
             # evidence that THIS job's failure was infra-observed (a
-            # participant died/went silent while it ran) — a job failing
-            # on its own terms after some unrelated earlier death must
-            # NOT be resubmitted to fail identically again
+            # participant died/went silent while it ran, or a participant
+            # reported an infra_suspect give-up) — a job failing on its
+            # own terms after some unrelated earlier death must NOT be
+            # resubmitted to fail identically again
             infra = config.job_id in self._infra_failed
             self._infra_failed.discard(config.job_id)
+        if not infra:
+            # leader-LOCAL evidence: the future's exception carries the
+            # infra_suspect marker (a bounded-retry give-up in this
+            # process — faults.retry.InfraTransientError)
+            infra = bool(getattr(jr.future.exception(), "infra_suspect",
+                                 False))
         if not infra:
             return  # the job failed on its own terms, not infra death
         from harmony_tpu.checkpoint.manager import CheckpointManager
@@ -972,13 +996,19 @@ class PodJobServer(JobServer):
             rep = self._wait_report_live(config.job_id, chief)
             if rep is None:
                 with self._pod_cond:  # infra-observed: resume-eligible
-                    self._infra_failed.add(config.job_id)
+                    self._record_infra_failed_locked(config.job_id)
                 raise RuntimeError(
                     f"chief follower {chief} never reported for "
                     f"{config.job_id} (connection lost or heartbeat "
                     "silence)"
                 )
             if not rep.get("ok"):
+                if rep.get("infra_suspect"):
+                    # chief-reported give-up on an infra fault: resume-
+                    # eligible (the _dispatch leg records participants'
+                    # flags; this covers the chief-only result path)
+                    with self._pod_cond:
+                        self._record_infra_failed_locked(config.job_id)
                 raise RuntimeError(
                     f"remote job failed on follower {chief}: "
                     f"{rep.get('error', 'unknown error')}"
@@ -1107,6 +1137,8 @@ class PodFollower:
                          name=f"pod-hb-{pid}").start()
 
     def _heartbeat_loop(self) -> None:
+        from harmony_tpu import faults
+
         while not self._hb_stop.wait(self._hb_period):
             try:
                 jobs = sorted(self._entities)
@@ -1115,6 +1147,19 @@ class PodFollower:
                 # beat catches up — the beacon must NEVER die while the
                 # process is healthy (its silence poisons the pod)
                 continue
+            if faults.armed():
+                # injected heartbeat silence ("skip" drops this beat; a
+                # "raise" rule is contained to the same outcome): the
+                # process is alive but mute — exactly the partial
+                # failure the leader's hb_timeout/infra-dead confinement
+                # must handle. The beacon THREAD must survive any
+                # injected action (its death would silence ALL beats,
+                # violating the never-die invariant above).
+                try:
+                    if faults.site("pod.heartbeat", pid=self.pid) == "skip":
+                        continue
+                except Exception:
+                    continue  # one beat lost, beacon lives
             try:
                 self._report({"cmd": "HEARTBEAT", "pid": self.pid,
                               "jobs": jobs})
@@ -1329,6 +1374,12 @@ class PodFollower:
                     pass
             report["ok"] = False
             report["error"] = f"{type(e).__name__}: {e}"
+            if getattr(e, "infra_suspect", False):
+                # a bounded-retry give-up (transport/storage/helper died
+                # — faults.retry.InfraTransientError): tell the leader
+                # this failure is INFRA-shaped so auto_resume jobs are
+                # eligible to resubmit, exactly like a follower death
+                report["infra_suspect"] = True
         self._entities.pop(config.job_id, None)
         self._pod_units.forget(config.job_id)
         self._report(report)

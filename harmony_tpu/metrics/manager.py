@@ -106,6 +106,17 @@ class MetricManager:
             ms = [m for m in ms if m.job_id == job_id]
         return ms
 
+    def fault_counters(self) -> Dict[str, int]:
+        """Fault-injection fires (``site:action``) + retry counters
+        (``op.retries`` / ``op.giveups``) for THIS process, from
+        harmony_tpu.faults. Zero entries on a healthy fabric with no plan
+        armed; a production dashboard watching ``*.retries`` sees
+        transient infra trouble before it becomes a giveup, and
+        ``*.giveups`` feeding the pod's infra-dead/auto-resume path."""
+        from harmony_tpu import faults
+
+        return faults.all_counters()
+
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star
         metric: reference BatchMetrics.dataProcessingRate summed)."""

@@ -195,35 +195,41 @@ def _py_blk_read(path: str) -> np.ndarray:
     import struct
     import zlib
 
+    # torn/garbled container state raises BlockCorruptError (matching the
+    # native reader's rc=-4 mapping) — corruption must never be
+    # misclassified as transient IO, or the chain-fallback recovery path
+    # retries it instead of quarantining
     with open(path, "rb") as f:
         head = f.read(12)
         if len(head) < 12:
-            raise IOError(f"blk_read({path}): truncated header")
+            raise BlockCorruptError(f"blk_read({path}): truncated header")
         magic, dtype_code, ndim = struct.unpack("<III", head)
         if magic not in (0x48544231, 0x48544232) or ndim > 8:
-            raise IOError(f"blk_read({path}): bad magic/ndim")
+            raise BlockCorruptError(f"blk_read({path}): bad magic/ndim")
         shape_bytes = f.read(8 * ndim)
         if len(shape_bytes) < 8 * ndim:
-            raise IOError(f"blk_read({path}): truncated header")
+            raise BlockCorruptError(f"blk_read({path}): truncated header")
         shape = struct.unpack(f"<{ndim}Q", shape_bytes) if ndim else ()
         raw_n = comp_n = None
         if magic == 0x48544232:
             sizes = f.read(16)
             if len(sizes) < 16:
-                raise IOError(f"blk_read({path}): truncated header")
+                raise BlockCorruptError(
+                    f"blk_read({path}): truncated header")
             raw_n, comp_n = struct.unpack("<QQ", sizes)
             # bound header-carried sizes before allocating from them (a
             # corrupt raw_n must not drive an unbounded decompress buffer)
             if comp_n > raw_n or (comp_n != raw_n
                                   and raw_n > comp_n * 1032 + 1024):
-                raise IOError(f"blk_read({path}): implausible size header")
+                raise BlockCorruptError(
+                    f"blk_read({path}): implausible size header")
         rest = f.read()
     if len(rest) < 4:
-        raise IOError(f"blk_read({path}): truncated payload")
+        raise BlockCorruptError(f"blk_read({path}): truncated payload")
     payload, crc_stored = rest[:-4], struct.unpack("<I", rest[-4:])[0]
     if comp_n is not None and comp_n != raw_n:
         if len(payload) != comp_n:
-            raise IOError(f"blk_read({path}): truncated payload")
+            raise BlockCorruptError(f"blk_read({path}): truncated payload")
         try:
             payload = zlib.decompress(payload, bufsize=raw_n)
         except zlib.error as e:
@@ -246,6 +252,8 @@ def blk_read(path: str) -> np.ndarray:
     dtype = ctypes.c_int32()
     nbytes = lib.ht_blk_read(path.encode(), None, 0, shape, ctypes.byref(ndim),
                              ctypes.byref(dtype))
+    if nbytes == -4:  # bad magic / truncated header — a torn file
+        raise BlockCorruptError(f"corrupt block {path} (torn header)")
     if nbytes < 0:
         raise IOError(f"blk_read({path}) metadata failed: rc={nbytes}")
     if dtype.value not in _CODE_DTYPES:
@@ -255,7 +263,7 @@ def blk_read(path: str) -> np.ndarray:
         path.encode(), out.ctypes.data_as(ctypes.c_void_p), nbytes,
         shape, ctypes.byref(ndim), ctypes.byref(dtype),
     )
-    if rc in (-6, -8):  # CRC mismatch / failed inflate — both corruption
+    if rc in (-4, -6, -8):  # torn header / CRC mismatch / failed inflate
         raise BlockCorruptError(f"corrupt block {path} (rc={rc})")
     if rc < 0:
         raise IOError(f"blk_read({path}) failed: rc={rc}")

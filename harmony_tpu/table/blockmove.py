@@ -53,6 +53,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from harmony_tpu import faults
+from harmony_tpu.faults.retry import InfraTransientError, RetryError, call_with_retry
+
 # Lockstep per-process counter (see module doc) naming each migration's
 # rendezvous keys / staging dir consistently across processes.
 _MOVE_SEQ = itertools.count()
@@ -60,6 +63,23 @@ _MOVE_SEQ = itertools.count()
 # Telemetry of the most recent migrate_blocks call IN THIS PROCESS — the
 # O(moved bytes) contract is asserted from these by the pod tests.
 last_move_stats: Dict[str, Any] = {}
+
+# transport-leg retries taken by the current exchange (folded into
+# last_move_stats["transport_retries"] when the migration completes)
+_LEG_RETRIES: List[int] = [0]
+
+
+class MigrationTransportError(InfraTransientError):
+    """A block-migration transport leg gave up after bounded retries.
+    Carries ``infra_suspect`` (via InfraTransientError): the pod leader
+    counts a job failure caused by this as auto-resume evidence — the
+    transport died, not the job's own logic (jobserver/pod.py)."""
+
+
+def _retry_policy():
+    from harmony_tpu.config.params import RetryPolicy
+
+    return RetryPolicy.from_env()
 
 
 def _move_timeout() -> float:
@@ -205,14 +225,53 @@ def _my_host() -> str:
         return "127.0.0.1"
 
 
-def _send_frame(sock: socket.socket, block: int, arr: np.ndarray) -> None:
+def _frame_parts(block: int, arr: np.ndarray) -> "Tuple[bytes, Any]":
+    """One wire/disk frame as (length-prefixed JSON header, payload
+    buffer). dtype encoding: ``dtype.str`` for ordinary dtypes (it
+    carries byte order — a big-endian ``'>f4'`` block must not be
+    reinterpreted little-endian on receipt), but BY NAME for extension
+    dtypes, whose str is an opaque ``'<V2'`` that does not round-trip
+    while ``np.dtype(name)`` resolves via the ml_dtypes registry — so
+    bf16/fp8 tables migrate on both transports. The payload stays a
+    ZERO-COPY memoryview for buffer-protocol dtypes (blocks can be
+    hundreds of MB; an extra copy per frame doubles peak host memory
+    during a reshard); only extension dtypes, which don't export the
+    buffer protocol, pay a tobytes() copy."""
     payload = np.ascontiguousarray(arr)
+    dt = payload.dtype
     header = json.dumps({
-        "b": int(block), "dtype": payload.dtype.str,
+        "b": int(block), "dtype": dt.name if dt.kind == "V" else dt.str,
         "shape": list(payload.shape), "n": int(payload.nbytes),
     }).encode()
-    sock.sendall(struct.pack("<I", len(header)) + header)
-    sock.sendall(memoryview(payload).cast("B"))
+    try:
+        body: Any = memoryview(payload).cast("B")
+    except (TypeError, ValueError):
+        body = payload.tobytes()  # extension dtypes (bfloat16/fp8)
+    return struct.pack("<I", len(header)) + header, body
+
+
+def _unpack_frame(buf: bytes) -> Tuple[int, np.ndarray]:
+    """Decode one whole frame (the concatenation of both
+    :func:`_frame_parts` halves) — the file channel's read side."""
+    if len(buf) < 4:
+        raise OSError("truncated block frame (no header length)")
+    hlen = struct.unpack("<I", buf[:4])[0]
+    if len(buf) < 4 + hlen:
+        raise OSError("truncated block frame (short header)")
+    hdr = json.loads(buf[4:4 + hlen])
+    data = buf[4 + hlen:]
+    if len(data) != hdr["n"]:
+        raise OSError(
+            f"truncated block frame for block {hdr['b']}: "
+            f"{len(data)} of {hdr['n']} payload bytes")
+    arr = np.frombuffer(data, dtype=np.dtype(hdr["dtype"]))
+    return int(hdr["b"]), arr.reshape(hdr["shape"])
+
+
+def _send_frame(sock: socket.socket, block: int, arr: np.ndarray) -> None:
+    head, body = _frame_parts(block, arr)
+    sock.sendall(head)
+    sock.sendall(body)
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -231,11 +290,22 @@ class _TcpReceiver:
     any process begins sending, so a resolvable address implies a live
     listener."""
 
+    #: extra time wait() allows after a connection error for the sender's
+    #: backoff-retried resend to land before giving up (sender backoff
+    #: tops out at HARMONY_RETRY_MAX_DELAY=2s by default, so 10s covers
+    #: several re-attempts without stalling a dead stream for the whole
+    #: HARMONY_POD_MOVE_TIMEOUT)
+    ERR_GRACE = 10.0
+
     def __init__(self, expected: Set[int]) -> None:
         self.expected = set(expected)
         self.blocks: Dict[int, np.ndarray] = {}
         self._done = threading.Event()
         self._err: Optional[BaseException] = None
+        self._err_time = 0.0
+        self._frames = 0       # TOTAL frames received, resends included —
+        self._err_frames = -1  # len(blocks) would miss resend progress
+        #                        (re-delivered ids overwrite in place)
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -281,23 +351,51 @@ class _TcpReceiver:
                     arr = arr.reshape(hdr["shape"])
                     with self._lock:
                         self.blocks[int(hdr["b"])] = arr
+                        self._frames += 1
                         if self.expected <= set(self.blocks):
                             self._done.set()
         except BaseException as e:  # noqa: BLE001 - surfaced in wait()
-            self._err = e
-            self._done.set()
+            # A broken CONNECTION is not a broken MIGRATION: the sender
+            # retries with backoff on a fresh connection (complete frames
+            # already landed stay valid — delivery is per block id, and a
+            # resent block just overwrites identical bytes). Record the
+            # error and keep accepting; wait() gives the resend ERR_GRACE
+            # to show up before surfacing it.
+            with self._lock:
+                self._err = e
+                self._err_time = time.monotonic()
+                self._err_frames = self._frames
 
     def wait(self, deadline: float) -> Dict[int, np.ndarray]:
-        if not self._done.wait(timeout=max(0.0, deadline - time.monotonic())):
-            missing = sorted(self.expected - set(self.blocks))
-            raise TimeoutError(
-                f"block migration: {len(missing)} inbound blocks missing "
-                f"after {_move_timeout()}s (first: {missing[:8]}) — a "
-                "source process died or the DCN channel is unreachable"
-            )
-        if self._err is not None:
-            raise self._err
-        return self.blocks
+        """Block until the expected set is complete. A recorded stream
+        error fails the wait after ERR_GRACE with no forward progress —
+        errors the SENDER cannot observe (a garbled final frame on a
+        cleanly-closed connection) must not stall the whole reshard for
+        the full move timeout."""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if self._done.wait(timeout=min(0.5, remaining)):
+                return self.blocks
+            with self._lock:
+                err, err_t = self._err, self._err_time
+                if err is not None and self._frames != self._err_frames:
+                    # a resend is landing frames: refresh the grace so an
+                    # actively recovering leg is never killed mid-resend
+                    self._err_time = err_t = time.monotonic()
+                    self._err_frames = self._frames
+            if err is not None and time.monotonic() - err_t > self.ERR_GRACE:
+                raise err  # no resend progress: the root cause stands
+        missing = sorted(self.expected - set(self.blocks))
+        detail = (f"; last connection error: {self._err!r}"
+                  if self._err is not None else "")
+        raise TimeoutError(
+            f"block migration: {len(missing)} inbound blocks missing "
+            f"after {_move_timeout()}s (first: {missing[:8]}) — a "
+            "source process died or the DCN channel is unreachable"
+            f"{detail}"
+        )
 
     def close(self) -> None:
         self._done.set()
@@ -335,20 +433,56 @@ def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
         for b, dst in my_sends:
             by_dst.setdefault(dst, []).append(b)
         wire_sent = 0
+        retries = [0]
+        policy = _retry_policy()
         for dst in sorted(by_dst):
-            addr = client.blocking_key_value_get(
-                f"harmony/blockmove/{seq}/{dst}",
-                max(1, int((deadline - time.monotonic()) * 1000)),
-            )
-            host, port = addr.rsplit(":", 1)
-            with socket.create_connection(
-                    (host, int(port)),
-                    timeout=max(0.1, deadline - time.monotonic())) as sock:
-                for b in by_dst[dst]:
-                    _send_frame(sock, b, outgoing[b])
-                    wire_sent += outgoing[b].nbytes
+
+            def attempt(dst=dst):
+                # the WHOLE leg retries on a fresh connection (address
+                # re-resolved: the peer may have rebound); the receiver
+                # keys by block id, so frames that landed before a broken
+                # pipe are simply overwritten by the resend
+                if faults.armed():
+                    faults.site("blockmove.connect", dst=dst, seq=seq)
+                addr = client.blocking_key_value_get(
+                    f"harmony/blockmove/{seq}/{dst}",
+                    max(1, int((deadline - time.monotonic()) * 1000)),
+                )
+                host, port = addr.rsplit(":", 1)
+                with socket.create_connection(
+                        (host, int(port)),
+                        timeout=max(0.1, deadline - time.monotonic())) as sock:
+                    for b in by_dst[dst]:
+                        if faults.armed():
+                            faults.site("blockmove.send", block=b,
+                                        dst=dst, seq=seq)
+                        _send_frame(sock, b, outgoing[b])
+
+            def on_retry(attempt_no, err, dst=dst):
+                retries[0] += 1
+
+            try:
+                call_with_retry(
+                    attempt, policy, op="blockmove.send",
+                    on_retry=on_retry, deadline=deadline,
+                )
+            except RetryError as e:
+                raise MigrationTransportError(
+                    f"block migration to process {dst} (blocks "
+                    f"{by_dst[dst][:8]}...) failed: {e}") from e
+            wire_sent += sum(outgoing[b].nbytes for b in by_dst[dst])
+        _LEG_RETRIES[0] += retries[0]
         if receiver is not None:
-            return receiver.wait(deadline), wire_sent
+            try:
+                return receiver.wait(deadline), wire_sent
+            except (OSError, ValueError, TypeError, KeyError) as e:
+                # the INBOUND leg failed — timeout (OSError subclass),
+                # truncated stream, or garbled header (json/np decode
+                # errors surface as ValueError/TypeError/KeyError):
+                # infra-shaped like a send give-up, so it must carry the
+                # same auto-resume marker
+                raise MigrationTransportError(
+                    f"block migration inbound leg failed: {e}") from e
         return {}, wire_sent
     finally:
         if receiver is not None:
@@ -394,12 +528,13 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
     err: Optional[BaseException] = None
     my_sends = {b for b, _ in plan.sends.get(pid, [])}
     written = 0
+    policy = _retry_policy()
     if my_sends:
         try:
             os.makedirs(stage, exist_ok=True)
             for b in sorted(my_sends):
-                tmp = os.path.join(stage, f"b{b}.npy.writing-{pid}")
-                dst = os.path.join(stage, f"b{b}.npy")
+                tmp = os.path.join(stage, f"b{b}.blk.writing-{pid}")
+                dst = os.path.join(stage, f"b{b}.blk")
                 # pre-clear THIS writer's stale files from a crashed prior
                 # session under the same deterministic name — a receiver
                 # must never adopt a stale payload (safe pre-fence: only
@@ -409,9 +544,32 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                         os.unlink(stale)
                     except FileNotFoundError:
                         pass
-                with open(tmp, "wb") as f:  # np.save appends .npy to names
-                    np.save(f, outgoing[b])
-                os.rename(tmp, dst)
+
+                def write_block(b=b, tmp=tmp, dst=dst):
+                    # the frame codec (not np.save): extension dtypes
+                    # (bfloat16/fp8) round-trip by NAME, where np.save
+                    # raises on them outright; header and payload are
+                    # written separately so no concatenated copy exists
+                    if faults.armed():
+                        faults.site("blockmove.stage_write", block=b,
+                                    seq=seq)
+                    head, body = _frame_parts(b, outgoing[b])
+                    with open(tmp, "wb") as f:
+                        f.write(head)
+                        f.write(body)
+                    os.rename(tmp, dst)
+
+                def on_retry(attempt_no, err_):
+                    _LEG_RETRIES[0] += 1
+
+                try:
+                    call_with_retry(write_block, policy,
+                                    op="blockmove.stage_write",
+                                    on_retry=on_retry)
+                except RetryError as e:
+                    raise MigrationTransportError(
+                        f"staging block {b} under {stage} failed: {e}"
+                    ) from e
                 written += outgoing[b].nbytes
         except BaseException as e:  # noqa: BLE001 - reported via the fence
             err = e
@@ -430,7 +588,30 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
     received: Dict[int, np.ndarray] = {}
     try:
         for b in sorted(plan.recvs.get(pid, set())):
-            received[b] = np.load(os.path.join(stage, f"b{b}.npy"))
+
+            def read_block(b=b):
+                if faults.armed():
+                    faults.site("blockmove.stage_read", block=b,
+                                seq=seq)
+                with open(os.path.join(stage, f"b{b}.blk"), "rb") as f:
+                    bid, arr = _unpack_frame(f.read())
+                if bid != b:
+                    raise OSError(
+                        f"staged frame b{b}.blk names block {bid}")
+                return arr
+
+            def on_retry(attempt_no, err_):
+                _LEG_RETRIES[0] += 1
+
+            try:
+                received[b] = call_with_retry(
+                    read_block, policy, op="blockmove.stage_read",
+                    on_retry=on_retry,
+                )
+            except RetryError as e:
+                raise MigrationTransportError(
+                    f"reading staged block {b} under {stage} failed: {e}"
+                ) from e
     except BaseException as e:  # noqa: BLE001 - reported via the fence
         err = e
     if member:
@@ -489,6 +670,7 @@ def migrate_blocks(arr: jax.Array, old_mesh: Mesh,
     shape, dtype = arr.shape, arr.dtype
     pid = jax.process_index()
     seq = next(_MOVE_SEQ)
+    _LEG_RETRIES[0] = 0
     plan = plan_moves(arr.sharding, new_sharding, shape, dtype.itemsize)
     my_sends = plan.sends.get(pid, [])
     my_recv = plan.recvs.get(pid, set())
@@ -566,10 +748,19 @@ def migrate_blocks(arr: jax.Array, old_mesh: Mesh,
             shard = shard.astype(dtype)
         shards.append(shard)
         devices.append(d)
-    new_arr = jax.make_array_from_single_device_arrays(
-        shape, new_sharding, shards,
-        dtype=dtype,  # required when this process holds no shards at all
-    )
+    try:
+        new_arr = jax.make_array_from_single_device_arrays(
+            shape, new_sharding, shards,
+            dtype=dtype,  # required when this process holds no shards
+        )
+    except TypeError:
+        # older jax: no dtype kwarg. Only reachable with shards to infer
+        # from — a zero-shard participant needs the newer jax anyway.
+        if not shards:
+            raise
+        new_arr = jax.make_array_from_single_device_arrays(
+            shape, new_sharding, shards
+        )
     last_move_stats.clear()
     last_move_stats.update({
         "seq": seq,
@@ -582,6 +773,9 @@ def migrate_blocks(arr: jax.Array, old_mesh: Mesh,
         "bytes_received": sum(a.nbytes for a in received.values()),
         "total_moves": plan.total_moves,
         "block_nbytes": plan.block_nbytes,
+        # transport legs re-attempted under the retry policy (0 on a
+        # healthy fabric; the fault tests assert >0 with recovery)
+        "transport_retries": _LEG_RETRIES[0],
         "seconds": time.monotonic() - t0,
     })
     return new_arr

@@ -150,6 +150,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: compile-heavy test, skipped unless --runslow"
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection test (harmony_tpu.faults); "
+        "the fast smoke set runs in tier-1, process-killing pod tests are "
+        "also marked slow",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

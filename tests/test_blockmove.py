@@ -164,7 +164,7 @@ def test_file_exchange_overwrites_stale_staging(tmp_path, monkeypatch):
     stale_dir = tmp_path / (
         f"harmony-move-{seq}-" + "-".join(str(d.id) for d in devs))
     stale_dir.mkdir()
-    (stale_dir / "b3.npy").write_bytes(b"torn garbage from a dead run")
+    (stale_dir / "b3.blk").write_bytes(b"torn garbage from a dead run")
     fresh = np.full((4, 2), 42.0, dtype=np.float32)
     plan = MovePlan(sends={0: [(3, 0)]}, recvs={0: {3}},
                     block_nbytes=fresh.nbytes)
@@ -225,20 +225,27 @@ def test_tcp_receiver_collects_expected_blocks_and_times_out():
         rx2.close()
 
 
-def test_tcp_receiver_preserves_dtype_and_shape():
+@pytest.mark.parametrize("dtype_name", ["int16", "bfloat16"])
+def test_tcp_receiver_preserves_dtype_and_shape(dtype_name):
+    """Frames carry dtype BY NAME: ml_dtypes types (bfloat16) have
+    ``dtype.str == '<V2'`` which does not round-trip, while the name
+    resolves via the ml_dtypes registry — a bf16-configured table must
+    migrate on the wire like any other (advisor round 5, low)."""
     import socket
     import time as _time
 
     from harmony_tpu.table.blockmove import _TcpReceiver, _send_frame
 
+    dtype = np.dtype(dtype_name)
     rx = _TcpReceiver({0})
     try:
-        payload = np.arange(12, dtype=np.int16).reshape(3, 2, 2)
+        payload = (np.arange(12).reshape(3, 2, 2) * 0.5).astype(dtype)
         with socket.create_connection(("127.0.0.1", rx.port)) as s:
             _send_frame(s, 0, payload)
         got = rx.wait(_time.monotonic() + 10)[0]
-        assert got.dtype == np.int16 and got.shape == (3, 2, 2)
-        np.testing.assert_array_equal(got, payload)
+        assert got.dtype == dtype and got.shape == (3, 2, 2)
+        np.testing.assert_array_equal(got.astype(np.float64),
+                                      payload.astype(np.float64))
     finally:
         rx.close()
 

@@ -1,0 +1,177 @@
+"""Pod-level fault recovery, driven by the deterministic injection
+harness (harmony_tpu.faults) instead of racy external kills.
+
+The env-serialized FaultPlan crosses into the REAL pod worker processes
+(PodHarness env_extra -> HARMONY_FAULT_PLAN -> lazy arm at the first
+guarded site), so a follower can be killed at an exact worker-step index
+mid-epoch — the coverage the round-5 issue asks for: auto-resume from the
+last committed chain checkpoint with loss parity against an uninterrupted
+run, and infra-dead confinement (unaffected jobs keep running).
+
+Slow tier: these spawn multi-process pods (~1 min)."""
+import json
+
+import pytest
+
+from harmony_tpu import faults
+
+pytestmark = [pytest.mark.slow, pytest.mark.faults]
+
+
+def _victim_cfg(epochs: int):
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id="fr-victim", app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs, num_mini_batches=2,
+            model_chkp_period=1,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.1},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 64, "num_features": 16,
+                            "num_classes": 4, "seed": 31},
+              "auto_resume": True},
+    )
+
+
+def test_injected_follower_crash_auto_resumes_with_loss_parity(tmp_path):
+    """Acceptance (d): a fault rule crashes the follower process at its
+    21st worker-step (mid-epoch ~10 of 24, deterministically — no kill
+    races, no commit polling); the pod confines the damage, a survivor
+    job on the leader completes untouched, and the victim auto-resumes
+    from its last committed chain checkpoint with a final loss exactly
+    equal to an uninterrupted single-process run."""
+    from tests.test_multihost import PodHarness, _mlr_job
+
+    root = str(tmp_path)
+    EPOCHS = 24
+    plan = faults.FaultPlan([faults.FaultRule(
+        "worker.step", match={"proc": 1}, after=20, count=1,
+        action="crash", exit_code=86,
+    )])
+    pod = PodHarness(2, 2, scheduler="pod_carve:1",
+                     env_extra={"HARMONY_POD_CHKP_ROOT": root,
+                                "HARMONY_POD_HB_TIMEOUT": "5",
+                                "HARMONY_POD_HB_PERIOD": "0.5",
+                                faults.ENV_VAR: plan.to_json()})
+    try:
+        pod.wait_ready()
+        # filler takes the leader's carve first so the victim lands
+        # wholly on the follower (the process the plan targets)
+        filler = _mlr_job("fr-filler", seed=1, epochs=1)
+        filler.params.num_mini_batches = 2
+        # survivor: a laggy job on the leader spanning the crash window —
+        # the confinement evidence (partial poison must not touch it)
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+
+        survivor = JobConfig(
+            job_id="fr-survivor", app_type="dolphin",
+            trainer="tests.helpers:LaggyMLRTrainer",
+            params=TrainerParams(
+                num_epochs=12, num_mini_batches=2,
+                app_params={"lag_sec": 0.3, "lag_worker": "/w0",
+                            "num_classes": 4, "num_features": 16,
+                            "features_per_partition": 4, "step_size": 0.1},
+            ),
+            num_workers=1,
+            user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+                  "data_args": {"n": 64, "num_features": 16,
+                                "num_classes": 4, "seed": 7}},
+        )
+        for cfg in (filler, _victim_cfg(EPOCHS), survivor):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        # the injected crash needs no polling: step 21 on proc 1 IS the
+        # kill point; just drain everything (victim fails -> auto-resume
+        # on the leader -> completes; survivor completes)
+        pod.drain(timeout=300)
+        pod.sender.send_shutdown_command()
+        out, err = pod.procs[0].communicate(timeout=120)
+        lead = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lead, (out, err[-2000:])
+        result = json.loads(lead[0][len("RESULT "):])
+        # the follower died OF THE INJECTION (its exit code), not a kill
+        assert pod.procs[1].wait(timeout=60) == 86
+    finally:
+        pod.kill()
+    # confinement: the co-tenant on the leader finished cleanly
+    sres = result["local_results"]["fr-survivor"]
+    assert "error" not in sres, sres
+    (slosses,) = [w["losses"] for w in sres.values()
+                  if isinstance(w, dict) and "losses" in w]
+    assert len(slosses) == 12
+    # auto-resume: only the remaining epochs ran on the survivors
+    vres = result["local_results"]["fr-victim"]
+    assert "error" not in vres, vres
+    (losses,) = [w["losses"] for w in vres.values()
+                 if isinstance(w, dict) and "losses" in w]
+    assert 0 < len(losses) < EPOCHS, losses
+    # loss parity: the resumed continuation is numerically identical to
+    # an uninterrupted single-process run of the same config
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=2)
+    server.start()
+    try:
+        base = _victim_cfg(EPOCHS)
+        base.user.pop("auto_resume")
+        iso = server.submit(base).result(timeout=240)
+        (iso_losses,) = [w["losses"] for w in iso["workers"].values()]
+        assert round(float(iso_losses[-1]), 5) == round(losses[-1], 5), (
+            iso_losses[-1], losses[-1])
+    finally:
+        server.shutdown(timeout=60)
+
+
+def test_injected_heartbeat_silence_confines_and_auto_resumes(tmp_path):
+    """Infra-dead via SILENCE, not death: a fault rule mutes the
+    follower's heartbeat beacon permanently after 4 beats. The leader
+    must declare the follower infra-dead on heartbeat timeout, confine
+    the damage to its processes, fail the victim infra-shaped, and
+    auto-resume it on the leader — while the follower process is in
+    fact still alive (the partial-failure mode a kill cannot test)."""
+    from tests.test_multihost import PodHarness, _mlr_job
+
+    root = str(tmp_path)
+    EPOCHS = 40
+    plan = faults.FaultPlan([faults.FaultRule(
+        "pod.heartbeat", match={"pid": 1}, after=4, count=-1,
+        action="skip",
+    )])
+    pod = PodHarness(2, 2, scheduler="pod_carve:1",
+                     env_extra={"HARMONY_POD_CHKP_ROOT": root,
+                                "HARMONY_POD_HB_TIMEOUT": "4",
+                                "HARMONY_POD_HB_PERIOD": "0.5",
+                                faults.ENV_VAR: plan.to_json()})
+    try:
+        pod.wait_ready()
+        filler = _mlr_job("hb-filler", seed=1, epochs=1)
+        filler.params.num_mini_batches = 2
+        victim = _victim_cfg(EPOCHS)
+        victim.job_id = "fr-victim"
+        # slow the victim down so silence (at ~2s + 4s timeout) lands
+        # mid-job with committed chain entries behind it
+        victim.trainer = "tests.helpers:LaggyMLRTrainer"
+        victim.params.app_params = dict(victim.params.app_params,
+                                        lag_sec=0.3, lag_worker="/w0")
+        for cfg in (filler, victim):
+            resp = pod.sender.send_job_submit_command(cfg)
+            assert resp.get("ok"), resp
+        pod.drain(timeout=300)
+        pod.sender.send_shutdown_command()
+        out, err = pod.procs[0].communicate(timeout=180)
+        lead = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lead, (out, err[-2000:])
+        result = json.loads(lead[0][len("RESULT "):])
+    finally:
+        pod.kill()
+    vres = result["local_results"]["fr-victim"]
+    assert "error" not in vres, vres
+    (losses,) = [w["losses"] for w in vres.values()
+                 if isinstance(w, dict) and "losses" in w]
+    # resumed on the leader: strictly fewer than all epochs ran there
+    assert 0 < len(losses) < EPOCHS, losses

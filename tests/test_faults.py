@@ -1,0 +1,571 @@
+"""Deterministic fault-injection harness + hardened recovery paths.
+
+Tier-1 ``faults`` smoke: plan/trigger semantics, the disarmed no-op path,
+retry/backoff, and single-process end-to-end recovery for each hardened
+layer — transport retry completes a block migration with contents intact,
+checkpoint corruption is detected and the chain resume falls back to the
+previous committed entry, and a wedged isolated orbax worker is killed,
+respawned, and its in-flight op retried within the deadline. The
+process-killing pod recovery tests live in test_fault_recovery_pod.py
+(slow tier)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import faults
+from harmony_tpu.config.params import RetryPolicy
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed with zeroed counters (arm with
+    propagate=True exports env state a later test must not inherit)."""
+    faults.disarm()
+    faults.reset_counters()
+    from harmony_tpu.faults import retry as _retry
+
+    _retry.reset_counters()
+    yield
+    faults.disarm()
+    faults.reset_counters()
+    _retry.reset_counters()
+
+
+@pytest.fixture()
+def fast_retries(monkeypatch):
+    monkeypatch.setenv("HARMONY_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("HARMONY_RETRY_MAX_DELAY", "0.002")
+
+
+# -- plan / trigger semantics --------------------------------------------
+
+
+class TestFaultPlan:
+    def test_disarmed_site_is_a_noop(self):
+        assert not faults.armed()
+        assert faults.site("blockmove.send", block=1) is None
+        assert faults.counters() == {}
+
+    def test_disarmed_overhead_is_one_global_read(self):
+        # the armed() guard: 100k disarmed checks must be effectively free
+        # (bench-criterion smoke; generous bound for loaded CI hosts)
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            faults.armed()
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_match_after_count(self):
+        plan = faults.FaultPlan([faults.FaultRule(
+            "blockmove.send", match={"block": 3}, after=1, count=2,
+            exc="OSError", message="boom",
+        )])
+        faults.arm(plan)
+        assert faults.site("blockmove.send", block=9) is None  # no match
+        assert faults.site("blockmove.send", block=3) is None  # after=1
+        with pytest.raises(OSError, match="boom"):
+            faults.site("blockmove.send", block=3)
+        with pytest.raises(OSError):
+            faults.site("blockmove.send", block=3)
+        assert faults.site("blockmove.send", block=3) is None  # count spent
+        assert faults.counters()["blockmove.send:raise"] == 2
+
+    def test_site_glob_and_skip_action(self):
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "pod.*", action="skip", count=-1)]))
+        assert faults.site("pod.heartbeat", pid=1) == "skip"
+        assert faults.site("pod.heartbeat", pid=2) == "skip"  # count=-1
+        assert faults.site("worker.step") is None
+
+    def test_env_round_trip_and_arm_from_env(self, monkeypatch):
+        plan = faults.FaultPlan(
+            [faults.FaultRule("chkp.*", match={"block": 1}, action="corrupt",
+                              count=3)],
+            state_path="/tmp/nonexistent-state.json",
+        )
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_json())
+        got = faults.arm_from_env()
+        assert got is not None and len(got.rules) == 1
+        r = got.rules[0]
+        assert (r.site, r.match, r.action, r.count) == (
+            "chkp.*", {"block": 1}, "corrupt", 3)
+        assert got.state_path == plan.state_path
+        assert faults.armed()
+
+    def test_propagate_exports_env_and_disarm_clears(self):
+        faults.arm(faults.FaultPlan([faults.FaultRule("x")]),
+                   propagate=True)
+        assert faults.ENV_VAR in os.environ
+        faults.disarm()
+        assert faults.ENV_VAR not in os.environ
+        assert not faults.armed()
+
+    def test_unknown_action_and_exception_rejected(self):
+        with pytest.raises(ValueError, match="action"):
+            faults.FaultRule("x", action="meteor")
+        with pytest.raises(ValueError, match="exception"):
+            faults.FaultRule("x", exc="SystemExit")
+
+    def test_state_file_shares_counters_across_plan_instances(self, tmp_path):
+        """The cross-process contract: two plans (as two processes would
+        have) sharing one state file honor after/count JOINTLY — a rule
+        that fired in a killed worker must not re-fire in its respawn."""
+        state = str(tmp_path / "state.json")
+        rule = {"site": "chkp.iso.serve", "count": 1, "action": "skip"}
+        p1 = faults.FaultPlan([faults.FaultRule(**rule)], state_path=state)
+        p2 = faults.FaultPlan.from_json(p1.to_json())  # a "second process"
+        assert p1.fire("chkp.iso.serve", {}) == "skip"
+        assert p2.fire("chkp.iso.serve", {}) is None  # already fired in p1
+        assert p1.fire("chkp.iso.serve", {}) is None
+
+    def test_delay_action_sleeps_then_continues(self):
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "slow.link", action="delay", delay_sec=0.05, count=1)]))
+        t0 = time.perf_counter()
+        assert faults.site("slow.link") == "delay"
+        assert time.perf_counter() - t0 >= 0.05
+        assert faults.site("slow.link") is None
+
+
+# -- retry / backoff ------------------------------------------------------
+
+
+class TestRetry:
+    def test_backoff_schedule(self):
+        p = RetryPolicy(max_attempts=5, base_delay_sec=0.1, max_delay_sec=0.5,
+                        multiplier=2.0, jitter=0.0)
+        assert list(faults.backoff_delays(p)) == [0.1, 0.2, 0.4, 0.5]
+
+    def test_retries_then_succeeds(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=4, base_delay_sec=0.1, jitter=0.0)
+        out = faults.call_with_retry(fn, p, op="t", sleep=sleeps.append)
+        assert out == "ok" and calls["n"] == 3
+        assert sleeps == [0.1, 0.2]
+        from harmony_tpu.faults.retry import retry_counters
+
+        assert retry_counters()["t.retries"] == 2
+
+    def test_giveup_raises_retry_error_with_infra_marker(self):
+        p = RetryPolicy(max_attempts=2, base_delay_sec=0.0)
+
+        def fn():
+            raise ConnectionResetError("peer gone")
+
+        with pytest.raises(faults.RetryError) as ei:
+            faults.call_with_retry(fn, p, op="t2", sleep=lambda s: None)
+        assert ei.value.attempts == 2
+        assert ei.value.infra_suspect  # the pod auto-resume evidence marker
+        assert isinstance(ei.value.last_error, ConnectionResetError)
+        from harmony_tpu.faults.retry import retry_counters
+
+        assert retry_counters()["t2.giveups"] == 1
+
+    def test_fatal_bypasses_retry(self):
+        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
+
+        p = RetryPolicy(max_attempts=5, base_delay_sec=0.0)
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise CheckpointCorruptError("bit rot")  # an OSError subclass
+
+        with pytest.raises(CheckpointCorruptError):
+            faults.call_with_retry(
+                fn, p, op="t3", fatal=(CheckpointCorruptError,),
+                sleep=lambda s: None)
+        assert calls["n"] == 1  # corruption is never re-read
+
+    def test_policy_from_env(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("HARMONY_RETRY_JITTER", "0.0")
+        p = RetryPolicy.from_env()
+        assert p.max_attempts == 7 and p.jitter == 0.0
+        assert p.base_delay_sec == RetryPolicy().base_delay_sec
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(multiplier=0.5)
+
+    def test_metric_manager_surfaces_counters(self):
+        from harmony_tpu.metrics.manager import MetricManager
+
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "x.y", action="skip", count=1)]))
+        faults.site("x.y")
+        assert MetricManager().fault_counters().get("x.y:skip") == 1
+
+
+# -- block migration: transport retry completes the move ------------------
+
+
+class _FakeKV:
+    """Stands in for the jax.distributed coordination KV store so the
+    TCP exchange runs single-process (loopback: pid 0 sends to itself)."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, k, v):
+        self.kv[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while time.monotonic() < deadline:
+            if k in self.kv:
+                return self.kv[k]
+            time.sleep(0.005)
+        raise TimeoutError(k)
+
+    def key_value_delete(self, k):
+        self.kv.pop(k, None)
+
+
+class TestBlockmoveRecovery:
+    def test_tcp_send_fault_retries_and_completes(self, monkeypatch,
+                                                  fast_retries):
+        """Acceptance (a), TCP leg: an injected transport failure during
+        the block send is retried with backoff on a fresh connection and
+        the migration completes with the payload intact."""
+        from harmony_tpu.table import blockmove
+
+        monkeypatch.setattr(blockmove, "_kv_client", lambda: _FakeKV())
+        payload = np.arange(24, dtype=np.float32).reshape(4, 6)
+        plan = blockmove.MovePlan(sends={0: [(3, 0)]}, recvs={0: {3}},
+                                  block_nbytes=payload.nbytes)
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "blockmove.send", match={"block": 3}, count=1,
+            exc="ConnectionResetError", message="injected link flap")]))
+        received, sent = blockmove._tcp_exchange(plan, {3: payload}, 91001)
+        np.testing.assert_array_equal(received[3], payload)
+        assert sent == payload.nbytes  # unique bytes, not retransmits
+        assert blockmove._LEG_RETRIES[0] >= 1
+        from harmony_tpu.faults.retry import retry_counters
+
+        assert retry_counters()["blockmove.send.retries"] >= 1
+
+    def test_tcp_send_giveup_escalates_infra_suspect(self, monkeypatch,
+                                                     fast_retries):
+        from harmony_tpu.table import blockmove
+
+        monkeypatch.setattr(blockmove, "_kv_client", lambda: _FakeKV())
+        monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "2")
+        payload = np.ones((2, 2), np.float32)
+        plan = blockmove.MovePlan(sends={0: [(0, 0)]}, recvs={},
+                                  block_nbytes=payload.nbytes)
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "blockmove.connect", count=-1, exc="ConnectionError",
+            message="fabric down")]))
+        with pytest.raises(blockmove.MigrationTransportError) as ei:
+            blockmove._tcp_exchange(plan, {0: payload}, 91002)
+        # the marker the pod layer turns into auto-resume evidence
+        assert ei.value.infra_suspect
+
+    def test_receiver_survives_broken_connection_then_resend(self):
+        """A truncated frame (sender died mid-send) must not poison the
+        receiver: the retried connection's resend completes the set."""
+        import socket
+        import struct
+
+        from harmony_tpu.table.blockmove import _TcpReceiver, _send_frame
+
+        rx = _TcpReceiver({5})
+        try:
+            payload = np.full((3, 3), 7.25, np.float32)
+            # attempt 1: header promising bytes that never arrive
+            with socket.create_connection(("127.0.0.1", rx.port)) as s:
+                hdr = json.dumps({"b": 5, "dtype": "float32",
+                                  "shape": [3, 3], "n": 36}).encode()
+                s.sendall(struct.pack("<I", len(hdr)) + hdr + b"\x00" * 8)
+            # attempt 2 (the retry): a clean resend
+            with socket.create_connection(("127.0.0.1", rx.port)) as s:
+                _send_frame(s, 5, payload)
+            got = rx.wait(time.monotonic() + 10)
+            np.testing.assert_array_equal(got[5], payload)
+        finally:
+            rx.close()
+
+    def test_receiver_fails_fast_when_no_resend_arrives(self, monkeypatch):
+        """A garbled frame the SENDER cannot observe (clean close after a
+        truncated payload) must fail the wait after the bounded error
+        grace, not stall the whole move timeout."""
+        import socket
+        import struct
+
+        from harmony_tpu.table.blockmove import _TcpReceiver
+
+        monkeypatch.setattr(_TcpReceiver, "ERR_GRACE", 0.4)
+        rx = _TcpReceiver({1})
+        try:
+            with socket.create_connection(("127.0.0.1", rx.port)) as s:
+                hdr = json.dumps({"b": 1, "dtype": "float32",
+                                  "shape": [2, 2], "n": 16}).encode()
+                s.sendall(struct.pack("<I", len(hdr)) + hdr + b"\x00" * 3)
+            t0 = time.monotonic()
+            with pytest.raises(OSError, match="truncated"):
+                rx.wait(time.monotonic() + 30)  # far beyond the grace
+            assert time.monotonic() - t0 < 10  # grace-bounded, not 30s
+        finally:
+            rx.close()
+
+    def test_file_exchange_bf16_and_staging_fault_retry(self, tmp_path,
+                                                        monkeypatch,
+                                                        fast_retries):
+        """Acceptance (a), file leg, with a bfloat16 payload: the staged
+        frame codec round-trips extension dtypes (np.save raised on them)
+        and an injected first-write failure is retried."""
+        import jax
+        import ml_dtypes
+        from jax.sharding import Mesh
+
+        from harmony_tpu.table.blockmove import MovePlan, _file_exchange
+
+        monkeypatch.setenv("HARMONY_POD_STAGE_ROOT", str(tmp_path))
+        devs = jax.devices()[:2]
+        mesh = Mesh(np.array(devs), ("model",))
+        payload = (np.arange(8).reshape(2, 4) * 0.5).astype(ml_dtypes.bfloat16)
+        plan = MovePlan(sends={0: [(2, 0)]}, recvs={0: {2}},
+                        block_nbytes=payload.nbytes)
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "blockmove.stage_write", count=1, exc="OSError",
+            message="injected EIO")]))
+        received, written = _file_exchange(plan, {2: payload}, 91003,
+                                           mesh, mesh)
+        assert received[2].dtype == payload.dtype
+        np.testing.assert_array_equal(
+            received[2].astype(np.float32), payload.astype(np.float32))
+        assert written == payload.nbytes
+
+
+# -- checkpoint integrity: detection + chain fallback ---------------------
+
+
+@pytest.fixture()
+def master(devices):
+    from harmony_tpu.parallel import DevicePool
+    from harmony_tpu.runtime import ETMaster
+
+    return ETMaster(DevicePool(devices))
+
+
+def _chain_two_epochs(master, root, job_id="cj"):
+    """A 2-entry committed chain for job ``job_id``: epoch 0 holds ones,
+    epoch 1 holds twos. Returns (mgr, handle, [cid0, cid1])."""
+    from harmony_tpu.checkpoint import CheckpointManager
+    from harmony_tpu.config.params import TableConfig
+
+    mgr = CheckpointManager.for_job(root, job_id)
+    exs = master.add_executors(4)
+    cfg = TableConfig(table_id=f"{job_id}:m", capacity=32, value_shape=(2,),
+                      num_blocks=8)
+    h = master.create_table(cfg, [e.id for e in exs])
+    keys = list(range(32))
+    h.table.multi_update(keys, np.ones((32, 2), np.float32))
+    cid0 = mgr.checkpoint(h, commit=True, app_meta={"epoch": 0.0})
+    h.table.multi_update(keys, np.ones((32, 2), np.float32))  # add -> 2.0
+    cid1 = mgr.checkpoint(h, commit=True, app_meta={"epoch": 1.0})
+    return mgr, h, [cid0, cid1]
+
+
+def _entity_for(job_id, root):
+    from harmony_tpu.config.params import JobConfig
+    from harmony_tpu.jobserver.entity import DolphinJobEntity
+
+    return DolphinJobEntity(JobConfig(job_id=job_id, app_type="dolphin"),
+                            chkp_root=root)
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_carries_block_checksums(self, master, tmp_path):
+        mgr, h, (cid0, _) = _chain_two_epochs(master, str(tmp_path), "ck0")
+        info = mgr.info(cid0)
+        assert info.block_checksums and len(info.block_checksums) == 8
+        assert set(info.block_checksums) == {str(b) for b in range(8)}
+
+    def test_restore_detects_content_swap_under_valid_container(
+            self, master, tmp_path):
+        """A block rewritten as a VALID .blk with wrong content passes the
+        container CRC — only the manifest checksum catches it."""
+        from harmony_tpu import native
+        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
+
+        mgr, h, (cid0, _) = _chain_two_epochs(master, str(tmp_path), "ck1")
+        d = mgr._backend.fetch(cid0)
+        victim = os.path.join(d, "3.blk")
+        if os.path.exists(victim) and native.available():
+            native.blk_write(victim, np.full((4, 2), 9.0, np.float32))
+        else:  # .npy fallback environment
+            victim = os.path.join(d, "3.npy")
+            np.save(victim, np.full((4, 2), 9.0, np.float32))
+        h.drop()
+        with pytest.raises(CheckpointCorruptError, match="checksum"):
+            mgr.restore(master, cid0, master.executor_ids()[:2],
+                        table_id="ck1-r")
+
+    def test_chain_resume_falls_back_and_quarantines(self, master, tmp_path):
+        """Acceptance (b): injected corruption in the NEWEST chain entry
+        is detected on restore and the resume falls back to the previous
+        committed entry; the corrupt one is quarantined out of every
+        later scan."""
+        root = str(tmp_path)
+        mgr, h, (cid0, cid1) = _chain_two_epochs(master, root, "ck2")
+        h.drop()
+        # torn/corrupt bytes in a committed block of the newest entry
+        d = mgr._backend.fetch(cid1)
+        name = next(n for n in os.listdir(d) if n.startswith("3."))
+        with open(os.path.join(d, name), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xde\xad\xbe\xef" * 4)
+        handle, starting_epoch, base = _entity_for("ck2", root)._restore_chain(
+            master, master.executor_ids()[:2], 1)
+        # fell back to epoch 0's snapshot (ones), resuming at epoch 1
+        assert starting_epoch == 1
+        np.testing.assert_allclose(
+            np.asarray(handle.table.pull_array()), 1.0)
+        ids = mgr.list_checkpoints()
+        assert cid1 not in ids and cid0 in ids  # quarantined, not deleted
+        assert os.path.isdir(
+            os.path.join(root, "ck2", "commit", cid1 + ".quarantined"))
+
+    def test_chain_resume_skips_torn_manifest(self, master, tmp_path):
+        root = str(tmp_path)
+        mgr, h, (cid0, cid1) = _chain_two_epochs(master, root, "ck3")
+        h.drop()
+        d = mgr._backend.fetch(cid1)
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            f.write('{"chkp_id": "ck3:m-2-')  # torn mid-write
+        handle, starting_epoch, _ = _entity_for("ck3", root)._restore_chain(
+            master, master.executor_ids()[:2], 1)
+        assert starting_epoch == 1
+        assert cid1 not in mgr.list_checkpoints()
+
+    def test_all_entries_corrupt_raises_with_evidence(self, master, tmp_path):
+        root = str(tmp_path)
+        mgr, h, cids = _chain_two_epochs(master, root, "ck4")
+        h.drop()
+        for cid in cids:
+            d = mgr._backend.fetch(cid)
+            name = next(n for n in os.listdir(d) if n.startswith("0."))
+            with open(os.path.join(d, name), "r+b") as f:
+                f.seek(8)
+                f.write(b"\xff" * 8)
+        with pytest.raises(ValueError, match="every chain checkpoint"):
+            _entity_for("ck4", root)._restore_chain(
+                master, master.executor_ids()[:2], 1)
+        assert all(c not in mgr.list_checkpoints() for c in cids)
+
+    def test_block_write_fault_retried_under_policy(self, master, tmp_path,
+                                                    fast_retries):
+        """Transient IO during checkpoint block staging retries instead of
+        failing the chain (chkp block I/O leg of the retry policy)."""
+        mgr_root = str(tmp_path)
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "chkp.block_write", count=2, exc="OSError",
+            message="injected ENOSPC blip")]))
+        mgr, h, (cid0, cid1) = _chain_two_epochs(master, mgr_root, "ck5")
+        from harmony_tpu.faults.retry import retry_counters
+
+        assert retry_counters()["chkp.block_write.retries"] >= 2
+        h.drop()
+        r = mgr.restore(master, cid1, master.executor_ids()[:2],
+                        table_id="ck5-r")
+        np.testing.assert_allclose(np.asarray(r.table.pull_array()), 2.0)
+
+
+# -- isolated orbax worker supervision ------------------------------------
+
+
+def _staged_src(tmp_path, chkp_id):
+    src = tmp_path / f"staged-{chkp_id}"
+    src.mkdir()
+    (src / "manifest.json").write_text(json.dumps(
+        {"chkp_id": chkp_id, "committed": False}))
+    (src / "b0.blk").write_bytes(b"\x01\x02\x03\x04")
+    return src
+
+
+@pytest.fixture()
+def iso_backend(tmp_path, monkeypatch):
+    from harmony_tpu.checkpoint.backends import OrbaxCommitBackend
+
+    monkeypatch.setattr(OrbaxCommitBackend, "_in_multiprocess",
+                        staticmethod(lambda: True))
+    b = OrbaxCommitBackend(str(tmp_path / "root"),
+                           cache_root=str(tmp_path / "cache"))
+    yield b
+    b._kill_isolated()
+
+
+class TestIsolatedWorkerSupervision:
+    def test_wedged_worker_killed_respawned_op_retried(
+            self, tmp_path, monkeypatch, iso_backend):
+        """Acceptance (c): a wedged worker (injected hang in its serve
+        loop) is detected at the supervision deadline, killed, respawned,
+        and the in-flight commit retried — no hang, and the shared fault
+        state keeps the respawn from re-wedging."""
+        monkeypatch.setenv("HARMONY_CHKP_ISO_TIMEOUT", "2")
+        monkeypatch.setenv("HARMONY_CHKP_ISO_SPAWN_GRACE", "15")
+        faults.arm(faults.FaultPlan(
+            [faults.FaultRule("chkp.iso.serve", action="hang",
+                              delay_sec=60, count=1)],
+            state_path=str(tmp_path / "fault-state.json"),
+        ), propagate=True)
+        src = _staged_src(tmp_path, "wedge-1")
+        t0 = time.monotonic()
+        iso_backend.commit("wedge-1", str(src))
+        took = time.monotonic() - t0
+        assert iso_backend.iso_respawns == 1
+        assert iso_backend.exists("wedge-1")
+        assert took < 55  # bounded by deadline+respawn, not the 60s hang
+
+    def test_protocol_desync_kills_worker_and_retries(
+            self, tmp_path, monkeypatch, iso_backend):
+        """Advisor low (backends.py:227): a garbled protocol line must
+        never leave a stale queued response to misattribute — the worker
+        is killed on desync and the op retried on a fresh one."""
+        monkeypatch.setenv("HARMONY_CHKP_ISO_TIMEOUT", "60")
+        faults.arm(faults.FaultPlan(
+            [faults.FaultRule("chkp.iso.serve", action="corrupt", count=1)],
+            state_path=str(tmp_path / "fault-state.json"),
+        ), propagate=True)
+        src = _staged_src(tmp_path, "desync-1")
+        iso_backend.commit("desync-1", str(src))
+        assert iso_backend.iso_respawns == 1
+        assert iso_backend.exists("desync-1")
+        # the next op rides the respawned worker with correct attribution
+        d = iso_backend.fetch("desync-1")
+        assert d is not None
+        with open(os.path.join(d, "b0.blk"), "rb") as f:
+            assert f.read() == b"\x01\x02\x03\x04"
+
+    def test_stderr_flood_does_not_hang(self, tmp_path, monkeypatch,
+                                        iso_backend):
+        """Advisor medium (backends.py:213): with stderr on a pipe, 256KB
+        of child logging filled the 64KB buffer and hung the pod. stderr
+        now goes to a file — the flood lands on disk, the op completes,
+        and the tail is available for error messages."""
+        monkeypatch.setenv("HARMONY_CHKP_ISO_TIMEOUT", "120")
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "chkp.iso.serve", action="spew", delay_sec=256, count=1,
+        )]), propagate=True)
+        src = _staged_src(tmp_path, "flood-1")
+        iso_backend.commit("flood-1", str(src))
+        assert iso_backend.iso_respawns == 0  # no kill needed, just drained
+        assert iso_backend.exists("flood-1")
+        assert os.path.getsize(iso_backend._iso_stderr_path) > 64 * 1024
+        assert "injected stderr noise" in iso_backend._stderr_tail()
