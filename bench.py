@@ -172,37 +172,90 @@ def run_concurrent(devices, scale: float, job_timeout: float = 900.0,
     return rate, job_walls
 
 
-def probe_accelerator(attempts: int = 3, timeout_s: float = 60.0) -> str:
+class ProbeError(RuntimeError):
+    """Accelerator probe exhausted its attempts. Carries the structured
+    per-attempt diagnostics so the BENCH json records WHAT happened each
+    try instead of a bare 'unreachable' string (the probe wedged four
+    rounds running with no trail)."""
+
+    def __init__(self, attempts_log):
+        self.attempts_log = list(attempts_log)
+        last = attempts_log[-1]["error"] if attempts_log else "no attempts"
+        super().__init__(
+            f"{len(attempts_log)} probe attempt(s) failed; last: {last}")
+
+
+def _kill_probe(proc) -> None:
+    """Kill-on-timeout that cannot itself hang the bench: SIGKILL the
+    probe's whole process group (it may have spawned plugin helpers),
+    then give the reap a BOUNDED wait — a child stuck in uninterruptible
+    IO (the wedged-transport failure mode that motivated subprocess
+    probes) is abandoned to init rather than blocking this run."""
+    import os as _os
+    import signal as _signal
+
+    try:
+        _os.killpg(proc.pid, _signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    try:
+        proc.communicate(timeout=10)
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        pass  # unreaped zombie or D-state child: abandoned, not waited on
+
+
+def probe_accelerator(attempts: int = 3,
+                      timeout_s: float = 60.0) -> "tuple[str, list]":
     """Probe accelerator health in a SUBPROCESS, retrying with backoff.
 
     In-process retries can't help once a wedged transport has blocked a
     backend-init thread (later attempts pile onto the same init lock), so
-    each attempt is a fresh interpreter with its own deadline. Returns the
-    probed platform name on success; raises RuntimeError carrying the
-    per-attempt diagnostics on final failure."""
+    each attempt is a fresh interpreter IN ITS OWN PROCESS GROUP with a
+    kill-on-timeout bound (_kill_probe). Returns (platform, attempts_log)
+    on success; raises :class:`ProbeError` carrying the per-attempt
+    diagnostics on final failure."""
     code = "import jax; ds = jax.devices(); print('PROBE', ds[0].platform, len(ds))"
-    errors = []
+    log: list = []
     for i in range(attempts):
         if i:
             backoff = 5.0 * i
             print(f"  discovery retry {i + 1}/{attempts} in {backoff:.0f}s",
                   file=sys.stderr)
             time.sleep(backoff)
+        rec = {"attempt": i + 1, "timeout_s": timeout_s}
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,  # own process group: killable whole
+        )
         try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=timeout_s)
+            out, err = proc.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            errors.append(f"attempt {i + 1}: probe hung >{timeout_s:.0f}s")
+            _kill_probe(proc)
+            rec.update(outcome="timeout",
+                       seconds=round(time.monotonic() - t0, 1),
+                       error=f"probe hung >{timeout_s:.0f}s (killed)")
+            log.append(rec)
             continue
-        for line in r.stdout.splitlines():
+        rec["seconds"] = round(time.monotonic() - t0, 1)
+        for line in out.splitlines():
             if line.startswith("PROBE "):
                 _, platform, count = line.split()
-                print(f"  probe: {count} {platform} device(s)", file=sys.stderr)
-                return platform
-        errors.append(f"attempt {i + 1}: rc={r.returncode}, "
-                      f"stderr tail: {r.stderr[-300:]!r}")
-    raise RuntimeError("; ".join(errors))
+                print(f"  probe: {count} {platform} device(s)",
+                      file=sys.stderr)
+                rec.update(outcome="ok", platform=platform,
+                           devices=int(count))
+                log.append(rec)
+                return platform, log
+        rec.update(outcome="error", rc=proc.returncode,
+                   error=f"rc={proc.returncode}, "
+                         f"stderr tail: {err[-300:]!r}")
+        log.append(rec)
+    raise ProbeError(log)
 
 
 def cpu_baseline_rate() -> float:
@@ -230,7 +283,7 @@ def cpu_baseline_rate() -> float:
 
 
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
-         job_walls: dict | None = None) -> None:
+         job_walls: dict | None = None, probe_log: list | None = None) -> None:
     if error:
         # Accelerator unreachable/failed: the CPU measurement IS the run's
         # primary result. A "value": 0.0 / "vs_baseline": 0.0 line polluted
@@ -261,6 +314,17 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # the aggregate is bounded by the LAST job: the straggler app
         # named here is the next perf target
         line["accel_job_walls_s"] = job_walls
+    if probe_log:
+        # per-attempt probe diagnostics: what each bounded attempt saw
+        # (outcome/rc/stderr tail/seconds) — readers of an unreachable
+        # round get the trail, not a bare string
+        line["probe"] = {
+            "attempts": len(probe_log),
+            "last_error": next(
+                (r.get("error") for r in reversed(probe_log)
+                 if r.get("error")), None),
+            "per_attempt": probe_log,
+        }
     if error:
         line["error"] = error
         # Provenance for readers of an error line: the most recent committed
@@ -303,20 +367,22 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
 def main():
     enable_compile_cache()
     try:
-        probe_accelerator()
-    except RuntimeError as e:
+        _platform, probe_log = probe_accelerator()
+    except ProbeError as e:
         # Wedged transport: never touch the accelerator plugin in-process
         # (its init would hang this interpreter too) — pin to CPU and still
         # record the baseline pass so rounds stay comparable.
         jax.config.update("jax_platforms", "cpu")
         emit(0.0, cpu_baseline_rate(),
-             error=f"accelerator unreachable after retries: {e}")
+             error=f"accelerator unreachable after retries: {e}",
+             probe_log=e.attempts_log)
         return
     try:
         accel = _discover_devices()
     except RuntimeError as e:  # probed fine but wedged since — same fallback
         jax.config.update("jax_platforms", "cpu")
-        emit(0.0, cpu_baseline_rate(), error=f"accelerator unreachable: {e}")
+        emit(0.0, cpu_baseline_rate(), error=f"accelerator unreachable: {e}",
+             probe_log=probe_log)
         return
     print(f"accelerator devices: {accel}", file=sys.stderr)
     try:
@@ -326,7 +392,8 @@ def main():
         tpu_rate, tpu_walls = run_concurrent(accel, scale=1.0)
     except Exception as e:  # a half-dead transport must still yield a line
         emit(0.0, cpu_baseline_rate(),
-             error=f"accelerator run failed: {type(e).__name__}: {e}")
+             error=f"accelerator run failed: {type(e).__name__}: {e}",
+             probe_log=probe_log)
         return
     emit(tpu_rate, cpu_baseline_rate(), job_walls=tpu_walls)
 

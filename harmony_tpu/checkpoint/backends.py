@@ -36,6 +36,27 @@ from harmony_tpu.faults.retry import InfraTransientError
 #: response (the stale-response misattribution bug, advisor round 5)
 _PROTO_PREFIX = "@harmony-chkp@ "
 
+# Process-wide respawn counter ACROSS backend instances: each manager
+# (and each elastic recovery attempt) constructs its own backend, so the
+# per-instance ``iso_respawns`` alone would undercount on the metrics
+# surface (MetricManager.fault_counters folds this in).
+import threading as _threading  # noqa: E402 - counter lock only
+
+_ISO_RESPAWNS = 0
+_ISO_LOCK = _threading.Lock()
+
+
+def _count_iso_respawn() -> None:
+    global _ISO_RESPAWNS
+    with _ISO_LOCK:
+        _ISO_RESPAWNS += 1
+
+
+def iso_respawn_total() -> int:
+    """Supervision-forced isolated-worker respawns in THIS process, all
+    backend instances summed (the fault-counters surface)."""
+    return _ISO_RESPAWNS
+
 
 class IsolatedWorkerError(InfraTransientError):
     """The isolated orbax worker died, wedged past its deadline, or
@@ -446,7 +467,9 @@ class OrbaxCommitBackend(CommitBackend):
             except IsolatedWorkerError as e:
                 # supervision failure: the op never completed (commit and
                 # fetch are idempotent) — retry ONCE on a fresh worker
-                self.iso_respawns += bool(attempt == 0)
+                if attempt == 0:
+                    self.iso_respawns += 1
+                    _count_iso_respawn()
                 last = e
                 faults.site("chkp.iso.supervise", op=op, attempt=attempt)
                 continue

@@ -31,7 +31,7 @@ import os
 import shutil
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -40,6 +40,82 @@ from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import RetryPolicy, TableConfig
 from harmony_tpu.faults.retry import call_with_retry
 from harmony_tpu.runtime.master import ETMaster, TableHandle
+
+
+#: Process-wide checkpoint READ accounting (blocks/bytes materialized
+#: from checkpoint storage by _read_block). The elastic-shrink tests
+#: assert the O(lost-bytes) restore contract against these; reset with
+#: :func:`reset_read_stats`.
+read_stats: Dict[str, int] = {"blocks_read": 0, "bytes_read": 0}
+_READ_STATS_LOCK = threading.Lock()
+
+
+def reset_read_stats() -> None:
+    with _READ_STATS_LOCK:
+        read_stats["blocks_read"] = 0
+        read_stats["bytes_read"] = 0
+
+
+def _account_read(arr: np.ndarray) -> None:
+    with _READ_STATS_LOCK:
+        read_stats["blocks_read"] += 1
+        read_stats["bytes_read"] += int(arr.nbytes)
+
+
+# -- per-process recovery cache (elastic shrink) --------------------------
+#
+# One entry per table id: the host-side copies of the blocks THIS
+# process staged for its most recent chain checkpoint, kept only while a
+# job opted in (CheckpointManager.recovery_retain). On elastic recovery
+# the partial restore takes every locally-cached block from here and
+# reads ONLY the genuinely lost ones from checkpoint storage — the
+# O(lost-bytes) half of the recovery contract. Module-global (not
+# per-manager) on purpose: each recovery attempt constructs a fresh
+# CheckpointManager, and the cache must survive that.
+
+_RECOVERY_CACHE: Dict[str, Tuple[str, Dict[int, np.ndarray]]] = {}
+_RECOVERY_LOCK = threading.Lock()
+_RECOVERY_MAX_TABLES = 8
+
+
+def _recovery_put(table_id: str, chkp_id: str,
+                  blocks: Dict[int, np.ndarray]) -> None:
+    with _RECOVERY_LOCK:
+        _RECOVERY_CACHE.pop(table_id, None)
+        _RECOVERY_CACHE[table_id] = (chkp_id, blocks)
+        while len(_RECOVERY_CACHE) > _RECOVERY_MAX_TABLES:
+            _RECOVERY_CACHE.pop(next(iter(_RECOVERY_CACHE)))
+
+
+def recovery_blocks(chkp_id: str) -> Optional[Dict[int, np.ndarray]]:
+    """This process's cached block copies for EXACTLY ``chkp_id``, or
+    None. A stale entry (a different, older checkpoint of the same
+    table) is never returned — mixing epochs would silently break the
+    recovery's consistent-cut guarantee."""
+    with _RECOVERY_LOCK:
+        for cid, blocks in _RECOVERY_CACHE.values():
+            if cid == chkp_id:
+                return dict(blocks)
+    return None
+
+
+def drop_recovery_cache(table_id: Optional[str] = None,
+                        prefix: Optional[str] = None) -> None:
+    """Release retained block copies: one table, every table whose id
+    starts with ``prefix`` (private model tables are namespaced
+    ``<job_id>:...``, so the pod leader drops a finished elastic
+    submission's retention by job-id prefix), or everything. Follower
+    processes rely on the LRU cap instead — they cannot tell an attempt
+    ending from the submission ending."""
+    with _RECOVERY_LOCK:
+        if table_id is None and prefix is None:
+            _RECOVERY_CACHE.clear()
+            return
+        if table_id is not None:
+            _RECOVERY_CACHE.pop(table_id, None)
+        if prefix is not None:
+            for tid in [t for t in _RECOVERY_CACHE if t.startswith(prefix)]:
+                _RECOVERY_CACHE.pop(tid, None)
 
 
 class CheckpointCorruptError(native.BlockCorruptError):
@@ -129,6 +205,7 @@ def _read_block(d: str, bid: int,
                 f"block {bid} under {d} fails its manifest checksum "
                 f"(expected {expected_crc}, got {got})"
             )
+    _account_read(arr)
     return arr
 
 
@@ -275,6 +352,11 @@ class CheckpointManager:
         self._backend = make_commit_backend(commit_root, backend)
         self._lock = threading.Lock()
         self._counter = 0
+        #: elastic-shrink jobs set this: each full-ratio checkpoint also
+        #: retains this process's staged host block copies in the
+        #: process-wide recovery cache (see module doc), so a later
+        #: partial restore reads only genuinely LOST blocks from storage
+        self.recovery_retain = False
 
     def advance_counter(self, base: int) -> None:
         """Start id counters past ``base`` — a RESUMED job's chain manager
@@ -333,6 +415,9 @@ class CheckpointManager:
             # pop as we go: each device block is released right after its
             # D2H transfer instead of pinning the snapshot until the end.
             checksums: Dict[str, int] = {}
+            retained: Optional[Dict[int, np.ndarray]] = (
+                {} if self.recovery_retain and keep is None else None
+            )
             policy = RetryPolicy.from_env()
             for bid in sorted(snap):
                 item = snap.pop(bid)
@@ -344,7 +429,12 @@ class CheckpointManager:
                     arr = arr[:keep] if keep else arr
                 checksums[str(bid)] = _write_block(staging, bid, arr,
                                                    policy)
+                if retained is not None:
+                    retained[bid] = arr
             info.block_checksums = checksums
+            if retained is not None:
+                _recovery_put(info.table_config.table_id, info.chkp_id,
+                              retained)
             with open(os.path.join(staging, "manifest.json"), "w") as f:
                 f.write(info.to_json())
             os.rename(staging, tdir)
@@ -479,6 +569,9 @@ class CheckpointManager:
             mine = handle.table.addressable_blocks()
             my_crcs: Dict[str, int] = {}
             policy = RetryPolicy.from_env()
+            retained: Optional[Dict[int, np.ndarray]] = (
+                {} if self.recovery_retain else None
+            )
             for bid in sorted(mine):
                 item = mine[bid]
                 if sparse:
@@ -488,6 +581,10 @@ class CheckpointManager:
                 else:
                     arr = np.asarray(item)
                 my_crcs[str(bid)] = _write_block(staging, bid, arr, policy)
+                if retained is not None:
+                    retained[bid] = arr
+            if retained is not None:
+                _recovery_put(info.table_config.table_id, chkp_id, retained)
             # Per-process checksum sidecar: only THIS process knows the
             # digests of the blocks it staged; the leader merges every
             # sidecar into the manifest's block_checksums after the
@@ -719,6 +816,113 @@ class CheckpointManager:
             handle.drop()  # no half-restored orphan tables
             raise
         return handle
+
+    def restore_partial(
+        self,
+        master: ETMaster,
+        chkp_id: str,
+        associators: Sequence[str],
+        data_axis: int = 1,
+        table_id: Optional[str] = None,
+    ) -> "Tuple[TableHandle, Dict[str, int]]":
+        """Elastic-recovery restore: rebuild the table on ``associators``
+        reading from checkpoint storage ONLY the blocks this process does
+        not already hold in its recovery cache (see module doc) — the
+        O(lost-bytes) path a shrink recovery needs, vs :meth:`restore`'s
+        O(model-bytes) full read. Blocks read from storage are verified
+        against the manifest checksums exactly like a full restore;
+        cached blocks are the very host copies whose digests the
+        manifest records, staged by this process at checkpoint time.
+
+        Topology-free like restore(): on a single-process mesh each
+        needed block imports through normal table writes; on a
+        multi-process mesh each process assembles only ITS addressable
+        shards (``jax.make_array_from_single_device_arrays``) so no
+        process ever reads — or holds — a full replica.
+
+        Returns ``(handle, stats)`` with stats =
+        {blocks_total, blocks_needed, blocks_local, blocks_read,
+        bytes_read}. Sparse and sampled checkpoints fall back to the
+        full restore (stats marks ``partial: 0``)."""
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+        from harmony_tpu.table.blockmove import axis0_bounds
+
+        d = self._dir_of(chkp_id)
+        info = self._load_manifest(d)
+        cfg = info.table_config
+        if table_id is not None:
+            cfg = cfg.replace(table_id=table_id)
+        if cfg.sparse or info.sampling_ratio < 1.0:
+            handle = self.restore(master, chkp_id, associators, data_axis,
+                                  table_id)
+            nb = len(info.block_ids)
+            return handle, {"partial": 0, "blocks_total": nb,
+                            "blocks_needed": nb, "blocks_local": 0,
+                            "blocks_read": nb, "bytes_read": -1}
+        local = recovery_blocks(chkp_id) or {}
+        handle = master.create_table(cfg, associators, data_axis)
+        try:
+            arr_shape = handle.table.array.shape
+            sharding = handle.table.sharding
+            spans = mesh_spans_processes(handle.table.mesh)
+            needed: set = set()
+            for _dev, idx in sharding.addressable_devices_indices_map(
+                    arr_shape).items():
+                start, stop = axis0_bounds(idx, arr_shape[0])
+                needed.update(range(start, stop))
+            crcs = info.block_checksums or {}
+            policy = RetryPolicy.from_env()
+            stats = {"partial": 1, "blocks_total": len(info.block_ids),
+                     "blocks_needed": len(needed), "blocks_local": 0,
+                     "blocks_read": 0, "bytes_read": 0}
+            blocks: Dict[int, np.ndarray] = {}
+            for bid in sorted(needed):
+                cached = local.get(bid)
+                if cached is not None:
+                    blocks[bid] = cached
+                    stats["blocks_local"] += 1
+                    continue
+                if faults.armed():
+                    faults.site("chkp.partial_read", block=bid,
+                                chkp_id=chkp_id)
+                arr = _read_block(d, bid, expected_crc=crcs.get(str(bid)),
+                                  policy=policy)
+                if arr.shape[0] < handle.table.spec.block_size:
+                    raise CheckpointCorruptError(
+                        f"partial restore of {chkp_id}: block {bid} is "
+                        f"short ({arr.shape[0]} rows) in a full-ratio "
+                        "checkpoint"
+                    )
+                blocks[bid] = arr
+                stats["blocks_read"] += 1
+                stats["bytes_read"] += int(arr.nbytes)
+            if not spans:
+                handle.table.import_blocks(blocks)
+            else:
+                # per-process shard assembly: this process provides only
+                # its addressable shards; peers provide theirs — the one
+                # construction multi-controller jax allows without every
+                # process holding (or reading) the whole table
+                import jax as _jax
+
+                dtype = handle.table.array.dtype
+                shards, devs = [], []
+                for dev, idx in sharding.addressable_devices_indices_map(
+                        arr_shape).items():
+                    start, stop = axis0_bounds(idx, arr_shape[0])
+                    stacked = np.stack(
+                        [np.asarray(blocks[i]) for i in range(start, stop)]
+                    ).astype(dtype, copy=False)
+                    shards.append(_jax.device_put(stacked, dev))
+                    devs.append(dev)
+                new_arr = _jax.make_array_from_single_device_arrays(
+                    arr_shape, sharding, shards
+                )
+                handle.table.install_array(new_arr)
+        except BaseException:
+            handle.drop()  # no half-restored orphan tables
+            raise
+        return handle, stats
 
     def delete(self, chkp_id: str) -> None:
         """Remove every copy (a crashed commit can leave the checkpoint in

@@ -91,15 +91,34 @@ class DashboardServer:
                   GROUP BY job_id
                  ) c ON m.id = c.max_loss_id
         """
+        # Recovery observability (elastic shrink/re-grow, confinement,
+        # auto-resume): events POST as kind='recovery'; the summary
+        # carries their count and the newest event so a degraded tenant
+        # is visible at a glance, not only in leader logs.
+        q_rec = """
+            SELECT m.job_id, c.n, m.payload FROM metrics m
+            JOIN (SELECT MAX(id) max_rec_id, COUNT(*) n
+                  FROM metrics WHERE kind = 'recovery'
+                  GROUP BY job_id
+                 ) c ON m.id = c.max_rec_id
+        """
         with self._db_lock:
             loss_rows = self._db.execute(q).fetchall()
+            rec_rows = self._db.execute(q_rec).fetchall()
             all_rows = self._db.execute(
                 "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
             ).fetchall()
         loss_by_job = {r[0]: json.loads(r[1]).get("loss") for r in loss_rows}
+        rec_by_job = {
+            r[0]: {"recoveries": r[1],
+                   "last_recovery": json.loads(r[2]).get("kind")}
+            for r in rec_rows
+        }
         return [
             {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
-             "last_loss": loss_by_job.get(job_id)}
+             "last_loss": loss_by_job.get(job_id),
+             "recoveries": rec_by_job.get(job_id, {}).get("recoveries", 0),
+             "last_recovery": rec_by_job.get(job_id, {}).get("last_recovery")}
             for job_id, count, last_ts in all_rows
         ]
 
@@ -176,14 +195,18 @@ class DashboardServer:
                 elif parsed.path == "/":
                     rows = "".join(
                         f"<tr><td>{j['job_id']}</td><td>{j['num_reports']}</td>"
-                        f"<td>{j['last_loss']}</td></tr>"
+                        f"<td>{j['last_loss']}</td>"
+                        f"<td>{j['recoveries'] or ''}"
+                        f"{(' (' + j['last_recovery'] + ')') if j['last_recovery'] else ''}"
+                        "</td></tr>"
                         for j in server.jobs()
                     )
                     body = (
                         "<html><head><title>harmony_tpu dashboard</title></head>"
                         "<body><h1>harmony_tpu jobs</h1>"
                         "<table border=1><tr><th>job</th><th>reports</th>"
-                        f"<th>last loss</th></tr>{rows}</table></body></html>"
+                        f"<th>last loss</th><th>recoveries</th></tr>{rows}"
+                        "</table></body></html>"
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/html")

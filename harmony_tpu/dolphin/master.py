@@ -31,10 +31,19 @@ from typing import Callable, Dict, List, Optional, Set
 
 
 class BatchProgressTracker:
-    """Tracks per-worker mini-batch progress (max batch index seen)."""
+    """Tracks per-worker mini-batch progress (max batch index seen).
 
-    def __init__(self, num_mini_batches_per_epoch: int) -> None:
+    ``floor_batch`` seeds the global minimum for RESUMED jobs (chain
+    auto-resume, elastic recovery): a fresh tracker reporting progress 0
+    would let the pod plan-horizon check accept a reshard/fence epoch
+    BEHIND the continuation's real progress — the divergent-application
+    hazard the horizon exists to prevent. The floor never decreases
+    observed progress, only prevents understating it."""
+
+    def __init__(self, num_mini_batches_per_epoch: int,
+                 floor_batch: int = 0) -> None:
         self._nb = num_mini_batches_per_epoch
+        self._floor = max(0, int(floor_batch))
         self._lock = threading.Lock()
         self._progress: Dict[str, int] = {}
 
@@ -46,7 +55,8 @@ class BatchProgressTracker:
 
     def global_min_batch(self) -> int:
         with self._lock:
-            return min(self._progress.values()) if self._progress else 0
+            low = min(self._progress.values()) if self._progress else 0
+            return max(low, self._floor)
 
     def starting_epoch(self) -> int:
         """Epoch a restarted worker should resume from (ref: StartingEpochIdx

@@ -1292,6 +1292,11 @@ class WorkerTasklet:
         self.trainer.cleanup(ctx)
         return {
             "job_id": self.job_id,
+            # (starting_epoch, epochs_run) is the exactly-once evidence
+            # the elastic tests stitch across recovery attempts: each
+            # attempt's half-open epoch range [starting_epoch,
+            # starting_epoch + epochs_run) must tile [0, num_epochs)
+            "starting_epoch": self.starting_epoch,
             "epochs_run": len(epoch_losses),
             "losses": epoch_losses,
             "stopped_early": stop,

@@ -1,0 +1,101 @@
+"""Elastic shrink-to-survivors recovery — shared vocabulary.
+
+The capstone of the recovery matrix (docs/FAULT_TOLERANCE.md):
+fail-fast -> auto-resume -> **degrade in place**. A job flagged
+``user.elastic_shrink`` does not FAIL when a pod follower is lost — the
+leader keeps the SAME submission (same job id, same client future) and
+re-dispatches it over the survivor set, restoring only the lost blocks
+from the last committed chain checkpoint (O(lost bytes), not O(model
+bytes) — checkpoint/manager.restore_partial + the per-process recovery
+cache). The reverse leg re-grows: when a lost follower comes back (a
+replacement JOIN, or a silence-confined follower's heartbeats resuming),
+the running shrunk job is fenced once and re-dispatched over the
+restored executor set. Elastic-PS systems show the same contract —
+degrade capacity in place and keep making progress (arXiv:2204.03211) —
+and TPU-pod practice treats worker-count changes as scheduling events,
+not job failures (arXiv:2011.03641).
+
+Why a FENCE instead of a live in-flight reshard: the recovery point must
+be a consistent epoch cut (the acceptance bar is numeric loss parity
+with an uninterrupted run, every batch processed exactly once per
+epoch). Mid-epoch in-flight state is not a cut — so reconfiguration
+always lands at the chief worker's epoch hook, the one point lockstep
+guarantees every participating process reaches at the same logical
+epoch (jobserver/podplan.py). The fence rides the existing PLAN
+broadcast; the chain hook has already snapshotted the fence epoch when
+it fires (hook composition order in entity.run), so the re-dispatch
+resumes exactly one epoch later with nothing lost and nothing replayed.
+
+This module holds only the pieces BOTH sides (the pod control plane and
+the job entity) need, so neither imports the other for them.
+"""
+from __future__ import annotations
+
+import os
+
+
+class ElasticFence(RuntimeError):
+    """Raised by the chief worker's epoch hook when a fence plan is due:
+    a deliberate, lockstep teardown of the current attempt so the leader
+    can re-dispatch the same submission over a different executor set.
+    NOT a failure of the job's own logic and NOT infra damage — the
+    elastic dispatch loop catches it and continues the submission."""
+
+    def __init__(self, kind: str, epoch: int) -> None:
+        super().__init__(
+            f"elastic {kind} fence at epoch {epoch}: attempt ends here so "
+            "the same submission can continue on a different executor set"
+        )
+        self.kind = kind          # "shrink" | "regrow"
+        self.epoch = int(epoch)
+
+    @property
+    def elastic_fence(self) -> str:
+        """Marker attribute mirrored onto follower JOB_DONE reports and
+        leader-side wrapper errors, so the elastic loop can classify a
+        fence without importing concrete exception types across the
+        wire."""
+        return self.kind
+
+
+def attempt_key(job_id: str, attempt: int) -> str:
+    """Wire/report/unit-protocol key of one elastic attempt. Attempt 0 is
+    the plain job id so every non-elastic path is byte-identical to
+    before; recovery attempts get a suffix so stale reports, heartbeat
+    listings and TaskUnit messages from a superseded attempt can never
+    be misattributed to the live one."""
+    return job_id if attempt <= 0 else f"{job_id}@a{attempt}"
+
+
+def attempt_of(config) -> int:
+    """The attempt index a (possibly recovery-) JobConfig encodes."""
+    rec = config.user.get("elastic_recovery")
+    return int(rec.get("attempt", 0)) if isinstance(rec, dict) else 0
+
+
+def config_attempt_key(config) -> str:
+    return attempt_key(config.job_id, attempt_of(config))
+
+
+def max_shrinks() -> int:
+    """Cap on in-place recoveries per submission (shrink + regrow fences
+    both count): a pod losing followers faster than recovery converges
+    must eventually fail loudly instead of thrashing forever."""
+    return int(os.environ.get("HARMONY_ELASTIC_MAX_SHRINKS", "4"))
+
+
+def regrow_enabled() -> bool:
+    """Whether a recovered follower triggers a re-grow fence on running
+    shrunk jobs (HARMONY_ELASTIC_REGROW=0 leaves them degraded)."""
+    return os.environ.get("HARMONY_ELASTIC_REGROW", "1").lower() not in (
+        "0", "false", "off", "")
+
+
+def cache_enabled() -> bool:
+    """Whether elastic jobs retain a host-side copy of this process's
+    blocks at each chain checkpoint (the recovery cache that makes
+    partial restore O(lost bytes)). Costs one host copy of the owned
+    shard per elastic job; HARMONY_ELASTIC_CACHE=0 trades restore I/O
+    for that memory back."""
+    return os.environ.get("HARMONY_ELASTIC_CACHE", "1").lower() not in (
+        "0", "false", "off", "")

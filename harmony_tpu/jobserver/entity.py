@@ -111,6 +111,9 @@ class DolphinJobEntity(JobEntity):
         # global counter (so the continued chain keeps monotonic ids)
         self._starting_epoch = 0
         self._chkp_counter_base = 0
+        #: elastic recovery accounting (restore stats + shrink plan) —
+        #: set by _restore_elastic, surfaced in the job result
+        self._elastic_restore: Optional[Dict[str, Any]] = None
 
     # -- setup -----------------------------------------------------------
 
@@ -194,6 +197,21 @@ class DolphinJobEntity(JobEntity):
             self._handle, _ = master.get_or_create_table(
                 cfg.tables[0], executor_ids, data_axis
             )
+        elif cfg.user.get("elastic_recovery"):
+            # Elastic in-place recovery (jobserver/elastic.py): the SAME
+            # submission continues on a changed executor set — partial
+            # restore reads only the blocks this process cannot source
+            # from its recovery cache (O(lost bytes), the shrink
+            # contract), at the epoch floor of the last committed chain
+            # entry.
+            if getattr(probe, "uses_local_table", False):
+                raise ValueError(
+                    f"job {cfg.job_id}: elastic recovery does not cover "
+                    "worker-local tables (their state is not chained)"
+                )
+            self._handle, self._starting_epoch, self._chkp_counter_base = (
+                self._restore_elastic(master, executor_ids, data_axis)
+            )
         elif cfg.user.get("resume_from_chain"):
             # Auto-resume: rebuild the model table from the job's LAST
             # committed chain checkpoint (restore-by-state, ref:
@@ -254,17 +272,54 @@ class DolphinJobEntity(JobEntity):
         acceptable under bounded-staleness semantics.
 
         Returns (handle, starting_epoch, counter_base)."""
-        from harmony_tpu.checkpoint.manager import CheckpointManager
+        mgr, ordered, base = self._chain_scan("resume_from_chain")
+        cfg = self.config
+        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
+        from harmony_tpu.jobserver.joblog import job_logger
+
+        failures = []
+        for info in ordered:
+            try:
+                handle = mgr.restore(master, info.chkp_id, executor_ids,
+                                     data_axis)
+            except (CheckpointCorruptError, FileNotFoundError) as e:
+                job_logger(cfg.job_id).warning(
+                    "chain entry %s is corrupt/torn (%s: %s); quarantining "
+                    "and falling back to the previous committed entry",
+                    info.chkp_id, type(e).__name__, e,
+                )
+                failures.append((info.chkp_id, f"{type(e).__name__}: {e}"))
+                mgr.quarantine(info.chkp_id)
+                continue
+            return handle, int(info.app_meta["epoch"]) + 1, base
+        raise ValueError(
+            f"job {cfg.job_id}: every chain checkpoint failed integrity "
+            f"on restore (all quarantined): {failures}"
+        )
+
+    def _chain_scan(self, why: str):
+        """Shared chain discovery for resume_from_chain AND elastic
+        recovery: epoch-tagged entries under this job's chkp root,
+        newest-first by the MONOTONIC epoch tag (wall clock can regress
+        across hosts/NTP steps and must never discard newer progress;
+        created_at only tie-breaks entries claiming the same epoch —
+        a resubmitted-from-scratch chain re-covering old ones), plus the
+        continuation counter base (ids stay unique/ordered past EVERY
+        existing entry; the epoch clock is the manifest tag, never the
+        counter). Torn-manifest entries are quarantined during the scan.
+        Returns (manager, ordered_infos, counter_base)."""
+        from harmony_tpu.checkpoint.manager import (
+            CheckpointCorruptError,
+            CheckpointManager,
+        )
+        from harmony_tpu.jobserver.joblog import job_logger
 
         cfg = self.config
         if self.chkp_root is None:
             raise ValueError(
-                f"job {cfg.job_id}: resume_from_chain needs the server's "
-                "chkp_root (the chain lives there)"
+                f"job {cfg.job_id}: {why} needs the server's chkp_root "
+                "(the chain lives there)"
             )
-        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
-        from harmony_tpu.jobserver.joblog import job_logger
-
         mgr = CheckpointManager.for_job(self.chkp_root, cfg.job_id)
         prefix = f"{cfg.job_id}:"
         infos = []
@@ -287,8 +342,8 @@ class DolphinJobEntity(JobEntity):
             infos.append(info)
         if not infos:
             raise ValueError(
-                f"job {cfg.job_id}: resume_from_chain found no epoch-"
-                f"tagged chain checkpoints under {self.chkp_root}"
+                f"job {cfg.job_id}: {why} found no epoch-tagged chain "
+                f"checkpoints under {self.chkp_root}"
             )
 
         def counter_of(cid: str) -> int:
@@ -297,44 +352,84 @@ class DolphinJobEntity(JobEntity):
             except (ValueError, IndexError):
                 return 0
 
-        # keep the continued chain's id counters monotonic past EVERY
-        # existing entry (ids stay unique/ordered; the epoch clock is the
-        # manifest tag, never the counter)
         base = max(counter_of(i.chkp_id) for i in infos)
-        # primary key: the MONOTONIC epoch tag (wall clock can regress
-        # across hosts/NTP steps and must never discard newer progress);
-        # created_at only tie-breaks entries claiming the same epoch
-        # (a resubmitted-from-scratch chain re-covering old epochs).
-        # Newest-first with CORRUPTION FALLBACK: a chain entry that fails
-        # restore integrity (manifest-checksum mismatch, torn block file,
-        # missing block) is quarantined and the PREVIOUS committed entry
-        # is tried — losing one epoch of progress beats failing the
-        # resume outright. Only corruption-class errors fall through;
-        # anything else (bad grant, schema mismatch) aborts immediately:
-        # it would fail identically on every entry.
+        # Newest-first with CORRUPTION FALLBACK (callers quarantine a
+        # failing entry and try the previous committed one — losing one
+        # epoch of progress beats failing the resume outright; anything
+        # non-corruption aborts immediately: it would fail identically
+        # on every entry).
         ordered = sorted(
             infos,
             key=lambda i: (int(i.app_meta["epoch"]), i.created_at),
             reverse=True,
         )
+        return mgr, ordered, base
+
+    def _restore_elastic(self, master: ETMaster, executor_ids: List[str],
+                         data_axis: int):
+        """The shrink/re-grow restore: newest committed chain entry,
+        partial-read (recovery cache first, checkpoint storage only for
+        what this process genuinely lost — manager.restore_partial), with
+        the same newest->oldest corruption fallback as _restore_chain.
+        Records the restore accounting (the O(lost-bytes) evidence) in
+        ``self._elastic_restore`` for the job result. Returns
+        (handle, starting_epoch, counter_base)."""
+        from harmony_tpu import faults
+        from harmony_tpu.checkpoint.manager import CheckpointCorruptError
+        from harmony_tpu.jobserver.joblog import job_logger
+        from harmony_tpu.table import ownership as _ownership
+
+        cfg = self.config
+        rec = cfg.user.get("elastic_recovery") or {}
+        mgr, ordered, base = self._chain_scan("elastic recovery")
         failures = []
         for info in ordered:
+            if faults.armed():
+                faults.site("elastic.restore", chkp_id=info.chkp_id,
+                            attempt=int(rec.get("attempt", 0)))
             try:
-                handle = mgr.restore(master, info.chkp_id, executor_ids,
-                                     data_axis)
+                handle, stats = mgr.restore_partial(
+                    master, info.chkp_id, executor_ids, data_axis
+                )
             except (CheckpointCorruptError, FileNotFoundError) as e:
                 job_logger(cfg.job_id).warning(
-                    "chain entry %s is corrupt/torn (%s: %s); quarantining "
-                    "and falling back to the previous committed entry",
+                    "elastic recovery: chain entry %s is corrupt/torn "
+                    "(%s: %s); quarantining and falling back",
                     info.chkp_id, type(e).__name__, e,
                 )
                 failures.append((info.chkp_id, f"{type(e).__name__}: {e}"))
                 mgr.quarantine(info.chkp_id)
                 continue
+            lost_execs = [e for e in rec.get("lost_executors", [])
+                          if e in info.executors]
+            plan = None
+            if lost_execs:
+                try:
+                    plan = _ownership.shrink_plan(
+                        info.ownership, info.executors, lost_execs,
+                        executor_ids,
+                    )
+                except ValueError:
+                    plan = None
+            self._elastic_restore = {
+                "attempt": int(rec.get("attempt", 0)),
+                "kind": rec.get("kind", "shrink"),
+                "chkp_id": info.chkp_id,
+                "resumed_epoch": int(info.app_meta["epoch"]) + 1,
+                "executors": list(executor_ids),
+                "lost_executors": list(lost_execs),
+                "lost_block_count": (len(plan["lost"]) if plan else 0),
+                **stats,
+            }
+            job_logger(cfg.job_id).event(
+                "elastic_restore",
+                recovery=self._elastic_restore["kind"],
+                **{k: v for k, v in self._elastic_restore.items()
+                   if k not in ("executors", "kind")})
             return handle, int(info.app_meta["epoch"]) + 1, base
         raise ValueError(
             f"job {cfg.job_id}: every chain checkpoint failed integrity "
-            f"on restore (all quarantined): {failures}"
+            f"during elastic recovery (all quarantined): {failures}"
         )
 
     def run(self) -> Dict[str, Any]:
@@ -350,7 +445,12 @@ class DolphinJobEntity(JobEntity):
             "training: %d worker(s), %d epoch(s) x %d mini-batch(es)",
             num_workers, params.num_epochs, nb,
         )
-        self.progress = BatchProgressTracker(nb)
+        # floor_batch: a RESUMED continuation (auto-resume / elastic
+        # recovery) must never report an epoch floor below its resume
+        # point — the pod plan/fence horizon check reads this
+        self.progress = BatchProgressTracker(
+            nb, floor_batch=self._starting_epoch * nb
+        )
         # Model-checkpoint chaining (ref: ModelChkpManager wired by
         # DolphinMaster.start:186-189): snapshots run off the CHIEF worker's
         # epoch hook — one snapshot per job epoch, async writers.
@@ -413,6 +513,14 @@ class DolphinJobEntity(JobEntity):
             )
             self._chkp_dir = root
             self._chkp_mgr = CheckpointManager.for_job(root, cfg.job_id)
+            if cfg.user.get("elastic_shrink"):
+                # elastic jobs keep a host copy of THIS process's staged
+                # blocks per chain entry (the recovery cache): a shrink
+                # restore then reads only genuinely lost blocks from
+                # storage — the O(lost-bytes) contract
+                from harmony_tpu.jobserver import elastic as _elastic
+
+                self._chkp_mgr.recovery_retain = _elastic.cache_enabled()
             if self._chkp_counter_base:
                 # a RESUMED job continues its chain: counters (and the
                 # epoch mapping a future resume derives from them) stay
@@ -607,6 +715,27 @@ class DolphinJobEntity(JobEntity):
         if self._global_tu is not None:
             self._global_tu.on_job_finish(cfg.job_id)
         if errors:
+            fence = next(
+                (e for e in errors
+                 if getattr(e, "elastic_fence", None) is not None), None,
+            )
+            if fence is not None and self._chkp_chain is not None:
+                # an elastic fence ends the attempt ON PURPOSE right
+                # after the fence epoch's chain hook — join the async
+                # writers so the recovery point is COMMITTED before the
+                # leader plans the next attempt (otherwise the restore
+                # falls back an epoch and re-runs it)
+                try:
+                    self._chkp_chain.drain()
+                except BaseException:  # noqa: BLE001 - fence still stands
+                    pass
+            if fence is not None:
+                # the fence outranks sibling errors: a worker released by
+                # the fence's stop broadcast may error while unwinding,
+                # and raising THAT would strip the marker the elastic
+                # loop classifies on — permanently failing a submission
+                # that was mid-planned-reconfiguration
+                raise fence
             raise errors[0]
         if tm_hook is not None:
             # final report AFTER all workers joined: the chief's last epoch
@@ -614,6 +743,10 @@ class DolphinJobEntity(JobEntity):
             # their tail ops land in this closing window
             tm_hook(params.num_epochs)
         out: Dict[str, Any] = {"job_id": cfg.job_id, "workers": results}
+        if self._elastic_restore is not None:
+            # the recovery attempt's restore accounting (the O(lost-bytes)
+            # evidence the elastic chaos tests assert against)
+            out["elastic_restore"] = dict(self._elastic_restore)
         if self._applied_plans:
             out["applied_plans"] = list(self._applied_plans)
         if orchestrator is not None:
@@ -814,6 +947,20 @@ class DolphinJobEntity(JobEntity):
 
         def hook(epoch_idx: int) -> None:
             for p in podplan.take(job_id, epoch_idx):
+                if p.get("elastic_fence"):
+                    # Elastic fence: this attempt ends HERE — at the one
+                    # point lockstep guarantees every process reaches at
+                    # the same logical epoch, right AFTER the chain hook
+                    # snapshotted this epoch (hook composition order in
+                    # run()), so the re-dispatch resumes at epoch+1 with
+                    # nothing lost. Sibling workers are released through
+                    # the SSP stop broadcast; the fence error carries the
+                    # marker the elastic dispatch loop classifies on.
+                    from harmony_tpu.jobserver.elastic import ElasticFence
+
+                    if self._ctrl is not None:
+                        self._ctrl.request_stop()
+                    raise ElasticFence(str(p["elastic_fence"]), epoch_idx)
                 # clamp to what src actually owns (deterministic: every
                 # process sees the same block map) so "drain" plans can
                 # just pass a large count

@@ -12,9 +12,56 @@ by the host application, and the adapter is cheap enough to create per job.
 from __future__ import annotations
 
 import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
 
 #: Shared base logger for server-scoped (not job-scoped) events.
 server_log = logging.getLogger("harmony_tpu.jobserver")
+
+# -- structured recovery/lifecycle events ---------------------------------
+#
+# Free-text operator logs are unqueryable; the recovery paths (elastic
+# shrink/re-grow, confinement, rehabilitation, auto-resume) additionally
+# record STRUCTURED events here so the job status JSON and the dashboard
+# can surface them without log scraping. Per-process, bounded, in-memory
+# — the durable record is still the operator log.
+
+_EVENTS_LOCK = threading.Lock()
+_EVENTS: Dict[str, List[Dict[str, Any]]] = {}
+_EVENTS_PER_JOB = 64
+_EVENTS_MAX_JOBS = 256
+
+
+def record_event(job_id: str, kind: str, **fields: Any) -> Dict[str, Any]:
+    """Append one structured event to ``job_id``'s ring. ``fields`` must
+    be JSON-serializable (they ride the status endpoint verbatim)."""
+    ev = {"ts": time.time(), "kind": kind, **fields}
+    with _EVENTS_LOCK:
+        ring = _EVENTS.setdefault(job_id, [])
+        ring.append(ev)
+        del ring[:-_EVENTS_PER_JOB]
+        while len(_EVENTS) > _EVENTS_MAX_JOBS:
+            _EVENTS.pop(next(iter(_EVENTS)))
+    return ev
+
+
+def job_events(job_id: Optional[str] = None,
+               limit: int = 32) -> "Dict[str, List[Dict[str, Any]]] | List[Dict[str, Any]]":
+    """Recorded events — for one job (a list, newest last) or all jobs
+    (job_id -> list). Snapshots; mutation-safe for callers."""
+    with _EVENTS_LOCK:
+        if job_id is not None:
+            return list(_EVENTS.get(job_id, []))[-limit:]
+        return {j: list(evs)[-limit:] for j, evs in _EVENTS.items()}
+
+
+def clear_events(job_id: Optional[str] = None) -> None:
+    with _EVENTS_LOCK:
+        if job_id is None:
+            _EVENTS.clear()
+        else:
+            _EVENTS.pop(job_id, None)
 
 
 class JobLogger(logging.LoggerAdapter):
@@ -26,6 +73,14 @@ class JobLogger(logging.LoggerAdapter):
 
     def process(self, msg, kwargs):
         return f"[JobId: {self.job_id}] {msg}", kwargs
+
+    def event(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Structured event + the matching operator-log line in one call
+        (the recovery paths' idiom: nothing important is ever ONLY in
+        free text)."""
+        self.info("%s %s", kind,
+                  " ".join(f"{k}={v!r}" for k, v in sorted(fields.items())))
+        return record_event(self.job_id, kind, **fields)
 
 
 def job_logger(job_id: str) -> JobLogger:

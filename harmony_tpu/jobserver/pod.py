@@ -72,9 +72,10 @@ import numpy as np
 
 from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import JobConfig
+from harmony_tpu.jobserver import elastic as _elastic
 from harmony_tpu.jobserver.joblog import job_logger, server_log
 from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
-from harmony_tpu.jobserver.server import JobServer
+from harmony_tpu.jobserver.server import JobResult, JobServer
 from harmony_tpu.runtime.podunits import (
     FollowerUnits,
     PodUnitArbiter,
@@ -143,6 +144,14 @@ class PodJobServer(JobServer):
         self.hb_timeout = float(os.environ.get("HARMONY_POD_HB_TIMEOUT",
                                                "60"))
         self._last_seen: Dict[int, float] = {}
+        #: pid -> last HEARTBEAT (the beacon specifically, not any
+        #: traffic): confinement is conservative (any traffic counts as
+        #: liveness), REHABILITATION is strict — a confined follower
+        #: answering a leader-solicited query is reachable, but only its
+        #: own resumed beacon proves the silence is actually over
+        #: (otherwise the fence's progress query would instantly
+        #: "rehabilitate" a mute follower and the pod would flap)
+        self._last_beat: Dict[int, float] = {}
         #: pid -> set of job ids the follower's latest heartbeat listed —
         #: catches a job thread that died without ever reporting
         self._hb_jobs: Dict[int, set] = {}
@@ -155,6 +164,22 @@ class PodJobServer(JobServer):
         # poisons (partial broadcasts) stay TOTAL.
         self._unusable_procs: set = set()
         self._poison_scope: Optional[str] = None  # "partial" | "total"
+        #: pids confined by heartbeat SILENCE (the process may well be
+        #: alive — a partition, a wedged beacon): the pod monitor both
+        #: confines on staleness and REHABILITATES when beats resume,
+        #: the in-place half of elastic re-grow
+        self._silenced: set = set()
+        #: job_id -> live elastic attempt bookkeeping ({"attempt",
+        #: "procs", "original_procs", "config"}) — what fence
+        #: scheduling and re-grow triggers read
+        self._elastic_active: Dict[str, Dict[str, Any]] = {}
+        #: recent elastic recovery events (bounded; status surface)
+        self.elastic_events: List[Dict[str, Any]] = []
+        self._monitor_stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._late_join_thread: Optional[threading.Thread] = None
+        #: pids reinstated after death/silence (observability + tests)
+        self.reinstated: List[int] = []
         #: jobs whose FAILURE was infra-observed (a participant died or
         #: went silent DURING the job) — the auto-resume eligibility
         #: evidence; a job failing on its own terms never lands here
@@ -221,22 +246,9 @@ class PodJobServer(JobServer):
                     f"pod join: {len(self._followers)}/{self._num_followers} "
                     f"followers after {join_timeout}s"
                 )
-            # accept()'d sockets are BLOCKING regardless of the listener's
-            # timeout: a connection that never sends JOIN (health check,
-            # scanner, crashed follower) must not hang bootstrap forever
-            conn.settimeout(30.0)
-            f = conn.makefile("r")
-            try:
-                hello = _recv(f)
-                # garbage (an HTTP health check, a scanner) or a JOIN with
-                # no pid must be dropped like silence, not crash bootstrap
-                pid = int(hello["pid"]) if hello else None
-            except (socket.timeout, OSError, ValueError, KeyError, TypeError):
-                hello, pid = None, None
-            if not hello or hello.get("cmd") != "JOIN" or pid is None:
-                conn.close()
+            pid, f = self._read_join(conn)
+            if pid is None:
                 continue
-            conn.settimeout(None)  # the reader thread owns this socket now
             self._followers[pid] = (conn, f)
             self._send_locks[pid] = threading.Lock()
             self._last_seen[pid] = time.monotonic()
@@ -248,7 +260,96 @@ class PodJobServer(JobServer):
             )
             t.start()
             self._readers.append(t)
+        # Active liveness: heartbeat staleness is now noticed WHENEVER it
+        # happens (a report wait used to be the only observer — a silent
+        # follower under a leader-local job went undetected until job
+        # end), and resumed beats from a silence-confined follower
+        # rehabilitate it (the elastic re-grow trigger).
+        self._monitor_thread = threading.Thread(
+            target=self._pod_monitor, daemon=True, name="pod-monitor",
+        )
+        self._monitor_thread.start()
+        # Replacement followers may JOIN at any time after bootstrap — a
+        # restarted host, or a partition healing with a fresh process.
+        sock.settimeout(1.0)
+        self._late_join_thread = threading.Thread(
+            target=self._accept_late_joins, daemon=True,
+            name="pod-late-join",
+        )
+        self._late_join_thread.start()
         return bound
+
+    @staticmethod
+    def _read_join(conn: socket.socket) -> "Tuple[Optional[int], Any]":
+        """One JOIN handshake on a fresh connection; (None, None) for
+        garbage. accept()'d sockets are BLOCKING regardless of the
+        listener's timeout: a connection that never sends JOIN (health
+        check, scanner, crashed follower) must not hang the accept loop
+        forever."""
+        conn.settimeout(30.0)
+        f = conn.makefile("r")
+        try:
+            hello = _recv(f)
+            # garbage (an HTTP health check, a scanner) or a JOIN with
+            # no pid must be dropped like silence, not crash the loop
+            pid = int(hello["pid"]) if hello else None
+        except (socket.timeout, OSError, ValueError, KeyError, TypeError):
+            hello, pid = None, None
+        if not hello or hello.get("cmd") != "JOIN" or pid is None:
+            conn.close()
+            return None, None
+        conn.settimeout(None)  # the reader thread owns this socket now
+        return pid, f
+
+    def _accept_late_joins(self) -> None:
+        """Post-bootstrap accept loop: a JOIN for a dead/confined pid is
+        a REPLACEMENT follower (or the same host restarted) and gets
+        reinstated; a JOIN for a live pid replaces its connection (the
+        old one is stale — e.g. the follower reconnected after a
+        partition its end diagnosed first)."""
+        sock = self._pod_sock
+        while True:
+            with self._pod_cond:
+                if self._pod_closing:
+                    return
+            try:
+                conn, addr = sock.accept()
+            except socket.timeout:
+                continue
+            except (OSError, AttributeError):
+                return  # listener closed (shutdown)
+            pid, f = self._read_join(conn)
+            if pid is None:
+                continue
+            server_log.info("pod follower %d re-JOINed from %s", pid, addr)
+            self._reinstate_follower(pid, conn, f)
+
+    def _reinstate_follower(self, pid: int, conn: socket.socket, f) -> None:
+        """Wire a replacement follower back into the pod: fresh reader,
+        liveness state cleared, executors restored to the scheduler, and
+        running shrunk elastic jobs offered a re-grow fence."""
+        with self._pod_cond:
+            old = self._followers.pop(pid, None)
+            self._followers[pid] = (conn, f)
+            self._send_locks[pid] = threading.Lock()
+            self._last_seen[pid] = time.monotonic()
+            self._hb_jobs.pop(pid, None)
+            self._dead_followers.discard(pid)
+            self._pod_cond.notify_all()
+        if old is not None:
+            try:
+                old[0].close()
+            except OSError:
+                pass
+        t = threading.Thread(
+            target=self._reader_loop, args=(pid, f), daemon=True,
+            name=f"pod-reader-{pid}",
+        )
+        t.start()
+        self._readers.append(t)
+        self.reinstated.append(pid)
+        self.pod_units.proc_done(pid)  # stale DONE obligations die here
+        self._rehabilitate(pid, reason="replacement JOIN")
 
     def _mark_broken(self, reason: str, scope: str = "total") -> None:
         """One poison path: record the reason and wake every pod waiter.
@@ -312,6 +413,208 @@ class PodJobServer(JobServer):
                 retired, sorted(wedged),
             )
 
+    def _proc_executors(self, pid: int) -> List[str]:
+        return [
+            eid for eid in self.master.executor_ids()
+            if self.master.executor(eid).device.process_index == pid
+        ]
+
+    def _pod_monitor(self) -> None:
+        """Active liveness loop: silence past ``hb_timeout`` confines a
+        follower (executors retired, elastic jobs spanning it fenced to
+        shrink); FRESH beats from a silence-confined follower
+        rehabilitate it (executors restored, shrunk elastic jobs fenced
+        to re-grow). Death (reader EOF) is handled by the reader paths
+        as before — this thread covers the partial failures only beats
+        can reveal."""
+        period = max(0.25, min(self.hb_timeout / 4.0, 2.0))
+        while not self._monitor_stop.wait(period):
+            with self._pod_cond:
+                if self._pod_closing:
+                    return
+                now = time.monotonic()
+                stale, fresh = [], []
+                for pid in self._followers:
+                    if pid in self._dead_followers:
+                        continue
+                    old = now - self._last_seen.get(pid, now) > self.hb_timeout
+                    beat_fresh = (now - self._last_beat.get(pid, 0.0)
+                                  <= self.hb_timeout)
+                    if old and pid not in self._silenced \
+                            and pid not in self._unusable_procs:
+                        stale.append(pid)
+                    elif beat_fresh and pid in self._silenced:
+                        # the BEACON itself resumed (class doc on
+                        # _last_beat): the one signal that lifts a
+                        # silence confinement
+                        fresh.append(pid)
+            for pid in stale:
+                self._on_follower_silence(pid)
+            for pid in fresh:
+                self._rehabilitate(pid, reason="heartbeats resumed")
+
+    def _on_follower_silence(self, pid: int) -> None:
+        """Infra-dead by SILENCE: the process may be alive (partition,
+        muted beacon), so — unlike a death — co-participants are NOT
+        presumed wedged (their collectives still have a live peer).
+        The pid alone retires; elastic jobs spanning it get a lockstep
+        shrink fence so the same submission continues on survivors."""
+        with self._pod_cond:
+            if pid in self._dead_followers or pid in self._silenced:
+                return
+            self._silenced.add(pid)
+            self._unusable_procs.add(pid)
+        retired = self._proc_executors(pid)
+        if retired:
+            self._scheduler.retire(retired)
+        server_log.warning(
+            "pod follower %d silent past %.1fs: confined (executors %s "
+            "retired); elastic jobs spanning it will shrink",
+            pid, self.hb_timeout, retired,
+        )
+        self._record_pod_event("follower_silenced", pid=pid,
+                               retired=retired)
+        self._schedule_elastic_fences("shrink", pid)
+
+    def _rehabilitate(self, pid: int, reason: str) -> None:
+        """A confined follower proved itself alive again (resumed beats,
+        or a replacement JOIN): lift the confinement, restore its
+        executors to the scheduler, and offer running shrunk elastic
+        jobs a re-grow fence back toward their original layout."""
+        with self._pod_cond:
+            if pid in self._dead_followers:
+                return  # reader saw EOF since; not alive after all
+            self._silenced.discard(pid)
+            self._unusable_procs.discard(pid)
+            if (self._poison_scope == "partial" and not self._dead_followers
+                    and not self._unusable_procs):
+                # every confined process is back: the pod is whole again
+                self._pod_broken = None
+                self._poison_scope = None
+            self._pod_cond.notify_all()
+        restored = self._proc_executors(pid)
+        if restored:
+            self._scheduler.restore(restored)
+        server_log.info("pod follower %d rehabilitated (%s); executors %s "
+                        "restored", pid, reason, restored)
+        self._record_pod_event("follower_rehabilitated", pid=pid,
+                               reason=reason, restored=restored)
+        if _elastic.regrow_enabled():
+            self._schedule_elastic_fences("regrow", pid)
+
+    def _record_pod_event(self, kind: str, job_id: Optional[str] = None,
+                          **fields: Any) -> Dict[str, Any]:
+        from harmony_tpu.jobserver import joblog
+
+        ev = joblog.record_event(job_id or "__pod__", kind, **fields)
+        with self._pod_cond:
+            self.elastic_events.append(dict(ev, job_id=job_id or "__pod__"))
+            del self.elastic_events[:-256]
+        if self._dashboard is not None:
+            # recovery events reach the dashboard summary (kind=recovery
+            # rows back its per-job recoveries column); best-effort like
+            # every other dashboard post
+            self._dashboard.post(job_id or "__pod__", "recovery", dict(ev))
+        return ev
+
+    def _elastic_give_up(self, jlog, job_id: str, **fields: Any) -> None:
+        """Terminal elastic outcome: one structured event in BOTH the
+        per-job log and the pod-level event ring (operators watching the
+        status endpoint must see why a degraded tenant stopped
+        recovering, not just that it failed)."""
+        ev = jlog.event("elastic_give_up", **fields)
+        with self._pod_cond:
+            self.elastic_events.append(dict(ev, job_id=job_id))
+            del self.elastic_events[:-256]
+        if self._dashboard is not None:
+            self._dashboard.post(job_id, "recovery", dict(ev))
+
+    # -- elastic fences ---------------------------------------------------
+
+    def _schedule_elastic_fences(self, kind: str, pid: int) -> None:
+        """Offer every affected RUNNING elastic job a fence: shrink for
+        jobs spanning the confined pid, re-grow for shrunk jobs that can
+        expand back onto a rehabilitated one."""
+        with self._pod_cond:
+            targets = []
+            for jid, st in self._elastic_active.items():
+                if kind == "shrink" and pid in st["procs"]:
+                    targets.append(jid)
+                elif (kind == "regrow" and st["attempt"] > 0
+                      and pid not in st["procs"]
+                      and pid in st["original_procs"]):
+                    targets.append(jid)
+        for jid in targets:
+            try:
+                self._schedule_elastic_fence(jid, kind)
+            except Exception as e:  # noqa: BLE001 - fence is best-effort
+                job_logger(jid).warning(
+                    "elastic %s fence could not be scheduled: %s: %s",
+                    kind, type(e).__name__, e,
+                )
+
+    def _schedule_elastic_fence(self, job_id: str,
+                                kind: str) -> Optional[int]:
+        """Schedule a lockstep elastic fence on a RUNNING attempt: the
+        plan broadcast rides the PLAN channel; every participating
+        process's chief hook raises the fence at the same epoch (the
+        multi-epoch-lead contract of schedule_pod_reshard, same horizon
+        arithmetic). Returns the fence epoch, or None when the job is
+        too close to its end to be worth reconfiguring."""
+        from harmony_tpu.dolphin.worker import WorkerTasklet
+        from harmony_tpu.jobserver import podplan
+
+        with self._pod_cond:
+            st = self._elastic_active.get(job_id)
+            if st is None:
+                return None
+            procs = set(st["procs"])
+            att = st["attempt"]
+            num_epochs = st["config"].params.num_epochs
+        rkey = _elastic.attempt_key(job_id, att)
+        with self._lock:
+            ent = self._entities.get(job_id)
+        cur = 0
+        if ent is not None and getattr(ent, "progress", None) is not None:
+            cur = ent.progress.starting_epoch()
+        else:
+            # prefer a HEALTHY participant for the floor query — the
+            # silence that triggered a shrink fence may be the very
+            # chief we'd otherwise ask; when only confined participants
+            # remain, still try (an injected-mute process answers; a
+            # real partition doesn't) but with a short timeout so the
+            # monitor thread is never stalled the full query window
+            with self._pod_cond:
+                silenced = set(self._silenced)
+            participants = sorted(p for p in procs if p != 0)
+            healthy = [p for p in participants if p not in silenced]
+            if healthy:
+                cur = self._query_remote_epoch(rkey, healthy[0])
+            elif participants:
+                cur = self._query_remote_epoch(rkey, participants[0],
+                                               timeout=5.0)
+        epoch = cur + WorkerTasklet.EPOCH_WINDOW + 2
+        if epoch >= num_epochs:
+            job_logger(job_id).info(
+                "elastic %s fence skipped: earliest safe epoch %d is past "
+                "the job's end (%d epochs)", kind, epoch, num_epochs,
+            )
+            return None
+        plan = {"epoch": int(epoch), "elastic_fence": kind}
+        for p in sorted(p for p in procs if p != 0):
+            try:
+                self._send_to(p, {"cmd": "PLAN", "job_id": job_id,
+                                  "plan": plan})
+            except OSError:
+                # an unreachable participant misses the fence — but a
+                # fence is cooperative teardown, and the job-level waits
+                # classify its silence through the normal infra paths
+                pass
+        podplan.schedule(job_id, plan)
+        self._record_pod_event(f"elastic_{kind}_fence", job_id=job_id,
+                               epoch=int(epoch), attempt=att)
+        return epoch
+
     def _reader_loop(self, pid: int, f) -> None:
         """Owns all reads from follower ``pid``: routes JOB_DONE payloads
         into the report buffer by (job_id, pid), and drives the unit
@@ -325,9 +628,15 @@ class PodJobServer(JobServer):
                 msg = None
             if msg is None:
                 with self._pod_cond:
-                    self._dead_followers.add(pid)
+                    cur = self._followers.get(pid)
+                    stale = cur is None or cur[1] is not f
+                    if not stale:
+                        self._dead_followers.add(pid)
+                        self._silenced.discard(pid)  # dead beats silent
                     closing = self._pod_closing
                     self._pod_cond.notify_all()
+                if stale:
+                    return  # superseded by a reinstated connection
                 self.pod_units.proc_done(pid)
                 if not closing:
                     self._on_follower_death(pid)
@@ -339,6 +648,7 @@ class PodJobServer(JobServer):
             with self._pod_cond:
                 self._last_seen[pid] = time.monotonic()
                 if msg.get("cmd") == "HEARTBEAT":
+                    self._last_beat[pid] = self._last_seen[pid]
                     self._hb_jobs[pid] = set(msg.get("jobs", []))
                     self._pod_cond.notify_all()
             if msg.get("cmd") == "HEARTBEAT":
@@ -436,7 +746,15 @@ class PodJobServer(JobServer):
                     return None
                 now = time.monotonic()
                 last = self._last_seen.get(pid, 0.0)
-                if now - last > self.hb_timeout:
+                # Short grace RIGHT AFTER staleness onset (total patience
+                # = hb_timeout + grace since the last traffic): a
+                # silence-confined follower's socket is still up, and its
+                # JOB_DONE for a lockstep fence races this wait by design
+                # (every process tears down at the same epoch). A pid
+                # stale far beyond the window gets no grace — waits on
+                # long-mute followers must fail promptly (the auto-resume
+                # path resubmits the moment the failure is classified).
+                if now - last > self.hb_timeout + min(5.0, self.hb_timeout):
                     return None
                 hb = self._hb_jobs.get(pid)
                 if hb is not None and job_id not in hb:
@@ -496,6 +814,18 @@ class PodJobServer(JobServer):
                 "units_granted": self.pod_units.grants_total,
                 "units_grant_to_done_s": round(
                     self.pod_units.grant_to_done_s, 4),
+                "silenced": sorted(self._silenced),
+                "dead": sorted(self._dead_followers),
+                "unusable_procs": sorted(self._unusable_procs),
+                "reinstated": list(self.reinstated),
+            }
+            out["elastic"] = {
+                "active": {
+                    j: {"attempt": st["attempt"],
+                        "procs": sorted(st["procs"])}
+                    for j, st in self._elastic_active.items()
+                },
+                "events": [dict(ev) for ev in self.elastic_events[-32:]],
             }
         return out
 
@@ -536,6 +866,233 @@ class PodJobServer(JobServer):
         return None
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
+        if self._elastic_eligible(config):
+            self._dispatch_elastic(config, executor_ids)
+            return
+        self._dispatch_once(config, executor_ids)
+        self._maybe_auto_resume(config, executor_ids)
+
+    def _elastic_eligible(self, config: JobConfig) -> bool:
+        """user.elastic_shrink jobs that can actually be recovered in
+        place: a dolphin job with a chain (the recovery point) and a
+        PRIVATE model table (a shared table's state belongs to every
+        tenant — rebuilding it under one would corrupt the others)."""
+        if not config.user.get("elastic_shrink"):
+            return False
+        ok = (config.app_type == "dolphin" and not config.tables
+              and config.params.model_chkp_period > 0
+              and self._chkp_root is not None)
+        if not ok:
+            job_logger(config.job_id).warning(
+                "elastic_shrink ignored: needs app_type=dolphin, a private "
+                "model table, model_chkp_period > 0 and a server chkp_root"
+            )
+        return ok
+
+    def _dispatch_elastic(self, config: JobConfig,
+                          executor_ids: List[str]) -> None:
+        """The elastic dispatch loop — ONE submission, many attempts.
+
+        Each attempt runs through the ordinary pod dispatch under an
+        attempt-keyed identity (jobserver/elastic.attempt_key) against a
+        PRIVATE inner future; the client-visible outer future resolves
+        only when an attempt completes or recovery is exhausted — no
+        resubmit, no duplicate-id dance, job status shows one running
+        job throughout. Failure classification per attempt:
+
+          * elastic FENCE (shrink or re-grow) — planned lockstep
+            teardown; recover and continue;
+          * infra-shaped failure (participant death/silence, an
+            infra_suspect give-up) — recover on survivors;
+          * anything else — the job failed on its own terms: fail the
+            submission (never resubmitted to fail identically).
+        """
+        outer = self._jobs[config.job_id]
+        jlog = job_logger(config.job_id)
+        cfg, execs = config, list(executor_ids)
+        original_procs = frozenset(
+            self.master.executor(e).device.process_index
+            for e in executor_ids
+        )
+        recoveries = 0
+        events: List[Dict[str, Any]] = []
+        last_exc: Optional[BaseException] = None
+        try:
+            while True:
+                att = _elastic.attempt_of(cfg)
+                inner = JobResult()
+                with self._lock:
+                    self._jobs[config.job_id] = inner
+                with self._pod_cond:
+                    self._elastic_active[config.job_id] = {
+                        "attempt": att,
+                        "procs": frozenset(
+                            self.master.executor(e).device.process_index
+                            for e in execs
+                        ),
+                        "original_procs": original_procs,
+                        "config": cfg,
+                    }
+                try:
+                    self._dispatch_once(cfg, execs)
+                finally:
+                    with self._pod_cond:
+                        self._elastic_active.pop(config.job_id, None)
+                exc = inner.future.exception()
+                if exc is None:
+                    result = dict(inner.future.result())
+                    if att or events:
+                        result["elastic"] = {
+                            "attempts": att + 1,
+                            "recoveries": recoveries,
+                            "events": list(events),
+                        }
+                    outer.future.set_result(result)
+                    return
+                last_exc = exc
+                fence = getattr(exc, "elastic_fence", None)
+                with self._pod_cond:
+                    infra = config.job_id in self._infra_failed
+                    self._infra_failed.discard(config.job_id)
+                infra = infra or bool(getattr(exc, "infra_suspect", False))
+                if fence is None and not infra:
+                    self._elastic_give_up(
+                        jlog, config.job_id,
+                        reason="job failed on its own terms",
+                        error=f"{type(exc).__name__}: {exc}"[:300])
+                    return
+                if recoveries >= _elastic.max_shrinks():
+                    self._elastic_give_up(
+                        jlog, config.job_id,
+                        reason=f"recovery cap {_elastic.max_shrinks()} "
+                               "reached (HARMONY_ELASTIC_MAX_SHRINKS)",
+                    )
+                    return
+                kind = "regrow" if fence == "regrow" else "shrink"
+                try:
+                    plan = self._plan_elastic_recovery(
+                        config, execs, att, kind, executor_ids, events
+                    )
+                except BaseException as e:  # noqa: BLE001 - give up cleanly
+                    self._elastic_give_up(
+                        jlog, config.job_id,
+                        reason=f"recovery planning failed: "
+                               f"{type(e).__name__}: {e}"[:300],
+                    )
+                    return
+                if plan is None:
+                    return
+                cfg, execs = plan
+                recoveries += 1
+        finally:
+            with self._lock:
+                self._jobs[config.job_id] = outer
+            if not outer.future.done():
+                outer.future.set_exception(
+                    last_exc if last_exc is not None else RuntimeError(
+                        "elastic dispatch ended without a result")
+                )
+            # the submission is over either way: release this process's
+            # retained recovery blocks (private tables are namespaced by
+            # job id; follower processes rely on the cache's LRU cap)
+            from harmony_tpu.checkpoint import manager as _chkp_mgr
+
+            _chkp_mgr.drop_recovery_cache(prefix=f"{config.job_id}:")
+
+    def _plan_elastic_recovery(
+        self,
+        config: JobConfig,
+        prev_execs: List[str],
+        prev_attempt: int,
+        kind: str,
+        original_execs: List[str],
+        events: List[Dict[str, Any]],
+    ) -> "Optional[Tuple[JobConfig, List[str]]]":
+        """Compute the next attempt: rehabilitate survivors confined only
+        transitively, re-acquire executors (survivors preferred for
+        shrink, the original layout for re-grow), verify a committed
+        chain exists, and mint the recovery config. None = no viable
+        recovery (an event records why; the submission then fails with
+        the attempt's error)."""
+        from harmony_tpu import faults
+        from harmony_tpu.checkpoint.manager import CheckpointManager
+
+        jlog = job_logger(config.job_id)
+        if faults.armed():
+            faults.site("pod.shrink_plan" if kind == "shrink"
+                        else "pod.regrow",
+                        job=config.job_id, attempt=prev_attempt)
+        # Rehabilitation: a process confined only TRANSITIVELY (it shared
+        # this job with the dead/silent one) that nonetheless REPORTED —
+        # proof its threads left the collectives — and still heartbeats
+        # is a survivor, not a casualty.
+        reports = self.pod_reports.get(config.job_id, {})
+        now = time.monotonic()
+        rehab: List[int] = []
+        with self._pod_cond:
+            for pid, rep in reports.items():
+                if (pid in self._unusable_procs
+                        and pid not in self._dead_followers
+                        and pid not in self._silenced
+                        and not rep.get("infra")
+                        and now - self._last_seen.get(pid, 0.0)
+                        <= self.hb_timeout):
+                    self._unusable_procs.discard(pid)
+                    rehab.append(pid)
+        for pid in rehab:
+            restored = self._proc_executors(pid)
+            if restored:
+                self._scheduler.restore(restored)
+            self._record_pod_event("follower_rehabilitated",
+                                   job_id=config.job_id, pid=pid,
+                                   reason="reported for the failed attempt")
+        with self._pod_cond:
+            unusable = set(self._unusable_procs)
+
+        def proc(e: str) -> int:
+            return self.master.executor(e).device.process_index
+
+        base = original_execs if kind == "regrow" else prev_execs
+        preferred = [e for e in base if proc(e) not in unusable]
+        granted = [
+            e for e in self._scheduler.reacquire(config.job_id, preferred)
+            if proc(e) not in unusable
+        ]
+        if not granted:
+            self._elastic_give_up(jlog, config.job_id,
+                                  reason="no usable executors to recover on")
+            return None
+        mgr = CheckpointManager.for_job(self._chkp_root, config.job_id)
+        chain_prefix = f"{config.job_id}:"
+        if not any(c.startswith(chain_prefix)
+                   for c in mgr.list_checkpoints()):
+            self._elastic_give_up(jlog, config.job_id,
+                                  reason="no committed chain checkpoints yet")
+            return None
+        lost = [e for e in prev_execs if e not in granted]
+        new_cfg = ConfigBase.from_dict(config.to_dict())
+        new_cfg.user["elastic_recovery"] = {
+            "attempt": prev_attempt + 1,
+            "kind": kind,
+            "lost_executors": lost,
+        }
+        ev = jlog.event(
+            f"elastic_{kind}",
+            attempt=prev_attempt + 1,
+            executors=list(granted),
+            lost_executors=lost,
+            procs=sorted({proc(e) for e in granted}),
+        )
+        events.append(dict(ev))
+        with self._pod_cond:
+            self.elastic_events.append(dict(ev, job_id=config.job_id))
+            del self.elastic_events[:-256]
+        if self._dashboard is not None:
+            self._dashboard.post(config.job_id, "recovery", dict(ev))
+        return new_cfg, granted
+
+    def _dispatch_once(self, config: JobConfig,
+                       executor_ids: List[str]) -> None:
         jlog = job_logger(config.job_id)
         procs = frozenset(
             self.master.executor(e).device.process_index for e in executor_ids
@@ -605,10 +1162,21 @@ class PodJobServer(JobServer):
             )
             return
         t0 = time.monotonic()
+        # Attempt key: identical to job_id for ordinary jobs; elastic
+        # recovery attempts get a suffixed identity so reports, unit
+        # messages and heartbeat listings from a superseded attempt can
+        # never be misattributed to the live one (jobserver/elastic.py).
+        att = _elastic.attempt_of(config)
+        rkey = _elastic.attempt_key(config.job_id, att)
         if pod_ordered:
             # the arbiter must know the job BEFORE any participant's first
-            # TU_WAIT can arrive (i.e. before RUN_JOB is sent)
-            self.pod_units.register_job(config.job_id, procs)
+            # TU_WAIT can arrive (i.e. before RUN_JOB is sent); recovery
+            # attempts inherit their predecessor's fair-share deficit
+            self.pod_units.register_job(
+                rkey, procs,
+                inherit_from=(_elastic.attempt_key(config.job_id, att - 1)
+                              if att > 0 else None),
+            )
         try:
             participants = sorted(p for p in procs if p != 0)
             run_local = 0 in procs
@@ -628,6 +1196,10 @@ class PodJobServer(JobServer):
                     "conf": config.to_dict(),
                     "executor_ids": list(executor_ids),
                     "chief_pid": min(procs),
+                    # elastic attempt index (0 for ordinary jobs): keys
+                    # the follower's entity registry, unit client and
+                    # JOB_DONE routing per attempt
+                    "att": att,
                     # Participate in the cross-job unit protocol (share-all
                     # overlap safety — runtime/podunits.py).
                     "pod_ordered": pod_ordered,
@@ -659,9 +1231,9 @@ class PodJobServer(JobServer):
             else:
                 # The leader holds none of this job's devices: the chief
                 # participant's report is the job result.
-                self._resolve_remote(config, participants)
+                self._resolve_remote(config, participants, rkey)
             if participants:
-                reports = self._collect_reports(config.job_id, participants)
+                reports = self._collect_reports(rkey, participants)
                 # Give-up escalation: a follower that FAILED the job on an
                 # exhausted-retry infra error (transport/storage — its
                 # report carries infra_suspect, the follower itself is
@@ -678,15 +1250,29 @@ class PodJobServer(JobServer):
                 if dead:
                     # death-driven: confine the damage (idempotent with
                     # the reader-EOF path) and poison PARTIALLY so
-                    # unaffected jobs and auto-resumes keep running
+                    # unaffected jobs and auto-resumes keep running.
+                    # For ELASTIC jobs only, SILENCED pids are excluded
+                    # from the wedge marking: the monitor already
+                    # confined them, their socket is intact, and wedging
+                    # co-participants would retire the very survivors
+                    # the shrink recovers on (the capped recovery loop
+                    # fails loudly if they turn out wedged after all).
+                    # Non-elastic jobs keep the conservative stance — a
+                    # silence that is really a FIN-less host death leaves
+                    # peers stuck in its collectives.
+                    elastic = bool(config.user.get("elastic_shrink"))
                     with self._pod_cond:
                         self._record_infra_failed_locked(config.job_id)
-                    for pid in dead:
+                        hard_dead = [p for p in dead
+                                     if not elastic
+                                     or p not in self._silenced]
+                    for pid in hard_dead:
                         self._on_follower_death(pid)
-                    self._mark_broken(
-                        f"follower(s) {dead} never reported for "
-                        f"{config.job_id}", scope="partial",
-                    )
+                    if hard_dead:
+                        self._mark_broken(
+                            f"follower(s) {hard_dead} never reported for "
+                            f"{config.job_id}", scope="partial",
+                        )
                 with self._pod_cond:  # concurrent dispatch threads trim too
                     self.pod_reports[config.job_id] = reports
                     while len(self.pod_reports) > 256:  # bound leader memory
@@ -702,7 +1288,7 @@ class PodJobServer(JobServer):
                 # after report collection: every participant's TU_DONEs
                 # precede its JOB_DONE on the same socket, so nothing of
                 # this job is still in flight at the arbiter
-                self.pod_units.deregister_job(config.job_id)
+                self.pod_units.deregister_job(rkey)
             with self._pod_cond:
                 # deregister so schedule_pod_reshard on a finished job
                 # raises KeyError instead of accreting stale plans
@@ -712,7 +1298,6 @@ class PodJobServer(JobServer):
                     self.job_walls.pop(next(iter(self.job_walls)))
                 self._active_procs.pop(config.job_id, None)
                 self._pod_cond.notify_all()
-        self._maybe_auto_resume(config, executor_ids)
 
     def _maybe_auto_resume(self, config: JobConfig,
                            executor_ids: List[str]) -> None:
@@ -869,7 +1454,8 @@ class PodJobServer(JobServer):
                 # Leader-local leg of the cross-job unit protocol: the
                 # entity wraps every global-dispatch region in a unit so
                 # overlapping tenants enqueue in the arbiter's one order.
-                client = leader_client(self.pod_units, config.job_id)
+                client = leader_client(self.pod_units,
+                                       _elastic.config_attempt_key(config))
                 extras["pod_unit_scope"] = client.scope
                 extras["pod_unit_contended"] = client.contended
             # The collective deferred eval runs at SHUTDOWN on one thread
@@ -982,7 +1568,8 @@ class PodJobServer(JobServer):
                 self._reports.pop((f"__evalc__{job_id}", pid), None)
             self._eval_participants.pop(job_id, None)
 
-    def _resolve_remote(self, config: JobConfig, participants: List[int]) -> None:
+    def _resolve_remote(self, config: JobConfig, participants: List[int],
+                        rkey: Optional[str] = None) -> None:
         """Leader-side completion for a job running wholly on followers:
         the lowest participating pid is the job chief; its JOB_DONE carries
         the sanitized result that resolves the leader's future (mirroring
@@ -991,9 +1578,10 @@ class PodJobServer(JobServer):
         jr = self._jobs[config.job_id]
         jlog = job_logger(config.job_id)
         chief = min(participants)
+        key = rkey or config.job_id
         t0 = time.monotonic()
         try:
-            rep = self._wait_report_live(config.job_id, chief)
+            rep = self._wait_report_live(key, chief)
             if rep is None:
                 with self._pod_cond:  # infra-observed: resume-eligible
                     self._record_infra_failed_locked(config.job_id)
@@ -1009,10 +1597,15 @@ class PodJobServer(JobServer):
                     # flags; this covers the chief-only result path)
                     with self._pod_cond:
                         self._record_infra_failed_locked(config.job_id)
-                raise RuntimeError(
+                err = RuntimeError(
                     f"remote job failed on follower {chief}: "
                     f"{rep.get('error', 'unknown error')}"
                 )
+                if rep.get("elastic_fence"):
+                    # the chief hit a planned elastic fence, not a bug:
+                    # carry the marker so the elastic loop classifies it
+                    err.elastic_fence = str(rep["elastic_fence"])
+                raise err
             result = rep.get("result") or {
                 "job_id": config.job_id, "workers": rep.get("workers", {})
             }
@@ -1035,6 +1628,7 @@ class PodJobServer(JobServer):
         _dispatch threads are still reading JOB_DONEs; wait out the
         active set so socket teardown follows those collections."""
         deadline = time.monotonic() + 30.0
+        self._monitor_stop.set()
         with self._pod_cond:
             self._pod_cond.wait_for(
                 lambda: not self._active_procs,
@@ -1219,10 +1813,20 @@ class PodFollower:
                 podplan.schedule(msg["job_id"], msg["plan"])
                 continue
             if msg.get("cmd") == "PROGRESS_REQ":
-                # the leader's observed-epoch-floor query for plan
-                # validation (schedule_pod_reshard on remote-only jobs)
+                # the leader's observed-epoch-floor query for plan/fence
+                # validation (schedule_pod_reshard / elastic fences on
+                # remote-only jobs). The query may arrive keyed by the
+                # job id OR an elastic attempt key (jobserver/elastic):
+                # entities register under attempt keys, so fall back to
+                # the newest attempt of the requested job.
                 jid = str(msg.get("job_id"))
                 ent = self._entities.get(jid)
+                if ent is None:
+                    base = jid.split("@a", 1)[0]
+                    for k in sorted(self._entities, reverse=True):
+                        if k == base or k.startswith(base + "@a"):
+                            ent = self._entities[k]
+                            break
                 ep = 0
                 if ent is not None and getattr(ent, "progress", None) is not None:
                     ep = ent.progress.starting_epoch()
@@ -1310,14 +1914,19 @@ class PodFollower:
             while len(self._job_confs) > 1024:
                 self._job_confs.pop(next(iter(self._job_confs)))
         chief = int(msg.get("chief_pid", 0)) == self.pid
+        # elastic attempt key: report routing, the entity registry and
+        # the unit protocol are all attempt-scoped so a superseded
+        # attempt's stragglers can never be misattributed to a live one
+        rkey = _elastic.attempt_key(config.job_id,
+                                    int(msg.get("att", 0) or 0))
         report: Dict[str, Any] = {
-            "cmd": "JOB_DONE", "pid": self.pid, "job_id": config.job_id,
+            "cmd": "JOB_DONE", "pid": self.pid, "job_id": rkey,
         }
         unit_extras: Dict[str, Any] = {}
         if msg.get("pod_ordered"):
             # this process's leg of the cross-job unit protocol (the
             # leader's arbiter orders overlapping tenants' dispatches)
-            client = follower_client(self._pod_units, config.job_id)
+            client = follower_client(self._pod_units, rkey)
             unit_extras = {"pod_unit_scope": client.scope,
                            "pod_unit_contended": client.contended}
         entity = None
@@ -1341,7 +1950,7 @@ class PodFollower:
                 chkp_root=msg.get("chkp_root"),
                 **unit_extras,
             )
-            self._entities[config.job_id] = entity
+            self._entities[rkey] = entity
             entity.setup(self.master, executor_ids)
             result = entity.run()
             if chief:
@@ -1356,7 +1965,14 @@ class PodFollower:
             entity.cleanup()
             report["ok"] = True
             report["workers"] = {
-                wid: {"losses": [float(x) for x in w.get("losses", [])]}
+                wid: {
+                    "losses": [float(x) for x in w.get("losses", [])],
+                    # exactly-once evidence for elastic recovery tests:
+                    # attempts' epoch ranges must tile [0, num_epochs)
+                    "starting_epoch": int(w.get("starting_epoch", 0)),
+                    "epochs_run": int(w.get("epochs_run",
+                                            len(w.get("losses", [])))),
+                }
                 for wid, w in result.get("workers", {}).items()
             }
             if chief:
@@ -1374,12 +1990,17 @@ class PodFollower:
                     pass
             report["ok"] = False
             report["error"] = f"{type(e).__name__}: {e}"
+            if getattr(e, "elastic_fence", None):
+                # a planned elastic fence, not a failure of the job's
+                # own logic: the leader's elastic loop classifies on
+                # this marker and continues the SAME submission
+                report["elastic_fence"] = str(e.elastic_fence)
             if getattr(e, "infra_suspect", False):
                 # a bounded-retry give-up (transport/storage/helper died
                 # — faults.retry.InfraTransientError): tell the leader
                 # this failure is INFRA-shaped so auto_resume jobs are
                 # eligible to resubmit, exactly like a follower death
                 report["infra_suspect"] = True
-        self._entities.pop(config.job_id, None)
-        self._pod_units.forget(config.job_id)
+        self._entities.pop(rkey, None)
+        self._pod_units.forget(rkey)
         self._report(report)

@@ -39,11 +39,30 @@ class JobScheduler:
         self._executors = list(executor_ids)
 
     def retire(self, executor_ids: List[str]) -> None:
-        """Permanently remove executors from future grants (a pod follower
-        died; its devices can never serve again this session). Running
-        grants are untouched — their jobs fail through their own paths."""
+        """Remove executors from future grants (a pod follower died or
+        went silent; its devices cannot serve while it is gone). Running
+        grants are untouched — their jobs fail through their own paths.
+        No longer permanent: :meth:`restore` reverses it when a silenced
+        follower's heartbeats resume or a replacement process JOINs."""
         gone = set(executor_ids)
         self._executors = [e for e in self._executors if e not in gone]
+
+    def restore(self, executor_ids: List[str]) -> None:
+        """Re-admit previously retired executors (elastic rehabilitation:
+        a confined follower proved itself alive again, or a replacement
+        JOINed with the same executor allocation order)."""
+        known = set(self._executors)
+        self._executors.extend(e for e in executor_ids if e not in known)
+
+    def reacquire(self, job_id: str, preferred: List[str]) -> List[str]:
+        """Elastic in-place recovery grant: the SAME submission needs
+        executors for its next attempt, preferring the previous grant's
+        survivors (minimal data movement). Returns the granted executor
+        ids ([] = nothing available; recovery fails over to a plain job
+        failure). Default (share-all semantics): the surviving preferred
+        set, else every live executor."""
+        alive = [e for e in preferred if e in self._executors]
+        return alive or list(self._executors)
 
 
 class ShareAllScheduler(JobScheduler):
@@ -120,6 +139,39 @@ class CarveScheduler(JobScheduler):
         with self._lock:
             super().retire(executor_ids)
             self._free = [e for e in self._free if e not in gone]
+
+    def restore(self, executor_ids: List[str]) -> None:
+        """Rehabilitated executors rejoin the free pool (and may unblock
+        queued arrivals) unless some job's live slice already claims
+        them."""
+        with self._lock:
+            super().restore(executor_ids)
+            sliced = {e for sl in self._slices.values() for e in sl}
+            self._free.extend(
+                e for e in executor_ids
+                if e not in sliced and e not in self._free
+            )
+            launches = self._drain_queue_locked()
+        for cfg, sl in launches:
+            self._launch(cfg, sl)
+
+    def reacquire(self, job_id: str, preferred: List[str]) -> List[str]:
+        """In-place recovery grant: take the still-free survivors of the
+        previous grant; if none survive, carve a fresh slice. The grant
+        registers under ``job_id`` so the attempt's on_job_finish returns
+        it like any slice (each attempt pairs one reacquire with one
+        finish)."""
+        with self._lock:
+            free = set(self._free)
+            take = [e for e in preferred if e in free]
+            if not take:
+                take = self._take_slice() or []
+            else:
+                taken = set(take)
+                self._free = [e for e in self._free if e not in taken]
+            if take:
+                self._slices[job_id] = take
+        return take
 
     def _take_slice(self) -> Optional[List[str]]:
         """Under the lock: carve the next job's slice or None to queue."""
@@ -222,6 +274,35 @@ class ProcessCarveScheduler(CarveScheduler):
         """executor id -> process index (from Executor.device.process_index)."""
         with self._lock:
             self._proc_of = dict(proc_of)
+
+    def reacquire(self, job_id: str, preferred: List[str]) -> List[str]:
+        """Whole-process recovery grant: survivors are kept only as
+        COMPLETE free processes (a partial process in a recovery grant
+        would break the disjoint-process concurrency guarantee every
+        carved tenant relies on); otherwise a fresh whole-process slice
+        is carved."""
+        with self._lock:
+            free = set(self._free)
+            wanted = set(preferred)
+            members: Dict[int, List[str]] = {}
+            for e in self._executors:
+                members.setdefault(self._proc_of.get(e, 0), []).append(e)
+            take = [
+                e for p, mem in sorted(members.items())
+                # the WHOLE process must be both preferred and free — a
+                # half-claimed process is exactly the shape the carve
+                # exists to forbid
+                if mem and wanted >= set(mem) and free >= set(mem)
+                for e in mem
+            ]
+            if not take:
+                take = self._take_slice() or []
+            else:
+                taken = set(take)
+                self._free = [e for e in self._free if e not in taken]
+            if take:
+                self._slices[job_id] = take
+        return take
 
     def _take_slice(self) -> Optional[List[str]]:
         """Under the lock: carve whole free processes or None to queue."""

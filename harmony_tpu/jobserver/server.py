@@ -337,11 +337,19 @@ class JobServer:
 
     def _status(self) -> Dict[str, Any]:
         """STATUS reply body (subclasses extend, e.g. pod health)."""
+        from harmony_tpu.jobserver import joblog
+
         return {
             "ok": True,
             "state": self.state,
             "running": self.running_jobs(),
             "evaluated": sorted(self.eval_results),
+            # recovery observability: fault-injection fires + transport/
+            # checkpoint retry counters + isolated-worker respawns for
+            # THIS process, and the structured per-job recovery events
+            # (shrink/re-grow/confinement/rehabilitation)
+            "fault_counters": self.metrics.fault_counters(),
+            "job_events": joblog.job_events(),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------

@@ -114,8 +114,13 @@ class MetricManager:
         transient infra trouble before it becomes a giveup, and
         ``*.giveups`` feeding the pod's infra-dead/auto-resume path."""
         from harmony_tpu import faults
+        from harmony_tpu.checkpoint import backends
 
-        return faults.all_counters()
+        out = faults.all_counters()
+        respawns = backends.iso_respawn_total()
+        if respawns:
+            out["chkp.iso.respawns"] = respawns
+        return out
 
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star

@@ -131,24 +131,41 @@ class PodUnitArbiter:
         # pod STATUS surface for benchmarks/podunits.py)
         self.grants_total = 0
         self.grant_to_done_s = 0.0
+        # final deficits of recently deregistered jobs (bounded): an
+        # elastic recovery attempt re-registers under a fresh key and
+        # INHERITS its predecessor's accumulated share, so a recovered
+        # tenant re-enters the fair queue where it left rather than
+        # resetting to the lowest active deficit on every attempt
+        self._legacy_deficit: Dict[str, float] = {}
 
     # -- registry ---------------------------------------------------------
 
-    def register_job(self, job_id: str, procs: "frozenset[int]") -> None:
+    def register_job(self, job_id: str, procs: "frozenset[int]",
+                     inherit_from: Optional[str] = None) -> None:
         with self._cond:
             # WFQ virtual-time start: a late arrival begins at the lowest
-            # active deficit so it cannot monopolize grants "catching up"
+            # active deficit so it cannot monopolize grants "catching up";
+            # an elastic recovery attempt instead inherits its superseded
+            # attempt's accumulated deficit (never below the late-arrival
+            # floor — inheritance must not grant a priority boost either)
             active = [s.deficit for s in self._jobs.values()]
+            start = min(active) if active else 0.0
+            if inherit_from is not None:
+                start = max(start, self._legacy_deficit.get(inherit_from,
+                                                            start))
             self._jobs[job_id] = _JobState(
-                frozenset(procs), min(active) if active else 0.0,
-                next(self._arrival),
+                frozenset(procs), start, next(self._arrival),
             )
 
     def deregister_job(self, job_id: str) -> None:
         """Job over (or failed): its outstanding units will never DONE —
         force-release them so peers unblock, and drop pending waits."""
         with self._cond:
-            if self._jobs.pop(job_id, None) is not None:
+            st = self._jobs.pop(job_id, None)
+            if st is not None:
+                self._legacy_deficit[job_id] = st.deficit
+                while len(self._legacy_deficit) > 256:
+                    self._legacy_deficit.pop(next(iter(self._legacy_deficit)))
                 self._maybe_grant_locked()
                 self._cond.notify_all()
 

@@ -694,6 +694,13 @@ def migrate_blocks(arr: jax.Array, old_mesh: Mesh,
         )
 
     mode = _transport_mode()
+    if faults.armed():
+        # the between-plan-and-exchange site: a participant crashing HERE
+        # (after every process computed the identical plan, before any
+        # byte moved) is the chaos case VERDICT weak #6 left untested —
+        # peers must end with intact tables and a loud transport error
+        # bounded by HARMONY_POD_MOVE_TIMEOUT, never a hang
+        faults.site("blockmove.exchange", seq=seq, mode=mode)
     if plan.total_moves == 0:
         received, sent_bytes = {}, 0
     elif mode == "tcp":

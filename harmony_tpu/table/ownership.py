@@ -130,3 +130,46 @@ class BlockManager:
             self._executors = list(executors)
             self._owner = [b % len(executors) for b in range(self.num_blocks)]
             self._notify_locked()
+
+
+# -- shrink-plan helpers (elastic recovery) -------------------------------
+#
+# Pure functions over a CHECKPOINTED ownership vector (the manifest's
+# block->executor-index map): when a follower is lost, the elastic
+# recovery path needs to know (a) which blocks died with it — the set
+# the partial restore must read back from the durable checkpoint — and
+# (b) which survivor absorbs each of them in the rebuilt layout, for the
+# recovery event log. Deterministic on every process by construction
+# (both inputs are global metadata), like blockmove.plan_moves.
+
+
+def lost_blocks(ownership: Sequence[int], executors: Sequence[str],
+                lost_executors: Sequence[str]) -> List[int]:
+    """Blocks owned by ``lost_executors`` in a checkpointed ownership
+    vector — the O(lost) set a shrink recovery restores from durable
+    storage (everything else lives on in survivors' recovery caches)."""
+    gone = {executors.index(e) for e in lost_executors if e in executors}
+    return [b for b, o in enumerate(ownership) if o in gone]
+
+
+def shrink_plan(
+    ownership: Sequence[int],
+    executors: Sequence[str],
+    lost_executors: Sequence[str],
+    survivors: Sequence[str],
+) -> Dict[str, object]:
+    """The shrink remap summary: lost blocks round-robined over
+    ``survivors`` (each survivor's absorbed share differs by at most one
+    block — the dead follower's batch/storage share spreads evenly).
+    Returns ``{"lost": [...], "absorbed": {survivor: [...]}}``; the
+    physical layout the restored table actually uses is the even mesh
+    partition over survivors, so this plan is the ACCOUNTING view the
+    recovery event log and tests assert against."""
+    if not survivors:
+        raise ValueError("shrink plan needs at least one survivor")
+    lost = lost_blocks(ownership, executors, lost_executors)
+    absorbed: Dict[str, List[int]] = {s: [] for s in survivors}
+    order = list(survivors)
+    for i, b in enumerate(lost):
+        absorbed[order[i % len(order)]].append(b)
+    return {"lost": lost, "absorbed": absorbed}

@@ -649,6 +649,33 @@ class DenseTable(LayoutAnnouncerMixin):
                 # under its old key; don't let it squat in the LRU.
                 progcache.drop(lambda k: k == (old_sig, "table_init"))
 
+    def install_array(self, arr: jax.Array) -> None:
+        """Replace the table's storage with a pre-assembled global array
+        on the CURRENT sharding (the elastic partial-restore path: each
+        process builds its addressable shards from cached + checkpoint
+        blocks and installs the jointly-constructed array — on a
+        multi-process mesh no single process could materialize the whole
+        payload that import_blocks' replicated-argument path needs)."""
+        with self._lock:
+            if arr.shape != self._arr.shape:
+                raise ValueError(
+                    f"install_array shape {arr.shape} != table "
+                    f"{self._arr.shape}")
+            if arr.sharding != self._sharding:
+                raise ValueError(
+                    "install_array: array sharding does not match the "
+                    "table's current sharding")
+            if arr.dtype != self._arr.dtype:
+                raise ValueError(
+                    f"install_array dtype {arr.dtype} != table "
+                    f"{self._arr.dtype}")
+            old, self._arr = self._arr, arr
+            if old is not arr:  # same-sharding device_put may alias
+                try:
+                    old.delete()
+                except RuntimeError:
+                    pass  # already donated/deleted
+
     # -- per-block IO (checkpoint path) ----------------------------------
 
     def snapshot_blocks(

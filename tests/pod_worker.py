@@ -67,9 +67,15 @@ def main() -> None:
             try:
                 res = jr.future.result(timeout=0)
                 local[job_id] = {
-                    wid: {"losses": [float(x) for x in w.get("losses", [])]}
+                    wid: {"losses": [float(x) for x in w.get("losses", [])],
+                          "starting_epoch": int(w.get("starting_epoch", 0)),
+                          "epochs_run": int(w.get("epochs_run",
+                                                  len(w.get("losses", []))))}
                     for wid, w in res.get("workers", {}).items()
                 }
+                for k in ("elastic", "elastic_restore"):
+                    if k in res:
+                        local[job_id][k] = res[k]
                 if "model_chkp_ids" in res:
                     local[job_id]["model_chkp_ids"] = res["model_chkp_ids"]
                 if "applied_plans" in res:
@@ -89,12 +95,18 @@ def main() -> None:
                         ]
             except Exception as e:  # noqa: BLE001 - reported in RESULT
                 local[job_id] = {"error": f"{type(e).__name__}: {e}"}
+        from harmony_tpu.jobserver import joblog
+
         print("RESULT " + json.dumps({
             "pid": 0,
+            "job_events": joblog.job_events(),
             "local_results": local,
             "pod_reports": server.pod_reports,
             "job_walls": server.job_walls,
             "eval_results": server.eval_results,
+            "elastic_events": server.elastic_events,
+            "reinstated": server.reinstated,
+            "auto_resumed": server.auto_resumed,
         }), flush=True)
     else:
         from harmony_tpu.jobserver.pod import PodFollower

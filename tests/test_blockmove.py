@@ -250,6 +250,154 @@ def test_tcp_receiver_preserves_dtype_and_shape(dtype_name):
         rx.close()
 
 
+# -- participant death mid-migration (VERDICT weak #6 residual) -----------
+#
+# PR 2 chaos-tested the TRANSPORT legs (connection resets, retried
+# resends). The residual gap: a migration PARTICIPANT dying between the
+# move plan and the ownership flip — peers must end with an intact table
+# and a loud MigrationTransportError bounded well under
+# HARMONY_POD_MOVE_TIMEOUT, never a hang or a torn shard.
+
+
+@pytest.fixture()
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("HARMONY_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("HARMONY_RETRY_MAX_DELAY", "0.002")
+    monkeypatch.setenv("HARMONY_POD_MOVE_TIMEOUT", "20")
+    yield
+    from harmony_tpu import faults
+
+    faults.disarm()
+
+
+def test_tcp_sender_death_mid_frame_fails_promptly(monkeypatch):
+    """A sender that dies MID-FRAME (partial header/payload then FIN —
+    exactly what a SIGKILL'd participant's kernel emits) must surface as
+    an error after the resend grace, not stall the receiver for the
+    whole move timeout."""
+    import socket
+    import time as _time
+
+    from harmony_tpu.table.blockmove import _TcpReceiver, _send_frame
+
+    monkeypatch.setattr(_TcpReceiver, "ERR_GRACE", 0.4)
+    rx = _TcpReceiver({1, 2})
+    try:
+        with socket.create_connection(("127.0.0.1", rx.port)) as s:
+            _send_frame(s, 1, np.ones((2, 3), np.float32))  # block 1 lands
+            # block 2's frame dies mid-payload: header promises 24 bytes,
+            # the process is killed after 4
+            import json as _json
+            import struct as _struct
+
+            hdr = _json.dumps({"b": 2, "dtype": "<f4", "shape": [2, 3],
+                               "n": 24}).encode()
+            s.sendall(_struct.pack("<I", len(hdr)) + hdr + b"\x00" * 4)
+        t0 = _time.monotonic()
+        with pytest.raises(OSError, match="truncated block 2"):
+            rx.wait(_time.monotonic() + 20)
+        took = _time.monotonic() - t0
+        assert took < 5, f"waited {took:.1f}s — grace did not bound the wait"
+        # the complete frame that landed before the death stayed valid
+        np.testing.assert_array_equal(rx.blocks[1], np.ones((2, 3),
+                                                            np.float32))
+    finally:
+        rx.close()
+
+
+def test_file_exchange_source_death_before_publish(tmp_path, monkeypatch,
+                                                   _fast_retries):
+    """Source participant killed between computing the move plan and
+    publishing its block (fault site blockmove.stage_write, persistent):
+    the exchange must raise MigrationTransportError promptly — bounded
+    by the retry policy, far under HARMONY_POD_MOVE_TIMEOUT — and clean
+    its staging; the caller's table bytes were never touched."""
+    import time as _time
+
+    from jax.sharding import Mesh
+
+    from harmony_tpu import faults
+    from harmony_tpu.table.blockmove import (
+        MigrationTransportError,
+        MovePlan,
+        _file_exchange,
+    )
+
+    monkeypatch.setenv("HARMONY_POD_STAGE_ROOT", str(tmp_path))
+    faults.arm(faults.FaultPlan([faults.FaultRule(
+        "blockmove.stage_write", count=-1, exc="OSError",
+        message="participant killed before publish",
+    )]))
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("model",))
+    payload = np.full((4, 2), 7.0, np.float32)
+    plan = MovePlan(sends={0: [(3, 0)]}, recvs={0: {3}},
+                    block_nbytes=payload.nbytes)
+    t0 = _time.monotonic()
+    with pytest.raises(MigrationTransportError, match="staging block 3"):
+        _file_exchange(plan, {3: payload}, 991, mesh, mesh)
+    assert _time.monotonic() - t0 < 10  # never the full move timeout
+    # the source payload (the caller's host copy of live table bytes)
+    # is untouched, and no torn staging survives for a later reader
+    np.testing.assert_array_equal(payload, 7.0)
+    assert not [p for p in tmp_path.iterdir()
+                if p.name.startswith("harmony-move-991")]
+
+
+def test_file_exchange_receiver_sees_dead_source_as_transport_error(
+        tmp_path, monkeypatch, _fast_retries):
+    """Receiver side of the same death: the planned block never appears
+    (its owner died pre-publish on another host, so no fence fired
+    here); bounded read retries give MigrationTransportError naming the
+    block — a diagnosis, not a hang."""
+    import time as _time
+
+    from jax.sharding import Mesh
+
+    from harmony_tpu.table.blockmove import (
+        MigrationTransportError,
+        MovePlan,
+        _file_exchange,
+    )
+
+    monkeypatch.setenv("HARMONY_POD_STAGE_ROOT", str(tmp_path))
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("model",))
+    plan = MovePlan(sends={}, recvs={0: {5}}, block_nbytes=8)
+    t0 = _time.monotonic()
+    with pytest.raises(MigrationTransportError, match="block 5"):
+        _file_exchange(plan, {}, 992, mesh, mesh)
+    assert _time.monotonic() - t0 < 10
+
+
+def test_exchange_site_injected_crash_is_contained(monkeypatch,
+                                                   _fast_retries):
+    """The blockmove.exchange site (post-plan, pre-transport) exists so
+    pod chaos tests can kill a REAL participant at exactly the
+    between-plan-and-flip point; in-process, a raise there must leave
+    the caller's array untouched (migrate_blocks raises before any
+    mutation — ownership flips only around the whole exchange)."""
+    from harmony_tpu import faults
+    from harmony_tpu.table.blockmove import migrate_blocks
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    faults.arm(faults.FaultPlan([faults.FaultRule(
+        "blockmove.exchange", count=1, exc="RuntimeError",
+        message="participant killed at the exchange",
+    )]))
+    devs = jax.devices()
+    old_mesh = Mesh(np.array(devs[:4]), ("model",))
+    new_mesh = Mesh(np.array(devs[4:8]), ("model",))
+    arr = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    arr = jax.device_put(arr, NamedSharding(old_mesh, P("model")))
+    before = np.asarray(arr).copy()
+    with pytest.raises(RuntimeError, match="killed at the exchange"):
+        migrate_blocks(arr, old_mesh, NamedSharding(new_mesh, P("model")))
+    np.testing.assert_array_equal(np.asarray(arr), before)  # intact
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_migrate_blocks_to_replicated_layout():
     devs = jax.devices()

@@ -1,0 +1,560 @@
+"""Elastic shrink-to-survivors recovery — the fast tier.
+
+Covers every layer of the in-place recovery path without spawning pod
+processes: the shrink-plan helpers (table/ownership.py), the partial
+restore + recovery cache + read accounting (checkpoint/manager.py), the
+leader's elastic dispatch loop end-to-end in-process (fence -> same
+submission recovers -> loss parity), the silence-confine/rehabilitate
+monitor and replacement-JOIN reinstatement against fake follower
+sockets, the scheduler's reacquire/restore surface, and recovery chaos
+at the new fault sites. Real multi-process pods: tests/test_elastic_pod.py
+(slow tier)."""
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import faults
+from harmony_tpu.checkpoint import manager as chkp_manager
+from harmony_tpu.checkpoint.manager import CheckpointManager
+from harmony_tpu.config.params import JobConfig, TableConfig, TrainerParams
+from harmony_tpu.jobserver import elastic
+from harmony_tpu.jobserver.elastic import ElasticFence
+from harmony_tpu.parallel import DevicePool
+from harmony_tpu.runtime import ETMaster
+from harmony_tpu.table import ownership
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    chkp_manager.reset_read_stats()
+    chkp_manager.drop_recovery_cache()
+    yield
+    faults.disarm()
+    chkp_manager.drop_recovery_cache()
+
+
+# -- ownership shrink plans ----------------------------------------------
+
+
+class TestShrinkPlan:
+    def test_lost_blocks_from_manifest_vector(self):
+        # 8 blocks round-robined over a,b,c; c dies
+        own = [i % 3 for i in range(8)]
+        execs = ["a", "b", "c"]
+        assert ownership.lost_blocks(own, execs, ["c"]) == [2, 5]
+        assert ownership.lost_blocks(own, execs, ["a", "c"]) == [0, 2, 3, 5, 6]
+        assert ownership.lost_blocks(own, execs, ["zz"]) == []
+
+    def test_shrink_plan_spreads_lost_evenly(self):
+        own = [i % 4 for i in range(16)]
+        execs = ["a", "b", "c", "d"]
+        plan = ownership.shrink_plan(own, execs, ["d"], ["a", "b", "c"])
+        assert plan["lost"] == [3, 7, 11, 15]
+        sizes = sorted(len(v) for v in plan["absorbed"].values())
+        assert sizes == [1, 1, 2]  # differs by at most one block
+        assert sorted(b for v in plan["absorbed"].values() for b in v) == \
+            plan["lost"]
+
+    def test_shrink_plan_needs_a_survivor(self):
+        with pytest.raises(ValueError, match="survivor"):
+            ownership.shrink_plan([0], ["a"], ["a"], [])
+
+
+# -- partial restore + recovery cache ------------------------------------
+
+
+def _make_handle(master, tid, capacity=64, vshape=(2,), n_exec=4):
+    exs = master.add_executors(n_exec)
+    cfg = TableConfig(table_id=tid, capacity=capacity, value_shape=vshape,
+                      num_blocks=16)
+    h = master.create_table(cfg, [e.id for e in exs])
+    vals = np.arange(capacity, dtype=np.float32)[:, None] * np.ones(
+        vshape, np.float32)
+    h.table.multi_update(list(range(capacity)), vals)
+    return h, vals
+
+
+class TestPartialRestore:
+    @pytest.fixture()
+    def master(self, devices):
+        return ETMaster(DevicePool(devices))
+
+    @pytest.fixture()
+    def mgr(self, tmp_path):
+        return CheckpointManager(str(tmp_path / "t"), str(tmp_path / "c"))
+
+    def test_cold_restore_reads_every_block_and_counts(self, mgr, master):
+        h, vals = _make_handle(master, "pr-cold")
+        cid = mgr.checkpoint(h, commit=True)
+        chkp_manager.reset_read_stats()
+        h2, stats = mgr.restore_partial(master, cid,
+                                        master.executor_ids()[:2],
+                                        table_id="pr-cold2")
+        np.testing.assert_allclose(np.asarray(h2.table.pull_array()), vals)
+        assert stats["partial"] == 1
+        assert stats["blocks_read"] == 16 and stats["blocks_local"] == 0
+        assert chkp_manager.read_stats["blocks_read"] == 16
+        assert stats["bytes_read"] == chkp_manager.read_stats["bytes_read"] > 0
+
+    def test_recovery_cache_makes_restore_read_nothing(self, mgr, master):
+        mgr.recovery_retain = True
+        h, vals = _make_handle(master, "pr-warm")
+        cid = mgr.checkpoint(h, commit=True)
+        assert chkp_manager.recovery_blocks(cid) is not None
+        chkp_manager.reset_read_stats()
+        h2, stats = mgr.restore_partial(master, cid,
+                                        master.executor_ids()[:2],
+                                        table_id="pr-warm2")
+        np.testing.assert_allclose(np.asarray(h2.table.pull_array()), vals)
+        assert stats["blocks_read"] == 0 and stats["blocks_local"] == 16
+        assert chkp_manager.read_stats["blocks_read"] == 0
+
+    def test_partial_cache_split_reads_exactly_the_lost_half(self, mgr,
+                                                             master):
+        """The pod shape of the O(lost-bytes) contract: a process whose
+        recovery cache holds only ITS addressable half (what the pod
+        checkpoint stages per process) reads back from storage exactly
+        the other half — the blocks that died with the peer."""
+        h, vals = _make_handle(master, "pr-half")
+        cid = mgr.checkpoint(h, commit=True)
+        mine = {b: np.asarray(h.table.export_blocks([b])[b])
+                for b in range(8)}  # this process staged blocks 0..7
+        chkp_manager._recovery_put("pr-half", cid, mine)
+        chkp_manager.reset_read_stats()
+        h2, stats = mgr.restore_partial(master, cid,
+                                        master.executor_ids()[:2],
+                                        table_id="pr-half2")
+        np.testing.assert_allclose(np.asarray(h2.table.pull_array()), vals)
+        assert stats["blocks_local"] == 8
+        assert stats["blocks_read"] == 8  # exactly the lost half
+        assert chkp_manager.read_stats["blocks_read"] == 8
+
+    def test_stale_cache_entry_is_never_used(self, mgr, master):
+        """The cache keys by EXACT checkpoint id: an older entry of the
+        same table must not leak a stale epoch into a recovery (the
+        consistent-cut guarantee)."""
+        mgr.recovery_retain = True
+        h, _ = _make_handle(master, "pr-stale")
+        cid1 = mgr.checkpoint(h, commit=True)
+        h.table.multi_update([0], np.full((1, 2), 99.0, np.float32))
+        cid2 = mgr.checkpoint(h, commit=True)
+        assert chkp_manager.recovery_blocks(cid1) is None  # superseded
+        assert chkp_manager.recovery_blocks(cid2) is not None
+        chkp_manager.reset_read_stats()
+        h2, stats = mgr.restore_partial(master, cid1,
+                                        master.executor_ids()[:2],
+                                        table_id="pr-stale2")
+        assert stats["blocks_read"] == 16  # cid1 must be re-read in full
+        assert np.asarray(h2.table.pull_array())[0, 0] == 0.0
+
+    def test_partial_restore_verifies_crc(self, mgr, master, tmp_path):
+        import os
+
+        h, _ = _make_handle(master, "pr-crc")
+        cid = mgr.checkpoint(h)  # temp stage: block files live here
+        d = os.path.join(mgr.temp_root, cid)
+        (blk,) = [n for n in sorted(os.listdir(d)) if n.startswith("3.")]
+        path = os.path.join(d, blk)
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+        with pytest.raises(chkp_manager.CheckpointCorruptError):
+            mgr.restore_partial(master, cid, master.executor_ids()[:2],
+                                table_id="pr-crc2")
+        # no half-restored orphan table left behind
+        assert "pr-crc2" not in master.table_ids()
+
+    def test_sparse_falls_back_to_full_restore(self, mgr, master):
+        exs = master.add_executors(2)
+        cfg = TableConfig(table_id="pr-sparse", capacity=64, value_shape=(2,),
+                          num_blocks=4, sparse=True)
+        h = master.create_table(cfg, [e.id for e in exs])
+        h.table.multi_update([3, 9], np.ones((2, 2), np.float32))
+        cid = mgr.checkpoint(h, commit=True)
+        h2, stats = mgr.restore_partial(master, cid,
+                                        [e.id for e in exs][:1],
+                                        table_id="pr-sparse2")
+        assert stats["partial"] == 0
+        np.testing.assert_allclose(
+            np.asarray(h2.table.multi_get([3, 9])), 1.0)
+
+
+# -- the elastic dispatch loop, in-process -------------------------------
+
+
+def _elastic_cfg(job_id, epochs, seed=3, extra_user=None):
+    user = {"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+            "data_args": {"n": 64, "num_features": 16, "num_classes": 4,
+                          "seed": seed},
+            "elastic_shrink": True}
+    user.update(extra_user or {})
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs, num_mini_batches=2, model_chkp_period=1,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.1},
+        ),
+        num_workers=1,
+        user=user,
+    )
+
+
+@pytest.fixture()
+def pod_server(tmp_path):
+    from harmony_tpu.jobserver.pod import PodJobServer
+
+    srv = PodJobServer(num_executors=2, num_followers=0,
+                       chkp_root=str(tmp_path / "chkp"))
+    srv.start()
+    srv.serve_pod(0)
+    yield srv
+    srv.shutdown(timeout=120)
+
+
+def _fence_when_active(srv, job_id, kind, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with srv._pod_cond:
+            live = job_id in srv._elastic_active
+        if live:
+            ep = srv._schedule_elastic_fence(job_id, kind)
+            assert ep is not None, "fence refused (job too short?)"
+            return ep
+        time.sleep(0.02)
+    raise AssertionError("job never became elastic-active")
+
+
+EPOCHS = 16
+
+
+class TestElasticDispatchLoop:
+    def test_fence_recovers_same_submission_with_parity(self, pod_server):
+        """The tentpole, in one process: a shrink fence tears the attempt
+        down at a lockstep epoch; the SAME submission (same future, no
+        resubmit) resumes one epoch later from the recovery cache
+        (0 checkpoint block reads) and lands numerically exactly where an
+        uninterrupted run lands."""
+        fut = pod_server.submit(_elastic_cfg("el-fence", EPOCHS))
+        fence_ep = _fence_when_active(pod_server, "el-fence", "shrink")
+        res = fut.result(timeout=180)
+        meta = res["elastic"]
+        assert meta["attempts"] == 2 and meta["recoveries"] == 1
+        assert [e["kind"] for e in meta["events"]] == ["elastic_shrink"]
+        rst = res["elastic_restore"]
+        assert rst["partial"] == 1
+        assert rst["resumed_epoch"] == fence_ep + 1
+        assert rst["blocks_read"] == 0  # all blocks from the recovery cache
+        assert rst["blocks_local"] == rst["blocks_needed"] > 0
+        # exactly-once: the final attempt covers exactly the tail epochs
+        (w,) = res["workers"].values()
+        assert w["starting_epoch"] == fence_ep + 1
+        assert w["epochs_run"] == EPOCHS - (fence_ep + 1)
+        # loss parity with an uninterrupted run of the same config
+        from harmony_tpu.jobserver.server import JobServer
+
+        ref = JobServer(num_executors=2)
+        ref.start()
+        try:
+            base = _elastic_cfg("el-ref", EPOCHS)
+            base.user.pop("elastic_shrink")
+            r2 = ref.submit(base).result(timeout=180)
+            (w2,) = r2["workers"].values()
+            assert round(w["losses"][-1], 6) == round(w2["losses"][-1], 6)
+        finally:
+            ref.shutdown(timeout=60)
+        # observability: status carries the recovery events
+        status = pod_server._status()
+        kinds = [e["kind"] for e in status["elastic"]["events"]]
+        assert "elastic_shrink_fence" in kinds and "elastic_shrink" in kinds
+        assert "fault_counters" in status and "job_events" in status
+        assert any(ev["kind"] == "elastic_restore"
+                   for ev in status["job_events"].get("el-fence", []))
+
+    def test_own_terms_failure_is_never_recovered(self, pod_server):
+        cfg = _elastic_cfg("el-bug", 4)
+        cfg.user["data_args"] = {"n": 1, "num_features": 16,
+                                 "num_classes": 4, "seed": 1}  # too few
+        with pytest.raises(Exception, match="cannot feed"):
+            pod_server.submit(cfg).result(timeout=120)
+        ev = [e for e in pod_server.elastic_events
+              if e.get("job_id") == "el-bug"]
+        assert [e["kind"] for e in ev] == ["elastic_give_up"]
+        assert "own terms" in ev[0]["reason"]
+
+    def test_recovery_cap_bounds_fence_loops(self, pod_server, monkeypatch):
+        monkeypatch.setenv("HARMONY_ELASTIC_MAX_SHRINKS", "0")
+        fut = pod_server.submit(_elastic_cfg("el-cap", EPOCHS))
+        _fence_when_active(pod_server, "el-cap", "shrink")
+        with pytest.raises(ElasticFence):
+            fut.result(timeout=120)
+
+    def test_injected_planning_death_fails_loudly(self, pod_server):
+        """Chaos: death-during-shrink (the pod.shrink_plan site). The
+        recovery planner dying must fail the submission with the
+        original fence error — loudly, promptly, no hang, no retry
+        loop."""
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "pod.shrink_plan", count=1, exc="RuntimeError",
+            message="planner struck down",
+        )]))
+        fut = pod_server.submit(_elastic_cfg("el-plandeath", EPOCHS))
+        _fence_when_active(pod_server, "el-plandeath", "shrink")
+        with pytest.raises(ElasticFence):
+            fut.result(timeout=120)
+        assert any(e["kind"] == "elastic_give_up"
+                   and "planning failed" in e.get("reason", "")
+                   for e in pod_server.elastic_events)
+
+    def test_injected_restore_failure_fails_loudly(self, pod_server,
+                                                   monkeypatch):
+        """Chaos: a second failure MID-RESTORE (the chkp.partial_read
+        site, standing in for a second follower dying while its blocks
+        are read back). The recovery attempt fails; the submission fails
+        cleanly instead of hanging or looping."""
+        monkeypatch.setenv("HARMONY_ELASTIC_CACHE", "0")  # force reads
+        faults.arm(faults.FaultPlan([faults.FaultRule(
+            "chkp.partial_read", count=-1, exc="OSError",
+            message="second failure mid-restore",
+        )]))
+        fut = pod_server.submit(_elastic_cfg("el-midrestore", EPOCHS))
+        _fence_when_active(pod_server, "el-midrestore", "shrink")
+        with pytest.raises(OSError, match="mid-restore"):
+            fut.result(timeout=120)
+
+
+# -- silence monitor / rehabilitation / reinstatement ---------------------
+
+
+class _FakeFollower:
+    """A scripted control-plane follower: JOINs, heartbeats on demand."""
+
+    def __init__(self, port, pid):
+        self.pid = pid
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.file = self.sock.makefile("r")
+        self.send({"cmd": "JOIN", "pid": pid})
+
+    def send(self, msg):
+        self.sock.sendall((json.dumps(msg) + "\n").encode())
+
+    def heartbeat(self, jobs=()):
+        self.send({"cmd": "HEARTBEAT", "pid": self.pid,
+                   "jobs": list(jobs)})
+
+    def close(self):
+        # the makefile dup must close too, or the server never sees EOF
+        for obj in (self.file, self.sock):
+            try:
+                obj.close()
+            except OSError:
+                pass
+
+
+class TestSilenceMonitorAndReinstatement:
+    def _server(self, tmp_path, n_followers=1):
+        from harmony_tpu.jobserver.pod import PodJobServer
+
+        srv = PodJobServer(num_executors=2, num_followers=n_followers,
+                           chkp_root=str(tmp_path / "chkp"))
+        srv.start()
+        srv.hb_timeout = 1.0
+        return srv
+
+    def test_silence_confines_then_resumed_beats_rehabilitate(self, tmp_path):
+        srv = self._server(tmp_path)
+        port_box = {}
+        t = threading.Thread(
+            target=lambda: port_box.update(p=srv.serve_pod(0)), daemon=True)
+        t.start()
+        for _ in range(100):
+            if srv._pod_sock is not None:
+                break
+            time.sleep(0.02)
+        fake = _FakeFollower(srv._pod_sock.getsockname()[1], pid=1)
+        try:
+            t.join(timeout=30)
+            assert not t.is_alive(), "serve_pod never completed the join"
+            # beats flow: no confinement
+            for _ in range(3):
+                fake.heartbeat()
+                time.sleep(0.2)
+            assert 1 not in srv._silenced
+            # silence past hb_timeout: the monitor confines
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and 1 not in srv._silenced:
+                time.sleep(0.1)
+            assert 1 in srv._silenced and 1 in srv._unusable_procs
+            assert srv._status()["pod"]["silenced"] == [1]
+            # beats resume: the monitor rehabilitates
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and 1 in srv._silenced:
+                fake.heartbeat()
+                time.sleep(0.1)
+            assert 1 not in srv._silenced and 1 not in srv._unusable_procs
+            kinds = [e["kind"] for e in srv.elastic_events]
+            assert "follower_silenced" in kinds
+            assert "follower_rehabilitated" in kinds
+        finally:
+            fake.close()
+            srv.shutdown(timeout=60)
+
+    def test_dead_follower_replacement_join_reinstates(self, tmp_path):
+        srv = self._server(tmp_path)
+        t = threading.Thread(target=lambda: srv.serve_pod(0), daemon=True)
+        t.start()
+        for _ in range(100):
+            if srv._pod_sock is not None:
+                break
+            time.sleep(0.02)
+        port = srv._pod_sock.getsockname()[1]
+        fake = _FakeFollower(port, pid=1)
+        try:
+            t.join(timeout=30)
+            assert not t.is_alive()
+            fake.heartbeat()
+            fake.close()  # reader EOF -> death confinement
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and 1 not in srv._dead_followers:
+                time.sleep(0.05)
+            assert 1 in srv._dead_followers
+            assert srv._status()["pod"]["broken"]
+            # a REPLACEMENT process JOINs with the same pid
+            fake2 = _FakeFollower(port, pid=1)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and 1 not in srv.reinstated:
+                fake2.heartbeat()
+                time.sleep(0.1)
+            assert srv.reinstated == [1]
+            assert 1 not in srv._dead_followers
+            assert 1 not in srv._unusable_procs
+            # the pod is whole again: the partial poison is lifted
+            assert srv._status()["pod"]["broken"] is None
+            fake2.close()
+        finally:
+            fake.close()
+            srv.shutdown(timeout=60)
+
+
+    def test_monitor_at_v5p32_shape_confines_only_the_silent_one(
+            self, tmp_path):
+        """Heartbeat tracking at the 8-follower (v5p-32) shape: seven
+        healthy beacons keep beating, the eighth goes mute — ONLY the
+        mute one is confined, and it rehabilitates alone when its beats
+        resume."""
+        srv = self._server(tmp_path, n_followers=8)
+        t = threading.Thread(target=lambda: srv.serve_pod(0), daemon=True)
+        t.start()
+        for _ in range(100):
+            if srv._pod_sock is not None:
+                break
+            time.sleep(0.02)
+        port = srv._pod_sock.getsockname()[1]
+        fakes = {pid: _FakeFollower(port, pid) for pid in range(1, 9)}
+        try:
+            t.join(timeout=30)
+            assert not t.is_alive(), "8-follower join never completed"
+            mute = 8
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and mute not in srv._silenced:
+                for pid, fk in fakes.items():
+                    if pid != mute:
+                        fk.heartbeat()
+                time.sleep(0.1)
+            assert srv._status()["pod"]["silenced"] == [mute]
+            assert srv._unusable_procs == {mute}  # the 7 others untouched
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and mute in srv._silenced:
+                for fk in fakes.values():
+                    fk.heartbeat()
+                time.sleep(0.1)
+            assert srv._silenced == set() and srv._unusable_procs == set()
+        finally:
+            for fk in fakes.values():
+                fk.close()
+            srv.shutdown(timeout=60)
+
+
+# -- scheduler reacquire/restore -----------------------------------------
+
+
+class TestSchedulerElasticSurface:
+    def test_share_all_reacquire_prefers_survivors(self):
+        from harmony_tpu.jobserver.scheduler import ShareAllScheduler
+
+        s = ShareAllScheduler()
+        s.bind(["e0", "e1", "e2"], lambda c, ex: None)
+        s.retire(["e2"])
+        assert s.reacquire("j", ["e1", "e2"]) == ["e1"]
+        assert s.reacquire("j", ["e2"]) == ["e0", "e1"]  # fresh fallback
+        s.restore(["e2"])
+        assert s.reacquire("j", ["e2"]) == ["e2"]
+
+    def test_carve_reacquire_takes_free_survivors_and_returns_them(self):
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        s = CarveScheduler(max_share=2)
+        s.bind(["e0", "e1", "e2", "e3"], lambda c, ex: None)
+        got = s.reacquire("j", ["e1", "e3"])
+        assert got == ["e1", "e3"]
+        assert set(got) & set(s._free) == set()
+        s.on_job_finish("j")  # the attempt's finish returns the slice
+        assert set(s._free) == {"e0", "e1", "e2", "e3"}
+
+    def test_process_carve_reacquire_grants_whole_processes_only(self):
+        from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
+
+        s = ProcessCarveScheduler()
+        s.bind(["e0", "e1", "e2", "e3"], lambda c, ex: None)
+        s.set_process_map({"e0": 0, "e1": 0, "e2": 1, "e3": 1})
+        # e1 alone is half a process: must NOT be granted as a survivor
+        s._free = ["e1", "e2", "e3"]
+        got = s.reacquire("j", ["e1", "e2", "e3"])
+        assert got == ["e2", "e3"]
+
+    def test_restore_unblocks_queued_arrivals(self):
+        from harmony_tpu.jobserver.scheduler import CarveScheduler
+
+        launched = []
+        s = CarveScheduler()
+        s.bind(["e0"], lambda c, ex: launched.append((c.job_id, ex)))
+        s.retire(["e0"])
+        s.on_job_arrival(JobConfig(job_id="q1", app_type="dolphin"))
+        assert launched == []  # queued: nothing free
+        s.restore(["e0"])
+        assert launched == [("q1", ["e0"])]
+
+
+# -- arbiter deficit inheritance -----------------------------------------
+
+
+def test_arbiter_recovery_attempt_inherits_deficit():
+    from harmony_tpu.runtime.podunits import PodUnitArbiter
+
+    arb = PodUnitArbiter(send_to=lambda p, m: None)
+    arb.register_job("J", frozenset({1}))
+    arb._jobs["J"].deficit = 7.5
+    arb.deregister_job("J")
+    # a competing tenant active at low deficit
+    arb.register_job("other", frozenset({1}))
+    arb._jobs["other"].deficit = 1.0
+    rkey = elastic.attempt_key("J", 1)
+    arb.register_job(rkey, frozenset({1}), inherit_from="J")
+    assert arb._jobs[rkey].deficit == 7.5  # no fairness reset
+    # without inheritance a fresh job starts at the active minimum
+    arb.register_job("fresh", frozenset({1}))
+    assert arb._jobs["fresh"].deficit == 1.0
+
+
+def test_attempt_key_round_trip():
+    assert elastic.attempt_key("j", 0) == "j"
+    assert elastic.attempt_key("j", 2) == "j@a2"
+    cfg = JobConfig(job_id="j", app_type="dolphin",
+                    user={"elastic_recovery": {"attempt": 3}})
+    assert elastic.attempt_of(cfg) == 3
+    assert elastic.config_attempt_key(cfg) == "j@a3"

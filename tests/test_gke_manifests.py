@@ -1,0 +1,109 @@
+"""Schema-lint for deploy/gke (no cluster needed): the manifests the
+README tells operators to `kubectl apply` must stay structurally valid
+k8s objects, wire the pod exactly as docs/DEPLOY.md describes, and name
+no env knob the docs don't document — promoted-from-sketch manifests
+rot precisely by drifting from the doc they came from."""
+import glob
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GKE_DIR = os.path.join(REPO, "deploy", "gke")
+
+
+def _docs() -> str:
+    out = []
+    for name in ("DEPLOY.md", "FAULT_TOLERANCE.md"):
+        with open(os.path.join(REPO, "docs", name)) as f:
+            out.append(f.read())
+    return "\n".join(out)
+
+
+def _manifests():
+    paths = sorted(glob.glob(os.path.join(GKE_DIR, "*.yaml")))
+    assert paths, "deploy/gke holds no manifests"
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc is not None:
+                    docs.append((os.path.basename(p), doc))
+    return docs
+
+
+def test_manifests_parse_and_have_k8s_identity():
+    for name, doc in _manifests():
+        for key in ("apiVersion", "kind", "metadata"):
+            assert key in doc, f"{name}: missing {key}"
+        assert doc["metadata"].get("name"), f"{name}: unnamed object"
+
+
+def test_indexed_job_wiring():
+    jobs = [d for _, d in _manifests() if d.get("kind") == "Job"]
+    assert jobs, "no Job manifest under deploy/gke"
+    (job,) = jobs
+    spec = job["spec"]
+    # one pod per slice host, all at once, index == process id
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == spec["parallelism"], (
+        "a partial slice cannot form the global mesh — completions must "
+        "equal parallelism")
+    pod = spec["template"]["spec"]
+    (container,) = pod["containers"]
+    env = {e["name"]: e for e in container.get("env", [])}
+    # the three pod-wiring variables from docs/DEPLOY.md §3
+    assert "JAX_COORDINATOR_ADDRESS" in env
+    assert "JAX_NUM_PROCESSES" in env
+    assert int(env["JAX_NUM_PROCESSES"]["value"]) == spec["completions"]
+    # JAX_PROCESS_ID derives from the completion index (downward API or
+    # the $JOB_COMPLETION_INDEX the kubelet injects), never hardcoded
+    args = " ".join(container.get("args", []) or [])
+    assert "JOB_COMPLETION_INDEX" in args or "JAX_PROCESS_ID" in env
+    assert "JAX_PROCESS_ID" not in env or "value" not in env.get(
+        "JAX_PROCESS_ID", {}), "JAX_PROCESS_ID must not be a fixed value"
+    # the coordinator address points at index 0 through the headless
+    # Service's subdomain
+    assert pod.get("subdomain"), "pods need the headless-Service subdomain"
+    coord = env["JAX_COORDINATOR_ADDRESS"]["value"]
+    assert "-0." in coord and coord.endswith(":8476"), coord
+    # leader ports exposed: coordinator, submit, control plane
+    ports = {p["containerPort"] for p in container.get("ports", [])}
+    assert {8476, 43110, 43111} <= ports
+    # checkpoint root wired: elastic shrink + auto-resume restore from it
+    assert "HARMONY_POD_CHKP_ROOT" in env
+
+
+def test_service_matches_job_subdomain_and_ports():
+    docs = _manifests()
+    services = [d for _, d in docs if d.get("kind") == "Service"]
+    (job,) = [d for _, d in docs if d.get("kind") == "Job"]
+    assert services, "no headless Service for coordinator DNS"
+    (svc,) = services
+    assert svc["spec"].get("clusterIP") in (None, "None"), (
+        "coordinator DNS needs a HEADLESS service")
+    assert svc["metadata"]["name"] == \
+        job["spec"]["template"]["spec"]["subdomain"]
+    svc_ports = {p["port"] for p in svc["spec"]["ports"]}
+    assert {8476, 43110, 43111} <= svc_ports
+    # selector matches the pod template's labels
+    sel = svc["spec"]["selector"]
+    labels = job["spec"]["template"]["metadata"]["labels"]
+    assert all(labels.get(k) == v for k, v in sel.items()), (sel, labels)
+
+
+def test_every_harmony_env_knob_is_documented():
+    """Env/doc consistency: any HARMONY_* variable a manifest wires must
+    appear in the docs' knob tables — an undocumented knob in a deploy
+    artifact is how configuration drifts out from under operators."""
+    documented = set(re.findall(r"HARMONY_[A-Z0-9_]+", _docs()))
+    for name, doc in _manifests():
+        if doc.get("kind") != "Job":
+            continue
+        for c in doc["spec"]["template"]["spec"]["containers"]:
+            for e in c.get("env", []):
+                if e["name"].startswith("HARMONY_"):
+                    assert e["name"] in documented, (
+                        f"{name}: {e['name']} is not documented in "
+                        "docs/DEPLOY.md / docs/FAULT_TOLERANCE.md")

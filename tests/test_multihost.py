@@ -262,7 +262,8 @@ def test_pod_concurrent_carved_tenants():
         server.shutdown(timeout=60)
 
 
-@pytest.mark.parametrize("nprocs,devs_per_proc", [(2, 4), (3, 2), (6, 1)])
+@pytest.mark.parametrize("nprocs,devs_per_proc",
+                         [(2, 4), (3, 2), (6, 1), (9, 1)])
 def test_pod_share_all_overlapping_tenants(nprocs, devs_per_proc):
     """SHARE-ALL multi-tenancy on a pod (round-3 verdict item 1 — the last
     reference capability with no pod equivalent): with the DEFAULT

@@ -108,6 +108,81 @@ class TestDashboard:
         finally:
             server.stop()
 
+    def test_recovery_events_surface_in_job_summary(self):
+        """Recovery observability (elastic shrink/re-grow): kind=recovery
+        posts back the summary's recoveries count + last event kind, and
+        the HTML view grows the column — a degraded tenant is visible at
+        a glance, not only in leader logs."""
+        server = DashboardServer().start()
+        try:
+            for kind, payload in (
+                ("EpochMetrics", {"loss": 0.9}),
+                ("recovery", {"kind": "elastic_shrink", "attempt": 1}),
+                ("recovery", {"kind": "elastic_regrow", "attempt": 2}),
+            ):
+                body = json.dumps({"job_id": "el-j", "kind": kind,
+                                   "payload": payload}).encode()
+                req = urllib.request.Request(
+                    server.url + "/api/metrics", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                assert json.loads(urllib.request.urlopen(req).read())["ok"]
+            (job,) = json.loads(
+                urllib.request.urlopen(server.url + "/api/jobs").read())
+            assert job["job_id"] == "el-j"
+            assert job["recoveries"] == 2
+            assert job["last_recovery"] == "elastic_regrow"
+            assert job["last_loss"] == 0.9  # loss rows unaffected
+            html = urllib.request.urlopen(server.url + "/").read().decode()
+            assert "recoveries" in html and "elastic_regrow" in html
+        finally:
+            server.stop()
+
+    def test_healthy_job_summary_has_zero_recoveries(self):
+        server = DashboardServer().start()
+        try:
+            body = json.dumps({"job_id": "ok-j", "kind": "EpochMetrics",
+                               "payload": {"loss": 0.1}}).encode()
+            req = urllib.request.Request(
+                server.url + "/api/metrics", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req)
+            (job,) = json.loads(
+                urllib.request.urlopen(server.url + "/api/jobs").read())
+            assert job["recoveries"] == 0 and job["last_recovery"] is None
+        finally:
+            server.stop()
+
+    def test_status_json_carries_fault_counters_and_events(self, devices):
+        """The jobserver STATUS payload (satellite: recovery
+        observability) exposes the PR-2 fault counters and the
+        structured per-job event log."""
+        from harmony_tpu import faults
+        from harmony_tpu.jobserver import joblog
+        from harmony_tpu.jobserver.server import JobServer
+
+        srv = JobServer(num_executors=2)
+        srv.start()
+        try:
+            faults.reset_counters()
+            faults.arm(faults.FaultPlan([faults.FaultRule(
+                "obs.site", count=1, action="skip")]))
+            faults.site("obs.site")
+            joblog.job_logger("obs-j").event("elastic_shrink", attempt=1)
+            status = srv._status()
+            assert status["fault_counters"].get("obs.site:skip") == 1
+            evs = status["job_events"]["obs-j"]
+            assert evs[-1]["kind"] == "elastic_shrink"
+            assert evs[-1]["attempt"] == 1 and "ts" in evs[-1]
+            # the payload is JSON-serializable end to end (it rides the
+            # TCP STATUS endpoint verbatim)
+            json.dumps(status)
+        finally:
+            faults.disarm()
+            joblog.clear_events("obs-j")
+            srv.shutdown(timeout=60)
+
     def test_bad_payload_is_400(self):
         server = DashboardServer().start()
         try:

@@ -355,6 +355,102 @@ def test_grant_storm_never_overlaps_conflicting_units():
     assert granted_events > 100  # the storm actually exercised grants
 
 
+def test_grant_storm_v5p32_shape_8_followers():
+    """The round-5 target shape: 8 followers (v5p-32, one host process
+    per 4 chips) + the leader, 12 jobs with randomized overlapping
+    process sets, 1200 interleaved WAIT/DONE events. Same safety
+    invariant as the 6-pid storm — no two process-overlapping jobs with
+    units outstanding together — plus liveness at the wider shape, where
+    the hold-back reservation set and the deficit ordering see far more
+    concurrent disjoint grants."""
+    import random
+
+    rng = random.Random(32)
+    pids = list(range(1, 9))
+    jobs = {}
+    for i in range(12):
+        procs = frozenset(rng.sample(pids, rng.randint(1, 8)))
+        jobs[f"J{i}"] = procs
+    w = _Wire()
+    arb = PodUnitArbiter(send_to=w)
+    for jid, procs in jobs.items():
+        arb.register_job(jid, procs)
+
+    def check_no_overlap():
+        outstanding = [(jid, st.procs) for jid, st in arb._jobs.items()
+                       if st.outstanding]
+        for i in range(len(outstanding)):
+            for j in range(i + 1, len(outstanding)):
+                (ja, pa), (jb, pb) = outstanding[i], outstanding[j]
+                assert not (pa & pb), (
+                    f"jobs {ja} and {jb} share procs {pa & pb} with "
+                    "units outstanding together")
+
+    next_seq = {jid: 0 for jid in jobs}
+    inflight = {}
+    for _ in range(1200):
+        move = rng.random()
+        if move < 0.5 and inflight:
+            key = rng.choice(sorted(inflight))
+            jid, seq = key
+            pid = inflight[key].pop()
+            arb.on_done(jid, seq, pid)
+            if not inflight[key]:
+                del inflight[key]
+        else:
+            jid = rng.choice(sorted(jobs))
+            seq = next_seq[jid]
+            next_seq[jid] += 1
+            for pid in rng.sample(sorted(jobs[jid]), len(jobs[jid])):
+                arb.on_wait(jid, seq, pid)
+        check_no_overlap()
+        for (j, s) in {(j, s) for _, j, s in w.grants()}:
+            st = arb._jobs[j]
+            if s in st.outstanding and (j, s) not in inflight:
+                inflight[(j, s)] = set(st.outstanding[s])
+    # drain to liveness: every announced unit eventually grants
+    for _ in range(20000):
+        if not inflight:
+            break
+        key = sorted(inflight)[0]
+        jid, seq = key
+        pid = inflight[key].pop()
+        arb.on_done(jid, seq, pid)
+        if not inflight[key]:
+            del inflight[key]
+        for (j, s) in {(j, s) for _, j, s in w.grants()}:
+            st = arb._jobs[j]
+            if s in st.outstanding and (j, s) not in inflight:
+                inflight[(j, s)] = set(st.outstanding[s])
+        check_no_overlap()
+    for jid in jobs:
+        assert not arb._jobs[jid].pending, (jid, arb._jobs[jid].pending)
+    assert arb.grants_total > 200
+
+
+def test_admission_predicate_at_v5p32_shape():
+    """The pod admission conflict predicate at the 8-follower shape:
+    pod_ordered jobs overlap freely across all 9 processes; an isolated
+    (non-ordered) pod-spanning job conflicts with every multi-process
+    overlap but never with single-process tenants."""
+    from harmony_tpu.jobserver.pod import PodJobServer
+
+    blocks = PodJobServer._blocks
+    everyone = frozenset(range(9))
+    half_a, half_b = frozenset(range(0, 5)), frozenset(range(5, 9))
+    single = frozenset({7})
+    # two share-all (ordered) pod-spanning tenants: never a conflict
+    assert not blocks(everyone, True, everyone, True)
+    # an isolated multi-process job conflicts with any multi-proc overlap
+    assert blocks(everyone, False, half_b, True)
+    assert blocks(half_a, True, frozenset({4, 5}), False)
+    # disjoint halves never conflict, ordered or not
+    assert not blocks(half_a, False, half_b, False)
+    # single-process tenants are always admissible
+    assert not blocks(single, False, everyone, False)
+    assert not blocks(everyone, False, single, False)
+
+
 def test_retry_announce_forces_regrant_even_after_successful_send():
     """A retry=True announce means the follower has been blocked past the
     retry interval — whatever the leader sent is lost to it (e.g. a grant
