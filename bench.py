@@ -282,6 +282,39 @@ def cpu_baseline_rate() -> float:
         return 0.0
 
 
+def measure_scrape_latency() -> "dict | None":
+    """Exporter-overhead probe (tracked round over round in BENCH json):
+    serve the process registry — populated by the training passes that
+    just ran — on an ephemeral port and time a few real HTTP scrapes.
+    Returns {metrics_scrape_ms, scrape_bytes, families} or None when the
+    probe itself fails (the bench line must never die for its
+    observability hook)."""
+    import urllib.request
+
+    try:
+        from harmony_tpu.metrics.exporter import MetricsExporter
+        from harmony_tpu.metrics.registry import parse_exposition
+
+        exp = MetricsExporter(0).start()
+        try:
+            samples = []
+            body = b""
+            for _ in range(5):
+                t0 = time.perf_counter()
+                body = urllib.request.urlopen(exp.url + "/metrics",
+                                              timeout=10).read()
+                samples.append((time.perf_counter() - t0) * 1000.0)
+            return {
+                "metrics_scrape_ms": round(sorted(samples)[len(samples) // 2], 3),
+                "scrape_bytes": len(body),
+                "families": len(parse_exposition(body.decode())),
+            }
+        finally:
+            exp.stop()
+    except Exception:
+        return None
+
+
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
          job_walls: dict | None = None, probe_log: list | None = None) -> None:
     if error:
@@ -361,6 +394,11 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
                         "against today's measured CPU rate")
                 line["prior_chip_capture"] = prior_line
                 break
+    obs = measure_scrape_latency()
+    if obs is not None:
+        # exporter overhead for THIS round's (training-populated)
+        # registry — a /metrics endpoint that drifts slow shows up here
+        line["obs"] = obs
     print(json.dumps(line))
 
 

@@ -50,6 +50,15 @@ def _count_iso_respawn() -> None:
     global _ISO_RESPAWNS
     with _ISO_LOCK:
         _ISO_RESPAWNS += 1
+    try:  # mirrored onto the /metrics registry (never fails supervision)
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().counter(
+            "harmony_chkp_iso_respawns_total",
+            "Supervision-forced isolated orbax-worker respawns",
+        ).inc()
+    except Exception:
+        pass
 
 
 def iso_respawn_total() -> int:

@@ -40,6 +40,7 @@ from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import RetryPolicy, TableConfig
 from harmony_tpu.faults.retry import call_with_retry
 from harmony_tpu.runtime.master import ETMaster, TableHandle
+from harmony_tpu.tracing.span import SpanContext, trace_span, wire_context
 
 
 #: Process-wide checkpoint READ accounting (blocks/bytes materialized
@@ -60,6 +61,22 @@ def _account_read(arr: np.ndarray) -> None:
     with _READ_STATS_LOCK:
         read_stats["blocks_read"] += 1
         read_stats["bytes_read"] += int(arr.nbytes)
+    # mirrored onto the process instrument registry so the O(lost-bytes)
+    # restore behavior is scrapeable, not only assertable in tests
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        reg = get_registry()
+        reg.counter(
+            "harmony_checkpoint_blocks_read_total",
+            "Blocks materialized from checkpoint storage",
+        ).inc()
+        reg.counter(
+            "harmony_checkpoint_read_bytes_total",
+            "Bytes materialized from checkpoint storage",
+        ).inc(int(arr.nbytes))
+    except Exception:
+        pass
 
 
 # -- per-process recovery cache (elastic shrink) --------------------------
@@ -468,12 +485,18 @@ class CheckpointManager:
         """
         from harmony_tpu.parallel.mesh import mesh_spans_processes
 
-        if mesh_spans_processes(handle.table.mesh):
-            return self._pod_checkpoint(handle, sampling_ratio, commit,
-                                        app_meta)
-        chkp_id, snap, info = self._snapshot(handle, sampling_ratio, app_meta)
-        self._write(info, snap, handle.table.spec.block_size, commit)
-        return chkp_id
+        with trace_span("checkpoint.write", table=handle.table_id) as sp:
+            if mesh_spans_processes(handle.table.mesh):
+                cid = self._pod_checkpoint(handle, sampling_ratio, commit,
+                                           app_meta)
+            else:
+                chkp_id, snap, info = self._snapshot(
+                    handle, sampling_ratio, app_meta)
+                self._write(info, snap, handle.table.spec.block_size, commit)
+                cid = chkp_id
+            if sp is not None:
+                sp.annotate("chkp_id", cid)
+            return cid
 
     def _pod_checkpoint(
         self, handle: TableHandle, sampling_ratio: float, commit: bool,
@@ -666,10 +689,16 @@ class CheckpointManager:
         chkp_id, snap, info = self._snapshot(handle, sampling_ratio, app_meta)
         pending = PendingCheckpoint(chkp_id)
         block_size = handle.table.spec.block_size
+        # the writer thread has no ambient span; carry the caller's trace
+        # context explicitly so the async write stays in the job's trace
+        parent_wire = wire_context()
 
         def run():
             try:
-                self._write(info, snap, block_size, commit)
+                with trace_span("checkpoint.write_async",
+                                parent=SpanContext.from_wire(parent_wire),
+                                chkp_id=chkp_id):
+                    self._write(info, snap, block_size, commit)
             except BaseException as e:  # surfaced by wait()
                 pending._error = e
             finally:
@@ -689,16 +718,17 @@ class CheckpointManager:
         mid-commit leaves the temp copy restorable. Idempotent: a retry
         after a crash between the durable write and the temp cleanup just
         finishes the cleanup."""
-        if faults.armed():
-            faults.site("chkp.commit", chkp_id=chkp_id)
-        src = os.path.join(self.temp_root, chkp_id)
-        if self._backend.exists(chkp_id):
-            shutil.rmtree(src, ignore_errors=True)
-            return
-        if not os.path.isdir(src):
-            raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
-        self._backend.commit(chkp_id, src)
-        shutil.rmtree(src)
+        with trace_span("checkpoint.commit", chkp_id=chkp_id):
+            if faults.armed():
+                faults.site("chkp.commit", chkp_id=chkp_id)
+            src = os.path.join(self.temp_root, chkp_id)
+            if self._backend.exists(chkp_id):
+                shutil.rmtree(src, ignore_errors=True)
+                return
+            if not os.path.isdir(src):
+                raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
+            self._backend.commit(chkp_id, src)
+            shutil.rmtree(src)
 
     def quarantine(self, chkp_id: str) -> None:
         """Move a DAMAGED checkpoint out of the restorable namespace
@@ -779,6 +809,18 @@ class CheckpointManager:
         one that wrote the checkpoint (ref: ETMaster.createTable(chkpId,
         associators)). Sampled checkpoints fill unsampled keys with init
         values (getOrInit semantics)."""
+        with trace_span("checkpoint.restore", chkp_id=chkp_id):
+            return self._restore_inner(master, chkp_id, associators,
+                                       data_axis, table_id)
+
+    def _restore_inner(
+        self,
+        master: ETMaster,
+        chkp_id: str,
+        associators: Sequence[str],
+        data_axis: int = 1,
+        table_id: Optional[str] = None,
+    ) -> TableHandle:
         d = self._dir_of(chkp_id)
         info = self._load_manifest(d)
         cfg = info.table_config
@@ -844,6 +886,22 @@ class CheckpointManager:
         {blocks_total, blocks_needed, blocks_local, blocks_read,
         bytes_read}. Sparse and sampled checkpoints fall back to the
         full restore (stats marks ``partial: 0``)."""
+        with trace_span("checkpoint.restore_partial", chkp_id=chkp_id) as sp:
+            handle, stats = self._restore_partial_inner(
+                master, chkp_id, associators, data_axis, table_id)
+            if sp is not None:
+                for k, v in stats.items():
+                    sp.annotate(k, v)
+            return handle, stats
+
+    def _restore_partial_inner(
+        self,
+        master: ETMaster,
+        chkp_id: str,
+        associators: Sequence[str],
+        data_axis: int = 1,
+        table_id: Optional[str] = None,
+    ) -> "Tuple[TableHandle, Dict[str, int]]":
         from harmony_tpu.parallel.mesh import mesh_spans_processes
         from harmony_tpu.table.blockmove import axis0_bounds
 

@@ -381,6 +381,22 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--db", default=":memory:")
 
+    p = sub.add_parser(
+        "obs",
+        help="observability tooling: flight records, /metrics scrape, "
+             "trace timelines (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument("what", choices=("flight", "metrics", "trace"))
+    p.add_argument("--port", type=int, default=43110,
+                   help="jobserver TCP port (flight: STATUS query)")
+    p.add_argument("--url", default=None,
+                   help="metrics: exporter/dashboard base URL "
+                        "(e.g. http://host:9090); trace: dashboard URL")
+    p.add_argument("--trace-id", default=None,
+                   help="trace: the trace to fetch")
+    p.add_argument("--job", default=None,
+                   help="trace: fetch a job's recent spans instead")
+
     args = ap.parse_args(argv)
 
     if args.cmd in ("start-jobserver", "start-pod", "run", "dashboard"):
@@ -398,11 +414,19 @@ def main(argv: List[str] | None = None) -> int:
         return _cmd_start_pod(args)
     if args.cmd == "submit":
         from harmony_tpu.jobserver.client import CommandSender
+        from harmony_tpu.tracing.span import trace_span
 
         cfg = build_config(args.app, args)
-        resp = CommandSender(args.port).send_job_submit_command(cfg)
+        # root span of the submission: its context rides the SUBMIT
+        # message, so the server, pod legs and workers re-parent onto
+        # ONE trace_id starting here (even though this short-lived
+        # process has no receiver of its own)
+        with trace_span("cli.submit", app=args.app, job_id=cfg.job_id):
+            resp = CommandSender(args.port).send_job_submit_command(cfg)
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
+    if args.cmd == "obs":
+        return _cmd_obs(args)
     if args.cmd == "run":
         return _cmd_run(args)
     if args.cmd == "pod-reshard":
@@ -423,7 +447,9 @@ def main(argv: List[str] | None = None) -> int:
         return 0 if resp.get("ok") else 1
     if args.cmd == "dashboard":
         from harmony_tpu.dashboard.server import DashboardServer
+        from harmony_tpu.tracing import flight
 
+        flight.install_signal_dump()
         server = DashboardServer(db_path=args.db, port=args.port).start()
         print(f"dashboard at {server.url}", flush=True)
         try:
@@ -463,11 +489,93 @@ def _make_server(num_executors: int, dashboard_url=None, chkp_root=None):
     return server
 
 
+def _cmd_obs(args: argparse.Namespace) -> int:
+    """Observability tooling (docs/OBSERVABILITY.md): dump flight
+    records via STATUS, scrape-and-pretty-print a /metrics endpoint, or
+    fetch a trace timeline from the dashboard's span store. Output is
+    made for piping (`| head`, `| grep`), so a closed pipe ends the
+    command quietly instead of stack-tracing."""
+    try:
+        return _cmd_obs_inner(args)
+    except BrokenPipeError:
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+def _cmd_obs_inner(args: argparse.Namespace) -> int:
+    import urllib.request
+
+    if args.what == "flight":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        status = CommandSender(args.port).send_status_command()
+        print(json.dumps({
+            "flight_records": status.get("flight_records", []),
+            "metrics_port": status.get("metrics_port"),
+            "stragglers": status.get("stragglers", {}),
+        }, indent=2))
+        return 0 if status.get("ok") else 1
+    if not args.url:
+        print("obs metrics/trace need --url", file=sys.stderr)
+        return 2
+    base = args.url.rstrip("/")
+    if args.what == "metrics":
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        from harmony_tpu.metrics.registry import parse_exposition
+
+        try:
+            families = parse_exposition(text)
+        except ValueError as e:
+            print(text)
+            print(f"(unparseable exposition: {e})", file=sys.stderr)
+            return 1
+        for name in sorted(families):
+            fam = families[name]
+            print(f"{name} [{fam['type']}]  {fam['help'] or ''}")
+            for sname, labels, value in fam["samples"]:
+                lab = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                print(f"  {sname}{{{lab}}} = {value}")
+        return 0
+    # trace timeline from the dashboard's span store
+    if args.trace_id:
+        q = f"trace_id={args.trace_id}"
+    elif args.job:
+        q = f"job_id={args.job}"
+    else:
+        print("obs trace needs --trace-id or --job", file=sys.stderr)
+        return 2
+    spans = json.loads(urllib.request.urlopen(
+        base + "/api/trace?" + q, timeout=10).read())
+    if not spans:
+        print("no spans", file=sys.stderr)
+        return 1
+    from harmony_tpu.tracing.timeline import timeline_rows
+
+    for row in timeline_rows(spans):
+        s = row["span"]
+        ann = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((s.get("annotations") or {}).items()))
+        print(f"{row['offset_sec']:9.3f}s {'  ' * row['depth']}"
+              f"{s['description']} [{row['duration_sec'] * 1000:.1f}ms] "
+              f"({s.get('process_id') or '?'}) {ann}")
+    return 0
+
+
 def _cmd_start_jobserver(args: argparse.Namespace) -> int:
+    from harmony_tpu.tracing import flight
+
+    flight.install_signal_dump()  # SIGTERM leaves a black box behind
     server = _make_server(args.num_executors,
                           dashboard_url=args.dashboard_url,
                           chkp_root=_chkp_root_of(args))
     port = server.serve_tcp(args.port)
+    if server.metrics_exporter is not None:
+        print(f"metrics at http://0.0.0.0:{server.metrics_exporter.port}"
+              "/metrics", flush=True)
     print(f"jobserver ready on port {port}", flush=True)
     try:
         while server.state != "CLOSED":
@@ -490,7 +598,9 @@ def _cmd_start_pod(args: argparse.Namespace) -> int:
     import time
 
     from harmony_tpu.parallel import multihost
+    from harmony_tpu.tracing import flight
 
+    flight.install_signal_dump()  # SIGTERM leaves a black box behind
     coordinator = args.coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     nprocs = args.num_processes or int(os.environ.get("JAX_NUM_PROCESSES", 0))
     pid = (args.process_id if args.process_id >= 0
@@ -533,10 +643,15 @@ def _cmd_start_pod(args: argparse.Namespace) -> int:
 
 
 def _cmd_run(args: argparse.Namespace) -> int:
+    from harmony_tpu.tracing.span import trace_span
+
     cfg = build_config(args.app, args)  # validate overrides BEFORE jax spins up
     server = _make_server(args.num_executors)
     try:
-        fut = server.submit(cfg)
+        # root span: submit() captures the ambient context, so the whole
+        # standalone run shares one trace_id
+        with trace_span("cli.run", app=args.app, job_id=cfg.job_id):
+            fut = server.submit(cfg)
         result = fut.result()
         print(json.dumps({"job_id": cfg.job_id, "result": _jsonable(result)}))
         return 0

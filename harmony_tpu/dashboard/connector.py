@@ -36,6 +36,15 @@ class DashboardConnector:
         except queue.Full:
             self.dropped += 1
 
+    def post_span(self, span: Dict[str, Any]) -> None:
+        """Enqueue one finished span (Span.to_dict shape) for the
+        dashboard's span store (POST /api/spans) — same non-blocking
+        drop-newest contract as metric posts."""
+        try:
+            self._q.put_nowait({"__span__": span})
+        except queue.Full:
+            self.dropped += 1
+
     def metric_sink(self, metric) -> None:
         """Adapter for MetricCollector sinks: dataclass metrics forward
         with their type name; PLAIN-DICT records (custom metrics from
@@ -74,9 +83,15 @@ class DashboardConnector:
             if item is None:
                 return
             try:
+                if "__span__" in item:
+                    path = "/api/spans"
+                    body = {"spans": [item["__span__"]]}
+                else:
+                    path = "/api/metrics"
+                    body = item
                 req = urllib.request.Request(
-                    self.url + "/api/metrics",
-                    data=json.dumps(item).encode(),
+                    self.url + path,
+                    data=json.dumps(body, default=repr).encode(),
                     headers={"Content-Type": "application/json"},
                 )
                 urllib.request.urlopen(req, timeout=self.timeout_sec).read()
@@ -87,3 +102,23 @@ class DashboardConnector:
     def close(self, timeout: float = 5.0) -> None:
         self._q.put(None)
         self._thread.join(timeout=timeout)
+
+
+class DashboardSpanReceiver:
+    """SpanReceiver tee-ing finished spans to a dashboard's span store
+    through an async :class:`DashboardConnector` (drop-don't-block).
+    Registered by JobServer when a dashboard_url is configured; the
+    dashboard then renders per-job trace timelines from REAL received
+    spans instead of nothing."""
+
+    def __init__(self, connector: DashboardConnector) -> None:
+        self._connector = connector
+
+    def receive(self, span) -> None:
+        try:
+            self._connector.post_span(span.to_dict())
+        except Exception:
+            pass  # observability never fails the emitting thread
+
+    def close(self) -> None:
+        pass  # the connector's owner closes it

@@ -11,7 +11,17 @@ Endpoints:
   POST /api/metrics          {"job_id", "kind", "payload": {...}} -> stored
   GET  /api/metrics?job_id=&kind=&limit=   -> JSON rows (newest first)
   GET  /api/jobs             -> JSON job summary (count, last loss, kinds)
+  POST /api/spans            {"spans": [span dicts]} -> stored
+  GET  /api/trace?trace_id= | ?job_id=     -> spans ordered by start time
+  GET  /trace?trace_id=      -> HTML per-trace timeline
+  GET  /metrics              -> Prometheus text exposition (this process)
   GET  /                     -> HTML summary table (the web UI)
+
+Hardening (vs the seed): ``limit`` is clamped/rejected instead of riding
+raw into SQL, malformed query params get real 400s, and file-backed
+databases run in WAL mode with per-request read connections so many
+followers POSTing concurrently don't serialize every read behind the
+writer's lock.
 """
 from __future__ import annotations
 
@@ -20,7 +30,7 @@ import sqlite3
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 _SCHEMA = """
@@ -32,7 +42,39 @@ CREATE TABLE IF NOT EXISTS metrics (
     payload TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_metrics_job ON metrics (job_id, kind, id);
+CREATE TABLE IF NOT EXISTS spans (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    trace_id TEXT NOT NULL,
+    span_id TEXT NOT NULL,
+    parent_id TEXT,
+    job_id TEXT,
+    description TEXT NOT NULL,
+    start_sec REAL,
+    stop_sec REAL,
+    process_id TEXT,
+    annotations TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_spans_trace ON spans (trace_id, start_sec);
+CREATE INDEX IF NOT EXISTS idx_spans_job ON spans (job_id, id);
 """
+
+#: limit clamp bounds: non-positive and giant values never reach SQL
+MAX_QUERY_LIMIT = 1000
+
+
+class BadRequest(ValueError):
+    """Malformed client input — rendered as a 400, never a 500."""
+
+
+def _clamp_limit(raw: Optional[str], default: int = 100) -> int:
+    if raw is None or raw == "":
+        return default
+    try:
+        limit = int(raw)
+    except (TypeError, ValueError):
+        raise BadRequest(f"limit must be an integer, got {raw!r}")
+    return max(1, min(limit, MAX_QUERY_LIMIT))
 
 
 class DashboardServer:
@@ -40,7 +82,15 @@ class DashboardServer:
     probing for a usable port)."""
 
     def __init__(self, db_path: str = ":memory:", port: int = 0) -> None:
+        self._db_path = db_path
+        self._file_backed = db_path != ":memory:" and "memory" not in db_path
         self._db = sqlite3.connect(db_path, check_same_thread=False)
+        if self._file_backed:
+            # WAL: readers proceed during writes, so follower POST storms
+            # don't serialize the read API behind the writer's lock (the
+            # per-request read connections below are what make this real
+            # — one shared connection would still serialize on _db_lock)
+            self._db.execute("PRAGMA journal_mode=WAL")
         self._db.executescript(_SCHEMA)
         self._db_lock = threading.Lock()
         handler = self._make_handler()
@@ -48,6 +98,20 @@ class DashboardServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- storage ---------------------------------------------------------
+
+    def _read_rows(self, sql: str, args: Tuple = ()) -> List[Tuple]:
+        """Run one read query. File-backed: a fresh per-request
+        connection (WAL lets it proceed against concurrent writers).
+        In-memory: the shared connection under the lock (a second
+        :memory: connection would be a different, empty database)."""
+        if self._file_backed:
+            conn = sqlite3.connect(self._db_path)
+            try:
+                return conn.execute(sql, args).fetchall()
+            finally:
+                conn.close()
+        with self._db_lock:
+            return self._db.execute(sql, args).fetchall()
 
     def insert(self, job_id: str, kind: str, payload: Dict[str, Any]) -> None:
         with self._db_lock:
@@ -57,11 +121,46 @@ class DashboardServer:
             )
             self._db.commit()
 
+    def insert_span(self, span: Dict[str, Any]) -> None:
+        """Store one span dict (the Span.to_dict shape). trace_id,
+        span_id and description are required; job_id is lifted from the
+        annotations so per-job trace queries need no JSON scan."""
+        try:
+            trace_id = str(span["trace_id"])
+            span_id = str(span["span_id"])
+            description = str(span["description"])
+        except (KeyError, TypeError):
+            raise BadRequest(
+                "span needs trace_id, span_id and description")
+        annotations = span.get("annotations") or {}
+        if not isinstance(annotations, dict):
+            annotations = {}
+        job_id = annotations.get("job_id")
+        with self._db_lock:
+            self._db.execute(
+                "INSERT INTO spans (ts, trace_id, span_id, parent_id, "
+                "job_id, description, start_sec, stop_sec, process_id, "
+                "annotations) VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (
+                    time.time(), trace_id, span_id,
+                    span.get("parent_id"),
+                    str(job_id) if job_id is not None else None,
+                    description,
+                    span.get("start_sec"), span.get("stop_sec"),
+                    span.get("process_id"),
+                    json.dumps(annotations, default=repr),
+                ),
+            )
+            self._db.commit()
+
     def query(
-        self, job_id: Optional[str] = None, kind: Optional[str] = None, limit: int = 100
+        self, job_id: Optional[str] = None, kind: Optional[str] = None,
+        limit: int = 100,
     ) -> List[Dict[str, Any]]:
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
         q = "SELECT ts, job_id, kind, payload FROM metrics"
-        cond, args = [], []
+        cond: List[str] = []
+        args: List[Any] = []
         if job_id:
             cond.append("job_id = ?")
             args.append(job_id)
@@ -72,12 +171,47 @@ class DashboardServer:
             q += " WHERE " + " AND ".join(cond)
         q += " ORDER BY id DESC LIMIT ?"
         args.append(limit)
-        with self._db_lock:
-            rows = self._db.execute(q, args).fetchall()
+        rows = self._read_rows(q, tuple(args))
         return [
             {"ts": ts, "job_id": j, "kind": k, "payload": json.loads(p)}
             for ts, j, k, p in rows
         ]
+
+    def trace(self, trace_id: Optional[str] = None,
+              job_id: Optional[str] = None,
+              limit: int = MAX_QUERY_LIMIT) -> List[Dict[str, Any]]:
+        """Spans of one trace (or one job's traces), ordered by start
+        time — the timeline view's source. The job_id variant resolves
+        the job's trace ids first and returns those traces WHOLE:
+        checkpoint/blockmove spans annotate chkp_id/table rather than
+        job_id, and a per-job view that dropped them would show a
+        submission with holes in it."""
+        if not trace_id and not job_id:
+            raise BadRequest("trace query needs trace_id or job_id")
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
+        if trace_id:
+            tids = [trace_id]
+        else:
+            tids = [r[0] for r in self._read_rows(
+                "SELECT DISTINCT trace_id FROM spans WHERE job_id = ? "
+                "ORDER BY id DESC LIMIT 8", (job_id,))]
+            if not tids:
+                return []
+        marks = ",".join("?" * len(tids))
+        q = ("SELECT trace_id, span_id, parent_id, job_id, description,"
+             " start_sec, stop_sec, process_id, annotations FROM spans"
+             f" WHERE trace_id IN ({marks}) ORDER BY start_sec LIMIT ?")
+        args: Tuple = (*tids, limit)
+        out = []
+        for row in self._read_rows(q, args):
+            (tid, sid, pid_, jid, desc, start, stop, proc, ann) = row
+            out.append({
+                "trace_id": tid, "span_id": sid, "parent_id": pid_,
+                "job_id": jid, "description": desc,
+                "start_sec": start, "stop_sec": stop, "process_id": proc,
+                "annotations": json.loads(ann) if ann else {},
+            })
+        return out
 
     def jobs(self) -> List[Dict[str, Any]]:
         # One aggregate query; last_loss = the newest report whose payload
@@ -102,23 +236,35 @@ class DashboardServer:
                   GROUP BY job_id
                  ) c ON m.id = c.max_rec_id
         """
-        with self._db_lock:
-            loss_rows = self._db.execute(q).fetchall()
-            rec_rows = self._db.execute(q_rec).fetchall()
-            all_rows = self._db.execute(
-                "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
-            ).fetchall()
+        loss_rows = self._read_rows(q)
+        rec_rows = self._read_rows(q_rec)
+        all_rows = self._read_rows(
+            "SELECT job_id, COUNT(*), MAX(ts) FROM metrics GROUP BY job_id"
+        )
+        # the NEWEST span row's trace per job (MAX(id), not
+        # MAX(trace_id) — trace ids are random hex, and the
+        # lexicographic max would link a stale trace after a resubmit)
+        trace_rows = self._read_rows(
+            """
+            SELECT s.job_id, s.trace_id FROM spans s
+            JOIN (SELECT MAX(id) mid FROM spans
+                  WHERE job_id IS NOT NULL GROUP BY job_id
+                 ) m ON s.id = m.mid
+            """
+        )
         loss_by_job = {r[0]: json.loads(r[1]).get("loss") for r in loss_rows}
         rec_by_job = {
             r[0]: {"recoveries": r[1],
                    "last_recovery": json.loads(r[2]).get("kind")}
             for r in rec_rows
         }
+        trace_by_job = {r[0]: r[1] for r in trace_rows}
         return [
             {"job_id": job_id, "num_reports": count, "last_ts": last_ts,
              "last_loss": loss_by_job.get(job_id),
              "recoveries": rec_by_job.get(job_id, {}).get("recoveries", 0),
-             "last_recovery": rec_by_job.get(job_id, {}).get("last_recovery")}
+             "last_recovery": rec_by_job.get(job_id, {}).get("last_recovery"),
+             "trace_id": trace_by_job.get(job_id)}
             for job_id, count, last_ts in all_rows
         ]
 
@@ -147,6 +293,49 @@ class DashboardServer:
         with self._db_lock:
             self._db.close()
 
+    @staticmethod
+    def _trace_html(spans: List[Dict[str, Any]]) -> str:
+        """Minimal per-trace timeline: one row per span, offset/duration
+        bars scaled to the trace's wall span, depth from parent links.
+        Every span-sourced string is HTML-escaped — span descriptions
+        and annotations are client-POSTed data."""
+        import html as _html
+
+        from harmony_tpu.tracing.timeline import timeline_rows
+
+        rows_data = timeline_rows(spans)
+        if not rows_data:
+            return ("<html><body><h1>trace</h1>"
+                    "<p>no spans</p></body></html>")
+        wall = rows_data[0]["wall_sec"]
+        rows = []
+        for r in rows_data:
+            s, dur = r["span"], r["duration_sec"]
+            left = 100.0 * r["offset_sec"] / wall
+            width = max(100.0 * dur / wall, 0.3)
+            pad = "&nbsp;" * (2 * r["depth"])
+            ann = ", ".join(
+                f"{_html.escape(str(k))}={_html.escape(str(v))}"
+                for k, v in sorted((s.get("annotations") or {}).items()))
+            rows.append(
+                f"<tr><td>{pad}{_html.escape(str(s['description']))}</td>"
+                f"<td>{_html.escape(str(s.get('process_id') or ''))}</td>"
+                f"<td>{dur * 1000:.1f}ms</td>"
+                f"<td><div style='margin-left:{left:.1f}%;"
+                f"width:{width:.1f}%;background:#46f;height:10px'></div>"
+                f"</td><td><small>{ann}</small></td></tr>"
+            )
+        tid = _html.escape(str(spans[0]["trace_id"]))
+        return (
+            f"<html><head><title>trace {tid}</title></head><body>"
+            f"<h1>trace {tid}</h1>"
+            f"<p>{len(spans)} span(s), {wall:.3f}s wall</p>"
+            "<table border=0 width='100%'>"
+            "<tr><th align=left>span</th><th>process</th><th>dur</th>"
+            "<th width='50%'>timeline</th><th>annotations</th></tr>"
+            + "".join(rows) + "</table></body></html>"
+        )
+
     def _make_handler(self):
         server = self
 
@@ -162,57 +351,117 @@ class DashboardServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _html(self, body: bytes,
+                      content_type: str = "text/html") -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self) -> None:
-                if urlparse(self.path).path != "/api/metrics":
-                    self._json(404, {"error": "not found"})
-                    return
+                path = urlparse(self.path).path
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     msg = json.loads(self.rfile.read(n))
-                    server.insert(
-                        str(msg["job_id"]), str(msg["kind"]), dict(msg["payload"])
-                    )
-                    self._json(200, {"ok": True})
+                    if path == "/api/metrics":
+                        server.insert(
+                            str(msg["job_id"]), str(msg["kind"]),
+                            dict(msg["payload"]),
+                        )
+                        self._json(200, {"ok": True})
+                    elif path == "/api/spans":
+                        spans = (msg.get("spans")
+                                 if isinstance(msg, dict) and "spans" in msg
+                                 else [msg])
+                        if not isinstance(spans, list):
+                            raise BadRequest("spans must be a list")
+                        for s in spans:
+                            server.insert_span(dict(s))
+                        self._json(200, {"ok": True, "stored": len(spans)})
+                    else:
+                        self._json(404, {"error": "not found"})
                 except Exception as e:  # bad payloads must not kill the server
                     self._json(400, {"error": str(e)})
 
             def do_GET(self) -> None:
                 parsed = urlparse(self.path)
+                qs = parse_qs(parsed.query)
+
+                def one(key: str) -> Optional[str]:
+                    return qs.get(key, [None])[0]
+
                 if parsed.path == "/api/metrics":
-                    try:  # malformed queries must not kill the connection
-                        qs = parse_qs(parsed.query)
+                    try:  # malformed queries are a 400, never a dead conn
                         result = server.query(
-                            job_id=qs.get("job_id", [None])[0],
-                            kind=qs.get("kind", [None])[0],
-                            limit=int(qs.get("limit", ["100"])[0]),
+                            job_id=one("job_id"),
+                            kind=one("kind"),
+                            limit=_clamp_limit(one("limit")),
                         )
+                    except BadRequest as e:
+                        self._json(400, {"error": str(e)})
+                        return
                     except Exception as e:
                         self._json(400, {"error": str(e)})
                         return
                     self._json(200, result)
+                elif parsed.path == "/api/trace":
+                    try:
+                        result = server.trace(
+                            trace_id=one("trace_id"),
+                            job_id=one("job_id"),
+                            limit=_clamp_limit(one("limit"),
+                                               default=MAX_QUERY_LIMIT),
+                        )
+                    except BadRequest as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
+                elif parsed.path == "/trace":
+                    try:
+                        spans = server.trace(trace_id=one("trace_id"),
+                                             job_id=one("job_id"))
+                    except BadRequest as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._html(server._trace_html(spans).encode())
+                elif parsed.path == "/metrics":
+                    from harmony_tpu.metrics.registry import get_registry
+
+                    self._html(
+                        get_registry().expose().encode(),
+                        content_type=(
+                            "text/plain; version=0.0.4; charset=utf-8"),
+                    )
                 elif parsed.path == "/api/jobs":
                     self._json(200, server.jobs())
                 elif parsed.path == "/":
+                    import html as _h
+                    from urllib.parse import quote as _q
+
                     rows = "".join(
-                        f"<tr><td>{j['job_id']}</td><td>{j['num_reports']}</td>"
-                        f"<td>{j['last_loss']}</td>"
+                        f"<tr><td>{_h.escape(str(j['job_id']))}</td>"
+                        f"<td>{j['num_reports']}</td>"
+                        f"<td>{_h.escape(str(j['last_loss']))}</td>"
                         f"<td>{j['recoveries'] or ''}"
-                        f"{(' (' + j['last_recovery'] + ')') if j['last_recovery'] else ''}"
-                        "</td></tr>"
+                        f"{(' (' + _h.escape(str(j['last_recovery'])) + ')') if j['last_recovery'] else ''}"
+                        "</td><td>"
+                        + (f"<a href='/trace?trace_id="
+                           f"{_q(str(j['trace_id']))}'>"
+                           f"{_h.escape(str(j['trace_id']))}</a>"
+                           if j.get("trace_id") else "")
+                        + "</td></tr>"
                         for j in server.jobs()
                     )
                     body = (
                         "<html><head><title>harmony_tpu dashboard</title></head>"
                         "<body><h1>harmony_tpu jobs</h1>"
                         "<table border=1><tr><th>job</th><th>reports</th>"
-                        f"<th>last loss</th><th>recoveries</th></tr>{rows}"
+                        f"<th>last loss</th><th>recoveries</th>"
+                        f"<th>trace</th></tr>{rows}"
                         "</table></body></html>"
                     ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._html(body)
                 else:
                     self._json(404, {"error": "not found"})
 

@@ -24,8 +24,8 @@ from harmony_tpu.table.table import DenseTable
 class ModelAccessor:
     def __init__(self, table: DenseTable) -> None:
         self._table = table
-        self.pull_tracer = Tracer()
-        self.push_tracer = Tracer()
+        self.pull_tracer = Tracer(instrument="accessor.pull")
+        self.push_tracer = Tracer(instrument="accessor.push")
 
     def pull(self, keys: Sequence[int]) -> np.ndarray:
         self.pull_tracer.start()

@@ -52,7 +52,7 @@ from harmony_tpu.metrics.collector import (
 from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
-from harmony_tpu.tracing import trace_span
+from harmony_tpu.tracing import SpanContext, trace_span
 from harmony_tpu.utils.platform import hard_sync
 
 
@@ -77,8 +77,19 @@ class WorkerTasklet:
         dispatch_turn: Optional[Callable[[], Any]] = None,
         pending_plan_epoch: Optional[Callable[[], Optional[int]]] = None,
         pod_contended: Optional[Callable[[], bool]] = None,
+        trace_parent: Optional[Dict[str, str]] = None,
+        attempt: int = 0,
     ) -> None:
         self.job_id = job_id
+        # Trace threading (tracing/span.py): the worker runs on its own
+        # thread, so the entity hands the dispatch span's wire context
+        # down explicitly — contextvars do not cross Thread starts. The
+        # elastic attempt index keys the `attempt` label/annotation as
+        # `job@aN` (jobserver/elastic.attempt_key's scheme).
+        self.trace_parent = trace_parent
+        self.attempt = int(attempt or 0)
+        self.attempt_key = (job_id if self.attempt <= 0
+                            else f"{job_id}@a{self.attempt}")
         self.ctx = ctx
         self.trainer = trainer
         self.data = data
@@ -1134,6 +1145,20 @@ class WorkerTasklet:
     # -- the loop --------------------------------------------------------
 
     def run(self) -> Dict[str, Any]:
+        """One span covers the worker's whole run — re-parented onto the
+        dispatch/submit trace when the entity handed a wire context down
+        (the job's epochs/steps/checkpoints/moves then share the
+        submission's trace_id end to end), a fresh root otherwise."""
+        with trace_span(
+            "dolphin.worker",
+            parent=SpanContext.from_wire(self.trace_parent),
+            job_id=self.job_id,
+            worker_id=self.ctx.worker_id,
+            attempt=self.attempt_key,
+        ):
+            return self._run_inner()
+
+    def _run_inner(self) -> Dict[str, Any]:
         ctx, params = self.ctx, self.ctx.params
         # Global init writes shared tables (multi-device programs): under
         # pod tenancy that region holds a dispatch turn/unit like any
@@ -1681,7 +1706,39 @@ class WorkerTasklet:
                     loss=float(losses[b]),
                 )
             )
+        # per-tenant step-time histogram (/metrics exposition + the
+        # straggler report's raw material): one observation per batch at
+        # the smeared per-batch time — async dispatch makes true
+        # per-batch device time unobservable (see the drain docstrings)
+        hist = self._step_histogram()
+        if hist is not None:
+            for _ in batch_sizes:
+                hist.observe(per_batch_time)
         return {k: float(v[-1]) for k, v in host.items()}
+
+    def _step_histogram(self):
+        """Cached child of harmony_step_time_seconds for this worker's
+        (job, attempt, worker) labelset; None when the registry is
+        unusable (metrics must never fail the hot loop)."""
+        hist = getattr(self, "_step_hist", None)
+        if hist is None:
+            try:
+                from harmony_tpu.metrics.registry import (
+                    STEP_TIME_BUCKETS,
+                    get_registry,
+                )
+
+                hist = get_registry().histogram(
+                    "harmony_step_time_seconds",
+                    "Per-mini-batch dispatch+device seconds per worker",
+                    ("job", "attempt", "worker"),
+                    buckets=STEP_TIME_BUCKETS,
+                ).labels(job=self.job_id, attempt=self.attempt_key,
+                         worker=self.ctx.worker_id)
+            except Exception:
+                return None
+            self._step_hist = hist
+        return hist
 
     def _ensure_stacked_cache(self) -> None:
         """Device-resident whole-epoch dataset ([num_batches, batch, ...]
@@ -1807,16 +1864,32 @@ class WorkerTasklet:
                 epoch=epoch, proc=jax.process_index(),
             )
         progress = self._primary_metric(last_metrics)
+        epoch_sec = time.perf_counter() - epoch_t0
         self.collector.add(
             EpochMetrics(
                 job_id=self.job_id,
                 worker_id=self.ctx.worker_id,
                 epoch_idx=epoch,
                 num_examples=epoch_examples,
-                epoch_time_sec=time.perf_counter() - epoch_t0,
+                epoch_time_sec=epoch_sec,
                 loss=progress,
             )
         )
+        try:  # per-tenant epoch-time histogram for /metrics scrapers
+            from harmony_tpu.metrics.registry import (
+                EPOCH_TIME_BUCKETS,
+                get_registry,
+            )
+
+            get_registry().histogram(
+                "harmony_epoch_time_seconds",
+                "Per-epoch wall seconds per worker",
+                ("job", "attempt"),
+                buckets=EPOCH_TIME_BUCKETS,
+            ).labels(job=self.job_id, attempt=self.attempt_key).observe(
+                epoch_sec)
+        except Exception:
+            pass
         epoch_losses.append(progress)
         if call_trainer_hook:
             self.trainer.on_epoch_finished(self.ctx, epoch)

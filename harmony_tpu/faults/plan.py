@@ -191,6 +191,13 @@ class FaultPlan:
             return None
         rule = self.rules[idx]
         _count(f"{rule.site}:{rule.action}")
+        # Telemetry plane (best-effort, never breaks injection): the trip
+        # lands (a) as an annotation on the current trace span, (b) in
+        # the flight-recorder ring — with a one-per-site crash dump, so
+        # even the "crash" action below leaves its black box on disk
+        # before os._exit — and (c) on the harmony_fault_fires_total
+        # counter the /metrics endpoints expose.
+        _observe_fire(rule, name, ctx)
         if rule.action == "crash":
             sys.stderr.write(
                 f"harmony.faults: injected crash at {name} "
@@ -230,6 +237,36 @@ class FaultPlan:
                 return idx
             finally:
                 fcntl.flock(lk, fcntl.LOCK_UN)
+
+
+def _observe_fire(rule: "FaultRule", name: str, ctx: Dict[str, Any]) -> None:
+    """Cross-wire a fired rule into the telemetry plane. Guarded: fault
+    injection must keep working even if the observability layer is
+    broken (it is the thing under test, after all)."""
+    try:
+        from harmony_tpu.tracing.span import current_span
+
+        span = current_span()
+        if span is not None:
+            span.annotate(f"fault:{name}", rule.action)
+    except Exception:
+        pass
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().counter(
+            "harmony_fault_fires_total",
+            "Injected-fault rule fires, by site pattern and action",
+            ("site", "action"),
+        ).labels(site=rule.site, action=rule.action).inc()
+    except Exception:
+        pass
+    try:
+        from harmony_tpu.tracing import flight
+
+        flight.get_recorder().on_fault_trip(name, rule.action, ctx)
+    except Exception:
+        pass
 
 
 # -- the armed plan + site entry points ----------------------------------

@@ -31,6 +31,22 @@ _counters: Dict[str, int] = {}
 def _count(key: str, n: int = 1) -> None:
     with _lock:
         _counters[key] = _counters.get(key, 0) + n
+    # Mirror onto the process instrument registry (metrics/registry.py):
+    # the ``<op>.retries`` / ``<op>.giveups`` keys become one labeled
+    # counter a /metrics scraper can watch — *.retries rising flags
+    # transient infra trouble before it becomes a giveup.
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        op, _, kind = key.rpartition(".")
+        get_registry().counter(
+            "harmony_retry_events_total",
+            "Bounded-retry events per op: kind=retries (re-attempts) "
+            "or kind=giveups (policy exhausted)",
+            ("op", "kind"),
+        ).labels(op=op or key, kind=kind).inc(n)
+    except Exception:  # observability must never fail the retry path
+        pass
 
 
 def retry_counters() -> Dict[str, int]:

@@ -39,7 +39,19 @@ class CommandSender:
         return json.loads(data.decode())
 
     def send_job_submit_command(self, config: JobConfig) -> Dict[str, Any]:
-        return self._roundtrip({"command": "SUBMIT", "conf": config.to_dict()})
+        """SUBMIT carries the caller's span context beside the config (the
+        TraceInfo-in-the-message pattern, tracing/span.py): a submission
+        made inside ``trace_span("cli.submit")`` yields ONE trace_id from
+        this client through the jobserver's dispatch, the pod legs and
+        the remote workers' spans. None outside any span — the field is
+        simply absent and the server roots a fresh trace."""
+        from harmony_tpu.tracing.span import wire_context
+
+        msg: Dict[str, Any] = {"command": "SUBMIT", "conf": config.to_dict()}
+        wire = wire_context()
+        if wire is not None:
+            msg["trace"] = wire
+        return self._roundtrip(msg)
 
     def send_status_command(self) -> Dict[str, Any]:
         return self._roundtrip({"command": "STATUS"})

@@ -610,6 +610,15 @@ class DolphinJobEntity(JobEntity):
         per = n // num_workers
         results: Dict[str, Any] = {}
         errors: List[BaseException] = []
+        # Trace threading: worker threads cannot inherit the dispatch
+        # span's contextvar, so capture its wire context HERE (the
+        # dispatch thread) and hand it down; the elastic attempt index
+        # labels every worker span/histogram with the job@aN key.
+        from harmony_tpu.jobserver import elastic as _elastic
+        from harmony_tpu.tracing.span import wire_context
+
+        trace_parent = wire_context()
+        attempt = _elastic.attempt_of(cfg)
 
         def run_worker(idx: int) -> None:
             wid = f"{cfg.job_id}/w{idx}"
@@ -676,6 +685,8 @@ class DolphinJobEntity(JobEntity):
                     # so fused multi-epoch windows may defer it; checkpoint
                     # chains snapshot state AT their epoch and disable them
                     defer_epoch_callback=(params.model_chkp_period <= 0),
+                    trace_parent=trace_parent,
+                    attempt=attempt,
                 )
                 self._workers.append(worker)
                 results[wid] = worker.run()

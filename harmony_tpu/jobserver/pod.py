@@ -82,6 +82,7 @@ from harmony_tpu.runtime.podunits import (
     follower_client,
     leader_client,
 )
+from harmony_tpu.tracing.span import SpanContext, trace_span, wire_context
 
 
 def _send(sock: socket.socket, msg: Dict[str, Any]) -> None:
@@ -412,6 +413,24 @@ class PodJobServer(JobServer):
                 "retired executors %s (unusable processes %s)",
                 retired, sorted(wedged),
             )
+        # Black box for the death: the leader's recent spans/events around
+        # the moment the follower vanished (tracing/flight.py). The ring
+        # event is synchronous (cheap); the file dump runs on its own
+        # thread — the death path feeds confinement and pod poisoning,
+        # and must not stall on disk I/O (the ring snapshot is taken at
+        # dump time, well inside the relevant window either way).
+        try:
+            from harmony_tpu.tracing import flight
+
+            rec = flight.get_recorder()
+            rec.event("follower_death", pid=pid,
+                      wedged=sorted(int(p) for p in wedged))
+            threading.Thread(
+                target=lambda: rec.dump(f"follower_death:{pid}", pid=pid),
+                daemon=True, name=f"flight-dump-{pid}",
+            ).start()
+        except Exception:
+            pass
 
     def _proc_executors(self, pid: int) -> List[str]:
         return [
@@ -867,7 +886,15 @@ class PodJobServer(JobServer):
 
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
         if self._elastic_eligible(config):
-            self._dispatch_elastic(config, executor_ids)
+            # ONE span for the whole elastic submission: every attempt's
+            # pod.dispatch span nests under it, so a trace shows the
+            # fences and recovery attempts as one connected story
+            with trace_span(
+                "elastic.submission",
+                parent=self._trace_parent_of(config),
+                job_id=config.job_id,
+            ):
+                self._dispatch_elastic(config, executor_ids)
             return
         self._dispatch_once(config, executor_ids)
         self._maybe_auto_resume(config, executor_ids)
@@ -970,9 +997,14 @@ class PodJobServer(JobServer):
                     return
                 kind = "regrow" if fence == "regrow" else "shrink"
                 try:
-                    plan = self._plan_elastic_recovery(
-                        config, execs, att, kind, executor_ids, events
-                    )
+                    with trace_span(
+                        "elastic.plan_recovery", job_id=config.job_id,
+                        kind=kind,
+                        attempt=_elastic.attempt_key(config.job_id, att + 1),
+                    ):
+                        plan = self._plan_elastic_recovery(
+                            config, execs, att, kind, executor_ids, events
+                        )
                 except BaseException as e:  # noqa: BLE001 - give up cleanly
                     self._elastic_give_up(
                         jlog, config.job_id,
@@ -1093,6 +1125,19 @@ class PodJobServer(JobServer):
 
     def _dispatch_once(self, config: JobConfig,
                        executor_ids: List[str]) -> None:
+        att = _elastic.attempt_of(config)
+        with trace_span(
+            "pod.dispatch",
+            parent=self._trace_parent_of(config),
+            job_id=config.job_id,
+            # the job@aN attempt key rides as a span annotation, so a
+            # trace query tells recovery attempts apart at a glance
+            attempt=_elastic.attempt_key(config.job_id, att),
+        ):
+            self._dispatch_once_inner(config, executor_ids)
+
+    def _dispatch_once_inner(self, config: JobConfig,
+                             executor_ids: List[str]) -> None:
         jlog = job_logger(config.job_id)
         procs = frozenset(
             self.master.executor(e).device.process_index for e in executor_ids
@@ -1196,6 +1241,11 @@ class PodJobServer(JobServer):
                     "conf": config.to_dict(),
                     "executor_ids": list(executor_ids),
                     "chief_pid": min(procs),
+                    # the dispatch span's wire context: follower-side job
+                    # spans re-parent onto it, so one trace_id spans the
+                    # leader->follower hop (tracing/span.py's TraceInfo
+                    # analogue, finally used ACROSS processes)
+                    "trace": wire_context(),
                     # elastic attempt index (0 for ordinary jobs): keys
                     # the follower's entity registry, unit client and
                     # JOB_DONE routing per attempt
@@ -1719,6 +1769,14 @@ class PodFollower:
         self.master.add_executors(num_executors)
         self.metrics = MetricManager()
         self.metrics.start_collection()
+        # telemetry plane, follower leg: flight recorder capturing this
+        # process's spans/events, and a per-process /metrics endpoint
+        # (HARMONY_METRICS_PORT; None when unset)
+        from harmony_tpu.metrics.exporter import exporter_from_env
+        from harmony_tpu.tracing import flight as _flight
+
+        _flight.get_recorder()
+        self.metrics_exporter = exporter_from_env()
         # Liveness beacon: the leader gates its job-report waits on
         # heartbeat freshness (never job duration), so a follower whose
         # job threads are busy inside hours-long collectives must still
@@ -1796,6 +1854,9 @@ class PodFollower:
                     except OSError:
                         break  # leader gone; nothing to tell it
                 self._hb_stop.set()
+                if self.metrics_exporter is not None:
+                    self.metrics_exporter.stop()
+                    self.metrics_exporter = None
                 self._sock.close()
                 return
             if msg.get("cmd") == "TU_GRANT":
@@ -1898,6 +1959,24 @@ class PodFollower:
         self._report(report)
 
     def _run_job(self, msg: Dict[str, Any], global_tu) -> None:
+        """One span per follower job leg, re-parented onto the leader's
+        dispatch span via the RUN_JOB trace context — the cross-PROCESS
+        half of the submission trace. The job thread has no ambient span,
+        so the explicit parent is the only way the legs connect."""
+        rkey = _elastic.attempt_key(
+            str(msg.get("conf", {}).get("job_id", "?")),
+            int(msg.get("att", 0) or 0),
+        )
+        with trace_span(
+            "pod.follower_job",
+            parent=SpanContext.from_wire(msg.get("trace")),
+            job_id=msg.get("conf", {}).get("job_id"),
+            attempt=rkey,
+            pid=self.pid,
+        ):
+            self._run_job_inner(msg, global_tu)
+
+    def _run_job_inner(self, msg: Dict[str, Any], global_tu) -> None:
         from harmony_tpu.jobserver.entity import build_entity
         from harmony_tpu.runtime.taskunit import LocalTaskUnitScheduler
 
@@ -1990,6 +2069,16 @@ class PodFollower:
                     pass
             report["ok"] = False
             report["error"] = f"{type(e).__name__}: {e}"
+            try:  # black-box trail: the failure beside its recent spans
+                from harmony_tpu.tracing import flight
+
+                flight.get_recorder().event(
+                    "follower_job_failed", job=rkey, pid=self.pid,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                    elastic_fence=str(getattr(e, "elastic_fence", "") or ""),
+                )
+            except Exception:
+                pass
             if getattr(e, "elastic_fence", None):
                 # a planned elastic fence, not a failure of the job's
                 # own logic: the leader's elastic loop classifies on

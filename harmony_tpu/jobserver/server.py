@@ -35,6 +35,13 @@ from harmony_tpu.metrics.manager import MetricManager
 from harmony_tpu.parallel.mesh import DevicePool
 from harmony_tpu.runtime.master import ETMaster
 from harmony_tpu.runtime.taskunit import GlobalTaskUnitScheduler, LocalTaskUnitScheduler
+from harmony_tpu.tracing.span import (
+    SpanContext,
+    current_span,
+    get_tracing,
+    trace_span,
+    wire_context,
+)
 from harmony_tpu.utils.statemachine import StateMachine
 
 
@@ -73,10 +80,31 @@ class JobServer:
         # metric tees to the async connector, which drops rather than
         # blocks when the dashboard is slow or down.
         self._dashboard = None
+        self._span_receiver = None
         if dashboard_url:
-            from harmony_tpu.dashboard.connector import DashboardConnector
+            from harmony_tpu.dashboard.connector import (
+                DashboardConnector,
+                DashboardSpanReceiver,
+            )
 
             self._dashboard = DashboardConnector(dashboard_url)
+            # finished spans tee to the dashboard's span store (async,
+            # drop-don't-block like every other dashboard post) so its
+            # per-job trace/timeline view renders real control-plane
+            # traces, not only metric rows
+            self._span_receiver = get_tracing().add_receiver(
+                DashboardSpanReceiver(self._dashboard)
+            )
+        # the crash-correlated flight recorder starts capturing spans the
+        # moment a server exists in this process (tracing/flight.py)
+        from harmony_tpu.tracing import flight as _flight
+
+        _flight.get_recorder()
+        # per-process Prometheus endpoint (HARMONY_METRICS_PORT; None
+        # when the knob is unset — tests and one-shots pay nothing)
+        from harmony_tpu.metrics.exporter import exporter_from_env
+
+        self.metrics_exporter = exporter_from_env()
         self.global_taskunit = GlobalTaskUnitScheduler()
         self.local_taskunit = LocalTaskUnitScheduler(cpu_slots, net_slots)
         self._scheduler = scheduler or ShareAllScheduler()
@@ -166,8 +194,14 @@ class JobServer:
         try:
             self._on_closing(timeout)
         finally:
+            if self._span_receiver is not None:
+                get_tracing().remove_receiver(self._span_receiver)
+                self._span_receiver = None
             if self._dashboard is not None:
                 self._dashboard.close()  # flush the async queue, then stop
+            if self.metrics_exporter is not None:
+                self.metrics_exporter.stop()
+                self.metrics_exporter = None
             self._state.transition("CLOSED")
 
     def _on_closing(self, timeout: Optional[float]) -> None:
@@ -242,7 +276,17 @@ class JobServer:
     # -- submission ------------------------------------------------------
 
     def submit(self, config: JobConfig) -> "Future[Dict[str, Any]]":
-        """SUBMIT: schedule a job; returns a future for its result."""
+        """SUBMIT: schedule a job; returns a future for its result.
+
+        Trace threading: the submitter's span context rides inside the
+        config (``user["_trace"]`` — already set by the TCP ingest when
+        the CLI sent one, else captured from the ambient span here), so
+        the dispatch thread, the pod legs and the workers all re-parent
+        onto ONE submission trace across threads and processes."""
+        if "_trace" not in config.user:
+            wire = wire_context()
+            if wire is not None:
+                config.user["_trace"] = wire
         with self._lock:
             # State checked under the registry lock: shutdown's INIT->CLOSING
             # flip holds the same lock, so a submit can't interleave between
@@ -278,7 +322,24 @@ class JobServer:
             self._dispatch_threads.append(t)
         t.start()
 
+    def _trace_parent_of(self, config: JobConfig) -> Optional[SpanContext]:
+        """Explicit re-parent target for a span opened on a fresh thread:
+        the submission's wire context — UNLESS an ambient span already
+        carries the trace (nested dispatch legs must nest, not re-root)."""
+        if current_span() is not None:
+            return None
+        return SpanContext.from_wire(config.user.get("_trace"))
+
     def _dispatch(self, config: JobConfig, executor_ids: List[str]) -> None:
+        with trace_span(
+            "jobserver.dispatch",
+            parent=self._trace_parent_of(config),
+            job_id=config.job_id,
+            executors=len(executor_ids),
+        ):
+            self._dispatch_job(config, executor_ids)
+
+    def _dispatch_job(self, config: JobConfig, executor_ids: List[str]) -> None:
         jr = self._jobs[config.job_id]
         jlog = job_logger(config.job_id)
         jlog.info("dispatched on executors %s", executor_ids)
@@ -339,6 +400,8 @@ class JobServer:
         """STATUS reply body (subclasses extend, e.g. pod health)."""
         from harmony_tpu.jobserver import joblog
 
+        from harmony_tpu.tracing import flight
+
         return {
             "ok": True,
             "state": self.state,
@@ -350,6 +413,13 @@ class JobServer:
             # (shrink/re-grow/confinement/rehabilitation)
             "fault_counters": self.metrics.fault_counters(),
             "job_events": joblog.job_events(),
+            # telemetry plane: per-job straggler attribution from the
+            # step-time records, this process's flight-recorder dumps
+            # (path + correlated trace ids), and where /metrics lives
+            "stragglers": self.metrics.straggler_report(),
+            "flight_records": flight.get_recorder().records(),
+            "metrics_port": (self.metrics_exporter.port
+                             if self.metrics_exporter is not None else None),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------
@@ -395,7 +465,19 @@ class JobServer:
                 cmd = msg.get("command")
                 if cmd == "SUBMIT":
                     config = ConfigBase.from_dict(msg["conf"])
-                    self.submit(config)
+                    # the client's span context (client.py sends it beside
+                    # the config): ride it inside the config so the whole
+                    # dispatch chain re-parents onto the CLI's trace
+                    wire = msg.get("trace")
+                    if wire and "_trace" not in config.user:
+                        config.user["_trace"] = dict(wire)
+                    with trace_span(
+                        "jobserver.submit",
+                        parent=SpanContext.from_wire(
+                            config.user.get("_trace")),
+                        job_id=config.job_id,
+                    ):
+                        self.submit(config)
                     reply = {"ok": True, "job_id": config.job_id}
                 elif cmd == "STATUS":
                     reply = self._status()

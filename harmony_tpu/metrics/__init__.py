@@ -7,9 +7,23 @@ from harmony_tpu.metrics.collector import (
     ServerMetrics,
 )
 from harmony_tpu.metrics.manager import MetricManager
+from harmony_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    get_registry,
+    set_registry,
+)
 
 __all__ = [
     "Tracer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "get_registry",
+    "set_registry",
     "BatchMetrics",
     "EpochMetrics",
     "InputPipelineMetrics",

@@ -122,6 +122,42 @@ class MetricManager:
             out["chkp.iso.respawns"] = respawns
         return out
 
+    def straggler_report(
+        self, job_id: Optional[str] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-job straggler attribution from the stored per-batch step
+        times: mean batch seconds per worker, the slowest worker, and the
+        slowest/median ratio — the "which tenant's step times regressed,
+        on which executor" answer TPU-pod practice lives by (step-time
+        variance IS the scaling signal at pod scale, arXiv:2011.03641).
+        Ratio ~1.0 = healthy; >> 1 names the straggler. Jobs with one
+        worker report ratio 1.0 (no peers to lag)."""
+        import statistics
+
+        with self._lock:
+            per_job: Dict[str, Dict[str, List[float]]] = {}
+            for wid, ms in self._batch.items():
+                for m in ms:
+                    if job_id is not None and m.job_id != job_id:
+                        continue
+                    per_job.setdefault(m.job_id, {}).setdefault(
+                        wid, []).append(m.batch_time_sec)
+        out: Dict[str, Dict[str, Any]] = {}
+        for jid, workers in per_job.items():
+            means = {w: sum(ts) / len(ts) for w, ts in workers.items() if ts}
+            if not means:
+                continue
+            med = statistics.median(means.values())
+            slowest = max(means, key=means.get)
+            out[jid] = {
+                "workers": {w: round(v, 6) for w, v in means.items()},
+                "slowest": slowest,
+                "slowest_sec": round(means[slowest], 6),
+                "median_sec": round(med, 6),
+                "ratio": round(means[slowest] / med, 3) if med > 0 else 1.0,
+            }
+        return out
+
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star
         metric: reference BatchMetrics.dataProcessingRate summed)."""

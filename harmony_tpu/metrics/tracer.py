@@ -13,7 +13,12 @@ from typing import Any, Optional
 
 
 class Tracer:
-    def __init__(self) -> None:
+    def __init__(self, instrument: Optional[str] = None) -> None:
+        #: optional phase name: when set, every record() feeds the
+        #: process registry's step-time histogram labeled phase=<name>
+        #: (metrics/registry.py) so phase timings are scrapeable, not
+        #: only averaged in-process
+        self.instrument = instrument
         self._t0: Optional[float] = None
         self.total_sec = 0.0
         self.count = 0
@@ -31,17 +36,47 @@ class Tracer:
         if self._t0 is None:
             raise RuntimeError("record() without start()")
         if block_on is not None:
-            try:
-                from harmony_tpu.utils.platform import hard_sync
+            # NARROW import guard: only "utils.platform itself is absent"
+            # is tolerable (a stripped-down install without the jax-side
+            # helpers). Failures INSIDE the module — its own jax import
+            # failing (ImportError named "jax"), hard_sync renamed away
+            # (AttributeError from the attribute access below) — are real
+            # and must surface, not silently skip the sync and
+            # mis-attribute device time to the next phase. Module import
+            # + attribute access, NOT from-import: a from-import of a
+            # missing symbol raises ImportError named after the MODULE,
+            # indistinguishable from the module being absent.
+            import importlib
 
-                hard_sync(block_on)  # a real sync even on lazy backends
-            except ImportError:  # pragma: no cover
-                pass
+            try:
+                _platform = importlib.import_module(
+                    "harmony_tpu.utils.platform")
+            except ImportError as e:  # pragma: no cover - stripped install
+                if e.name != "harmony_tpu.utils.platform":
+                    raise
+                _platform = None
+            if _platform is not None:
+                _platform.hard_sync(block_on)  # real sync on lazy backends
         dt = time.perf_counter() - self._t0
         self.total_sec += dt
         self.count += 1
         self.elem_count += num_elems
         self._t0 = None
+        if self.instrument:
+            try:
+                from harmony_tpu.metrics.registry import (
+                    STEP_TIME_BUCKETS,
+                    get_registry,
+                )
+
+                get_registry().histogram(
+                    "harmony_phase_seconds",
+                    "Tracer-timed phase seconds (pull/push/compute ...)",
+                    ("phase",),
+                    buckets=STEP_TIME_BUCKETS,
+                ).labels(phase=self.instrument).observe(dt)
+            except Exception:
+                pass  # the stopwatch must never fail on its histogram
         return dt
 
     def avg_sec(self) -> float:
@@ -52,4 +87,4 @@ class Tracer:
         return self.elem_count / self.total_sec if self.total_sec > 0 else 0.0
 
     def reset(self) -> None:
-        self.__init__()
+        self.__init__(self.instrument)

@@ -111,15 +111,20 @@ class TableHandle:
         The target layout is ANNOUNCED before the flip (workers prewarm
         their programs) so the flip->reshard gap stays one locked
         device_put, not an announcement's compile time."""
+        from harmony_tpu.tracing.span import trace_span
+
         counts = self.block_manager.block_counts()
         n = min(num_blocks, counts.get(src, 0))
         counts[src] = counts.get(src, 0) - n
         counts[dst] = counts.get(dst, 0) + n
-        self._announce_target(
-            [e for e in self.block_manager.executors if counts.get(e, 0) > 0]
-        )
-        moved = self.block_manager.move(src, dst, num_blocks)
-        self._reshard_to_owners()
+        with trace_span("table.blockmove", table=self.table_id, src=src,
+                        dst=dst, blocks=n):
+            self._announce_target(
+                [e for e in self.block_manager.executors
+                 if counts.get(e, 0) > 0]
+            )
+            moved = self.block_manager.move(src, dst, num_blocks)
+            self._reshard_to_owners()
         return moved
 
     def rebalance(self, executor_ids: Sequence[str]) -> None:

@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from harmony_tpu import faults
 from harmony_tpu.faults.retry import InfraTransientError, RetryError, call_with_retry
+from harmony_tpu.tracing.span import trace_span
 
 # Lockstep per-process counter (see module doc) naming each migration's
 # rendezvous keys / staging dir consistently across processes.
@@ -666,6 +667,53 @@ def migrate_blocks(arr: jax.Array, old_mesh: Mesh,
     process calls this in lockstep. Peak host traffic on each process is
     the bytes it sends plus the bytes it receives — O(moved), asserted by
     tests via :data:`last_move_stats`."""
+    with trace_span("blockmove.migrate") as sp:
+        out = _migrate_blocks_inner(arr, old_mesh, new_sharding)
+        if sp is not None:
+            for k in ("seq", "transport", "blocks_sent", "bytes_sent",
+                      "blocks_received", "transport_retries"):
+                sp.annotate(k, last_move_stats.get(k))
+        _record_move_metrics(last_move_stats)
+        return out
+
+
+def _record_move_metrics(stats: Dict[str, Any]) -> None:
+    """Fold one migration's stats into the process instrument registry
+    (metrics/registry.py): cumulative counters (unlike the per-move
+    ``last_move_stats`` snapshot, these stay monotone for scrapers) plus
+    the fixed-boundary transfer-size histogram."""
+    try:
+        from harmony_tpu.metrics.registry import (
+            TRANSFER_SIZE_BUCKETS,
+            get_registry,
+        )
+
+        reg = get_registry()
+        transport = str(stats.get("transport", ""))
+        reg.counter(
+            "harmony_blockmove_migrations_total",
+            "Completed block migrations", ("transport",),
+        ).labels(transport=transport).inc()
+        reg.counter(
+            "harmony_blockmove_sent_bytes_total",
+            "Bytes this process transmitted across block migrations",
+            ("transport",),
+        ).labels(transport=transport).inc(int(stats.get("bytes_sent", 0)))
+        reg.counter(
+            "harmony_blockmove_transport_retries_total",
+            "Transport legs re-attempted under the retry policy",
+        ).inc(int(stats.get("transport_retries", 0)))
+        reg.histogram(
+            "harmony_blockmove_transfer_bytes",
+            "Per-migration bytes transmitted by this process",
+            buckets=TRANSFER_SIZE_BUCKETS,
+        ).observe(float(stats.get("bytes_sent", 0)))
+    except Exception:
+        pass  # observability must never fail a migration
+
+
+def _migrate_blocks_inner(arr: jax.Array, old_mesh: Mesh,
+                          new_sharding: NamedSharding) -> jax.Array:
     t0 = time.monotonic()
     shape, dtype = arr.shape, arr.dtype
     pid = jax.process_index()

@@ -168,6 +168,15 @@ class LayoutAnnouncerMixin:
         with self._lock:
             listeners = list(self._layout_listeners)
             self._layout_version = getattr(self, "_layout_version", 0) + 1
+        try:  # the announcement count, scrapeable (metrics/registry.py)
+            from harmony_tpu.metrics.registry import get_registry
+
+            get_registry().counter(
+                "harmony_table_layout_changes_total",
+                "Reshard announcements across this process's tables",
+            ).inc()
+        except Exception:
+            pass
         for fn in listeners:
             try:
                 fn(new_mesh)

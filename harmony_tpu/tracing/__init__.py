@@ -11,8 +11,11 @@ from harmony_tpu.tracing.span import (
     trace_span,
 )
 from harmony_tpu.tracing.profiler import device_trace, profile_session
+from harmony_tpu.tracing.flight import FlightRecorder, get_recorder
 
 __all__ = [
+    "FlightRecorder",
+    "get_recorder",
     "Span",
     "SpanContext",
     "SpanReceiver",

@@ -1,0 +1,228 @@
+"""Crash-correlated flight recorder.
+
+When a pod process dies, the evidence of WHAT it was doing — which
+trace, which elastic attempt, which fault site — historically lived only
+in interleaved operator logs. The flight recorder keeps a bounded
+per-process ring of the most recent spans and structured events, and
+dumps it to a JSON file at the moments that matter:
+
+  * a fault site trips (once per site per process — the injection
+    harness fires sites repeatedly and one dump per site is the signal;
+    a ``crash`` rule dumps BEFORE ``os._exit``, so even a SIGKILL-style
+    death leaves its black box on disk);
+  * the pod leader observes a follower death;
+  * ``SIGTERM`` lands on a long-running entry point
+    (:func:`install_signal_dump` — wired by the CLI, never on import).
+
+Each dump is correlated: it carries every ``trace_id`` seen in the ring
+and the elastic ``attempt_key`` (``job@aN``) when the trigger's context
+names one, so ``harmony-tpu obs flight`` / the STATUS endpoint can join
+flight records against the distributed trace they belong to.
+
+Knobs (docs/OBSERVABILITY.md): ``HARMONY_FLIGHT_DIR`` (dump directory;
+default ``<tmp>/harmony-flight``), ``HARMONY_FLIGHT_CAP`` (ring size,
+default 256).
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from harmony_tpu.tracing.span import Span, SpanReceiver, get_tracing
+
+ENV_DIR = "HARMONY_FLIGHT_DIR"
+ENV_CAP = "HARMONY_FLIGHT_CAP"
+_MAX_DUMP_SUMMARIES = 64
+
+
+def _default_dir() -> str:
+    return os.environ.get(ENV_DIR) or os.path.join(
+        tempfile.gettempdir(), "harmony-flight")
+
+
+def _default_cap() -> int:
+    try:
+        return max(16, int(os.environ.get(ENV_CAP, "256")))
+    except ValueError:
+        return 256
+
+
+def _attempt_key(ctx: Dict[str, Any]) -> Optional[str]:
+    """The ``job@aN`` attempt key a trigger context names, if any (same
+    scheme as jobserver/elastic.attempt_key, inlined so the tracing
+    package never imports the jobserver)."""
+    job = ctx.get("job") or ctx.get("job_id")
+    if job is None:
+        return None
+    try:
+        attempt = int(ctx.get("attempt", 0) or 0)
+    except (TypeError, ValueError):
+        attempt = 0
+    return str(job) if attempt <= 0 else f"{job}@a{attempt}"
+
+
+class FlightRecorder(SpanReceiver):
+    """Bounded ring of recent spans + events, dumpable to JSON."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 out_dir: Optional[str] = None) -> None:
+        self._lock = threading.Lock()
+        self._ring: "deque[Dict[str, Any]]" = deque(
+            maxlen=capacity or _default_cap())
+        self.out_dir = out_dir or _default_dir()
+        #: summaries of dumps written by this process, newest last
+        self.dumps: List[Dict[str, Any]] = []
+        self.dump_count = 0
+        self._dumped_sites: set = set()
+
+    # -- capture ---------------------------------------------------------
+
+    def receive(self, span: Span) -> None:
+        rec = {"kind": "span", **span.to_dict()}
+        with self._lock:
+            self._ring.append(rec)
+
+    def event(self, kind: str, **fields: Any) -> None:
+        rec = {"kind": "event", "event": kind, "ts": time.time(), **fields}
+        with self._lock:
+            self._ring.append(rec)
+
+    def ring_size(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- dump ------------------------------------------------------------
+
+    def dump(self, reason: str, **meta: Any) -> Optional[str]:
+        """Write the current ring (plus ``meta``) to one JSON file;
+        returns its path, or None when the write failed (a dying process
+        must never die HARDER because its black box could not flush)."""
+        with self._lock:
+            records = list(self._ring)
+        trace_ids = sorted({
+            r["trace_id"] for r in records
+            if r.get("kind") == "span" and r.get("trace_id")
+        })
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in reason)[:80]
+        body = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "process_id": get_tracing().process_id,
+            "meta": meta,
+            "trace_ids": trace_ids,
+            "records": records,
+        }
+        path = os.path.join(
+            self.out_dir,
+            f"flight-{os.getpid()}-{int(time.time() * 1000)}-{safe}.json",
+        )
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = path + ".writing"
+            with open(tmp, "w") as f:
+                json.dump(body, f, default=repr)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        summary = {"path": path, "reason": reason, "ts": body["ts"],
+                   "meta": {k: repr(v) if not isinstance(
+                       v, (str, int, float, bool, type(None))) else v
+                       for k, v in meta.items()},
+                   "trace_ids": trace_ids, "records": len(records)}
+        with self._lock:
+            self.dumps.append(summary)
+            del self.dumps[:-_MAX_DUMP_SUMMARIES]
+            self.dump_count += 1
+        return path
+
+    def records(self) -> List[Dict[str, Any]]:
+        """Dump summaries (path/reason/trace_ids), newest last — what the
+        STATUS endpoint and ``harmony-tpu obs flight`` surface."""
+        with self._lock:
+            return [dict(d) for d in self.dumps]
+
+    # -- triggers --------------------------------------------------------
+
+    def on_fault_trip(self, site: str, action: str,
+                      ctx: Dict[str, Any]) -> None:
+        """Fault-site trip: always an event in the ring; ONE dump per
+        site per process (repeat fires of the same site would bury the
+        first — and most diagnostic — ring snapshot under copies)."""
+        fields = {k: v for k, v in ctx.items()
+                  if isinstance(v, (str, int, float, bool, type(None)))}
+        self.event("fault_trip", site=site, action=action, **fields)
+        with self._lock:
+            if site in self._dumped_sites:
+                return
+            self._dumped_sites.add(site)
+        meta: Dict[str, Any] = {"site": site, "action": action, **fields}
+        ak = _attempt_key(ctx)
+        if ak is not None:
+            meta["attempt_key"] = ak
+        self.dump(f"fault:{site}", **meta)
+
+
+# -- process-wide recorder -------------------------------------------------
+
+_rec_lock = threading.Lock()
+_recorder: Optional[FlightRecorder] = None
+
+
+def get_recorder() -> FlightRecorder:
+    """The process recorder, created on first use and subscribed to the
+    process-wide tracing so recent spans land in the ring."""
+    global _recorder
+    with _rec_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+            get_tracing().add_receiver(_recorder)
+        return _recorder
+
+
+def peek_recorder() -> Optional[FlightRecorder]:
+    """The recorder if one exists — never creates (metric callbacks must
+    not instantiate observability state as a side effect of a scrape)."""
+    with _rec_lock:
+        return _recorder
+
+
+def reset_recorder() -> None:
+    """Drop the process recorder (tests)."""
+    global _recorder
+    with _rec_lock:
+        rec, _recorder = _recorder, None
+    if rec is not None:
+        get_tracing().remove_receiver(rec)
+
+
+def install_signal_dump(signals: Optional[List[int]] = None) -> None:
+    """Dump the ring when a termination signal lands, then chain to the
+    previous handler (or exit, matching the default action). Called by
+    long-running CLI entry points only — never on import, and only from
+    the main thread (signal.signal's requirement)."""
+    import signal as _signal
+
+    sigs = signals or [_signal.SIGTERM]
+    rec = get_recorder()
+    for signum in sigs:
+        previous = _signal.getsignal(signum)
+
+        def handler(num, frame, _prev=previous):
+            rec.dump(f"signal:{num}")
+            if callable(_prev):
+                _prev(num, frame)
+            elif _prev == _signal.SIG_DFL:
+                _signal.signal(num, _signal.SIG_DFL)
+                _signal.raise_signal(num)
+
+        try:
+            _signal.signal(signum, handler)
+        except (ValueError, OSError):
+            pass  # not the main thread / unsupported signal: no hook

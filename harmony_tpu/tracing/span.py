@@ -108,20 +108,68 @@ class InMemorySpanReceiver(SpanReceiver):
 
 
 class LocalFileSpanReceiver(SpanReceiver):
-    """JSON-lines span log (ref: the HTrace local-file receiver option)."""
+    """JSON-lines span log (ref: the HTrace local-file receiver option).
 
-    def __init__(self, path: str) -> None:
+    Lifecycle hardening: ``close`` is registered with :mod:`atexit`, so a
+    short-lived follower/worker process that never reaches an orderly
+    ``Tracing.close()`` still flushes its tail spans instead of silently
+    dropping them; and the file ROTATES at ``max_bytes`` (keeping one
+    ``<path>.1`` predecessor) so a long-lived jobserver's span log stays
+    bounded instead of growing without limit. ``max_bytes=0`` disables
+    rotation; the default comes from ``HARMONY_TRACE_MAX_BYTES``
+    (64 MiB)."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None) -> None:
+        import atexit
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(
+                    "HARMONY_TRACE_MAX_BYTES", str(64 << 20)))
+            except ValueError:
+                max_bytes = 64 << 20
+        self.max_bytes = max_bytes
         self._f = open(path, "a", buffering=1)
+        self._written = self._f.tell()  # appending: count existing bytes
         self._lock = threading.Lock()
+        self._closed = False
+        atexit.register(self.close)
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # rotation is best-effort; keep appending regardless
+        self._f = open(self.path, "a", buffering=1)
+        self._written = self._f.tell()
 
     def receive(self, span: Span) -> None:
+        line = json.dumps(span.to_dict()) + "\n"
         with self._lock:
-            self._f.write(json.dumps(span.to_dict()) + "\n")
+            if self._closed:
+                return  # an atexit-closed receiver drops, never crashes
+            if self.max_bytes and self._written + len(line) > self.max_bytes:
+                self._rotate_locked()
+            self._f.write(line)
+            self._written += len(line)
 
     def close(self) -> None:
+        import atexit
+
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._f.flush()
             self._f.close()
+        # this receiver is done; keep the process-exit hook list short
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
 
 
 class Tracing:
