@@ -17,6 +17,7 @@ throughput (the north-star quantity) rather than a compile-time race —
 see enable_compile_cache().
 """
 import json
+import os
 import subprocess
 import sys
 import time
@@ -315,6 +316,91 @@ def measure_scrape_latency() -> "dict | None":
         return None
 
 
+def measure_state_movement() -> "dict | None":
+    """State-movement latency probe (tracked round over round in BENCH
+    json beside throughput): a small checkpoint restore and a small TCP
+    block-migration exchange, both on the CPU backend so every round is
+    comparable regardless of accelerator health. Returns
+    {"chkp.restore_ms", "move.exchange_ms", ...} or None — the bench
+    line must never die for its state-movement hook."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    root = tempfile.mkdtemp(prefix="harmony-bench-sm-")
+    try:
+        from harmony_tpu.checkpoint import CheckpointManager
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime import ETMaster
+        from harmony_tpu.table import blockmove
+
+        cpu = jax.devices("cpu")
+        master = ETMaster(DevicePool(cpu[:1]))
+        execs = [e.id for e in master.add_executors(1)]
+        nb, rows, dim = 32, 256, 256  # 32 x 256 KB = 8 MB
+        cfg = TableConfig(table_id="bench-sm", capacity=nb * rows,
+                          value_shape=(dim,), num_blocks=nb)
+        h = master.create_table(cfg, execs)
+        vals = np.ones((nb * rows, dim), np.float32)
+        h.table.multi_update(list(range(nb * rows)), vals)
+        mgr = CheckpointManager(root + "/temp", root + "/commit")
+        cid = mgr.checkpoint(h)
+        samples = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            rh = mgr.restore(master, cid, execs, table_id=f"bench-sm-r{i}")
+            samples.append((time.perf_counter() - t0) * 1000.0)
+            rh.drop()
+        restore_ms = sorted(samples)[len(samples) // 2]
+
+        class _KV:
+            def __init__(self):
+                self.kv = {}
+
+            def key_value_set(self, k, v):
+                self.kv[k] = v
+
+            def blocking_key_value_get(self, k, timeout_ms):
+                return self.kv[k]
+
+            def key_value_delete(self, k):
+                self.kv.pop(k, None)
+
+        block = np.ones((rows, dim), np.float32)
+        plan = blockmove.MovePlan(
+            sends={0: [(b, 0) for b in range(nb)]},
+            recvs={0: set(range(nb))}, block_nbytes=block.nbytes)
+        outgoing = {b: block for b in range(nb)}
+        orig_kv = blockmove._kv_client
+        blockmove._kv_client = lambda: _KV()
+        try:
+            samples = []
+            for i in range(3):
+                t0 = time.perf_counter()
+                received, _ = blockmove._tcp_exchange(plan, outgoing,
+                                                      900000 + i)
+                samples.append((time.perf_counter() - t0) * 1000.0)
+                assert len(received) == nb
+        finally:
+            blockmove._kv_client = orig_kv
+        exchange_ms = sorted(samples)[len(samples) // 2]
+        from harmony_tpu.checkpoint.manager import _chkp_io_threads
+
+        return {
+            "chkp.restore_ms": round(restore_ms, 1),
+            "move.exchange_ms": round(exchange_ms, 1),
+            "chkp_mb": round(nb * rows * dim * 4 / 1e6, 1),
+            "move_parallel": blockmove._move_parallel(),
+            "io_threads": _chkp_io_threads(),
+        }
+    except Exception:
+        return None
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
          job_walls: dict | None = None, probe_log: list | None = None) -> None:
     if error:
@@ -399,6 +485,12 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # exporter overhead for THIS round's (training-populated)
         # registry — a /metrics endpoint that drifts slow shows up here
         line["obs"] = obs
+    sm = measure_state_movement()
+    if sm is not None:
+        # state-movement latency (checkpoint restore + migration
+        # exchange) tracked beside throughput, so future PRs see
+        # recovery-path regressions in the same trajectory
+        line["state_movement"] = sm
     print(json.dumps(line))
 
 

@@ -1,14 +1,26 @@
 #!/usr/bin/env python
-"""Cross-process block-migration bandwidth — the NEW move path's number.
+"""Cross-process block-migration bandwidth — parallel vs serial legs.
 
 Round-4's verdict flagged the old cross-process reshard (full-table
-replicate + host round-trip) as the elasticity ceiling; this measures
-its replacement (table/blockmove.py) end to end on a 2-process virtual
-pod: a 512-block, 64 MB dense table shrinks onto process 0's devices
-and grows back, point-to-point over the TCP DCN channel. Reported:
-moved bytes (exactly half the table per direction — the O(moved)
-contract), wall per direction, and effective bandwidth over the moved
-bytes. Loopback numbers — the protocol/assembly cost floor, not DCN.
+replicate + host round-trip) as the elasticity ceiling; round 5 replaced
+it with point-to-point block moves (table/blockmove.py). This round makes
+the exchange CONCURRENT (HARMONY_MOVE_PARALLEL legs + split streams), so
+the bench drives the TRANSPORT LAYER itself — ``_tcp_exchange`` with a
+synthetic MovePlan across 3 REAL processes rendezvousing through the jax
+coordination KV store — serial (=1) and parallel (=4), interleaved
+rounds, best-of per arm. (The table-level reshard wrapper needs
+multi-process SPMD computations, which this host's jax CPU backend
+cannot run — see ROADMAP; the transport is exactly the layer this round
+parallelized, and every received block is verified byte-identical to the
+payload in BOTH modes before a number is reported.)
+
+Directions:
+  * grow: proc 0 streams half the table to proc 1 and half to proc 2 —
+    the MULTI-PEER send direction: serial sends the legs one after the
+    other, parallel overlaps them (splitting oversized legs into
+    striped streams);
+  * shrink: procs 1+2 each stream their half back to proc 0
+    (multi-source receive).
 
 Prints ONE JSON line. Run: python benchmarks/blockmove_bench.py
 """
@@ -21,7 +33,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.common import free_port, sanitized_cpu_env  # noqa: E402
 
-NB, CAP, DIM = 512, 16384, 1024  # 16384 x 1024 x f32 = 64 MB
+NPROCS = 3
+NB, ROWS, DIM = 128, 1024, 256   # 128 x 1 MB blocks = 128 MB moved/direction
+ROUNDS = 3
 
 WORKER = r'''
 import json, os, sys, time
@@ -30,57 +44,86 @@ def main():
     coordinator, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     from harmony_tpu.parallel import multihost
     assert multihost.initialize_distributed(coordinator, nprocs, pid)
-    import jax, numpy as np
-    from harmony_tpu.parallel.mesh import build_mesh
-    from harmony_tpu.config.params import TableConfig
-    from harmony_tpu.table.table import DenseTable, TableSpec
+    import numpy as np
     from harmony_tpu.table import blockmove
-    NB, CAP, DIM = %d, %d, %d
-    devs = jax.devices()
-    mesh_a = build_mesh(devs, data=1, model=len(devs))
-    mesh_b = build_mesh(devs[:len(devs) // 2], data=1,
-                        model=len(devs) // 2)
-    cfg = TableConfig(table_id="bm", capacity=CAP, value_shape=(DIM,),
-                      num_blocks=NB)
-    t = DenseTable(TableSpec(cfg), mesh_a)
-    keys = np.arange(CAP)
-    vals = (np.arange(DIM, dtype=np.float32)[None, :]
-            + keys[:, None]).astype(np.float32)
-    t.multi_put(keys, vals)
-    t0 = time.perf_counter(); t.reshard(mesh_b)
-    shrink_s = time.perf_counter() - t0
-    st = dict(blockmove.last_move_stats)
-    t0 = time.perf_counter(); t.reshard(mesh_a)
+    NB, ROWS, DIM = %d, %d, %d
+    base = np.arange(ROWS * DIM, dtype=np.float32).reshape(ROWS, DIM)
+    def block(b):
+        return base + b
+    # grow: pid 0 -> peers 1 and 2 (multi-peer send)
+    plan_g = blockmove.MovePlan(
+        sends={0: [(b, 1 + (b %% 2)) for b in range(NB)]},
+        recvs={1: {b for b in range(NB) if b %% 2 == 0},
+               2: {b for b in range(NB) if b %% 2 == 1}},
+        block_nbytes=base.nbytes,
+    )
+    out_g = {b: block(b) for b in range(NB)} if pid == 0 else {}
+    t0 = time.perf_counter()
+    recv, sent = blockmove._tcp_exchange(plan_g, out_g, 1)
     grow_s = time.perf_counter() - t0
-    st2 = dict(blockmove.last_move_stats)
-    mine = t.addressable_blocks()
-    ok = all(np.allclose(mine[b][0], vals[b * (CAP // NB)])
-             for b in list(mine)[:8])
+    for b, a in recv.items():
+        assert np.array_equal(a, block(b)), f"grow parity broke at {b}"
+    # shrink: peers 1 and 2 -> pid 0 (multi-source receive)
+    plan_s = blockmove.MovePlan(
+        sends={1: [(b, 0) for b in range(NB) if b %% 2 == 0],
+               2: [(b, 0) for b in range(NB) if b %% 2 == 1]},
+        recvs={0: set(range(NB))},
+        block_nbytes=base.nbytes,
+    )
+    out_s = ({b: block(b) for b in range(NB) if (b %% 2) + 1 == pid}
+             if pid else {})
+    t0 = time.perf_counter()
+    recv, sent2 = blockmove._tcp_exchange(plan_s, out_s, 2)
+    shrink_s = time.perf_counter() - t0
+    for b, a in recv.items():
+        assert np.array_equal(a, block(b)), f"shrink parity broke at {b}"
     print("RESULT " + json.dumps({
-        "pid": pid, "ok": bool(ok),
-        "shrink_s": round(shrink_s, 3), "grow_s": round(grow_s, 3),
-        "shrink_moved": st.get("bytes_sent", 0)
-                        + st.get("bytes_received", 0),
-        "grow_moved": st2.get("bytes_sent", 0)
-                      + st2.get("bytes_received", 0),
-        "transport": st.get("transport"),
+        "pid": pid, "grow_s": round(grow_s, 3),
+        "shrink_s": round(shrink_s, 3),
+        "moved": int(sent + sent2
+                     + sum(a.nbytes for a in recv.values())
+                     + (len(plan_g.recvs.get(pid, ())) * base.nbytes)),
     }), flush=True)
 main()
-''' % (NB, CAP, DIM)
+''' % (NB, ROWS, DIM)
 
 
-def main() -> None:
+def _paced_plan_json() -> str:
+    """A deterministic per-block wire-time injection (5 ms at every
+    blockmove.send hit — a 1 MB block at ~200 MB/s per stream, the
+    realistic single-TCP-stream DCN rate) via the PR-2 fault harness:
+    the bench-only DCN pacing emulation, same spirit as
+    HARMONY_POD_UNIT_LAT_MS. Loopback has no wire time at all, so the
+    'local' arm measures only protocol CPU (bounded by this host's core
+    quota); the paced arm measures the latency-bound regime real DCN
+    streams live in, where overlapping legs is the whole point."""
+    from harmony_tpu.faults import FaultPlan, FaultRule
+
+    return FaultPlan([FaultRule(
+        "blockmove.send", action="delay", delay_sec=0.005, count=-1,
+    )]).to_json()
+
+
+def run_pod(parallel: int, paced: bool) -> "dict":
+    """One 3-process pass at HARMONY_MOVE_PARALLEL=parallel; returns
+    {grow_s, shrink_s} as the max across processes (the exchange is done
+    when the last participant is)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = sanitized_cpu_env(4)
+    env = sanitized_cpu_env(1)
+    env["HARMONY_MOVE_PARALLEL"] = str(parallel)
+    if paced:
+        env["HARMONY_FAULT_PLAN"] = _paced_plan_json()
+    else:
+        env.pop("HARMONY_FAULT_PLAN", None)
     port = free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, f"127.0.0.1:{port}", "2",
-             str(pid), repo],
+            [sys.executable, "-c", WORKER, f"127.0.0.1:{port}",
+             str(NPROCS), str(pid), repo],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
-        for pid in range(2)
+        for pid in range(NPROCS)
     ]
     rows = []
     try:
@@ -91,33 +134,69 @@ def main() -> None:
             line = [ln for ln in out.splitlines()
                     if ln.startswith("RESULT ")]
             rows.append(json.loads(line[0][len("RESULT "):]))
-    except Exception as e:  # noqa: BLE001 - one JSON line, always
+    except Exception:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        raise
+    return {"grow_s": max(r["grow_s"] for r in rows),
+            "shrink_s": max(r["shrink_s"] for r in rows)}
+
+
+def main() -> None:
+    moved_mb = NB * ROWS * DIM * 4 / 1e6
+    arms = {}
+    try:
+        for profile, paced in (("local", False), ("paced_dcn", True)):
+            best = {1: {"grow_s": None, "shrink_s": None},
+                    4: {"grow_s": None, "shrink_s": None}}
+            # interleaved rounds, best-of per arm: this host's throughput
+            # drifts round to round, so serial and parallel alternate
+            # inside every round instead of running as two blocks
+            for _ in range(ROUNDS):
+                for par in (1, 4):
+                    got = run_pod(par, paced)
+                    for k, v in got.items():
+                        cur = best[par][k]
+                        best[par][k] = v if cur is None else min(cur, v)
+            serial, parallel = best[1], best[4]
+            arms[profile] = {
+                "serial": {k: round(v, 3) for k, v in serial.items()},
+                "parallel": {k: round(v, 3) for k, v in parallel.items()},
+                "speedup_grow": round(
+                    serial["grow_s"] / parallel["grow_s"], 2),
+                "speedup_shrink": round(
+                    serial["shrink_s"] / parallel["shrink_s"], 2),
+            }
+    except Exception as e:  # noqa: BLE001 - one JSON line, always
         print(json.dumps({
-            "metric": "cross-process block migration bandwidth",
-            "value": None, "unit": "MB/s moved",
+            "metric": "cross-process block migration, parallel vs serial legs",
+            "value": None, "unit": "MB/s moved (grow, parallel)",
             "error": f"{type(e).__name__}: {e}"[:300],
         }))
         return
-    assert all(r["ok"] for r in rows), rows
-    table_mb = CAP * DIM * 4 / 1e6
-    moved_mb = rows[0]["shrink_moved"] / 1e6  # same plan on both procs
-    wall = max(r["shrink_s"] for r in rows)
-    grow_wall = max(r["grow_s"] for r in rows)
     print(json.dumps({
-        "metric": "cross-process block migration bandwidth",
-        "value": round(moved_mb / wall, 1), "unit": "MB/s moved",
-        "table_mb": round(table_mb, 1), "moved_mb": round(moved_mb, 1),
-        "blocks": NB, "shrink_s": round(wall, 3),
-        "grow_s": round(grow_wall, 3),
-        "grow_mbps": round(moved_mb / grow_wall, 1),
-        "transport": rows[0]["transport"],
-        "note": ("2-process virtual pod, loopback TCP: the protocol + "
-                 "assembly cost floor. Moved bytes are exactly half the "
-                 "table per direction (the O(moved) contract) — the old "
-                 "path replicated the WHOLE table per device"),
+        "metric": "cross-process block migration, parallel vs serial legs",
+        "value": round(moved_mb / arms["local"]["parallel"]["grow_s"], 1),
+        "unit": "MB/s moved (grow, parallel, local)",
+        "moved_mb": round(moved_mb, 1), "blocks": NB, "procs": NPROCS,
+        "rounds": ROUNDS,
+        "local": arms["local"],
+        "paced_dcn": arms["paced_dcn"],
+        "transport": "tcp",
+        "note": ("3 real processes, loopback TCP, jax-KV rendezvous; "
+                 "transport layer only (this host's CPU backend cannot "
+                 "run the multi-process SPMD rebuild — see ROADMAP). "
+                 "Every received block verified byte-identical in both "
+                 "modes; grow = multi-peer send (HARMONY_MOVE_PARALLEL=4 "
+                 "overlaps per-peer legs + splits oversized legs into "
+                 "striped streams). 'local' is pure protocol CPU and is "
+                 "capped by this host's ~2-core quota (thread scaling "
+                 "ceiling ~1.4x measured); 'paced_dcn' injects a "
+                 "deterministic 5 ms/block wire time at blockmove.send "
+                 "(fault-harness delay rule, HARMONY_POD_UNIT_LAT_MS "
+                 "precedent) — the latency-bound regime real DCN streams "
+                 "occupy, where overlapped legs shine"),
     }))
 
 

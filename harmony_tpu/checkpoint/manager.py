@@ -31,7 +31,7 @@ import os
 import shutil
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -55,6 +55,179 @@ def reset_read_stats() -> None:
     with _READ_STATS_LOCK:
         read_stats["blocks_read"] = 0
         read_stats["bytes_read"] = 0
+
+
+# -- parallel block I/O (HARMONY_CHKP_IO_THREADS) -------------------------
+#
+# Every block of a checkpoint is an independent file with an independent
+# checksum, so write/read legs parallelize freely: the file I/O and the
+# CRC both run outside the GIL (native codec / zlib over a memoryview),
+# and one block's CRC overlaps the next block's disk I/O on the pool.
+# Serial (threads == 1) takes the exact pre-parallel code path — the
+# bit-identical fallback. In-flight bytes on the WRITE side are bounded
+# (backpressure against the D2H producer) so a slow disk never turns
+# into an unbounded host-memory spike of staged blocks.
+
+#: write-side in-flight budget per worker thread (bytes)
+_INFLIGHT_PER_THREAD = 256 << 20
+
+
+def _chkp_io_threads() -> int:
+    """Worker count for checkpoint block I/O (HARMONY_CHKP_IO_THREADS;
+    1 = the serial, bit-identical fallback)."""
+    try:
+        return max(1, int(os.environ.get("HARMONY_CHKP_IO_THREADS", "4")))
+    except ValueError:
+        return 4
+
+
+def _observe_io(op: str, seconds: float) -> None:
+    """harmony_chkp_io_seconds{op}: per-block checkpoint I/O latency
+    (op = write | read | partial_read). Best-effort — observability
+    must never fail a checkpoint."""
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().histogram(
+            "harmony_chkp_io_seconds",
+            "Per-block checkpoint I/O latency",
+            ("op",),
+        ).labels(op=op).observe(seconds)
+    except Exception:
+        pass
+
+
+def _set_inflight_gauge(nbytes: int) -> None:
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().gauge(
+            "harmony_chkp_inflight_bytes",
+            "Bytes of checkpoint blocks staged in host memory awaiting "
+            "their write leg (write-side backpressure budget)",
+        ).set(float(nbytes))
+    except Exception:
+        pass
+
+
+class _InflightBudget:
+    """Write-side backpressure: ``acquire(nbytes)`` blocks until the
+    in-flight total fits under the cap, so the D2H producer stalls
+    instead of buffering the whole table ahead of a slow disk. A single
+    block larger than the cap is admitted alone (never deadlocks)."""
+
+    def __init__(self, cap_bytes: int) -> None:
+        self._cap = max(1, int(cap_bytes))
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._inflight > 0 and self._inflight + n > self._cap:
+                self._cv.wait()
+            self._inflight += n
+            _set_inflight_gauge(self._inflight)
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._inflight -= n
+            _set_inflight_gauge(self._inflight)
+            self._cv.notify_all()
+
+
+def _stage_blocks(staging: str, arrs, policy: RetryPolicy) -> Dict[str, int]:
+    """Write an ORDERED iterable of ``(bid, host block)`` pairs under
+    ``staging`` and return the manifest checksum map ``{str(bid): crc}``.
+
+    Serial when HARMONY_CHKP_IO_THREADS == 1. Otherwise the caller's
+    iterator keeps producing (device D2H + packing) on THIS thread while
+    block write + CRC legs run on the pool — producing stalls only when
+    the in-flight budget is exhausted. Per-block retry (chkp.block_write
+    site + RetryPolicy) runs inside each leg, unchanged."""
+    threads = _chkp_io_threads()
+    if threads == 1:
+        out: Dict[str, int] = {}
+        for bid, arr in arrs:
+            t0 = time.monotonic()
+            out[str(bid)] = _write_block(staging, bid, arr, policy)
+            _observe_io("write", time.monotonic() - t0)
+        return out
+    from concurrent.futures import Future, ThreadPoolExecutor
+
+    budget = _InflightBudget(threads * _INFLIGHT_PER_THREAD)
+    failed = threading.Event()
+    futures: Dict[int, "Future"] = {}
+
+    def write_one(bid: int, arr: np.ndarray) -> int:
+        try:
+            t0 = time.monotonic()
+            crc = _write_block(staging, bid, arr, policy)
+            _observe_io("write", time.monotonic() - t0)
+            return crc
+        except BaseException:
+            failed.set()  # stop the producer: no point staging more D2H
+            raise
+        finally:
+            budget.release(arr.nbytes)
+
+    with ThreadPoolExecutor(max_workers=threads,
+                            thread_name_prefix="chkp-io") as pool:
+        for bid, arr in arrs:
+            if failed.is_set():
+                break
+            budget.acquire(arr.nbytes)
+            futures[bid] = pool.submit(write_one, bid, arr)
+    out = {}
+    first_err: Optional[BaseException] = None
+    for bid in sorted(futures):
+        try:
+            out[str(bid)] = futures[bid].result()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
+    return out
+
+
+#: blocks per incremental import_blocks call on the pipelined restore
+#: path — small enough that device staging overlaps the tail of the
+#: reads, large enough that the jitted scatter amortizes
+_RESTORE_CHUNK_BLOCKS = 16
+
+
+def _fetch_blocks(d: str, bids, crcs: Dict[str, int],
+                  policy: RetryPolicy, op: str = "read") -> Dict[int, np.ndarray]:
+    """Read many blocks from checkpoint dir ``d`` (parallel when
+    HARMONY_CHKP_IO_THREADS > 1; read order is irrelevant — every block
+    is independently CRC-verified against the manifest). Returns
+    ``{bid: arr}``; the first failing block's error is raised after
+    outstanding reads are cancelled or drained."""
+
+    def read_one(bid: int):
+        t0 = time.monotonic()
+        arr = _read_block(d, bid, expected_crc=crcs.get(str(bid)),
+                          policy=policy)
+        _observe_io(op, time.monotonic() - t0)
+        return bid, arr
+
+    bids = list(bids)
+    threads = min(_chkp_io_threads(), max(1, len(bids)))
+    if threads == 1:
+        return dict(read_one(b) for b in bids)
+    from concurrent.futures import ThreadPoolExecutor, as_completed
+
+    pool = ThreadPoolExecutor(max_workers=threads,
+                              thread_name_prefix="chkp-io")
+    try:
+        futs = [pool.submit(read_one, b) for b in bids]
+        out: Dict[int, np.ndarray] = {}
+        for f in as_completed(futs):
+            bid, arr = f.result()  # first corrupt/lost block raises here
+            out[bid] = arr
+        return out
+    finally:
+        pool.shutdown(wait=True, cancel_futures=True)
 
 
 def _account_read(arr: np.ndarray) -> None:
@@ -429,26 +602,30 @@ class CheckpointManager:
             if info.sampling_ratio < 1.0:
                 keep = max(1, int(block_size * info.sampling_ratio))
             sparse = info.table_config.sparse
-            # pop as we go: each device block is released right after its
-            # D2H transfer instead of pinning the snapshot until the end.
-            checksums: Dict[str, int] = {}
             retained: Optional[Dict[int, np.ndarray]] = (
                 {} if self.recovery_retain and keep is None else None
             )
             policy = RetryPolicy.from_env()
-            for bid in sorted(snap):
-                item = snap.pop(bid)
-                if sparse:
-                    sk, v = item
-                    arr = _pack_hash_block(np.asarray(sk), np.asarray(v))
-                else:
-                    arr = np.asarray(item)
-                    arr = arr[:keep] if keep else arr
-                checksums[str(bid)] = _write_block(staging, bid, arr,
-                                                   policy)
-                if retained is not None:
-                    retained[bid] = arr
-            info.block_checksums = checksums
+
+            def host_blocks():
+                # pop as we go: each device block is released right after
+                # its D2H transfer instead of pinning the snapshot until
+                # the end. Runs on THIS thread (the producer of the
+                # parallel write pool in _stage_blocks).
+                for bid in sorted(snap):
+                    item = snap.pop(bid)
+                    if sparse:
+                        sk, v = item
+                        arr = _pack_hash_block(np.asarray(sk), np.asarray(v))
+                    else:
+                        arr = np.asarray(item)
+                        arr = arr[:keep] if keep else arr
+                    if retained is not None:
+                        retained[bid] = arr
+                    yield bid, arr
+
+            info.block_checksums = _stage_blocks(staging, host_blocks(),
+                                                 policy)
             if retained is not None:
                 _recovery_put(info.table_config.table_id, info.chkp_id,
                               retained)
@@ -590,22 +767,25 @@ class CheckpointManager:
             os.makedirs(staging, exist_ok=True)  # processes race; shared FS
             sparse = info.table_config.sparse
             mine = handle.table.addressable_blocks()
-            my_crcs: Dict[str, int] = {}
             policy = RetryPolicy.from_env()
             retained: Optional[Dict[int, np.ndarray]] = (
                 {} if self.recovery_retain else None
             )
-            for bid in sorted(mine):
-                item = mine[bid]
-                if sparse:
-                    arr = _pack_hash_block(
-                        np.asarray(item[0]), np.asarray(item[1])
-                    )
-                else:
-                    arr = np.asarray(item)
-                my_crcs[str(bid)] = _write_block(staging, bid, arr, policy)
-                if retained is not None:
-                    retained[bid] = arr
+
+            def host_blocks():
+                for bid in sorted(mine):
+                    item = mine[bid]
+                    if sparse:
+                        arr = _pack_hash_block(
+                            np.asarray(item[0]), np.asarray(item[1])
+                        )
+                    else:
+                        arr = np.asarray(item)
+                    if retained is not None:
+                        retained[bid] = arr
+                    yield bid, arr
+
+            my_crcs = _stage_blocks(staging, host_blocks(), policy)
             if retained is not None:
                 _recovery_put(info.table_config.table_id, chkp_id, retained)
             # Per-process checksum sidecar: only THIS process knows the
@@ -827,20 +1007,58 @@ class CheckpointManager:
         if table_id is not None:
             cfg = cfg.replace(table_id=table_id)
         handle = master.create_table(cfg, associators, data_axis)
+        pool = None
         try:
+            from harmony_tpu.parallel.mesh import mesh_spans_processes
+
             spec = handle.table.spec
-            blocks: Dict[int, np.ndarray] = {}
             crcs = info.block_checksums or {}
             policy = RetryPolicy.from_env()
+            threads = min(_chkp_io_threads(), max(1, len(info.block_ids)))
+            # Chunked import is single-process only: import_blocks on a
+            # multi-process mesh is an SPMD-collective dispatch, and the
+            # chunk COUNT here derives from this process's local
+            # HARMONY_CHKP_IO_THREADS — env skew across the pod would
+            # diverge the collective sequence and wedge the restore.
+            # Spanning meshes keep the single import call (reads still
+            # parallel via _fetch_blocks below).
+            pipelined = (threads > 1 and not cfg.sparse
+                         and info.sampling_ratio >= 1.0
+                         and not mesh_spans_processes(handle.table.mesh))
+            raw: Dict[int, Any] = {}
+            if pipelined:
+                # dense full-ratio: stream reads off the I/O pool and
+                # install finished chunks while later reads are still on
+                # disk — restore is pipeline latency, not reads + import.
+                # Chunks are formed in BLOCK-ID order (not completion
+                # order) so repeated restores stay deterministic.
+                from concurrent.futures import ThreadPoolExecutor
+
+                def read_one(bid: int):
+                    t0 = time.monotonic()
+                    arr = _read_block(d, bid,
+                                      expected_crc=crcs.get(str(bid)),
+                                      policy=policy)
+                    _observe_io("read", time.monotonic() - t0)
+                    return arr
+
+                pool = ThreadPoolExecutor(max_workers=threads,
+                                          thread_name_prefix="chkp-io")
+                raw = {bid: pool.submit(read_one, bid)
+                       for bid in info.block_ids}
+            else:
+                # sparse / sampled need per-block post-processing against
+                # table state; read everything first (still parallel)
+                raw = _fetch_blocks(d, info.block_ids, crcs, policy)
+            blocks: Dict[int, np.ndarray] = {}
             for bid in info.block_ids:
-                arr = _read_block(d, bid, expected_crc=crcs.get(str(bid)),
-                                  policy=policy)
+                arr = raw.pop(bid)
+                if pipelined:
+                    arr = arr.result()
                 if cfg.sparse:
                     blocks[bid] = _unpack_hash_block(arr, spec)
                     continue
                 if arr.shape[0] < spec.block_size:
-                    from harmony_tpu.parallel.mesh import mesh_spans_processes
-
                     if mesh_spans_processes(handle.table.mesh):
                         raise ValueError(
                             f"checkpoint {chkp_id} is sampled; the init-pad "
@@ -853,10 +1071,16 @@ class CheckpointManager:
                     full[: arr.shape[0]] = arr
                     arr = full
                 blocks[bid] = arr
+                if pipelined and len(blocks) >= _RESTORE_CHUNK_BLOCKS:
+                    handle.table.import_blocks(blocks)
+                    blocks = {}
             handle.table.import_blocks(blocks)
         except BaseException:
             handle.drop()  # no half-restored orphan tables
             raise
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True, cancel_futures=True)
         return handle
 
     def restore_partial(
@@ -919,6 +1143,7 @@ class CheckpointManager:
                             "blocks_read": nb, "bytes_read": -1}
         local = recovery_blocks(chkp_id) or {}
         handle = master.create_table(cfg, associators, data_axis)
+        pool = None
         try:
             arr_shape = handle.table.array.shape
             sharding = handle.table.sharding
@@ -933,29 +1158,67 @@ class CheckpointManager:
             stats = {"partial": 1, "blocks_total": len(info.block_ids),
                      "blocks_needed": len(needed), "blocks_local": 0,
                      "blocks_read": 0, "bytes_read": 0}
-            blocks: Dict[int, np.ndarray] = {}
-            for bid in sorted(needed):
-                cached = local.get(bid)
-                if cached is not None:
-                    blocks[bid] = cached
-                    stats["blocks_local"] += 1
-                    continue
+
+            def read_one(bid: int) -> np.ndarray:
                 if faults.armed():
                     faults.site("chkp.partial_read", block=bid,
                                 chkp_id=chkp_id)
+                t0 = time.monotonic()
                 arr = _read_block(d, bid, expected_crc=crcs.get(str(bid)),
                                   policy=policy)
+                _observe_io("partial_read", time.monotonic() - t0)
                 if arr.shape[0] < handle.table.spec.block_size:
                     raise CheckpointCorruptError(
                         f"partial restore of {chkp_id}: block {bid} is "
                         f"short ({arr.shape[0]} rows) in a full-ratio "
                         "checkpoint"
                     )
-                blocks[bid] = arr
-                stats["blocks_read"] += 1
-                stats["bytes_read"] += int(arr.nbytes)
+                return arr
+
+            # lost blocks stream off the I/O pool while cached blocks —
+            # and, below, per-shard device_put staging — proceed on this
+            # thread: lost-block recovery is pipeline latency, not
+            # sum-of-latencies
+            to_read = sorted(b for b in needed if b not in local)
+            futures: Dict[int, Any] = {}
+            if to_read and _chkp_io_threads() > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(
+                    max_workers=min(_chkp_io_threads(), len(to_read)),
+                    thread_name_prefix="chkp-io")
+                futures = {bid: pool.submit(read_one, bid)
+                           for bid in to_read}
+
+            resolved: Dict[int, np.ndarray] = {}
+
+            def fetch(bid: int) -> np.ndarray:
+                got = resolved.get(bid)
+                if got is not None:
+                    return got
+                cached = local.get(bid)
+                if cached is not None:
+                    arr = cached
+                    stats["blocks_local"] += 1
+                else:
+                    fut = futures.get(bid)
+                    arr = fut.result() if fut is not None else read_one(bid)
+                    stats["blocks_read"] += 1
+                    stats["bytes_read"] += int(arr.nbytes)
+                resolved[bid] = arr
+                return arr
+
             if not spans:
-                handle.table.import_blocks(blocks)
+                # chunked install in block-id order: device staging of a
+                # finished chunk overlaps the still-outstanding reads
+                chunk: Dict[int, np.ndarray] = {}
+                for bid in sorted(needed):
+                    chunk[bid] = fetch(bid)
+                    if pool is not None and \
+                            len(chunk) >= _RESTORE_CHUNK_BLOCKS:
+                        handle.table.import_blocks(chunk)
+                        chunk = {}
+                handle.table.import_blocks(chunk)
             else:
                 # per-process shard assembly: this process provides only
                 # its addressable shards; peers provide theirs — the one
@@ -969,7 +1232,7 @@ class CheckpointManager:
                         arr_shape).items():
                     start, stop = axis0_bounds(idx, arr_shape[0])
                     stacked = np.stack(
-                        [np.asarray(blocks[i]) for i in range(start, stop)]
+                        [np.asarray(fetch(i)) for i in range(start, stop)]
                     ).astype(dtype, copy=False)
                     shards.append(_jax.device_put(stacked, dev))
                     devs.append(dev)
@@ -980,6 +1243,9 @@ class CheckpointManager:
         except BaseException:
             handle.drop()  # no half-restored orphan tables
             raise
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True, cancel_futures=True)
         return handle, stats
 
     def delete(self, chkp_id: str) -> None:

@@ -66,8 +66,94 @@ _MOVE_SEQ = itertools.count()
 last_move_stats: Dict[str, Any] = {}
 
 # transport-leg retries taken by the current exchange (folded into
-# last_move_stats["transport_retries"] when the migration completes)
+# last_move_stats["transport_retries"] when the migration completes);
+# legs run concurrently under HARMONY_MOVE_PARALLEL, so every increment
+# holds _RETRY_LOCK
 _LEG_RETRIES: List[int] = [0]
+_RETRY_LOCK = threading.Lock()
+
+#: Transport I/O chunk: the receiver's per-recv_into cap AND the
+#: sender's head+body coalesce threshold share it, so both sides agree
+#: on what "small enough to copy once" means.
+_IO_CHUNK = 1 << 20
+
+#: A leg carrying more than this splits into multiple framed streams
+#: when the worker pool has spare parallelism — one TCP stream rarely
+#: fills a DCN link; the receiver keys frames by block id, so streams
+#: to the same destination are order-free.
+_LEG_SPLIT_BYTES = 16 << 20
+
+
+def _move_parallel() -> int:
+    """Bounded worker count for concurrent transport legs
+    (HARMONY_MOVE_PARALLEL; 1 = the serial, bit-identical fallback)."""
+    try:
+        return max(1, int(os.environ.get("HARMONY_MOVE_PARALLEL", "4")))
+    except ValueError:
+        return 4
+
+
+def _observe_leg_seconds(transport: str, seconds: float) -> None:
+    """harmony_move_leg_seconds{transport}: per-leg transfer latency
+    (tcp: one framed stream; file: one staged block op). Best-effort —
+    observability must never fail a migration."""
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().histogram(
+            "harmony_move_leg_seconds",
+            "Block-migration transport leg latency",
+            ("transport",),
+        ).labels(transport=transport).observe(seconds)
+    except Exception:
+        pass
+
+
+class _PoolStopped(Exception):
+    """Internal marker: a queued leg skipped because a sibling already
+    failed — never surfaced (the sibling's real error is raised)."""
+
+
+def _run_pooled(items: Sequence[Any], fn, parallel: int, label: str) -> List[Any]:
+    """Run ``fn(item)`` for every item: inline in item order when
+    ``parallel`` is 1 (the serial fallback — no pool, no reordering),
+    else on a bounded worker pool. Returns results in item order and
+    raises the first (by item order) real failure — once any leg fails,
+    queued legs are skipped so a dead peer doesn't burn every remaining
+    leg's full retry cycle before the error escalates (legs already
+    running finish their own bounded retry). Per-item retry/fault
+    semantics live inside ``fn``."""
+    if parallel <= 1 or len(items) <= 1:
+        return [fn(it) for it in items]
+    from concurrent.futures import ThreadPoolExecutor
+
+    stop = threading.Event()
+
+    def guarded(it):
+        if stop.is_set():
+            raise _PoolStopped()
+        try:
+            return fn(it)
+        except BaseException:
+            stop.set()
+            raise
+
+    with ThreadPoolExecutor(max_workers=min(parallel, len(items)),
+                            thread_name_prefix=label) as pool:
+        futs = [pool.submit(guarded, it) for it in items]
+        out: List[Any] = []
+        first_err: Optional[BaseException] = None
+        for f in futs:
+            try:
+                out.append(f.result())
+            except _PoolStopped:
+                pass  # superseded by the sibling's real error
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return out
 
 
 class MigrationTransportError(InfraTransientError):
@@ -270,19 +356,47 @@ def _unpack_frame(buf: bytes) -> Tuple[int, np.ndarray]:
 
 
 def _send_frame(sock: socket.socket, block: int, arr: np.ndarray) -> None:
+    """One frame, ONE write: two back-to-back sendall calls put the tiny
+    length-prefixed header in its own segment, which Nagle holds back
+    waiting for the receiver's ACK of the previous frame's payload —
+    a per-frame RTT stall. Small payloads coalesce into a single buffer
+    (one syscall); large ones go through sendmsg, the writev-style
+    gather that submits header and zero-copy payload together."""
     head, body = _frame_parts(block, arr)
-    sock.sendall(head)
-    sock.sendall(body)
+    body_mv = body if isinstance(body, memoryview) else memoryview(body)
+    if len(body_mv) <= _IO_CHUNK:
+        sock.sendall(b"".join((head, body_mv)))  # ONE copy, one syscall
+        return
+    try:
+        sent = sock.sendmsg([head, body_mv])
+    except AttributeError:  # pragma: no cover - platforms without sendmsg
+        sock.sendall(head)
+        sock.sendall(body_mv)
+        return
+    # sendmsg may stop short (socket buffer full): finish the remainder
+    # with sendall, which loops internally
+    if sent < len(head):
+        sock.sendall(head[sent:])
+        sock.sendall(body_mv)
+    elif sent < len(head) + len(body_mv):
+        sock.sendall(body_mv[sent - len(head):])
 
 
-def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    """Exactly ``n`` bytes into ONE preallocated buffer via recv_into —
+    the old ``bytearray += recv()`` loop copied every chunk twice (recv
+    allocation + extend) and once more for the final bytes(). Returns
+    the buffer itself (callers frombuffer/parse it in place), or None
+    on EOF before the read completes (same contract as before)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:got + min(_IO_CHUNK, n - got)])
+        if r == 0:
             return None
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
+    return buf
 
 
 class _TcpReceiver:
@@ -339,6 +453,11 @@ class _TcpReceiver:
     def _drain(self, conn: socket.socket) -> None:
         try:
             with conn:
+                try:
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass  # exotic transports without the option
                 while True:
                     raw = _read_exact(conn, 4)
                     if raw is None:
@@ -406,12 +525,38 @@ class _TcpReceiver:
             pass
 
 
+def _leg_streams(by_dst: Dict[int, List[int]],
+                 outgoing: Dict[int, np.ndarray],
+                 parallel: int) -> List[Tuple[int, List[int]]]:
+    """The exchange's work list: ``(dst, blocks)`` per framed stream, in
+    deterministic order. Serial keeps exactly one stream per destination
+    (the pre-parallel wire behavior, byte for byte); with spare
+    parallelism an oversized leg splits into up to ``parallel``
+    round-robin striped streams of >= _LEG_SPLIT_BYTES each — the
+    receiver keys frames by block id, so stream order is irrelevant."""
+    legs: List[Tuple[int, List[int]]] = []
+    for dst in sorted(by_dst):
+        blocks = by_dst[dst]
+        nstreams = 1
+        if parallel > 1:
+            total = sum(outgoing[b].nbytes for b in blocks)
+            nstreams = max(1, min(parallel, len(blocks),
+                                  int(total // _LEG_SPLIT_BYTES)))
+        for i in range(nstreams):
+            stripe = blocks[i::nstreams]
+            if stripe:
+                legs.append((dst, stripe))
+    return legs
+
+
 def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                   seq: int) -> Tuple[Dict[int, np.ndarray], int]:
-    """Run this process's legs of the plan over TCP. ``outgoing`` maps
-    block -> host array for every block this process must send. Returns
-    (received blocks, wire bytes sent — counted PER LEG, so a block
-    fanned out to N destinations counts N times)."""
+    """Run this process's legs of the plan over TCP, concurrently across
+    destinations on a bounded pool (HARMONY_MOVE_PARALLEL workers; 1 =
+    the serial fallback). ``outgoing`` maps block -> host array for every
+    block this process must send. Returns (received blocks, wire bytes
+    sent — counted PER LEG, so a block fanned out to N destinations
+    counts N times)."""
     client = _kv_client()
     if client is None:
         raise RuntimeError(
@@ -428,17 +573,22 @@ def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
     if receiver is not None:
         client.key_value_set(key, f"{_my_host()}:{receiver.port}")
     try:
-        # group sends by destination: one connection per peer, all its
-        # blocks streamed over it
+        # group sends by destination: one connection per stream, a
+        # destination's blocks striped over 1..parallel streams
         by_dst: Dict[int, List[int]] = {}
         for b, dst in my_sends:
             by_dst.setdefault(dst, []).append(b)
-        wire_sent = 0
+        parallel = _move_parallel()
+        wire_sent = [0]
         retries = [0]
+        agg_lock = threading.Lock()
         policy = _retry_policy()
-        for dst in sorted(by_dst):
 
-            def attempt(dst=dst):
+        def run_leg(leg: Tuple[int, List[int]]) -> None:
+            dst, blocks = leg
+            t0 = time.monotonic()
+
+            def attempt():
                 # the WHOLE leg retries on a fresh connection (address
                 # re-resolved: the peer may have rebound); the receiver
                 # keys by block id, so frames that landed before a broken
@@ -453,14 +603,20 @@ def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                 with socket.create_connection(
                         (host, int(port)),
                         timeout=max(0.1, deadline - time.monotonic())) as sock:
-                    for b in by_dst[dst]:
+                    try:
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                    except OSError:
+                        pass
+                    for b in blocks:
                         if faults.armed():
                             faults.site("blockmove.send", block=b,
                                         dst=dst, seq=seq)
                         _send_frame(sock, b, outgoing[b])
 
-            def on_retry(attempt_no, err, dst=dst):
-                retries[0] += 1
+            def on_retry(attempt_no, err):
+                with agg_lock:
+                    retries[0] += 1
 
             try:
                 call_with_retry(
@@ -470,9 +626,16 @@ def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
             except RetryError as e:
                 raise MigrationTransportError(
                     f"block migration to process {dst} (blocks "
-                    f"{by_dst[dst][:8]}...) failed: {e}") from e
-            wire_sent += sum(outgoing[b].nbytes for b in by_dst[dst])
-        _LEG_RETRIES[0] += retries[0]
+                    f"{blocks[:8]}...) failed: {e}") from e
+            with agg_lock:
+                wire_sent[0] += sum(outgoing[b].nbytes for b in blocks)
+            _observe_leg_seconds("tcp", time.monotonic() - t0)
+
+        _run_pooled(_leg_streams(by_dst, outgoing, parallel), run_leg,
+                    parallel, "blockmove-leg")
+        wire_sent = wire_sent[0]
+        with _RETRY_LOCK:
+            _LEG_RETRIES[0] += retries[0]
         if receiver is not None:
             try:
                 return receiver.wait(deadline), wire_sent
@@ -530,10 +693,18 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
     my_sends = {b for b, _ in plan.sends.get(pid, [])}
     written = 0
     policy = _retry_policy()
+    parallel = _move_parallel()
+
+    def on_retry(attempt_no, err_):
+        with _RETRY_LOCK:
+            _LEG_RETRIES[0] += 1
+
     if my_sends:
         try:
             os.makedirs(stage, exist_ok=True)
-            for b in sorted(my_sends):
+
+            def stage_one(b: int) -> int:
+                t0 = time.monotonic()
                 tmp = os.path.join(stage, f"b{b}.blk.writing-{pid}")
                 dst = os.path.join(stage, f"b{b}.blk")
                 # pre-clear THIS writer's stale files from a crashed prior
@@ -546,7 +717,7 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                     except FileNotFoundError:
                         pass
 
-                def write_block(b=b, tmp=tmp, dst=dst):
+                def write_block():
                     # the frame codec (not np.save): extension dtypes
                     # (bfloat16/fp8) round-trip by NAME, where np.save
                     # raises on them outright; header and payload are
@@ -560,9 +731,6 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                         f.write(body)
                     os.rename(tmp, dst)
 
-                def on_retry(attempt_no, err_):
-                    _LEG_RETRIES[0] += 1
-
                 try:
                     call_with_retry(write_block, policy,
                                     op="blockmove.stage_write",
@@ -571,7 +739,11 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                     raise MigrationTransportError(
                         f"staging block {b} under {stage} failed: {e}"
                     ) from e
-                written += outgoing[b].nbytes
+                _observe_leg_seconds("file", time.monotonic() - t0)
+                return outgoing[b].nbytes
+
+            written = sum(_run_pooled(sorted(my_sends), stage_one,
+                                      parallel, "blockmove-stage"))
         except BaseException as e:  # noqa: BLE001 - reported via the fence
             err = e
     if member:
@@ -588,9 +760,11 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
             )
     received: Dict[int, np.ndarray] = {}
     try:
-        for b in sorted(plan.recvs.get(pid, set())):
 
-            def read_block(b=b):
+        def fetch_one(b: int) -> Tuple[int, np.ndarray]:
+            t0 = time.monotonic()
+
+            def read_block():
                 if faults.armed():
                     faults.site("blockmove.stage_read", block=b,
                                 seq=seq)
@@ -601,11 +775,8 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                         f"staged frame b{b}.blk names block {bid}")
                 return arr
 
-            def on_retry(attempt_no, err_):
-                _LEG_RETRIES[0] += 1
-
             try:
-                received[b] = call_with_retry(
+                arr = call_with_retry(
                     read_block, policy, op="blockmove.stage_read",
                     on_retry=on_retry,
                 )
@@ -613,6 +784,12 @@ def _file_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                 raise MigrationTransportError(
                     f"reading staged block {b} under {stage} failed: {e}"
                 ) from e
+            _observe_leg_seconds("file", time.monotonic() - t0)
+            return b, arr
+
+        received = dict(_run_pooled(sorted(plan.recvs.get(pid, set())),
+                                    fetch_one, parallel,
+                                    "blockmove-fetch"))
     except BaseException as e:  # noqa: BLE001 - reported via the fence
         err = e
     if member:
