@@ -401,6 +401,84 @@ def measure_state_movement() -> "dict | None":
         shutil.rmtree(root, ignore_errors=True)
 
 
+def measure_sparse_hot_path() -> "dict | None":
+    """Sparse device-hot-path probe (tracked round over round in BENCH
+    json): a small embedding-SGD table driven fused (FusedSparseStep,
+    one donated-buffer program per batch) and unfused (ModelAccessor
+    host round trip), interleaved, on the CPU backend. Returns fused/
+    unfused samples-per-sec, the ratio, the unfused arm's measured
+    per-phase pull/comp/push seconds, and asserts loss parity — or None
+    (the bench line must never die for its sparse-path hook). Full A/B:
+    benchmarks/sparse_step_bench.py (SPARSE_STEP_r07.json)."""
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.dolphin import ModelAccessor
+        from harmony_tpu.parallel import build_mesh
+        from harmony_tpu.table import DenseTable, TableSpec
+
+        mesh = build_mesh(jax.devices("cpu")[:1])
+        rows, width, batch, nb = 2048, 32, 256, 30
+        rng = np.random.default_rng(0)
+        batches = [
+            (rng.integers(0, rows, batch).astype(np.int32),
+             rng.normal(size=(batch, width)).astype(np.float32))
+            for _ in range(nb)
+        ]
+
+        def table():
+            return DenseTable(
+                TableSpec(TableConfig(table_id="bench-sparse",
+                                      capacity=rows, value_shape=(width,),
+                                      num_blocks=32)), mesh)
+
+        def compute(r, t):
+            err = r - t
+            return -0.05 * err, {"loss": jnp.mean(jnp.sum(err * err, -1))}
+
+        acc_f = ModelAccessor(table())
+        fs = acc_f.fused_step(compute, signature=("bench-sparse-hook",))
+        fs.run_batches(batches[:2])  # compile warmup
+        t0 = time.perf_counter()
+        l_f = [float(a["loss"]) for a in fs.run_batches(batches)]
+        fused_s = time.perf_counter() - t0
+
+        acc = ModelAccessor(table())
+        comp = jax.jit(compute)
+
+        def one(keys, tgt):
+            rows_h = acc.pull(keys)
+            delta, aux = jax.block_until_ready(
+                comp(jnp.asarray(rows_h), jnp.asarray(tgt)))
+            acc.push(keys, np.asarray(delta))
+            return float(aux["loss"])
+
+        for k, t in batches[:2]:
+            one(k, t)
+        acc.get_and_reset_times()
+        t0 = time.perf_counter()
+        l_u = [one(k, t) for k, t in batches]
+        unfused_s = time.perf_counter() - t0
+        pull_s, push_s = acc.get_and_reset_times()
+        if l_f != l_u:
+            return {"error": "fused/unfused loss parity broke"}
+        n = nb * batch
+        return {
+            "fused_sps": round(n / fused_s, 1),
+            "unfused_sps": round(n / unfused_s, 1),
+            "ratio": round(unfused_s / fused_s, 2),
+            "unfused_pull_ms": round(pull_s * 1000, 2),
+            "unfused_push_ms": round(push_s * 1000, 2),
+            "unfused_comp_ms": round(
+                max(unfused_s - pull_s - push_s, 0.0) * 1000, 2),
+            "loss_parity": "bit-identical",
+        }
+    except Exception:
+        return None
+
+
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
          job_walls: dict | None = None, probe_log: list | None = None) -> None:
     if error:
@@ -491,6 +569,12 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # exchange) tracked beside throughput, so future PRs see
         # recovery-path regressions in the same trajectory
         line["state_movement"] = sm
+    sp = measure_sparse_hot_path()
+    if sp is not None:
+        # fused-vs-unfused sparse step throughput + the unfused arm's
+        # measured per-phase pull/comp/push split, tracked round over
+        # round so device-hot-path regressions land in the trajectory
+        line["sparse_hot_path"] = sp
     print(json.dumps(line))
 
 

@@ -164,11 +164,10 @@ class LDATrainer(Trainer):
 
     def init_global_settings(self, ctx: TrainerContext) -> None:
         if ctx.local_table is not None:
-            spec = ctx.local_table.spec
             unset = jnp.full((self.num_docs, self.max_doc_len), -1, jnp.int32)
-            ctx.local_table.apply_step(
-                lambda arr, v: (jax.jit(spec.write_all)(arr, v), None), unset
-            )
+            # table-level write_all: the old per-call jax.jit(spec.write_all)
+            # lambda built a fresh jit wrapper (and retraced) every init
+            ctx.local_table.write_all(unset)
 
     # -- pure compute -----------------------------------------------------
 

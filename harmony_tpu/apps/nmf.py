@@ -19,7 +19,6 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -87,10 +86,9 @@ class NMFTrainer(Trainer):
             ctx.model_table.multi_update(list(range(self.num_cols)), r0)
         if ctx.local_table is not None:
             l0 = rng.uniform(0, self.init_scale, (self.num_rows, self.rank)).astype(np.float32)
-            spec = ctx.local_table.spec
-            ctx.local_table.apply_step(
-                lambda arr, v: (jax.jit(spec.write_all)(arr, v), None), jnp.asarray(l0)
-            )
+            # table-level write_all: the old per-call jax.jit(spec.write_all)
+            # lambda built a fresh jit wrapper (and retraced) every init
+            ctx.local_table.write_all(l0)
 
     def hyperparams(self) -> Dict[str, float]:
         return {"lr": self._lr}

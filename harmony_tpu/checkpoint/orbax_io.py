@@ -20,7 +20,6 @@ import json
 import os
 from typing import Optional, Sequence
 
-import jax
 import numpy as np
 
 from harmony_tpu.config.base import ConfigBase
@@ -70,11 +69,10 @@ def load_orbax(
                 f"({cfg.capacity}, {spec.value_shape})"
             )
         # whole-table key-order write: write_all is a reshape for range
-        # tables and ONE scatter for hash tables — not per-key puts
-        handle.table.apply_step(
-            lambda arr, v: (jax.jit(spec.write_all)(arr, v), None),
-            values,
-        )
+        # tables and ONE scatter for hash tables — not per-key puts; the
+        # table-level method rides its jit cache instead of building a
+        # fresh jax.jit wrapper per restore
+        handle.table.write_all(values)
     except BaseException:
         handle.drop()  # no half-restored orphan tables
         raise

@@ -195,6 +195,18 @@ class TrainerParams(ConfigBase):
     # background thread's device_puts would break the deterministic
     # pod-wide dispatch order.
     input_prefetch: bool = True
+    # Fused device hot path (dolphin/worker.py): compile each batch's
+    # PULL -> COMP -> PUSH into ONE jitted program with the table buffer
+    # donated (the dense SPMD fast path's contract). Default ON; OFF
+    # selects the unfused per-phase fallback — three separately-dispatched
+    # programs with a host round-trip between phases (the reference's
+    # ModelAccessor shape), bit-identical losses for a fixed seed, and
+    # REAL measured pull/push/comp phase seconds instead of the fused
+    # path's probe-derived split. The process-wide HARMONY_FUSED_STEP env
+    # knob (0/1) overrides for operator rollback. Multi-process meshes
+    # keep the fused path regardless: the unfused host round-trip would
+    # need every process to materialize cross-host shards.
+    fused_step: bool = True
     app_params: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -6,7 +6,7 @@ from harmony_tpu.dolphin.accessor import (
     make_accessor,
 )
 from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
-from harmony_tpu.dolphin.worker import WorkerTasklet
+from harmony_tpu.dolphin.worker import FusedSparseStep, WorkerTasklet
 
 __all__ = [
     "Trainer",
@@ -15,6 +15,7 @@ __all__ = [
     "ModelAccessor",
     "CachedModelAccessor",
     "make_accessor",
+    "FusedSparseStep",
     "PrefetchPipeline",
     "StagedBatch",
     "WorkerTasklet",

@@ -45,6 +45,18 @@ class ModelAccessor:
         self._table.multi_update(keys, deltas)
         self.push_tracer.record(len(keys))
 
+    def fused_step(self, compute_fn, **kw) -> "Any":
+        """Compile this accessor's pull→compute→push cycle into ONE
+        donated-buffer program (dolphin.worker.FusedSparseStep). Phase
+        charging follows the module docstring's fused contract: the whole
+        step is COMP (the step's own ``comp_tracer``); this accessor's
+        pull/push tracers keep reporting zero — a fused step genuinely
+        has no separable phases. Keyword args pass through
+        (``signature=`` opts into the process program cache)."""
+        from harmony_tpu.dolphin.worker import FusedSparseStep
+
+        return FusedSparseStep(self._table, compute_fn, **kw)
+
     def get_and_reset_times(self) -> tuple:
         pull, push = self.pull_tracer.total_sec, self.push_tracer.total_sec
         self.pull_tracer.reset()

@@ -30,6 +30,8 @@ compute-heavy and network-heavy spans (ref: LocalTaskUnitScheduler.java:
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -54,6 +56,178 @@ from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
 from harmony_tpu.tracing import SpanContext, trace_span
 from harmony_tpu.utils.platform import hard_sync
+
+
+def _phase_boundary(tree, replicate_on: "Optional[Mesh]" = None):
+    """Materialization point between the fused step's PULL/COMP/PUSH
+    stages (``lax.optimization_barrier``): XLA must not fuse across it, so
+    each stage computes exactly what its standalone program computes and
+    the fused/unfused A-B arms stay BIT-identical (cross-phase fusion
+    re-associates matmul accumulations — measured ~1e-7 loss drift).
+    ``replicate_on`` additionally pins the boundary value replicated on
+    that mesh — the PULL stage's documented contract (pull IS the
+    all-gather of the model-axis-sharded table; the host-driven path
+    materializes exactly this replica), without which GSPMD partitions
+    the downstream compute differently per mode and reduction orders
+    drift. On TPU the stages already end at Pallas kernel calls
+    (ops/sparse.py), which are materialization boundaries anyway — the
+    barrier codifies the contract rather than adding cost."""
+    if replicate_on is not None:
+        tree = _replicated_tree(tree, replicate_on)
+    return jax.lax.optimization_barrier(tree)
+
+
+def _replicated_tree(tree, mesh: Mesh):
+    """Constrain every array leaf replicated on ``mesh`` — the boundary
+    sharding both step modes share (see _phase_boundary): GSPMD
+    propagates shardings backward through unconstrained values, so a
+    phase-crossing value left natural partitions its producing reduction
+    differently in the one-program and per-program builds, and float
+    accumulation orders drift."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, rep), tree
+    )
+
+
+class _UnfusedStep:
+    """The host-driven per-phase step (``TrainerParams.fused_step=False``).
+
+    Dispatches PULL, COMP and PUSH as three separate compiled programs
+    with the MODEL traffic round-tripping through host numpy between
+    phases — the reference's ModelAccessor shape (pull -> host -> local
+    compute -> host -> push). Worker-LOCAL table state stays on device in
+    both modes (it is worker-private memory in the reference too); only
+    the PS-table traffic crosses the host. Plugs into the same
+    apply_step/commit machinery as the fused jit (it is just a callable),
+    and only its PUSH program donates the table buffer(s), so the commit
+    contract is unchanged.
+
+    Phase seconds are measured directly (perf_counter around each
+    hard-synced dispatch) and exposed via :meth:`mean_phase_seconds` —
+    the worker feeds them to BatchMetrics instead of the fused path's
+    probe-derived split. The FIRST call per build is excluded from the
+    accumulators: it compiles the three phase programs inside the timed
+    regions, and a compile-inflated mean would misattribute later
+    (compile-free) batches' comp time to comm — the same reason the comm
+    probe warms up before it measures.
+    """
+
+    def __init__(self, pull_p, comp_p, push_p, *, is_hash: bool,
+                 uses_local: bool, keys_push: bool, replicated) -> None:
+        self._pull_p = pull_p
+        self._comp_p = comp_p
+        self._push_p = push_p
+        self._is_hash = is_hash
+        self._uses_local = uses_local
+        self._keys_push = keys_push
+        self._replicated = replicated
+        self.pull_sec = 0.0
+        self.comp_sec = 0.0
+        self.push_sec = 0.0
+        self.steps = 0
+        self.timed_steps = 0
+
+    def mean_phase_seconds(self) -> Tuple[float, float, float]:
+        """(pull, comp, push) mean device+round-trip seconds per
+        steady-state step (the compile-bearing first call excluded)."""
+        n = max(self.timed_steps, 1)
+        return self.pull_sec / n, self.comp_sec / n, self.push_sec / n
+
+    def _roundtrip(self, value):
+        """Host round-trip of one phase boundary: D2H materialize, then
+        re-place replicated on the step's mesh (a raw uncommitted upload
+        racing the sharded batch operands would raise a device mismatch
+        inside the next phase's program)."""
+        import jax as _jax
+
+        host = np.asarray(value)
+        return _jax.device_put(host, self._replicated)
+
+    def __call__(self, *args):
+        if self._uses_local:
+            arr, larr, batch, hyper = args
+        else:
+            arr, batch, hyper = args
+            larr = None
+        t0 = time.perf_counter()
+        if self._is_hash:
+            if self._uses_local:
+                state2, rows, token, lmodel = hard_sync(
+                    self._pull_p(arr, larr, batch))
+            else:
+                state2, rows, token = hard_sync(self._pull_p(arr, batch))
+                lmodel = None
+            p_t = time.perf_counter() - t0
+            rows_d = self._roundtrip(rows)
+            t0 = time.perf_counter()
+            if self._uses_local:
+                delta, new_l, metrics = hard_sync(
+                    self._comp_p(rows_d, lmodel, batch, hyper))
+            else:
+                delta, metrics = hard_sync(
+                    self._comp_p(rows_d, batch, hyper))
+                new_l = None
+            c_t = time.perf_counter() - t0
+            delta_d = self._roundtrip(delta)
+            t0 = time.perf_counter()
+            if self._uses_local:
+                (new_state, new_larr), dropped = hard_sync(
+                    self._push_p(state2, larr, token, delta_d, new_l))
+            else:
+                new_state, dropped = hard_sync(
+                    self._push_p(state2, token, delta_d))
+                new_larr = None
+            u_t = time.perf_counter() - t0
+            metrics = dict(metrics)
+            metrics["_dropped"] = dropped
+        else:
+            if self._uses_local:
+                model, lmodel = hard_sync(self._pull_p(arr, larr))
+            else:
+                model = hard_sync(self._pull_p(arr))
+                lmodel = None
+            p_t = time.perf_counter() - t0
+            model_d = self._roundtrip(model)
+            t0 = time.perf_counter()
+            if self._uses_local:
+                delta, new_l, metrics = hard_sync(
+                    self._comp_p(model_d, lmodel, batch, hyper))
+            else:
+                delta, metrics = hard_sync(
+                    self._comp_p(model_d, batch, hyper))
+                new_l = None
+            c_t = time.perf_counter() - t0
+            delta_d = self._roundtrip(delta)
+            t0 = time.perf_counter()
+            if self._uses_local:
+                (new_arr, new_larr), sync = hard_sync(
+                    self._push_p(arr, larr, delta_d, new_l))
+            elif self._keys_push:
+                new_arr, sync = hard_sync(self._push_p(arr, batch, delta_d))
+                new_larr = None
+            else:
+                new_arr, sync = hard_sync(self._push_p(arr, delta_d))
+                new_larr = None
+            u_t = time.perf_counter() - t0
+            metrics = dict(metrics)
+            if not metrics:
+                # same guarantee as the fused path's _with_sync: at least
+                # one step-output-dependent metric (sync is one pushed
+                # element, computed inside the push program)
+                metrics = {"_sync": sync}
+            new_state = new_arr
+        if self.steps > 0:
+            # steady-state only: call 0 compiled the phase programs inside
+            # the timed regions (see class docstring)
+            self.pull_sec += p_t
+            self.comp_sec += c_t
+            self.push_sec += u_t
+            self.timed_steps += 1
+        self.steps += 1
+        if self._uses_local:
+            return (new_state, new_larr), metrics
+        return new_state, metrics
 
 
 class WorkerTasklet:
@@ -173,6 +347,18 @@ class WorkerTasklet:
         # default ON; _prefetch_usable() gates it off where a background
         # device_put would break pod-deterministic dispatch order.
         self._prefetch_on = bool(getattr(ctx.params, "input_prefetch", True))
+        # Fused device hot path (config default ON): each batch's
+        # PULL/COMP/PUSH compiles into one donated-buffer program. OFF
+        # selects the unfused per-phase fallback (_build_unfused): three
+        # separately-dispatched programs with a host round-trip between
+        # phases — the reference's host-driven ModelAccessor shape, kept
+        # as the bit-identical A/B arm and the operator rollback path.
+        # HARMONY_FUSED_STEP (0/1) overrides process-wide.
+        fused = bool(getattr(ctx.params, "fused_step", True))
+        env_fused = os.environ.get("HARMONY_FUSED_STEP")
+        if env_fused is not None:
+            fused = env_fused.strip().lower() not in ("0", "false", "off")
+        self._fused_on = fused
         self._active_pipeline: Optional[PrefetchPipeline] = None
         # (epoch, pipeline) spawned ahead of its epoch (see
         # _spawn_next_pipeline) — consumed by _epoch_batch_stream
@@ -233,7 +419,9 @@ class WorkerTasklet:
                 trainer.pull_keys(batch), replicated
             )
             state, rows, token = spec.pull(state, keys)            # PULL
-            delta, aux, metrics = compute(rows)                    # COMP
+            rows = _phase_boundary(rows, replicate_on=mesh)
+            delta, aux, metrics = _phase_boundary(compute(rows),
+                                                  replicate_on=mesh)  # COMP
             # SPI hook (identity by default): trainers maintaining cross-row
             # invariants (e.g. LDA's summary row = sum of word rows)
             # reconcile the delta with the admission mask so a dropped
@@ -257,11 +445,16 @@ class WorkerTasklet:
                 # per-doc assignments).
 
                 def _step(state, local, batch, hyper):
+                    # the local pull belongs to the PULL stage even though
+                    # it is traced inside the compute closure — barrier it
+                    # so the stage split matches the unfused build's
+                    lmodel = _phase_boundary(local_spec.pull_all(local),
+                                             replicate_on=mesh)
                     state, new_l, metrics = _hash_pull_push(
                         state,
                         batch,
                         lambda rows: trainer.compute_with_local(
-                            rows, local_spec.pull_all(local), batch, hyper
+                            rows, lmodel, batch, hyper
                         ),
                     )
                     return (
@@ -272,10 +465,13 @@ class WorkerTasklet:
                 return _step
 
             def _step(arr, local, batch, hyper):
-                model = spec.pull_all(arr)                         # PULL
-                lmodel = local_spec.pull_all(local)
-                delta, new_l, metrics = trainer.compute_with_local(
-                    model, lmodel, batch, hyper
+                model, lmodel = _phase_boundary(
+                    (spec.pull_all(arr), local_spec.pull_all(local)),
+                    replicate_on=mesh,
+                )                                                  # PULL
+                delta, new_l, metrics = _phase_boundary(
+                    trainer.compute_with_local(model, lmodel, batch, hyper),
+                    replicate_on=mesh,
                 )                                                  # COMP
                 new_arr = spec.push_all(arr, delta)                # PUSH
                 return (
@@ -299,8 +495,11 @@ class WorkerTasklet:
         if trainer.pull_mode == "all":
 
             def _step(arr, batch, hyper):
-                model = spec.pull_all(arr)                         # PULL
-                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
+                model = _phase_boundary(spec.pull_all(arr),
+                                        replicate_on=mesh)         # PULL
+                delta, metrics = _phase_boundary(
+                    trainer.compute(model, batch, hyper),
+                    replicate_on=mesh)                             # COMP
                 new_arr = spec.push_all(arr, delta)                # PUSH
                 return new_arr, sync(metrics, new_arr)
 
@@ -309,8 +508,11 @@ class WorkerTasklet:
 
             def _step(arr, batch, hyper):
                 keys = trainer.pull_keys(batch)
-                model = spec.pull(arr, keys)                       # PULL
-                delta, metrics = trainer.compute(model, batch, hyper)  # COMP
+                model = _phase_boundary(spec.pull(arr, keys),
+                                        replicate_on=mesh)         # PULL
+                delta, metrics = _phase_boundary(
+                    trainer.compute(model, batch, hyper),
+                    replicate_on=mesh)                             # COMP
                 new_arr = spec.push(arr, keys, delta, via=push_via)  # PUSH
                 return new_arr, sync(metrics, new_arr)
 
@@ -380,7 +582,11 @@ class WorkerTasklet:
         hyper_sig = tuple(sorted(self.trainer.hyperparams().keys()))
         return (tsig, table_sig, local_sig, batch_sig, hyper_sig,
                 push_route,  # the BAKED lowering (measured; see caller)
-                self.data.num_mini_batches if self._use_fused_epoch() else None)
+                self.data.num_mini_batches if self._use_fused_epoch() else None,
+                # fused and unfused builds trace DIFFERENT programs from
+                # otherwise-identical signatures — the mode is part of the
+                # structural identity
+                "fused" if self._fused_mode() else "unfused")
 
     def _program_builders(self, tsh, lsh, push_route):
         """The step/epoch jit-wrapper constructors for a GIVEN layout
@@ -417,6 +623,141 @@ class WorkerTasklet:
 
         return build_step, build_epoch
 
+    def _build_unfused(self, key, tsh, lsh, push_route) -> "_UnfusedStep":
+        """The per-phase fallback (fused_step=False): PULL, COMP and PUSH
+        as three separately-compiled programs with a host round-trip
+        between phases — the reference's host-driven ModelAccessor shape
+        (pull -> numpy -> compute -> numpy -> push), kept bit-identical to
+        the fused program (same traced math, different dispatch
+        boundaries; gathers/adds are boundary-insensitive). The phase
+        programs participate in the program cache under the same
+        structural key as the fused step (mode-tagged), so rebuilds and
+        resubmissions reuse them. Only the PUSH program donates the table
+        buffer(s) — PULL must read them first."""
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
+        spec = self.ctx.model_table.spec
+        trainer = self.trainer
+        is_hash = isinstance(self.ctx.model_table, DeviceHashTable)
+        mesh = (tsh[0] if isinstance(tsh, tuple) else tsh).mesh
+        local_spec = (self.ctx.local_table.spec
+                      if trainer.uses_local_table else None)
+        replicated = NamedSharding(mesh, P())
+
+        mesh2 = mesh  # the boundary-replication mesh (see _replicated_tree)
+        keys_push = False
+        if is_hash:
+            if trainer.uses_local_table:
+                def pull_fn(state, larr, batch):
+                    keys = jax.lax.with_sharding_constraint(
+                        trainer.pull_keys(batch), replicated
+                    )
+                    state2, rows, token = spec.pull(state, keys)
+                    rows, lmodel = _replicated_tree(
+                        (rows, local_spec.pull_all(larr)), mesh2)
+                    return state2, rows, token, lmodel
+
+                def comp_fn(rows, lmodel, batch, hyper):
+                    return _replicated_tree(trainer.compute_with_local(
+                        rows, lmodel, batch, hyper), mesh2)
+
+                def push_fn(state, local, token, delta, new_l):
+                    delta = trainer.mask_delta(delta, token[2])
+                    new_state = spec.push(state, token, delta)
+                    dropped = jnp.sum(~token[2]).astype(jnp.float32)
+                    return ((new_state, local_spec.write_all(local, new_l)),
+                            dropped)
+
+                donate = (0, 1)
+            else:
+                def pull_fn(state, batch):
+                    keys = jax.lax.with_sharding_constraint(
+                        trainer.pull_keys(batch), replicated
+                    )
+                    state2, rows, token = spec.pull(state, keys)
+                    return state2, _replicated_tree(rows, mesh2), token
+
+                def comp_fn(rows, batch, hyper):
+                    return _replicated_tree(
+                        trainer.compute(rows, batch, hyper), mesh2)
+
+                def push_fn(state, token, delta):
+                    delta = trainer.mask_delta(delta, token[2])
+                    new_state = spec.push(state, token, delta)
+                    dropped = jnp.sum(~token[2]).astype(jnp.float32)
+                    return new_state, dropped
+
+                donate = (0,)
+        elif trainer.uses_local_table:
+            def pull_fn(arr, larr):
+                return _replicated_tree(
+                    (spec.pull_all(arr), local_spec.pull_all(larr)), mesh2)
+
+            def comp_fn(model, lmodel, batch, hyper):
+                return _replicated_tree(
+                    trainer.compute_with_local(model, lmodel, batch, hyper),
+                    mesh2)
+
+            def push_fn(arr, larr, delta, new_l):
+                new_arr = spec.push_all(arr, delta)
+                return ((new_arr, local_spec.write_all(larr, new_l)),
+                        jnp.ravel(new_arr)[0])
+
+            donate = (0, 1)
+        elif trainer.pull_mode == "all":
+            def pull_fn(arr):
+                return _replicated_tree(spec.pull_all(arr), mesh2)
+
+            def comp_fn(model, batch, hyper):
+                return _replicated_tree(
+                    trainer.compute(model, batch, hyper), mesh2)
+
+            def push_fn(arr, delta):
+                new_arr = spec.push_all(arr, delta)
+                return new_arr, jnp.ravel(new_arr)[0]
+
+            donate = (0,)
+        else:
+            keys_push = True
+
+            def pull_fn(arr, batch):
+                return _replicated_tree(
+                    spec.pull(arr, trainer.pull_keys(batch)), mesh2)
+
+            def comp_fn(model, batch, hyper):
+                return _replicated_tree(
+                    trainer.compute(model, batch, hyper), mesh2)
+
+            def push_fn(arr, batch, delta):
+                new_arr = spec.push(arr, trainer.pull_keys(batch), delta,
+                                    via=push_route)
+                return new_arr, jnp.ravel(new_arr)[0]
+
+            donate = (0,)
+
+        def cached(tag, build):
+            return progcache.get_or_build(
+                None if key is None else (key, tag), build)
+
+        # push output pinned to the layout snapshot, exactly as the fused
+        # build's out_shardings pin it (commit then re-homes nothing)
+        push_out = (((tsh, lsh), None) if trainer.uses_local_table
+                    else (tsh, None))
+        pull_p = cached("unfused_pull",
+                        lambda: jax.jit(pull_fn, donate_argnums=()))
+        comp_p = cached("unfused_comp",
+                        lambda: jax.jit(comp_fn, donate_argnums=()))
+        push_p = cached("unfused_push",
+                        lambda: jax.jit(push_fn, donate_argnums=donate,
+                                        out_shardings=push_out))
+        return _UnfusedStep(
+            pull_p, comp_p, push_p,
+            is_hash=is_hash,
+            uses_local=trainer.uses_local_table,
+            keys_push=keys_push,
+            replicated=replicated,
+        )
+
     def _prewarm_layout(self, new_mesh: Mesh) -> None:
         """Layout-announcement listener (TableHandle._reshard_to_owners
         announces the TARGET mesh before flipping ownership): build the
@@ -432,6 +773,8 @@ class WorkerTasklet:
 
             table = self.ctx.model_table
             is_hash = isinstance(table, DeviceHashTable)
+            if not self._fused_mode():
+                return  # prewarm builds fused programs only
             if self.trainer.uses_local_table:
                 return  # the (model, local) pair reshards independently
             if (self.dispatch_turn is not None
@@ -534,15 +877,22 @@ class WorkerTasklet:
         self._program_cache_key = self._program_key(tsh, lsh, self._push_route)
         key = self._program_cache_key
 
-        build_step, build_epoch = self._program_builders(
-            tsh, lsh, self._push_route)
-        self._step = progcache.get_or_build(
-            None if key is None else (key, "step"), build_step
-        )
-        if self._use_fused_epoch():
-            self._epoch_fn = progcache.get_or_build(
-                None if key is None else (key, "epoch"), build_epoch
+        if not self._fused_mode():
+            # host-driven per-phase fallback: the phase programs ride the
+            # program cache under the same (mode-tagged) key; the wrapper
+            # object is rebuilt per build (it carries phase timers)
+            self._step = self._build_unfused(key, tsh, lsh, self._push_route)
+            self._epoch_fn = None
+        else:
+            build_step, build_epoch = self._program_builders(
+                tsh, lsh, self._push_route)
+            self._step = progcache.get_or_build(
+                None if key is None else (key, "step"), build_step
             )
+            if self._use_fused_epoch():
+                self._epoch_fn = progcache.get_or_build(
+                    None if key is None else (key, "epoch"), build_epoch
+                )
         self._eval_fn = progcache.get_or_build(
             None if key is None else (key, "eval"),
             lambda: jax.jit(self.trainer.evaluate),
@@ -677,6 +1027,18 @@ class WorkerTasklet:
 
         return mesh_spans_processes(mesh)
 
+    def _fused_mode(self) -> bool:
+        """Whether this worker's step dispatches as ONE fused program.
+        The unfused fallback is host-driven (each phase round-trips
+        through host memory), so a multi-process mesh — whose shards no
+        single process can materialize — keeps the fused path regardless
+        of the knob."""
+        if self._fused_on:
+            return True
+        # the TABLE's mesh, not self.mesh: the decision must track the
+        # live layout even between a reshard and the post-flip rebuild
+        return self._mesh_spans_processes(self.ctx.model_table.mesh)
+
     def _probe_comm(self, batch: Tuple[np.ndarray, ...]) -> None:
         """Time the probe programs on one batch (warmup dispatch first so
         compile never lands in the measurement); stores (pull_s, push_s)
@@ -761,6 +1123,7 @@ class WorkerTasklet:
             self.batch_barrier is None
             and self.taskunit is None
             and not self.data.is_shuffling
+            and self._fused_mode()  # host round-trips cannot lax.scan
         )
 
     # Max fused epochs per drain. Each drained window costs one full
@@ -782,6 +1145,11 @@ class WorkerTasklet:
         never crosses a comm-probe epoch — the probe measures the live
         table between dispatches."""
         if self.batch_barrier is not None:
+            return 1
+        if not self._fused_mode():
+            # unfused steps block on host round-trips per phase: a window
+            # would only batch the metric drain of an already-synchronous
+            # loop — keep the honest per-epoch cadence
             return 1
         if self.pod_contended is not None and self.pod_contended():
             # Cross-job pod tenancy: a multi-epoch window is one dispatch
@@ -1216,6 +1584,8 @@ class WorkerTasklet:
             # order relative to a probe-free run.
             since = epoch - self.starting_epoch
             if self.comm_probe_every and self.global_init and (
+                self._fused_mode()  # unfused measures phases directly
+            ) and (
                 self._probe_pull is None or since >= self._next_probe
             ):
                 self._next_probe = since + 8 * self.comm_probe_every
@@ -1682,10 +2052,16 @@ class WorkerTasklet:
         # honest comm/comp split from the last probe (see _probe_comm):
         # comp = measured step time minus the probed pull/push device time.
         # With the probe off both are 0 and comp degenerates to the whole
-        # batch time — the conservative fused-mode default.
-        t_pull, t_push = getattr(
-            self.ctx.model_table, "_comm_split", self._comm_probe_times
-        )
+        # batch time — the conservative fused-mode default. The unfused
+        # per-phase path needs no probe at all: its phases dispatch
+        # separately, so the split is MEASURED per step.
+        measured = getattr(self._step, "mean_phase_seconds", None)
+        if measured is not None:
+            t_pull, _t_comp, t_push = measured()
+        else:
+            t_pull, t_push = getattr(
+                self.ctx.model_table, "_comm_split", self._comm_probe_times
+            )
         comp = max(per_batch_time - t_pull - t_push, 0.0)
         # NOTE: the weighted-fair-queue unit cost is reported from the
         # dispatch scope only (per granted UNIT) — reporting the drain's
@@ -1951,3 +2327,156 @@ class WorkerTasklet:
         model = table.pull_array()
         metrics = self._eval_fn(model, self._shard_batch(batch))
         return {k: float(v) for k, v in metrics.items()}
+
+
+class FusedSparseStep:
+    """ONE compiled program for a host-driven sparse pull→compute→push.
+
+    The host path (ModelAccessor users: benchmarks, serving-style readers,
+    apps driving a table outside WorkerTasklet) historically crossed
+    Python per phase — ``pull`` gathers to numpy, the caller computes, and
+    ``push`` scatters the delta back, three dispatches and two full host
+    round-trips per batch. This wraps the cycle the way the dense SPMD
+    fast path does (WorkerTasklet._program_builders): the table array
+    enters as a DONATED argument, the keyed gather / compute / keyed
+    scatter trace into one XLA program, and dispatch+commit ride
+    ``DenseTable.apply_step`` so donation stays invisible to concurrent
+    host accessors. Underneath, the keyed gather/scatter lower through
+    ops/sparse.py (Pallas on TPU, jnp fallback elsewhere).
+
+    Phase accounting matches the accessor's documented fused contract:
+    the WHOLE step is charged to COMP (``comp_tracer`` feeds the
+    ``harmony_phase_seconds{phase="accessor.comp"}`` histogram); the
+    pull/push tracers genuinely have no separable phases to report.
+
+    Donation rules: ONLY the table buffer (argument 0) is donated. Keys
+    and extra operands — including device arrays staged by
+    :meth:`run_batches` or held in the process devcache — are read-only
+    by construction, preserving the devcache contract
+    (data/devcache.py: cached buffers are never invalidated by a step).
+
+    ``signature`` (hashable) names the compute_fn's traced behavior for
+    the process program cache (runtime/progcache) — same contract as
+    ``Trainer.jit_signature``: equal signatures MUST mean an identical
+    traced program, and the default ``None`` opts out of caching.
+    """
+
+    #: steps in flight before the driver blocks on the oldest aux (keeps
+    #: the donated-buffer chain and dispatch queue bounded)
+    MAX_INFLIGHT = 8
+
+    def __init__(
+        self,
+        table,
+        compute_fn: Callable,
+        *,
+        signature: Optional[Any] = None,
+        donate: bool = True,
+        push_via: Optional[str] = None,
+    ) -> None:
+        from harmony_tpu.metrics.tracer import Tracer
+        from harmony_tpu.table.hashtable import DeviceHashTable
+        from harmony_tpu.table.table import DenseTable
+
+        if isinstance(table, DeviceHashTable):
+            raise TypeError(
+                "FusedSparseStep drives DenseTable workloads; hash-backed "
+                "tables already fuse through WorkerTasklet's keyed step"
+            )
+        if not isinstance(table, DenseTable):
+            raise TypeError(f"need a DenseTable, got {type(table).__name__}")
+        self.table = table
+        spec = table.spec
+        route = push_via if push_via is not None else table.push_via
+        self.push_route = route
+        self.donate = bool(donate)
+
+        def _step(arr, keys, *extra):
+            rows = spec.pull(arr, keys)                    # PULL
+            delta, aux = compute_fn(rows, *extra)          # COMP
+            new_arr = spec.push(arr, keys, delta, via=route)  # PUSH
+            return new_arr, aux
+
+        dn = (0,) if donate else ()
+        key = None
+        if signature is not None:
+            from harmony_tpu.runtime import progcache as _pc
+
+            tsig = _pc.table_signature(table)
+            if tsig is not None:
+                key = (tsig, "fused_sparse", signature, route, bool(donate))
+        self._fn = progcache.get_or_build(
+            key, lambda: jax.jit(_step, donate_argnums=dn)
+        )
+        self.cache_key = key
+        self.comp_tracer = Tracer(instrument="accessor.comp")
+
+    # -- single step ------------------------------------------------------
+
+    def step(self, keys, *extra):
+        """Dispatch one fused batch and commit; returns compute_fn's aux.
+        Blocks on the aux (the accessor's per-op shape) so the tracer
+        charges real device time to COMP."""
+        k = keys if hasattr(keys, "dtype") else jnp.asarray(keys, jnp.int32)
+        self.comp_tracer.start()
+        aux = self.table.apply_step(self._fn, k, *extra)
+        self.comp_tracer.record(int(k.shape[0]), block_on=aux)
+        return aux
+
+    # -- batched driver with double-buffered staging ----------------------
+
+    def _stage(self, batch: Tuple) -> Tuple:
+        """H2D placement of one host batch (keys first, then compute_fn's
+        extras), replicated on the table's mesh. Staged arrays are only
+        ever read by the step (never donated)."""
+        mesh = self.table.mesh
+        sh = NamedSharding(mesh, P())
+        keys, *extra = batch
+        k = keys if hasattr(keys, "dtype") else np.asarray(keys, np.int32)
+        return tuple(jax.device_put(a, sh) for a in (k, *extra))
+
+    def run_batches(self, batches, *, inflight: Optional[int] = None):
+        """Drive host batches ``(keys, *extra)`` through the fused step
+        with batch k+1's device_put STAGED while batch k computes — the
+        double-buffered gradient/index transfer (StageRing, the input
+        pipeline's primitive). Returns the list of per-batch aux outputs
+        (synced). Falls back to synchronous staging on multi-process
+        meshes, where a background device_put is collective-backed (same
+        rule as WorkerTasklet._prefetch_usable)."""
+        from harmony_tpu.data.loader import StageRing
+        from harmony_tpu.parallel.mesh import mesh_spans_processes
+
+        cap = int(inflight) if inflight else 2
+        auxes: List[Any] = []
+        if mesh_spans_processes(self.table.mesh):
+            for b in batches:
+                auxes.append(self.table.apply_step(self._fn, *self._stage(b)))
+            hard_sync(auxes)
+            return auxes
+        ring = StageRing(lambda: cap)
+
+        def produce() -> None:
+            try:
+                for b in batches:
+                    if not ring.put(self._stage(b)):
+                        return
+                ring.finish()
+            except BaseException as e:  # surfaced at the consumer's get()
+                ring.set_error(e)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="fused-sparse-stage")
+        t.start()
+        try:
+            while True:
+                item = ring.get()
+                if item is StageRing.DONE:
+                    break
+                auxes.append(self.table.apply_step(self._fn, *item))
+                if len(auxes) >= self.MAX_INFLIGHT:
+                    hard_sync(auxes[len(auxes) - self.MAX_INFLIGHT])
+        finally:
+            ring.close()
+            t.join(timeout=5.0)
+        hard_sync(auxes)
+        return auxes

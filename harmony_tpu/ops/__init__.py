@@ -10,6 +10,7 @@ from harmony_tpu.ops.attention import blockwise_attention, flash_attention
 from harmony_tpu.ops.histogram import segment_sum, weighted_histogram
 from harmony_tpu.ops.mxu import mxu_dot
 from harmony_tpu.ops.ring import ring_attention
+from harmony_tpu.ops.sparse import gather_rows, segment_sum_rows
 from harmony_tpu.ops.ulysses import a2a_attention, a2a_self_attention
 
 __all__ = [
@@ -17,8 +18,10 @@ __all__ = [
     "a2a_self_attention",
     "blockwise_attention",
     "flash_attention",
+    "gather_rows",
     "mxu_dot",
     "ring_attention",
     "segment_sum",
+    "segment_sum_rows",
     "weighted_histogram",
 ]

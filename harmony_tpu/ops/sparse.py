@@ -1,0 +1,201 @@
+"""Sparse table kernels — batched row gather + row-granular segment-sum.
+
+The two device ops that dominate NMF/LDA-style sparse workloads are the
+table's keyed pull (multi_get: a batched embedding gather) and the keyed
+push's duplicate fold (multi_update: a segment-sum of delta rows by
+destination key). XLA lowers both through generic gather/scatter, which on
+TPU serialises duplicate keys and round-trips HBM per row; these Pallas
+kernels stream rows through VMEM instead — the gather rides the scalar-
+prefetch pipeline (index known before the block arrives, so the DMA for
+row *i+1* overlaps the copy of row *i*), and the segment-sum keeps the
+whole accumulator resident in VMEM across the grid so duplicate folds
+never touch HBM.
+
+Route selection happens AT TRACE TIME on the host (``_route``): the
+kernels run only on a TPU backend with kernel-friendly shapes; everywhere
+else — tier-1 on ``JAX_PLATFORMS=cpu`` in particular — a pure-jnp fallback
+traces through the SAME call graph, so CPU tests exercise exactly the code
+path production uses minus the kernel body. ``HARMONY_SPARSE_KERNEL``
+(``pallas`` | ``jnp``) overrides the automatic choice — the operator
+rollback knob, same contract as ``HARMONY_PUSH_VIA``.
+
+Numerical contract: the gather fallback is value-identical to the kernel
+(a gather copies bytes); the segment-sum routes agree exactly when the
+folded values are addition-order-insensitive (integer-valued counts, no
+duplicate keys) and to float tolerance otherwise (duplicate folds may
+associate differently). On any ONE route the result is deterministic —
+the fused-vs-unfused parity tests run both arms on the same backend, so
+their bit-identical-loss contract never crosses routes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# Lane width of the VPU/MXU register file: kernel shapes must tile it.
+_LANES = 128
+# Accumulator-residency budget for the segment-sum kernel (bytes). The
+# whole [num_rows, W] accumulator block stays in VMEM across the grid
+# (same output block every step => consecutive-revisit residency); bigger
+# tables fall back to the jnp route rather than thrash HBM per step.
+_ACC_VMEM_BYTES = 8 << 20
+# Delta rows folded per grid step (the scalar fold loop's span).
+_FOLD_TILE = 256
+
+
+def kernel_route(interpret: Optional[bool] = None) -> bool:
+    """True when the Pallas route is selected — decided on the HOST at
+    trace time, never inside a traced computation. ``interpret=True``
+    forces the kernel in interpreter mode (tests validating the kernel
+    body itself on CPU)."""
+    if interpret:
+        return True
+    from harmony_tpu.utils.platform import env_choice, tpu_backend
+
+    forced = env_choice("HARMONY_SPARSE_KERNEL", ("pallas", "jnp"))
+    if forced:
+        return forced == "pallas"
+    return tpu_backend()
+
+
+def _gather_kernel(idx_ref, table_ref, out_ref):
+    """One pulled row per grid step: the index map already selected the
+    source row block (scalar-prefetched indices), so the body is a copy."""
+    out_ref[:] = table_ref[:]
+
+
+def gather_rows(
+    table: jnp.ndarray,
+    idx: jnp.ndarray,
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``out[i] = table[idx[i]]`` — table [R, W], idx [N] int32 -> [N, W].
+
+    Out-of-range ids — NEGATIVE included — clamp to the nearest valid row
+    (jax gather's OOB clamp semantics, applied explicitly on BOTH routes:
+    jnp advanced indexing would wrap negatives Python-style, which the
+    kernel's clamp cannot reproduce). The batched embedding gather behind
+    ``TableSpec.pull`` / multi_get.
+    """
+    if table.ndim != 2 or idx.ndim != 1:
+        raise ValueError(f"bad shapes table={table.shape} idx={idx.shape}")
+    R, W = table.shape
+    N = idx.shape[0]
+    use_kernel = (
+        kernel_route(interpret)
+        and N > 0
+        and R > 0
+        and W % _LANES == 0
+        and table.dtype in (jnp.float32, jnp.bfloat16)
+    )
+    safe = jnp.clip(idx.astype(jnp.int32), 0, max(R - 1, 0))
+    if not use_kernel:
+        return table[safe]
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[pl.BlockSpec((1, W), lambda i, idx_ref: (idx_ref[i], 0))],
+        out_specs=pl.BlockSpec((1, W), lambda i, idx_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, W), table.dtype),
+        interpret=bool(interpret),
+    )(safe, table)
+
+
+def _make_fold_kernel(num_rows: int, tile: int):
+    def _fold_kernel(idx_ref, delta_ref, acc_ref):
+        """Grid over delta tiles; the [num_rows, W] accumulator block is
+        the SAME output block every step, so it stays VMEM-resident and
+        the per-row folds are VMEM read-modify-writes. Rows fold in index
+        order (a sequential scalar loop), matching the fallback's
+        scatter-add fold order for duplicate keys."""
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body(j, _):
+            k = idx_ref[i * tile + j]
+            ok = (k >= 0) & (k < num_rows)
+            kc = jnp.clip(k, 0, num_rows - 1)
+            row = pl.load(delta_ref, (pl.ds(j, 1), slice(None)))
+            cur = pl.load(acc_ref, (pl.ds(kc, 1), slice(None)))
+            pl.store(
+                acc_ref,
+                (pl.ds(kc, 1), slice(None)),
+                cur + jnp.where(ok, row, jnp.zeros_like(row)),
+            )
+            return 0
+
+        jax.lax.fori_loop(0, tile, body, 0)
+
+    return _fold_kernel
+
+
+def segment_sum_rows(
+    deltas: jnp.ndarray,
+    idx: jnp.ndarray,
+    num_rows: int,
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``out[k] = sum over i with idx[i]==k of deltas[i]`` — deltas [N, W],
+    idx [N] int32 -> [num_rows, W]. Out-of-range ids contribute nothing
+    (both routes). The multi_update duplicate fold: the result is applied
+    to the table with ONE dense add (``TableSpec.push`` via="sparse"),
+    like the mxu route but with a row-granular fold instead of the
+    one-hot matmul (ops/histogram.py) — cheaper when W is wide and the
+    key set is a small fraction of the table."""
+    if deltas.ndim != 2 or idx.ndim != 1 or idx.shape[0] != deltas.shape[0]:
+        raise ValueError(f"bad shapes deltas={deltas.shape} idx={idx.shape}")
+    N, W = deltas.shape
+    use_kernel = (
+        kernel_route(interpret)
+        and N > 0
+        and W % _LANES == 0
+        and deltas.dtype == jnp.float32
+        and num_rows * W * 4 <= _ACC_VMEM_BYTES
+    )
+    if not use_kernel:
+        ok = (idx >= 0) & (idx < num_rows)
+        safe = jnp.where(ok, idx, 0)
+        masked = jnp.where(ok[:, None], deltas, jnp.zeros_like(deltas))
+        return jnp.zeros((num_rows, W), deltas.dtype).at[safe].add(masked)
+    tile = min(_FOLD_TILE, N)
+    pad = (-N) % tile
+    idx32 = idx.astype(jnp.int32)
+    if pad:
+        # padded rows carry id -1: masked out inside the kernel
+        idx32 = jnp.pad(idx32, (0, pad), constant_values=-1)
+        deltas = jnp.pad(deltas, ((0, pad), (0, 0)))
+        N += pad
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N // tile,),
+        in_specs=[pl.BlockSpec((tile, W), lambda i, idx_ref: (i, 0))],
+        out_specs=pl.BlockSpec((num_rows, W), lambda i, idx_ref: (0, 0)),
+    )
+    return pl.pallas_call(
+        _make_fold_kernel(num_rows, tile),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_rows, W), deltas.dtype),
+        interpret=bool(interpret),
+    )(idx32, deltas)
+
+
+def value_width(value_shape) -> int:
+    """Row width of a table value (scalars are width-1 rows)."""
+    return int(np.prod(value_shape)) if value_shape else 1

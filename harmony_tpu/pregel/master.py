@@ -87,17 +87,13 @@ class PregelMaster:
         self.superstep_count = 0
         # seed vertex state (ref: vertex table bulk-loaded before superstep 0)
         init = computation.initial_state(V)
-        vspec = self.vertex_table.spec
-        self.vertex_table.apply_step(
-            lambda arr, v: (jax.jit(vspec.write_all)(arr, v), None), init
-        )
+        # table-level write_all: the old per-call jax.jit(spec.write_all)
+        # lambdas (one INSIDE the message-table loop) built fresh jit
+        # wrappers per invocation, defeating the jit cache
+        self.vertex_table.write_all(init)
         # seed message tables with the combiner identity ("no message")
         for mt in self._msg_tables:
-            ms = mt.spec
-            mt.apply_step(
-                lambda arr, v: (jax.jit(ms.write_all)(arr, v), None),
-                jnp.full((V,), computation.msg_identity, jnp.float32),
-            )
+            mt.write_all(jnp.full((V,), computation.msg_identity, jnp.float32))
         self._build()
 
     # -- compiled superstep ----------------------------------------------

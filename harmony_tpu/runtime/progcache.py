@@ -92,6 +92,24 @@ def table_signature(table: Any, sharding=None) -> Optional[Tuple]:
 _inflight: dict = {}
 
 
+def _record_event(result: str) -> None:
+    """Scrapeable hit/miss counter beside the in-process _stats dict
+    (metrics/registry.py): recompiles of cached-eligible programs —
+    WorkerTasklet step rebuilds, FusedSparseStep builds, table inits —
+    become visible in /metrics as harmony_progcache_events_total. Guarded: the
+    cache must never fail (or slow) a build on registry trouble."""
+    try:
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().counter(
+            "harmony_progcache_events_total",
+            "Compiled-program cache lookups by result",
+            ("result",),
+        ).labels(result=result).inc()
+    except Exception:
+        pass
+
+
 def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Callable:
     """Return the cached callable for ``key``, building (and caching) on
     miss. ``key=None`` bypasses the cache entirely.
@@ -108,12 +126,15 @@ def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Call
             if fn is not None:
                 _cache.move_to_end(key)
                 _stats["hits"] += 1
-                return fn
-            ev = _inflight.get(key)
-            if ev is None:
-                ev = threading.Event()
-                _inflight[key] = ev
-                break  # this thread builds
+            else:
+                ev = _inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    _inflight[key] = ev
+                    break  # this thread builds
+        if fn is not None:
+            _record_event("hit")  # outside the lock (registry has its own)
+            return fn
         ev.wait()
         # builder finished (or failed): loop re-checks the cache; on builder
         # failure the entry is absent and THIS thread takes over the build.
@@ -126,6 +147,7 @@ def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Call
             _cache.move_to_end(key)
             while len(_cache) > _MAX_ENTRIES:
                 _cache.popitem(last=False)
+        _record_event("miss")
         return fn
     finally:
         with _lock:

@@ -235,9 +235,20 @@ class TableSpec:
         return vals.astype(self.dtype).reshape(self.storage_shape)
 
     def pull(self, arr: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:
-        """multiGetOrInit: gather values for ``keys`` -> [n, *value_shape]."""
+        """multiGetOrInit: gather values for ``keys`` -> [n, *value_shape].
+
+        Routed through ops.sparse.gather_rows — the Pallas batched
+        embedding gather on TPU backends, a value-identical jnp gather
+        everywhere else (route picked at trace time, so tier-1 on CPU
+        walks the same call graph)."""
+        from harmony_tpu.ops.sparse import gather_rows, value_width
+
         b, o = self.partitioner.locate(keys)
-        return arr[b, o]
+        flat_idx = (b * self.block_size + o).astype(jnp.int32)
+        flat = arr.reshape(self.num_blocks * self.block_size,
+                           value_width(self.value_shape))
+        rows = gather_rows(flat, flat_idx.reshape(-1))
+        return rows.reshape(*flat_idx.shape, *self.value_shape)
 
     def pull_all(self, arr: jnp.ndarray) -> jnp.ndarray:
         """Whole table as ``[capacity, *value_shape]`` in key order (the
@@ -269,6 +280,10 @@ class TableSpec:
             of the table (>= capacity/256 keys — the dense-add bandwidth
             amortises over duplicate folds), else "scatter" (a few rows
             into a huge table: streaming the table would dominate).
+          * "sparse" — pre-fold duplicates with the row-granular Pallas
+            segment-sum (ops.sparse.segment_sum_rows; jnp fallback off
+            TPU) and apply ONE dense add — the mxu route's shape without
+            the table-sized one-hot contraction.
           * "auto" — "scatter". The spec cannot see which devices the
             array lives on (the process default backend is NOT it — a CPU
             table in a TPU-default process is normal in tests/benchmarks),
@@ -282,14 +297,20 @@ class TableSpec:
         elif via == "mxu_auto":
             dense_enough = keys.shape[0] >= max(32, self.config.capacity // 256)
             via = "mxu" if mode == "add" and dense_enough else "scatter"
-        if via == "mxu":
+        if via in ("mxu", "sparse"):
+            # both fold duplicates into a flat-row delta and apply ONE
+            # dense add; they differ only in the fold op (one-hot matmul
+            # vs row-granular Pallas/jnp segment-sum)
             if mode != "add":
-                raise ValueError("via='mxu' requires an additive update fn")
-            from harmony_tpu.ops.histogram import segment_sum
+                raise ValueError(f"via={via!r} requires an additive update fn")
+            if via == "mxu":
+                from harmony_tpu.ops.histogram import segment_sum as fold
+            else:
+                from harmony_tpu.ops.sparse import segment_sum_rows as fold
 
             n = keys.shape[0]
             flat_idx = (b * self.block_size + o).astype(jnp.int32).reshape(-1)
-            folded = segment_sum(
+            folded = fold(
                 deltas.reshape(n, -1).astype(jnp.float32),
                 flat_idx,
                 self.num_blocks * self.block_size,
@@ -536,14 +557,15 @@ class DenseTable(LayoutAnnouncerMixin):
     def push_via(self) -> str:
         """Platform-resolved keyed-push route: the size-gated MXU
         duplicate-fold on an all-TPU mesh for additive tables, XLA scatter
-        everywhere else. ``HARMONY_PUSH_VIA`` (scatter|mxu|mxu_auto)
+        everywhere else. ``HARMONY_PUSH_VIA`` (scatter|mxu|mxu_auto|sparse)
         overrides — the operator rollback knob while on-chip measurements
         of fold-vs-scatter at real shapes are still settling (the first
-        honest capture had scatter ahead at the bench shape)."""
+        honest capture had scatter ahead at the bench shape); "sparse"
+        opts into the row-granular Pallas fold (ops/sparse.py)."""
         from harmony_tpu.utils.platform import device_is_tpu, env_choice
 
         forced = env_choice("HARMONY_PUSH_VIA",
-                            ("scatter", "mxu", "mxu_auto"))
+                            ("scatter", "mxu", "mxu_auto", "sparse"))
         if forced:
             return forced
         on_tpu = all(device_is_tpu(d) for d in self._mesh.devices.flat)
@@ -568,6 +590,20 @@ class DenseTable(LayoutAnnouncerMixin):
     # (ref: Table.updateNoReply / multiUpdateNoReply).
     update_no_reply = update
     multi_update_no_reply = multi_update
+
+    def write_all(self, values) -> None:
+        """Whole-table key-order overwrite (host-level write_all).
+
+        Routes through the table's jit cache (_jitted) like every other
+        host op — callers used to wrap ``jax.jit(spec.write_all)`` in a
+        fresh lambda per invocation, which built a new jit wrapper (and
+        retraced) every call; the cache makes the program build
+        once-per-table instead."""
+        v = jnp.asarray(values)
+        with self._lock:
+            self._arr = self._jitted("write_all", self.spec.write_all)(
+                self._arr, v
+            )
 
     def multi_put(self, keys: Sequence[int], values: np.ndarray) -> None:
         """Bulk set (no old-value return): the bulk-load insertion path
