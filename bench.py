@@ -575,7 +575,33 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # measured per-phase pull/comp/push split, tracked round over
         # round so device-hot-path regressions land in the trajectory
         line["sparse_hot_path"] = sp
+    lint = measure_lint()
+    if lint is not None:
+        # harmonylint suite runtime + finding counts: the suite runs in
+        # tier-1 every round, so its wall time drifting up is a tax on
+        # every CI pass — keep it visible in the same trajectory
+        line["lint"] = lint
     print(json.dumps(line))
+
+
+def measure_lint() -> "dict | None":
+    """harmonylint-suite runtime probe (tracked round over round in the
+    BENCH json): one full run over harmony_tpu/. Returns {"lint.wall_ms",
+    findings, suppressed, files, passes} or None — the bench line must
+    never die for its lint hook."""
+    try:
+        from harmony_tpu.analysis import run_lint
+
+        r = run_lint()
+        return {
+            "lint.wall_ms": r.wall_ms,
+            "findings": len(r.findings),
+            "suppressed": len(r.suppressed),
+            "files": r.files_scanned,
+            "passes": len(r.passes_run),
+        }
+    except Exception:
+        return None
 
 
 def main():

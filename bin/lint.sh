@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# harmonylint over the tree (docs/STATIC_ANALYSIS.md). Exit 1 on any
+# unallowlisted finding — same contract tier-1 enforces. Pass-through
+# args: --json, --passes a,b, --verbose, --write-baseline PATH ...
+exec python -m harmony_tpu.cli lint "$@"

@@ -1,0 +1,55 @@
+"""harmonylint — codebase-aware static analysis for harmony_tpu.
+
+Every pass in this package pins an invariant this repo learned the hard
+way (docs/STATIC_ANALYSIS.md has the catalog with the historical bug
+each one guards):
+
+  * per-process env/time/random state must never steer SPMD dispatch
+    order (the PR 5 chunk-count rule),
+  * state shared with a thread/pool callable holds its lock
+    (the ``_LEG_RETRIES`` rule),
+  * a donated buffer is dead after the jitted call,
+  * fault sites, env knobs, tracer spans and metric names stay
+    consistent with the docs and conventions that operators read.
+
+The framework is pure stdlib (``ast`` + text) — importing it must never
+pull in jax, so the CLI's thin ``lint`` subcommand stays thin.
+
+Public surface::
+
+    from harmony_tpu.analysis import run_lint, all_passes
+    result = run_lint()                # whole harmony_tpu/ tree
+    for f in result.findings: print(f.format())
+"""
+from __future__ import annotations
+
+from harmony_tpu.analysis.core import (  # noqa: F401
+    CodebaseIndex,
+    Finding,
+    LintConfig,
+    LintResult,
+    Pass,
+    load_baseline,
+    load_config,
+    render_json,
+    render_text,
+    run_lint,
+    save_baseline,
+)
+from harmony_tpu.analysis.passes import all_passes, get_pass  # noqa: F401
+
+__all__ = [
+    "CodebaseIndex",
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "Pass",
+    "all_passes",
+    "get_pass",
+    "load_baseline",
+    "load_config",
+    "render_json",
+    "render_text",
+    "run_lint",
+    "save_baseline",
+]

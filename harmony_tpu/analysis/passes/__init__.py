@@ -1,0 +1,50 @@
+"""Pass registry. Each module holds one pass; the order here is the
+report order (concurrency/correctness passes first, conventions last).
+
+Adding a pass (docs/STATIC_ANALYSIS.md has the full walkthrough):
+
+1. new module with a :class:`harmony_tpu.analysis.core.Pass` subclass,
+2. register the class in ``_REGISTRY``,
+3. a bad/fixed fixture pair under ``tests/fixtures/lint/`` plus a case
+   in ``tests/test_analysis.py::TestPassFixtures``,
+4. run ``bin/lint.sh`` — the new pass must come up green on the real
+   tree (fix what it finds; allowlist only with a written reason).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from harmony_tpu.analysis.core import Pass, PragmaHygienePass
+from harmony_tpu.analysis.passes.donate import UseAfterDonatePass
+from harmony_tpu.analysis.passes.faultsites import FaultSiteRegistryPass
+from harmony_tpu.analysis.passes.jit import JitHygienePass
+from harmony_tpu.analysis.passes.knobs import KnobConsistencyPass
+from harmony_tpu.analysis.passes.metricnames import MetricConventionsPass
+from harmony_tpu.analysis.passes.spans import SpanHygienePass
+from harmony_tpu.analysis.passes.spmd import SpmdDivergencePass
+from harmony_tpu.analysis.passes.threads import ThreadSharedStatePass
+
+_REGISTRY = (
+    PragmaHygienePass,  # framework-owned; also always-on (see its doc)
+    SpmdDivergencePass,
+    ThreadSharedStatePass,
+    UseAfterDonatePass,
+    FaultSiteRegistryPass,
+    KnobConsistencyPass,
+    SpanHygienePass,
+    JitHygienePass,
+    MetricConventionsPass,
+)
+
+
+def all_passes() -> List[Pass]:
+    return [cls() for cls in _REGISTRY]
+
+
+def get_pass(name: str) -> Pass:
+    for cls in _REGISTRY:
+        if cls.name == name:
+            return cls()
+    raise KeyError(
+        f"unknown lint pass {name!r}; known: "
+        f"{sorted(c.name for c in _REGISTRY)}")

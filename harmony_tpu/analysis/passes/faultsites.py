@@ -1,0 +1,115 @@
+"""fault-site-registry: code fault sites ⇄ docs/FAULT_TOLERANCE.md.
+
+The chaos harness (PR 2) addresses faults by NAME: a plan rule armed at
+``"chkp.block_write"`` only ever fires if production code actually
+declares ``faults.site("chkp.block_write", ...)``. A typo'd or stale
+site name fails silently — the chaos test "passes" while injecting
+nothing, which is worse than no test. Both directions are pinned
+against the registry table in docs/FAULT_TOLERANCE.md (§Fault-site
+registry):
+
+* every site literal fired in code has a registry row (operators pick
+  injection points from that table; an unlisted site is invisible
+  chaos surface),
+* every registry row is fired somewhere in code (a dead row arms plans
+  that can never trip — the silent-pass failure mode above).
+
+Site names inside ``"a.b" if cond else "c.d"`` selector expressions are
+all collected.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass
+
+REGISTRY_DOC = "FAULT_TOLERANCE.md"
+_SECTION = "### Fault-site registry"
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|")
+_SITE_SHAPE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+def _doc_registry(text: str) -> Dict[str, int]:
+    """site -> 1-based line number of its registry row."""
+    sites: Dict[str, int] = {}
+    in_section = False
+    for lno, line in enumerate(text.splitlines(), start=1):
+        if line.strip() == _SECTION:
+            in_section = True
+            continue
+        if in_section and line.startswith(("## ", "### ")):
+            break
+        if in_section:
+            m = _ROW_RE.match(line.strip())
+            if m:
+                sites[m.group(1)] = lno
+    return sites
+
+
+def _code_sites(index: CodebaseIndex) -> List[Tuple[str, str, int]]:
+    """(site, file, line) for every literal inside the first argument of
+    a ``faults.site(...)`` call."""
+    out: List[Tuple[str, str, int]] = []
+    for sf in index.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            is_site = (
+                isinstance(f, ast.Attribute) and f.attr == "site"
+                and isinstance(f.value, ast.Name) and f.value.id == "faults")
+            if not is_site:
+                continue
+            for sub in ast.walk(node.args[0]):
+                if (isinstance(sub, ast.Constant)
+                        and isinstance(sub.value, str)
+                        and _SITE_SHAPE.match(sub.value)):
+                    out.append((sub.value, sf.rel, node.lineno))
+    return out
+
+
+class FaultSiteRegistryPass(Pass):
+    name = "fault-site-registry"
+    description = ("every faults.site() name has a FAULT_TOLERANCE.md "
+                   "registry row and every row is fired in code")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        doc_rel = f"docs/{REGISTRY_DOC}"
+        text = index.doc_text(REGISTRY_DOC)
+        registry = _doc_registry(text)
+        fired = _code_sites(index)
+        if not text or not registry:
+            if fired:  # fixture trees without chaos sites need no doc
+                out.append(self.finding(
+                    doc_rel, 1,
+                    "fault-site registry table not found "
+                    f"({_SECTION} in {doc_rel})",
+                    hint="the chaos harness's site names are operator "
+                         "API; the registry table is their source of "
+                         "truth"))
+            return out
+        fired_names = {s for s, _, _ in fired}
+        for site, file, line in fired:
+            if site not in registry:
+                out.append(self.finding(
+                    file, line,
+                    f"fault site {site!r} is not in the {doc_rel} "
+                    "registry",
+                    hint="add a row (site / layer / context keys) — or "
+                         "this is a typo'd site no plan can ever arm"))
+        for site, lno in sorted(registry.items()):
+            if index.partial:
+                break  # a file slice cannot prove a site is unfired
+            if site not in fired_names:
+                out.append(self.finding(
+                    doc_rel, lno,
+                    f"registry row {site!r} has no faults.site() in "
+                    "code",
+                    hint="a dead row arms chaos plans that silently "
+                         "never trip; drop the row or restore the site"))
+        return out

@@ -1,0 +1,100 @@
+"""metric-conventions: instrument declarations obey the exposition
+contract at the declaration site.
+
+The scrape-time grammar/semantic linter (``metrics.registry
+.lint_exposition``, tier-1 since PR 4) catches a bad family name only
+when a scrape happens to render it; this pass pins the same naming
+conventions STATICALLY on every ``registry.counter/gauge/histogram/
+register_callback`` call with a literal name, so a typo'd family fails
+lint before it ever reaches an exporter:
+
+* names are ``harmony_``-prefixed snake_case (the label-join and
+  dashboards key on the prefix),
+* counters end ``_total`` (the rule lint_exposition enforces at scrape
+  time — Prometheus rate() semantics),
+* histograms end in a base unit (``_seconds`` / ``_bytes``) per the
+  OpenMetrics unit convention docs/OBSERVABILITY.md documents,
+* the HELP string is non-empty (a help-less family renders a lint
+  failure at scrape time).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass, _str_const
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HISTO_UNITS = ("_seconds", "_bytes")
+_METHODS = ("counter", "gauge", "histogram", "register_callback")
+
+
+class MetricConventionsPass(Pass):
+    name = "metric-conventions"
+    description = ("registry instrument names satisfy the exposition "
+                   "lint's conventions at the declaration site")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _METHODS
+                        and node.args):
+                    continue
+                mname = _str_const(node.args[0])
+                if mname is None or not mname.startswith("harmony_"):
+                    # non-literal or foreign-prefix names are out of
+                    # scope (the prefix is what routes to OUR registry
+                    # conventions; .counter() on arbitrary objects must
+                    # not trip this pass)
+                    continue
+                kind = node.func.attr
+                if kind == "register_callback":
+                    kind_arg = (node.args[2] if len(node.args) > 2 else
+                                next((k.value for k in node.keywords
+                                      if k.arg == "kind"), None))
+                    kind = _str_const(kind_arg) if kind_arg is not None \
+                        else None
+                if not _NAME_RE.match(mname):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"metric name {mname!r} is not snake_case",
+                        hint="exposition renders family names verbatim; "
+                             "see docs/OBSERVABILITY.md naming table"))
+                if kind == "counter" and not mname.endswith("_total"):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"harmony_* counter {mname!r} must end _total",
+                        hint="same rule lint_exposition enforces at "
+                             "scrape time — fix the name here, not the "
+                             "scrape"))
+                if (kind == "histogram"
+                        and not mname.endswith(_HISTO_UNITS)):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"histogram {mname!r} lacks a base-unit suffix "
+                        f"({'/'.join(_HISTO_UNITS)})",
+                        hint="observe() values are seconds or bytes "
+                             "everywhere in this tree; name the unit"))
+                help_arg = (node.args[1] if len(node.args) > 1 else
+                            next((k.value for k in node.keywords
+                                  if k.arg == "help"), None))
+                help_lit = _str_const(help_arg) if help_arg is not None \
+                    else None
+                # absent help is as bad as empty help (and contrary to
+                # first appearances, scrape-time lint_exposition does
+                # NOT catch either: the exporter renders `# HELP name `
+                # which parses back as help="", not None)
+                if help_arg is None or help_lit == "":
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"instrument {mname!r} declared with an empty "
+                        "or missing HELP string",
+                        hint="one sentence: what the number means and "
+                             "its unit"))
+        return out

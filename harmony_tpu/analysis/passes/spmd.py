@@ -1,0 +1,289 @@
+"""spmd-divergence: per-process state never steers SPMD dispatch.
+
+The PR 5 chunk-count rule, generalized. On a multi-process mesh every
+process must trace and dispatch the IDENTICAL sequence of collective
+programs ("Exploring the limits of Concurrency in ML Training on
+Google TPUs" — concurrency correctness at pod scale hinges on it); a
+value that can differ per process — an env knob, the wall clock, a
+random draw — steering how many times (or whether) a collective
+dispatches wedges the pod, usually hours into a run, always on the
+process you are not looking at. PR 5 hit exactly this: a restore chunk
+count derived from this process's ``HARMONY_CHKP_IO_THREADS`` gating
+``import_blocks`` (an SPMD-collective dispatch on spanning meshes).
+
+The pass flags a dispatch-marker call (collectives + ``import_blocks``
+/ ``mesh_sum``-style repo primitives) whose governing control flow —
+enclosing ``if`` tests, ``while`` tests, ``for`` iterables in the same
+function — is tainted by per-process state:
+
+* direct: ``os.environ``/``os.getenv``/``env_*`` reads, ``HARMONY_*``
+  literals in calls, ``time.*`` clocks, ``random``-ish draws;
+* transitive: locals assigned from tainted expressions, and calls to
+  same-module functions that read such state.
+
+The sanctioned idiom is structural, not a pragma: derive the
+process-uniform decision WITH a topology guard —
+``... and not mesh_spans_processes(mesh)`` (or ``process_count()``)
+— in the same condition chain, the way checkpoint/manager.py's
+pipelined restore does. A control chain that consults a topology guard
+anywhere in its derivation is accepted.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass, _dotted_name
+
+DISPATCH_MARKERS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "pbroadcast", "process_allgather",
+    "broadcast_one_to_all", "import_blocks", "mesh_sum",
+}
+UNIFORM_GUARDS = {
+    "mesh_spans_processes", "spans_processes", "process_count",
+    "process_index", "is_multiprocess", "single_process",
+}
+_TIME_FUNCS = {"time", "monotonic", "perf_counter", "time_ns", "clock"}
+_RANDOM_FUNCS = {"random", "randint", "randrange", "shuffle", "choice",
+                 "uniform", "gauss", "sample"}
+
+
+def _is_divergent_call(node: ast.Call) -> Optional[str]:
+    """Why this call reads per-process state (None when it doesn't)."""
+    dotted = _dotted_name(node.func)
+    parts = dotted.split(".") if dotted else []
+    if parts:
+        last = parts[-1]
+        if "environ" in parts or last == "getenv":
+            return "env read"
+        if last.startswith("env_"):
+            return "env read"
+        if "time" in parts[:-1] and last in _TIME_FUNCS:
+            return "clock read"
+        if len(parts) == 1 and last in ("monotonic", "perf_counter",
+                                        "time_ns"):
+            return "clock read"
+        if "random" in parts[:-1] and last in _RANDOM_FUNCS | {"rand",
+                                                               "randn"}:
+            return "random draw"
+        if len(parts) == 1 and last in _RANDOM_FUNCS - {"random"}:
+            return "random draw"
+    for arg in ast.walk(node):
+        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                and arg.value.startswith("HARMONY_")):
+            return f"env read ({arg.value})"
+    return None
+
+
+def _contains_divergence(expr: ast.AST,
+                         tainted: Set[str],
+                         divergent_funcs: Set[str]) -> Optional[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            why = _is_divergent_call(node)
+            if why:
+                return why
+            dotted = _dotted_name(node.func)
+            if dotted and dotted.rsplit(".", 1)[-1] in divergent_funcs:
+                return f"call to {dotted}() which reads per-process state"
+        elif isinstance(node, ast.Subscript):
+            if _dotted_name(node.value).endswith("environ"):
+                return "env read"
+        elif isinstance(node, ast.Name) and node.id in tainted:
+            return f"value derived from per-process state ({node.id})"
+    return None
+
+
+def _contains_guard(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        dotted = _dotted_name(node if not isinstance(node, ast.Call)
+                              else node.func)
+        if dotted and dotted.rsplit(".", 1)[-1] in UNIFORM_GUARDS:
+            return True
+    return False
+
+
+def _own_statements(fn: ast.AST) -> List[ast.stmt]:
+    """Function body statements excluding nested def/class bodies (those
+    are separate analyses)."""
+    return list(fn.body)
+
+
+def _walk_own(stmts: Sequence[ast.stmt]):
+    """Yield every node in these statements, not descending into nested
+    function/class scopes."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SpmdDivergencePass(Pass):
+    name = "spmd-divergence"
+    description = ("env/clock/random state never controls whether or "
+                   "how many times an SPMD collective dispatches")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            funcs = [n for n in ast.walk(sf.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            divergent_funcs = self._divergent_funcs(funcs)
+            for fn in funcs:
+                out.extend(self._check_function(sf.rel, fn,
+                                                divergent_funcs))
+        return out
+
+    def _divergent_funcs(self, funcs: Sequence[ast.AST]) -> Set[str]:
+        """Same-module functions that (transitively) read per-process
+        state — matched by bare name at call sites."""
+        direct: Set[str] = set()
+        calls: Dict[str, Set[str]] = {}
+        for fn in funcs:
+            called: Set[str] = set()
+            for node in _walk_own(fn.body):
+                if isinstance(node, ast.Call):
+                    if _is_divergent_call(node):
+                        direct.add(fn.name)
+                    dotted = _dotted_name(node.func)
+                    if dotted:
+                        called.add(dotted.rsplit(".", 1)[-1])
+                elif (isinstance(node, ast.Subscript)
+                        and _dotted_name(node.value).endswith("environ")):
+                    direct.add(fn.name)
+            calls[fn.name] = called
+        divergent = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for name, called in calls.items():
+                if name not in divergent and called & divergent:
+                    divergent.add(name)
+                    changed = True
+        return divergent
+
+    def _check_function(self, rel: str, fn: ast.AST,
+                        divergent_funcs: Set[str]) -> List[Finding]:
+        own = _own_statements(fn)
+        tainted, guarded = self._taint_names(own, divergent_funcs)
+        findings: List[Finding] = []
+        controls: List[ast.AST] = []
+
+        def judge_call(node: ast.Call) -> None:
+            dotted = _dotted_name(node.func)
+            if not dotted or dotted.rsplit(".", 1)[-1] not in \
+                    DISPATCH_MARKERS:
+                return
+            why = None
+            for ctrl in controls:
+                why = _contains_divergence(ctrl, tainted, divergent_funcs)
+                if why:
+                    break
+            if not why:
+                return
+            if any(_contains_guard(c) for c in controls):
+                return
+            for ctrl in controls:
+                for n in ast.walk(ctrl):
+                    if isinstance(n, ast.Name) and n.id in guarded:
+                        return
+            findings.append(self.finding(
+                rel, node.lineno,
+                f"SPMD dispatch {dotted}() is controlled by per-process "
+                f"state ({why}) — processes can diverge on whether/how "
+                "often this collective runs",
+                hint="make the controlling value process-uniform, or "
+                     "gate the env-derived path with `not "
+                     "mesh_spans_processes(mesh)` / `process_count() == "
+                     "1` in the same condition (the PR 5 restore-chunk "
+                     "idiom)", col=node.col_offset))
+
+        def scan_exprs(node: ast.AST) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    judge_call(sub)
+
+        def visit(stmts: Sequence[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.If):
+                    scan_exprs(stmt.test)
+                    controls.append(stmt.test)
+                    visit(stmt.body)
+                    visit(stmt.orelse)
+                    controls.pop()
+                elif isinstance(stmt, ast.While):
+                    scan_exprs(stmt.test)
+                    controls.append(stmt.test)
+                    visit(stmt.body)
+                    controls.pop()
+                    visit(stmt.orelse)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_exprs(stmt.iter)
+                    controls.append(stmt.iter)
+                    visit(stmt.body)
+                    controls.pop()
+                    visit(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        scan_exprs(item.context_expr)
+                    visit(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    visit(stmt.body)
+                    for h in stmt.handlers:
+                        visit(h.body)
+                    visit(stmt.orelse)
+                    visit(stmt.finalbody)
+                else:
+                    scan_exprs(stmt)
+
+        visit(own)
+        return findings
+
+    def _taint_names(self, stmts: Sequence[ast.stmt],
+                     divergent_funcs: Set[str]
+                     ) -> Tuple[Set[str], Set[str]]:
+        """(tainted, guarded): locals derived from per-process state,
+        and the subset whose derivation ALSO consulted a topology guard
+        (the sanctioned idiom — `pipelined = threads > 1 and not
+        mesh_spans_processes(mesh)`)."""
+        assigns: List[Tuple[List[str], ast.AST]] = []
+        for node in _walk_own(stmts):
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names and node.value is not None:
+                    assigns.append((names, node.value))
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                t = node.target
+                if isinstance(t, ast.Name) and node.value is not None:
+                    assigns.append(([t.id], node.value))
+        tainted: Set[str] = set()
+        guarded: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for names, value in assigns:
+                if _contains_divergence(value, tainted, divergent_funcs):
+                    has_guard = (_contains_guard(value)
+                                 or any(isinstance(n, ast.Name)
+                                        and n.id in guarded
+                                        for n in ast.walk(value)))
+                    for n in names:
+                        if n not in tainted:
+                            tainted.add(n)
+                            changed = True
+                        if has_guard and n not in guarded:
+                            guarded.add(n)
+                            changed = True
+        return tainted, guarded

@@ -1,0 +1,328 @@
+"""thread-shared-state: shared mutable state holds its lock.
+
+The ``_LEG_RETRIES`` rule (caught in PR 5 review): a counter mutated
+from pool-submitted migration legs AND from the coordinating code,
+where one side forgot the lock — increments interleave, retries vanish
+from ``last_move_stats``, and the bug only reproduces under concurrent
+legs on a loaded host. Statically checkable shape:
+
+* a callable handed to a thread (``threading.Thread(target=...)``,
+  ``pool.submit(f, ...)``, or this repo's pooled-leg helper
+  ``_run_pooled(items, f, ...)``) mutates an attribute of its class or
+  a module-level global, AND
+* other (non-``__init__``) code of the same class/module mutates the
+  same state, AND
+* at least one of those mutation sites is not inside a ``with <lock>``
+  block (any context-manager whose name contains lock/cond/mutex/sem).
+
+Both sides must hold a lock — "the thread side is guarded" is not a
+discipline, it is half of one. ``__init__`` (and ``__new__`` /
+``__post_init__``) assignments are construction-time and exempt.
+Methods reachable from a thread entry through ``self.<m>()`` calls, and
+functions lexically nested inside thread callables, count as running on
+the thread.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass, _dotted_name
+
+# word-boundary-aware: `self._lock` / `_RETRY_LOCK` / `pod_cond` are
+# locks; `block_writer` ('lock' mid-word) and `clock` are NOT
+_LOCKISH = re.compile(r"(^|_)(lock|cond|mutex|sem|cv)s?($|_|\d)",
+                      re.IGNORECASE)
+_POOLED_HELPER = re.compile(r"(^|_)run_pooled$")
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _lockish_with(node: ast.With) -> bool:
+    for item in node.items:
+        name = _dotted_name(item.context_expr)
+        if not name and isinstance(item.context_expr, ast.Call):
+            name = _dotted_name(item.context_expr.func)
+        if name and _LOCKISH.search(name.rsplit(".", 1)[-1]):
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class _MutSite:
+    name: str              # attr or global name
+    node: ast.AST
+    func: ast.AST          # innermost def containing the mutation
+    locked: bool
+    in_init: bool
+    where: str             # human label for the message
+
+
+class _FileAnalysis(ast.NodeVisitor):
+    """One walk: scope-resolved thread-entry targets, per-class attr
+    mutations, module-global mutations — with the enclosing ``with``
+    stack tracked for lock detection."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.tree = tree
+        # plain AND annotated assignments: the real `_LEG_RETRIES:
+        # List[int] = [0]` is an AnnAssign — missing it would make this
+        # pass blind to its own headline bug
+        self.module_globals: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                self.module_globals.update(
+                    t.id for t in stmt.targets if isinstance(t, ast.Name))
+            elif (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                self.module_globals.add(stmt.target.id)
+        #: def-node ids that are handed to a thread/pool directly
+        self.thread_entries: Set[int] = set()
+        #: (class-node id, method name) referenced as self.<m> targets
+        self.thread_methods: Set[Tuple[int, str]] = set()
+        #: class-node id -> {method name: def node}
+        self.class_methods: Dict[int, Dict[str, ast.AST]] = {}
+        #: class-node id -> class name
+        self.class_names: Dict[int, str] = {}
+        #: def-node id -> set of self.<m>() method names it calls
+        self.self_calls: Dict[int, Set[str]] = {}
+        #: def-node id -> id of the def it is lexically nested in
+        self.parent_def: Dict[int, Optional[int]] = {}
+        #: def-node id -> id of the enclosing class (methods AND defs
+        #: nested inside them — a `self.<m>()` call from a nested leg
+        #: function must resolve against the same class)
+        self.def_class: Dict[int, Optional[int]] = {}
+        #: def-node id -> def node
+        self.defs: Dict[int, ast.AST] = {}
+        #: mutations of self.<attr>: class-node id -> list[_MutSite]
+        self.attr_muts: Dict[int, List[_MutSite]] = {}
+        #: mutations of module globals: name -> list[_MutSite]
+        self.global_muts: Dict[str, List[_MutSite]] = {}
+
+        self._scopes: List[Dict[str, ast.AST]] = []
+        self._class_stack: List[int] = []
+        self._def_stack: List[ast.AST] = []
+        self._with_locks = 0
+        self._visit_module()
+
+    # -- scope plumbing ---------------------------------------------------
+
+    def _visit_module(self) -> None:
+        self._scopes.append(self._defs_in(self.tree.body))
+        for stmt in self.tree.body:
+            self.visit(stmt)
+
+    @staticmethod
+    def _defs_in(body: List[ast.stmt]) -> Dict[str, ast.AST]:
+        return {s.name: s for s in body
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _resolve(self, name: str) -> Optional[ast.AST]:
+        for scope in reversed(self._scopes):
+            if name in scope:
+                return scope[name]
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_methods[id(node)] = self._defs_in(node.body)
+        self.class_names[id(node)] = node.name
+        self._class_stack.append(id(node))
+        self._scopes.append({})  # class body is not a name scope for defs
+        for stmt in node.body:
+            self.visit(stmt)
+        self._scopes.pop()
+        self._class_stack.pop()
+
+    def _visit_def(self, node) -> None:
+        self.defs[id(node)] = node
+        self.parent_def[id(node)] = (
+            id(self._def_stack[-1]) if self._def_stack else None)
+        self.def_class[id(node)] = (
+            self._class_stack[-1] if self._class_stack else None)
+        self._def_stack.append(node)
+        self._scopes.append(self._defs_in(node.body))
+        saved_locks = self._with_locks
+        self._with_locks = 0  # a lock held OUTSIDE a def does not guard
+        for stmt in node.body:  # a deferred call of the def
+            self.visit(stmt)
+        self._with_locks = saved_locks
+        self._scopes.pop()
+        self._def_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = _lockish_with(node)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if locked:
+            self._with_locks += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if locked:
+            self._with_locks -= 1
+
+    visit_AsyncWith = visit_With
+
+    # -- thread-entry discovery ------------------------------------------
+
+    def _mark_entry(self, expr: ast.AST) -> None:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self._class_stack):
+            self.thread_methods.add((self._class_stack[-1], expr.attr))
+        elif isinstance(expr, ast.Name):
+            target = self._resolve(expr.id)
+            if target is not None:
+                self.thread_entries.add(id(target))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fname = _dotted_name(node.func)
+        last = fname.rsplit(".", 1)[-1] if fname else ""
+        if last == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self._mark_entry(kw.value)
+        elif last == "submit" and node.args:
+            self._mark_entry(node.args[0])
+        elif _POOLED_HELPER.search(last):
+            for arg in node.args:
+                self._mark_entry(arg)
+        # self.<m>() calls, for the runs-on-thread closure
+        if (self._def_stack
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            self.self_calls.setdefault(
+                id(self._def_stack[-1]), set()).add(node.func.attr)
+        self.generic_visit(node)
+
+    # -- mutation discovery ----------------------------------------------
+
+    def _record_mutation(self, target: ast.AST, stmt: ast.AST) -> None:
+        if not self._def_stack:
+            return  # module-level execution is import-time, single-threaded
+        base = target
+        while isinstance(base, (ast.Subscript, ast.Attribute)) and not (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            base = base.value
+        func = self._def_stack[-1]
+        fname = getattr(func, "name", "<lambda>")
+        locked = self._with_locks > 0
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and self._class_stack):
+            self.attr_muts.setdefault(self._class_stack[-1], []).append(
+                _MutSite(name=base.attr, node=stmt, func=func,
+                         locked=locked, in_init=fname in _INIT_METHODS,
+                         where=fname))
+        elif isinstance(base, ast.Name) and base.id in self.module_globals:
+            is_rebind = base is target  # plain `X = ...` needs `global`
+            if is_rebind and not self._has_global_decl(base.id):
+                return
+            self.global_muts.setdefault(base.id, []).append(
+                _MutSite(name=base.id, node=stmt, func=func,
+                         locked=locked, in_init=False, where=fname))
+
+    def _has_global_decl(self, name: str) -> bool:
+        for func in self._def_stack:
+            for stmt in ast.walk(func):
+                if isinstance(stmt, ast.Global) and name in stmt.names:
+                    return True
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_mutation(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_mutation(node.target, node)
+        self.generic_visit(node)
+
+    # -- runs-on-thread closure ------------------------------------------
+
+    def thread_ctx(self) -> Set[int]:
+        ctx: Set[int] = set(self.thread_entries)
+        for cls_id, mname in self.thread_methods:
+            m = self.class_methods.get(cls_id, {}).get(mname)
+            if m is not None:
+                ctx.add(id(m))
+        changed = True
+        while changed:
+            changed = False
+            # self.<m>() from ANY def running on the thread — a method
+            # or a def lexically nested inside one (the closure-heavy
+            # leg-function shape) — puts the callee on the thread
+            for def_id in list(ctx):
+                cls_id = self.def_class.get(def_id)
+                if cls_id is None:
+                    continue
+                methods = self.class_methods.get(cls_id, {})
+                for callee in self.self_calls.get(def_id, ()):
+                    c = methods.get(callee)
+                    if c is not None and id(c) not in ctx:
+                        ctx.add(id(c))
+                        changed = True
+            # defs nested inside a thread callable run on the thread
+            for def_id, parent in self.parent_def.items():
+                if (def_id not in ctx and parent is not None
+                        and parent in ctx):
+                    ctx.add(def_id)
+                    changed = True
+        return ctx
+
+
+class ThreadSharedStatePass(Pass):
+    name = "thread-shared-state"
+    description = ("state mutated from a thread/pool callable and from "
+                   "other code of the same class/module holds a common "
+                   "lock on both sides")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            fa = _FileAnalysis(sf.tree)
+            ctx = fa.thread_ctx()
+
+            def runs_on_thread(site: _MutSite) -> bool:
+                return id(site.func) in ctx
+
+            for cls_id, sites in fa.attr_muts.items():
+                cname = fa.class_names.get(cls_id, "?")
+                by_attr: Dict[str, List[_MutSite]] = {}
+                for s in sites:
+                    by_attr.setdefault(s.name, []).append(s)
+                for attr, group in by_attr.items():
+                    self._judge(out, sf.rel, f"{cname}.{attr}", group,
+                                runs_on_thread)
+            for gname, group in fa.global_muts.items():
+                self._judge(out, sf.rel, gname, group, runs_on_thread)
+        return out
+
+    def _judge(self, out: List[Finding], rel: str, label: str,
+               group: List[_MutSite], runs_on_thread) -> None:
+        thread_side = [s for s in group if runs_on_thread(s)]
+        other_side = [s for s in group
+                      if not runs_on_thread(s) and not s.in_init]
+        if not thread_side or not other_side:
+            return
+        unguarded = [s for s in thread_side + other_side if not s.locked]
+        for s in unguarded:
+            side = ("a thread/pool callable" if runs_on_thread(s)
+                    else "non-thread code")
+            out.append(self.finding(
+                rel, s.node.lineno,
+                f"{label} is mutated from {side} ({s.where}) without its "
+                "lock, while the other side of the same state also "
+                "mutates it",
+                hint="hold one lock at EVERY mutation site (`with "
+                     "self._lock:` / the module's lock) — the "
+                     "_LEG_RETRIES rule from PR 5",
+                col=getattr(s.node, "col_offset", 0)))

@@ -382,6 +382,30 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--db", default=":memory:")
 
     p = sub.add_parser(
+        "lint",
+        help="harmonylint: codebase-aware static analysis pinning the "
+             "repo's concurrency/SPMD/docs invariants "
+             "(docs/STATIC_ANALYSIS.md)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or package dirs to lint "
+                        "(default: the installed harmony_tpu/ tree)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (schema v1)")
+    p.add_argument("--passes", default=None,
+                   help="comma-separated subset of pass names")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the pass catalog and exit")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON: suppress its findings "
+                        "(overrides [tool.harmony.lint] baseline)")
+    p.add_argument("--write-baseline", default=None, metavar="PATH",
+                   help="write the run's active findings as a new "
+                        "baseline and exit 0")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list suppressed findings")
+
+    p = sub.add_parser(
         "obs",
         help="observability tooling: flight records, /metrics scrape, "
              "trace timelines (docs/OBSERVABILITY.md)",
@@ -425,6 +449,8 @@ def main(argv: List[str] | None = None) -> int:
             resp = CommandSender(args.port).send_job_submit_command(cfg)
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
+    if args.cmd == "lint":
+        return _cmd_lint(args)
     if args.cmd == "obs":
         return _cmd_obs(args)
     if args.cmd == "run":
@@ -487,6 +513,89 @@ def _make_server(num_executors: int, dashboard_url=None, chkp_root=None):
                        chkp_root=chkp_root)
     server.start()
     return server
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """harmonylint runner — pure stdlib, never imports jax (this must
+    stay invocable on a box with no accelerator stack, like the thin
+    submit path). Exit codes: 0 clean, 1 findings, 2 usage error."""
+    import os
+
+    from harmony_tpu.analysis import (
+        all_passes,
+        get_pass,
+        load_baseline,
+        render_json,
+        render_text,
+        run_lint,
+        save_baseline,
+    )
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.name:22s} {p.description}")
+        return 0
+    passes = None
+    if args.passes:
+        try:
+            passes = [get_pass(n.strip())
+                      for n in args.passes.split(",") if n.strip()]
+        except KeyError as e:
+            print(e.args[0], file=sys.stderr)
+            return 2
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"baseline: {e}", file=sys.stderr)
+            return 2
+    kwargs: Dict[str, Any] = {"passes": passes, "baseline": baseline}
+    if args.paths:
+        missing = [p for p in args.paths
+                   if not os.path.isfile(p) and not os.path.isdir(p)]
+        if missing:
+            # a typo'd path silently dropped would leave the gate green
+            # while the file goes unlinted
+            print(f"lint: no such path: {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+        files = [p for p in args.paths if os.path.isfile(p)]
+        dirs = [p for p in args.paths if os.path.isdir(p)]
+        if files and dirs:
+            print("lint: pass either files or one package dir, not both",
+                  file=sys.stderr)
+            return 2
+        if files:
+            kwargs["files"] = files
+        elif len(dirs) == 1:
+            kwargs["root"] = dirs[0]
+        else:
+            print("lint: at most one package dir", file=sys.stderr)
+            return 2
+    try:
+        result = run_lint(**kwargs)
+    except (ValueError, OSError) as e:
+        # broken [tool.harmony.lint] config / unreadable baseline: a
+        # USAGE error (exit 2), never confusable with "findings" (1)
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        try:
+            n = save_baseline(result, args.write_baseline)
+        except OSError as e:
+            # same contract as a bad --baseline read: a failed WRITE is a
+            # usage error (2), never confusable with "findings" (1)
+            print(f"lint: write-baseline: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+              f"{args.write_baseline}")
+        return 0
+    if args.json:
+        print(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
 
 
 def _cmd_obs(args: argparse.Namespace) -> int:

@@ -250,9 +250,14 @@ class PodJobServer(JobServer):
             pid, f = self._read_join(conn)
             if pid is None:
                 continue
-            self._followers[pid] = (conn, f)
-            self._send_locks[pid] = threading.Lock()
-            self._last_seen[pid] = time.monotonic()
+            # under the pod cond even though readers start below: the
+            # late-join acceptor and monitor mutate these same maps from
+            # their threads, and every mutation site holds the lock (the
+            # thread-shared-state lint pins this)
+            with self._pod_cond:
+                self._followers[pid] = (conn, f)
+                self._send_locks[pid] = threading.Lock()
+                self._last_seen[pid] = time.monotonic()
             server_log.info("pod follower %d joined from %s", pid, addr)
         for pid, (conn, f) in sorted(self._followers.items()):
             t = threading.Thread(

@@ -432,7 +432,8 @@ class JobServer:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind(("127.0.0.1", port))
         sock.listen(16)
-        self._tcp_sock = sock
+        with self._lock:
+            self._tcp_sock = sock
         self.port = sock.getsockname()[1]
 
         def loop() -> None:
@@ -508,9 +509,13 @@ class JobServer:
                 pass  # client went away; nothing to tell it
 
     def _stop_tcp(self) -> None:
-        if self._tcp_sock is not None:
+        # under the lock: shutdown() can be invoked from a TCP handler
+        # thread, and two concurrent SHUTDOWNs racing this check-close-
+        # clear sequence could close-then-read a None socket
+        with self._lock:
+            sock, self._tcp_sock = self._tcp_sock, None
+        if sock is not None:
             try:
-                self._tcp_sock.close()
+                sock.close()
             except OSError:
                 pass
-            self._tcp_sock = None

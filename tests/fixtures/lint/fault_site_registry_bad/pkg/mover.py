@@ -1,0 +1,17 @@
+"""KNOWN-BAD fixture tree: a typo'd site (``blockmove.sendd``) the
+registry never heard of — a plan armed at ``blockmove.send`` silently
+injects nothing — and the registry's ``chkp.commit`` row has no code
+site left. The fault-site-registry pass must flag both directions."""
+from harmony_tpu import faults
+
+
+def send_block(block, dst):
+    if faults.armed():
+        faults.site("blockmove.sendd", block=block, dst=dst)  # typo'd
+    return dst.push(block)
+
+
+def stage_block(block, seq):
+    if faults.armed():
+        faults.site("blockmove.stage_write", block=block, seq=seq)
+    return seq

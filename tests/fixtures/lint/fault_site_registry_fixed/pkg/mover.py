@@ -1,0 +1,21 @@
+"""FIXED fixture tree: every fired site has a registry row and every
+row is fired. The fault-site-registry pass must come up clean."""
+from harmony_tpu import faults
+
+
+def send_block(block, dst):
+    if faults.armed():
+        faults.site("blockmove.send", block=block, dst=dst)
+    return dst.push(block)
+
+
+def stage_block(block, seq):
+    if faults.armed():
+        faults.site("blockmove.stage_write", block=block, seq=seq)
+    return seq
+
+
+def commit(chkp_id):
+    if faults.armed():
+        faults.site("chkp.commit", chkp_id=chkp_id)
+    return chkp_id

@@ -1,0 +1,17 @@
+"""KNOWN-BAD fixture: the PR 6 retrace-every-call bug — a fresh jit
+wrapper built and invoked in one expression inside a per-call lambda
+(apps/nmf.py's old shape), plus a step-shaped jit silent about
+donation. The jit-hygiene pass must flag both."""
+import jax
+
+
+def write_all(specs, values):
+    for spec, value in zip(specs, values):
+        jax.jit(spec.write_all)(value)  # BAD: construct-and-call
+
+
+def train_step(tbl, batch):
+    return tbl + batch
+
+
+step = jax.jit(train_step)  # BAD: step-shaped, donation intent unstated

@@ -1,0 +1,23 @@
+"""FIXED fixture: wrappers are cached (module scope here; table._jitted
+or runtime/progcache in the tree) and the step jit states its donation
+intent explicitly. The jit-hygiene pass must come up clean."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def _writer(spec):
+    return jax.jit(spec.write_all)
+
+
+def write_all(specs, values):
+    for spec, value in zip(specs, values):
+        _writer(spec)(value)
+
+
+def train_step(tbl, batch):
+    return tbl + batch
+
+
+step = jax.jit(train_step, donate_argnums=(0,))

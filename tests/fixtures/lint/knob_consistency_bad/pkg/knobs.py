@@ -1,0 +1,13 @@
+"""KNOWN-BAD fixture tree: the tuning knob read below is documented
+nowhere, and the manifest wires a ghost knob that nothing in this tree
+reads (and that the docs never mention). The knob-consistency pass
+must flag all three directions."""
+import os
+
+
+def tuning():
+    return int(os.environ.get("HARMONY_SECRET_TUNING", "0"))  # undocumented
+
+
+def period():
+    return float(os.environ.get("HARMONY_HB_PERIOD_FIX", "2"))

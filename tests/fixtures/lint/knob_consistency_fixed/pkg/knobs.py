@@ -1,0 +1,12 @@
+"""FIXED fixture tree: every knob read is documented and every
+manifest-wired knob is read and documented. The knob-consistency pass
+must come up clean."""
+import os
+
+
+def tuning():
+    return int(os.environ.get("HARMONY_SECRET_TUNING", "0"))
+
+
+def period():
+    return float(os.environ.get("HARMONY_HB_PERIOD_FIX", "2"))

@@ -1,0 +1,12 @@
+"""KNOWN-BAD fixture: instrument declarations that would fail the
+scrape-time exposition lint — a harmony_* counter without _total, a
+histogram without a base-unit suffix, and an empty HELP string. The
+metric-conventions pass must flag all three."""
+
+
+def register(reg):
+    reg.counter("harmony_progcache_events", "hits and misses",
+                ("result",))  # BAD: counter must end _total
+    reg.histogram("harmony_step_latency", "per-step wall time",
+                  ("job",))  # BAD: no _seconds/_bytes unit suffix
+    reg.gauge("harmony_inflight_bytes", "")  # BAD: empty HELP

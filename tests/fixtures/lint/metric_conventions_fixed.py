@@ -1,0 +1,11 @@
+"""FIXED fixture: the same instruments named per the exposition
+contract (docs/OBSERVABILITY.md). The metric-conventions pass must
+come up clean."""
+
+
+def register(reg):
+    reg.counter("harmony_progcache_events_total", "hits and misses",
+                ("result",))
+    reg.histogram("harmony_step_latency_seconds", "per-step wall time",
+                  ("job",))
+    reg.gauge("harmony_inflight_bytes", "bytes currently in flight")

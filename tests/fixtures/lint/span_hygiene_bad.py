@@ -1,0 +1,19 @@
+"""KNOWN-BAD fixture: a span opened positionally leaks on the
+exception path — compute() raising skips __exit__, the span never
+emits, and the trace timeline loses the failing subtree. The
+span-hygiene pass must flag both opens."""
+from harmony_tpu.tracing.span import trace_span
+
+
+def step(compute, batch):
+    cm = trace_span("dolphin.step", batch=batch)  # BAD: no `with`
+    cm.__enter__()
+    out = compute(batch)
+    cm.__exit__(None, None, None)
+    return out
+
+
+def epoch(compute, batches):
+    spans = [trace_span("dolphin.epoch", i=i)  # BAD: stored, never closed
+             for i, _ in enumerate(batches)]
+    return [compute(b) for b in batches], spans

@@ -1,0 +1,17 @@
+"""FIXED fixture: spans opened via `with` (or owned by an ExitStack)
+close — and emit — on every path. The span-hygiene pass must come up
+clean."""
+import contextlib
+
+from harmony_tpu.tracing.span import trace_span
+
+
+def step(compute, batch):
+    with trace_span("dolphin.step", batch=batch):
+        return compute(batch)
+
+
+def epoch(compute, batches):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(trace_span("dolphin.epoch"))
+        return [compute(b) for b in batches]

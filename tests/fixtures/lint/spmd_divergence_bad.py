@@ -1,0 +1,24 @@
+"""KNOWN-BAD fixture: the PR 5 chunk-count bug, pre-fix shape.
+
+The restore chunk count derives from this process's
+HARMONY_CHKP_IO_THREADS; `import_blocks` on a spanning mesh is an
+SPMD-collective dispatch, so env skew across the pod diverges the
+collective sequence and wedges the restore. The spmd-divergence pass
+must flag the gated `import_blocks` call."""
+import os
+
+
+def _chkp_io_threads():
+    return max(1, int(os.environ.get("HARMONY_CHKP_IO_THREADS", "4")))
+
+
+def restore_inner(handle, info, read_block):
+    threads = min(_chkp_io_threads(), max(1, len(info.block_ids)))
+    pipelined = threads > 1 and not info.sparse
+    blocks = {}
+    for bid in info.block_ids:
+        blocks[bid] = read_block(bid)
+        if pipelined and len(blocks) >= 16:
+            handle.table.import_blocks(blocks)  # BAD: env-steered dispatch
+            blocks = {}
+    handle.table.import_blocks(blocks)

@@ -1,0 +1,26 @@
+"""FIXED fixture: the PR 5 shape as shipped — the env-derived pipeline
+decision is fenced to single-process meshes by a topology guard in the
+same condition chain, so spanning meshes keep the one collective
+import. The spmd-divergence pass must come up clean."""
+import os
+
+
+def _chkp_io_threads():
+    return max(1, int(os.environ.get("HARMONY_CHKP_IO_THREADS", "4")))
+
+
+def mesh_spans_processes(mesh):
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def restore_inner(handle, info, read_block, mesh):
+    threads = min(_chkp_io_threads(), max(1, len(info.block_ids)))
+    pipelined = (threads > 1 and not info.sparse
+                 and not mesh_spans_processes(mesh))
+    blocks = {}
+    for bid in info.block_ids:
+        blocks[bid] = read_block(bid)
+        if pipelined and len(blocks) >= 16:
+            handle.table.import_blocks(blocks)  # single-process only
+            blocks = {}
+    handle.table.import_blocks(blocks)

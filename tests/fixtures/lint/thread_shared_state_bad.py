@@ -1,0 +1,45 @@
+"""KNOWN-BAD fixture: the `_LEG_RETRIES` bug, pre-PR-5-review shape.
+
+A module counter mutated from pool-submitted migration legs AND reset
+from the coordinating code, with no lock on either side — increments
+interleave and retries vanish from the stats. The thread-shared-state
+pass must flag both unguarded mutation sites. A second class-shaped
+case: a worker thread and a public method both move `self._state`
+without the instance lock."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from typing import List
+
+_LEG_RETRIES: List[int] = [0]  # annotated, like the real blockmove.py
+_RETRY_LOCK = threading.Lock()
+
+
+def tcp_exchange(legs, send):
+    def run_leg(leg):
+        send(leg)
+        _LEG_RETRIES[0] += 1  # BAD: pool thread, no _RETRY_LOCK
+
+    with ThreadPoolExecutor(4) as pool:
+        futs = [pool.submit(run_leg, leg) for leg in legs]
+    return [f.result() for f in futs]
+
+
+def migrate_blocks(arr, plan, send):
+    _LEG_RETRIES[0] = 0  # BAD: other side of the same counter, no lock
+    return tcp_exchange(plan(arr), send)
+
+
+class Mover:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        self._state = "draining"  # BAD: worker thread, no self._lock
+
+    def close(self):
+        self._state = "closed"  # BAD: caller thread, same attribute

@@ -1,0 +1,44 @@
+"""FIXED fixture: every mutation site of the shared counter/attribute
+holds the common lock (the shape blockmove.py ships since PR 5). The
+thread-shared-state pass must come up clean."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from typing import List
+
+_LEG_RETRIES: List[int] = [0]
+_RETRY_LOCK = threading.Lock()
+
+
+def tcp_exchange(legs, send):
+    def run_leg(leg):
+        send(leg)
+        with _RETRY_LOCK:
+            _LEG_RETRIES[0] += 1
+
+    with ThreadPoolExecutor(4) as pool:
+        futs = [pool.submit(run_leg, leg) for leg in legs]
+    return [f.result() for f in futs]
+
+
+def migrate_blocks(arr, plan, send):
+    with _RETRY_LOCK:
+        _LEG_RETRIES[0] = 0
+    return tcp_exchange(plan(arr), send)
+
+
+class Mover:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        with self._lock:
+            self._state = "draining"
+
+    def close(self):
+        with self._lock:
+            self._state = "closed"

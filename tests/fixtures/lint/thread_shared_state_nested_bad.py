@@ -1,0 +1,31 @@
+"""KNOWN-BAD fixture: the `_LEG_RETRIES` bug hidden one closure deeper.
+
+The thread entry (`_loop`) does not touch shared state itself — it
+defines a nested leg function that calls `self._bump()`, and _bump_
+mutates the counter. The runs-on-thread closure must follow
+`self.<m>()` calls made from defs lexically nested inside thread
+callables, not just from methods handed to Thread directly — exactly
+the closure-heavy shape pod.py/blockmove use for per-leg work.
+"""
+import threading
+
+
+class NestedCounter:
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        def pump():  # nested leg function — still runs on the thread
+            self._bump()
+        for _ in range(3):
+            pump()
+
+    def _bump(self):
+        self._n += 1  # BAD: reached from the thread via nested def, no lock
+
+    def reset(self):
+        self._n = 0  # BAD: other side of the same counter, no lock

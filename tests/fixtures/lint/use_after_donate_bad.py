@@ -1,0 +1,19 @@
+"""KNOWN-BAD fixture: donated buffer touched after the step.
+
+Both shapes the pass pins: a read of the donated name after the call
+without rebinding, and a loop that re-donates the same dead handle
+every iteration. The use-after-donate pass must flag both."""
+import jax
+
+train_step = jax.jit(lambda tbl, batch: tbl + batch, donate_argnums=(0,))
+
+
+def run_epoch(tbl, batches):
+    for batch in batches:
+        out = train_step(tbl, batch)  # BAD: tbl never rebound in the loop
+    return out
+
+
+def run_once(tbl, batch):
+    out = train_step(tbl, batch)
+    return out, tbl.sum()  # BAD: tbl was donated on the line above

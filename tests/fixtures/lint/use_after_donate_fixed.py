@@ -1,0 +1,17 @@
+"""FIXED fixture: the sanctioned donation shape — the call's result is
+bound back onto the donated name, so every read sees the live buffer.
+The use-after-donate pass must come up clean."""
+import jax
+
+train_step = jax.jit(lambda tbl, batch: tbl + batch, donate_argnums=(0,))
+
+
+def run_epoch(tbl, batches):
+    for batch in batches:
+        tbl = train_step(tbl, batch)
+    return tbl
+
+
+def run_once(tbl, batch):
+    tbl = train_step(tbl, batch)
+    return tbl, tbl.sum()

@@ -1,0 +1,29 @@
+"""Shared harmonylint run for the tier-1 wrapper tests.
+
+The full suite over the real tree costs ~5 s of parsing+passes;
+test_analysis's gate, the jit-hygiene wrappers, the gke env/doc
+wrapper and the telemetry metric-conventions wrapper all want the same
+answer, so one process-wide run is cached here and each consumer
+filters it by pass name (the full-suite run subsumes any single-pass
+run: same index, same detections)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+_RESULT = None
+
+
+def full_tree_result():
+    from harmony_tpu.analysis import run_lint
+
+    global _RESULT
+    if _RESULT is None:
+        _RESULT = run_lint()
+    return _RESULT
+
+
+def tree_findings(pass_name: Optional[str] = None) -> List:
+    r = full_tree_result()
+    if pass_name is None:
+        return list(r.findings)
+    return [f for f in r.findings if f.pass_name == pass_name]

@@ -1,112 +1,35 @@
 """Tier-1 lint: jit construction hygiene in hot/warm paths.
 
-Guards the bug class PR 6 fixed (apps/nmf.py, apps/lda.py,
-checkpoint/orbax_io.py, pregel/master.py): building a FRESH ``jax.jit``
-wrapper inside a lambda/loop that runs per invocation — each call makes a
-new Python closure, so jax's executable cache can never hit and the
-program retraces (and recompiles) every time. Two AST rules over all of
-``harmony_tpu/``:
-
-  1. no construct-and-call — ``jax.jit(...)(...)`` / ``pjit(...)(...)``
-     in one expression builds a wrapper and throws it away after one
-     call. Hoist the wrapper (module scope, a table's ``_jitted`` cache,
-     or runtime/progcache).
-  2. step-shaped jits declare donation intent — any ``jax.jit(fn)``
-     whose traced function is named like a training step (``*step*``,
-     ``*epoch*``) must pass ``donate_argnums`` EXPLICITLY (``()`` is
-     fine: it says "this step deliberately does not donate"). Donation
-     is the fused hot path's memory contract; an implicit default on a
-     step is how a double-buffered table silently doubles HBM.
+Since PR 7 the two AST rules that lived here are the ``jit-hygiene``
+pass of harmonylint (harmony_tpu/analysis/passes/jit.py — the full
+suite also runs tree-wide in tests/test_analysis.py); these wrappers
+keep the original per-rule failure surface. The old file-level
+allowlist (table/autotune.py's one-shot push-route measurement) is now
+an inline ``# lint: allow(jit-hygiene) <reason>`` pragma at the call
+site, where the justification can't drift away from the code it
+vouches for.
 """
 from __future__ import annotations
 
-import ast
-import os
-import re
-
-HARMONY_ROOT = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "harmony_tpu",
-)
-
-# Files allowed to construct-and-call a jit wrapper, with why. Keep this
-# list SHORT and justified — every entry is a place the lint cannot see
-# the call frequency and a human vouched it is one-shot.
-CONSTRUCT_AND_CALL_ALLOWLIST = {
-    # one-shot push-route measurement at job-build time (never per batch)
-    "table/autotune.py",
-}
-
-STEP_NAME = re.compile(r"(^|_)(step|epoch|superstep)", re.IGNORECASE)
+from lint_helpers import tree_findings
 
 
-def _is_jit_call(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
-        return True
-    if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
-        return True
-    return False
-
-
-def _py_files():
-    for root, _dirs, files in os.walk(HARMONY_ROOT):
-        if "__pycache__" in root:
-            continue
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
-
-
-def _rel(path: str) -> str:
-    return os.path.relpath(path, HARMONY_ROOT).replace(os.sep, "/")
+def _findings():
+    return tree_findings("jit-hygiene")
 
 
 def test_no_construct_and_call_jit():
     """jax.jit(...)(...) builds a fresh wrapper per evaluation — the
     retrace-every-call bug class. Every such expression must be hoisted
     into a cached wrapper."""
-    offenders = []
-    for path in _py_files():
-        rel = _rel(path)
-        tree = ast.parse(open(path).read(), filename=path)
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Call)
-                and _is_jit_call(node.func)
-            ):
-                if rel in CONSTRUCT_AND_CALL_ALLOWLIST:
-                    continue
-                offenders.append(f"{rel}:{node.lineno}")
-    assert not offenders, (
-        "jit wrapper constructed and invoked in one expression (retraces "
-        "every call) — hoist it into a cached wrapper (table._jitted / "
-        f"runtime.progcache / module scope): {offenders}"
-    )
+    offenders = [f.format() for f in _findings()
+                 if "constructed and invoked" in f.message]
+    assert not offenders, offenders
 
 
 def test_step_shaped_jits_declare_donation_intent():
     """Any jit over a function named like a training step must say what
     it donates — explicitly, even when the answer is 'nothing'."""
-    offenders = []
-    for path in _py_files():
-        rel = _rel(path)
-        tree = ast.parse(open(path).read(), filename=path)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
-                continue
-            if not node.args:
-                continue
-            target = node.args[0]
-            if not (isinstance(target, ast.Name)
-                    and STEP_NAME.search(target.id)):
-                continue
-            kwargs = {k.arg for k in node.keywords}
-            if "donate_argnums" not in kwargs:
-                offenders.append(f"{rel}:{node.lineno} jit({target.id})")
-    assert not offenders, (
-        "step-shaped jit without an explicit donate_argnums (pass "
-        f"donate_argnums=() to declare a deliberate non-donating step): "
-        f"{offenders}"
-    )
+    offenders = [f.format() for f in _findings()
+                 if "donate_argnums" in f.message]
+    assert not offenders, offenders

@@ -108,6 +108,19 @@ class TestRegistry:
         assert decoded == weird
 
 
+def test_metric_declarations_satisfy_exposition_conventions():
+    """The static half of the exposition lint — since PR 7 the naming
+    rules lint_exposition enforces at scrape time (harmony_ prefix,
+    counters end _total, histograms carry a unit, non-empty HELP) are
+    pinned at every instrument DECLARATION site by harmonylint's
+    ``metric-conventions`` pass, so a bad family fails tier-1 even if
+    no test ever scrapes it."""
+    from lint_helpers import tree_findings
+
+    findings = tree_findings("metric-conventions")
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
 class TestExporter:
     def test_metrics_endpoint_passes_format_lint_and_monotone(
             self, fresh_registry):
