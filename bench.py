@@ -604,6 +604,153 @@ def measure_lint() -> "dict | None":
         return None
 
 
+# -- machine-checked perf history (bench.py --compare) ---------------------
+#
+# The committed BENCH_r*.json trajectory was prose-reviewed until now: a
+# regression only surfaced if a human read two JSON blobs side by side.
+# `--compare` diffs the newest two rounds on the named headline series
+# and exits 1 on a >threshold drop, so the history is machine-checked
+# (bin/bench_diff.sh wraps it; tests/test_bench_compare.py runs it as a
+# tier-1 smoke over the committed rounds).
+
+#: higher-is-better series checked by default. `value` is the headline
+#: aggregate; `cpu_rate` is the always-measurable denominator that keeps
+#: rounds comparable when the accelerator transport is wedged.
+HEADLINE_SERIES = ("value", "cpu_rate")
+COMPARE_THRESHOLD = 0.15
+
+
+def _bench_line(path: str) -> dict:
+    """The result line of one committed round — either the bare JSON
+    line bench.py prints or the driver's wrapper with it under
+    "parsed"."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a bench line")
+    return data
+
+
+def _series_value(line: dict, name: str):
+    """The measured number for one series, or (None, reason) when the
+    round holds no measurement for it. 0.0 counts as a MEASUREMENT only
+    when the line does not carry the unreachable-accelerator markers —
+    the emit() convention reserves 0.0-with-error for 'did not run'."""
+    v = line.get(name)
+    if v is None:
+        return None, "series absent"
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None, f"non-numeric {v!r}"
+    unreachable = ("error" in line
+                   or line.get("accelerator") == "unreachable")
+    if v <= 0.0 and unreachable:
+        return None, "unreachable-accelerator round (0.0 is not a measurement)"
+    return v, None
+
+
+def find_bench_rounds(root: "str | None" = None) -> "list[str]":
+    """Committed BENCH_r*.json beside this file (or under ``root``),
+    ordered oldest -> newest by round number."""
+    import glob
+    import re
+
+    root = root or os.path.dirname(os.path.abspath(__file__))
+
+    def round_of(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    files = [p for p in glob.glob(os.path.join(root, "BENCH_r*.json"))
+             if round_of(p) >= 0]
+    return sorted(files, key=round_of)
+
+
+def compare_bench(old_path: str, new_path: str,
+                  series=HEADLINE_SERIES,
+                  threshold: float = COMPARE_THRESHOLD) -> dict:
+    """Diff two committed rounds on the named headline series. A series
+    REGRESSES when both rounds measured it and the new value fell more
+    than ``threshold`` below the old; a series only one round measured
+    is reported as skipped (with the reason), never failed — an
+    unreachable accelerator is a transport state, not a code
+    regression."""
+    old_line, new_line = _bench_line(old_path), _bench_line(new_path)
+    report = {
+        "old": os.path.basename(old_path),
+        "new": os.path.basename(new_path),
+        "threshold": threshold,
+        "series": {},
+        "regressions": [],
+    }
+    for name in series:
+        old_v, old_why = _series_value(old_line, name)
+        new_v, new_why = _series_value(new_line, name)
+        row: dict = {"old": old_v, "new": new_v}
+        if old_v is None or new_v is None:
+            row["status"] = "skipped"
+            row["note"] = "; ".join(
+                f"{side}: {why}" for side, why in
+                (("old", old_why), ("new", new_why)) if why)
+            report["series"][name] = row
+            continue
+        row["ratio"] = round(new_v / old_v, 4) if old_v else None
+        if old_v > 0 and new_v < old_v * (1.0 - threshold):
+            row["status"] = "regression"
+            report["regressions"].append(name)
+        else:
+            row["status"] = "ok"
+        report["series"][name] = row
+    report["ok"] = not report["regressions"]
+    return report
+
+
+def compare_main(argv) -> int:
+    """`python bench.py --compare [--dir D] [--series a,b] [--threshold
+    T] [OLD NEW]` — defaults to the newest two committed rounds. Exit:
+    0 ok, 1 regression, 2 usage (fewer than two rounds / bad files)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --compare")
+    ap.add_argument("--compare", action="store_true")  # the mode flag
+    ap.add_argument("--dir", default=None,
+                    help="where the committed BENCH_r*.json live "
+                         "(default: beside bench.py)")
+    ap.add_argument("--series", default=",".join(HEADLINE_SERIES),
+                    help="comma-separated headline series (higher=better)")
+    ap.add_argument("--threshold", type=float, default=COMPARE_THRESHOLD,
+                    help="allowed fractional drop before failing")
+    ap.add_argument("files", nargs="*",
+                    help="explicit OLD NEW round files (default: the "
+                         "newest two in --dir)")
+    args = ap.parse_args(argv)
+    if args.files and len(args.files) != 2:
+        print("--compare takes exactly two files (OLD NEW) or none",
+              file=sys.stderr)
+        return 2
+    if args.files:
+        old_path, new_path = args.files
+    else:
+        rounds = find_bench_rounds(args.dir)
+        if len(rounds) < 2:
+            print(f"--compare needs two committed rounds; found "
+                  f"{len(rounds)}", file=sys.stderr)
+            return 2
+        old_path, new_path = rounds[-2], rounds[-1]
+    series = [s.strip() for s in args.series.split(",") if s.strip()]
+    try:
+        report = compare_bench(old_path, new_path, series=series,
+                               threshold=args.threshold)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"--compare: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main():
     enable_compile_cache()
     try:
@@ -639,4 +786,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv[1:]:
+        sys.exit(compare_main(sys.argv[1:]))
     main()

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Machine-checked perf history: diff the newest two committed
+# BENCH_r*.json on the headline series and exit 1 on a >15% regression
+# (bench.py --compare; tier-1 runs the same check as a smoke). Pass-
+# through args: --dir D, --series a,b, --threshold T, or two explicit
+# round files (OLD NEW).
+cd "$(dirname "$0")/.." || exit 2
+exec python bench.py --compare "$@"

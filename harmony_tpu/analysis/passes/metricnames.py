@@ -1,5 +1,5 @@
 """metric-conventions: instrument declarations obey the exposition
-contract at the declaration site.
+contract at the declaration site, and instrument ⇄ doc-table parity.
 
 The scrape-time grammar/semantic linter (``metrics.registry
 .lint_exposition``, tier-1 since PR 4) catches a bad family name only
@@ -16,30 +16,87 @@ lint before it ever reaches an exporter:
   OpenMetrics unit convention docs/OBSERVABILITY.md documents,
 * the HELP string is non-empty (a help-less family renders a lint
   failure at scrape time).
+
+Plus the doc-parity directions (mirroring knob-consistency's shape):
+
+* every instrument REGISTERED in the tree appears in the
+  docs/OBSERVABILITY.md metric table — an undocumented instrument is a
+  number operators cannot interpret (the table is the metric glossary);
+* every ``harmony_*`` name a metric-table row documents is registered
+  somewhere — a documented-but-unregistered metric is a dashboard query
+  that silently returns nothing.
+
+Both directions need the WHOLE tree and the real docs to mean anything,
+so they are skipped on partial runs (explicit files / dir slices — the
+fixture corpus lints file-by-file and must not be compared against the
+real repo's table).
 """
 from __future__ import annotations
 
 import ast
 import re
-from typing import List
+from typing import Dict, List, Set, Tuple
 
 from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass, _str_const
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _HISTO_UNITS = ("_seconds", "_bytes")
 _METHODS = ("counter", "gauge", "histogram", "register_callback")
+#: full instrument names in doc TABLE rows (lowercase by convention —
+#: the knob tables' HARMONY_* env names never collide with this)
+_DOC_METRIC_RE = re.compile(r"harmony_[a-z][a-z0-9_]*")
+_METRIC_DOC = "OBSERVABILITY.md"
+
+
+def _registered_instruments(
+    tree: ast.AST, rel: str
+) -> List[Tuple[str, str, int]]:
+    """(name, method, line) for every registry-method call with a
+    literal ``harmony_*`` first argument in one module."""
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHODS
+                and node.args):
+            continue
+        mname = _str_const(node.args[0])
+        if mname is None or not mname.startswith("harmony_"):
+            continue
+        out.append((mname, node.func.attr, node.lineno))
+    return out
+
+
+def _doc_table_metrics(index: CodebaseIndex) -> Dict[str, int]:
+    """Instrument names in docs/OBSERVABILITY.md TABLE rows (lines
+    starting with ``|``) -> first line number. Prose name-drops give an
+    operator no source/meaning row and do not count — the same
+    table-row rule knob-consistency applies to the knob docs."""
+    out: Dict[str, int] = {}
+    for lno, line in enumerate(
+            index.doc_text(_METRIC_DOC).splitlines(), start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for name in _DOC_METRIC_RE.findall(line):
+            out.setdefault(name, lno)
+    return out
 
 
 class MetricConventionsPass(Pass):
     name = "metric-conventions"
     description = ("registry instrument names satisfy the exposition "
-                   "lint's conventions at the declaration site")
+                   "lint's conventions and match the OBSERVABILITY.md "
+                   "metric table (both directions)")
 
     def run(self, index: CodebaseIndex) -> List[Finding]:
         out: List[Finding] = []
+        registered: List[Tuple[str, str, str, int]] = []
         for sf in index.files:
             if sf.tree is None:
                 continue
+            for mname, _method, lineno in _registered_instruments(
+                    sf.tree, sf.rel):
+                registered.append((mname, _method, sf.rel, lineno))
             for node in ast.walk(sf.tree):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)
@@ -97,4 +154,59 @@ class MetricConventionsPass(Pass):
                         "or missing HELP string",
                         hint="one sentence: what the number means and "
                              "its unit"))
+
+        if index.partial:
+            # a file slice can neither prove a doc row is registered
+            # nowhere nor is its (often fixture) content part of the
+            # operator surface the table documents
+            return out
+        documented = _doc_table_metrics(index)
+        doc_rel = f"docs/{_METRIC_DOC}"
+        if not documented:
+            if registered:
+                # no metric table resolvable (docs/ absent — e.g. a
+                # site-packages install): one structural finding, not
+                # one per instrument
+                out.append(self.finding(
+                    doc_rel, 1,
+                    "no metric table found in docs/OBSERVABILITY.md "
+                    "(lines starting with '|' naming harmony_* families)",
+                    hint="run the lint from the repo root — the table "
+                         "is the metric glossary this pass checks "
+                         "against"))
+            return out
+        for mname, _method, rel, lineno in registered:
+            if mname not in documented:
+                out.append(self.finding(
+                    rel, lineno,
+                    f"instrument {mname} is registered here but appears "
+                    "in no docs/OBSERVABILITY.md metric-table row",
+                    hint="add a `metric | source` row — an undocumented "
+                         "instrument is a number operators cannot "
+                         "interpret"))
+        # the reverse direction needs the WIDER surface (tests and
+        # benchmarks legitimately register probe instruments), same as
+        # knob-consistency's read scan; an unparseable file degrades to
+        # a raw-text scan rather than marking its instruments missing
+        reg_names: Set[str] = {m for m, _k, _r, _l in registered}
+        scanned = {sf.rel for sf in index.files}
+        for rel, text in index.repo_py_texts().items():
+            if rel in scanned:
+                continue
+            try:
+                tree = ast.parse(text)
+            except (SyntaxError, ValueError):
+                reg_names.update(_DOC_METRIC_RE.findall(text))
+                continue
+            reg_names.update(
+                m for m, _k, _l in _registered_instruments(tree, rel))
+        for name, lno in sorted(documented.items()):
+            if name not in reg_names:
+                out.append(self.finding(
+                    doc_rel, lno,
+                    f"metric table documents {name} but nothing in the "
+                    "repo registers it",
+                    hint="a documented-but-unregistered metric is a "
+                         "dashboard query that silently returns "
+                         "nothing; fix the row or wire the instrument"))
         return out

@@ -525,9 +525,13 @@ class CheckpointManager:
         HDFS vs local fs config, ChkpManagerSlave.java:50-63)."""
         if backend is None:
             backend = os.environ.get("HARMONY_CHKP_BACKEND") or None
-        return cls(os.path.join(chkp_root, job_id, "temp"),
-                   os.path.join(chkp_root, job_id, "commit"),
-                   backend=backend)
+        mgr = cls(os.path.join(chkp_root, job_id, "temp"),
+                  os.path.join(chkp_root, job_id, "commit"),
+                  backend=backend)
+        # job attribution for the tenant cost ledger: a per-job manager
+        # charges its checkpoint byte traffic to its job
+        mgr.job_id = job_id
+        return mgr
 
     def __init__(self, temp_root: str, commit_root: str, backend=None) -> None:
         """``commit_root`` names the durable store: a directory (posix
@@ -542,11 +546,32 @@ class CheckpointManager:
         self._backend = make_commit_backend(commit_root, backend)
         self._lock = threading.Lock()
         self._counter = 0
+        #: set by for_job(): names the tenant this manager's checkpoint
+        #: byte traffic is charged to (metrics/accounting.py); None =
+        #: unattributed (table-binding fallback, or dropped)
+        self.job_id: Optional[str] = None
         #: elastic-shrink jobs set this: each full-ratio checkpoint also
         #: retains this process's staged host block copies in the
         #: process-wide recovery cache (see module doc), so a later
         #: partial restore reads only genuinely LOST blocks from storage
         self.recovery_retain = False
+
+    def _account_bytes(self, kind: str, nbytes: int, table_id: str) -> None:
+        """Tenant-ledger attribution (metrics/accounting.py): a per-job
+        manager (for_job) charges its job directly; others resolve
+        through the ledger's table binding. Guarded — accounting must
+        never fail (or slow) checkpoint I/O."""
+        if nbytes <= 0:
+            return
+        try:
+            from harmony_tpu.metrics.accounting import ledger
+
+            if self.job_id is not None:
+                ledger().record_job_bytes(self.job_id, kind, int(nbytes))
+            else:
+                ledger().record_table_bytes(table_id, kind, int(nbytes))
+        except Exception:
+            pass
 
     def advance_counter(self, base: int) -> None:
         """Start id counters past ``base`` — a RESUMED job's chain manager
@@ -607,6 +632,8 @@ class CheckpointManager:
             )
             policy = RetryPolicy.from_env()
 
+            staged_bytes = [0]
+
             def host_blocks():
                 # pop as we go: each device block is released right after
                 # its D2H transfer instead of pinning the snapshot until
@@ -622,10 +649,13 @@ class CheckpointManager:
                         arr = arr[:keep] if keep else arr
                     if retained is not None:
                         retained[bid] = arr
+                    staged_bytes[0] += int(arr.nbytes)
                     yield bid, arr
 
             info.block_checksums = _stage_blocks(staging, host_blocks(),
                                                  policy)
+            self._account_bytes("chkp_write", staged_bytes[0],
+                                info.table_config.table_id)
             if retained is not None:
                 _recovery_put(info.table_config.table_id, info.chkp_id,
                               retained)
@@ -1025,6 +1055,7 @@ class CheckpointManager:
             pipelined = (threads > 1 and not cfg.sparse
                          and info.sampling_ratio >= 1.0
                          and not mesh_spans_processes(handle.table.mesh))
+            read_bytes = 0
             raw: Dict[int, Any] = {}
             if pipelined:
                 # dense full-ratio: stream reads off the I/O pool and
@@ -1055,6 +1086,7 @@ class CheckpointManager:
                 arr = raw.pop(bid)
                 if pipelined:
                     arr = arr.result()
+                read_bytes += int(arr.nbytes)
                 if cfg.sparse:
                     blocks[bid] = _unpack_hash_block(arr, spec)
                     continue
@@ -1075,6 +1107,8 @@ class CheckpointManager:
                     handle.table.import_blocks(blocks)
                     blocks = {}
             handle.table.import_blocks(blocks)
+            self._account_bytes("chkp_read", read_bytes,
+                                info.table_config.table_id)
         except BaseException:
             handle.drop()  # no half-restored orphan tables
             raise
@@ -1116,6 +1150,10 @@ class CheckpointManager:
             if sp is not None:
                 for k, v in stats.items():
                     sp.annotate(k, v)
+            # bytes_read is -1 on the sparse/sampled full-restore
+            # fallback (unknown here; the inner restore accounted it)
+            self._account_bytes("chkp_read", stats.get("bytes_read", 0),
+                                handle.table_id)
             return handle, stats
 
     def _restore_partial_inner(

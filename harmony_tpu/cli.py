@@ -407,12 +407,15 @@ def main(argv: List[str] | None = None) -> int:
 
     p = sub.add_parser(
         "obs",
-        help="observability tooling: flight records, /metrics scrape, "
-             "trace timelines (docs/OBSERVABILITY.md)",
+        help="observability tooling: per-tenant cost top, flight "
+             "records, /metrics scrape, trace timelines "
+             "(docs/OBSERVABILITY.md)",
     )
-    p.add_argument("what", choices=("flight", "metrics", "trace"))
+    p.add_argument("what", choices=("top", "flight", "metrics", "trace"))
     p.add_argument("--port", type=int, default=43110,
-                   help="jobserver TCP port (flight: STATUS query)")
+                   help="jobserver TCP port (top/flight: STATUS query)")
+    p.add_argument("--json", action="store_true",
+                   help="top: raw ledger JSON instead of the table")
     p.add_argument("--url", default=None,
                    help="metrics: exporter/dashboard base URL "
                         "(e.g. http://host:9090); trace: dashboard URL")
@@ -616,6 +619,19 @@ def _cmd_obs(args: argparse.Namespace) -> int:
 def _cmd_obs_inner(args: argparse.Namespace) -> int:
     import urllib.request
 
+    if args.what == "top":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        status = CommandSender(args.port).send_status_command()
+        if not status.get("ok"):
+            print(json.dumps(status))
+            return 1
+        if getattr(args, "json", False):
+            print(json.dumps(status.get("tenants", {}), indent=2))
+            return 0
+        for line in _render_tenant_top(status.get("tenants", {})):
+            print(line)
+        return 0
     if args.what == "flight":
         from harmony_tpu.jobserver.client import CommandSender
 
@@ -672,6 +688,64 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
               f"{s['description']} [{row['duration_sec'] * 1000:.1f}ms] "
               f"({s.get('process_id') or '?'}) {ann}")
     return 0
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "-"  # pragma: no cover - loop always returns
+
+
+def _render_tenant_top(tenants: dict) -> "List[str]":
+    """One-screen per-tenant cost view from a single STATUS scrape
+    (docs/OBSERVABILITY.md "Tenant accounting" has the column glossary).
+    Unknown-vs-zero is load-bearing: a None (no cost model, no target,
+    no peers) renders as '-', never as 0 — bench.py's convention
+    reserves 0 for real zeros. Rows sort by windowed device seconds,
+    heaviest first (the 'top' semantic)."""
+    cols = ("TENANT", "ATTEMPT", "W", "DEV-S", "SPS", "MFU", "HBM",
+            "HBM%", "INWAIT%", "SLO", "STRAG")
+    rows = [cols]
+
+    def pct(v):
+        return f"{100.0 * v:.1f}" if v is not None else "-"
+
+    for r in sorted(tenants.values(),
+                    key=lambda r: -(r.get("device_seconds") or 0.0)):
+        slo = r.get("slo") or {}
+        att = slo.get("attainment")
+        slo_cell = "-" if att is None else (
+            f"{att:.2f}" + ("!" if slo.get("events") else ""))
+        mfu = r.get("mfu")
+        strag = r.get("straggler_ratio")
+        rows.append((
+            str(r.get("job", "?")),
+            str(r.get("attempt", "")),
+            str(r.get("workers", 0)),
+            f"{r.get('device_seconds') or 0.0:.2f}",
+            ("-" if r.get("samples_per_sec") is None
+             else f"{r['samples_per_sec']:,.0f}"),
+            "-" if mfu is None else f"{100.0 * mfu:.2f}%",
+            _fmt_bytes(r.get("resident_bytes")),
+            pct(r.get("hbm_share")),
+            pct(r.get("input_wait_frac")),
+            slo_cell,
+            "-" if strag is None else f"{strag:.2f}",
+        ))
+    widths = [max(len(row[i]) for row in rows) for i in range(len(cols))]
+    out = []
+    for i, row in enumerate(rows):
+        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            out.append("  ".join("-" * w for w in widths))
+    if len(rows) == 1:
+        out.append("(no tenant activity recorded)")
+    return out
 
 
 def _cmd_start_jobserver(args: argparse.Namespace) -> int:

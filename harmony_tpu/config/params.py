@@ -195,6 +195,15 @@ class TrainerParams(ConfigBase):
     # background thread's device_puts would break the deterministic
     # pod-wide dispatch order.
     input_prefetch: bool = True
+    # Per-job throughput SLO (metrics/accounting.py): the samples/sec
+    # this job is expected to sustain. 0 = no target. When a worker
+    # sustains < 90% of the target across a window of epochs it records
+    # a structured joblog event (kind="slo") and the tenant ledger's
+    # attainment gauge (harmony_tenant_slo_attainment) carries the
+    # achieved/target ratio — the signal the ROADMAP-item-4 policy loop
+    # scales on. The process-wide HARMONY_SLO_SPS env knob overrides
+    # for every job (operator floor enforcement).
+    target_samples_per_sec: float = 0.0
     # Fused device hot path (dolphin/worker.py): compile each batch's
     # PULL -> COMP -> PUSH into ONE jitted program with the table buffer
     # donated (the dense SPMD fast path's contract). Default ON; OFF

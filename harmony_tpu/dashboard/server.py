@@ -213,6 +213,21 @@ class DashboardServer:
             })
         return out
 
+    def tenants(self) -> List[Dict[str, Any]]:
+        """Newest tenant cost vector per job (the jobserver POSTs ledger
+        rows as kind='tenant' at epoch cadence) — the dashboard face of
+        ``harmony-tpu obs top``. Rows sort heaviest-first by windowed
+        device seconds."""
+        q = """
+            SELECT m.payload FROM metrics m
+            JOIN (SELECT MAX(id) mid FROM metrics WHERE kind = 'tenant'
+                  GROUP BY job_id
+                 ) c ON m.id = c.mid
+        """
+        rows = [json.loads(r[0]) for r in self._read_rows(q)]
+        rows.sort(key=lambda r: -(r.get("device_seconds") or 0.0))
+        return rows
+
     def jobs(self) -> List[Dict[str, Any]]:
         # One aggregate query; last_loss = the newest report whose payload
         # has a top-level "loss" key (json_extract, not substring match —
@@ -435,9 +450,50 @@ class DashboardServer:
                     )
                 elif parsed.path == "/api/jobs":
                     self._json(200, server.jobs())
+                elif parsed.path == "/api/tenants":
+                    self._json(200, server.tenants())
                 elif parsed.path == "/":
                     import html as _h
                     from urllib.parse import quote as _q
+
+                    def cell(v, fmt="{}"):
+                        # None is "unknown", rendered as a dash — never
+                        # as a zero (the ledger's explicit-None contract)
+                        return "-" if v is None else fmt.format(v)
+
+                    tenant_rows = "".join(
+                        f"<tr><td>{_h.escape(str(t.get('job', '?')))}</td>"
+                        f"<td>{_h.escape(str(t.get('attempt', '')))}</td>"
+                        f"<td>{cell(t.get('device_seconds'), '{:.2f}')}</td>"
+                        f"<td>{cell(t.get('samples_per_sec'), '{:,.0f}')}</td>"
+                        + "<td>"
+                        + ("-" if t.get("mfu") is None
+                           else f"{100.0 * t['mfu']:.2f}%")
+                        + "</td>"
+                        f"<td>{cell(t.get('resident_bytes'))}</td>"
+                        + "<td>"
+                        + ("-" if t.get("hbm_share") is None
+                           else f"{100.0 * t['hbm_share']:.1f}%")
+                        + "</td>"
+                        + "<td>"
+                        + ("-" if t.get("input_wait_frac") is None
+                           else f"{100.0 * t['input_wait_frac']:.1f}%")
+                        + "</td>"
+                        + "<td>"
+                        + ("-" if (t.get("slo") or {}).get(
+                            "attainment") is None
+                           else f"{t['slo']['attainment']:.2f}"
+                           + ("!" if t["slo"].get("events") else ""))
+                        + "</td></tr>"
+                        for t in server.tenants()
+                    )
+                    tenants_html = (
+                        "<h2>tenants</h2><table border=1>"
+                        "<tr><th>job</th><th>attempt</th><th>dev-s</th>"
+                        "<th>sps</th><th>MFU</th><th>HBM bytes</th>"
+                        "<th>HBM%</th><th>in-wait%</th><th>SLO</th></tr>"
+                        f"{tenant_rows}</table>"
+                    ) if tenant_rows else ""
 
                     rows = "".join(
                         f"<tr><td>{_h.escape(str(j['job_id']))}</td>"
@@ -459,7 +515,7 @@ class DashboardServer:
                         "<table border=1><tr><th>job</th><th>reports</th>"
                         f"<th>last loss</th><th>recoveries</th>"
                         f"<th>trace</th></tr>{rows}"
-                        "</table></body></html>"
+                        f"</table>{tenants_html}</body></html>"
                     ).encode()
                     self._html(body)
                 else:

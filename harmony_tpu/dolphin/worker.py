@@ -55,6 +55,7 @@ from harmony_tpu.parallel.dispatch import dispatch_scope
 from harmony_tpu.parallel.mesh import DATA_AXIS
 from harmony_tpu.runtime import progcache
 from harmony_tpu.tracing import SpanContext, trace_span
+from harmony_tpu.tracing.profiler import maybe_profile_epoch
 from harmony_tpu.utils.platform import hard_sync
 
 
@@ -372,6 +373,26 @@ class WorkerTasklet:
         # This worker's own op counters (single-threaded; per-job metric
         # attribution sums these across the job's workers).
         self.op_stats: Dict[str, int] = {"pulls": 0, "pushes": 0, "pull_bytes": 0}
+        # Per-job throughput SLO (metrics/accounting.py): the env knob
+        # overrides the per-job param for every tenant (operator floor).
+        # Breach detection is chief-only and windowed: SLO_WINDOW_EPOCHS
+        # consecutive epochs under 90% of target fire ONE structured
+        # joblog event (kind="slo"); recovery re-arms it.
+        from harmony_tpu.metrics import accounting as _acct
+
+        target = _acct.slo_target_from_env()
+        if target is None:
+            p = float(getattr(ctx.params, "target_samples_per_sec", 0.0)
+                      or 0.0)
+            target = p if p > 0 else None
+        self._slo_target: Optional[float] = target
+        self._slo_below = 0
+        self._slo_fired = False
+        # FLOPs of one step of the CURRENT compiled program (progcache
+        # cost table); resolved lazily after the first compile, reset on
+        # rebuild. None = backend exposes no cost model (ledger keeps
+        # the None — 0.0 is reserved for real zeros).
+        self._flops_per_step: Optional[float] = None
 
     # -- step construction ----------------------------------------------
 
@@ -954,6 +975,27 @@ class WorkerTasklet:
             # hit again under the old key.
             progcache.drop(lambda k: k[0] == prev_key)
         self._built_once = True
+        # tenant cost accounting: (re)bind this job's tables for byte
+        # attribution, refresh the resident-table gauge, and invalidate
+        # the cached per-step FLOP figure (the new build may trace a
+        # different program). Guarded: accounting never fails a build.
+        self._flops_per_step = None
+        try:
+            from harmony_tpu.metrics.accounting import ledger
+
+            acct = ledger()
+            acct.bind_table(table.spec.table_id, self.job_id,
+                            self.attempt_key)
+            if self.trainer.uses_local_table:
+                acct.bind_table(self.ctx.local_table.spec.table_id,
+                                self.job_id, self.attempt_key)
+            acct.set_resident(self.job_id, self.attempt_key, "table",
+                              self._table_resident_bytes())
+            if self._slo_target is not None:
+                acct.set_slo_target(self.job_id, self.attempt_key,
+                                    self._slo_target)
+        except Exception:
+            pass
 
     def _build_comm_probe(self) -> None:
         """Standalone PULL and PULL+PUSH(zero-delta) programs mirroring the
@@ -1368,6 +1410,13 @@ class WorkerTasklet:
                 consumer_stall_sec=s["consumer_stall_sec"],
             )
         )
+        try:  # tenant ledger: input-wait seconds feed the wait fraction
+            from harmony_tpu.metrics.accounting import ledger
+
+            ledger().record_input_wait(self.job_id, self.attempt_key,
+                                       s["consumer_stall_sec"])
+        except Exception:
+            pass
 
     def _on_layout_announcement(self, new_mesh: Mesh) -> None:
         """Reshard announcement listener: staged input batches target the
@@ -1620,7 +1669,13 @@ class WorkerTasklet:
                 # with trainer hooks run between them (declared windowable
                 # = epoch-indexed only), ONE drain at the end, then the
                 # per-epoch host bookkeeping replays in order.
-                with trace_span(
+                # sampled continuous device capture (chief-only: the
+                # profiler is process-wide; N workers double-starting
+                # would fight over one session)
+                with maybe_profile_epoch(
+                    epoch, self.job_id, span=window,
+                    enabled=self.global_init,
+                ), trace_span(
                     "dolphin.epoch_window",
                     job_id=self.job_id,
                     worker_id=self.ctx.worker_id,
@@ -1660,7 +1715,9 @@ class WorkerTasklet:
                 epoch += window
                 continue
             epoch_t0 = time.perf_counter()
-            with trace_span(
+            with maybe_profile_epoch(
+                epoch, self.job_id, enabled=self.global_init,
+            ), trace_span(
                 "dolphin.epoch",
                 job_id=self.job_id,
                 worker_id=self.ctx.worker_id,
@@ -2090,7 +2147,114 @@ class WorkerTasklet:
         if hist is not None:
             for _ in batch_sizes:
                 hist.observe(per_batch_time)
+        # tenant cost ledger (metrics/accounting.py): one feed per epoch
+        # drain — device seconds, steps, examples, the compiled step's
+        # FLOP figure, and the current resident-HBM components. Guarded:
+        # accounting must never fail (or slow) the drain.
+        try:
+            from harmony_tpu.metrics.accounting import ledger
+
+            steps = len(batch_sizes)
+            acct = ledger()
+            acct.observe_steps(
+                self.job_id, self.attempt_key, self.ctx.worker_id,
+                steps=steps, device_sec=per_batch_time * steps,
+                examples=int(sum(batch_sizes)),
+                flops_per_step=self._program_flops_per_step(),
+                devices=int(self.mesh.devices.size),
+            )
+            acct.set_resident(self.job_id, self.attempt_key, "input",
+                              self._input_resident_bytes())
+            acct.set_resident(self.job_id, self.attempt_key, "program",
+                              self._program_resident_bytes())
+        except Exception:
+            pass
         return {k: float(v[-1]) for k, v in host.items()}
+
+    # -- tenant cost accounting helpers ----------------------------------
+
+    def _program_flops_per_step(self) -> Optional[float]:
+        """XLA cost-analysis FLOPs of ONE step of the current program
+        (runtime/progcache's compile telemetry), resolved lazily — the
+        cost row exists only after the first dispatch compiled. The
+        fused-epoch program's figure covers the whole scan, so it is
+        divided back down to per-step. None (never 0.0) when the
+        backend exposes no cost model or the trainer opted out of
+        caching."""
+        if self._flops_per_step is not None:
+            return self._flops_per_step
+        key = self._program_cache_key
+        if key is None:
+            return None
+        if not self._fused_mode():
+            total = 0.0
+            for tag in ("unfused_pull", "unfused_comp", "unfused_push"):
+                cost = progcache.program_cost((key, tag))
+                if cost is None or cost.flops is None:
+                    return None
+                total += cost.flops
+            self._flops_per_step = total
+        elif self._use_fused_epoch():
+            cost = progcache.program_cost((key, "epoch"))
+            if cost is None or cost.flops is None:
+                return None
+            self._flops_per_step = cost.flops / max(
+                self.data.num_mini_batches, 1)
+        else:
+            cost = progcache.program_cost((key, "step"))
+            if cost is None or cost.flops is None:
+                return None
+            self._flops_per_step = cost.flops
+        return self._flops_per_step
+
+    def _table_resident_bytes(self) -> int:
+        """Device bytes pinned by this job's table storage (dense
+        array, or hash keys+values) — the dominant HBM term for table
+        workloads."""
+        def one(table) -> int:
+            if table is None:
+                return 0
+            spec = table.spec
+            itemsize = np.dtype(spec.dtype).itemsize
+            kshape = getattr(spec, "keys_shape", None)
+            if kshape is not None:  # hash table: int32 keys + values
+                return (int(np.prod(kshape)) * 4
+                        + int(np.prod(spec.values_shape)) * itemsize)
+            return int(np.prod(spec.storage_shape)) * itemsize
+
+        total = one(self.ctx.model_table)
+        if self.trainer.uses_local_table:
+            total += one(self.ctx.local_table)
+        return total
+
+    def _input_resident_bytes(self) -> int:
+        """Device bytes of this worker's resident input copies (its
+        stacked-epoch upload + per-batch caches — the worker's share of
+        devcache occupancy)."""
+        total = 0
+        if self._stacked_cache is not None:
+            total += sum(int(getattr(a, "nbytes", 0))
+                         for a in self._stacked_cache)
+        for b in self._batch_cache.values():
+            leaves = b if isinstance(b, (tuple, list)) else (b,)
+            total += sum(int(getattr(a, "nbytes", 0)) for a in leaves)
+        return total
+
+    def _program_resident_bytes(self) -> int:
+        """Temp + generated-code bytes of this job's compiled programs
+        (memory_analysis via progcache) — the constants/workspace HBM a
+        compiled executable pins beyond its arguments."""
+        key = self._program_cache_key
+        if key is None:
+            return 0
+        total = 0
+        for tag in ("step", "epoch", "eval",
+                    "unfused_pull", "unfused_comp", "unfused_push"):
+            cost = progcache.program_cost((key, tag))
+            if cost is not None:
+                total += ((cost.temp_bytes or 0)
+                          + (cost.generated_code_bytes or 0))
+        return total
 
     def _step_histogram(self):
         """Cached child of harmony_step_time_seconds for this worker's
@@ -2266,6 +2430,7 @@ class WorkerTasklet:
                 epoch_sec)
         except Exception:
             pass
+        self._check_slo(epoch, epoch_examples, epoch_sec)
         epoch_losses.append(progress)
         if call_trainer_hook:
             self.trainer.on_epoch_finished(self.ctx, epoch)
@@ -2280,6 +2445,51 @@ class WorkerTasklet:
             with self._turn():
                 pass
         self.collector.flush()
+
+    #: consecutive under-target epochs before the SLO event fires — one
+    #: slow epoch (a reshard, a checkpoint, a co-tenant's burst) is
+    #: noise; a sustained run is the scheduler-actionable signal
+    SLO_WINDOW_EPOCHS = 3
+    #: attainment floor: below this fraction of target counts as a breach
+    SLO_ATTAINMENT_FLOOR = 0.9
+
+    def _check_slo(self, epoch: int, epoch_examples: int,
+                   epoch_sec: float) -> None:
+        """Windowed SLO attainment check at the epoch boundary (chief
+        only — the target is per JOB, so sibling workers checking their
+        own shares would multiply-fire). The job-level rate is estimated
+        as this worker's rate × num_workers (the data provider splits
+        the epoch evenly); exact for single-worker jobs. A sustained
+        breach records ONE structured joblog event (kind="slo") and
+        counts in the tenant ledger; recovery above the floor re-arms."""
+        if self._slo_target is None or not self.global_init:
+            return
+        own_sps = epoch_examples / epoch_sec if epoch_sec > 0 else 0.0
+        job_sps = own_sps * max(self.ctx.num_workers, 1)
+        if job_sps >= self.SLO_ATTAINMENT_FLOOR * self._slo_target:
+            self._slo_below = 0
+            self._slo_fired = False
+            return
+        self._slo_below += 1
+        if self._slo_below < self.SLO_WINDOW_EPOCHS or self._slo_fired:
+            return
+        self._slo_fired = True
+        try:
+            from harmony_tpu.jobserver import joblog
+            from harmony_tpu.metrics.accounting import ledger
+
+            joblog.record_event(
+                self.job_id, kind="slo",
+                attempt=self.attempt_key,
+                epoch=epoch,
+                target_sps=self._slo_target,
+                achieved_sps=round(job_sps, 3),
+                attainment=round(job_sps / self._slo_target, 4),
+                window_epochs=self.SLO_WINDOW_EPOCHS,
+            )
+            ledger().record_slo_event(self.job_id)
+        except Exception:
+            pass  # SLO observability never fails the epoch boundary
 
     def _account_ops(self, num_steps: int) -> None:
         """Fold this dispatch window's pull/push counts (one pull + one push

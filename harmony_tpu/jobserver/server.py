@@ -129,6 +129,33 @@ class JobServer:
         self.metrics.on_metric(record)
         if self._dashboard is not None:
             self._dashboard.metric_sink(record)
+            self._maybe_post_tenants(record)
+
+    #: minimum seconds between tenant-ledger posts to the dashboard —
+    #: epoch reports can land at hundreds/sec across tenants, and the
+    #: ledger snapshot is a (cheap but nonzero) whole-store walk
+    _TENANT_POST_PERIOD = 2.0
+    _last_tenant_post = 0.0
+
+    def _maybe_post_tenants(self, record) -> None:
+        """Rate-limited tee of the per-tenant cost vectors to the
+        dashboard (kind="tenant", one row per job): epoch boundaries are
+        the natural cadence — that is when the ledger's numbers move."""
+        import time as _time
+
+        from harmony_tpu.metrics.collector import EpochMetrics
+
+        if not isinstance(record, EpochMetrics):
+            return
+        now = _time.monotonic()
+        if now - self._last_tenant_post < self._TENANT_POST_PERIOD:
+            return
+        self._last_tenant_post = now
+        try:
+            for jid, row in self.metrics.tenant_ledger().items():
+                self._dashboard.post(jid, "tenant", row)
+        except Exception:
+            pass  # dashboard posts are best-effort by contract
 
     # -- lifecycle -------------------------------------------------------
 
@@ -417,6 +444,10 @@ class JobServer:
             # step-time records, this process's flight-recorder dumps
             # (path + correlated trace ids), and where /metrics lives
             "stragglers": self.metrics.straggler_report(),
+            # per-tenant device cost accounting (metrics/accounting.py):
+            # MFU, device-seconds, resident HBM, input-wait, SLO
+            # attainment per job@attempt — what `obs top` renders
+            "tenants": self.metrics.tenant_ledger(),
             "flight_records": flight.get_recorder().records(),
             "metrics_port": (self.metrics_exporter.port
                              if self.metrics_exporter is not None else None),

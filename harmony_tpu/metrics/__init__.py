@@ -1,4 +1,5 @@
 from harmony_tpu.metrics.tracer import Tracer
+from harmony_tpu.metrics.accounting import LedgerStore, ledger
 from harmony_tpu.metrics.collector import (
     BatchMetrics,
     EpochMetrics,
@@ -18,6 +19,8 @@ from harmony_tpu.metrics.registry import (
 
 __all__ = [
     "Tracer",
+    "LedgerStore",
+    "ledger",
     "Counter",
     "Gauge",
     "Histogram",

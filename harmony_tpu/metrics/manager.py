@@ -158,6 +158,25 @@ class MetricManager:
             }
         return out
 
+    def tenant_ledger(
+        self, window_sec: Optional[float] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant device cost vectors (metrics/accounting.py) joined
+        with this manager's straggler attribution — the one-call answer
+        to "what does each tenant cost, and is it healthy". Rides the
+        STATUS payload (``tenants``), flight-recorder dumps, and
+        ``harmony-tpu obs top``; the ROADMAP-item-4 policy engine reads
+        the same join. Keys are job ids; see docs/OBSERVABILITY.md
+        "Tenant accounting" for the field glossary."""
+        from harmony_tpu.metrics.accounting import ledger
+
+        rows = ledger().snapshot(window_sec)
+        stragglers = self.straggler_report()
+        for jid, row in rows.items():
+            rep = stragglers.get(jid)
+            row["straggler_ratio"] = rep["ratio"] if rep else None
+        return rows
+
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star
         metric: reference BatchMetrics.dataProcessingRate summed)."""

@@ -226,7 +226,25 @@ class TableHandle:
             announce(self._mesh_for(target_owners))
 
     def _reshard_to_owners(self) -> None:
+        from harmony_tpu.table import blockmove
+
+        seq_before = blockmove.last_move_stats.get("seq")
         self.table.reshard(self._mesh_for(self.owning_executors()))
+        stats = blockmove.last_move_stats
+        if stats.get("seq") != seq_before:
+            # a cross-process block migration ran for THIS reshard:
+            # charge its wire bytes to the owning tenant's cost ledger
+            # (same-device-set reshards move bytes inside XLA and are
+            # already visible as device time)
+            try:
+                from harmony_tpu.metrics.accounting import ledger
+
+                ledger().record_table_bytes(
+                    self.table_id, "move",
+                    int(stats.get("bytes_sent", 0))
+                    + int(stats.get("bytes_received", 0)))
+            except Exception:
+                pass  # accounting never fails a migration
 
 
 class ETMaster:

@@ -31,9 +31,12 @@ the bound is deliberately small.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
-from typing import Any, Callable, Hashable, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
+import jax
 from jax.sharding import Mesh
 
 _MAX_ENTRIES = 32
@@ -92,6 +95,219 @@ def table_signature(table: Any, sharding=None) -> Optional[Tuple]:
 _inflight: dict = {}
 
 
+# -- compile telemetry ------------------------------------------------------
+#
+# Every cached-eligible build is wrapped in an _InstrumentedProgram: the
+# FIRST call AOT-lowers and compiles (jit's own laziness would hide the
+# compile inside an arbitrary later dispatch), the wall time of that
+# compile is observed into harmony_compile_seconds{program}, and the
+# executable's XLA cost_analysis()/memory_analysis() land in a bounded
+# per-program cost table keyed by the structural program key — the
+# FLOP/byte denominators the tenant ledger (metrics/accounting.py) turns
+# into per-job MFU. Backends that expose neither analysis (or reject AOT
+# entirely) walk the SAME code path and record explicit Nones: the CPU
+# tier-1 run and a TPU pod differ only in which fields are filled.
+
+_COST_MAX_ENTRIES = 128
+_costs: "OrderedDict[Hashable, ProgramCost]" = OrderedDict()
+
+
+@dataclass
+class ProgramCost:
+    """One compiled program's measured build cost. ``flops`` is the XLA
+    cost-analysis model count for ONE invocation of the program (a fused
+    epoch program's figure covers every step it scans over — callers
+    divide by their step count); None = the backend exposed no analysis,
+    which consumers must keep distinct from a measured 0.0."""
+
+    tag: str                       # "step" / "epoch" / "table_init" / ...
+    compile_seconds: float
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    created_at: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tag": self.tag,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+        }
+
+
+def _key_tag(key: Hashable) -> str:
+    """Human tag of a structural key: the step-kind string the call sites
+    append — ("...", "step") / ("...", "epoch") / (sig, "table_init") /
+    (tsig, "fused_sparse", ...). Bounded vocabulary by construction, so
+    it is safe as a metric label."""
+    if isinstance(key, tuple) and len(key) >= 2 and isinstance(key[1], str):
+        return key[1]
+    return "program"
+
+
+def _extract_cost(tag: str, seconds: float, compiled) -> "ProgramCost":
+    """Pull flops/bytes out of a jax.stages.Compiled, tolerating every
+    backend shape: list-of-dicts, dict, None, or a raising method."""
+    cost = ProgramCost(tag=tag, compile_seconds=seconds)
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict) and ca:
+            flops = ca.get("flops")
+            cost.flops = float(flops) if flops is not None else None
+            ba = ca.get("bytes accessed")
+            cost.bytes_accessed = float(ba) if ba is not None else None
+    except Exception:
+        pass  # no cost model on this backend: explicit Nones
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            cost.argument_bytes = int(
+                getattr(ma, "argument_size_in_bytes", 0))
+            cost.output_bytes = int(getattr(ma, "output_size_in_bytes", 0))
+            cost.temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0))
+            cost.generated_code_bytes = int(
+                getattr(ma, "generated_code_size_in_bytes", 0))
+    except Exception:
+        pass
+    return cost
+
+
+def _record_cost(key: Hashable, cost: "ProgramCost") -> None:
+    with _lock:
+        _costs[key] = cost
+        _costs.move_to_end(key)
+        while len(_costs) > _COST_MAX_ENTRIES:
+            _costs.popitem(last=False)
+    try:  # scrapeable compile wall time; the registry must never fail a build
+        from harmony_tpu.metrics.registry import get_registry
+
+        get_registry().histogram(
+            "harmony_compile_seconds",
+            "Wall seconds to build one cached program (trace + XLA compile)",
+            ("program",),
+        ).labels(program=cost.tag).observe(cost.compile_seconds)
+    except Exception:
+        pass
+
+
+def program_cost(key: Hashable) -> Optional["ProgramCost"]:
+    """The recorded build cost of ``key``'s program, or None when it has
+    not compiled (or was evicted). Read-only; the ledger's FLOP source."""
+    with _lock:
+        return _costs.get(key)
+
+
+def program_costs() -> List[Dict[str, Any]]:
+    """Cost-table snapshot (newest last) for STATUS / obs tooling. Keys
+    are structural tuples, unreadable raw — rows carry the tag + a short
+    stable digest so operators can join rows across scrapes."""
+    with _lock:
+        items = list(_costs.items())
+    out = []
+    for key, cost in items:
+        row = cost.to_dict()
+        row["key_digest"] = f"{abs(hash(key)) & 0xFFFFFFFF:08x}"
+        out.append(row)
+    return out
+
+
+class _InstrumentedProgram:
+    """Callable wrapper adding compile telemetry to one cached program.
+
+    First call: AOT ``lower(*args).compile()`` — the compile wall time is
+    measured EXPLICITLY instead of hiding inside jit's lazy first
+    dispatch — then the call executes through the compiled object.
+    Steady state: calls dispatch straight through the compiled
+    executable — no per-call argument inspection; a Python-level guard
+    measured ~22us/call, swamping the ~2us the executable's dispatch
+    costs over jit's C++ fast path, in the per-batch hot loop this
+    wrapper sits on. The executable itself validates shapes/dtypes/
+    PLACEMENTS at dispatch time, BEFORE executing (and therefore before
+    donating), raising TypeError/ValueError; catching exactly those
+    flips the wrapper PERMANENTLY onto the plain jit path, which
+    recompiles per new signature — the uninstrumented behavior. (Args
+    that are genuinely broken — e.g. an already-donated buffer — fail
+    the jit path with the same error, so error parity holds.) Builders
+    that return a non-stage callable (no ``.lower``) or a backend that
+    rejects AOT get first-call wall-time-only telemetry the same way.
+
+    The wrapper object itself is what the cache stores, so the identity
+    contract (equal keys -> the same callable) is preserved."""
+
+    __slots__ = ("_key", "_tag", "_fn", "_compiled", "_lock",
+                 "_fallback", "_time_plain")
+
+    def __init__(self, key: Hashable, fn: Callable) -> None:
+        self._key = key
+        self._tag = _key_tag(key)
+        self._fn = fn
+        self._compiled = None
+        self._lock = threading.Lock()
+        self._fallback = False   # True = permanently on the plain jit path
+        self._time_plain = False  # one timed jit first-dispatch still owed
+
+    def _instrument_first_call(self, args, kwargs) -> None:
+        """One thread AOT-compiles and records; concurrent callers wait
+        (same once-per-program semantics jit's own cache gives). A
+        builder without ``.lower`` (plain callable) or a backend that
+        rejects AOT degrades to timing the first jit dispatch —
+        trace+compile+run, the best available compile-time proxy — with
+        analyses left as explicit Nones."""
+        with self._lock:
+            if self._compiled is not None or self._fallback:
+                return
+            lower = getattr(self._fn, "lower", None)
+            if lower is not None:
+                try:
+                    t0 = time.perf_counter()
+                    compiled = lower(*args, **kwargs).compile()
+                    seconds = time.perf_counter() - t0
+                    _record_cost(self._key,
+                                 _extract_cost(self._tag, seconds, compiled))
+                    self._compiled = compiled
+                    return
+                except Exception:
+                    pass
+            self._fallback = True
+            self._time_plain = True
+
+    def __call__(self, *args, **kwargs):
+        if not self._fallback:
+            if self._compiled is None:
+                self._instrument_first_call(args, kwargs)
+            if self._compiled is not None:
+                try:
+                    return self._compiled(*args, **kwargs)
+                except (TypeError, ValueError):
+                    # dispatch-time validation (raised BEFORE execution,
+                    # so nothing was donated): shapes/dtypes/placements
+                    # the lowering did not see. Should not happen — the
+                    # structural key pins them — but a caller-supplied
+                    # signature could lie: permanent fallback to the jit
+                    # path, which recompiles per signature exactly as
+                    # the uninstrumented wrapper would (and re-raises
+                    # identically if the args are genuinely broken)
+                    self._fallback = True
+        if self._time_plain:
+            self._time_plain = False
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            _record_cost(self._key, ProgramCost(
+                tag=self._tag, compile_seconds=time.perf_counter() - t0))
+            return out
+        return self._fn(*args, **kwargs)
+
+
 def _record_event(result: str) -> None:
     """Scrapeable hit/miss counter beside the in-process _stats dict
     (metrics/registry.py): recompiles of cached-eligible programs —
@@ -140,7 +356,10 @@ def get_or_build(key: Optional[Hashable], build: Callable[[], Callable]) -> Call
         # failure the entry is absent and THIS thread takes over the build.
     try:
         # Build OUTSIDE the lock: tracing can be slow and may itself dispatch.
-        fn = build()
+        # Cached-eligible programs are wrapped for compile telemetry: the
+        # wrapper IS the cached object, so the identity contract (equal
+        # keys -> the same callable) and every existing call shape hold.
+        fn = _InstrumentedProgram(key, build())
         with _lock:
             _stats["misses"] += 1
             _cache[key] = fn
@@ -165,6 +384,10 @@ def drop(predicate) -> int:
         stale = [k for k in _cache if predicate(k)]
         for k in stale:
             del _cache[k]
+        # matching cost rows go with their executables: program_costs()
+        # must not keep reporting programs the reshard path discarded
+        for k in [k for k in _costs if predicate(k)]:
+            del _costs[k]
         return len(stale)
 
 
@@ -176,4 +399,5 @@ def stats() -> dict:
 def clear() -> None:
     with _lock:
         _cache.clear()
+        _costs.clear()
         _stats.update(hits=0, misses=0)

@@ -52,6 +52,19 @@ def _default_cap() -> int:
         return 256
 
 
+def _tenant_snapshot() -> Dict[str, Any]:
+    """Tenant ledger snapshot for a dump, or {} — a dying process must
+    never die HARDER because accounting could not be read (and the
+    tracing package must not hard-depend on metrics)."""
+    try:
+        from harmony_tpu.metrics.accounting import peek_ledger
+
+        store = peek_ledger()
+        return store.snapshot() if store is not None else {}
+    except Exception:
+        return {}
+
+
 def _attempt_key(ctx: Dict[str, Any]) -> Optional[str]:
     """The ``job@aN`` attempt key a trigger context names, if any (same
     scheme as jobserver/elastic.attempt_key, inlined so the tracing
@@ -117,6 +130,11 @@ class FlightRecorder(SpanReceiver):
             "process_id": get_tracing().process_id,
             "meta": meta,
             "trace_ids": trace_ids,
+            # who was costing what when this process died: the tenant
+            # cost vectors (metrics/accounting.py) snapshotted INTO the
+            # black box, so a post-mortem can tell a starved tenant from
+            # a runaway one without a live scrape
+            "tenants": _tenant_snapshot(),
             "records": records,
         }
         path = os.path.join(

@@ -1,0 +1,9 @@
+"""KNOWN-BAD fixture tree: the histogram registered below appears in
+no docs/OBSERVABILITY.md metric-table row, and the doc table documents
+a gauge nothing in this tree registers. The metric-conventions pass's
+doc-parity directions must flag both."""
+
+
+def register(reg):
+    reg.histogram("harmony_widget_seconds", "per-widget wall time",
+                  ("job",))  # BAD: not in the doc's metric table

@@ -1,0 +1,8 @@
+"""FIXED fixture tree: every registered instrument has its
+docs/OBSERVABILITY.md metric-table row and every documented name is
+registered. The metric-conventions pass must come up clean."""
+
+
+def register(reg):
+    reg.histogram("harmony_widget_seconds", "per-widget wall time",
+                  ("job",))

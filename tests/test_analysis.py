@@ -130,6 +130,32 @@ class TestPassFixtures:
         assert "_total" in msgs and "base-unit" in msgs \
             and "empty or missing HELP" in msgs, render_text(r)
 
+    def test_metric_conventions_flags_doc_drift_both_directions(self):
+        """The doc-parity directions (mirroring knob-consistency): a
+        registered-but-undocumented instrument anchors at its call
+        site; a documented-but-unregistered name anchors at its doc
+        table row."""
+        r = _lint_tree("metric_doc_bad", "metric-conventions")
+        msgs = [f.message for f in r.findings]
+        assert any("harmony_widget_seconds" in m
+                   and "no docs/OBSERVABILITY.md metric-table row" in m
+                   for m in msgs), msgs
+        assert any("harmony_ghost_gauge" in m
+                   and "nothing in the repo registers it" in m
+                   for m in msgs), msgs
+        doc = [f for f in r.findings if f.file.startswith("docs/")]
+        assert doc and doc[0].line > 1
+
+    def test_metric_conventions_accepts_documented_tree(self):
+        r = _lint_tree("metric_doc_fixed", "metric-conventions")
+        assert r.ok, render_text(r)
+
+    def test_metric_conventions_doc_directions_skip_partial_runs(self):
+        """File slices (the fixture corpus lints file-by-file) must not
+        be compared against the real repo's metric table."""
+        r = _lint_file("metric_conventions_fixed.py", "metric-conventions")
+        assert r.ok, render_text(r)
+
     def test_metric_conventions_accepts_contractual_names(self):
         r = _lint_file("metric_conventions_fixed.py", "metric-conventions")
         assert r.ok, render_text(r)

@@ -1,0 +1,95 @@
+"""Machine-checked perf history: bench.py --compare diffs the newest
+two committed BENCH_r*.json rounds and fails on a >15% regression in
+the named headline series — with the unreachable-accelerator 0.0
+convention honored (0.0-with-error is 'did not run', never a measured
+zero). The committed-rounds test IS the tier-1 gate: a round that
+regresses the headline series now fails CI instead of waiting for a
+human to read two JSON blobs."""
+import json
+
+import pytest
+
+import bench
+
+
+def _write_round(tmp_path, n, line):
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps({"n": n, "parsed": line}))
+    return str(p)
+
+
+class TestBenchCompare:
+    def test_committed_rounds_stay_within_threshold(self):
+        """The tier-1 smoke over the repo's real perf history."""
+        rounds = bench.find_bench_rounds()
+        assert len(rounds) >= 2, "perf history needs >= 2 committed rounds"
+        report = bench.compare_bench(rounds[-2], rounds[-1])
+        assert report["ok"], report
+
+    def test_regression_detected(self, tmp_path):
+        old = _write_round(tmp_path, 1, {"value": 1000.0, "cpu_rate": 500.0})
+        new = _write_round(tmp_path, 2, {"value": 800.0, "cpu_rate": 510.0})
+        report = bench.compare_bench(old, new)
+        assert not report["ok"]
+        assert report["regressions"] == ["value"]
+        assert report["series"]["cpu_rate"]["status"] == "ok"
+
+    def test_drop_within_threshold_passes(self, tmp_path):
+        old = _write_round(tmp_path, 1, {"value": 1000.0, "cpu_rate": 500.0})
+        new = _write_round(tmp_path, 2, {"value": 900.0, "cpu_rate": 450.0})
+        assert bench.compare_bench(old, new)["ok"]
+
+    def test_unreachable_zero_is_skipped_not_failed(self, tmp_path):
+        """0.0 on a round carrying the unreachable markers is a transport
+        state, not a measured collapse — the gate must not fail on it."""
+        old = _write_round(tmp_path, 1, {"value": 1000.0, "cpu_rate": 500.0})
+        new = _write_round(tmp_path, 2, {
+            "value": 0.0, "cpu_rate": 505.0,
+            "error": "accelerator unreachable after retries",
+        })
+        report = bench.compare_bench(old, new)
+        assert report["ok"], report
+        assert report["series"]["value"]["status"] == "skipped"
+        assert "unreachable" in report["series"]["value"]["note"]
+
+    def test_real_zero_regresses(self, tmp_path):
+        """A measured 0.0 — no unreachable markers — IS a collapse."""
+        old = _write_round(tmp_path, 1, {"value": 1000.0, "cpu_rate": 500.0})
+        new = _write_round(tmp_path, 2, {"value": 0.0, "cpu_rate": 505.0})
+        report = bench.compare_bench(old, new)
+        assert not report["ok"]
+        assert "value" in report["regressions"]
+
+    def test_bare_line_format_accepted(self, tmp_path):
+        """Rounds committed as the bare printed line (no driver wrapper)
+        diff identically to wrapped ones."""
+        p = tmp_path / "BENCH_r03.json"
+        p.write_text(json.dumps({"value": 1200.0, "cpu_rate": 600.0}))
+        old = _write_round(tmp_path, 2, {"value": 1000.0, "cpu_rate": 500.0})
+        report = bench.compare_bench(old, str(p))
+        assert report["ok"]
+        assert report["series"]["value"]["ratio"] == pytest.approx(1.2)
+
+    def test_round_ordering_is_numeric(self, tmp_path):
+        for n in (9, 10, 2):
+            _write_round(tmp_path, n, {"value": 1.0})
+        import os
+
+        rounds = bench.find_bench_rounds(str(tmp_path))
+        assert [os.path.basename(r) for r in rounds] == [
+            "BENCH_r02.json", "BENCH_r09.json", "BENCH_r10.json"]
+
+    def test_cli_exit_codes(self, tmp_path):
+        old = _write_round(tmp_path, 1, {"value": 1000.0, "cpu_rate": 500.0})
+        bad = _write_round(tmp_path, 2, {"value": 100.0, "cpu_rate": 500.0})
+        assert bench.compare_main(
+            ["--compare", "--dir", str(tmp_path)]) == 1
+        assert bench.compare_main(["--compare", old, bad]) == 1
+        assert bench.compare_main(
+            ["--compare", old, bad, "--threshold", "0.95"]) == 0
+        # usage errors are 2, never confusable with "regression" (1)
+        assert bench.compare_main(["--compare", old]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert bench.compare_main(
+            ["--compare", "--dir", str(empty)]) == 2
