@@ -575,6 +575,13 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # measured per-phase pull/comp/push split, tracked round over
         # round so device-hot-path regressions land in the trajectory
         line["sparse_hot_path"] = sp
+    isvc = measure_input_service()
+    if isvc is not None:
+        # disaggregated-input-service throughput A/B (small unpinned
+        # probe; the committed INPUT_SVC_r*.json holds the pinned-budget
+        # capture) — tracked so service-path regressions land in the
+        # trajectory, and --compare checks input_service.svc_sps
+        line["input_service"] = isvc
     lint = measure_lint()
     if lint is not None:
         # harmonylint suite runtime + finding counts: the suite runs in
@@ -582,6 +589,35 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # every CI pass — keep it visible in the same trajectory
         line["lint"] = lint
     print(json.dumps(line))
+
+
+def measure_input_service() -> "dict | None":
+    """Input-service probe (tracked round over round in the BENCH json,
+    and by --compare via the dotted input_service.* series): a small
+    multi-tenant-process service-vs-in-process A/B — 3 same-dataset
+    tenant processes, standalone service, unpinned cores (the full
+    pinned-budget capture is benchmarks/INPUT_SVC_r10.json). Returns
+    {svc_sps, inproc_sps, speedup, parity} or None — the bench line
+    must never die for its input-service hook."""
+    try:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.bench_input_pipeline import run_service_bench
+
+        r = run_service_bench(tenants=3, n=262144, epochs=2, rounds=1,
+                              cores=0)
+        if not r.get("losses_bit_identical"):
+            return {"error": "service/in-process loss parity broke"}
+        return {
+            "svc_sps": r["service_sps"],
+            "inproc_sps": r["inproc_sps"],
+            "speedup": r["speedup"],
+            "parity": "bit-identical",
+        }
+    except Exception:
+        return None
 
 
 def measure_lint() -> "dict | None":
@@ -615,8 +651,11 @@ def measure_lint() -> "dict | None":
 
 #: higher-is-better series checked by default. `value` is the headline
 #: aggregate; `cpu_rate` is the always-measurable denominator that keeps
-#: rounds comparable when the accelerator transport is wedged.
-HEADLINE_SERIES = ("value", "cpu_rate")
+#: rounds comparable when the accelerator transport is wedged;
+#: `input_service.svc_sps` (dotted = nested lookup) tracks the
+#: disaggregated-input-service serving rate — absent in rounds before
+#: PR 10, which --compare skips rather than fails.
+HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps")
 COMPARE_THRESHOLD = 0.15
 
 
@@ -635,10 +674,16 @@ def _bench_line(path: str) -> dict:
 
 def _series_value(line: dict, name: str):
     """The measured number for one series, or (None, reason) when the
-    round holds no measurement for it. 0.0 counts as a MEASUREMENT only
+    round holds no measurement for it. Dotted names index nested dicts
+    (``input_service.svc_sps``). 0.0 counts as a MEASUREMENT only
     when the line does not carry the unreachable-accelerator markers —
     the emit() convention reserves 0.0-with-error for 'did not run'."""
-    v = line.get(name)
+    v: "object | None" = line
+    for part in name.split("."):
+        if not isinstance(v, dict):
+            v = None
+            break
+        v = v.get(part)
     if v is None:
         return None, "series absent"
     try:

@@ -406,6 +406,19 @@ def main(argv: List[str] | None = None) -> int:
                    help="also list suppressed findings")
 
     p = sub.add_parser(
+        "inputsvc",
+        help="standalone shared input-data service (jax-free worker "
+             "process; trainers reach it via HARMONY_INPUT_SERVICE_ADDR "
+             "— docs/INPUT_PIPELINE.md §Input service)",
+    )
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = ephemeral, printed as JSON)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (multi-host: a DCN-reachable IP)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker slots (default HARMONY_INPUT_WORKERS)")
+
+    p = sub.add_parser(
         "obs",
         help="observability tooling: per-tenant cost top, flight "
              "records, /metrics scrape, trace timelines "
@@ -454,6 +467,15 @@ def main(argv: List[str] | None = None) -> int:
         return 0 if resp.get("ok") else 1
     if args.cmd == "lint":
         return _cmd_lint(args)
+    if args.cmd == "inputsvc":
+        # the standalone worker process is deliberately jax-free; its
+        # entry shares __main__'s implementation
+        from harmony_tpu.inputsvc.__main__ import main as inputsvc_main
+
+        return inputsvc_main([
+            "--port", str(args.port), "--host", args.host,
+        ] + ([] if args.workers is None
+             else ["--workers", str(args.workers)]))
     if args.cmd == "obs":
         return _cmd_obs(args)
     if args.cmd == "run":

@@ -195,6 +195,20 @@ class TrainerParams(ConfigBase):
     # background thread's device_puts would break the deterministic
     # pod-wide dispatch order.
     input_prefetch: bool = True
+    # Disaggregated input-data service (harmony_tpu/inputsvc): pull
+    # assembled, shard-ready batches from the shared input workers
+    # instead of assembling them in-process, so same-dataset tenants
+    # share ONE epoch assembly through the cross-tenant batch cache.
+    # Default OFF (opt-in rollout); the process-wide
+    # HARMONY_INPUT_SERVICE env knob (0/1) overrides for every job, and
+    # HARMONY_INPUT_SERVICE_ADDR points trainers at a standalone service
+    # process. Requires a wire-safe dataset identity (user.data_fn /
+    # data_args); jobs without one keep in-process assembly. Losses are
+    # bit-identical either way for a fixed seed — the service replays
+    # the same epoch permutation the local provider draws — and every
+    # service failure degrades to in-process assembly after bounded
+    # retry (docs/INPUT_PIPELINE.md §"Input service").
+    input_service: bool = False
     # Per-job throughput SLO (metrics/accounting.py): the samples/sec
     # this job is expected to sustain. 0 = no target. When a worker
     # sustains < 90% of the target across a window of epochs it records

@@ -1,22 +1,51 @@
-from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
-from harmony_tpu.dolphin.data import TrainingDataProvider
-from harmony_tpu.dolphin.accessor import (
-    CachedModelAccessor,
-    ModelAccessor,
-    make_accessor,
-)
-from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
-from harmony_tpu.dolphin.worker import FusedSparseStep, WorkerTasklet
+"""Dolphin — the PS-style training framework layer.
 
-__all__ = [
-    "Trainer",
-    "TrainerContext",
-    "TrainingDataProvider",
-    "ModelAccessor",
-    "CachedModelAccessor",
-    "make_accessor",
-    "FusedSparseStep",
-    "PrefetchPipeline",
-    "StagedBatch",
-    "WorkerTasklet",
-]
+Exports resolve lazily (PEP 562): ``harmony_tpu.dolphin.data`` is pure
+numpy and is imported by the standalone input-worker process
+(``python -m harmony_tpu.inputsvc``), which must not pay — or depend on
+— the jax import the worker/accessor modules pull in. Eager ``from
+harmony_tpu.dolphin import WorkerTasklet`` style imports behave exactly
+as before.
+"""
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "Trainer": "harmony_tpu.dolphin.trainer",
+    "TrainerContext": "harmony_tpu.dolphin.trainer",
+    "TrainingDataProvider": "harmony_tpu.dolphin.data",
+    "DeferredTrainingDataProvider": "harmony_tpu.dolphin.data",
+    "CachedModelAccessor": "harmony_tpu.dolphin.accessor",
+    "ModelAccessor": "harmony_tpu.dolphin.accessor",
+    "make_accessor": "harmony_tpu.dolphin.accessor",
+    "PrefetchPipeline": "harmony_tpu.dolphin.prefetch",
+    "StagedBatch": "harmony_tpu.dolphin.prefetch",
+    "FusedSparseStep": "harmony_tpu.dolphin.worker",
+    "WorkerTasklet": "harmony_tpu.dolphin.worker",
+}
+
+__all__ = sorted(_EXPORTS)
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from harmony_tpu.dolphin.accessor import (
+        CachedModelAccessor,
+        ModelAccessor,
+        make_accessor,
+    )
+    from harmony_tpu.dolphin.data import (
+        DeferredTrainingDataProvider,
+        TrainingDataProvider,
+    )
+    from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
+    from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
+    from harmony_tpu.dolphin.worker import FusedSparseStep, WorkerTasklet
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(mod), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

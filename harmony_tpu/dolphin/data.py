@@ -13,7 +13,7 @@ like each reference worker seeing its local input blocks.
 """
 from __future__ import annotations
 
-from typing import Iterator, Sequence, Tuple
+from typing import Any, Iterator, Sequence, Tuple
 
 import numpy as np
 
@@ -49,7 +49,21 @@ class TrainingDataProvider:
         self.num_mini_batches = num_mini_batches
         self._arrays = [a[: self.batch_size * num_mini_batches] for a in arrays]
         self._shuffle = shuffle_each_epoch
+        self.seed = seed
         self._rng = np.random.default_rng(seed)
+        # Replay cursor for epoch_batches_at: (next_epoch, rng) of a
+        # SEPARATE generator advanced only by explicit-epoch reads, so
+        # random-access epochs stay O(1) amortized when consumed in
+        # order (the service/fallback path) without touching the
+        # sequential iterator's RNG. Lock-guarded: concurrent explicit-
+        # epoch readers exist on the trainer side (a pump thread's
+        # fallback racing a self-serving consumer, or a pre-spawned
+        # next-epoch producer) and an interleaved shuffle draw would
+        # silently yield the WRONG permutation.
+        import threading
+
+        self._replay = (0, np.random.default_rng(seed))
+        self._replay_lock = threading.Lock()
 
     @property
     def num_examples(self) -> int:
@@ -85,6 +99,62 @@ class TrainingDataProvider:
             sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
             yield tuple(a[sl] for a in epoch_arrays)
 
+    def array_specs(self) -> "list[tuple[tuple, np.dtype]]":
+        """Per-array (trailing shape, dtype) — the batch structure
+        without the batch axis. Program keys and shape probes read THIS
+        instead of poking ``_arrays``, so a deferred provider can answer
+        without materializing its data."""
+        return [(tuple(a.shape[1:]), a.dtype) for a in self._arrays]
+
+    def first_rows(self, k: int) -> Tuple[np.ndarray, ...]:
+        """The first ``k`` rows of each array in stable storage order
+        (the comm probe's sample batch — real values, not shapes)."""
+        return tuple(a[:k] for a in self._arrays)
+
+    def epoch_permutation(self, epoch: int) -> np.ndarray:
+        """The permutation ``epoch_batches()`` would draw for its
+        ``epoch``-th call (0-based), WITHOUT advancing the sequential
+        iterator's RNG — the epoch shuffle is thus a pure function of
+        ``(seed, epoch)``, which is what lets the input service assemble
+        any tenant's epoch remotely and lets a mid-job fallback resume
+        at the right epoch: both replay the same draw sequence a fresh
+        ``default_rng(seed)`` yields. Consumed-in-order reads are O(1)
+        amortized via the replay cursor; a backward read replays from
+        the seed."""
+        if not self._shuffle:
+            raise ValueError("epoch_permutation is undefined without shuffle")
+        with self._replay_lock:
+            nxt, rng = self._replay
+            if epoch < nxt:  # backward: replay from scratch
+                nxt, rng = 0, np.random.default_rng(self.seed)
+            idx = np.arange(self.num_examples)
+            while True:
+                perm = idx.copy()
+                rng.shuffle(perm)
+                nxt += 1
+                if nxt > epoch:
+                    break
+            self._replay = (nxt, rng)
+            return perm
+
+    def epoch_batches_at(self, epoch: int) -> Iterator[Tuple[np.ndarray, ...]]:
+        """``epoch_batches()`` for an EXPLICIT epoch index: yields the
+        exact batch sequence the sequential iterator's ``epoch``-th call
+        yields, leaving the sequential RNG untouched. This is the
+        assembly path of the input service (workers are asked for
+        '(spec, epoch)', not 'next') and of the trainer's in-process
+        fallback after a service give-up (the local RNG never advanced
+        while the service was serving, so sequential iteration would
+        replay epoch 0's draw)."""
+        if self._shuffle:
+            perm = self.epoch_permutation(epoch)
+            epoch_arrays = [a[perm] for a in self._arrays]
+        else:
+            epoch_arrays = self._arrays
+        for b in range(self.num_mini_batches):
+            sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
+            yield tuple(a[sl] for a in epoch_arrays)
+
     def batch_at(self, b: int) -> Tuple[np.ndarray, ...]:
         """Batch ``b`` of the STABLE epoch order — only defined for
         non-shuffling providers (shuffled order lives in the epoch
@@ -96,3 +166,93 @@ class TrainingDataProvider:
             raise IndexError(f"batch {b} out of range")
         sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
         return tuple(a[sl] for a in self._arrays)
+
+
+class DeferredTrainingDataProvider(TrainingDataProvider):
+    """A provider whose host arrays materialize on FIRST data access.
+
+    Input-service tenants consume assembled batches off the wire, so the
+    local copy of the dataset exists only as the FALLBACK source — a
+    tenant whose fetches never fail should not pay the data_fn call
+    (often the single most expensive host step: synthetic generators,
+    file parses) nor hold a dataset-sized array it never reads. All
+    metadata (batch counts/sizes, shuffle identity, the epoch
+    permutation replay — a pure function of (seed, n)) is available
+    without materializing; the data-bearing accessors materialize
+    lazily, and the realized arrays are validated against the declared
+    ``num_examples``."""
+
+    def __init__(
+        self,
+        arrays_fn,
+        num_examples: int,
+        num_mini_batches: int,
+        shuffle_each_epoch: bool = False,
+        seed: int = 0,
+        dataset_key: "tuple | None" = None,
+        array_specs: "list[tuple[tuple, Any]] | None" = None,
+    ) -> None:
+        if num_mini_batches <= 0 or num_mini_batches > num_examples:
+            raise ValueError(
+                f"bad num_mini_batches={num_mini_batches} for "
+                f"n={num_examples}")
+        self._arrays_fn = arrays_fn
+        self._declared_n = int(num_examples)
+        self.dataset_key = dataset_key if not shuffle_each_epoch else None
+        self.batch_size = num_examples // num_mini_batches
+        self.num_mini_batches = num_mini_batches
+        self._shuffle = shuffle_each_epoch
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._arrays = None
+        self._declared_specs = (
+            None if array_specs is None
+            else [(tuple(tail), np.dtype(dt)) for tail, dt in array_specs]
+        )
+        import threading
+
+        self._replay = (0, np.random.default_rng(seed))
+        self._replay_lock = threading.Lock()
+        self._materialize_lock = threading.Lock()
+
+    def array_specs(self):
+        if self._arrays is None:
+            if self._declared_specs is None:
+                self._ensure()  # no declared specs: materialize to answer
+            else:
+                return list(self._declared_specs)
+        return super().array_specs()
+
+    def first_rows(self, k: int):
+        self._ensure()
+        return super().first_rows(k)
+
+    def _ensure(self) -> None:
+        with self._materialize_lock:
+            if self._arrays is not None:
+                return
+            out = self._arrays_fn()
+            arrays = [np.asarray(a)
+                      for a in (out if isinstance(out, (tuple, list))
+                                else (out,))]
+            if not arrays or any(a.shape[0] != self._declared_n
+                                 for a in arrays):
+                raise ValueError(
+                    "deferred provider materialized arrays that do not "
+                    f"match the declared num_examples={self._declared_n}")
+            self._arrays = [
+                a[: self.batch_size * self.num_mini_batches]
+                for a in arrays
+            ]
+
+    def epoch_batches(self):
+        self._ensure()
+        return super().epoch_batches()
+
+    def epoch_batches_at(self, epoch: int):
+        self._ensure()
+        return super().epoch_batches_at(epoch)
+
+    def batch_at(self, b: int):
+        self._ensure()
+        return super().batch_at(b)

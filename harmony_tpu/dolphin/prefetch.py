@@ -82,7 +82,10 @@ class PrefetchPipeline:
     ``skip_stage_fn`` (optional) suppresses the ``device_put`` for batches
     that are already device-resident (one evicted cache entry must not
     re-transfer the whole epoch) — those flow through host-only and the
-    consumer's cache lookup serves them.
+    consumer's cache lookup serves them; ``epoch_source`` (optional)
+    replaces the provider's local assembly with an external batch stream
+    — the input-service feed (harmony_tpu/inputsvc) — leaving staging,
+    invalidation and the consumer contract untouched.
     """
 
     JOIN_TIMEOUT = 10.0
@@ -97,17 +100,26 @@ class PrefetchPipeline:
         job_id: str = "",
         net_scope: Optional[Callable[[Callable[[], bool]], Any]] = None,
         skip_stage_fn: Optional[Callable[[int], bool]] = None,
+        epoch_source: Optional[Callable[[], Any]] = None,
     ) -> None:
         self._provider = provider
         self._sharding_fn = sharding_fn
         self._net_scope = net_scope
         self._skip_stage_fn = skip_stage_fn
+        self._epoch_source = epoch_source
         self._ring = StageRing(depth_fn)
         self._epoch = epoch
         self._job_id = job_id
         self._host_only = False  # see stop_staging()
         self.produce_sec = 0.0  # host assembly (gather/stack) seconds
         self.stage_sec = 0.0    # device_put seconds (incl. NET admission)
+        # staged device copies DROPPED before use, by reason ("reshard" =
+        # layout-change invalidation, "demote" = host-only demotion) —
+        # mutated from the announcement-listener thread while the
+        # producer/consumer run, so guarded; mirrored onto the registry's
+        # harmony_input_dropped_total{reason} counter
+        self._drop_lock = threading.Lock()
+        self.dropped: dict = {}
         self._thread = threading.Thread(
             target=self._produce,
             name=f"prefetch-{job_id or 'job'}-e{epoch}",
@@ -124,10 +136,22 @@ class PrefetchPipeline:
                 "dolphin.prefetch.produce",
                 job_id=self._job_id, epoch=self._epoch,
             ) as span:
-                it = enumerate(self._provider.epoch_batches())
+                it = enumerate(
+                    self._epoch_source()
+                    if self._epoch_source is not None
+                    else self._provider.epoch_batches()
+                )
                 while True:
                     t0 = time.perf_counter()
-                    nxt = next(it, None)
+                    if (self._epoch_source is not None
+                            and self._net_scope is not None):
+                        # service fetches are network work: ride the fair
+                        # queue as NET units (same class as staging) so a
+                        # tenant's input pulls queue behind its own share
+                        with self._net_scope(self._closed):
+                            nxt = next(it, None)
+                    else:
+                        nxt = next(it, None)
                     self.produce_sec += time.perf_counter() - t0
                     if nxt is None:
                         break
@@ -194,16 +218,39 @@ class PrefetchPipeline:
                 return
             yield item
 
-    def invalidate(self) -> int:
+    def invalidate(self, reason: str = "reshard") -> int:
         """Reshard announcement hook: drop the staged device copies (host
         copies stay — the consumer re-places them on the live mesh), and
         let new stages pick up the new sharding from ``sharding_fn``.
-        Returns the number of staged batches invalidated."""
+        Returns the number of staged batches invalidated; copies that
+        actually existed count into ``dropped[reason]`` and the
+        ``harmony_input_dropped_total{reason}`` registry counter (they
+        are H2D transfers paid and thrown away — stats() used to lose
+        them entirely)."""
+        box = [0]
 
         def drop(item: StagedBatch) -> None:
+            if item.device is not None:
+                box[0] += 1
             item.device = None
 
-        return self._ring.apply(drop)
+        n = self._ring.apply(drop)
+        if box[0]:
+            with self._drop_lock:
+                self.dropped[reason] = self.dropped.get(reason, 0) + box[0]
+            try:
+                from harmony_tpu.metrics.registry import get_registry
+
+                get_registry().counter(
+                    "harmony_input_dropped_total",
+                    "Staged input batches whose device copies were "
+                    "dropped before use, by reason (reshard "
+                    "invalidation / host-only demotion)",
+                    ("reason",),
+                ).labels(reason=reason).inc(box[0])
+            except Exception:
+                pass  # metrics are an observer, never a dependency
+        return n
 
     def stop_staging(self) -> int:
         """Demote the pipeline to host-only production: the producer keeps
@@ -216,7 +263,7 @@ class PrefetchPipeline:
         dispatches). Also invalidates already-staged copies; returns the
         invalidated count."""
         self._host_only = True
-        return self.invalidate()
+        return self.invalidate(reason="demote")
 
     def close(self) -> None:
         """Stop the producer (idempotent) and join it — no leaked thread.
@@ -231,6 +278,8 @@ class PrefetchPipeline:
 
     def stats(self) -> dict:
         r = self._ring
+        with self._drop_lock:
+            dropped = dict(self.dropped)
         return {
             "staged": r.staged,
             "max_depth": r.max_depth,
@@ -238,4 +287,6 @@ class PrefetchPipeline:
             "consumer_stall_sec": r.consumer_stall_sec,
             "produce_sec": self.produce_sec,
             "stage_sec": self.stage_sec,
+            "dropped": dropped,
+            "dropped_batches": sum(dropped.values()),
         }

@@ -254,8 +254,17 @@ class WorkerTasklet:
         pod_contended: Optional[Callable[[], bool]] = None,
         trace_parent: Optional[Dict[str, str]] = None,
         attempt: int = 0,
+        input_feed: Optional[Any] = None,
     ) -> None:
         self.job_id = job_id
+        # Disaggregated input service (harmony_tpu/inputsvc): a
+        # TrainerInputFeed streaming assembled host batches off the
+        # shared input workers, with built-in bounded retry and
+        # in-process fallback. None = local assembly (the default).
+        # The feed replaces WHERE host batches come from; staging,
+        # devcache bypass, reshard invalidation and sharding checks are
+        # untouched, and losses stay bit-identical for a fixed seed.
+        self._input_feed = input_feed
         # Trace threading (tracing/span.py): the worker runs on its own
         # thread, so the entity hands the dispatch span's wire context
         # down explicitly — contextvars do not cross Thread starts. The
@@ -559,9 +568,8 @@ class WorkerTasklet:
             return via  # static gate resolves deterministically in-trace
         try:
             sample = tuple(
-                jax.ShapeDtypeStruct(
-                    (self.data.batch_size, *a.shape[1:]), a.dtype)
-                for a in self.data._arrays
+                jax.ShapeDtypeStruct((self.data.batch_size, *tail), dt)
+                for tail, dt in self.data.array_specs()
             )
             nkeys = int(jax.eval_shape(self.trainer.pull_keys, sample).shape[0])
             from harmony_tpu.table.autotune import choose_push_route
@@ -597,8 +605,8 @@ class WorkerTasklet:
         else:
             local_sig = None
         batch_sig = tuple(
-            (self.data.batch_size, *a.shape[1:], str(a.dtype))
-            for a in self.data._arrays
+            (self.data.batch_size, *tail, str(dt))
+            for tail, dt in self.data.array_specs()
         )
         hyper_sig = tuple(sorted(self.trainer.hyperparams().keys()))
         return (tsig, table_sig, local_sig, batch_sig, hyper_sig,
@@ -867,9 +875,9 @@ class WorkerTasklet:
                 batch_sh = NamedSharding(new_mesh, P(DATA_AXIS))
                 dummy = tuple(
                     jax.device_put(
-                        np.zeros((self.data.batch_size, *a.shape[1:]),
-                                 a.dtype), batch_sh)
-                    for a in self.data._arrays
+                        np.zeros((self.data.batch_size, *tail), dt),
+                        batch_sh)
+                    for tail, dt in self.data.array_specs()
                 )
                 with dispatch_scope(new_mesh) as fin:
                     out = fin(step(arr0, dummy, hyper))
@@ -923,8 +931,8 @@ class WorkerTasklet:
         # an eval_shape of pull_keys (no compute), all-mode pulls capacity.
         if self.trainer.pull_mode == "keys":
             sample = tuple(
-                jax.ShapeDtypeStruct((self.data.batch_size, *a.shape[1:]), a.dtype)
-                for a in self.data._arrays
+                jax.ShapeDtypeStruct((self.data.batch_size, *tail), dt)
+                for tail, dt in self.data.array_specs()
             )
             self._pull_rows = int(
                 jax.eval_shape(self.trainer.pull_keys, sample).shape[0]
@@ -1313,7 +1321,14 @@ class WorkerTasklet:
                 # (no background device_puts) instead
                 handoff[1].stop_staging()
             else:
-                for i, b in enumerate(self.data.epoch_batches()):
+                # synchronous fallback: the feed (when present) must
+                # still be the source — its epoch replay never advanced
+                # the provider's sequential RNG, so epoch_batches() here
+                # would replay epoch 0's draw
+                src = (self._input_feed.epoch_iter(epoch)
+                       if self._input_feed is not None
+                       else self.data.epoch_batches())
+                for i, b in enumerate(src):
                     yield i, b, None
                 return
         if handoff is not None:
@@ -1358,6 +1373,11 @@ class WorkerTasklet:
                 i in self._batch_cache
                 or devcache.contains(self._devcache_key(i))
             )
+        epoch_source = None
+        if self._input_feed is not None:
+            feed = self._input_feed
+            # bound per pipeline: each pipeline owns ONE epoch's stream
+            epoch_source = lambda: feed.epoch_iter(epoch)  # noqa: E731
         return PrefetchPipeline(
             self.data,
             lambda: self._batch_sharding,
@@ -1366,6 +1386,7 @@ class WorkerTasklet:
             job_id=self.job_id,
             net_scope=net_scope,
             skip_stage_fn=skip_staged,
+            epoch_source=epoch_source,
         )
 
     def _spawn_next_pipeline(self, next_epoch: int) -> None:
@@ -1395,6 +1416,14 @@ class WorkerTasklet:
 
     def _emit_prefetch_metrics(self, epoch: int, pipeline: PrefetchPipeline) -> None:
         s = pipeline.stats()
+        svc = fb = 0
+        if self._input_feed is not None:
+            # EXACT per-epoch attribution from the feed (a cumulative
+            # delta would misattribute when the pre-spawned next-epoch
+            # pump lands batches before this epoch's emit)
+            es = self._input_feed.epoch_stats(epoch)
+            svc = es["service"]
+            fb = es["fallbacks"]
         self.collector.add(
             InputPipelineMetrics(
                 job_id=self.job_id,
@@ -1408,6 +1437,9 @@ class WorkerTasklet:
                 stage_sec=s["stage_sec"],
                 producer_idle_sec=s["producer_idle_sec"],
                 consumer_stall_sec=s["consumer_stall_sec"],
+                dropped_batches=s["dropped_batches"],
+                service_batches=svc,
+                service_fallbacks=fb,
             )
         )
         try:  # tenant ledger: input-wait seconds feed the wait fraction
@@ -1638,8 +1670,7 @@ class WorkerTasklet:
                 self._probe_pull is None or since >= self._next_probe
             ):
                 self._next_probe = since + 8 * self.comm_probe_every
-                first = tuple(a[: self.data.batch_size]
-                              for a in self.data._arrays)
+                first = self.data.first_rows(self.data.batch_size)
                 if first and len(first[0]):
                     if (self.dispatch_turn is not None
                             and not self._use_fused_epoch()):

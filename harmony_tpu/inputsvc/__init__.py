@@ -1,0 +1,44 @@
+"""Disaggregated multi-tenant input-data service.
+
+Shared input workers assemble and cache mini-batches ONCE per
+(dataset, transform, sharding, epoch) and serve every tenant training on
+them over framed TCP — the tf.data-service move (PAPERS.md) applied to
+this framework's input path. See :mod:`harmony_tpu.inputsvc.service`
+for the architecture, :mod:`harmony_tpu.inputsvc.spec` for the
+cache-key isolation contract, and docs/INPUT_PIPELINE.md §"Input
+service" for the operator story.
+
+Runs embedded in the jobserver (started on demand for opted-in jobs) or
+standalone — ``python -m harmony_tpu.inputsvc`` / ``harmony-tpu
+inputsvc`` — in which case trainers find it via
+``HARMONY_INPUT_SERVICE_ADDR``. The standalone process never imports
+jax.
+"""
+from harmony_tpu.inputsvc.cache import BatchCache
+from harmony_tpu.inputsvc.client import (
+    InputServiceError,
+    TrainerInputFeed,
+    default_endpoint,
+    enabled_for,
+    fetch_epoch,
+    fetch_stats,
+    host_cache,
+    set_default_endpoint,
+)
+from harmony_tpu.inputsvc.service import InputAutoscaler, InputService
+from harmony_tpu.inputsvc.spec import DatasetSpec
+
+__all__ = [
+    "BatchCache",
+    "DatasetSpec",
+    "InputAutoscaler",
+    "InputService",
+    "InputServiceError",
+    "TrainerInputFeed",
+    "default_endpoint",
+    "enabled_for",
+    "fetch_epoch",
+    "fetch_stats",
+    "host_cache",
+    "set_default_endpoint",
+]

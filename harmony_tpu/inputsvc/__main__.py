@@ -1,0 +1,63 @@
+"""Standalone input-service process: ``python -m harmony_tpu.inputsvc``.
+
+The disaggregation unit: one of these per host serves every trainer
+process pointed at it via ``HARMONY_INPUT_SERVICE_ADDR``. Deliberately
+jax-free (batch assembly is numpy + sockets), so it starts in
+milliseconds and its memory is dataset + cache, not an XLA runtime.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="harmony-tpu inputsvc",
+        description="standalone shared input-data service",
+    )
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral, printed on stdout)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (multi-host: a DCN-reachable IP)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="worker slots (default HARMONY_INPUT_WORKERS)")
+    args = ap.parse_args(argv)
+
+    import os
+
+    pin = os.environ.get("HARMONY_INPUT_PIN_CORES")
+    if pin and hasattr(os, "sched_setaffinity"):
+        # dedicate host cores to input work (the disaggregation contract:
+        # input workers scale on their OWN cores, not the trainers') —
+        # e.g. "4,5"; malformed values fall through unpinned
+        try:
+            os.sched_setaffinity(
+                0, {int(c) for c in pin.split(",") if c.strip()})
+        except (ValueError, OSError):
+            pass
+
+    from harmony_tpu.inputsvc.service import InputService
+
+    svc = InputService(workers=args.workers, host=args.host)
+    port = svc.start(args.port)
+    # one JSON line so wrappers can parse the bound endpoint
+    print(json.dumps({"inputsvc": True, "host": args.host, "port": port,
+                      "workers": svc.workers}), flush=True)
+    done = threading.Event()
+
+    def _stop(signum, frame) -> None:
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    done.wait()
+    svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Cross-tenant batch cache: bytes-bounded LRU over assembled batches.
+
+One entry is one assembled mini-batch — a tuple of host numpy arrays —
+under the full 5-tuple key from :mod:`harmony_tpu.inputsvc.spec`. The
+map is exact-key: a tenant whose transform fingerprint differs by one
+bit sees a miss, never a neighbor's bytes (the isolation contract).
+
+Eviction is LRU by total payload bytes (``HARMONY_INPUT_CACHE_MB``).
+Entries of a shuffling epoch are VIEWS into that epoch's one permuted
+copy, so the accounted bytes equal the epoch copy's size spread over
+its batches. Caveat the operator should know: evicting PART of an epoch
+credits the budget for the evicted views' bytes while the surviving
+views still pin the whole base buffer — a cache thrashing across many
+partially-evicted epochs can hold more real memory than the configured
+budget (bounded by one epoch copy per live spec). Epochs are inserted
+and consumed oldest-first, so steady state evicts whole epochs and the
+bound holds; size the budget to a few epochs per concurrent spec
+(docs/DEPLOY.md §7) rather than exactly one.
+
+Registry metrics (best-effort — a metrics failure must never break a
+serve path): ``harmony_inputsvc_cache_events_total{result}`` with
+result hit/miss/evict, and the ``harmony_inputsvc_cache_bytes`` gauge.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+
+def cache_budget_bytes() -> int:
+    """HARMONY_INPUT_CACHE_MB (default 256 MiB) as bytes."""
+    mb = float(os.environ.get("HARMONY_INPUT_CACHE_MB", "256") or 256)
+    return max(1, int(mb * (1 << 20)))
+
+
+class BatchCache:
+    """Thread-safe bytes-bounded LRU of assembled batches."""
+
+    def __init__(self, max_bytes: Optional[int] = None) -> None:
+        self.max_bytes = (cache_budget_bytes()
+                          if max_bytes is None else int(max_bytes))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._events = None
+        self._gauge = None
+        try:
+            from harmony_tpu.metrics.registry import get_registry
+
+            reg = get_registry()
+            self._events = reg.counter(
+                "harmony_inputsvc_cache_events_total",
+                "Cross-tenant input batch-cache lookups and evictions",
+                ("result",),
+            )
+            self._gauge = reg.gauge(
+                "harmony_inputsvc_cache_bytes",
+                "Resident bytes in the cross-tenant input batch cache",
+            )
+        except Exception:
+            pass  # metrics are an observer, never a dependency
+
+    def _event(self, result: str) -> None:
+        if self._events is not None:
+            try:
+                self._events.labels(result=result).inc()
+            except Exception:
+                pass
+
+    def get(self, key: Tuple) -> Optional[Tuple]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self.misses += 1
+                self._event("miss")
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self._event("hit")
+            return hit[0]
+
+    def put(self, key: Tuple, batch: Tuple) -> bool:
+        """Insert (idempotent for an existing key); returns False when
+        the batch alone exceeds the whole budget (never cached — caching
+        it would flush everything for one entry)."""
+        nbytes = sum(int(a.nbytes) for a in batch)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (tuple(batch), nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, freed) = self._entries.popitem(last=False)
+                self._bytes -= freed
+                self.evictions += 1
+                self._event("evict")
+            if self._gauge is not None:
+                try:
+                    self._gauge.set(float(self._bytes))
+                except Exception:
+                    pass
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            if self._gauge is not None:
+                try:
+                    self._gauge.set(0.0)
+                except Exception:
+                    pass
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

@@ -1,0 +1,523 @@
+"""Trainer-side input-service client: fetch, bounded retry, fallback.
+
+The client is what the prefetch producer calls instead of assembling
+locally (``PrefetchPipeline`` with an ``epoch_source``): it streams one
+epoch's framed batches off the service, in batch order, and hands the
+host tuples to the normal staging path — the device side (StageRing,
+devcache bypass, reshard invalidation, ``StagedBatch.take``) never
+learns where a batch came from, which is what keeps losses bit-identical
+with the service on or off for a fixed seed.
+
+Failure stance (docs/FAULT_TOLERANCE.md): the ``inputsvc.fetch`` site
+fires before each fetch attempt; connection/stream failures retry under
+the standard bounded-backoff :class:`~harmony_tpu.config.params.
+RetryPolicy`, RESUMING from the first batch the stream did not deliver
+(frames are idempotent by batch index). Exhaustion degrades to
+in-process assembly for the epoch via
+``TrainingDataProvider.epoch_batches_at`` — same permutation, same
+bytes, just local work — counted in
+``harmony_inputsvc_fallback_total{reason}``. The service is a
+throughput optimization; it is never allowed to become a liveness
+dependency.
+
+TRAINER-HOST CACHE: feeds in one process share a bounded
+:class:`~harmony_tpu.inputsvc.cache.BatchCache` under the SAME strict
+key contract as the service's — so N same-dataset tenants on one host
+pay the wire ONCE per epoch, not once per tenant (the loopback/NIC
+copy is the dominant serving cost once assembly is deduplicated).
+Shared batches are read-only by construction — consumers feed
+``np.stack``/``device_put`` and never mutate, the exact contract the
+process devcache already imposes on device copies. One feed per
+(spec, epoch) is elected fetch OWNER; sibling tenants consume batches
+as the owner lands them and self-serve only if the owner dies or the
+entry is evicted under memory pressure
+(``HARMONY_INPUT_CLIENT_CACHE_MB``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from harmony_tpu import faults
+from harmony_tpu.config.params import RetryPolicy
+from harmony_tpu.faults.retry import _count as _retry_count
+from harmony_tpu.faults.retry import backoff_delays
+from harmony_tpu.inputsvc import protocol
+from harmony_tpu.inputsvc.spec import DatasetSpec
+
+__all__ = [
+    "InputServiceError",
+    "TrainerInputFeed",
+    "default_endpoint",
+    "enabled_for",
+    "fetch_epoch",
+    "set_default_endpoint",
+]
+
+
+class InputServiceError(OSError):
+    """Service unusable for this fetch after bounded retry."""
+
+
+# -- endpoint registry ----------------------------------------------------
+
+_endpoint_lock = threading.Lock()
+_process_endpoint: Optional[Tuple[str, int]] = None
+
+
+def set_default_endpoint(addr: Optional[Tuple[str, int]]) -> None:
+    """Process-local default service address (the jobserver registers
+    its embedded service here); ``HARMONY_INPUT_SERVICE_ADDR`` wins over
+    it when set (standalone/disaggregated deployments)."""
+    global _process_endpoint
+    with _endpoint_lock:
+        _process_endpoint = addr
+
+
+def default_endpoint() -> Optional[Tuple[str, int]]:
+    raw = os.environ.get("HARMONY_INPUT_SERVICE_ADDR")
+    if raw:
+        host, _, port = raw.rpartition(":")
+        try:
+            return (host or "127.0.0.1", int(port))
+        except ValueError:
+            return None
+    with _endpoint_lock:
+        return _process_endpoint
+
+
+def enabled_for(params: Any) -> bool:
+    """Whether this job opts into the input service:
+    ``TrainerParams.input_service`` (default OFF), overridden process-
+    wide by HARMONY_INPUT_SERVICE (0/1) — the operator rollout/rollback
+    knob."""
+    on = bool(getattr(params, "input_service", False))
+    env = os.environ.get("HARMONY_INPUT_SERVICE")
+    if env is not None and env.strip() != "":
+        # empty string == unset (manifests wire the knob with value ""
+        # to mean 'per-job opt-in' without deleting the row)
+        on = env.strip().lower() not in ("0", "false", "off")
+    return on
+
+
+# -- fetch ----------------------------------------------------------------
+
+def fetch_epoch(
+    addr: Tuple[str, int],
+    spec: DatasetSpec,
+    epoch: int,
+    *,
+    tenant: str = "",
+    start: int = 0,
+    policy: Optional[RetryPolicy] = None,
+    timeout: float = 60.0,
+) -> Iterator[Tuple[int, Tuple]]:
+    """Yield ``(batch_idx, host_arrays)`` for batches ``start..nb-1`` of
+    one epoch, in order, retrying under ``policy`` and resuming from the
+    first undelivered batch. Raises :class:`InputServiceError` on
+    exhaustion (callers fall back to local assembly)."""
+    policy = policy or RetryPolicy.from_env()
+    delays = backoff_delays(policy)
+    nb = spec.num_mini_batches
+    nxt = start
+    last_err: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        if attempt:
+            time.sleep(next(delays))
+        try:
+            if faults.armed():
+                faults.site("inputsvc.fetch", tenant=tenant, epoch=epoch,
+                            start=nxt, attempt=attempt)
+            with protocol.connect(addr, timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                protocol.send_msg(sock, {
+                    "op": "epoch", "spec": spec.to_wire(),
+                    "epoch": int(epoch), "start": int(nxt),
+                    "tenant": tenant,
+                })
+                while nxt < nb:
+                    frame = protocol.recv_frame(sock)
+                    if frame is None:
+                        raise protocol.ProtocolError(
+                            f"stream ended at batch {nxt}/{nb}")
+                    op = frame.get("op")
+                    if op == "batch":
+                        if int(frame["b"]) != nxt:
+                            raise protocol.ProtocolError(
+                                f"out-of-order batch {frame['b']} "
+                                f"(expected {nxt})")
+                        yield nxt, frame["data"]
+                        nxt += 1
+                        continue
+                    if op == "error":
+                        raise protocol.ProtocolError(
+                            f"service error: {frame.get('error')}")
+                    if op == "end":
+                        raise protocol.ProtocolError(
+                            f"early end at batch {nxt}/{nb}")
+                    raise protocol.ProtocolError(f"unexpected frame {op!r}")
+                return
+        except OSError as e:  # includes InjectedFault + ProtocolError
+            last_err = e
+            if attempt + 1 < policy.max_attempts:
+                # standard bounded-retry telemetry (fault_counters() /
+                # harmony_retry_events_total) — the loop is hand-rolled
+                # because it must RESUME the stream, not re-run a closure
+                _retry_count("inputsvc.fetch.retries")
+    _retry_count("inputsvc.fetch.giveups")
+    raise InputServiceError(
+        f"input service at {addr} unusable for epoch {epoch} after "
+        f"{policy.max_attempts} attempts (next batch {nxt}/{nb}): "
+        f"{type(last_err).__name__}: {last_err}"
+    )
+
+
+# -- trainer-host shared batch cache --------------------------------------
+
+def client_cache_budget() -> int:
+    """HARMONY_INPUT_CLIENT_CACHE_MB (default 256 MiB) as bytes — the
+    per-trainer-process budget for service-fetched batches shared
+    across tenants."""
+    mb = float(os.environ.get("HARMONY_INPUT_CLIENT_CACHE_MB", "256") or 256)
+    return max(1, int(mb * (1 << 20)))
+
+
+class _EpochProgress:
+    """Fetch-owner election + progress signal for one (spec, epoch):
+    sibling tenants wait for the owner to land batch ``b`` instead of
+    opening their own streams."""
+
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.high = -1   # highest batch index landed in the cache
+        self.done = False
+
+    def advance(self, b: int) -> None:
+        with self.cond:
+            self.high = max(self.high, b)
+            self.cond.notify_all()
+
+    def finish(self) -> None:
+        with self.cond:
+            self.done = True
+            self.cond.notify_all()
+
+    def wait_past(self, b: int, slice_timeout: float) -> bool:
+        """True once batch ``b`` landed or the owner finished/died;
+        False when the owner made NO progress for one whole timeout
+        slice — progress-based, so a steadily-landing owner is waited
+        on indefinitely while a consumer-paced stall (the owner's own
+        training loop throttling its stream) is detected within one
+        slice instead of one long fixed timeout per batch."""
+        while True:
+            with self.cond:
+                seen = self.high
+                if self.cond.wait_for(
+                        lambda: self.high >= b or self.done,
+                        timeout=slice_timeout):
+                    return True
+                if self.high == seen:
+                    return False  # a full slice with zero progress
+
+
+class _HostCache:
+    """Process-wide shared cache + per-epoch owner registry."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self._cache: Optional[Any] = None
+        self.inflight: Dict[Tuple, _EpochProgress] = {}
+
+    def cache(self):
+        with self.lock:
+            if self._cache is None:
+                from harmony_tpu.inputsvc.cache import BatchCache
+
+                self._cache = BatchCache(client_cache_budget())
+            return self._cache
+
+    def claim(self, key: Tuple) -> Tuple[_EpochProgress, bool]:
+        """(progress, is_owner) for one (provider_key, epoch)."""
+        with self.lock:
+            prog = self.inflight.get(key)
+            if prog is None or prog.done:
+                prog = self.inflight[key] = _EpochProgress()
+                return prog, True
+            return prog, False
+
+    def release(self, key: Tuple, prog: _EpochProgress) -> None:
+        prog.finish()
+        with self.lock:
+            if self.inflight.get(key) is prog:
+                del self.inflight[key]
+
+
+_host_cache = _HostCache()
+
+
+def host_cache():
+    """The process-wide trainer-host batch cache (tests/ops surface)."""
+    return _host_cache.cache()
+
+
+def fetch_stats(addr: Tuple[str, int],
+                timeout: float = 10.0) -> Dict[str, Any]:
+    """One service stats snapshot over the wire (bench/ops tooling)."""
+    with protocol.connect(addr, timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        protocol.send_msg(sock, {"op": "stats"})
+        frame = protocol.recv_frame(sock)
+    if not frame or frame.get("op") != "stats":
+        raise InputServiceError(f"bad stats reply from {addr}: {frame}")
+    return frame["stats"]
+
+
+# -- trainer feed ---------------------------------------------------------
+
+class TrainerInputFeed:
+    """One worker's service-backed epoch source, with in-process
+    fallback. Constructed by the job entity when the job opts in and its
+    dataset has a wire-safe identity; consumed by the worker's prefetch
+    pipeline (one ``epoch_iter`` per epoch, batches in order)."""
+
+    def __init__(
+        self,
+        spec: DatasetSpec,
+        provider: Any,
+        *,
+        tenant: str = "",
+        endpoint: Optional[Tuple[str, int]] = None,
+        policy: Optional[RetryPolicy] = None,
+    ) -> None:
+        self.spec = spec
+        self.provider = provider
+        self.tenant = tenant
+        self._endpoint = endpoint
+        self._policy = policy
+        self._lock = threading.Lock()
+        # counters read by the worker's per-epoch metrics emit while the
+        # producer thread advances them. CONSUMED batches split by
+        # origin (service_batches/shared_batches/local_batches);
+        # wire_batches counts PUMP receipts, which land in the host
+        # cache and are consumed later as shared — counting them as
+        # consumption would double-book every pumped epoch
+        self.service_batches = 0    # consumed directly off a wire stream
+        self.shared_batches = 0     # consumed from the trainer-host cache
+        self.local_batches = 0      # consumed from in-process fallback
+        self.wire_batches = 0       # pump wire receipts (landed, not consumed)
+        self.pump_local_batches = 0  # pump FALLBACK landings (local work;
+        #                              consumed later as shared — the worker
+        #                              metric subtracts them so an outage
+        #                              epoch never reports as service-served)
+        self.fallbacks = 0          # service give-up events
+        self.sibling_timeouts = 0   # gave up waiting on a fetch owner
+        # per-EPOCH attribution for the worker's InputPipelineMetrics:
+        # cumulative-total deltas misattribute across epochs when a
+        # pre-spawned next-epoch pump lands batches before the current
+        # epoch's metrics emit (an outage epoch could read as
+        # service-fed). Bounded: consumed by epoch_stats(), capped.
+        self._epoch_counts: Dict[int, Dict[str, int]] = {}
+        self._fallback_counter = None
+        try:
+            from harmony_tpu.metrics.registry import get_registry
+
+            self._fallback_counter = get_registry().counter(
+                "harmony_inputsvc_fallback_total",
+                "Epochs degraded from the input service to in-process "
+                "assembly, by reason",
+                ("reason",),
+            )
+        except Exception:
+            pass  # metrics are an observer, never a dependency
+
+    def endpoint(self) -> Optional[Tuple[str, int]]:
+        return self._endpoint or default_endpoint()
+
+    _EPOCH_COUNTS_CAP = 64
+
+    def _note_fallback(self, reason: str,
+                       epoch: Optional[int] = None) -> None:
+        with self._lock:
+            self.fallbacks += 1
+            if epoch is not None:
+                self._epoch_count_locked(epoch)["fallbacks"] += 1
+        if self._fallback_counter is not None:
+            try:
+                self._fallback_counter.labels(reason=reason).inc()
+            except Exception:
+                pass
+
+    def _epoch_count_locked(self, epoch: int) -> Dict[str, int]:
+        ec = self._epoch_counts.get(epoch)
+        if ec is None:
+            ec = self._epoch_counts[epoch] = {
+                "service": 0, "shared": 0, "local": 0, "pump_local": 0,
+                "fallbacks": 0,
+            }
+            while len(self._epoch_counts) > self._EPOCH_COUNTS_CAP:
+                self._epoch_counts.pop(next(iter(self._epoch_counts)))
+        return ec
+
+    def _bump_epoch(self, epoch: int, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._epoch_count_locked(epoch)[field] += n
+
+    def epoch_stats(self, epoch: int) -> Dict[str, int]:
+        """Per-epoch consumption attribution, POPPED on read (the
+        worker emits each epoch once). ``service`` counts consumed
+        batches that genuinely came off the service — shared host-cache
+        reads minus the pump's local-fallback landings (which flow
+        through the same cache but were assembled in-process), plus
+        direct wire consumption."""
+        with self._lock:
+            ec = self._epoch_counts.pop(epoch, None)
+        if ec is None:
+            return {"service": 0, "fallbacks": 0}
+        return {
+            "service": max(0, ec["shared"] - ec["pump_local"])
+            + ec["service"],
+            "fallbacks": ec["fallbacks"],
+        }
+
+    #: progress-slice for waiting on a fetch owner: an owner that lands
+    #: nothing for one whole slice is consumer-paced (e.g. its ring is
+    #: full behind a fused multi-epoch drain) — the sibling self-serves
+    #: instead of lockstepping to it; duplicated wire beats a stall
+    SIBLING_WAIT = 0.5
+
+    def _bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def _stream(self, epoch: int, start: int, cache,
+                progress: Optional[_EpochProgress],
+                consumed: bool = True) -> Iterator[Tuple]:
+        """Fetch batches ``start..nb-1`` (service first, local fallback),
+        landing each in the trainer-host cache — and, when this feed owns
+        the epoch, signalling progress so sibling tenants consume from
+        the cache instead of the wire. ``consumed=False`` is the pump:
+        its yields are discarded, so receipts count into wire_batches
+        instead of the consumption counters."""
+        def land(idx: int, batch: Tuple) -> None:
+            ok = cache.put(self.spec.cache_key(epoch, idx), batch)
+            # advance ONLY when the batch actually landed: signalling a
+            # rejected put (batch bigger than the cache budget) would
+            # make waiters see progress, re-read a guaranteed miss, and
+            # spin forever instead of taking the self-serve branch
+            if ok and progress is not None:
+                progress.advance(idx)
+
+        nxt = start
+        addr = self.endpoint()
+        if addr is None:
+            self._note_fallback("no_endpoint", epoch)
+        else:
+            try:
+                for idx, batch in fetch_epoch(
+                    addr, self.spec, epoch,
+                    tenant=self.tenant, policy=self._policy, start=start,
+                ):
+                    if consumed:
+                        self._bump("service_batches")
+                        self._bump_epoch(epoch, "service")
+                    else:
+                        self._bump("wire_batches")
+                    land(idx, batch)
+                    yield batch
+                    nxt = idx + 1
+                return
+            except InputServiceError:
+                self._note_fallback("fetch_giveup", epoch)
+        for idx, batch in enumerate(self.provider.epoch_batches_at(epoch)):
+            if idx < nxt:
+                continue
+            if consumed:
+                self._bump("local_batches")
+                self._bump_epoch(epoch, "local")
+            else:
+                self._bump("pump_local_batches")
+                self._bump_epoch(epoch, "pump_local")
+            land(idx, batch)
+            yield batch
+
+    def _start_pump(self, epoch: int, start: int, cache,
+                    progress: _EpochProgress, ek: Tuple) -> None:
+        """Drain the epoch's stream into the trainer-host cache on a
+        dedicated thread, at WIRE speed. The first design had the owner
+        fetch lazily through its own consuming generator — which paced
+        the whole epoch (and every waiting sibling) by the owner's
+        device_put/step cadence, one batch per training step. The pump
+        decouples them: batches land as fast as the service sends, and
+        owner + siblings all consume from the cache symmetrically."""
+
+        def pump() -> None:
+            try:
+                for _ in self._stream(epoch, start, cache, progress,
+                                      consumed=False):
+                    pass
+            except BaseException:  # noqa: BLE001 - consumers self-serve
+                pass
+            finally:
+                _host_cache.release(ek, progress)
+
+        threading.Thread(
+            target=pump, name=f"inputsvc-pump-{self.tenant}-e{epoch}",
+            daemon=True,
+        ).start()
+
+    def epoch_iter(self, epoch: int) -> Iterator[Tuple]:
+        """Host batch tuples of one epoch, in batch order. Batches come
+        from the trainer-host cache (landed by whichever feed won the
+        epoch's pump election — possibly this one), with local assembly
+        as the terminal fallback (resuming at the first unserved batch:
+        the permutation is a pure function of (seed, epoch), so the
+        splice is seamless). Yielded arrays may be SHARED with sibling
+        tenants — read-only by the input-path contract."""
+        nb = self.spec.num_mini_batches
+        cache = _host_cache.cache()
+        ek = (self.spec.provider_key(), epoch)
+        b = 0
+        while b < nb:
+            hit = cache.get(self.spec.cache_key(epoch, b))
+            if hit is not None:
+                self._bump("shared_batches")
+                self._bump_epoch(epoch, "shared")
+                yield hit
+                b += 1
+                continue
+            progress, owner = _host_cache.claim(ek)
+            if owner:
+                self._start_pump(epoch, b, cache, progress, ek)
+            progress.wait_past(b, self.SIBLING_WAIT)
+            hit = cache.get(self.spec.cache_key(epoch, b))
+            if hit is not None:
+                self._bump("shared_batches")
+                self._bump_epoch(epoch, "shared")
+                yield hit
+                b += 1
+                continue
+            # Self-serve the remainder on a private stream. Either the
+            # pump stalled a whole progress slice, or it moved past /
+            # finished WITHOUT batch b being readable — rejected as
+            # un-cacheable, or evicted before we got to it. A pump
+            # never revisits an index, so waiting again (or re-electing
+            # a pump) would spin or re-fetch the whole epoch forever.
+            self._bump("sibling_timeouts")
+            for batch in self._stream(epoch, b, cache, None):
+                yield batch
+                b += 1
+            return
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "service_batches": self.service_batches,
+                "shared_batches": self.shared_batches,
+                "local_batches": self.local_batches,
+                "wire_batches": self.wire_batches,
+                "pump_local_batches": self.pump_local_batches,
+                "fallbacks": self.fallbacks,
+                "sibling_timeouts": self.sibling_timeouts,
+            }

@@ -1,0 +1,150 @@
+"""Input-service wire protocol: framed JSON control + multi-array batches.
+
+Rides the SAME single-write framed-stream discipline as the block-
+migration transport (utils/framing.py, extracted from PR 5's blockmove
+frames): every frame is a 4-byte little-endian header length, a JSON
+header, and zero or more payload buffers submitted in ONE write
+(coalesced small, sendmsg-gathered large); both socket ends set
+TCP_NODELAY.
+
+Two frame kinds, distinguished by the header's ``op``:
+
+  * control — header only (``{"op": "epoch"|"end"|"error"|"stats"|...}``);
+  * batch — ``{"op": "batch", "b": <idx>, "arrays": [{dtype, shape,
+    n}, ...]}`` followed by each array's bytes in order. dtype encoding
+    follows blockmove's rule: ``dtype.str`` (byte order matters) except
+    BY NAME for extension dtypes whose str doesn't round-trip.
+
+The decoder returns batch payloads as numpy arrays over the received
+buffer — zero extra copies after the socket read.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from harmony_tpu.utils.framing import read_exact, send_frame_parts, set_nodelay
+
+__all__ = [
+    "ProtocolError",
+    "connect",
+    "recv_frame",
+    "send_batch",
+    "send_msg",
+]
+
+#: Bound on one frame's JSON header — a frame whose header length field
+#: exceeds this is a desynced/hostile stream, not a big request.
+_MAX_HEADER = 1 << 20
+
+#: Bound on one batch array's payload — a parseable-but-garbage header
+#: claiming petabytes must raise a retryable ProtocolError, not
+#: OOM-kill the trainer inside ``bytearray(n)``.
+_MAX_PAYLOAD = 4 << 30
+
+
+class ProtocolError(OSError):
+    """Framing violation (truncated/desynced stream)."""
+
+
+def connect(addr: Tuple[str, int], timeout: float = 10.0) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout)
+    set_nodelay(sock)
+    return sock
+
+
+def _head(header: Dict[str, Any]) -> bytes:
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack("<I", len(raw)) + raw
+
+
+def send_msg(sock: socket.socket, header: Dict[str, Any]) -> None:
+    """One control frame (header only), one write."""
+    send_frame_parts(sock, _head(header), ())
+
+
+def _array_meta(arr: np.ndarray) -> Tuple[Dict[str, Any], Any]:
+    payload = np.ascontiguousarray(arr)
+    dt = payload.dtype
+    meta = {
+        "dtype": dt.name if dt.kind == "V" else dt.str,
+        "shape": list(payload.shape),
+        "n": int(payload.nbytes),
+    }
+    try:
+        body: Any = memoryview(payload).cast("B")
+    except (TypeError, ValueError):
+        body = payload.tobytes()  # extension dtypes without buffer protocol
+    return meta, body
+
+
+def send_batch(sock: socket.socket, batch_idx: int,
+               arrays: Sequence[np.ndarray]) -> None:
+    """One assembled mini-batch (tuple of arrays) as ONE frame, one
+    write: header + every payload through the shared gather path."""
+    metas = []
+    bodies = []
+    for a in arrays:
+        meta, body = _array_meta(a)
+        metas.append(meta)
+        bodies.append(body)
+    head = _head({"op": "batch", "b": int(batch_idx), "arrays": metas})
+    send_frame_parts(sock, head, bodies)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Next frame as its header dict; batch frames carry the decoded
+    arrays under ``"data"`` (tuple of numpy arrays). None on clean EOF
+    before a header; ProtocolError on truncation mid-frame."""
+    raw = read_exact(sock, 4)
+    if raw is None:
+        return None
+    (hlen,) = struct.unpack("<I", raw)
+    if hlen > _MAX_HEADER:
+        raise ProtocolError(f"oversized frame header ({hlen} bytes)")
+    hraw = read_exact(sock, hlen)
+    if hraw is None:
+        raise ProtocolError("truncated frame header")
+    try:
+        header = json.loads(bytes(hraw))
+    except ValueError as e:
+        raise ProtocolError(f"unparseable frame header: {e}") from e
+    if header.get("op") != "batch":
+        return header
+    data = []
+    for meta in header.get("arrays", ()):
+        try:
+            n = int(meta["n"])
+            dt = np.dtype(meta["dtype"])
+            shape = tuple(int(d) for d in meta["shape"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(
+                f"bad batch {header.get('b')} array header: {e}") from e
+        if not 0 <= n <= _MAX_PAYLOAD:
+            raise ProtocolError(
+                f"batch {header.get('b')} claims a {n}-byte array "
+                "(desynced stream)")
+        expected = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        if n != expected:
+            raise ProtocolError(
+                f"batch {header.get('b')} payload size {n} != "
+                f"{expected} for shape {shape} {dt} (desynced stream)")
+        body = read_exact(sock, n)
+        if body is None:
+            raise ProtocolError(
+                f"truncated batch {header.get('b')} payload")
+        # every decode failure must be ProtocolError (an OSError): the
+        # client's retry-and-fallback only catches OSError, and the
+        # service must never become a liveness dependency
+        try:
+            data.append(np.frombuffer(body, dtype=dt).reshape(shape))
+        except (TypeError, ValueError) as e:
+            raise ProtocolError(
+                f"undecodable batch {header.get('b')} payload: {e}"
+            ) from e
+    header["data"] = tuple(data)
+    return header

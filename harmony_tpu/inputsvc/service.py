@@ -1,0 +1,592 @@
+"""Shared input-data service: disaggregated batch assembly + fair serving.
+
+PR 1's prefetch producer runs inside every trainer process, so N tenants
+on one host redo overlapping batch assembly and fight for the same cores
+— the problem tf.data service solves by moving input work into shared,
+independently scaled workers (PAPERS.md). This module is that service:
+
+  * an :class:`InputService` listens on TCP and serves assembled,
+    shard-ready host batches over the framed-stream protocol
+    (:mod:`harmony_tpu.inputsvc.protocol` — PR 5's single-write frames +
+    TCP_NODELAY). It can run EMBEDDED in the jobserver process (the
+    default the jobserver starts on demand) or STANDALONE via
+    ``python -m harmony_tpu.inputsvc`` / ``harmony-tpu inputsvc``, where
+    trainer processes reach it through ``HARMONY_INPUT_SERVICE_ADDR`` —
+    the disaggregation unit. The standalone process never imports jax;
+  * assembled batches land in the cross-tenant :class:`BatchCache`
+    under the strict key contract of :mod:`harmony_tpu.inputsvc.spec`,
+    so same-dataset/same-transform tenants share ONE assembly instead of
+    duplicating it, while differently-transformed tenants can never read
+    each other's bytes. Concurrent same-epoch requests deduplicate
+    in flight (first requester assembles, the rest wait on its result);
+  * fairness rides the existing :class:`~harmony_tpu.runtime.podunits.
+    PodUnitArbiter`: every tenant's cache-MISS assembly is one granted
+    unit on the tenant's worker slot, so grants are deficit-fair in
+    measured assembly seconds — one tenant's input storm queues behind
+    its own deficit, not in front of everyone else's batches. Cache hits
+    stream without a grant (they cost wire time, not worker time);
+  * "workers" are the arbiter's admission slots: ``workers=N`` allows N
+    concurrent assemblies, each slot serializing its tenants fairly.
+    :class:`InputAutoscaler` closes the elasticity loop — it watches the
+    tenant ledger's input-wait fraction and the straggler report and
+    resizes the slot count between the configured min/max.
+
+Fault sites: ``inputsvc.worker_death`` fires inside a worker slot's
+assembly (the injected analogue of an input-worker process dying
+mid-epoch); the client-side ``inputsvc.fetch`` plus bounded retry and
+the in-process fallback live in :mod:`harmony_tpu.inputsvc.client`.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from harmony_tpu import faults
+from harmony_tpu.inputsvc import protocol
+from harmony_tpu.inputsvc.cache import BatchCache
+from harmony_tpu.inputsvc.spec import DatasetSpec, decode_args
+from harmony_tpu.runtime.podunits import PodUnitArbiter, PodUnitClient
+
+__all__ = ["InputAutoscaler", "InputService"]
+
+
+def workers_from_env() -> int:
+    """HARMONY_INPUT_WORKERS (default 2): initial worker-slot count."""
+    return max(1, int(os.environ.get("HARMONY_INPUT_WORKERS", "2") or 2))
+
+
+def max_workers_from_env() -> int:
+    """HARMONY_INPUT_WORKERS_MAX (default 8): autoscaler ceiling."""
+    return max(1, int(os.environ.get("HARMONY_INPUT_WORKERS_MAX", "8") or 8))
+
+
+def scale_period_from_env() -> float:
+    """HARMONY_INPUT_SCALE_PERIOD (default 10 s): autoscaler cadence."""
+    return max(0.1, float(
+        os.environ.get("HARMONY_INPUT_SCALE_PERIOD", "10") or 10))
+
+
+#: Datasets materialized per service process (LRU): each entry is the
+#: HOST arrays one data_fn call produced — a handful of tenants' worth,
+#: not a general store.
+_DATASET_CAP = 8
+
+#: Bound on waiting for another tenant's in-flight assembly of the same
+#: epoch before assembling independently (its owner may have died).
+_INFLIGHT_WAIT = 120.0
+
+
+class InputService:
+    """One shared input service instance (see module docstring)."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        cache_bytes: Optional[int] = None,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self._host = host
+        self._lock = threading.Lock()
+        self._workers = workers_from_env() if workers is None else max(1, int(workers))
+        self.cache = BatchCache(cache_bytes)
+        # tenants grant through the SAME arbiter the pod leader uses for
+        # dispatch units — deficit-fair in measured grant-to-done seconds
+        self._arbiter = PodUnitArbiter(send_to=lambda pid, msg: None)
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        self._slot_seq = itertools.count()
+        self._providers: Dict[Tuple, Tuple[Any, threading.Lock]] = {}
+        self._datasets: "Dict[str, List[Any]]" = {}
+        self._dataset_order: List[str] = []
+        self._dataset_events: Dict[str, threading.Event] = {}
+        self._inflight_epochs: Dict[Tuple, threading.Event] = {}
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.port: Optional[int] = None
+        # telemetry (lock-guarded; surfaced via stats() -> STATUS)
+        self._requests: Dict[str, int] = {}
+        self._batches_cache = 0
+        self._batches_assembled = 0
+        self._bytes_served = 0
+        self._worker_deaths = 0
+        self._errors = 0
+        self.scale_events: List[Dict[str, Any]] = []
+        self._batch_counter = None
+        try:
+            from harmony_tpu.metrics.registry import get_registry
+
+            self._batch_counter = get_registry().counter(
+                "harmony_inputsvc_batches_total",
+                "Batches served by the input service, by source",
+                ("source",),
+            )
+        except Exception:
+            pass  # metrics are an observer, never a dependency
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, port: int = 0) -> int:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self._host, port))
+        sock.listen(64)
+        with self._lock:
+            self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="inputsvc-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        self._arbiter.poison()  # unblock any tenant still in admission
+
+    @property
+    def address(self) -> Optional[Tuple[str, int]]:
+        return (self._host, self.port) if self.port is not None else None
+
+    # -- elasticity -------------------------------------------------------
+
+    @property
+    def workers(self) -> int:
+        with self._lock:
+            return self._workers
+
+    def set_workers(self, n: int, reason: str = "manual") -> int:
+        """Resize the worker-slot pool (autoscaler / operator). Existing
+        tenants re-slot lazily at their next idle request so no in-flight
+        grant is orphaned; new tenants spread over the new slot count
+        immediately."""
+        n = max(1, int(n))
+        with self._lock:
+            old, self._workers = self._workers, n
+            if n != old:
+                self.scale_events.append({
+                    "t": time.time(), "from": old, "to": n,
+                    "reason": reason,
+                })
+                del self.scale_events[:-64]
+        return n
+
+    # -- tenant registry --------------------------------------------------
+
+    def _tenant(self, tenant: str) -> Dict[str, Any]:
+        """Get/create tenant state; re-slot idle tenants whose slot fell
+        off a shrunk pool. Caller must hold no locks."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            fresh = st is None
+            if not fresh and st["slot"] >= self._workers and not st["inflight"]:
+                fresh = True  # pool shrank under this tenant: re-slot it
+            if fresh:
+                slot = next(self._slot_seq) % self._workers
+                self._arbiter.register_job(
+                    tenant, frozenset({slot}),
+                    inherit_from=tenant if st is not None else None,
+                )
+                prev = st or {}
+                # DONE must report the tenant's SLOT id: the arbiter
+                # tracks outstanding units as the registered proc set,
+                # and a done from any other pid would leave the unit
+                # outstanding forever — wedging every tenant sharing
+                # the slot the moment two of them interleave
+                arb = self._arbiter
+                st = self._tenants[tenant] = {
+                    "slot": slot,
+                    "client": PodUnitClient(
+                        tenant,
+                        wait=arb.local_wait,
+                        done=(lambda jid, seq, _s=slot:
+                              arb.on_done(jid, seq, _s)),
+                    ),
+                    "inflight": 0,
+                    "requests": prev.get("requests", 0),
+                    "batches": prev.get("batches", 0),
+                    "assemble_sec": prev.get("assemble_sec", 0.0),
+                }
+            return st
+
+    @contextlib.contextmanager
+    def _unit_scope(self, tenant: str):
+        """One fair-queue unit around one cache-miss assembly."""
+        st = self._tenant(tenant)
+        with self._lock:
+            st["inflight"] += 1
+            client = st["client"]
+        t0 = time.perf_counter()
+        try:
+            with client.scope():
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                st["inflight"] -= 1
+                st["assemble_sec"] += dt
+
+    # -- dataset / provider materialization -------------------------------
+
+    def _dataset(self, spec: DatasetSpec) -> List[Any]:
+        """Host arrays of the spec's data source (per-process LRU — the
+        worker owns the source, tf.data-service style). Concurrent
+        first requests deduplicate in flight: two tenants on the same
+        dataset with DIFFERENT transforms share no epoch key, so
+        without this the data_fn — often the single most expensive
+        host step — would run once per tenant and each copy would be
+        appended to the eviction order (prematurely evicting live
+        datasets below the cap)."""
+        import numpy as np
+
+        from harmony_tpu.config.base import resolve_symbol
+
+        did = spec.dataset_id
+        while True:
+            with self._lock:
+                hit = self._datasets.get(did)
+                if hit is not None:
+                    # LRU touch: a hot dataset must outlive colder ones
+                    # past the cap (re-materialization is the cost the
+                    # cache exists to avoid)
+                    try:
+                        self._dataset_order.remove(did)
+                    except ValueError:
+                        pass
+                    self._dataset_order.append(did)
+                    return hit
+                ev = self._dataset_events.get(did)
+                owner = ev is None
+                if owner:
+                    ev = self._dataset_events[did] = threading.Event()
+            if not owner:
+                ev.wait(timeout=_INFLIGHT_WAIT)
+                continue  # re-check; a dead owner makes us the next one
+            try:
+                fn = resolve_symbol(spec.data_fn)
+                out = fn(**decode_args(spec.data_args))
+                arrays = [
+                    np.asarray(a)
+                    for a in (out if isinstance(out, (tuple, list))
+                              else (out,))
+                ]
+                with self._lock:
+                    if did not in self._datasets:
+                        self._datasets[did] = arrays
+                        self._dataset_order.append(did)
+                        while len(self._dataset_order) > _DATASET_CAP:
+                            self._datasets.pop(
+                                self._dataset_order.pop(0), None)
+                    return self._datasets[did]
+            finally:
+                with self._lock:
+                    self._dataset_events.pop(did, None)
+                ev.set()
+
+    def _provider(self, spec: DatasetSpec) -> Tuple[Any, threading.Lock]:
+        """The spec's assembly provider + its replay lock (the replay
+        cursor inside ``epoch_permutation`` is stateful)."""
+        pk = spec.provider_key()
+        with self._lock:
+            hit = self._providers.get(pk)
+            if hit is not None:
+                return hit
+        arrays = self._dataset(spec)
+        from harmony_tpu.dolphin.data import TrainingDataProvider
+
+        prov = TrainingDataProvider(
+            [a[spec.lo:spec.hi] for a in arrays],
+            spec.num_mini_batches,
+            shuffle_each_epoch=spec.shuffle,
+            seed=spec.seed,
+        )
+        with self._lock:
+            hit = self._providers.get(pk)
+            if hit is None:
+                hit = self._providers[pk] = (prov, threading.Lock())
+            return hit
+
+    # -- assembly ---------------------------------------------------------
+
+    def _assemble_epoch(self, tenant: str, spec: DatasetSpec,
+                        epoch: int) -> None:
+        """Materialize every batch of (spec, epoch) into the cache —
+        exactly once across concurrent requesters: the first becomes the
+        owner and assembles under its fair-queue unit; the rest wait for
+        its completion event and re-read the cache."""
+        ek = (spec.provider_key(), epoch)
+        with self._lock:
+            ev = self._inflight_epochs.get(ek)
+            owner = ev is None
+            if owner:
+                ev = self._inflight_epochs[ek] = threading.Event()
+        if not owner:
+            ev.wait(timeout=_INFLIGHT_WAIT)
+            return
+        try:
+            with self._unit_scope(tenant):
+                prov, plock = self._provider(spec)
+                st = self._tenant(tenant)
+                if faults.armed():
+                    faults.site("inputsvc.worker_death", tenant=tenant,
+                                epoch=epoch, slot=st["slot"])
+                with plock:
+                    for idx, batch in enumerate(prov.epoch_batches_at(epoch)):
+                        self.cache.put(spec.cache_key(epoch, idx), batch)
+                with self._lock:
+                    self._batches_assembled += spec.num_mini_batches
+        except faults.InjectedFault:
+            with self._lock:
+                self._worker_deaths += 1
+            raise
+        finally:
+            with self._lock:
+                self._inflight_epochs.pop(ek, None)
+            ev.set()
+
+    # -- serving ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        sock = self._sock
+        while True:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="inputsvc-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from harmony_tpu.utils.framing import set_nodelay
+
+        with conn:
+            set_nodelay(conn)
+            while True:
+                try:
+                    msg = protocol.recv_frame(conn)
+                except OSError:
+                    return  # desynced/dead peer: drop the connection
+                if msg is None:
+                    return
+                op = str(msg.get("op"))
+                with self._lock:
+                    self._requests[op] = self._requests.get(op, 0) + 1
+                try:
+                    if op == "epoch":
+                        self._serve_epoch(conn, msg)
+                    elif op == "stats":
+                        protocol.send_msg(
+                            conn, {"op": "stats", "stats": self.stats()})
+                    elif op == "ping":
+                        protocol.send_msg(conn, {"op": "pong"})
+                    else:
+                        protocol.send_msg(
+                            conn,
+                            {"op": "error", "error": f"unknown op {op!r}"})
+                except OSError:
+                    return  # peer went away mid-reply
+                except Exception as e:  # noqa: BLE001 - reported to peer
+                    with self._lock:
+                        self._errors += 1
+                    try:
+                        protocol.send_msg(conn, {
+                            "op": "error",
+                            "error": f"{type(e).__name__}: {e}",
+                        })
+                    except OSError:
+                        return
+
+    def _serve_epoch(self, conn: socket.socket, msg: Dict[str, Any]) -> None:
+        spec = DatasetSpec.from_wire(msg["spec"])
+        epoch = int(msg.get("epoch", 0))
+        start = int(msg.get("start", 0))
+        tenant = str(msg.get("tenant", "?"))
+        st = self._tenant(tenant)
+        with self._lock:
+            st["requests"] += 1
+        nb = spec.num_mini_batches
+        b = start
+        while b < nb:
+            key = spec.cache_key(epoch, b)
+            batch = self.cache.get(key)
+            src = "cache"
+            if batch is None:
+                prov0, _ = self._provider(spec)
+                if (sum(a.nbytes for a in prov0._arrays)
+                        > self.cache.max_bytes):
+                    # the whole epoch cannot fit: a cache-fill assembly
+                    # would self-evict and force a SECOND full assembly
+                    # on the direct path — go straight there
+                    batch = None
+                else:
+                    self._assemble_epoch(tenant, spec, epoch)
+                    batch = self.cache.get(key)
+                src = "assembled"
+                if batch is None:
+                    # the whole epoch outruns the cache budget (or a
+                    # concurrent flood evicted it before we re-read):
+                    # assemble THIS tenant's remainder directly, outside
+                    # the cache, so undersized budgets degrade to
+                    # per-tenant work instead of a livelock. Assembly
+                    # happens under the fair-queue unit; the SENDS do
+                    # not — the socket is paced by the tenant's own
+                    # consumer, and a unit (or the provider replay lock)
+                    # held across a consumer-paced send would serialize
+                    # every other tenant of the slot behind the slowest
+                    # reader
+                    prov, plock = self._provider(spec)
+                    with self._unit_scope(tenant):
+                        with plock:
+                            rest = [
+                                direct for idx, direct in enumerate(
+                                    prov.epoch_batches_at(epoch))
+                                if idx >= b
+                            ]
+                    for off, direct in enumerate(rest):
+                        protocol.send_batch(conn, b + off, direct)
+                        self._count_batch(st, direct, "assembled")
+                    b = nb
+                    break
+            protocol.send_batch(conn, b, batch)
+            self._count_batch(st, batch, src)
+            b += 1
+        protocol.send_msg(conn, {"op": "end", "epoch": epoch})
+
+    def _count_batch(self, st: Dict[str, Any], batch, source: str) -> None:
+        nbytes = sum(int(a.nbytes) for a in batch)
+        with self._lock:
+            st["batches"] += 1
+            self._bytes_served += nbytes
+            if source == "cache":
+                self._batches_cache += 1
+        if self._batch_counter is not None:
+            try:
+                self._batch_counter.labels(source=source).inc()
+            except Exception:
+                pass
+
+    # -- observability ----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            tenants = {
+                t: {
+                    "slot": st["slot"],
+                    "requests": st["requests"],
+                    "batches": st["batches"],
+                    "assemble_sec": round(st["assemble_sec"], 6),
+                }
+                for t, st in self._tenants.items()
+            }
+            out = {
+                "port": self.port,
+                "workers": self._workers,
+                "requests": dict(self._requests),
+                "batches_from_cache": self._batches_cache,
+                "batches_assembled": self._batches_assembled,
+                "bytes_served": self._bytes_served,
+                "worker_deaths": self._worker_deaths,
+                "errors": self._errors,
+                "tenants": tenants,
+                "scale_events": list(self.scale_events),
+            }
+        out["cache"] = self.cache.stats()
+        return out
+
+
+class InputAutoscaler:
+    """Feedback loop scaling the service's worker slots from the tenant
+    ledger's input-wait fraction and the straggler report.
+
+    ``wait_frac_fn`` returns the mean input-wait fraction across live
+    tenants (None when unknown); ``straggler_fn`` the worst
+    slowest/median step-time ratio (None when unknown). Scale UP when
+    tenants demonstrably wait on input (wait fraction above
+    ``up_frac``, or moderately waiting while a straggler ratio says one
+    worker lags its peers); scale DOWN when input wait is negligible.
+    One step per tick — input supply should ramp, not slosh."""
+
+    UP_FRAC = 0.10
+    DOWN_FRAC = 0.02
+    STRAGGLER_RATIO = 1.5
+
+    def __init__(
+        self,
+        service: InputService,
+        wait_frac_fn: Callable[[], Optional[float]],
+        straggler_fn: Optional[Callable[[], Optional[float]]] = None,
+        min_workers: Optional[int] = None,
+        max_workers: Optional[int] = None,
+        period: Optional[float] = None,
+    ) -> None:
+        self.service = service
+        self._wait_frac_fn = wait_frac_fn
+        self._straggler_fn = straggler_fn
+        self.min_workers = (workers_from_env()
+                            if min_workers is None else max(1, int(min_workers)))
+        self.max_workers = (max_workers_from_env()
+                            if max_workers is None else max(1, int(max_workers)))
+        self.period = scale_period_from_env() if period is None else period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> Optional[Dict[str, Any]]:
+        """One scaling decision; returns the scale event or None."""
+        try:
+            frac = self._wait_frac_fn()
+        except Exception:
+            frac = None
+        ratio = None
+        if self._straggler_fn is not None:
+            try:
+                ratio = self._straggler_fn()
+            except Exception:
+                ratio = None
+        w = self.service.workers
+        if frac is not None and w < self.max_workers and (
+            frac > self.UP_FRAC
+            or (frac > self.DOWN_FRAC and ratio is not None
+                and ratio > self.STRAGGLER_RATIO)
+        ):
+            self.service.set_workers(
+                w + 1, reason=f"input_wait={frac:.3f}")
+            return self.service.scale_events[-1]
+        if frac is not None and frac < self.DOWN_FRAC and w > self.min_workers:
+            self.service.set_workers(
+                w - 1, reason=f"input_wait={frac:.3f}")
+            return self.service.scale_events[-1]
+        return None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.period):
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=loop, name="inputsvc-autoscale", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,180 @@
+"""Dataset identity + the cross-tenant batch-cache key contract.
+
+The input service deduplicates host input work ACROSS tenants, so its
+cache key must capture everything that can change a batch's bytes — and
+nothing a tenant could vary to read another tenant's differently-
+transformed data. The key is the 5-tuple
+
+    (dataset_id, transform_fingerprint, sharding, epoch_seed, batch)
+
+  * ``dataset_id`` — identity of the data SOURCE: the generator dotted
+    path plus its canonicalized (type-tagged) arguments. Two jobs with
+    the same ``(data_fn, data_args)`` are defined to see the same
+    dataset (the jobserver's host-data cache already relies on this);
+  * ``transform_fingerprint`` — identity of the TRANSFORM pipeline
+    applied on top of the source: today the epoch shuffle (on/off + its
+    seed) and the equal-split trim, versioned so a future transform
+    change invalidates rather than aliases old entries;
+  * ``sharding`` — how the dataset shards into worker slices and
+    mini-batches: ``(lo, hi, num_mini_batches)``. Two workers of one
+    job, or two jobs splitting the same dataset differently, never
+    collide;
+  * ``epoch_seed`` — the realized per-epoch randomness: ``(seed,
+    epoch)`` names one epoch's permutation draw;
+  * ``batch`` — the mini-batch index within the epoch.
+
+Isolation is structural: every field that feeds batch assembly is IN
+the key (tests/test_inputsvc.py holds two same-dataset tenants with
+different transforms to zero shared entries), and the id/fingerprint
+halves are SHA-256 over canonical encodings — a tenant cannot craft
+args that collide with another tenant's key short of breaking the hash.
+
+Type tagging mirrors ``JobEntity._data_source_key``: ``True == 1 ==
+1.0`` in Python, but a ``data_fn`` can behave differently per type, so
+the canonical form carries the type name beside the value.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Tuple
+
+#: Bump when batch-assembly semantics change (trim rule, permutation
+#: derivation, wire dtype policy): old cache entries must invalidate,
+#: never alias.
+TRANSFORM_VERSION = 1
+
+
+def canonical(value: Any) -> Any:
+    """Type-tagged, JSON-ready canonical form of a data_args value.
+    Dicts sort by key; raises TypeError for values that cannot cross the
+    wire (callers treat that as 'this job cannot use the service')."""
+    if isinstance(value, bool) or value is None:
+        return [type(value).__name__, value]
+    if isinstance(value, (int, float, str)):
+        return [type(value).__name__, value]
+    if isinstance(value, (list, tuple)):
+        return [type(value).__name__, [canonical(v) for v in value]]
+    if isinstance(value, dict):
+        # keys must be REAL strings: coercing (str(1) == str("1")) would
+        # collide two different argument dicts into one dataset_id AND
+        # make decode_args hand the data_fn different kwargs than the
+        # tenant's local assembly used — both contract violations. A
+        # non-str-keyed dict simply has no wire identity (callers fall
+        # back to in-process assembly).
+        for k in value:
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"data_args dict key {k!r} is not a string — no "
+                    "wire-canonical identity")
+        items = sorted(value.items())
+        return ["dict", [[k, canonical(v)] for k, v in items]]
+    raise TypeError(f"data_args value {value!r} is not wire-canonical")
+
+
+def _uncanonical(tagged: Any) -> Any:
+    """Inverse of :func:`canonical` — reconstruct the typed value."""
+    tag, value = tagged
+    if tag == "dict":
+        return {k: _uncanonical(v) for k, v in value}
+    if tag in ("list", "tuple"):
+        seq = [_uncanonical(v) for v in value]
+        return tuple(seq) if tag == "tuple" else seq
+    if tag == "NoneType":
+        return None
+    if tag == "bool":
+        return bool(value)
+    if tag == "int":
+        return int(value)
+    if tag == "float":
+        return float(value)
+    if tag == "str":
+        return str(value)
+    raise TypeError(f"unknown canonical tag {tag!r}")
+
+
+def decode_args(data_args: str) -> Dict[str, Any]:
+    """The kwargs dict a spec's canonical ``data_args`` JSON encodes —
+    what an input worker passes back to the resolved ``data_fn``."""
+    return _uncanonical(json.loads(data_args))
+
+
+def _digest(obj: Any) -> str:
+    raw = json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(raw).hexdigest()[:20]
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    """Everything an input worker needs to assemble one tenant slice's
+    batches — and nothing else (no tenant identity: the whole point is
+    that same-spec tenants share the work)."""
+
+    data_fn: str            # dotted path of the dataset generator
+    data_args: str          # canonical JSON of its kwargs (see canonical)
+    lo: int                 # worker slice [lo, hi) of the dataset rows
+    hi: int
+    num_mini_batches: int
+    shuffle: bool
+    seed: int
+
+    @classmethod
+    def build(cls, data_fn: str, data_args: Dict[str, Any], lo: int,
+              hi: int, num_mini_batches: int, shuffle: bool,
+              seed: int) -> "DatasetSpec":
+        """Canonicalize ``data_args`` (raises TypeError when they cannot
+        cross the wire)."""
+        canon = canonical(dict(data_args))
+        return cls(
+            data_fn=str(data_fn),
+            data_args=json.dumps(canon, sort_keys=True,
+                                 separators=(",", ":")),
+            lo=int(lo), hi=int(hi),
+            num_mini_batches=int(num_mini_batches),
+            shuffle=bool(shuffle), seed=int(seed),
+        )
+
+    # -- key components ---------------------------------------------------
+
+    @property
+    def dataset_id(self) -> str:
+        return _digest([self.data_fn, self.data_args])
+
+    @property
+    def transform_fingerprint(self) -> str:
+        return _digest([TRANSFORM_VERSION, self.shuffle, self.seed])
+
+    @property
+    def sharding(self) -> Tuple[int, int, int]:
+        return (self.lo, self.hi, self.num_mini_batches)
+
+    def provider_key(self) -> Tuple:
+        """Identity of the assembled STREAM (everything but epoch/batch)
+        — the service memoizes one provider replay state per value."""
+        return (self.dataset_id, self.transform_fingerprint, self.sharding)
+
+    def cache_key(self, epoch: int, batch: int) -> Tuple:
+        """The full cross-tenant cache key for one mini-batch."""
+        return (self.dataset_id, self.transform_fingerprint, self.sharding,
+                (self.seed, int(epoch)), int(batch))
+
+    # -- wire form --------------------------------------------------------
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "data_fn": self.data_fn, "data_args": self.data_args,
+            "lo": self.lo, "hi": self.hi,
+            "num_mini_batches": self.num_mini_batches,
+            "shuffle": self.shuffle, "seed": self.seed,
+        }
+
+    @classmethod
+    def from_wire(cls, wire: Dict[str, Any]) -> "DatasetSpec":
+        return cls(
+            data_fn=str(wire["data_fn"]),
+            data_args=str(wire["data_args"]),
+            lo=int(wire["lo"]), hi=int(wire["hi"]),
+            num_mini_batches=int(wire["num_mini_batches"]),
+            shuffle=bool(wire["shuffle"]), seed=int(wire["seed"]),
+        )

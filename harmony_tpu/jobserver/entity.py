@@ -173,6 +173,36 @@ class DolphinJobEntity(JobEntity):
             _HOST_DATA_CACHE.put(key, arrays)
         return arrays
 
+    def _make_input_feed(self, provider, lo: int, hi: int, nb: int):
+        """Input-service feed for one worker's slice — or None, which
+        keeps in-process assembly. None whenever the job did not opt in
+        (``TrainerParams.input_service`` / HARMONY_INPUT_SERVICE), the
+        dataset identity cannot cross the wire, or no service endpoint
+        is known (embedded service not running and no
+        HARMONY_INPUT_SERVICE_ADDR) — the service is an optimization,
+        never a dependency."""
+        from harmony_tpu import inputsvc
+
+        if not inputsvc.enabled_for(self.config.params):
+            return None
+        user = self.config.user
+        if "data_fn" not in user:
+            return None
+        if inputsvc.default_endpoint() is None:
+            return None
+        try:
+            spec = inputsvc.DatasetSpec.build(
+                user["data_fn"], user.get("data_args", {}),
+                lo=lo, hi=hi, num_mini_batches=nb,
+                shuffle=provider.is_shuffling,
+                seed=provider.seed,
+            )
+        except TypeError:
+            return None  # non-canonical data_args: no wire identity
+        return inputsvc.TrainerInputFeed(
+            spec, provider, tenant=self.config.job_id,
+        )
+
     def setup(self, master: ETMaster, executor_ids: List[str]) -> None:
         # Table creation dispatches multi-device init programs — under
         # cross-job pod tenancy that region must hold a dispatch unit like
@@ -634,6 +664,7 @@ class DolphinJobEntity(JobEntity):
                         None if src is None else (src, sl.start, hi, nb)
                     ),
                 )
+                input_feed = self._make_input_feed(data, sl.start, hi, nb)
                 ctx = TrainerContext(
                     params=params,
                     model_table=self._handle.table,
@@ -687,6 +718,7 @@ class DolphinJobEntity(JobEntity):
                     defer_epoch_callback=(params.model_chkp_period <= 0),
                     trace_parent=trace_parent,
                     attempt=attempt,
+                    input_feed=input_feed,
                 )
                 self._workers.append(worker)
                 results[wid] = worker.run()

@@ -121,6 +121,12 @@ class JobServer:
         self._tcp_thread: Optional[threading.Thread] = None
         self._tcp_sock: Optional[socket.socket] = None
         self.port: Optional[int] = None
+        # Embedded input-data service (harmony_tpu/inputsvc): started on
+        # demand when the first opted-in job arrives — scheduled and
+        # owned by the jobserver like any other tenant resource, scaled
+        # by the ledger-fed autoscaler, surfaced via STATUS.
+        self.input_service = None
+        self._input_autoscaler = None
 
     def _on_metric(self, record) -> None:
         """Every job metric lands in the manager AND (when configured)
@@ -229,6 +235,7 @@ class JobServer:
             if self.metrics_exporter is not None:
                 self.metrics_exporter.stop()
                 self.metrics_exporter = None
+            self._stop_input_service()
             self._state.transition("CLOSED")
 
     def _on_closing(self, timeout: Optional[float]) -> None:
@@ -314,6 +321,12 @@ class JobServer:
             wire = wire_context()
             if wire is not None:
                 config.user["_trace"] = wire
+        from harmony_tpu import inputsvc
+
+        if inputsvc.enabled_for(config.params):
+            # before scheduling: the workers resolve the endpoint at
+            # dispatch time, so the service must exist by then
+            self._ensure_input_service()
         with self._lock:
             # State checked under the registry lock: shutdown's INIT->CLOSING
             # flip holds the same lock, so a submit can't interleave between
@@ -419,6 +432,55 @@ class JobServer:
         its plan channel for multi-process grants here)."""
         return {}
 
+    def _ensure_input_service(self) -> None:
+        """Start the embedded input service + its autoscaler once. A
+        configured HARMONY_INPUT_SERVICE_ADDR means a standalone service
+        process owns the role — workers will use it directly and the
+        jobserver starts nothing."""
+        import os
+
+        from harmony_tpu import inputsvc
+
+        if os.environ.get("HARMONY_INPUT_SERVICE_ADDR"):
+            return
+        with self._lock:
+            if self.input_service is not None:
+                return
+            svc = inputsvc.InputService()
+            port = svc.start()
+            inputsvc.set_default_endpoint(("127.0.0.1", port))
+            metrics = self.metrics
+
+            def wait_frac() -> "float | None":
+                rows = metrics.tenant_ledger()
+                fr = [r.get("input_wait_frac") for r in rows.values()
+                      if r.get("input_wait_frac") is not None]
+                return sum(fr) / len(fr) if fr else None
+
+            def straggler() -> "float | None":
+                reps = metrics.straggler_report()
+                ratios = [r["ratio"] for r in reps.values()]
+                return max(ratios) if ratios else None
+
+            scaler = inputsvc.InputAutoscaler(svc, wait_frac, straggler)
+            scaler.start()
+            self.input_service = svc
+            self._input_autoscaler = scaler
+        server_log.info("input service up on port %d (%d workers)",
+                        port, svc.workers)
+
+    def _stop_input_service(self) -> None:
+        with self._lock:
+            svc, self.input_service = self.input_service, None
+            scaler, self._input_autoscaler = self._input_autoscaler, None
+        if scaler is not None:
+            scaler.stop()
+        if svc is not None:
+            from harmony_tpu import inputsvc
+
+            inputsvc.set_default_endpoint(None)
+            svc.stop()
+
     def running_jobs(self) -> List[str]:
         with self._lock:
             return [j for j, r in self._jobs.items() if not r.future.done()]
@@ -451,6 +513,11 @@ class JobServer:
             "flight_records": flight.get_recorder().records(),
             "metrics_port": (self.metrics_exporter.port
                              if self.metrics_exporter is not None else None),
+            # disaggregated input service (harmony_tpu/inputsvc): port,
+            # worker slots, per-tenant queue traffic, cache hit/byte
+            # stats and autoscaler events — None when not running
+            "input_service": (self.input_service.stats()
+                              if self.input_service is not None else None),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------

@@ -74,6 +74,14 @@ class InputPipelineMetrics(ConfigBase):
     stage_sec: float = 0.0
     producer_idle_sec: float = 0.0
     consumer_stall_sec: float = 0.0
+    # staged device copies dropped before use (reshard invalidation /
+    # host-only demotion) — paid H2D transfers thrown away
+    dropped_batches: int = 0
+    # input-service integration (harmony_tpu/inputsvc): batches this
+    # epoch that came off the service vs assembled locally after a
+    # service give-up (fallbacks counts give-up EVENTS, not batches)
+    service_batches: int = 0
+    service_fallbacks: int = 0
 
 
 @config

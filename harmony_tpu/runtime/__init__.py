@@ -1,3 +1,19 @@
-from harmony_tpu.runtime.master import ETMaster, Executor, TableHandle
+"""Runtime layer. Exports resolve lazily (PEP 562): ``runtime.master``
+pulls in jax, but ``runtime.podunits`` is pure stdlib and is imported by
+the jax-free standalone input-worker process (harmony_tpu/inputsvc)."""
+from typing import TYPE_CHECKING
 
 __all__ = ["ETMaster", "Executor", "TableHandle"]
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from harmony_tpu.runtime.master import ETMaster, Executor, TableHandle
+
+
+def __getattr__(name: str):
+    if name not in __all__:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module("harmony_tpu.runtime.master"), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value

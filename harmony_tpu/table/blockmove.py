@@ -56,6 +56,7 @@ from jax.sharding import Mesh, NamedSharding
 from harmony_tpu import faults
 from harmony_tpu.faults.retry import InfraTransientError, RetryError, call_with_retry
 from harmony_tpu.tracing.span import trace_span
+from harmony_tpu.utils import framing as _framing
 
 # Lockstep per-process counter (see module doc) naming each migration's
 # rendezvous keys / staging dir consistently across processes.
@@ -72,10 +73,10 @@ last_move_stats: Dict[str, Any] = {}
 _LEG_RETRIES: List[int] = [0]
 _RETRY_LOCK = threading.Lock()
 
-#: Transport I/O chunk: the receiver's per-recv_into cap AND the
-#: sender's head+body coalesce threshold share it, so both sides agree
-#: on what "small enough to copy once" means.
-_IO_CHUNK = 1 << 20
+#: Transport I/O chunk (shared single-write framing primitives live in
+#: utils/framing.py so the input service reuses the same wire discipline
+#: without importing this jax-bearing module).
+_IO_CHUNK = _framing.IO_CHUNK
 
 #: A leg carrying more than this splits into multiple framed streams
 #: when the worker pool has spare parallelism — one TCP stream rarely
@@ -356,47 +357,20 @@ def _unpack_frame(buf: bytes) -> Tuple[int, np.ndarray]:
 
 
 def _send_frame(sock: socket.socket, block: int, arr: np.ndarray) -> None:
-    """One frame, ONE write: two back-to-back sendall calls put the tiny
-    length-prefixed header in its own segment, which Nagle holds back
-    waiting for the receiver's ACK of the previous frame's payload —
-    a per-frame RTT stall. Small payloads coalesce into a single buffer
-    (one syscall); large ones go through sendmsg, the writev-style
-    gather that submits header and zero-copy payload together."""
+    """One block frame, one write (utils/framing.py holds the shared
+    single-write coalesce/sendmsg discipline)."""
     head, body = _frame_parts(block, arr)
-    body_mv = body if isinstance(body, memoryview) else memoryview(body)
-    if len(body_mv) <= _IO_CHUNK:
-        sock.sendall(b"".join((head, body_mv)))  # ONE copy, one syscall
-        return
-    try:
-        sent = sock.sendmsg([head, body_mv])
-    except AttributeError:  # pragma: no cover - platforms without sendmsg
-        sock.sendall(head)
-        sock.sendall(body_mv)
-        return
-    # sendmsg may stop short (socket buffer full): finish the remainder
-    # with sendall, which loops internally
-    if sent < len(head):
-        sock.sendall(head[sent:])
-        sock.sendall(body_mv)
-    elif sent < len(head) + len(body_mv):
-        sock.sendall(body_mv[sent - len(head):])
+    _framing.send_frame_parts(sock, head, (body,))
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
     """Exactly ``n`` bytes into ONE preallocated buffer via recv_into —
     the old ``bytearray += recv()`` loop copied every chunk twice (recv
-    allocation + extend) and once more for the final bytes(). Returns
-    the buffer itself (callers frombuffer/parse it in place), or None
-    on EOF before the read completes (same contract as before)."""
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:got + min(_IO_CHUNK, n - got)])
-        if r == 0:
-            return None
-        got += r
-    return buf
+    allocation + extend) and once more for the final bytes(). Kept as a
+    thin local name over utils/framing.read_exact (the shared receiver
+    primitive). Returns the buffer itself (callers frombuffer/parse it
+    in place), or None on EOF before the read completes."""
+    return _framing.read_exact(sock, n)
 
 
 class _TcpReceiver:

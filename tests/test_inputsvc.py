@@ -1,0 +1,754 @@
+"""Disaggregated input-data service tests (harmony_tpu/inputsvc).
+
+Covers the PR-10 contracts:
+  * cache-KEY ISOLATION — two tenants on the same dataset with
+    different transforms can never share an entry, and a cache hit is
+    byte-identical to local assembly;
+  * fixed-seed LOSS PARITY, service on vs off, for MLR and NMF
+    (shuffling providers — the service replays the exact epoch
+    permutation the local provider draws);
+  * FAULT behavior — ``inputsvc.worker_death`` mid-assembly and
+    ``inputsvc.fetch`` client failures retry under the bounded policy
+    and degrade to in-process assembly with unchanged losses;
+  * the wire protocol, the bytes-bounded LRU cache, the trainer-host
+    shared cache, the deferred provider, fairness bookkeeping, the
+    autoscaler, and the jobserver's embedded-service surface.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import faults, inputsvc
+from harmony_tpu.config.params import JobConfig, RetryPolicy, TrainerParams
+from harmony_tpu.dolphin import (
+    DeferredTrainingDataProvider,
+    TrainerContext,
+    TrainingDataProvider,
+    WorkerTasklet,
+)
+from harmony_tpu.faults import FaultPlan, FaultRule
+from harmony_tpu.inputsvc import (
+    BatchCache,
+    DatasetSpec,
+    InputAutoscaler,
+    InputService,
+    TrainerInputFeed,
+    fetch_epoch,
+    fetch_stats,
+)
+from harmony_tpu.inputsvc.spec import canonical, decode_args
+from harmony_tpu.table import DenseTable, TableSpec
+
+MLR_ARGS = {"n": 96, "num_features": 8, "num_classes": 4, "seed": 7}
+MLR_FN = "harmony_tpu.apps.mlr:make_synthetic"
+
+FAST_RETRY = RetryPolicy(max_attempts=3, base_delay_sec=0.01,
+                         max_delay_sec=0.02, jitter=0.0)
+
+
+def mlr_spec(seed=3, shuffle=True, nb=4, args=MLR_ARGS):
+    return DatasetSpec.build(MLR_FN, args, lo=0, hi=args["n"],
+                             num_mini_batches=nb, shuffle=shuffle,
+                             seed=seed)
+
+
+def mlr_provider(seed=3, shuffle=True, nb=4, args=MLR_ARGS):
+    from harmony_tpu.apps.mlr import make_synthetic
+
+    x, y = make_synthetic(**args)
+    return TrainingDataProvider([x, y], nb, shuffle_each_epoch=shuffle,
+                                seed=seed)
+
+
+@pytest.fixture()
+def service():
+    svc = InputService(workers=2)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def batches_equal(a, b):
+    return (len(a) == len(b)
+            and all(x.dtype == y.dtype and x.shape == y.shape
+                    and (x == y).all() for x, y in zip(a, b)))
+
+
+class TestSpec:
+    def test_canonical_roundtrip_and_type_tagging(self):
+        args = {"n": 1, "f": 1.0, "b": True, "s": "x", "none": None,
+                "lst": [1, (2, 3)], "nested": {"k": 2}}
+        assert decode_args(mlr_spec(args=dict(MLR_ARGS)).data_args) \
+            == MLR_ARGS
+        # True == 1 == 1.0 in Python; the canonical form must not collide
+        assert canonical(True) != canonical(1) != canonical(1.0)
+        spec = DatasetSpec.build("f", args, lo=0, hi=1,
+                                 num_mini_batches=1, shuffle=False, seed=0)
+        out = decode_args(spec.data_args)
+        assert out["b"] is True and out["n"] == 1 and out["f"] == 1.0
+        assert out["lst"] == [1, (2, 3)]
+
+    def test_non_canonical_args_raise(self):
+        with pytest.raises(TypeError):
+            DatasetSpec.build("f", {"arr": np.zeros(2)}, lo=0, hi=2,
+                              num_mini_batches=1, shuffle=False, seed=0)
+
+    def test_non_string_dict_keys_have_no_wire_identity(self):
+        # str(1) == str("1"): coerced keys would collide two different
+        # argument dicts into one dataset_id AND decode different
+        # kwargs than local assembly used — reject instead
+        with pytest.raises(TypeError):
+            DatasetSpec.build("f", {"m": {1: "a"}}, lo=0, hi=2,
+                              num_mini_batches=1, shuffle=False, seed=0)
+
+    def test_key_isolation_components(self):
+        base = mlr_spec(seed=3)
+        # same dataset, different transform seed: same dataset_id,
+        # DIFFERENT fingerprint -> disjoint keys for every batch
+        other = mlr_spec(seed=4)
+        assert other.dataset_id == base.dataset_id
+        assert other.transform_fingerprint != base.transform_fingerprint
+        assert other.cache_key(0, 0) != base.cache_key(0, 0)
+        # different sharding (slice / batch split) never collides
+        resliced = DatasetSpec.build(MLR_FN, MLR_ARGS, lo=0, hi=48,
+                                     num_mini_batches=4, shuffle=True,
+                                     seed=3)
+        assert resliced.cache_key(0, 0) != base.cache_key(0, 0)
+        # different source args -> different dataset_id
+        args2 = dict(MLR_ARGS, seed=8)
+        assert mlr_spec(args=args2).dataset_id != base.dataset_id
+        # wire roundtrip preserves identity
+        assert DatasetSpec.from_wire(base.to_wire()) == base
+
+
+class TestBatchCache:
+    def test_lru_eviction_by_bytes(self):
+        cache = BatchCache(max_bytes=100)
+        a = (np.zeros(10, np.float32),)  # 40 bytes
+        cache.put(("k", 1), a)
+        cache.put(("k", 2), a)
+        cache.get(("k", 1))  # refresh 1
+        cache.put(("k", 3), a)  # 120 bytes -> evict oldest (2)
+        assert cache.get(("k", 2)) is None
+        assert cache.get(("k", 1)) is not None
+        assert cache.evictions == 1
+        assert cache.resident_bytes <= 100
+
+    def test_oversized_entry_rejected(self):
+        cache = BatchCache(max_bytes=10)
+        assert not cache.put(("big",), (np.zeros(100, np.float32),))
+        assert len(cache) == 0
+
+    def test_hit_is_byte_identical(self):
+        cache = BatchCache(max_bytes=1 << 20)
+        rng = np.random.default_rng(0)
+        batch = (rng.normal(size=(4, 3)).astype(np.float32),
+                 rng.integers(0, 5, 4).astype(np.int32))
+        cache.put(("k",), batch)
+        hit = cache.get(("k",))
+        assert batches_equal(hit, batch)
+
+
+class TestProtocol:
+    def test_msg_and_batch_roundtrip(self, service):
+        from harmony_tpu.inputsvc import protocol
+
+        sock = protocol.connect(service.address)
+        try:
+            protocol.send_msg(sock, {"op": "ping"})
+            assert protocol.recv_frame(sock)["op"] == "pong"
+            protocol.send_msg(sock, {"op": "stats"})
+            reply = protocol.recv_frame(sock)
+            assert reply["op"] == "stats" and "cache" in reply["stats"]
+            protocol.send_msg(sock, {"op": "bogus"})
+            assert protocol.recv_frame(sock)["op"] == "error"
+        finally:
+            sock.close()
+
+    def test_batch_frame_preserves_dtype_shape_bytes(self):
+        import socket as socklib
+
+        from harmony_tpu.inputsvc import protocol
+
+        a, b = socklib.socketpair()
+        try:
+            rng = np.random.default_rng(1)
+            arrays = (rng.normal(size=(5, 2)).astype(np.float32),
+                      rng.integers(0, 9, 5).astype(np.int64))
+            protocol.send_batch(a, 7, arrays)
+            frame = protocol.recv_frame(b)
+            assert frame["op"] == "batch" and frame["b"] == 7
+            assert batches_equal(frame["data"], arrays)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestServiceEndToEnd:
+    def test_fetch_byte_identical_to_local_assembly(self, service):
+        spec = mlr_spec(seed=3)
+        local = mlr_provider(seed=3)
+        for epoch in range(2):
+            got = dict(fetch_epoch(service.address, spec, epoch,
+                                   tenant="t0"))
+            for i, exp in enumerate(local.epoch_batches()):
+                assert batches_equal(got[i], exp), (epoch, i)
+
+    def test_cross_tenant_sharing_and_isolation(self, service):
+        spec_a = mlr_spec(seed=3)
+        spec_b = mlr_spec(seed=99)  # same dataset, different transform
+        list(fetch_epoch(service.address, spec_a, 0, tenant="a1"))
+        assembled_once = service.stats()["batches_assembled"]
+        # same-transform tenant: pure cache hits, no new assembly
+        list(fetch_epoch(service.address, spec_a, 0, tenant="a2"))
+        st = service.stats()
+        assert st["batches_assembled"] == assembled_once
+        assert st["batches_from_cache"] >= spec_a.num_mini_batches
+        # differently-transformed tenant: never reads a1's entries —
+        # a fresh assembly happens, and its bytes differ
+        got_b = dict(fetch_epoch(service.address, spec_b, 0, tenant="b1"))
+        assert service.stats()["batches_assembled"] > assembled_once
+        local_b = mlr_provider(seed=99)
+        for i, exp in enumerate(local_b.epoch_batches()):
+            assert batches_equal(got_b[i], exp)
+        local_a = mlr_provider(seed=3)
+        a0 = next(local_a.epoch_batches())
+        assert not batches_equal(got_b[0], a0)
+
+    def test_mid_epoch_resume_start_offset(self, service):
+        spec = mlr_spec(seed=5)
+        got = dict(fetch_epoch(service.address, spec, 0, tenant="r",
+                               start=2))
+        assert sorted(got) == [2, 3]
+        local = mlr_provider(seed=5)
+        for i, exp in enumerate(local.epoch_batches()):
+            if i >= 2:
+                assert batches_equal(got[i], exp)
+
+    def test_stats_over_the_wire(self, service):
+        list(fetch_epoch(service.address, mlr_spec(), 0, tenant="s"))
+        st = fetch_stats(service.address)
+        assert st["batches_assembled"] >= 4
+        assert st["tenants"]["s"]["batches"] == 4
+
+    def test_fairness_units_account_assembly_seconds(self, service):
+        list(fetch_epoch(service.address, mlr_spec(seed=11), 0,
+                         tenant="fair"))
+        st = service.stats()["tenants"]["fair"]
+        assert st["requests"] == 1
+        assert st["assemble_sec"] >= 0.0
+        assert service._arbiter.grants_total >= 1
+
+    def test_undersized_cache_degrades_to_direct_serving(self):
+        svc = InputService(workers=1, cache_bytes=64)  # nothing fits
+        svc.start()
+        try:
+            spec = mlr_spec(seed=13)
+            got = dict(fetch_epoch(svc.address, spec, 0, tenant="d"))
+            local = mlr_provider(seed=13)
+            for i, exp in enumerate(local.epoch_batches()):
+                assert batches_equal(got[i], exp)
+        finally:
+            svc.stop()
+
+
+class TestHostCache:
+    def test_sibling_feeds_share_one_wire_stream(self, service):
+        inputsvc.host_cache().clear()
+        spec = mlr_spec(seed=21)
+        feeds = [TrainerInputFeed(spec, mlr_provider(seed=21),
+                                  tenant=f"hc{i}", endpoint=service.address)
+                 for i in range(2)]
+        out0 = [tuple(np.array(a) for a in b)
+                for b in feeds[0].epoch_iter(0)]
+        out1 = [tuple(np.array(a) for a in b)
+                for b in feeds[1].epoch_iter(0)]
+        for a, b in zip(out0, out1):
+            assert batches_equal(a, b)
+        stats = [f.stats() for f in feeds]
+        # exactly one pump paid the wire for the whole epoch; BOTH
+        # feeds (the pump's owner included) consumed via the shared
+        # host cache
+        assert sum(s["wire_batches"] for s in stats) == 4
+        assert sum(s["shared_batches"] for s in stats) == 8
+        assert sum(s["service_batches"] for s in stats) == 0
+        assert all(s["fallbacks"] == 0 for s in stats)
+
+
+class TestTrainerParity:
+    def _run_worker(self, trainer, arrays, mesh, params, *, seed, feed_spec,
+                    endpoint, shuffle=True, local=False):
+        from harmony_tpu.table import DenseTable, TableSpec
+
+        model = DenseTable(TableSpec(trainer.model_table_config()), mesh)
+        local_t = (DenseTable(TableSpec(trainer.local_table_config()), mesh)
+                   if getattr(trainer, "uses_local_table", False) else None)
+        ctx = TrainerContext(params=params, model_table=model,
+                             local_table=local_t)
+        data = TrainingDataProvider(arrays, params.num_mini_batches,
+                                    shuffle_each_epoch=shuffle, seed=seed)
+        feed = None
+        if endpoint is not None:
+            feed = TrainerInputFeed(feed_spec, data, tenant="parity",
+                                    endpoint=endpoint)
+        w = WorkerTasklet("parity", ctx, trainer, data, mesh,
+                          input_feed=feed)
+        return w.run()["losses"]
+
+    def test_mlr_fixed_seed_losses_service_on_vs_off(self, mesh8, service):
+        from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+
+        args = {"n": 128, "num_features": 8, "num_classes": 4, "seed": 5}
+        x, y = make_synthetic(**args)
+        params = TrainerParams(num_epochs=3, num_mini_batches=4,
+                               comm_probe_period=0)
+        spec = DatasetSpec.build(MLR_FN, args, lo=0, hi=args["n"],
+                                 num_mini_batches=4, shuffle=True, seed=9)
+
+        def one(endpoint):
+            tr = MLRTrainer(num_classes=4, num_features=8,
+                            features_per_partition=2, step_size=0.3)
+            return self._run_worker(tr, [x, y], mesh8, params, seed=9,
+                                    feed_spec=spec, endpoint=endpoint)
+
+        off = one(None)
+        on = one(service.address)
+        assert off == on  # bit-identical
+        assert service.stats()["batches_assembled"] >= 4
+
+    def test_nmf_fixed_seed_losses_service_on_vs_off(self, mesh8, service):
+        from harmony_tpu.apps.nmf import NMFTrainer, make_synthetic
+
+        args = {"num_rows": 64, "num_cols": 16, "rank": 3, "seed": 4}
+        row_idx, xm = make_synthetic(**args)
+        params = TrainerParams(num_epochs=3, num_mini_batches=4,
+                               comm_probe_period=0)
+        spec = DatasetSpec.build(
+            "harmony_tpu.apps.nmf:make_synthetic", args,
+            lo=0, hi=args["num_rows"], num_mini_batches=4,
+            shuffle=True, seed=6,
+        )
+
+        def one(endpoint):
+            tr = NMFTrainer(64, 16, 3, step_size=0.02, seed=4)
+            return self._run_worker(tr, [row_idx, xm], mesh8, params,
+                                    seed=6, feed_spec=spec,
+                                    endpoint=endpoint)
+
+        off = one(None)
+        on = one(service.address)
+        assert off == on  # bit-identical
+
+    def test_service_batches_reach_input_pipeline_metrics(self, mesh8,
+                                                          service):
+        from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+        from harmony_tpu.metrics import MetricCollector, MetricManager
+
+        inputsvc.host_cache().clear()
+        args = {"n": 64, "num_features": 8, "num_classes": 4, "seed": 2}
+        x, y = make_synthetic(**args)
+        params = TrainerParams(num_epochs=2, num_mini_batches=4,
+                               comm_probe_period=0)
+        spec = DatasetSpec.build(MLR_FN, args, lo=0, hi=64,
+                                 num_mini_batches=4, shuffle=True, seed=31)
+        manager = MetricManager()
+        manager.start_collection()
+        data = TrainingDataProvider([x, y], 4, shuffle_each_epoch=True,
+                                    seed=31)
+        feed = TrainerInputFeed(spec, data, tenant="met",
+                                endpoint=service.address)
+        tr = MLRTrainer(num_classes=4, num_features=8,
+                        features_per_partition=2, step_size=0.3)
+        model = DenseTable(TableSpec(tr.model_table_config()), mesh8)
+        w = WorkerTasklet(
+            "met", TrainerContext(params=params, model_table=model), tr,
+            data, mesh8, input_feed=feed,
+            collector=MetricCollector(sink=manager.on_metric,
+                                      job_id="met", worker_id="w0"),
+        )
+        w.run()
+        pipe = manager.input_pipeline_metrics(job_id="met")
+        assert sum(m.service_batches for m in pipe) == 8  # 2 epochs x 4
+        assert sum(m.service_fallbacks for m in pipe) == 0
+
+    def test_epoch_stats_never_credit_outage_epochs(self, service):
+        """Per-epoch attribution: a pump that fell back to local
+        assembly must yield service=0 for ITS epoch even when a healthy
+        epoch's batches land concurrently (the cumulative-delta scheme
+        this replaced inverted the attribution)."""
+        inputsvc.host_cache().clear()
+        spec = mlr_spec(seed=87)
+        feed = TrainerInputFeed(spec, mlr_provider(seed=87), tenant="es",
+                                endpoint=service.address,
+                                policy=FAST_RETRY)
+        list(feed.epoch_iter(0))  # healthy: wire-pumped
+        faults.arm(FaultPlan([FaultRule("inputsvc.fetch", count=-1)]))
+        try:
+            list(feed.epoch_iter(1))  # outage: pump falls back locally
+        finally:
+            faults.disarm()
+        assert feed.epoch_stats(0) == {"service": 4, "fallbacks": 0}
+        assert feed.epoch_stats(1) == {"service": 0, "fallbacks": 1}
+        # popped on read: a second query is empty
+        assert feed.epoch_stats(1) == {"service": 0, "fallbacks": 0}
+
+
+class TestFaults:
+    def test_worker_death_then_in_process_fallback(self, service):
+        """The recovery-matrix row: inputsvc.worker_death on every
+        assembly attempt -> error frames -> bounded client retry ->
+        IN-PROCESS fallback, batches identical to local assembly."""
+        inputsvc.host_cache().clear()
+        spec = mlr_spec(seed=41)
+        feed = TrainerInputFeed(spec, mlr_provider(seed=41), tenant="wd",
+                                endpoint=service.address,
+                                policy=FAST_RETRY)
+        faults.arm(FaultPlan([FaultRule("inputsvc.worker_death",
+                                        count=-1)]))
+        try:
+            got = list(feed.epoch_iter(0))
+        finally:
+            faults.disarm()
+        assert len(got) == 4
+        local = mlr_provider(seed=41)
+        for g, exp in zip(got, local.epoch_batches()):
+            assert batches_equal(g, exp)
+        st = feed.stats()
+        # the PUMP fell back to local assembly (pump_local landings, NOT
+        # wire receipts — an outage epoch must not read as service-fed);
+        # consumption flowed through the host cache
+        assert st["fallbacks"] == 1
+        assert st["shared_batches"] == 4
+        assert st["pump_local_batches"] == 4 and st["wire_batches"] == 0
+        assert service.stats()["worker_deaths"] >= 1
+        counters = faults.all_counters()
+        assert counters.get("inputsvc.fetch.giveups", 0) >= 1
+        # service healthy again: the next epoch rides the wire
+        got1 = list(feed.epoch_iter(1))
+        assert len(got1) == 4 and feed.stats()["wire_batches"] == 4
+        assert feed.stats()["fallbacks"] == 1
+
+    def test_one_worker_death_is_absorbed_by_retry(self, service):
+        """A single injected death costs one retry, not a fallback."""
+        inputsvc.host_cache().clear()
+        spec = mlr_spec(seed=43)
+        feed = TrainerInputFeed(spec, mlr_provider(seed=43), tenant="wd1",
+                                endpoint=service.address,
+                                policy=FAST_RETRY)
+        faults.arm(FaultPlan([FaultRule("inputsvc.worker_death",
+                                        count=1)]))
+        try:
+            got = list(feed.epoch_iter(0))
+        finally:
+            faults.disarm()
+        assert len(got) == 4
+        st = feed.stats()
+        assert st["fallbacks"] == 0
+        assert st["wire_batches"] == 4 and st["shared_batches"] == 4
+
+    def test_client_fetch_fault_falls_back_with_counters(self, service):
+        inputsvc.host_cache().clear()
+        spec = mlr_spec(seed=47)
+        feed = TrainerInputFeed(spec, mlr_provider(seed=47), tenant="cf",
+                                endpoint=service.address,
+                                policy=FAST_RETRY)
+        faults.reset_counters()
+        faults.arm(FaultPlan([FaultRule("inputsvc.fetch", count=-1)]))
+        try:
+            got = list(feed.epoch_iter(0))
+        finally:
+            faults.disarm()
+        assert len(got) == 4
+        assert feed.stats()["fallbacks"] == 1
+        c = faults.all_counters()
+        assert c.get("inputsvc.fetch:raise", 0) >= FAST_RETRY.max_attempts
+        assert c.get("inputsvc.fetch.retries", 0) >= 1
+
+    def test_no_endpoint_means_local_assembly(self):
+        inputsvc.host_cache().clear()
+        feed = TrainerInputFeed(mlr_spec(seed=51), mlr_provider(seed=51),
+                                tenant="ne", endpoint=None)
+        assert inputsvc.default_endpoint() is None
+        got = list(feed.epoch_iter(0))
+        assert len(got) == 4
+        st = feed.stats()
+        assert st["fallbacks"] == 1
+        # the pump assembled locally; consumption rode the host cache
+        assert st["pump_local_batches"] == 4 and st["shared_batches"] == 4
+        assert st["wire_batches"] == 0
+
+
+class TestHostCacheLiveness:
+    def test_oversized_batches_self_serve_instead_of_spinning(self,
+                                                              service,
+                                                              monkeypatch):
+        """A batch bigger than the client-cache budget can never land;
+        progress must NOT advance for it, so the consumer takes the
+        self-serve branch instead of spinning on a guaranteed miss."""
+        from harmony_tpu.inputsvc import client as client_mod
+
+        tiny = client_mod._HostCache()
+        tiny._cache = BatchCache(max_bytes=8)  # nothing fits
+        monkeypatch.setattr(client_mod, "_host_cache", tiny)
+        feed = TrainerInputFeed(mlr_spec(seed=83), mlr_provider(seed=83),
+                                tenant="os", endpoint=service.address,
+                                policy=FAST_RETRY)
+        feed.SIBLING_WAIT = 0.2
+        done = {}
+
+        def consume():
+            done["got"] = list(feed.epoch_iter(0))
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=20)
+        assert not t.is_alive(), "epoch_iter wedged on an un-cacheable batch"
+        assert len(done["got"]) == 4
+        local = mlr_provider(seed=83)
+        for g, exp in zip(done["got"], local.epoch_batches()):
+            assert batches_equal(g, exp)
+
+
+class TestServiceDatasetDedup:
+    def test_concurrent_first_requests_materialize_once(self, monkeypatch):
+        svc = InputService(workers=2)
+        calls = []
+        real = __import__("harmony_tpu.config.base",
+                          fromlist=["resolve_symbol"]).resolve_symbol
+
+        def counting_resolve(path):
+            fn = real(path)
+
+            def wrapped(**kw):
+                calls.append(1)
+                time.sleep(0.05)  # widen the race window
+                return fn(**kw)
+
+            return wrapped
+
+        import harmony_tpu.config.base as base_mod
+
+        monkeypatch.setattr(base_mod, "resolve_symbol", counting_resolve)
+        # same dataset, different transforms: no shared epoch key, so
+        # only the dataset-level dedup can prevent a double data_fn call
+        specs = [mlr_spec(seed=91), mlr_spec(seed=92)]
+        outs = []
+
+        def go(s):
+            prov, _ = svc._provider(s)
+            outs.append(prov)
+
+        threads = [threading.Thread(target=go, args=(s,)) for s in specs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(calls) == 1, f"data_fn ran {len(calls)} times"
+        assert len(svc._dataset_order) == 1
+
+
+class TestDeferredProvider:
+    def test_metadata_without_materialization(self):
+        calls = []
+
+        def load():
+            calls.append(1)
+            return (np.arange(32, dtype=np.float32).reshape(8, 4),
+                    np.arange(8, dtype=np.int32))
+
+        p = DeferredTrainingDataProvider(
+            load, 8, 4, shuffle_each_epoch=True, seed=5,
+            array_specs=[((4,), "float32"), ((), "int32")],
+        )
+        assert p.num_mini_batches == 4 and p.batch_size == 2
+        assert p.array_specs() == [((4,), np.dtype("float32")),
+                                   ((), np.dtype("int32"))]
+        perm = p.epoch_permutation(0)  # pure (seed, n) function
+        assert not calls  # nothing materialized yet
+        eager = TrainingDataProvider(
+            [np.arange(32, dtype=np.float32).reshape(8, 4),
+             np.arange(8, dtype=np.int32)], 4,
+            shuffle_each_epoch=True, seed=5)
+        assert (perm == eager.epoch_permutation(0)).all()
+        # first DATA access materializes exactly once
+        b0 = list(p.epoch_batches_at(1))
+        assert calls == [1]
+        list(p.epoch_batches_at(2))
+        assert calls == [1]
+        for g, exp in zip(b0, eager.epoch_batches_at(1)):
+            assert batches_equal(g, exp)
+
+    def test_materialized_shape_mismatch_raises(self):
+        p = DeferredTrainingDataProvider(
+            lambda: (np.zeros((4, 2), np.float32),), 8, 2,
+            array_specs=[((2,), "float32")],
+        )
+        with pytest.raises(ValueError):
+            list(p.epoch_batches_at(0))
+
+
+class TestAutoscaler:
+    def test_scales_up_on_input_wait_and_down_when_idle(self):
+        svc = InputService(workers=2)
+        frac = [0.5]
+        scaler = InputAutoscaler(svc, lambda: frac[0], min_workers=1,
+                                 max_workers=4, period=999)
+        ev = scaler.tick()
+        assert ev is not None and svc.workers == 3
+        frac[0] = 0.0
+        scaler.tick()
+        scaler.tick()
+        assert svc.workers == 1  # floored at min
+        scaler.tick()
+        assert svc.workers == 1
+        assert len(svc.scale_events) == 3
+
+    def test_straggler_tiebreak_and_none_safety(self):
+        svc = InputService(workers=2)
+        scaler = InputAutoscaler(svc, lambda: 0.05, lambda: 2.0,
+                                 min_workers=1, max_workers=4, period=999)
+        assert scaler.tick() is not None and svc.workers == 3
+        quiet = InputAutoscaler(svc, lambda: None, min_workers=1,
+                                max_workers=4, period=999)
+        assert quiet.tick() is None  # unknown wait fraction: no action
+
+    def test_shrunk_pool_reslots_idle_tenants(self):
+        svc = InputService(workers=4)
+        svc.start()
+        try:
+            for i in range(4):
+                list(fetch_epoch(svc.address, mlr_spec(seed=60 + i), 0,
+                                 tenant=f"rs{i}"))
+            svc.set_workers(1, reason="test")
+            list(fetch_epoch(svc.address, mlr_spec(seed=70), 0,
+                             tenant="rs0"))
+            assert svc.stats()["tenants"]["rs0"]["slot"] == 0
+        finally:
+            svc.stop()
+
+
+class TestJobServerIntegration:
+    def test_embedded_service_parity_and_status(self):
+        from harmony_tpu.jobserver import JobServer
+
+        def submit(jid, svc_on, seed):
+            server = JobServer(num_executors=1)
+            server.start()
+            cfg = JobConfig(
+                job_id=jid, app_type="dolphin",
+                trainer="harmony_tpu.apps.mlr:MLRTrainer",
+                params=TrainerParams(
+                    num_epochs=2, num_mini_batches=4,
+                    input_service=svc_on, comm_probe_period=0,
+                    app_params={"num_classes": 4, "num_features": 8,
+                                "features_per_partition": 2,
+                                "step_size": 0.5},
+                ),
+                num_workers=1,
+                user={"data_fn": MLR_FN,
+                      "data_args": {"n": 64, "num_features": 8,
+                                    "num_classes": 4, "seed": seed}},
+            )
+            res = server.submit(cfg).result(timeout=120)
+            status = server._status()
+            server.shutdown(timeout=60)
+            return res["workers"][f"{jid}/w0"]["losses"], status
+
+        # distinct dataset seeds per comparison pair so the process
+        # devcache cannot serve a previous run's device batches
+        l_off, st_off = submit("isvc-off", False, seed=123)
+        assert st_off["input_service"] is None
+        l_on, st_on = submit("isvc-on", True, seed=123)
+        assert l_off == l_on
+        assert st_on["input_service"] is not None
+        svc_stats = st_on["input_service"]
+        assert (svc_stats["batches_assembled"]
+                + svc_stats["batches_from_cache"]) >= 0
+        assert "cache" in svc_stats and "workers" in svc_stats
+        # the embedded endpoint is torn down with the server
+        assert inputsvc.default_endpoint() is None
+
+
+class TestPrefetchDropCounter:
+    def test_invalidate_counts_dropped_device_copies(self, mesh8):
+        """Satellite: stats() must count batches dropped by reshard
+        invalidation, and the registry counter must carry them."""
+        import jax
+
+        from harmony_tpu.dolphin.prefetch import PrefetchPipeline
+        from harmony_tpu.metrics.registry import get_registry
+
+        data = mlr_provider(seed=77, shuffle=False)
+        gate = threading.Event()
+
+        class GatedProvider:
+            def epoch_batches(self):
+                for i, b in enumerate(data.epoch_batches()):
+                    yield b
+                    if i == 1:
+                        gate.wait(timeout=10)
+
+        sharding = jax.sharding.NamedSharding(
+            mesh8, jax.sharding.PartitionSpec())
+        pipe = PrefetchPipeline(GatedProvider(), lambda: sharding,
+                                lambda: 8)
+        deadline = time.monotonic() + 10
+        while pipe._ring.depth() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        dropped = pipe.invalidate()
+        assert dropped == 2
+        gate.set()
+        items = list(pipe)
+        pipe.close()
+        s = pipe.stats()
+        assert s["dropped_batches"] == 2
+        assert s["dropped"] == {"reshard": 2}
+        assert len(items) == 4
+        fam = get_registry().counter(
+            "harmony_input_dropped_total",
+            "Staged input batches whose device copies were "
+            "dropped before use, by reason (reshard "
+            "invalidation / host-only demotion)",
+            ("reason",),
+        )
+        assert fam.labels(reason="reshard").value >= 2
+
+    def test_stop_staging_counts_demotions(self, mesh8):
+        import jax
+
+        from harmony_tpu.dolphin.prefetch import PrefetchPipeline
+
+        data = mlr_provider(seed=78, shuffle=False)
+        sharding = jax.sharding.NamedSharding(
+            mesh8, jax.sharding.PartitionSpec())
+        pipe = PrefetchPipeline(data, lambda: sharding, lambda: 8)
+        deadline = time.monotonic() + 10
+        while pipe._ring.depth() < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        n = pipe.stop_staging()
+        list(pipe)
+        pipe.close()
+        assert pipe.stats()["dropped"].get("demote") == n
+        assert n >= 1
+
+
+class TestBenchSmoke:
+    @pytest.mark.slow
+    def test_service_ab_tiny(self):
+        """The multi-tenant A/B harness end to end at toy sizes: two
+        tenant processes, a standalone service process, in-bench parity
+        gate green."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from benchmarks.bench_input_pipeline import run_service_bench
+
+        res = run_service_bench(tenants=2, n=4096, features=4, classes=2,
+                                epochs=2, batches=4, rounds=1, cores=0)
+        assert res["losses_bit_identical"]
+        assert res["inproc_sps"] > 0 and res["service_sps"] > 0
+        assert res["service"]["batches_assembled"] >= 4
