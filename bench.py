@@ -588,6 +588,13 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # tier-1 every round, so its wall time drifting up is a tax on
         # every CI pass — keep it visible in the same trajectory
         line["lint"] = lint
+    od = measure_obs_doctor()
+    if od is not None:
+        # telemetry-history ingest + full-rule-evaluation wall time per
+        # scrape cycle: the scraper/doctor run inside the jobserver at
+        # HARMONY_OBS_SCRAPE_PERIOD cadence, so their overhead must be
+        # measured, not assumed (pinned capture: OBS_DOCTOR_r11.json)
+        line["obs_doctor"] = od
     print(json.dumps(line))
 
 
@@ -615,6 +622,60 @@ def measure_input_service() -> "dict | None":
             "inproc_sps": r["inproc_sps"],
             "speedup": r["speedup"],
             "parity": "bit-identical",
+        }
+    except Exception:
+        return None
+
+
+def measure_obs_doctor() -> "dict | None":
+    """Telemetry-history + doctor overhead probe (tracked round over
+    round in the BENCH json): ingest of this process's REAL exposition
+    (populated by the training passes that just ran) per scrape cycle,
+    and one full rule evaluation over a store holding scenario-shaped
+    tenant series. Returns {ingest_ms, diagnose_ms, series, points,
+    rules, diagnoses} or None — the bench line must never die for its
+    observability hook. Full sweep: benchmarks/obs_doctor.py
+    (OBS_DOCTOR_r11.json)."""
+    try:
+        from harmony_tpu.metrics.doctor import Doctor, all_rules
+        from harmony_tpu.metrics.history import HistoryStore
+        from harmony_tpu.metrics.registry import get_registry
+
+        text = get_registry().expose()
+        store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+        rounds = 20
+        now = time.time()
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            store.ingest_exposition("leader", text,
+                                    ts=now - (rounds - i))
+        ingest_ms = (time.perf_counter() - t0) * 1000.0 / rounds
+        # scenario-shaped tenant series so every rule has real work
+        for j in range(8):
+            labels = {"job": f"bench-t{j}", "attempt": f"bench-t{j}"}
+            for i in range(30):
+                ts = now - 30 + i
+                store.ingest("tenant.input_wait_frac", labels,
+                             0.8 if j % 2 else 0.1, ts=ts)
+                store.ingest("tenant.straggler_ratio", labels,
+                             2.5 if j % 3 == 0 else 1.0, ts=ts)
+                store.ingest("tenant.mfu", labels,
+                             0.4 if i < 15 else 0.1, ts=ts)
+        doc = Doctor(store, events_fn=dict)
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            doc.diagnose()  # dedupe suppresses re-EMISSION, not the work
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        st = store.stats()
+        return {
+            "ingest_ms": round(ingest_ms, 3),
+            "diagnose_ms": round(sorted(samples)[len(samples) // 2], 3),
+            "series": st["series"],
+            "points": st["points"],
+            "rules": len(all_rules()),
+            "diagnoses": len(doc.recent()),
+            "scrape_bytes": len(text),
         }
     except Exception:
         return None

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Telemetry-history + doctor overhead benchmark (PR 11).
+
+The scraper and doctor run INSIDE the jobserver at
+``HARMONY_OBS_SCRAPE_PERIOD`` cadence, stealing cycles from the control
+plane — so their cost is measured, not assumed. Three stages:
+
+1. **scrape round-trip** — a real HTTP scrape of a populated exporter
+   through the hardened :class:`ScrapeClient` (wire + parse);
+2. **ingest** — folding one parsed exposition into the store, swept
+   over target counts (the leader scrapes every pod follower);
+3. **diagnose** — one full rule-catalog evaluation, swept over tenant
+   counts with scenario-shaped series (every rule has real work).
+
+Prints ONE JSON document; the committed capture is
+``benchmarks/OBS_DOCTOR_r<N>.json``. Pure CPU/stdlib — comparable
+across rounds regardless of accelerator health.
+
+Usage: python benchmarks/obs_doctor.py [--rounds N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def _populate(reg, families: int = 30, cells: int = 8) -> None:
+    """A registry shaped like a busy worker's: a few dozen families,
+    a handful of label cells each, one histogram in three."""
+    for i in range(families):
+        if i % 3 == 0:
+            h = reg.histogram(f"harmony_bench_f{i}_seconds", "bench")
+            for j in range(cells):
+                h.observe(0.01 * (j + 1))
+        elif i % 3 == 1:
+            c = reg.counter(f"harmony_bench_f{i}_total", "bench",
+                            ("op",))
+            for j in range(cells):
+                c.labels(op=f"op{j}").inc(j + 1)
+        else:
+            g = reg.gauge(f"harmony_bench_f{i}", "bench", ("job",))
+            for j in range(cells):
+                g.labels(job=f"j{j}").set(float(j))
+
+
+def bench_scrape(rounds: int) -> dict:
+    from harmony_tpu.metrics.exporter import MetricsExporter
+    from harmony_tpu.metrics.history import HistoryStore, ScrapeClient
+    from harmony_tpu.metrics.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    _populate(reg)
+    exp = MetricsExporter(0, registry=reg).start()
+    client = ScrapeClient()
+    store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+    try:
+        samples = []
+        text = ""
+        for i in range(rounds):
+            t0 = time.perf_counter()
+            text = client.fetch("bench", exp.url + "/metrics")
+            store.ingest_exposition("bench", text,
+                                    ts=time.time() - rounds + i)
+            samples.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        exp.stop()
+    return {
+        "roundtrip_ms": round(statistics.median(samples), 3),
+        "scrape_bytes": len(text),
+        "series": store.stats()["series"],
+    }
+
+
+def bench_ingest(rounds: int) -> dict:
+    from harmony_tpu.metrics.history import HistoryStore
+    from harmony_tpu.metrics.registry import MetricRegistry, parse_exposition
+
+    reg = MetricRegistry()
+    _populate(reg)
+    families = parse_exposition(reg.expose())
+    out = {}
+    for targets in (1, 4, 16):
+        store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+        samples = []
+        for r in range(rounds):
+            ts = time.time() - rounds + r
+            t0 = time.perf_counter()
+            for t in range(targets):
+                store.ingest_exposition(f"pod:{t}", families, ts=ts)
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        st = store.stats()
+        out[f"targets_{targets}"] = {
+            "cycle_ms": round(statistics.median(samples), 3),
+            "series": st["series"],
+            "points": st["points"],
+        }
+    return out
+
+
+def _scenario_store(tenants: int):
+    from harmony_tpu.metrics.history import HistoryStore
+
+    store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+    now = time.time()
+    for j in range(tenants):
+        labels = {"job": f"t{j}", "attempt": f"t{j}"}
+        for i in range(60):
+            ts = now - 60 + i
+            store.ingest("tenant.input_wait_frac", labels,
+                         0.8 if j % 2 else 0.1, ts=ts)
+            store.ingest("tenant.straggler_ratio", labels,
+                         2.5 if j % 3 == 0 else 1.0, ts=ts)
+            store.ingest("tenant.mfu", labels,
+                         0.4 if i < 30 else 0.1, ts=ts)
+            store.ingest("tenant.samples_per_sec", labels,
+                         1000.0 - i, ts=ts)
+    store.ingest("harmony_table_layout_changes_total",
+                 {"target": "leader"}, 1.0, ts=now - 50, kind="counter",
+                 target="leader")
+    store.ingest("harmony_table_layout_changes_total",
+                 {"target": "leader"}, 3.0, ts=now - 10, kind="counter",
+                 target="leader")
+    return store
+
+
+def bench_diagnose(rounds: int) -> dict:
+    from harmony_tpu.metrics.doctor import Doctor, all_rules
+
+    out = {"rules": len(all_rules())}
+    for tenants in (2, 8, 32):
+        store = _scenario_store(tenants)
+        doc = Doctor(store, events_fn=dict)
+        samples = []
+        fired = 0
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            fired += len(doc.diagnose())
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        out[f"tenants_{tenants}"] = {
+            "eval_ms": round(statistics.median(samples), 3),
+            "series": store.stats()["series"],
+            "diagnoses_emitted": fired,
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_doctor bench")
+    ap.add_argument("--rounds", type=int, default=20)
+    args = ap.parse_args(argv)
+    line = {
+        "metric": "telemetry-history ingest + doctor rule-evaluation "
+                  "overhead per scrape cycle",
+        "unit": "ms (median)",
+        "rounds": args.rounds,
+        "scrape": bench_scrape(args.rounds),
+        "ingest": bench_ingest(args.rounds),
+        "diagnose": bench_diagnose(args.rounds),
+    }
+    print(json.dumps(line, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

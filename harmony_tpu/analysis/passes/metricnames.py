@@ -26,10 +26,17 @@ Plus the doc-parity directions (mirroring knob-consistency's shape):
   somewhere — a documented-but-unregistered metric is a dashboard query
   that silently returns nothing.
 
-Both directions need the WHOLE tree and the real docs to mean anything,
-so they are skipped on partial runs (explicit files / dir slices — the
-fixture corpus lints file-by-file and must not be compared against the
-real repo's table).
+Plus the DOCTOR-RULE parity directions (same shape, different
+registry): every rule declared through ``doctor_rule("name", ...)``
+(metrics/doctor.py) appears as a row in the OBSERVABILITY.md "Rule
+catalog" table, and every catalog row names a shipped rule — an
+undocumented rule is a verdict operators cannot interpret, and a
+documented-but-unshipped rule is a diagnosis that will never fire.
+
+All parity directions need the WHOLE tree and the real docs to mean
+anything, so they are skipped on partial runs (explicit files / dir
+slices — the fixture corpus lints file-by-file and must not be
+compared against the real repo's table).
 """
 from __future__ import annotations
 
@@ -64,6 +71,58 @@ def _registered_instruments(
         if mname is None or not mname.startswith("harmony_"):
             continue
         out.append((mname, node.func.attr, node.lineno))
+    return out
+
+
+#: the doctor-rule declaration callable (metrics/doctor.py) — literal
+#: first args are the shipped rule names
+_RULE_CALL = "doctor_rule"
+#: a rule name inside a catalog-table row: the FIRST backticked
+#: snake_case token of the row
+_DOC_RULE_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_RULE_HEADING = "rule catalog"
+
+
+def _declared_rules(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(rule_name, line) for every ``doctor_rule("name", ...)`` call
+    with a literal first argument in one module."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fn = node.func
+        fname = (fn.attr if isinstance(fn, ast.Attribute)
+                 else fn.id if isinstance(fn, ast.Name) else None)
+        if fname != _RULE_CALL:
+            continue
+        rname = _str_const(node.args[0])
+        if rname is not None:
+            out.append((rname, node.lineno))
+    return out
+
+
+def _doc_rule_catalog(index: CodebaseIndex) -> Dict[str, int]:
+    """Rule names in the OBSERVABILITY.md *Rule catalog* table -> line
+    number: table rows (``|``-prefixed) between a heading containing
+    "Rule catalog" and the next heading; the row's FIRST backticked
+    token is the rule name. Prose name-drops elsewhere do not count —
+    the catalog row (predicate, evidence format) is the operator
+    contract this pass pins."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for lno, line in enumerate(
+            index.doc_text(_METRIC_DOC).splitlines(), start=1):
+        if line.lstrip().startswith("#"):
+            in_section = _RULE_HEADING in line.lower()
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        stripped = line.strip().strip("|").strip()
+        if set(stripped) <= {"-", "|", " ", ":"}:
+            continue  # the separator row
+        m = _DOC_RULE_RE.search(line)
+        if m:
+            out.setdefault(m.group(1), lno)
     return out
 
 
@@ -160,8 +219,47 @@ class MetricConventionsPass(Pass):
             # nowhere nor is its (often fixture) content part of the
             # operator surface the table documents
             return out
-        documented = _doc_table_metrics(index)
+
+        # -- doctor-rule <-> rule-catalog parity (both directions) -----
+        declared_rules: List[Tuple[str, str, int]] = []
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            for rname, lineno in _declared_rules(sf.tree):
+                declared_rules.append((rname, sf.rel, lineno))
+        doc_rules = _doc_rule_catalog(index)
         doc_rel = f"docs/{_METRIC_DOC}"
+        if declared_rules and not doc_rules:
+            out.append(self.finding(
+                doc_rel, 1,
+                "doctor rules are declared but docs/OBSERVABILITY.md "
+                "has no 'Rule catalog' table",
+                hint="add the catalog (rule | predicate | evidence "
+                     "rows) — the table is the verdict glossary this "
+                     "pass checks against"))
+        else:
+            declared_names = {r for r, _f, _l in declared_rules}
+            for rname, rel, lineno in declared_rules:
+                if rname not in doc_rules:
+                    out.append(self.finding(
+                        rel, lineno,
+                        f"doctor rule {rname!r} is declared here but "
+                        "appears in no OBSERVABILITY.md rule-catalog "
+                        "row",
+                        hint="add a `rule | predicate | evidence` row "
+                             "— an undocumented rule is a verdict "
+                             "operators cannot interpret"))
+            for rname, lno in sorted(doc_rules.items()):
+                if rname not in declared_names:
+                    out.append(self.finding(
+                        doc_rel, lno,
+                        f"rule catalog documents {rname!r} but no "
+                        "doctor_rule() declares it",
+                        hint="a documented-but-unshipped rule is a "
+                             "diagnosis that will never fire; fix the "
+                             "row or ship the rule"))
+
+        documented = _doc_table_metrics(index)
         if not documented:
             if registered:
                 # no metric table resolvable (docs/ absent — e.g. a

@@ -424,11 +424,14 @@ def main(argv: List[str] | None = None) -> int:
              "records, /metrics scrape, trace timelines "
              "(docs/OBSERVABILITY.md)",
     )
-    p.add_argument("what", choices=("top", "flight", "metrics", "trace"))
+    p.add_argument("what",
+                   choices=("top", "flight", "metrics", "trace", "doctor"))
     p.add_argument("--port", type=int, default=43110,
-                   help="jobserver TCP port (top/flight: STATUS query)")
+                   help="jobserver TCP port (top/flight/doctor: STATUS "
+                        "query)")
     p.add_argument("--json", action="store_true",
-                   help="top: raw ledger JSON instead of the table")
+                   help="top: raw ledger JSON instead of the table; "
+                        "doctor: raw diagnoses + history stats")
     p.add_argument("--url", default=None,
                    help="metrics: exporter/dashboard base URL "
                         "(e.g. http://host:9090); trace: dashboard URL")
@@ -664,6 +667,23 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
             "stragglers": status.get("stragglers", {}),
         }, indent=2))
         return 0 if status.get("ok") else 1
+    if args.what == "doctor":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        status = CommandSender(args.port).send_status_command()
+        if not status.get("ok"):
+            print(json.dumps(status))
+            return 1
+        if getattr(args, "json", False):
+            print(json.dumps({
+                "diagnoses": status.get("diagnoses", []),
+                "history": status.get("history", {}),
+            }, indent=2))
+            return 0
+        for line in _render_doctor(status.get("diagnoses", []),
+                                   status.get("history", {})):
+            print(line)
+        return 0
     if not args.url:
         print("obs metrics/trace need --url", file=sys.stderr)
         return 2
@@ -710,6 +730,56 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
               f"{s['description']} [{row['duration_sec'] * 1000:.1f}ms] "
               f"({s.get('process_id') or '?'}) {ann}")
     return 0
+
+
+def _render_table(rows: "List[tuple]") -> "List[str]":
+    """Fixed-width text table shared by the ``obs`` renderers: rows[0]
+    is the header; a dashed separator follows it."""
+    widths = [max(len(row[i]) for row in rows)
+              for i in range(len(rows[0]))]
+    out = []
+    for i, row in enumerate(rows):
+        out.append("  ".join(c.ljust(w)
+                             for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            out.append("  ".join("-" * w for w in widths))
+    return out
+
+
+def _render_doctor(diagnoses: list, history: dict) -> "List[str]":
+    """One-screen doctor view from a single STATUS scrape: a header
+    with the store's shape (series/points/targets — is the sensor
+    layer even seeing anything?), then one row per diagnosis, newest
+    last. Empty is a real answer: 'no diagnoses' over a populated
+    store means the cluster looks healthy; over an EMPTY store it
+    means nothing is being scraped — the header disambiguates."""
+    out = []
+    scraper = history.get("scraper") or {}
+    out.append(
+        f"history: {history.get('series', 0)} series, "
+        f"{history.get('points', 0)} points, "
+        f"window {history.get('window_sec', '?')}s @ "
+        f"{history.get('resolution_sec', '?')}s, "
+        f"{scraper.get('cycles', 0)} scrape cycles, "
+        f"targets: {', '.join(history.get('targets', [])) or '-'}")
+    if history.get("gap_marks"):
+        out.append(f"  ({history['gap_marks']} missed-scrape gap marks, "
+                   f"{history.get('restarts', 0)} process restarts seen)")
+    if not diagnoses:
+        out.append("no diagnoses — all rules silent over the window")
+        return out
+    rows = [("WHEN", "RULE", "SUBJECT", "CONF", "SUMMARY")]
+    import time as _time
+
+    for d in diagnoses:
+        rows.append((
+            _time.strftime("%H:%M:%S", _time.localtime(d.get("ts", 0))),
+            str(d.get("rule", "?")),
+            str(d.get("job") or d.get("target") or "-"),
+            f"{d.get('confidence', 0.0):.2f}",
+            str(d.get("summary", "")),
+        ))
+    return out + _render_table(rows)
 
 
 def _fmt_bytes(n) -> str:
@@ -759,12 +829,7 @@ def _render_tenant_top(tenants: dict) -> "List[str]":
             slo_cell,
             "-" if strag is None else f"{strag:.2f}",
         ))
-    widths = [max(len(row[i]) for row in rows) for i in range(len(cols))]
-    out = []
-    for i, row in enumerate(rows):
-        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
-        if i == 0:
-            out.append("  ".join("-" * w for w in widths))
+    out = _render_table(rows)
     if len(rows) == 1:
         out.append("(no tenant activity recorded)")
     return out

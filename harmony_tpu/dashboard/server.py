@@ -228,6 +228,44 @@ class DashboardServer:
         rows.sort(key=lambda r: -(r.get("device_seconds") or 0.0))
         return rows
 
+    #: ledger fields /api/history will serve as a series — a strict
+    #: allowlist, so a query param never rides into payload lookups
+    #: with surprising types (every one is numeric-or-None in the row)
+    HISTORY_FIELDS = ("samples_per_sec", "mfu", "input_wait_frac",
+                      "device_seconds", "resident_bytes", "hbm_share")
+
+    def history(self, job_id: Optional[str],
+                field: str = "samples_per_sec",
+                limit: int = 200) -> Dict[str, Any]:
+        """Time series for one job from the stored kind='tenant' rows
+        (the jobserver posts the ledger at epoch cadence — the rows ARE
+        the history), plus the job's kind='diagnosis' rows so the panel
+        can overlay verdicts. Without a job_id: the jobs that have any
+        history. ``field`` picks the ledger column (HISTORY_FIELDS)."""
+        if job_id is None:
+            rows = self._read_rows(
+                "SELECT DISTINCT job_id FROM metrics "
+                "WHERE kind IN ('tenant', 'diagnosis') ORDER BY job_id")
+            return {"jobs": [r[0] for r in rows],
+                    "fields": list(self.HISTORY_FIELDS)}
+        if field not in self.HISTORY_FIELDS:
+            raise BadRequest(
+                f"field must be one of {'/'.join(self.HISTORY_FIELDS)}")
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
+        rows = self._read_rows(
+            "SELECT ts, payload FROM metrics WHERE kind = 'tenant' "
+            "AND job_id = ? ORDER BY id DESC LIMIT ?", (job_id, limit))
+        points: List[List[float]] = []
+        for ts, payload in reversed(rows):  # oldest first for rendering
+            v = json.loads(payload).get(field)
+            if isinstance(v, (int, float)):
+                points.append([ts, float(v)])
+        diags = [json.loads(r[1]) for r in reversed(self._read_rows(
+            "SELECT ts, payload FROM metrics WHERE kind = 'diagnosis' "
+            "AND job_id = ? ORDER BY id DESC LIMIT 32", (job_id,)))]
+        return {"job_id": job_id, "field": field, "points": points,
+                "diagnoses": diags}
+
     def jobs(self) -> List[Dict[str, Any]]:
         # One aggregate query; last_loss = the newest report whose payload
         # has a top-level "loss" key (json_extract, not substring match —
@@ -351,6 +389,84 @@ class DashboardServer:
             + "".join(rows) + "</table></body></html>"
         )
 
+    @staticmethod
+    def _history_html(data: Dict[str, Any]) -> str:
+        """Sparkline + diagnosis-timeline panel for one job: the series
+        as an inline SVG polyline, the diagnoses laid out with the same
+        :func:`~harmony_tpu.tracing.timeline.timeline_rows` shaping the
+        trace views use (a diagnosis window IS a span: start, stop,
+        description). Every rendered string is HTML-escaped — payloads
+        are client-POSTed data."""
+        import html as _html
+
+        from harmony_tpu.tracing.timeline import timeline_rows
+
+        job = _html.escape(str(data.get("job_id", "?")))
+        field = _html.escape(str(data.get("field", "")))
+        points = data.get("points") or []
+        parts = [f"<html><head><title>history {job}</title></head><body>",
+                 f"<h1>history: {job}</h1>"]
+        if points:
+            ts = [p[0] for p in points]
+            vs = [p[1] for p in points]
+            t0, t1 = min(ts), max(ts)
+            lo, hi = min(vs), max(vs)
+            tspan = max(t1 - t0, 1e-9)
+            vspan = max(hi - lo, 1e-9)
+            w, h = 600, 80
+            pts = " ".join(
+                f"{(t - t0) / tspan * w:.1f},"
+                f"{h - (v - lo) / vspan * h:.1f}"
+                for t, v in points)
+            parts.append(
+                f"<p>{field}: {len(points)} points, "
+                f"min {lo:.4g}, max {hi:.4g}</p>"
+                f"<svg width='{w}' height='{h + 4}' "
+                "style='border:1px solid #ccc'>"
+                f"<polyline points='{pts}' fill='none' "
+                "stroke='#46f' stroke-width='1.5'/></svg>")
+        else:
+            parts.append(f"<p>no {field} history recorded</p>")
+        def num(v):
+            # diagnosis rows are client-POSTed data: a non-numeric
+            # window value must degrade to None (timeline_rows handles
+            # that) rather than TypeError the whole panel
+            return float(v) if isinstance(v, (int, float)) else None
+
+        diags = data.get("diagnoses") or []
+        spans = []
+        for i, d in enumerate(diags):
+            win = d.get("window")
+            if not (isinstance(win, (list, tuple)) and len(win) == 2):
+                win = [d.get("ts"), d.get("ts")]
+            spans.append({
+                "trace_id": "doctor", "span_id": str(i),
+                "description": f"{d.get('rule', '?')}: "
+                               f"{d.get('summary', '')}",
+                "start_sec": num(win[0]), "stop_sec": num(win[1]),
+            })
+        rows_data = timeline_rows(spans)
+        if rows_data:
+            wall = rows_data[0]["wall_sec"]
+            parts.append("<h2>diagnoses</h2>"
+                         "<table border=0 width='100%'>"
+                         "<tr><th align=left>verdict</th>"
+                         "<th width='50%'>window</th></tr>")
+            for r in rows_data:
+                s, dur = r["span"], r["duration_sec"]
+                left = 100.0 * r["offset_sec"] / wall
+                width = max(100.0 * dur / wall, 0.3)
+                parts.append(
+                    f"<tr><td>{_html.escape(str(s['description']))}</td>"
+                    f"<td><div style='margin-left:{left:.1f}%;"
+                    f"width:{width:.1f}%;background:#e55;height:10px'>"
+                    "</div></td></tr>")
+            parts.append("</table>")
+        else:
+            parts.append("<p>no diagnoses recorded</p>")
+        parts.append("</body></html>")
+        return "".join(parts)
+
     def _make_handler(self):
         server = self
 
@@ -440,6 +556,38 @@ class DashboardServer:
                         self._json(400, {"error": str(e)})
                         return
                     self._html(server._trace_html(spans).encode())
+                elif parsed.path == "/api/history":
+                    try:
+                        result = server.history(
+                            job_id=one("job_id"),
+                            field=one("field") or "samples_per_sec",
+                            limit=_clamp_limit(one("limit"), default=200),
+                        )
+                    except BadRequest as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
+                elif parsed.path == "/history":
+                    jid = one("job_id")
+                    if not jid:
+                        self._json(400,
+                                   {"error": "history needs job_id"})
+                        return
+                    try:
+                        data = server.history(
+                            job_id=jid,
+                            field=one("field") or "samples_per_sec")
+                        body = server._history_html(data).encode()
+                    except BadRequest as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    except Exception as e:
+                        # stored rows are client-POSTed data: one
+                        # malformed row must render a 400, never drop
+                        # the connection for every future panel view
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._html(body)
                 elif parsed.path == "/metrics":
                     from harmony_tpu.metrics.registry import get_registry
 
@@ -462,7 +610,11 @@ class DashboardServer:
                         return "-" if v is None else fmt.format(v)
 
                     tenant_rows = "".join(
-                        f"<tr><td>{_h.escape(str(t.get('job', '?')))}</td>"
+                        # job cell links to the history panel (sparkline
+                        # + diagnosis timeline) for that tenant
+                        f"<tr><td><a href='/history?job_id="
+                        f"{_q(str(t.get('job', '?')))}'>"
+                        f"{_h.escape(str(t.get('job', '?')))}</a></td>"
                         f"<td>{_h.escape(str(t.get('attempt', '')))}</td>"
                         f"<td>{cell(t.get('device_seconds'), '{:.2f}')}</td>"
                         f"<td>{cell(t.get('samples_per_sec'), '{:,.0f}')}</td>"

@@ -42,11 +42,21 @@ def main(argv=None) -> int:
 
     from harmony_tpu.inputsvc.service import InputService
 
+    # per-process /metrics exporter (HARMONY_METRICS_PORT; None when
+    # unset): the standalone worker is a scrape target like any other
+    # long-running process — point the jobserver's history scraper at
+    # it via HARMONY_OBS_SCRAPE_TARGETS (docs/OBSERVABILITY.md)
+    from harmony_tpu.metrics.exporter import exporter_from_env
+
+    exporter = exporter_from_env()
     svc = InputService(workers=args.workers, host=args.host)
     port = svc.start(args.port)
     # one JSON line so wrappers can parse the bound endpoint
     print(json.dumps({"inputsvc": True, "host": args.host, "port": port,
-                      "workers": svc.workers}), flush=True)
+                      "workers": svc.workers,
+                      "metrics_port": (exporter.port
+                                       if exporter is not None else None)}),
+          flush=True)
     done = threading.Event()
 
     def _stop(signum, frame) -> None:
@@ -56,6 +66,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _stop)
     done.wait()
     svc.stop()
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 

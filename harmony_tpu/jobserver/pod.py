@@ -156,6 +156,13 @@ class PodJobServer(JobServer):
         #: pid -> set of job ids the follower's latest heartbeat listed —
         #: catches a job thread that died without ever reporting
         self._hb_jobs: Dict[int, set] = {}
+        #: pid -> the /metrics exporter port the follower's heartbeat
+        #: advertises (history-scraper target discovery); absent when
+        #: the follower runs without HARMONY_METRICS_PORT
+        self._hb_metrics_ports: Dict[int, int] = {}
+        #: pid -> the peer address the follower connected from — the
+        #: host half of its scrape target
+        self._follower_hosts: Dict[int, str] = {}
         # Failure confinement (beyond the reference's fail-fast stubs,
         # JobServerDriver.java:271-298): a follower death marks only the
         # dead process AND processes sharing a running job with it as
@@ -258,6 +265,7 @@ class PodJobServer(JobServer):
                 self._followers[pid] = (conn, f)
                 self._send_locks[pid] = threading.Lock()
                 self._last_seen[pid] = time.monotonic()
+                self._follower_hosts[pid] = addr[0]
             server_log.info("pod follower %d joined from %s", pid, addr)
         for pid, (conn, f) in sorted(self._followers.items()):
             t = threading.Thread(
@@ -334,12 +342,21 @@ class PodJobServer(JobServer):
         """Wire a replacement follower back into the pod: fresh reader,
         liveness state cleared, executors restored to the scheduler, and
         running shrunk elastic jobs offered a re-grow fence."""
+        try:
+            peer_host = conn.getpeername()[0]
+        except OSError:
+            peer_host = None
         with self._pod_cond:
             old = self._followers.pop(pid, None)
             self._followers[pid] = (conn, f)
             self._send_locks[pid] = threading.Lock()
             self._last_seen[pid] = time.monotonic()
             self._hb_jobs.pop(pid, None)
+            # a replacement process re-advertises its exporter on its
+            # next beat; the old port may belong to a dead process
+            self._hb_metrics_ports.pop(pid, None)
+            if peer_host is not None:
+                self._follower_hosts[pid] = peer_host
             self._dead_followers.discard(pid)
             self._pod_cond.notify_all()
         if old is not None:
@@ -674,6 +691,9 @@ class PodJobServer(JobServer):
                 if msg.get("cmd") == "HEARTBEAT":
                     self._last_beat[pid] = self._last_seen[pid]
                     self._hb_jobs[pid] = set(msg.get("jobs", []))
+                    if msg.get("metrics_port"):
+                        self._hb_metrics_ports[pid] = int(
+                            msg["metrics_port"])
                     self._pod_cond.notify_all()
             if msg.get("cmd") == "HEARTBEAT":
                 continue
@@ -842,6 +862,11 @@ class PodJobServer(JobServer):
                 "dead": sorted(self._dead_followers),
                 "unusable_procs": sorted(self._unusable_procs),
                 "reinstated": list(self.reinstated),
+                # heartbeat-advertised follower /metrics ports — the
+                # history scraper's target discovery, surfaced so an
+                # operator can scrape the same endpoints by hand
+                "metrics_ports": {str(p): port for p, port
+                                  in sorted(self._hb_metrics_ports.items())},
             }
             out["elastic"] = {
                 "active": {
@@ -852,6 +877,23 @@ class PodJobServer(JobServer):
                 "events": [dict(ev) for ev in self.elastic_events[-32:]],
             }
         return out
+
+    def _scrape_targets(self) -> Dict[str, Any]:
+        """The leader's own registry + every live follower whose
+        heartbeat advertised an exporter port. Dead/confined followers
+        are skipped — their gap is already the signal, and scraping a
+        corpse would only slow the loop down to its timeout."""
+        targets = super()._scrape_targets()
+        with self._pod_cond:
+            ports = dict(self._hb_metrics_ports)
+            hosts = dict(self._follower_hosts)
+            skip = set(self._dead_followers) | set(self._silenced)
+        for pid, port in sorted(ports.items()):
+            if pid in skip:
+                continue
+            host = hosts.get(pid) or "127.0.0.1"
+            targets[f"pod:{pid}"] = f"http://{host}:{port}/metrics"
+        return targets
 
     @staticmethod
     def _blocks(ps: frozenset, their_ordered: bool, procs: frozenset,
@@ -1818,8 +1860,16 @@ class PodFollower:
                 except Exception:
                     continue  # one beat lost, beacon lives
             try:
+                # the beacon advertises this process's /metrics port so
+                # the leader's history scraper discovers followers from
+                # the heartbeat plumbing it already trusts (no separate
+                # service registry); None when the exporter is off
                 self._report({"cmd": "HEARTBEAT", "pid": self.pid,
-                              "jobs": jobs})
+                              "jobs": jobs,
+                              "metrics_port": (
+                                  self.metrics_exporter.port
+                                  if self.metrics_exporter is not None
+                                  else None)})
             except OSError:
                 return  # leader gone; the main loop handles shutdown
 

@@ -31,6 +31,8 @@ from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.entity import JobEntity, build_entity
 from harmony_tpu.jobserver.joblog import job_logger, server_log
 from harmony_tpu.jobserver.scheduler import JobScheduler, ShareAllScheduler, make_scheduler
+from harmony_tpu.metrics.doctor import Doctor, set_doctor
+from harmony_tpu.metrics.history import HistoryScraper, HistoryStore, extra_targets
 from harmony_tpu.metrics.manager import MetricManager
 from harmony_tpu.parallel.mesh import DevicePool
 from harmony_tpu.runtime.master import ETMaster
@@ -127,6 +129,27 @@ class JobServer:
         # by the ledger-fed autoscaler, surfaced via STATUS.
         self.input_service = None
         self._input_autoscaler = None
+        # Telemetry history + root-cause doctor (metrics/history.py +
+        # metrics/doctor.py): a jobserver-side scraper polls every known
+        # process's /metrics (the leader's own registry in-process, pod
+        # followers via their heartbeat-advertised exporter ports) and
+        # the tenant ledger into a bounded time-series store; the doctor
+        # evaluates its rule catalog after every poll. Diagnoses land as
+        # kind="diagnosis" joblog events, ride STATUS, and tee to the
+        # dashboard when one is configured.
+        self.history = HistoryStore()
+        self.doctor = Doctor(
+            self.history,
+            stragglers_fn=self.metrics.straggler_report,
+            sinks=(self._post_diagnosis,),
+        )
+        set_doctor(self.doctor)
+        self._history_scraper = HistoryScraper(
+            self.history,
+            targets_fn=self._scrape_targets,
+            ledger_fn=self.metrics.tenant_ledger,
+            on_cycle=self.doctor.diagnose,
+        )
 
     def _on_metric(self, record) -> None:
         """Every job metric lands in the manager AND (when configured)
@@ -174,6 +197,7 @@ class JobServer:
             e.device.platform == "cpu" for e in executors
         )
         self._scheduler.bind([e.id for e in executors], self._launch)
+        self._history_scraper.start()
         self._state.transition("INIT")
         server_log.info("jobserver up: %d executors, scheduler=%s",
                         len(executors), type(self._scheduler).__name__)
@@ -232,6 +256,11 @@ class JobServer:
                 self._span_receiver = None
             if self._dashboard is not None:
                 self._dashboard.close()  # flush the async queue, then stop
+            self._history_scraper.stop()
+            from harmony_tpu.metrics.doctor import peek_doctor
+
+            if peek_doctor() is self.doctor:
+                set_doctor(None)
             if self.metrics_exporter is not None:
                 self.metrics_exporter.stop()
                 self.metrics_exporter = None
@@ -432,6 +461,29 @@ class JobServer:
         its plan channel for multi-process grants here)."""
         return {}
 
+    def _scrape_targets(self) -> Dict[str, Any]:
+        """History-scraper target provider: this process's own registry
+        (sampled in-process — the leader pays no HTTP for itself) plus
+        any ``HARMONY_OBS_SCRAPE_TARGETS`` extras (standalone inputsvc
+        workers). The pod server adds follower exporters discovered
+        from the heartbeat plumbing."""
+        from harmony_tpu.metrics.registry import get_registry
+
+        targets: Dict[str, Any] = {"leader": get_registry().expose}
+        targets.update(extra_targets())
+        return targets
+
+    def _post_diagnosis(self, diag) -> None:
+        """Doctor sink: tee every fresh diagnosis to the dashboard as a
+        kind="diagnosis" row (same best-effort contract as metric
+        posts) so the history panel can overlay verdicts on series."""
+        if self._dashboard is not None:
+            try:
+                self._dashboard.post(diag.subject, "diagnosis",
+                                     diag.to_dict())
+            except Exception:
+                pass  # dashboard posts are best-effort by contract
+
     def _ensure_input_service(self) -> None:
         """Start the embedded input service + its autoscaler once. A
         configured HARMONY_INPUT_SERVICE_ADDR means a standalone service
@@ -513,6 +565,12 @@ class JobServer:
             "flight_records": flight.get_recorder().records(),
             "metrics_port": (self.metrics_exporter.port
                              if self.metrics_exporter is not None else None),
+            # telemetry history + doctor (metrics/history.py + doctor.py):
+            # store/scraper shape and the newest structured diagnoses —
+            # what `harmony-tpu obs doctor` renders
+            "history": {**self.history.stats(),
+                        "scraper": self._history_scraper.stats()},
+            "diagnoses": self.doctor.recent(),
             # disaggregated input service (harmony_tpu/inputsvc): port,
             # worker slots, per-tenant queue traffic, cache hit/byte
             # stats and autoscaler events — None when not running

@@ -1,5 +1,11 @@
 from harmony_tpu.metrics.tracer import Tracer
 from harmony_tpu.metrics.accounting import LedgerStore, ledger
+from harmony_tpu.metrics.doctor import Diagnosis, Doctor, all_rules
+from harmony_tpu.metrics.history import (
+    HistoryScraper,
+    HistoryStore,
+    ScrapeClient,
+)
 from harmony_tpu.metrics.collector import (
     BatchMetrics,
     EpochMetrics,
@@ -21,6 +27,12 @@ __all__ = [
     "Tracer",
     "LedgerStore",
     "ledger",
+    "Diagnosis",
+    "Doctor",
+    "all_rules",
+    "HistoryScraper",
+    "HistoryStore",
+    "ScrapeClient",
     "Counter",
     "Gauge",
     "Histogram",

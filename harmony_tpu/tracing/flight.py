@@ -65,6 +65,20 @@ def _tenant_snapshot() -> Dict[str, Any]:
         return {}
 
 
+def _diagnoses_snapshot() -> List[Dict[str, Any]]:
+    """Recent doctor diagnoses for a dump, or [] — same contract as the
+    tenant snapshot: a dying process must never die HARDER because its
+    diagnosis history could not be read, and tracing must not
+    hard-depend on metrics."""
+    try:
+        from harmony_tpu.metrics.doctor import peek_doctor
+
+        doc = peek_doctor()
+        return doc.recent() if doc is not None else []
+    except Exception:
+        return []
+
+
 def _attempt_key(ctx: Dict[str, Any]) -> Optional[str]:
     """The ``job@aN`` attempt key a trigger context names, if any (same
     scheme as jobserver/elastic.attempt_key, inlined so the tracing
@@ -135,6 +149,10 @@ class FlightRecorder(SpanReceiver):
             # black box, so a post-mortem can tell a starved tenant from
             # a runaway one without a live scrape
             "tenants": _tenant_snapshot(),
+            # what the doctor had already concluded when this process
+            # died (metrics/doctor.py) — a dump with "input_bound on
+            # tenant X" inside answers the post-mortem's first question
+            "diagnoses": _diagnoses_snapshot(),
             "records": records,
         }
         path = os.path.join(

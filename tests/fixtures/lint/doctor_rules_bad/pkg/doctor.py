@@ -1,0 +1,16 @@
+"""KNOWN-BAD fixture tree: the rule declared below appears in no
+docs/OBSERVABILITY.md rule-catalog row, and the catalog documents a
+rule nothing in this tree declares. The metric-conventions pass's
+doctor-rule parity directions must flag both."""
+
+
+def doctor_rule(name, description):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+@doctor_rule("phantom_stall", "fires when nothing documents it")
+def _phantom_stall(ctx):  # BAD: not in the doc's rule catalog
+    return []

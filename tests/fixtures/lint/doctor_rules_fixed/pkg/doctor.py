@@ -1,0 +1,14 @@
+"""FIXED twin of doctor_rules_bad: every declared rule has a catalog
+row and every catalog row names a declared rule."""
+
+
+def doctor_rule(name, description):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+@doctor_rule("phantom_stall", "documented in the catalog below")
+def _phantom_stall(ctx):
+    return []

@@ -160,6 +160,35 @@ class TestPassFixtures:
         r = _lint_file("metric_conventions_fixed.py", "metric-conventions")
         assert r.ok, render_text(r)
 
+    def test_doctor_rule_parity_flags_both_directions(self):
+        """PR 11: the doctor-rule catalog directions — a declared-but-
+        undocumented rule anchors at its doctor_rule() call; a
+        documented-but-unshipped rule anchors at its catalog row."""
+        r = _lint_tree("doctor_rules_bad", "metric-conventions")
+        msgs = [f.message for f in r.findings]
+        assert any("phantom_stall" in m
+                   and "no OBSERVABILITY.md rule-catalog row" in m
+                   for m in msgs), msgs
+        assert any("ghost_rule" in m
+                   and "no doctor_rule() declares it" in m
+                   for m in msgs), msgs
+        doc = [f for f in r.findings if f.file.startswith("docs/")]
+        assert doc and doc[0].line > 1
+
+    def test_doctor_rule_parity_accepts_documented_tree(self):
+        r = _lint_tree("doctor_rules_fixed", "metric-conventions")
+        assert r.ok, render_text(r)
+
+    def test_doctor_rule_parity_skips_partial_runs(self):
+        """A file slice must not be compared against the real repo's
+        rule catalog (same contract as the metric-table directions)."""
+        r = run_lint(
+            files=[os.path.join(FIXTURES, "doctor_rules_bad", "pkg",
+                                "doctor.py")],
+            repo_root=os.path.join(FIXTURES, "doctor_rules_bad"),
+            passes=[get_pass("metric-conventions")])
+        assert r.ok, render_text(r)
+
     def test_fault_site_registry_flags_both_directions(self):
         r = _lint_tree("fault_site_registry_bad", "fault-site-registry")
         msgs = [f.message for f in r.findings]

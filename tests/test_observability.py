@@ -261,6 +261,64 @@ class TestDashboard:
                 server.shutdown(timeout=60)
             dash.stop()
 
+    def test_history_api_serves_series_and_diagnoses(self):
+        """PR 11: /api/history turns the posted kind='tenant' ledger
+        rows into a time series (oldest first) and carries the job's
+        kind='diagnosis' rows beside it; /history renders the sparkline
+        + diagnosis-timeline panel."""
+        server = DashboardServer().start()
+        try:
+            def post(kind, payload):
+                body = json.dumps({"job_id": "h-j", "kind": kind,
+                                   "payload": payload}).encode()
+                req = urllib.request.Request(
+                    server.url + "/api/metrics", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                assert json.loads(urllib.request.urlopen(req).read())["ok"]
+
+            for sps in (100.0, 120.0, 90.0):
+                post("tenant", {"job": "h-j", "samples_per_sec": sps,
+                                "mfu": None})
+            now = time.time()
+            post("diagnosis", {"rule": "input_bound",
+                               "verdict": "input_bound",
+                               "summary": "tenant h-j is input-bound",
+                               "window": [now - 30, now]})
+            data = json.loads(urllib.request.urlopen(
+                server.url + "/api/history?job_id=h-j").read())
+            assert [v for _, v in data["points"]] == [100.0, 120.0, 90.0]
+            assert data["field"] == "samples_per_sec"
+            assert data["diagnoses"][0]["rule"] == "input_bound"
+            # mfu was None in every row: no points, not zeros
+            mfu = json.loads(urllib.request.urlopen(
+                server.url + "/api/history?job_id=h-j&field=mfu").read())
+            assert mfu["points"] == []
+            # without a job: the discovery listing
+            jobs = json.loads(urllib.request.urlopen(
+                server.url + "/api/history").read())
+            assert "h-j" in jobs["jobs"] and "mfu" in jobs["fields"]
+            # unknown field: a 400, never a KeyError-shaped 500
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    server.url + "/api/history?job_id=h-j&field=evil")
+            assert e.value.code == 400
+            html = urllib.request.urlopen(
+                server.url + "/history?job_id=h-j").read().decode()
+            assert "<svg" in html and "input_bound" in html
+            # a malformed client-POSTed diagnosis row (non-numeric
+            # window) must not break the panel for every future view
+            post("diagnosis", {"rule": "mangled",
+                               "window": ["not", "numbers"]})
+            html = urllib.request.urlopen(
+                server.url + "/history?job_id=h-j").read().decode()
+            assert "mangled" in html  # rendered (degraded), not a 500
+            # the jobs page links each tenant to its panel
+            root = urllib.request.urlopen(server.url + "/").read().decode()
+            assert "/history?job_id=h-j" in root
+        finally:
+            server.stop()
+
     def test_connector_survives_dead_dashboard(self):
         conn = DashboardConnector("http://127.0.0.1:1")  # nothing listens
         conn.post("j", "k", {})
