@@ -595,6 +595,13 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # HARMONY_OBS_SCRAPE_PERIOD cadence, so their overhead must be
         # measured, not assumed (pinned capture: OBS_DOCTOR_r11.json)
         line["obs_doctor"] = od
+    cp = measure_critpath()
+    if cp is not None:
+        # step-phase budget computation + critical-path analysis wall
+        # time: the snapshot runs on every ledger query / scrape cycle
+        # and the analyzer on every STATUS, so their overhead rides the
+        # trajectory too (pinned sweep: CRITPATH_r13.json)
+        line["critpath"] = cp
     print(json.dumps(line))
 
 
@@ -676,6 +683,49 @@ def measure_obs_doctor() -> "dict | None":
             "rules": len(all_rules()),
             "diagnoses": len(doc.recent()),
             "scrape_bytes": len(text),
+        }
+    except Exception:
+        return None
+
+
+def measure_critpath() -> "dict | None":
+    """Step-phase budget + critical-path overhead probe (tracked round
+    over round in the BENCH json): windowed budget computation
+    (PhaseBudgetStore.snapshot — runs on every ledger query and scrape
+    cycle) and the full critical-path analysis (critpath.analyze —
+    runs on every STATUS) over a scenario-shaped store. Returns
+    {budget_ms, analyze_ms, tenants, workers, epochs} or None — the
+    bench line must never die for its observability hook. Full sweep:
+    benchmarks/critpath.py (CRITPATH_r13.json)."""
+    try:
+        from harmony_tpu.metrics import critpath
+        from harmony_tpu.metrics.phases import PhaseBudgetStore
+
+        store = PhaseBudgetStore()
+        tenants, workers, epochs = 8, 4, 24
+        for j in range(tenants):
+            for e in range(epochs):
+                for w in range(workers):
+                    store.observe_epoch(
+                        f"bench-t{j}", f"bench-t{j}", f"w{w}", e,
+                        0.1 + 0.01 * w,
+                        {"input_wait": 0.01, "host_dispatch": 0.005,
+                         "pull_comm": 0.01, "compute": 0.06,
+                         "push_comm": 0.005})
+        budget_samples = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            snap = store.snapshot()
+            budget_samples.append((time.perf_counter() - t0) * 1000.0)
+        analyze_samples = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            critpath.analyze(snap)
+            analyze_samples.append((time.perf_counter() - t0) * 1000.0)
+        return {
+            "budget_ms": round(sorted(budget_samples)[5], 3),
+            "analyze_ms": round(sorted(analyze_samples)[5], 3),
+            "tenants": tenants, "workers": workers, "epochs": epochs,
         }
     except Exception:
         return None

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Step-phase budget + critical-path overhead benchmark (PR 13).
+
+The :class:`~harmony_tpu.metrics.phases.PhaseBudgetStore` snapshot runs
+on every ledger query and every scrape cycle, and
+:func:`~harmony_tpu.metrics.critpath.analyze` on every STATUS — both
+inside the jobserver control plane — so their cost is measured, not
+assumed. Two stages, swept over the control-plane shapes that matter:
+
+1. **budget** — windowed budget computation (snapshot: per-epoch
+   sibling-wall join into ``barrier_wait``, residual closure,
+   per-worker fractions), swept over workers 1/4/16;
+2. **analyze** — the full critical-path analysis (classification,
+   dominant phase, per-epoch gating worker), swept over tenants 2/8.
+
+Prints ONE JSON document; the committed capture is
+``benchmarks/CRITPATH_r<N>.json``. Pure CPU/stdlib — comparable across
+rounds regardless of accelerator health.
+
+Usage: python benchmarks/critpath.py [--rounds N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+#: one epoch's feed shape — a believable budget (compute-dominant with
+#: real comm/dispatch/input shares); per-worker skew feeds the barrier
+_PHASES = {"input_wait": 0.02, "host_dispatch": 0.01,
+           "pull_comm": 0.015, "compute": 0.08, "push_comm": 0.01}
+_EPOCHS = 32
+
+
+def _fill(store, tenants: int, workers: int, epochs: int = _EPOCHS):
+    for j in range(tenants):
+        for e in range(epochs):
+            for w in range(workers):
+                store.observe_epoch(
+                    f"t{j}", f"t{j}", f"w{w}", e,
+                    0.15 + 0.02 * (w % 3),
+                    dict(_PHASES))
+
+
+def bench_budget(rounds: int) -> dict:
+    from harmony_tpu.metrics.phases import PhaseBudgetStore
+
+    out = {}
+    for workers in (1, 4, 16):
+        store = PhaseBudgetStore()
+        _fill(store, tenants=8, workers=workers)
+        samples = []
+        snap = {}
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            snap = store.snapshot()
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        out[f"workers_{workers}"] = {
+            "snapshot_ms": round(statistics.median(samples), 3),
+            "tenants": len(snap),
+            "worker_rows": sum(len(r["per_worker"])
+                               for r in snap.values()),
+        }
+    return out
+
+
+def bench_analyze(rounds: int) -> dict:
+    from harmony_tpu.metrics import critpath
+    from harmony_tpu.metrics.phases import PhaseBudgetStore
+
+    out = {}
+    for tenants in (2, 8):
+        store = PhaseBudgetStore()
+        _fill(store, tenants=tenants, workers=4)
+        snap = store.snapshot()
+        samples = []
+        verdicts = {}
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            verdicts = critpath.analyze(snap)
+            samples.append((time.perf_counter() - t0) * 1000.0)
+        per_epoch = statistics.median(samples) / max(
+            sum(len(r["epoch_walls"]) for r in snap.values()), 1)
+        out[f"tenants_{tenants}"] = {
+            "analyze_ms": round(statistics.median(samples), 3),
+            "per_epoch_ms": round(per_epoch, 5),
+            "classifications": sorted({
+                v["classification"] for v in verdicts.values()}),
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="critpath bench")
+    ap.add_argument("--rounds", type=int, default=30)
+    args = ap.parse_args(argv)
+    line = {
+        "metric": "step-phase budget computation + critical-path "
+                  "analysis overhead",
+        "unit": "ms (median)",
+        "rounds": args.rounds,
+        "epochs_per_tenant": _EPOCHS,
+        "budget": bench_budget(args.rounds),
+        "analyze": bench_analyze(args.rounds),
+    }
+    print(json.dumps(line, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

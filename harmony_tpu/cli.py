@@ -420,21 +420,25 @@ def main(argv: List[str] | None = None) -> int:
 
     p = sub.add_parser(
         "obs",
-        help="observability tooling: per-tenant cost top, flight "
-             "records, /metrics scrape, trace timelines "
-             "(docs/OBSERVABILITY.md)",
+        help="observability tooling: per-tenant cost top, step-phase "
+             "critpath, flight records, /metrics scrape, trace "
+             "timelines (docs/OBSERVABILITY.md)",
     )
     p.add_argument("what",
-                   choices=("top", "flight", "metrics", "trace", "doctor"))
-    p.add_argument("--port", type=int, default=43110,
-                   help="jobserver TCP port (top/flight/doctor: STATUS "
-                        "query)")
+                   choices=("top", "flight", "metrics", "trace",
+                            "doctor", "critpath"))
+    p.add_argument("--port", type=int, default=None,
+                   help="jobserver TCP port (top/flight/doctor/critpath:"
+                        " STATUS query; default $HARMONY_JOBSERVER_PORT"
+                        " then 43110)")
     p.add_argument("--json", action="store_true",
                    help="top: raw ledger JSON instead of the table; "
-                        "doctor: raw diagnoses + history stats")
+                        "doctor: raw diagnoses + history stats; "
+                        "critpath: raw phase budgets")
     p.add_argument("--url", default=None,
-                   help="metrics: exporter/dashboard base URL "
-                        "(e.g. http://host:9090); trace: dashboard URL")
+                   help="metrics: exporter base URL (default "
+                        "$HARMONY_METRICS_URL); trace: dashboard URL "
+                        "(default $HARMONY_DASHBOARD_URL)")
     p.add_argument("--trace-id", default=None,
                    help="trace: the trace to fetch")
     p.add_argument("--job", default=None,
@@ -641,13 +645,56 @@ def _cmd_obs(args: argparse.Namespace) -> int:
         return 0
 
 
+#: env knobs behind the shared ``obs`` endpoint resolution (documented
+#: in docs/OBSERVABILITY.md §6 / DEPLOY §7) — the flag always wins; the
+#: port-based STATUS commands fall back to the default submit port
+ENV_JOBSERVER_PORT = "HARMONY_JOBSERVER_PORT"
+ENV_METRICS_URL = "HARMONY_METRICS_URL"
+ENV_DASHBOARD_URL = "HARMONY_DASHBOARD_URL"
+_OBS_URL_KNOBS = {"metrics": ENV_METRICS_URL, "trace": ENV_DASHBOARD_URL}
+
+
+def _resolve_obs_endpoint(args: argparse.Namespace):
+    """ONE endpoint resolution for every ``obs`` subcommand (the old
+    shape made ``metrics``/``trace`` demand --url while the STATUS
+    commands silently used a different flag): explicit flag, then the
+    env knob, then — for port-based commands only — the default submit
+    port. Returns ``("port", int)`` or ``("url", str)``; raises
+    SystemExit(2) with an error NAMING the env knob otherwise."""
+    import os
+
+    if args.what in _OBS_URL_KNOBS:
+        knob = _OBS_URL_KNOBS[args.what]
+        url = args.url or os.environ.get(knob, "").strip()
+        if not url:
+            raise SystemExit(
+                f"obs {args.what} needs --url (or the {knob} env knob)")
+        return "url", url.rstrip("/")
+    if args.port is not None:
+        return "port", int(args.port)
+    raw = os.environ.get(ENV_JOBSERVER_PORT, "").strip()
+    if raw:
+        try:
+            return "port", int(raw)
+        except ValueError:
+            raise SystemExit(
+                f"obs {args.what}: {ENV_JOBSERVER_PORT}={raw!r} is not "
+                "a port number")
+    return "port", 43110
+
+
 def _cmd_obs_inner(args: argparse.Namespace) -> int:
     import urllib.request
 
+    try:
+        kind, endpoint = _resolve_obs_endpoint(args)
+    except SystemExit as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
     if args.what == "top":
         from harmony_tpu.jobserver.client import CommandSender
 
-        status = CommandSender(args.port).send_status_command()
+        status = CommandSender(endpoint).send_status_command()
         if not status.get("ok"):
             print(json.dumps(status))
             return 1
@@ -660,17 +707,18 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
     if args.what == "flight":
         from harmony_tpu.jobserver.client import CommandSender
 
-        status = CommandSender(args.port).send_status_command()
+        status = CommandSender(endpoint).send_status_command()
         print(json.dumps({
             "flight_records": status.get("flight_records", []),
             "metrics_port": status.get("metrics_port"),
             "stragglers": status.get("stragglers", {}),
+            "profile_capture": status.get("profile_capture"),
         }, indent=2))
         return 0 if status.get("ok") else 1
     if args.what == "doctor":
         from harmony_tpu.jobserver.client import CommandSender
 
-        status = CommandSender(args.port).send_status_command()
+        status = CommandSender(endpoint).send_status_command()
         if not status.get("ok"):
             print(json.dumps(status))
             return 1
@@ -684,10 +732,20 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
                                    status.get("history", {})):
             print(line)
         return 0
-    if not args.url:
-        print("obs metrics/trace need --url", file=sys.stderr)
-        return 2
-    base = args.url.rstrip("/")
+    if args.what == "critpath":
+        from harmony_tpu.jobserver.client import CommandSender
+
+        status = CommandSender(endpoint).send_status_command()
+        if not status.get("ok"):
+            print(json.dumps(status))
+            return 1
+        if getattr(args, "json", False):
+            print(json.dumps(status.get("phase_budget", {}), indent=2))
+            return 0
+        for line in _render_critpath(status.get("phase_budget", {})):
+            print(line)
+        return 0
+    base = endpoint
     if args.what == "metrics":
         text = urllib.request.urlopen(base + "/metrics",
                                       timeout=10).read().decode()
@@ -780,6 +838,57 @@ def _render_doctor(diagnoses: list, history: dict) -> "List[str]":
             str(d.get("summary", "")),
         ))
     return out + _render_table(rows)
+
+
+#: waterfall row order + short labels (docs/OBSERVABILITY.md §9 column
+#: glossary) — taxonomy order, residual last
+_CRITPATH_ROWS = (("input_wait", "input"), ("host_dispatch", "dispatch"),
+                  ("pull_comm", "pull"), ("compute", "compute"),
+                  ("push_comm", "push"), ("barrier_wait", "barrier"),
+                  ("residual", "residual"))
+_CRITPATH_BAR = 30
+
+
+def _render_critpath(budget: dict) -> "List[str]":
+    """One-screen per-tenant step-phase waterfall from a single STATUS
+    scrape (docs/OBSERVABILITY.md §9 has the glossary): per tenant a
+    classification header, one bar per phase (percent of window wall —
+    phases + residual sum to ~100% by the budget invariant), and the
+    per-epoch critical path (which worker and phase gated the epoch
+    barrier — the straggler report says who, this says why)."""
+    if not budget:
+        return ["(no phase budget recorded — no worker fed the "
+                "budget store in the window)"]
+    out: List[str] = []
+    for job in sorted(budget,
+                      key=lambda j: -(budget[j].get("wall_sec") or 0.0)):
+        row = budget[job]
+        fr = row.get("fractions") or {}
+        ph = row.get("phases") or {}
+        strag = row.get("straggler_ratio")
+        out.append(
+            f"{job} [{row.get('attempt', job)}]  "
+            f"{row.get('classification', '?')}  "
+            f"wall {row.get('wall_sec', 0.0):.2f}s over "
+            f"{row.get('epochs', 0)} epoch(s), "
+            f"{len(row.get('per_worker') or {})} worker(s)"
+            + (f", straggler x{strag:.2f}" if strag is not None else ""))
+        for phase, label in _CRITPATH_ROWS:
+            f = float(fr.get(phase, 0.0))
+            bar = "#" * max(int(round(f * _CRITPATH_BAR)),
+                            1 if f > 0 else 0)
+            out.append(f"  {label:9s} {100.0 * f:5.1f}% "
+                       f"{ph.get(phase, 0.0):8.3f}s  {bar}")
+        cp = row.get("critical_path") or []
+        if cp:
+            gates = ", ".join(
+                f"e{c['epoch']}:{c['worker']}({c['phase']})"
+                for c in cp[-6:])
+            out.append(f"  critical path: {gates}")
+        out.append("")
+    if out and not out[-1]:
+        out.pop()
+    return out
 
 
 def _fmt_bytes(n) -> str:

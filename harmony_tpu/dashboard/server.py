@@ -266,6 +266,30 @@ class DashboardServer:
         return {"job_id": job_id, "field": field, "points": points,
                 "diagnoses": diags}
 
+    def critpath_rows(self, job_id: str,
+                      limit: int = 64) -> List[Dict[str, Any]]:
+        """One job's step-phase budget history from the stored
+        kind='tenant' rows (the jobserver posts the ledger — now
+        carrying each tenant's phase fractions + bound classification —
+        at epoch cadence). Oldest first; rows without a budget (the
+        tenant predates the phase plane, or no worker fed it) are
+        skipped rather than rendered as zeros."""
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
+        rows = self._read_rows(
+            "SELECT ts, payload FROM metrics WHERE kind = 'tenant' "
+            "AND job_id = ? ORDER BY id DESC LIMIT ?", (job_id, limit))
+        out: List[Dict[str, Any]] = []
+        for ts, payload in reversed(rows):
+            p = json.loads(payload)
+            phases = p.get("phases")
+            if not isinstance(phases, dict):
+                continue
+            out.append({"ts": ts,
+                        "phases": {str(k): v for k, v in phases.items()
+                                   if isinstance(v, (int, float))},
+                        "classification": p.get("phase_class")})
+        return out
+
     def jobs(self) -> List[Dict[str, Any]]:
         # One aggregate query; last_loss = the newest report whose payload
         # has a top-level "loss" key (json_extract, not substring match —
@@ -467,6 +491,76 @@ class DashboardServer:
         parts.append("</body></html>")
         return "".join(parts)
 
+    #: stacked-bar colors per phase (taxonomy order; residual grey —
+    #: the explicitly-unattributed share must LOOK unattributed)
+    _PHASE_COLORS = (("input_wait", "#fa0"), ("host_dispatch", "#a6f"),
+                     ("pull_comm", "#46f"), ("compute", "#4a4"),
+                     ("push_comm", "#28c"), ("barrier_wait", "#e55"),
+                     ("residual", "#bbb"))
+
+    @classmethod
+    def _critpath_html(cls, job_id: str,
+                       rows: List[Dict[str, Any]]) -> str:
+        """Stacked-phase timeline panel for one job: each stored budget
+        sample renders as one 100%-wide stacked bar (phases + residual
+        sum to the wall by the budget invariant), shaped through the
+        same :func:`~harmony_tpu.tracing.timeline.timeline_rows` helper
+        the trace views use — a phase segment IS a span (start =
+        cumulative fraction, stop = start + fraction). Every rendered
+        string is HTML-escaped — payloads are client-POSTed data."""
+        import html as _html
+
+        from harmony_tpu.tracing.timeline import timeline_rows
+
+        job = _html.escape(str(job_id))
+        parts = [f"<html><head><title>critpath {job}</title></head>"
+                 f"<body><h1>step-phase budget: {job}</h1>"]
+        legend = " ".join(
+            f"<span style='background:{c};padding:0 6px'>&nbsp;</span>"
+            f"{_html.escape(p)}"
+            for p, c in cls._PHASE_COLORS)
+        parts.append(f"<p>{legend}</p>")
+        if not rows:
+            parts.append("<p>no phase budget recorded for this job</p>"
+                         "</body></html>")
+            return "".join(parts)
+        parts.append("<table border=0 width='100%'>"
+                     "<tr><th align=left>when</th><th align=left>"
+                     "class</th><th width='70%'>phases</th></tr>")
+        for i, row in enumerate(rows):
+            spans = []
+            cum = 0.0
+            for phase, _c in cls._PHASE_COLORS:
+                f = row["phases"].get(phase)
+                if not isinstance(f, (int, float)) or f <= 0:
+                    continue
+                spans.append({"trace_id": "critpath",
+                              "span_id": f"{i}:{phase}",
+                              "description": phase,
+                              "start_sec": cum, "stop_sec": cum + f})
+                cum += f
+            shaped = timeline_rows(spans)
+            wall = shaped[0]["wall_sec"] if shaped else 1.0
+            colors = dict(cls._PHASE_COLORS)
+            segs = "".join(
+                f"<div title='{_html.escape(r['span']['description'])}"
+                f" {100.0 * r['duration_sec'] / wall:.1f}%' "
+                f"style='display:inline-block;height:12px;"
+                f"width:{100.0 * r['duration_sec'] / wall:.2f}%;"
+                f"background:"
+                f"{colors.get(r['span']['description'], '#bbb')}'>"
+                "</div>"
+                for r in shaped)
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(row.get("ts", 0)))
+            cls_name = _html.escape(str(row.get("classification") or "-"))
+            parts.append(
+                f"<tr><td>{when}</td><td>{cls_name}</td>"
+                f"<td><div style='width:100%;background:#eee'>{segs}"
+                "</div></td></tr>")
+        parts.append("</table></body></html>")
+        return "".join(parts)
+
     def _make_handler(self):
         server = self
 
@@ -588,6 +682,36 @@ class DashboardServer:
                         self._json(400, {"error": str(e)})
                         return
                     self._html(body)
+                elif parsed.path == "/api/critpath":
+                    jid = one("job_id")
+                    if not jid:
+                        self._json(400,
+                                   {"error": "critpath needs job_id"})
+                        return
+                    try:
+                        result = server.critpath_rows(
+                            jid, limit=_clamp_limit(one("limit"),
+                                                    default=64))
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, {"job_id": jid, "rows": result})
+                elif parsed.path == "/critpath":
+                    jid = one("job_id")
+                    if not jid:
+                        self._json(400,
+                                   {"error": "critpath needs job_id"})
+                        return
+                    try:
+                        rows = server.critpath_rows(jid)
+                        body = server._critpath_html(jid, rows).encode()
+                    except Exception as e:
+                        # stored rows are client-POSTed data: one
+                        # malformed row must render a 400, never drop
+                        # the connection for every future panel view
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._html(body)
                 elif parsed.path == "/metrics":
                     from harmony_tpu.metrics.registry import get_registry
 
@@ -636,6 +760,14 @@ class DashboardServer:
                             "attainment") is None
                            else f"{t['slo']['attainment']:.2f}"
                            + ("!" if t["slo"].get("events") else ""))
+                        + "</td>"
+                        # step-phase bound verdict, linked to the
+                        # stacked-phase /critpath panel for the tenant
+                        + "<td>"
+                        + (f"<a href='/critpath?job_id="
+                           f"{_q(str(t.get('job', '?')))}'>"
+                           f"{_h.escape(str(t['phase_class']))}</a>"
+                           if t.get("phase_class") else "-")
                         + "</td></tr>"
                         for t in server.tenants()
                     )
@@ -643,7 +775,8 @@ class DashboardServer:
                         "<h2>tenants</h2><table border=1>"
                         "<tr><th>job</th><th>attempt</th><th>dev-s</th>"
                         "<th>sps</th><th>MFU</th><th>HBM bytes</th>"
-                        "<th>HBM%</th><th>in-wait%</th><th>SLO</th></tr>"
+                        "<th>HBM%</th><th>in-wait%</th><th>SLO</th>"
+                        "<th>phase</th></tr>"
                         f"{tenant_rows}</table>"
                     ) if tenant_rows else ""
 

@@ -402,6 +402,15 @@ class WorkerTasklet:
         # rebuild. None = backend exposes no cost model (ledger keeps
         # the None — 0.0 is reserved for real zeros).
         self._flops_per_step: Optional[float] = None
+        # Step-phase time budget (metrics/phases.py): host-dispatch
+        # seconds accumulate on the training thread between "batch
+        # ready" and the device dispatch call; per-epoch phase splits
+        # stage in _phase_pending (keyed by epoch) between the metric
+        # drain — where the work split is computed — and _finish_epoch,
+        # where the epoch WALL is finally known and the budget feeds.
+        self._phase_dispatch_acc = 0.0
+        self._phase_pending: Dict[int, Dict[str, float]] = {}
+        self._phase_input_wait: Dict[int, float] = {}
 
     # -- step construction ----------------------------------------------
 
@@ -1125,6 +1134,14 @@ class WorkerTasklet:
             # other tenants never stall behind a probe's round-trips.
             def once() -> float:
                 t0 = time.perf_counter()
+                # model-pull wire-time fault site INSIDE the timed
+                # region: a "delay" rule injects deterministic comm
+                # latency the probe then honestly MEASURES into the
+                # split — the phase-budget acceptance's injection point
+                # (the blockmove.send delay-rule precedent)
+                if faults.armed():
+                    faults.site("worker.pull", job=self.job_id,
+                                worker=self.ctx.worker_id, probe=1)
                 with dispatch_scope(self.mesh) as fin:
                     out = fin(fn(*args))
                 # hard_sync, not block_until_ready: on the lazy axon
@@ -1160,8 +1177,10 @@ class WorkerTasklet:
             self._probe_pull = None
             return
         self._comm_probe_times = (t_pull, max(t_pp - t_pull, 0.0))
-        # publish for sibling workers sharing this table (read at emit time)
-        self.ctx.model_table._comm_split = self._comm_probe_times
+        # publish for sibling workers sharing this table (read at emit
+        # time) through the table's typed accessor — a lock-fenced
+        # publication, not a private-attr poke
+        self.ctx.model_table.set_comm_split(self._comm_probe_times)
 
     def _use_fused_epoch(self) -> bool:
         """Whole-epoch compilation is only correct with no between-batch host
@@ -1442,6 +1461,10 @@ class WorkerTasklet:
                 service_fallbacks=fb,
             )
         )
+        # input_wait phase (metrics/phases.py): staged per epoch here —
+        # the stream closes inside the dispatch loop, before the epoch
+        # wall is known at _finish_epoch, where the budget feeds
+        self._phase_input_wait[epoch] = float(s["consumer_stall_sec"])
         try:  # tenant ledger: input-wait seconds feed the wait fraction
             from harmony_tpu.metrics.accounting import ledger
 
@@ -1539,6 +1562,17 @@ class WorkerTasklet:
             )
         for _ in range(self.MAX_RESHARD_RETRIES):
             self._maybe_rebuild()
+            # host-dispatch phase (metrics/phases.py): the host seconds
+            # between batch-ready and the device dispatch call —
+            # placement, cache lookups, staging takes. Timed so the
+            # budget can subtract it from the smeared step wall; the
+            # fault site INSIDE the region lets a "delay" rule inject a
+            # deterministic host stall the budget then measures (the
+            # dispatch-bound acceptance scenario).
+            t_place = time.perf_counter()
+            if faults.armed():
+                faults.site("worker.dispatch", job=self.job_id,
+                            worker=self.ctx.worker_id, batch=batch_idx)
             batch_dev = staged.take(self._batch_sharding) if staged is not None else None
             if batch_dev is not None:
                 self._prefetch_hits += 1
@@ -1557,6 +1591,14 @@ class WorkerTasklet:
                 if staged is not None:
                     self._prefetch_misses += 1
                 batch_dev = self._shard_batch(self._host_batch(batch_idx, batch))
+            self._phase_dispatch_acc += time.perf_counter() - t_place
+            # model-pull wire-time site on the step path proper (the
+            # probe carries its twin): a "delay" rule makes each step
+            # pay the injected comm latency the probe measured, so the
+            # budget's pull_comm attribution matches the wall it splits
+            if faults.armed():
+                faults.site("worker.pull", job=self.job_id,
+                            worker=self.ctx.worker_id, batch=batch_idx)
             try:
                 return self._dispatch_step(self._step, batch_dev, hyper)
             except ValueError as e:
@@ -1825,6 +1867,7 @@ class WorkerTasklet:
         pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t = (
             self._dispatch_epoch_batches(epoch, global_batch_idx)
         )
+        dispatch_sec = self._take_dispatch_sec()
         if not stop:
             # next epoch's host assembly runs while the drain below blocks
             # (under TaskUnit contention its STAGING still queues behind
@@ -1854,7 +1897,8 @@ class WorkerTasklet:
             # waits excluded) evenly — averages feeding the optimizer stay
             # right, per-batch variance is deliberately given up.
             last_metrics = self._emit_batch_metrics(
-                epoch, host, batch_sizes, work_t / len(pending)
+                epoch, host, batch_sizes, work_t / len(pending),
+                dispatch_sec=dispatch_sec,
             )
             self._account_ops(len(pending))
         return epoch_examples, last_metrics, global_batch_idx, stop
@@ -2076,13 +2120,14 @@ class WorkerTasklet:
             pending, sizes, examples, global_batch_idx, _stop, work_t = (
                 self._dispatch_epoch_batches(first_epoch + j, global_batch_idx)
             )
-            per_epoch.append((pending, sizes, examples, work_t))
+            per_epoch.append((pending, sizes, examples, work_t,
+                              self._take_dispatch_sec()))
             # next epoch's producer overlaps either the next dispatch run
             # (j+1 < k) or the window drain below
             self._spawn_next_pipeline(first_epoch + j + 1)
             if j + 1 < k:
                 self.trainer.on_epoch_finished(self.ctx, first_epoch + j)
-        all_pending = [m for p, _, _, _ in per_epoch for m in p]
+        all_pending = [m for p, _, _, _, _ in per_epoch for m in p]
         drain_t = 0.0
         host: Dict[str, np.ndarray] = {}
         if all_pending:
@@ -2098,7 +2143,7 @@ class WorkerTasklet:
             drain_t = time.perf_counter() - t0
         out = []
         off = 0
-        for pending, sizes, examples, work_t in per_epoch:
+        for pending, sizes, examples, work_t, disp_t in per_epoch:
             nb = len(pending)
             last: Dict[str, float] = {}
             if nb:
@@ -2106,6 +2151,7 @@ class WorkerTasklet:
                 last = self._emit_batch_metrics(
                     first_epoch + len(out), epoch_host, sizes,
                     (work_t + drain_t / k) / nb,
+                    dispatch_sec=disp_t,
                 )
             off += nb
             # accounting deferred to run()'s replay loop (see
@@ -2114,12 +2160,20 @@ class WorkerTasklet:
         per_epoch_sec = (time.perf_counter() - t_start) / k
         return out, global_batch_idx, per_epoch_sec
 
+    def _take_dispatch_sec(self) -> float:
+        """Drain the host-dispatch accumulator (one epoch's placement
+        seconds; single-threaded — only the training thread feeds it)."""
+        v, self._phase_dispatch_acc = self._phase_dispatch_acc, 0.0
+        return v
+
     def _emit_batch_metrics(
         self,
         epoch: int,
         host: Dict[str, np.ndarray],
         batch_sizes: List[int],
         per_batch_time: float,
+        dispatch_sec: float = 0.0,
+        dispatch_in_work: bool = True,
     ) -> Dict[str, float]:
         """Shared epoch-end drain: strip internal underscore-keys (_sync),
         emit one BatchMetrics per batch with the smeared time, and return
@@ -2143,13 +2197,13 @@ class WorkerTasklet:
         # batch time — the conservative fused-mode default. The unfused
         # per-phase path needs no probe at all: its phases dispatch
         # separately, so the split is MEASURED per step.
-        measured = getattr(self._step, "mean_phase_seconds", None)
+        measured_fn = getattr(self._step, "mean_phase_seconds", None)
+        measured = measured_fn() if measured_fn is not None else None
         if measured is not None:
-            t_pull, _t_comp, t_push = measured()
+            t_pull, _t_comp, t_push = measured
         else:
-            t_pull, t_push = getattr(
-                self.ctx.model_table, "_comm_split", self._comm_probe_times
-            )
+            t_pull, t_push = (self.ctx.model_table.comm_split()
+                              or self._comm_probe_times)
         comp = max(per_batch_time - t_pull - t_push, 0.0)
         # NOTE: the weighted-fair-queue unit cost is reported from the
         # dispatch scope only (per granted UNIT) — reporting the drain's
@@ -2198,6 +2252,36 @@ class WorkerTasklet:
                               self._input_resident_bytes())
             acct.set_resident(self.job_id, self.attempt_key, "program",
                               self._program_resident_bytes())
+        except Exception:
+            pass
+        # Step-phase time budget (metrics/phases.py): split this epoch's
+        # measured work into pull/compute/push — the unfused step's REAL
+        # per-phase measurements, else the probe split refined by the
+        # compiled program's FLOP seconds — and stage it (with the
+        # host-dispatch seconds) for _finish_epoch, where the epoch WALL
+        # is known and the budget feeds. Guarded: the budget must never
+        # fail (or slow) the drain.
+        try:
+            from harmony_tpu.metrics.accounting import _peak_flops
+            from harmony_tpu.metrics.phases import split_device_phases
+
+            steps = len(batch_sizes)
+            work = per_batch_time * steps
+            split = split_device_phases(
+                work, steps,
+                # batched paths time placement INSIDE the per-batch dt
+                # (subtract it from the work split); the fused-epoch
+                # path's stacked upload happens OUTSIDE work_t
+                dispatch_sec=dispatch_sec if dispatch_in_work else 0.0,
+                measured=measured,
+                probe_split=(None if measured is not None
+                             else (t_pull, t_push)),
+                flops_per_step=self._program_flops_per_step(),
+                peak_flops=_peak_flops(),
+                devices=int(self.mesh.devices.size),
+            )
+            self._phase_pending[epoch] = {
+                "host_dispatch": float(dispatch_sec), **split}
         except Exception:
             pass
         return {k: float(v[-1]) for k, v in host.items()}
@@ -2368,7 +2452,13 @@ class WorkerTasklet:
         # interleaving with steps produce a cross-process collective
         # mismatch — any global placement must hold the dispatch unit.
         with self._turn():
+            # the one-time stacked upload is this path's host-dispatch
+            # phase: the host work between batches-ready and device
+            # dispatch (zero on warm-cache windows)
+            t_place = time.perf_counter()
             self._ensure_stacked_cache()
+            self._phase_dispatch_acc += time.perf_counter() - t_place
+        dispatch_sec = self._take_dispatch_sec()
         work_t = 0.0  # dispatch+device seconds, EXCLUDING admission waits
         window_metrics = []
         for j in range(k):
@@ -2403,6 +2493,7 @@ class WorkerTasklet:
             last = self._emit_batch_metrics(
                 first_epoch + j, host_metrics,
                 [self.data.batch_size] * nb, per_epoch_sec / nb,
+                dispatch_sec=dispatch_sec / k, dispatch_in_work=False,
             )
             # op accounting happens in run()'s replay loop, interleaved
             # with the deferred epoch callbacks, so per-epoch ServerMetrics
@@ -2461,6 +2552,29 @@ class WorkerTasklet:
                 epoch_sec)
         except Exception:
             pass
+        # Step-phase budget feed: the epoch wall is finally known here —
+        # join the staged work split + host-dispatch with this epoch's
+        # input-wait and hand the row to the process budget store
+        # (metrics/phases.py). Whatever the measured phases do not cover
+        # (admission waits, drains' host share, this bookkeeping) stays
+        # an explicit residual there. Guarded: the budget must never
+        # fail the epoch boundary.
+        # popped UNCONDITIONALLY, outside the guard: the stream close
+        # stages an input-wait entry per epoch, and a failing split or
+        # budget path must not grow these dicts by one orphan per
+        # epoch for the life of the tasklet
+        ph = self._phase_pending.pop(epoch, None)
+        input_wait = self._phase_input_wait.pop(epoch, 0.0)
+        if ph is not None:
+            try:
+                from harmony_tpu.metrics.phases import budget
+
+                ph["input_wait"] = input_wait
+                budget().observe_epoch(
+                    self.job_id, self.attempt_key, self.ctx.worker_id,
+                    epoch, epoch_sec, ph)
+            except Exception:
+                pass
         self._check_slo(epoch, epoch_examples, epoch_sec)
         epoch_losses.append(progress)
         if call_trainer_hook:

@@ -543,6 +543,9 @@ class JobServer:
 
         from harmony_tpu.tracing import flight
 
+        # ONE straggler walk per STATUS: the report, the ledger join
+        # and the phase-budget analysis all consume the same figures
+        stragglers = self.metrics.straggler_report()
         return {
             "ok": True,
             "state": self.state,
@@ -557,11 +560,21 @@ class JobServer:
             # telemetry plane: per-job straggler attribution from the
             # step-time records, this process's flight-recorder dumps
             # (path + correlated trace ids), and where /metrics lives
-            "stragglers": self.metrics.straggler_report(),
+            "stragglers": stragglers,
             # per-tenant device cost accounting (metrics/accounting.py):
             # MFU, device-seconds, resident HBM, input-wait, SLO
             # attainment per job@attempt — what `obs top` renders
-            "tenants": self.metrics.tenant_ledger(),
+            "tenants": self.metrics.tenant_ledger(stragglers=stragglers),
+            # step-phase time budget + critical-path attribution
+            # (metrics/phases.py + critpath.py): per-tenant phase
+            # seconds/fractions, bound classification, and per-epoch
+            # gating worker+phase — what `obs critpath` renders
+            "phase_budget": self.metrics.phase_budget(
+                stragglers=stragglers),
+            # newest sampled device-profile capture on THIS process's
+            # disk (HARMONY_PROFILE_DIR), if the sampler ever ran —
+            # until now xplane dumps landed and nothing referenced them
+            "profile_capture": flight.profile_capture_path(),
             "flight_records": flight.get_recorder().records(),
             "metrics_port": (self.metrics_exporter.port
                              if self.metrics_exporter is not None else None),

@@ -1,6 +1,13 @@
 from harmony_tpu.metrics.tracer import Tracer
 from harmony_tpu.metrics.accounting import LedgerStore, ledger
+from harmony_tpu.metrics.critpath import analyze, classify
 from harmony_tpu.metrics.doctor import Diagnosis, Doctor, all_rules
+from harmony_tpu.metrics.phases import (
+    PHASES,
+    PhaseBudgetStore,
+    budget,
+    split_device_phases,
+)
 from harmony_tpu.metrics.history import (
     HistoryScraper,
     HistoryStore,
@@ -27,6 +34,12 @@ __all__ = [
     "Tracer",
     "LedgerStore",
     "ledger",
+    "PHASES",
+    "PhaseBudgetStore",
+    "budget",
+    "split_device_phases",
+    "analyze",
+    "classify",
     "Diagnosis",
     "Doctor",
     "all_rules",

@@ -11,7 +11,8 @@ instead of a wall of gauges.
 Shipped rules (the catalog table in docs/OBSERVABILITY.md §Telemetry
 history & doctor is lint-held to this file in both directions):
 ``input_bound``, ``straggler``, ``mfu_collapse``, ``compile_storm``,
-``infra_suspect``, ``slo_breach``. Rules are declared through
+``infra_suspect``, ``comm_bound``, ``dispatch_bound``, ``slo_breach``.
+Rules are declared through
 :func:`doctor_rule` with LITERAL names — the ``metric-conventions``
 lint pass reads them statically.
 
@@ -33,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from harmony_tpu.metrics import critpath as _CP
 from harmony_tpu.metrics.history import HistoryStore
 
 # -- tunable predicate thresholds (module constants, surfaced in the
@@ -324,6 +326,86 @@ def _infra_suspect(ctx: DoctorContext) -> List[Diagnosis]:
             evidence={"events_in_window": total,
                       "by_op": {k: round(v, 1)
                                 for k, v in sorted(ops.items())}}))
+    return out
+
+
+def _phase_median(ctx: "DoctorContext", series: str,
+                  job: Optional[str]) -> Optional[float]:
+    """Median of one tenant.phase.* series for ``job`` over the window,
+    or None below MIN_POINTS — phase verdicts need a SUSTAINED budget,
+    not one noisy window."""
+    want = {"job": job} if job else None
+    for _labels, pts in ctx.store.range(series, labels=want,
+                                        since=ctx.since):
+        vals = [v for _, v in pts]
+        if len(vals) >= MIN_POINTS:
+            return _median(vals)
+    return None
+
+
+@doctor_rule("comm_bound",
+             "tenant's windowed pull_comm + push_comm wall fraction "
+             f"sustained at or above {_CP.COMM_BOUND_FRAC} (the "
+             "step-phase budget, metrics/phases.py) — model traffic, "
+             "not math, owns the step; packing this tenant tighter "
+             "makes it worse")
+def _comm_bound(ctx: DoctorContext) -> List[Diagnosis]:
+    out: List[Diagnosis] = []
+    for labels, pts in ctx.store.range("tenant.phase.pull_comm",
+                                       since=ctx.since):
+        vals = [v for _, v in pts]
+        if len(vals) < MIN_POINTS:
+            continue
+        job = labels.get("job")
+        pull_med = _median(vals)
+        push_med = _phase_median(ctx, "tenant.phase.push_comm", job) or 0.0
+        med = pull_med + push_med
+        if med < _CP.COMM_BOUND_FRAC:
+            continue
+        out.append(Diagnosis(
+            rule="comm_bound", verdict="comm_bound",
+            confidence=min(1.0, 0.5 + (med - _CP.COMM_BOUND_FRAC)),
+            summary=(f"tenant {job} is comm-bound: pull+push own "
+                     f"{med:.0%} of its step wall (pull {pull_med:.2f}, "
+                     f"push {push_med:.2f}) over {len(vals)} samples"),
+            window=(pts[0][0], pts[-1][0]),
+            job=job,
+            evidence={"series": "tenant.phase.pull_comm",
+                      "pull_median": round(pull_med, 4),
+                      "push_median": round(push_med, 4),
+                      "comm_fraction": round(med, 4),
+                      "points": ctx.excerpt(pts)}))
+    return out
+
+
+@doctor_rule("dispatch_bound",
+             "tenant's windowed host_dispatch wall fraction sustained "
+             f"at or above {_CP.DISPATCH_BOUND_FRAC} (the step-phase "
+             "budget) — host placement between batch-ready and device "
+             "dispatch gates the step; more chips would sit as idle as "
+             "the current ones")
+def _dispatch_bound(ctx: DoctorContext) -> List[Diagnosis]:
+    out: List[Diagnosis] = []
+    for labels, pts in ctx.store.range("tenant.phase.host_dispatch",
+                                       since=ctx.since):
+        vals = [v for _, v in pts]
+        if len(vals) < MIN_POINTS:
+            continue
+        med = _median(vals)
+        if med < _CP.DISPATCH_BOUND_FRAC:
+            continue
+        job = labels.get("job")
+        out.append(Diagnosis(
+            rule="dispatch_bound", verdict="dispatch_bound",
+            confidence=min(1.0, 0.5 + (med - _CP.DISPATCH_BOUND_FRAC)),
+            summary=(f"tenant {job} is dispatch-bound: host dispatch "
+                     f"owns {med:.0%} of its step wall over "
+                     f"{len(vals)} samples"),
+            window=(pts[0][0], pts[-1][0]),
+            job=job,
+            evidence={"series": "tenant.phase.host_dispatch",
+                      "median": round(med, 4),
+                      "points": ctx.excerpt(pts)}))
     return out
 
 

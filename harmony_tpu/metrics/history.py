@@ -733,6 +733,16 @@ class HistoryScraper:
                     if v is None:
                         continue  # unknown is unknown, not 0
                     self.store.ingest(series, labels, float(v), ts=ts)
+                # step-phase budget fold (metrics/phases.py): the
+                # ledger join carries each tenant's windowed phase
+                # FRACTIONS — first-class tenant.phase.* series, the
+                # comm_bound/dispatch_bound rules' raw material. An
+                # absent budget (no worker fed yet) stays unknown.
+                for p, v in (row.get("phases") or {}).items():
+                    if v is None:
+                        continue
+                    self.store.ingest(f"tenant.phase.{p}", labels,
+                                      float(v), ts=ts)
                 slo = row.get("slo") or {}
                 if slo.get("attainment") is not None:
                     self.store.ingest("tenant.slo_attainment", labels,

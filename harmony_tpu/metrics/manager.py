@@ -159,7 +159,8 @@ class MetricManager:
         return out
 
     def tenant_ledger(
-        self, window_sec: Optional[float] = None
+        self, window_sec: Optional[float] = None,
+        stragglers: Optional[Dict[str, Dict[str, Any]]] = None,
     ) -> Dict[str, Dict[str, Any]]:
         """Per-tenant device cost vectors (metrics/accounting.py) joined
         with this manager's straggler attribution — the one-call answer
@@ -169,13 +170,56 @@ class MetricManager:
         the same join. Keys are job ids; see docs/OBSERVABILITY.md
         "Tenant accounting" for the field glossary."""
         from harmony_tpu.metrics.accounting import ledger
+        from harmony_tpu.metrics.phases import peek_budget
 
         rows = ledger().snapshot(window_sec)
-        stragglers = self.straggler_report()
+        # ``stragglers`` lets one STATUS reply share a single report
+        # walk across its stragglers/tenants/phase_budget fields
+        if stragglers is None:
+            stragglers = self.straggler_report()
+        # step-phase budget join (metrics/phases.py): each tenant row
+        # carries its windowed phase FRACTIONS so the history scraper
+        # can fold them as first-class tenant.phase.* series; peek —
+        # a ledger query must not instantiate budget state
+        store = peek_budget()
+        budgets = (store.snapshot_memoized(window_sec)
+                   if store is not None else {})
         for jid, row in rows.items():
             rep = stragglers.get(jid)
             row["straggler_ratio"] = rep["ratio"] if rep else None
+            b = budgets.get(jid)
+            if b:
+                from harmony_tpu.metrics import critpath
+
+                row["phases"] = dict(b["fractions"])
+                row["phase_class"] = critpath.classify(b["fractions"])
+            else:
+                row["phases"] = None
+                row["phase_class"] = None
         return rows
+
+    def phase_budget(
+        self, window_sec: Optional[float] = None,
+        stragglers: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant step-phase budgets enriched with the critical-path
+        attribution (metrics/critpath.py): classification, dominant
+        phase, and per-epoch gating worker+phase — what STATUS
+        ``phase_budget`` and ``harmony-tpu obs critpath`` render. Empty
+        before any worker fed the budget store."""
+        from harmony_tpu.metrics import critpath
+        from harmony_tpu.metrics.phases import peek_budget
+
+        store = peek_budget()
+        if store is None:
+            return {}
+        # the memoized snapshot: one STATUS builds both its `tenants`
+        # join and this payload from ONE store walk (and may pass one
+        # shared straggler report the same way)
+        return critpath.analyze(
+            store.snapshot_memoized(window_sec),
+            stragglers=(stragglers if stragglers is not None
+                        else self.straggler_report()))
 
     def aggregate_throughput(self, job_id: Optional[str] = None) -> float:
         """Aggregate samples/sec across workers (the BASELINE north-star

@@ -161,6 +161,26 @@ class LayoutAnnouncerMixin:
         with self._lock:
             return getattr(self, "_layout_version", 0)
 
+    def set_comm_split(self, split) -> None:
+        """Publish the comm probe's measured per-step (pull_sec,
+        push_sec) device seconds for this table — chief-measured, read
+        by every sibling worker sharing the table (the probe blocks the
+        table lock for several round-trips; once per job per epoch is
+        enough). A TYPED accessor on purpose: the split used to be a
+        private-attr poke (``table._comm_split = ...``) that the
+        thread-shared-state lint could not see and downstream consumers
+        reached into; the lock here is the cross-thread publication
+        fence."""
+        with self._lock:
+            self._comm_split = (float(split[0]), float(split[1]))
+
+    def comm_split(self):
+        """The last published (pull_sec, push_sec) probe split, or None
+        before any probe ran — callers fall back to their own default
+        rather than inventing zeros."""
+        with self._lock:
+            return getattr(self, "_comm_split", None)
+
     def announce_reshard(self, new_mesh: Mesh) -> None:
         """Run listeners with the target mesh (outside the table lock —
         listeners dispatch device programs). Best-effort: a failing

@@ -65,6 +65,33 @@ def _tenant_snapshot() -> Dict[str, Any]:
         return {}
 
 
+def _phase_snapshot() -> Dict[str, Any]:
+    """Step-phase budget snapshot for a dump, or {} — same contract as
+    the tenant snapshot: peek, never create, never die harder."""
+    try:
+        from harmony_tpu.metrics.phases import peek_budget
+
+        store = peek_budget()
+        return store.snapshot() if store is not None else {}
+    except Exception:
+        return {}
+
+
+def profile_capture_path() -> Optional[str]:
+    """Newest sampled device-profile capture THIS process wrote, or
+    None — guarded once here for every surface (flight dumps and the
+    jobserver's STATUS): a dump that can point at the xplane trace of
+    the dying process's last epochs answers the post-mortem's second
+    question, and a STATUS reply must never fail because the profile
+    dir is odd."""
+    try:
+        from harmony_tpu.tracing.profiler import newest_capture
+
+        return newest_capture()
+    except Exception:
+        return None
+
+
 def _diagnoses_snapshot() -> List[Dict[str, Any]]:
     """Recent doctor diagnoses for a dump, or [] — same contract as the
     tenant snapshot: a dying process must never die HARDER because its
@@ -149,6 +176,14 @@ class FlightRecorder(SpanReceiver):
             # black box, so a post-mortem can tell a starved tenant from
             # a runaway one without a live scrape
             "tenants": _tenant_snapshot(),
+            # where inside the step each tenant's time was going when
+            # this process died (metrics/phases.py) — the budget beside
+            # the cost vectors, so a post-mortem can tell comm-starved
+            # from compute-saturated without a live scrape
+            "phase_budget": _phase_snapshot(),
+            # the newest sampled device-profile capture on disk, when
+            # the sampler ran (tracing/profiler.py)
+            "profile_capture": profile_capture_path(),
             # what the doctor had already concluded when this process
             # died (metrics/doctor.py) — a dump with "input_bound on
             # tenant X" inside answers the post-mortem's first question

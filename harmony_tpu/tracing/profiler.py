@@ -146,6 +146,40 @@ def rotate_profile_dir(root: str,
     return removed
 
 
+def newest_capture(root: Optional[str] = None,
+                   pid: Optional[int] = None) -> Optional[str]:
+    """Path of the NEWEST capture entry THIS process wrote under the
+    profile dir, or None when the sampler never ran (or the dir is
+    unreadable). STATUS and flight-recorder dumps surface this so the
+    xplane dump an incident needs is one field away instead of an
+    undiscovered file on disk.
+
+    The default dir is shared across runs and processes, so entries
+    are filtered to this process's captures (``maybe_profile_epoch``
+    names them ``<job>-e<epoch>-<pid>``) — a STATUS reply must not
+    point an incident responder at a week-old or foreign process's
+    dump. ``pid`` overrides the writer pid to match; ``pid=0`` matches
+    every capture."""
+    root = root or _profile_dir()
+    suffix = f"-{os.getpid() if pid is None else pid}"
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    newest, newest_m = None, -1.0
+    for n in names:
+        if pid != 0 and not n.endswith(suffix):
+            continue
+        p = os.path.join(root, n)
+        try:
+            m = os.path.getmtime(p)
+        except OSError:
+            continue
+        if m > newest_m:
+            newest, newest_m = p, m
+    return newest
+
+
 @contextlib.contextmanager
 def maybe_profile_epoch(epoch: int, job_id: str = "",
                         span: int = 1,

@@ -33,8 +33,11 @@ def _feed(store, name, labels, values, spacing=1.0, kind="gauge",
 class TestRuleCatalog:
     def test_shipped_rules_present_in_order(self):
         names = [r.name for r in all_rules()]
+        # slo_breach joins the others and must stay LAST (declaration
+        # order is evaluation order); the PR-13 phase rules sit before it
         assert names == ["input_bound", "straggler", "mfu_collapse",
-                         "compile_storm", "infra_suspect", "slo_breach"]
+                         "compile_storm", "infra_suspect", "comm_bound",
+                         "dispatch_bound", "slo_breach"]
         assert all(r.description for r in all_rules())
 
     def test_input_bound_fires_and_names_tenant(self):
