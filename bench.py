@@ -595,6 +595,12 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # HARMONY_OBS_SCRAPE_PERIOD cadence, so their overhead must be
         # measured, not assumed (pinned capture: OBS_DOCTOR_r11.json)
         line["obs_doctor"] = od
+    ha = measure_ha()
+    if ha is not None:
+        # control-plane HA costs: per-transition durable-append (fsync)
+        # overhead and standby takeover latency (election + fenced
+        # replay) — both must stay flat as the control plane grows
+        line["ha"] = ha
     cp = measure_critpath()
     if cp is not None:
         # step-phase budget computation + critical-path analysis wall
@@ -726,6 +732,61 @@ def measure_critpath() -> "dict | None":
             "budget_ms": round(sorted(budget_samples)[5], 3),
             "analyze_ms": round(sorted(analyze_samples)[5], 3),
             "tenants": tenants, "workers": workers, "epochs": epochs,
+        }
+    except Exception:
+        return None
+
+
+def measure_ha() -> "dict | None":
+    """Control-plane HA overhead probe (tracked round over round in
+    the BENCH json): durable log-append cost (write+flush+fsync per
+    control-plane transition — the tax every submission/dispatch/
+    completion now pays on an HA leader) and warm-standby takeover
+    latency (lease election + fenced replay + re-arm bookkeeping over
+    a populated log; the server-boot share is excluded — it is the
+    same cost a cold start pays). Returns {append_ms, appends_per_sec,
+    takeover_ms, replayed_entries} or None — the bench line must never
+    die for its HA hook."""
+    try:
+        import tempfile
+
+        from harmony_tpu.jobserver.halog import DurableJobLog, ReplayState
+        from harmony_tpu.jobserver.lease import LeaseManager
+
+        root = tempfile.mkdtemp(prefix="harmony-bench-ha-")
+        path = os.path.join(root, "job.walog")
+        log = DurableJobLog(path)
+        n = 256
+        t0 = time.perf_counter()
+        for i in range(n):
+            kind = ("submission", "dispatch", "job_done")[i % 3]
+            log.append(kind, job_id=f"bench-j{i % 8}",
+                       config={"job_id": f"bench-j{i % 8}", "k": i})
+        wall = time.perf_counter() - t0
+        log.close()
+        # takeover: election + reopen (torn-tail scan) + fenced replay
+        samples = []
+        replayed = 0
+        for r in range(5):
+            lease = LeaseManager(root, f"bench-rep-{r}", lease_s=30.0)
+            t0 = time.perf_counter()
+            if not lease.try_acquire():  # never assert: -O strips it,
+                raise RuntimeError("bench lease acquire failed")
+            relog = DurableJobLog(path)
+            relog.set_epoch(lease.epoch)
+            st = ReplayState.from_entries(relog.entries())
+            samples.append((time.perf_counter() - t0) * 1000.0)
+            replayed = st.entries_applied
+            relog.close()
+            lease.release()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+        return {
+            "append_ms": round(wall * 1000.0 / n, 4),
+            "appends_per_sec": round(n / wall, 1),
+            "takeover_ms": round(sorted(samples)[len(samples) // 2], 3),
+            "replayed_entries": replayed,
         }
     except Exception:
         return None

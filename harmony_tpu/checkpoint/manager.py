@@ -939,6 +939,22 @@ class CheckpointManager:
                 raise FileNotFoundError(f"no temp checkpoint {chkp_id}")
             self._backend.commit(chkp_id, src)
             shutil.rmtree(src)
+            # Structured commit pointer for the control plane: chain ids
+            # are job-prefixed (``<job>:...``); the event rides this
+            # process's joblog ring, and — when THIS process hosts an HA
+            # leader (leader-local jobs) — the sink tees it into the
+            # durable log. A chief-follower commit stays process-local;
+            # the takeover re-arm scans shared chain storage either way.
+            # Guarded lazy import: checkpointing must not hard-depend on
+            # the jobserver package.
+            if ":" in chkp_id:
+                try:
+                    from harmony_tpu.jobserver.joblog import record_event
+
+                    record_event(chkp_id.split(":", 1)[0], "chkp_chain",
+                                 chkp_id=chkp_id)
+                except Exception:
+                    pass
 
     def quarantine(self, chkp_id: str) -> None:
         """Move a DAMAGED checkpoint out of the restorable namespace

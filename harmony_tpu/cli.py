@@ -323,6 +323,22 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--chkp-root", default=None,
                    help="root for model-checkpoint chains / auto-resume "
                         "(default: $HARMONY_POD_CHKP_ROOT)")
+    p.add_argument("--ha-replica-id", default=None,
+                   help="HA control plane (set with HARMONY_HA_LOG_DIR; "
+                        "docs/DEPLOY.md §HA): this replica's stable "
+                        "identity (default: hostname)")
+    p.add_argument("--ha-advertise", default=None,
+                   help="HA: the host:port OTHER replicas should "
+                        "redirect clients to for this replica "
+                        "(NOT_LEADER replies; default 127.0.0.1:--port)")
+    p.add_argument("--ha-recv-port", type=int, default=None,
+                   help="HA: bind the standby log-receiver here "
+                        "(peer-replication mode, HARMONY_HA_REPLICAS); "
+                        "omit when replicas share HARMONY_HA_LOG_DIR")
+    p.add_argument("--ha-bind", default="127.0.0.1",
+                   help="HA: interface the submit/standby endpoint "
+                        "binds (0.0.0.0 when clients live on other "
+                        "hosts, e.g. the GKE control plane)")
 
     for name in ("submit", "run"):
         p = sub.add_parser(
@@ -333,7 +349,10 @@ def main(argv: List[str] | None = None) -> int:
         p.add_argument("app", choices=sorted(PRESETS))
         _common_job_flags(p)
         if name == "submit":
-            p.add_argument("--port", type=int, default=43110)
+            p.add_argument("--port", type=int, default=None,
+                           help="jobserver TCP port (default: the "
+                                "HARMONY_JOBSERVER_ADDRS replica list, "
+                                "then 43110)")
         else:
             p.add_argument("--num-executors", type=int, default=0)
 
@@ -359,17 +378,25 @@ def main(argv: List[str] | None = None) -> int:
                    help="shared/gs:// root for model-checkpoint chains, "
                         "auto-resume, deferred eval "
                         "(default: $HARMONY_POD_CHKP_ROOT; docs/DEPLOY.md)")
+    p.add_argument("--pod-leader-addrs", default=None,
+                   help="HA: comma-separated host:port control-plane "
+                        "endpoints a follower may re-HELLO after leader "
+                        "loss (default: the one leader it first joined; "
+                        "docs/DEPLOY.md §HA)")
 
     p = sub.add_parser("status", help="query a running jobserver")
-    p.add_argument("--port", type=int, default=43110)
+    p.add_argument("--port", type=int, default=None,
+                   help="default: $HARMONY_JOBSERVER_ADDRS, then 43110")
     p = sub.add_parser("shutdown", help="graceful jobserver shutdown")
-    p.add_argument("--port", type=int, default=43110)
+    p.add_argument("--port", type=int, default=None,
+                   help="default: $HARMONY_JOBSERVER_ADDRS, then 43110")
     p = sub.add_parser(
         "pod-reshard",
         help="live-migrate table blocks of a RUNNING pod job "
              "(applied at the given epoch on every process in lockstep)",
     )
-    p.add_argument("--port", type=int, default=43110)
+    p.add_argument("--port", type=int, default=None,
+                   help="default: $HARMONY_JOBSERVER_ADDRS, then 43110")
     p.add_argument("--job", required=True)
     p.add_argument("--src", required=True, help="source executor id")
     p.add_argument("--dst", required=True, help="destination executor id")
@@ -460,7 +487,6 @@ def main(argv: List[str] | None = None) -> int:
     if args.cmd == "start-pod":
         return _cmd_start_pod(args)
     if args.cmd == "submit":
-        from harmony_tpu.jobserver.client import CommandSender
         from harmony_tpu.tracing.span import trace_span
 
         cfg = build_config(args.app, args)
@@ -469,7 +495,8 @@ def main(argv: List[str] | None = None) -> int:
         # ONE trace_id starting here (even though this short-lived
         # process has no receiver of its own)
         with trace_span("cli.submit", app=args.app, job_id=cfg.job_id):
-            resp = CommandSender(args.port).send_job_submit_command(cfg)
+            resp = _cli_command(
+                lambda: _sender(args.port).send_job_submit_command(cfg))
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
     if args.cmd == "lint":
@@ -488,19 +515,16 @@ def main(argv: List[str] | None = None) -> int:
     if args.cmd == "run":
         return _cmd_run(args)
     if args.cmd == "pod-reshard":
-        from harmony_tpu.jobserver.client import CommandSender
-
-        resp = CommandSender(args.port).send_pod_reshard_command(
-            args.job, args.src, args.dst, args.blocks, args.epoch
-        )
+        resp = _cli_command(
+            lambda: _sender(args.port).send_pod_reshard_command(
+                args.job, args.src, args.dst, args.blocks, args.epoch))
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
     if args.cmd in ("status", "shutdown"):
-        from harmony_tpu.jobserver.client import CommandSender
-
-        sender = CommandSender(args.port)
-        resp = (sender.send_status_command() if args.cmd == "status"
-                else sender.send_shutdown_command())
+        sender = _sender(args.port)
+        resp = _cli_command(
+            lambda: (sender.send_status_command() if args.cmd == "status"
+                     else sender.send_shutdown_command()))
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
     if args.cmd == "dashboard":
@@ -636,8 +660,16 @@ def _cmd_obs(args: argparse.Namespace) -> int:
     fetch a trace timeline from the dashboard's span store. Output is
     made for piping (`| head`, `| grep`), so a closed pipe ends the
     command quietly instead of stack-tracing."""
+    from harmony_tpu.jobserver.client import NotLeaderError
+
     try:
         return _cmd_obs_inner(args)
+    except NotLeaderError as e:
+        # an explicitly addressed standby/deposed replica: the refusal
+        # is an answer (with the redirect), not a traceback
+        print(json.dumps({"ok": False, "not_leader": True,
+                          "error": str(e), "leader": e.leader}))
+        return 1
     except BrokenPipeError:
         import os
 
@@ -647,20 +679,49 @@ def _cmd_obs(args: argparse.Namespace) -> int:
 
 #: env knobs behind the shared ``obs`` endpoint resolution (documented
 #: in docs/OBSERVABILITY.md §6 / DEPLOY §7) — the flag always wins; the
-#: port-based STATUS commands fall back to the default submit port
+#: port-based STATUS commands fall back to the HA replica list
+#: (HARMONY_JOBSERVER_ADDRS), then the default submit port
 ENV_JOBSERVER_PORT = "HARMONY_JOBSERVER_PORT"
 ENV_METRICS_URL = "HARMONY_METRICS_URL"
 ENV_DASHBOARD_URL = "HARMONY_DASHBOARD_URL"
 _OBS_URL_KNOBS = {"metrics": ENV_METRICS_URL, "trace": ENV_DASHBOARD_URL}
 
 
+def _sender(port):
+    """CommandSender for the submit/status/shutdown/reshard commands:
+    an explicit --port wins; otherwise the HARMONY_JOBSERVER_ADDRS
+    replica list (failover + NOT_LEADER redirects — control-plane HA),
+    then the default submit port."""
+    from harmony_tpu.jobserver.client import CommandSender
+
+    if port is not None:
+        return CommandSender(int(port))
+    return CommandSender.from_env()
+
+
+def _cli_command(fn):
+    """Run one client command; a NOT_LEADER refusal from an explicitly
+    addressed standby/deposed replica comes back as the documented
+    one-line JSON reply (exit 1), never a raw traceback."""
+    from harmony_tpu.jobserver.client import NotLeaderError
+
+    try:
+        return fn()
+    except NotLeaderError as e:
+        return {"ok": False, "not_leader": True, "error": str(e),
+                "leader": e.leader}
+
+
 def _resolve_obs_endpoint(args: argparse.Namespace):
     """ONE endpoint resolution for every ``obs`` subcommand (the old
     shape made ``metrics``/``trace`` demand --url while the STATUS
     commands silently used a different flag): explicit flag, then the
-    env knob, then — for port-based commands only — the default submit
-    port. Returns ``("port", int)`` or ``("url", str)``; raises
-    SystemExit(2) with an error NAMING the env knob otherwise."""
+    env knobs — HARMONY_JOBSERVER_ADDRS (the HA replica list, so
+    ``obs`` keeps answering through a leader takeover) before
+    HARMONY_JOBSERVER_PORT — then, for port-based commands only, the
+    default submit port. Returns ``("port", int)``, ``("addrs",
+    [host:port, ...])`` or ``("url", str)``; raises SystemExit(2) with
+    an error NAMING the env knob otherwise."""
     import os
 
     if args.what in _OBS_URL_KNOBS:
@@ -672,6 +733,11 @@ def _resolve_obs_endpoint(args: argparse.Namespace):
         return "url", url.rstrip("/")
     if args.port is not None:
         return "port", int(args.port)
+    from harmony_tpu.jobserver.client import jobserver_addrs
+
+    addrs = jobserver_addrs()
+    if addrs:
+        return "addrs", addrs
     raw = os.environ.get(ENV_JOBSERVER_PORT, "").strip()
     if raw:
         try:
@@ -683,6 +749,16 @@ def _resolve_obs_endpoint(args: argparse.Namespace):
     return "port", 43110
 
 
+def _obs_status_sender(kind: str, endpoint):
+    """CommandSender for the STATUS-backed obs subcommands: a plain
+    port, or the HA replica list (failover + NOT_LEADER redirects)."""
+    from harmony_tpu.jobserver.client import CommandSender
+
+    if kind == "addrs":
+        return CommandSender(addrs=endpoint)
+    return CommandSender(endpoint)
+
+
 def _cmd_obs_inner(args: argparse.Namespace) -> int:
     import urllib.request
 
@@ -692,9 +768,7 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
         print(e.args[0], file=sys.stderr)
         return 2
     if args.what == "top":
-        from harmony_tpu.jobserver.client import CommandSender
-
-        status = CommandSender(endpoint).send_status_command()
+        status = _obs_status_sender(kind, endpoint).send_status_command()
         if not status.get("ok"):
             print(json.dumps(status))
             return 1
@@ -705,9 +779,7 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
             print(line)
         return 0
     if args.what == "flight":
-        from harmony_tpu.jobserver.client import CommandSender
-
-        status = CommandSender(endpoint).send_status_command()
+        status = _obs_status_sender(kind, endpoint).send_status_command()
         print(json.dumps({
             "flight_records": status.get("flight_records", []),
             "metrics_port": status.get("metrics_port"),
@@ -716,9 +788,7 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
         }, indent=2))
         return 0 if status.get("ok") else 1
     if args.what == "doctor":
-        from harmony_tpu.jobserver.client import CommandSender
-
-        status = CommandSender(endpoint).send_status_command()
+        status = _obs_status_sender(kind, endpoint).send_status_command()
         if not status.get("ok"):
             print(json.dumps(status))
             return 1
@@ -733,9 +803,7 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
             print(line)
         return 0
     if args.what == "critpath":
-        from harmony_tpu.jobserver.client import CommandSender
-
-        status = CommandSender(endpoint).send_status_command()
+        status = _obs_status_sender(kind, endpoint).send_status_command()
         if not status.get("ok"):
             print(json.dumps(status))
             return 1
@@ -948,6 +1016,10 @@ def _cmd_start_jobserver(args: argparse.Namespace) -> int:
     from harmony_tpu.tracing import flight
 
     flight.install_signal_dump()  # SIGTERM leaves a black box behind
+    from harmony_tpu.jobserver import ha as _ha
+
+    if _ha.ha_enabled():
+        return _cmd_start_jobserver_ha(args)
     server = _make_server(args.num_executors,
                           dashboard_url=args.dashboard_url,
                           chkp_root=_chkp_root_of(args))
@@ -963,6 +1035,54 @@ def _cmd_start_jobserver(args: argparse.Namespace) -> int:
             time.sleep(0.5)
     except KeyboardInterrupt:
         server.shutdown()
+    return 0
+
+
+def _cmd_start_jobserver_ha(args: argparse.Namespace) -> int:
+    """One HA control-plane replica (docs/DEPLOY.md §HA): stand by on
+    the submit port (NOT_LEADER + leader redirect), contend on the
+    shared lease, and on winning it replay the durable job log, re-arm
+    every in-flight submission, and serve. The server itself is built
+    LAZILY at takeover — a standby pays no executors."""
+    import os
+    import socket as _socket
+    import time
+
+    from harmony_tpu.jobserver.ha import HAController
+    from harmony_tpu.jobserver.lease import ha_log_dir
+
+    replica = (args.ha_replica_id or os.environ.get("HOSTNAME")
+               or _socket.gethostname())
+
+    def factory():
+        from harmony_tpu.jobserver.server import JobServer
+        from harmony_tpu.utils.devices import discover_devices
+
+        devices = discover_devices()
+        return JobServer(num_executors=args.num_executors or len(devices),
+                         dashboard_url=args.dashboard_url,
+                         chkp_root=_chkp_root_of(args))
+
+    ctl = HAController(
+        factory, log_dir=ha_log_dir(), replica_id=replica,
+        submit_port=args.port,
+        advertise_addr=args.ha_advertise or f"127.0.0.1:{args.port}",
+        recv_port=args.ha_recv_port,
+        bind_host=args.ha_bind,
+    ).start()
+    print(f"HA replica {replica} standing by on port {ctl.port} "
+          f"(log dir {ha_log_dir()})", flush=True)
+    try:
+        while True:
+            if ctl.wait_leader(timeout=0.5):
+                break
+        print(f"HA replica {replica} is LEADER on port {ctl.port} "
+              f"(epoch {ctl.lease.epoch}, replay {ctl.replay_ms} ms, "
+              f"{len(ctl.rearmed)} submission(s) re-armed)", flush=True)
+        while ctl.server is not None and ctl.server.state != "CLOSED":
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        ctl.stop()
     return 0
 
 
@@ -1017,7 +1137,16 @@ def _cmd_start_pod(args: argparse.Namespace) -> int:
     leader_host = coordinator.rsplit(":", 1)[0]
     print(f"pod follower {pid} joining {leader_host}:{args.pod_port}",
           flush=True)
-    PodFollower(leader_host, args.pod_port, pid, n_exec).run()
+    leader_addrs = None
+    if args.pod_leader_addrs:
+        leader_addrs = []
+        for a in args.pod_leader_addrs.split(","):
+            a = a.strip()
+            if a:
+                host, _, port = a.rpartition(":")
+                leader_addrs.append((host or "127.0.0.1", int(port)))
+    PodFollower(leader_host, args.pod_port, pid, n_exec,
+                leader_addrs=leader_addrs).run()
     return 0
 
 

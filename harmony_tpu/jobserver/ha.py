@@ -1,0 +1,491 @@
+"""Warm-standby JobServer failover — the control-plane HA capstone.
+
+Composition of the two primitives this package already grew:
+
+  * :mod:`harmony_tpu.jobserver.halog` — the durable, replicated,
+    CRC-framed log of control-plane state transitions;
+  * :mod:`harmony_tpu.jobserver.lease` — file-lease leader election
+    with fenced (monotonic) leader epochs.
+
+A control-plane replica runs ONE :class:`HAController`:
+
+  * **standby phase** — a minimal TCP endpoint answers on the submit
+    port immediately (STATUS with ``role=standby``; everything else
+    gets a ``NOT_LEADER`` reply carrying the current leader's
+    advertised address, which the failover client follows), and — in
+    peer-replication mode — a :class:`~halog.LogReceiver` applies the
+    leader's stream to the local log copy. The replica contends on the
+    lease at a fraction of the lease period.
+  * **takeover** — the moment the lease lands (the old leader died or
+    stopped renewing): replay the log (fenced — a deposed leader's
+    late writes are rejected), build the real JobServer through the
+    caller's factory, wire the durable log + lease into it
+    (``JobServer.enable_ha``), RE-ARM every in-flight submission
+    (accepted-but-never-completed in the log) from its committed
+    checkpoint chain — elastic jobs continue their attempt sequence
+    (``elastic_recovery`` attempt N+1, so stale reports from the old
+    leader's attempt can never be misattributed), chained jobs resume
+    via ``resume_from_chain``, chainless ones re-run from scratch —
+    and start serving the SAME submit port the standby endpoint just
+    vacated. Live pod followers re-HELLO on leader change
+    (``PodFollower`` reconnects on socket loss), keeping their pids,
+    executors and running attempts; trainers ride the existing
+    degrade patterns (inputsvc fallback, elastic fences) during the
+    takeover window.
+
+One structured ``kind="leader_takeover"`` joblog event records every
+takeover (old/new leader, replay ms, re-armed jobs, re-adopted pods);
+it rides STATUS, the durable log itself, and the ``leader_flap``
+doctor rule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from harmony_tpu.jobserver import joblog
+from harmony_tpu.jobserver.halog import (
+    LOG_FILENAME,
+    DurableJobLog,
+    LogReceiver,
+    LogReplicator,
+    ReplayState,
+)
+from harmony_tpu.jobserver.joblog import server_log
+from harmony_tpu.jobserver.lease import LeaseManager, replica_peers
+
+#: the pseudo-job id HA-level structured events are recorded under
+HA_JOB = "__ha__"
+
+
+def ha_enabled() -> bool:
+    from harmony_tpu.jobserver.lease import ha_log_dir
+
+    return ha_log_dir() is not None
+
+
+class StandbyEndpoint:
+    """Minimal TCP responder a standby runs on the submit port: STATUS
+    works (operators can see the replica exists and who leads);
+    anything mutating gets ``NOT_LEADER`` plus the leader's advertised
+    address so the failover client can redirect instead of guessing."""
+
+    def __init__(self, port: int, info_fn: Callable[[], Dict[str, Any]],
+                 leader_hint_fn: Callable[[], Optional[str]],
+                 host: str = "127.0.0.1") -> None:
+        self._port = port
+        self._host = host
+        self._info_fn = info_fn
+        self._leader_hint_fn = leader_hint_fn
+        self._sock: Optional[socket.socket] = None
+        self.port: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self._host, self._port))
+        sock.listen(16)
+        sock.settimeout(0.5)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ha-standby-tcp")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        # a thread blocked inside accept() keeps the PORT bound until it
+        # returns — and the takeover rebinds this exact port for the
+        # real server, so the vacate must be complete, not just begun
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                conn.settimeout(10.0)
+                data = b""
+                while not data.endswith(b"\n"):
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                try:
+                    cmd = json.loads(data.decode()).get("command")
+                except ValueError:
+                    cmd = None
+                if cmd == "STATUS":
+                    reply: Dict[str, Any] = {
+                        "ok": True, "state": "STANDBY", "running": [],
+                        "ha": self._info_fn(),
+                    }
+                else:
+                    reply = {
+                        "ok": False, "not_leader": True,
+                        "error": "NOT_LEADER: this replica is a warm "
+                                 "standby",
+                        "leader": self._leader_hint_fn(),
+                    }
+                conn.sendall((json.dumps(reply) + "\n").encode())
+            except OSError:
+                pass
+
+
+class HAController:
+    """One control-plane replica: standby until the lease lands, then
+    take over (module docstring). ``server_factory()`` returns an
+    UNSTARTED JobServer/PodJobServer; ``on_leader(server)`` (optional)
+    runs after ``server.start()`` and before the submit port opens —
+    the pod hook point (``serve_pod``)."""
+
+    def __init__(
+        self,
+        server_factory: Callable[[], Any],
+        log_dir: str,
+        replica_id: str,
+        submit_port: int = 0,
+        advertise_addr: Optional[str] = None,
+        recv_port: Optional[int] = None,
+        peers: Optional[List[str]] = None,
+        lease_s: Optional[float] = None,
+        on_leader: Optional[Callable[[Any], None]] = None,
+        bind_host: str = "127.0.0.1",
+    ) -> None:
+        self._factory = server_factory
+        self.log_dir = log_dir
+        self.replica_id = replica_id
+        self.submit_port = submit_port
+        self.advertise_addr = advertise_addr
+        self._recv_port = recv_port
+        self.peers = peers if peers is not None else replica_peers()
+        self._lease_s = lease_s
+        self._on_leader = on_leader
+        #: interface the standby endpoint AND the post-takeover server
+        #: bind — loopback by default (the single-machine contract);
+        #: cross-host deployments pass the advertised interface
+        #: (cli --ha-bind, deploy/gke/controlplane.yaml)
+        self.bind_host = bind_host
+        self.log_path = os.path.join(log_dir, LOG_FILENAME)
+        self.lease: Optional[LeaseManager] = None
+        self.server: Optional[Any] = None
+        self.receiver: Optional[LogReceiver] = None
+        self.standby: Optional[StandbyEndpoint] = None
+        self.port: Optional[int] = None
+        self.replay_ms: Optional[float] = None
+        self.rearmed: List[str] = []
+        self._stop = threading.Event()
+        self._leader_ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: guards port/receiver/server/replay bookkeeping — start()
+        #: runs on the caller's thread, _takeover on the controller's
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "HAController":
+        """Begin the standby→leader state machine on its own thread;
+        the standby endpoint answers the submit port before this
+        returns."""
+        standby = StandbyEndpoint(self.submit_port, self._standby_info,
+                                  self._leader_hint, host=self.bind_host)
+        port = standby.start()
+        with self._lock:
+            self.standby = standby
+            self.port = port
+        if self._recv_port is not None:
+            # peer-replication mode: this replica's LOCAL log copy is
+            # fed by the leader's stream. (Shared-volume mode must NOT
+            # open the shared file while the leader appends — it is
+            # opened once, at takeover.)
+            receiver = LogReceiver(DurableJobLog(self.log_path),
+                                   port=self._recv_port)
+            receiver.start()
+            with self._lock:
+                self.receiver = receiver
+        with self._lock:
+            self.lease = LeaseManager(
+                self.log_dir, self.replica_id, lease_s=self._lease_s,
+                addr=self.advertise_addr or f"127.0.0.1:{self.port}",
+                on_lost=self._on_deposed,
+            )
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ha-{self.replica_id}")
+        self._thread.start()
+        return self
+
+    def wait_leader(self, timeout: Optional[float] = None) -> bool:
+        """Block until THIS replica has completed a takeover."""
+        return self._leader_ready.wait(timeout)
+
+    def stop(self, shutdown_timeout: float = 60.0) -> None:
+        self._stop.set()
+        if self.lease is not None:
+            self.lease.stop()
+        if self.standby is not None:
+            self.standby.stop()
+        with self._lock:
+            receiver, self.receiver = self.receiver, None
+            server, self.server = self.server, None
+        if receiver is not None:
+            receiver.stop()
+            receiver.log.close()
+        if server is not None:
+            try:
+                server.shutdown(timeout=shutdown_timeout)
+            except Exception:
+                pass
+        if self.lease is not None:
+            self.lease.release()
+
+    # -- standby ---------------------------------------------------------
+
+    def _standby_info(self) -> Dict[str, Any]:
+        return {
+            "enabled": True,
+            "role": "standby",
+            "replica": self.replica_id,
+            "leader": self._leader_hint(),
+            "log": (self.receiver.stats()
+                    if self.receiver is not None else None),
+        }
+
+    def _leader_hint(self) -> Optional[str]:
+        """The live leader's advertised submit address, from the lease
+        file — the redirect target NOT_LEADER replies carry."""
+        from harmony_tpu.jobserver.lease import leader_hint, read_lease
+
+        cur = read_lease(self.log_dir)
+        if cur and cur.get("holder") == self.replica_id:
+            return self.advertise_addr
+        return leader_hint(self.log_dir)
+
+    def _on_deposed(self) -> None:
+        """This replica lost a lease it held. The server (if any) is
+        already fenced — its lease went invalid, so submits answer
+        NOT_LEADER and durable appends are refused — but say so loudly;
+        split-brain avoidance depends on the operator seeing this."""
+        server_log.error(
+            "HA replica %s DEPOSED at epoch %s: a successor holds the "
+            "lease; this server now answers NOT_LEADER",
+            self.replica_id,
+            self.lease.epoch if self.lease is not None else "?")
+
+    # -- takeover --------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.lease.wait_acquire():
+                return  # stopped while standing by
+            if self._stop.is_set():
+                return
+            # renewal starts the moment the lease lands — the takeover
+            # itself (server factory = jax runtime init, log replay)
+            # can easily outlast one lease window, and an unrenewed
+            # lease mid-takeover would let a peer elect itself and run
+            # the same re-armed submissions concurrently
+            self.lease.start_renewal()
+            try:
+                self._takeover()
+                return
+            except Exception as e:  # noqa: BLE001 - a failed takeover
+                # must be visible, the lease released so a peer can
+                # try, and THIS replica must return to standby — an
+                # inert process that neither answers its port nor
+                # contends would silently shrink the replica set
+                server_log.error("HA takeover by %s FAILED: %s: %s",
+                                 self.replica_id, type(e).__name__, e)
+                self.lease.release()
+                if self._stop.wait(max(0.2, self.lease.lease_s / 2.0)):
+                    return
+                self._restandby()
+
+    def _restandby(self) -> None:
+        """Rebuild the standby phase after a failed takeover: re-open
+        the standby endpoint (the takeover stopped it) on the same
+        port, and a FRESH lease manager (release() stopped the old
+        one's event machinery)."""
+        standby = StandbyEndpoint(
+            self.port or self.submit_port, self._standby_info,
+            self._leader_hint, host=self.bind_host)
+        with self._lock:
+            self.standby = standby
+        try:
+            port = standby.start()
+            with self._lock:
+                self.port = port
+        except OSError as e:
+            # the port may be momentarily unreleasable after a failed
+            # serve_tcp bind; standing by without the endpoint is still
+            # better than exiting — the replica keeps contending
+            server_log.warning(
+                "HA %s: standby endpoint re-bind failed (%s); standing "
+                "by without it", self.replica_id, e)
+        with self._lock:
+            self.lease = LeaseManager(
+                self.log_dir, self.replica_id, lease_s=self._lease_s,
+                addr=self.advertise_addr or f"127.0.0.1:{self.port}",
+                on_lost=self._on_deposed,
+            )
+
+    def _takeover(self) -> None:
+        from harmony_tpu import faults
+
+        t0 = time.perf_counter()
+        prev = self.lease.previous or {}
+        if faults.armed():
+            faults.site("jobserver.takeover", replica=self.replica_id,
+                        epoch=self.lease.epoch)
+        # the standby endpoint vacates the submit port for the real
+        # server; the receiver's stream is superseded by leadership
+        with self._lock:
+            receiver, self.receiver = self.receiver, None
+        if receiver is not None:
+            receiver.stop()
+            receiver.log.close()
+        self.standby.stop()
+        log = DurableJobLog(self.log_path)  # truncates any torn tail
+        server = None
+        try:
+            log.set_epoch(self.lease.epoch)
+            state = ReplayState.from_entries(log.entries())
+            # the REPLAYED takeover history seeds this process's joblog
+            # ring BEFORE enable_ha hooks the durable sink (no
+            # re-append): leader_flap and STATUS must see the cluster's
+            # takeover history, not just this process's own event —
+            # every takeover happens in a different process
+            for e in state.takeovers[-8:]:
+                joblog.record_event(
+                    HA_JOB, "leader_takeover",
+                    **{k: v for k, v in e.items()
+                       if k not in ("seq", "epoch", "kind", "job")})
+            replicator = (LogReplicator(log, self.peers)
+                          if self.peers else None)
+            server = self._factory()
+            server.enable_ha(log, lease=self.lease, replicator=replicator,
+                             replica_id=self.replica_id)
+            server.start()
+            if self._on_leader is not None:
+                self._on_leader(server)
+            port = server.serve_tcp(self.submit_port or (self.port or 0),
+                                    host=self.bind_host)
+            if not self.lease.is_valid():
+                # the lease lapsed mid-takeover despite renewals (store
+                # unreachable): a successor may already lead — abort
+                # BEFORE re-arming anything
+                raise RuntimeError("lease lapsed during takeover")
+            rearmed = self._rearm(server, state)
+        except BaseException:
+            # a half-complete takeover must not leak a running server,
+            # an open log handle, or a registered joblog sink into the
+            # re-standby cycle
+            if server is not None:
+                try:
+                    server.shutdown(timeout=15.0)  # _stop_ha closes log
+                except Exception:
+                    pass
+            else:
+                log.close()
+            raise
+        with self._lock:
+            self.port = port
+            self.rearmed = rearmed
+            self.replay_ms = round((time.perf_counter() - t0) * 1000.0, 2)
+            self.server = server
+        pods = sorted(getattr(server, "_followers", {}) or {})
+        ev = joblog.record_event(
+            HA_JOB, "leader_takeover",
+            old_leader=prev.get("holder"),
+            new_leader=self.replica_id,
+            epoch=self.lease.epoch,
+            replay_ms=self.replay_ms,
+            replayed_entries=state.entries_applied,
+            rejected_stale=state.rejected_stale,
+            rearmed=list(self.rearmed),
+            readopted_pods=pods,
+        )
+        dash = getattr(server, "_dashboard", None)
+        if dash is not None:
+            # same best-effort recovery-row contract as the pod events:
+            # the dashboard's per-job recoveries column shows takeovers
+            try:
+                dash.post(HA_JOB, "recovery", dict(ev))
+            except Exception:
+                pass
+        server_log.info(
+            "HA takeover complete: %s leads at epoch %d (replay %.1f ms, "
+            "%d in-flight submission(s) re-armed, port %d)",
+            self.replica_id, self.lease.epoch, self.replay_ms,
+            len(self.rearmed), self.port)
+        self._leader_ready.set()
+
+    def _rearm(self, server: Any, state: ReplayState) -> List[str]:
+        """Re-arm every in-flight submission from the replayed log:
+        elastic jobs continue their attempt sequence, chained jobs
+        resume from the last committed chain entry, chainless ones
+        re-run from scratch (nothing of theirs was ever committed)."""
+        from harmony_tpu.config.base import ConfigBase
+
+        rearmed: List[str] = []
+        for job in state.in_flight():
+            try:
+                cfg = ConfigBase.from_dict(state.submissions[job])
+                has_chain = self._has_chain(server, job)
+                if has_chain and cfg.user.get("elastic_shrink"):
+                    # continue the SAME submission's attempt sequence:
+                    # the attempt key isolates any straggling report
+                    # from an attempt the dead leader had in flight
+                    cfg.user["elastic_recovery"] = {
+                        "attempt": state.attempts.get(job, 0) + 1,
+                        "kind": "shrink",
+                        "lost_executors": [],
+                    }
+                elif has_chain:
+                    cfg.user["resume_from_chain"] = True
+                server.submit(cfg)
+                rearmed.append(job)
+            except Exception as e:  # noqa: BLE001 - re-arm the rest
+                server_log.error(
+                    "takeover re-arm of %s failed: %s: %s",
+                    job, type(e).__name__, e)
+        return rearmed
+
+    @staticmethod
+    def _has_chain(server: Any, job: str) -> bool:
+        root = getattr(server, "_chkp_root", None)
+        if not root:
+            return False
+        try:
+            from harmony_tpu.checkpoint.manager import CheckpointManager
+
+            mgr = CheckpointManager.for_job(root, job)
+            prefix = f"{job}:"
+            return any(c.startswith(prefix)
+                       for c in mgr.list_checkpoints())
+        except Exception:
+            return False

@@ -1,0 +1,567 @@
+"""Durable replicated job log — the control plane's source of truth.
+
+PR 3's joblog gave the recovery paths a STRUCTURED event stream, but it
+is per-process and in-memory: when the JobServer leader dies, every
+submission, attempt, fence and chain pointer dies with it. This module
+promotes that stream into an append-only, fsync'd, CRC-framed on-disk
+log of control-plane state transitions — submission accepted (config +
+``_trace`` included, so a takeover can re-arm the SAME submission),
+dispatch, attempt start/end, elastic fence/shrink/re-grow, checkpoint
+chain commits, completion — plus the machinery a warm standby needs:
+
+  * :class:`DurableJobLog` — the on-disk log. One record per entry:
+    ``u32 length | u32 crc32(payload) | payload`` (little-endian, JSON
+    payload). Appends are a single write + flush + fsync, so a
+    committed record survives process death; replay tolerates a TORN
+    TAIL (a crash mid-append) by truncating at the last whole,
+    CRC-valid record — exactly the torn-commit stance the checkpoint
+    chain takes (manifest-written-last).
+  * :class:`LogReplicator` / :class:`LogReceiver` — leader→standby
+    streaming over the PR-5 framed-stream wire (utils/framing.py): the
+    receiver opens with its last applied seq, the replicator streams
+    the missing suffix from disk (catch-up after any gap) and then
+    live entries; reconnects re-run the same handshake, so replication
+    is idempotent by seq.
+  * :class:`ReplayState` — reconstructs scheduler/arbiter/elastic
+    state from the entries: in-flight submissions (accepted minus
+    completed), last attempt per job, committed chain pointers, and
+    the takeover history. FENCED: entries stamped with a leader epoch
+    lower than one already replayed are a deposed leader's late writes
+    and are rejected, never applied.
+
+The reference system's long-running JobServer master keeps all of this
+in one process (SURVEY.md §0); parameter-service systems make the same
+state durable so aggregation survives server churn (arXiv:2204.03211),
+and TensorFlow's long-running training leans on durable state +
+re-adoption across coordinator restarts (arXiv:1605.08695).
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from harmony_tpu.jobserver.joblog import server_log
+from harmony_tpu.utils.framing import read_exact, send_frame_parts, set_nodelay
+
+_HEADER = struct.Struct("<II")  # payload length, crc32(payload)
+#: sanity bound on one record — a length field past this is torn/garbage
+_MAX_RECORD = 16 << 20
+#: default on-disk log filename under HARMONY_HA_LOG_DIR
+LOG_FILENAME = "job.walog"
+
+
+class StaleEpochError(RuntimeError):
+    """A write stamped with a leader epoch older than one the log has
+    already accepted — a deposed leader's late append. Rejecting it is
+    the fencing contract: after a takeover, nothing the old leader
+    still has in flight can contaminate the new leader's history."""
+
+    def __init__(self, entry_epoch: int, fence_epoch: int) -> None:
+        super().__init__(
+            f"fenced: entry epoch {entry_epoch} < log epoch {fence_epoch} "
+            "(a deposed leader's late write)"
+        )
+        self.entry_epoch = entry_epoch
+        self.fence_epoch = fence_epoch
+
+
+def encode_record(payload: bytes) -> bytes:
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def scan_records(path: str) -> Tuple[List[Dict[str, Any]], int, int]:
+    """Scan the log file: returns (entries, good_bytes, torn_bytes).
+    ``good_bytes`` is the offset of the last whole CRC-valid record's
+    end; anything past it (a torn tail from a crash mid-append, or
+    trailing corruption) counts in ``torn_bytes`` and is NOT decoded."""
+    entries: List[Dict[str, Any]] = []
+    good = 0
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(_HEADER.size)
+                if len(head) < _HEADER.size:
+                    break
+                length, crc = _HEADER.unpack(head)
+                if length > _MAX_RECORD:
+                    break
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                try:
+                    entries.append(json.loads(payload.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    break  # framed but unparseable: treat as torn
+                good = f.tell()
+    except FileNotFoundError:
+        return [], 0, 0
+    return entries, good, max(0, size - good)
+
+
+class DurableJobLog:
+    """Append-only fsync'd control-plane log (module docstring).
+
+    ``fence_epoch`` is the highest leader epoch the log has accepted;
+    :meth:`append` rejects lower-epoch writes with
+    :class:`StaleEpochError`. Appends tee to registered sinks (the
+    replicator) AFTER the record is durable — a standby can never hold
+    an entry the leader's disk does not."""
+
+    def __init__(self, path: str, fsync: bool = True) -> None:
+        self.path = path
+        self._fsync = fsync
+        entries, good, torn = scan_records(path)
+        if torn:
+            server_log.warning(
+                "halog: truncating %d torn byte(s) at the tail of %s "
+                "(%d whole record(s) kept)", torn, path, len(entries))
+            with open(path, "rb+") as f:
+                f.truncate(good)
+        self.torn_recovered = torn
+        self._lock = threading.Lock()
+        self._seq = max((int(e.get("seq", 0)) for e in entries), default=0)
+        self.fence_epoch = max(
+            (int(e.get("epoch", 0)) for e in entries), default=0)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        self._sinks: List[Callable[[Dict[str, Any], bytes], None]] = []
+        self.appends = 0
+        self.append_bytes = 0
+        #: cumulative seconds spent inside durable appends (the
+        #: write+flush+fsync cost the bench hook tracks)
+        self.append_seconds = 0.0
+
+    # -- write side ------------------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        """Raise the fence floor (a freshly elected leader stamps its
+        lease epoch here before its first append)."""
+        with self._lock:
+            if epoch < self.fence_epoch:
+                raise StaleEpochError(epoch, self.fence_epoch)
+            self.fence_epoch = int(epoch)
+
+    def append(self, kind: str, job_id: Optional[str] = None,
+               epoch: Optional[int] = None, seq: Optional[int] = None,
+               **fields: Any) -> Dict[str, Any]:
+        """Append one durable entry; returns it (with seq/epoch/ts).
+        Raises StaleEpochError for a fenced (deposed-leader) write.
+        ``seq`` preserves an upstream sequence number (the replication
+        receiver passes the LEADER's seq verbatim, so the local copy's
+        numbering can never drift from the stream it mirrors); local
+        writers leave it None and get the next local seq."""
+        from harmony_tpu import faults
+
+        with self._lock:
+            ep = self.fence_epoch if epoch is None else int(epoch)
+            if ep < self.fence_epoch:
+                raise StaleEpochError(ep, self.fence_epoch)
+            self.fence_epoch = ep
+            self._seq = int(seq) if seq is not None \
+                else self._seq + 1
+            entry = {"seq": self._seq, "epoch": ep, "ts": time.time(),
+                     "kind": kind, "job": job_id, **fields}
+            if faults.armed():
+                # "raise" here models a failing log disk; "delay" a slow
+                # fsync — both surface to the caller like the real fault
+                faults.site("jobserver.log_append", kind=kind,
+                            seq=self._seq)
+            payload = json.dumps(entry, sort_keys=True,
+                                 default=repr).encode()
+            rec = encode_record(payload)
+            t0 = time.perf_counter()
+            self._f.write(rec)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self.append_seconds += time.perf_counter() - t0
+            self.appends += 1
+            self.append_bytes += len(rec)
+            # sinks run UNDER the append lock: two concurrent appends
+            # must enqueue into the replicator in seq order, or the
+            # receiver's seq-idempotence would drop the late-arriving
+            # lower seq as a duplicate — a silent, permanent hole in
+            # the standby's log. (Sink work is a queue append; the
+            # replicator never takes this lock from inside its cond.)
+            for sink in self._sinks:
+                try:
+                    sink(entry, rec)
+                except Exception:  # replication is best-effort per
+                    pass           # append; catch-up repairs gaps
+        return entry
+
+    def add_sink(self, fn: Callable[[Dict[str, Any], bytes], None]) -> None:
+        with self._lock:
+            self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    # -- read side -------------------------------------------------------
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def entries(self, since_seq: int = 0) -> List[Dict[str, Any]]:
+        """Whole-file scan (torn tail skipped, never truncated here),
+        filtered to seq > ``since_seq`` — the replicator's catch-up
+        source and the takeover replay input."""
+        with self._lock:
+            self._f.flush()
+        out, _good, _torn = scan_records(self.path)
+        return [e for e in out if int(e.get("seq", 0)) > since_seq]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "path": self.path,
+                "last_seq": self._seq,
+                "fence_epoch": self.fence_epoch,
+                "appends": self.appends,
+                "append_bytes": self.append_bytes,
+                "append_seconds": round(self.append_seconds, 6),
+                "torn_recovered_bytes": self.torn_recovered,
+                "sinks": len(self._sinks),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# -- replication ------------------------------------------------------------
+
+
+def _send_record(sock: socket.socket, payload: bytes) -> None:
+    send_frame_parts(
+        sock, _HEADER.pack(len(payload), zlib.crc32(payload)), [payload])
+
+
+def _recv_record(sock: socket.socket) -> Optional[bytes]:
+    head = read_exact(sock, _HEADER.size)
+    if head is None:
+        return None
+    length, crc = _HEADER.unpack(bytes(head))
+    if length > _MAX_RECORD:
+        raise ValueError(f"replication frame length {length} exceeds cap")
+    payload = read_exact(sock, length)
+    if payload is None:
+        return None
+    payload = bytes(payload)
+    if zlib.crc32(payload) != crc:
+        raise ValueError("replication frame CRC mismatch")
+    return payload
+
+
+class LogReplicator:
+    """Leader side: stream every durable entry to the standby receivers
+    named by ``peers`` (``host:port`` strings — HARMONY_HA_REPLICAS).
+    One daemon thread per peer: connect (bounded backoff), read the
+    receiver's ``{"last_seq": n}`` hello, send the missing suffix from
+    disk, then drain the live queue. Any error drops the connection;
+    the reconnect handshake re-runs catch-up, so a gap is repaired, not
+    accumulated."""
+
+    def __init__(self, log: DurableJobLog, peers: List[str],
+                 connect_timeout: float = 5.0) -> None:
+        self.log = log
+        self.peers = list(peers)
+        self._connect_timeout = connect_timeout
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[bytes]] = {p: [] for p in self.peers}
+        self._cond = threading.Condition(self._lock)
+        self._state: Dict[str, Dict[str, Any]] = {
+            p: {"connected": False, "sent_seq": 0, "reconnects": 0,
+                "resync": False}
+            for p in self.peers
+        }
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        self.log.add_sink(self._on_append)
+        for peer in self.peers:
+            t = threading.Thread(target=self._peer_loop, args=(peer,),
+                                 daemon=True, name=f"halog-repl-{peer}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self.log.remove_sink(self._on_append)
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _on_append(self, entry: Dict[str, Any], rec: bytes) -> None:
+        with self._cond:
+            for peer, q in self._queues.items():
+                q.append(rec)
+                # bound leader memory under a slow/dead standby: drop
+                # the buffered backlog AND force that peer's connection
+                # to resync — a silent mid-stream drop would be a gap
+                # the receiver never notices; the reconnect handshake
+                # re-reads the missing suffix from disk instead
+                if len(q) > 4096:
+                    q.clear()
+                    self._state[peer]["resync"] = True
+            self._cond.notify_all()
+
+    def _peer_loop(self, peer: str) -> None:
+        host, _, port = peer.rpartition(":")
+        delay = 0.2
+        while not self._stop.is_set():
+            try:
+                with socket.create_connection(
+                        (host or "127.0.0.1", int(port)),
+                        timeout=self._connect_timeout) as sock:
+                    set_nodelay(sock)
+                    sock.settimeout(30.0)
+                    hello = _recv_record(sock)
+                    if hello is None:
+                        raise OSError("receiver closed during hello")
+                    last_seq = int(json.loads(hello.decode())
+                                   .get("last_seq", 0))
+                    with self._cond:
+                        self._queues[peer].clear()
+                        self._state[peer]["connected"] = True
+                        self._state[peer]["resync"] = False
+                    # catch-up: everything the receiver is missing,
+                    # re-framed from disk (the gap repair)
+                    sent = last_seq
+                    for e in self.log.entries(since_seq=last_seq):
+                        payload = json.dumps(e, sort_keys=True,
+                                             default=repr).encode()
+                        _send_record(sock, payload)
+                        sent = int(e["seq"])
+                    with self._cond:
+                        self._state[peer]["sent_seq"] = sent
+                    delay = 0.2
+                    while not self._stop.is_set():
+                        with self._cond:
+                            while (not self._queues[peer]
+                                   and not self._state[peer]["resync"]
+                                   and not self._stop.is_set()):
+                                self._cond.wait(timeout=1.0)
+                            if self._state[peer]["resync"]:
+                                # backlog overflowed mid-connection:
+                                # reconnect so catch-up repairs the gap
+                                raise OSError(
+                                    "replication backlog overflow")
+                            batch = self._queues[peer][:]
+                            self._queues[peer].clear()
+                        for rec in batch:
+                            sock.sendall(rec)
+                        if batch:
+                            # read the log's seq BEFORE taking the cond:
+                            # append holds log._lock while calling the
+                            # sink (which takes this cond) — taking the
+                            # locks here in the opposite order would be
+                            # a classic ABBA deadlock
+                            last = self.log.last_seq
+                            with self._cond:
+                                self._state[peer]["sent_seq"] = last
+            except (OSError, ValueError) as e:
+                with self._cond:
+                    if self._state[peer]["connected"]:
+                        server_log.warning(
+                            "halog replicator: peer %s dropped (%s); "
+                            "will catch up on reconnect", peer, e)
+                    self._state[peer]["connected"] = False
+                    self._state[peer]["reconnects"] += 1
+                if self._stop.wait(delay):
+                    return
+                delay = min(delay * 2, 5.0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {p: dict(s) for p, s in self._state.items()}
+
+
+class LogReceiver:
+    """Standby side: accept the leader's replication stream and append
+    received entries to the LOCAL durable log, so a takeover can replay
+    from this replica's own disk. Seq-idempotent (duplicates from a
+    catch-up overlap are skipped) and epoch-fenced (entries below the
+    local fence epoch are rejected and counted)."""
+
+    def __init__(self, log: DurableJobLog, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.log = log
+        self._host = host
+        self._port = port
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.port: Optional[int] = None
+        self.received = 0
+        self.rejected_stale = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self._host, self._port))
+        sock.listen(4)
+        sock.settimeout(1.0)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="halog-recv")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._thread is not None:
+            # the accept loop keeps the port bound until it returns; a
+            # stopped receiver must have fully vacated it (reuse/tests)
+            self._thread.join(timeout=3.0)
+            self._thread = None
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except (OSError, AttributeError):
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="halog-recv-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                set_nodelay(conn)
+                conn.settimeout(60.0)
+                _send_record(conn, json.dumps(
+                    {"last_seq": self.log.last_seq}).encode())
+                while not self._stop.is_set():
+                    payload = _recv_record(conn)
+                    if payload is None:
+                        return
+                    entry = json.loads(payload.decode())
+                    self._apply(entry)
+            except (OSError, ValueError) as e:
+                if not self._stop.is_set():
+                    server_log.warning(
+                        "halog receiver: stream error (%s); awaiting "
+                        "reconnect", e)
+
+    def _apply(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            if int(entry.get("seq", 0)) <= self.log.last_seq:
+                return  # catch-up overlap: seq-idempotent
+            try:
+                fields = {k: v for k, v in entry.items()
+                          if k not in ("seq", "epoch", "ts", "kind", "job")}
+                self.log.append(entry["kind"], job_id=entry.get("job"),
+                                epoch=int(entry.get("epoch", 0)),
+                                seq=int(entry["seq"]), **fields)
+                self.received += 1
+            except StaleEpochError:
+                self.rejected_stale += 1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"port": self.port, "received": self.received,
+                    "rejected_stale": self.rejected_stale,
+                    "last_seq": self.log.last_seq}
+
+
+# -- replay ------------------------------------------------------------------
+
+
+class ReplayState:
+    """Control-plane state reconstructed from log entries (fenced):
+    what a freshly elected leader needs to re-arm the cluster."""
+
+    def __init__(self) -> None:
+        #: job -> the accepted JobConfig dict (kind="submission")
+        self.submissions: Dict[str, Dict[str, Any]] = {}
+        #: job -> terminal entry (kind="job_done")
+        self.done: Dict[str, Dict[str, Any]] = {}
+        #: job -> highest elastic attempt index seen
+        self.attempts: Dict[str, int] = {}
+        #: job -> newest committed chain checkpoint id (kind="chkp_chain")
+        self.chains: Dict[str, str] = {}
+        #: takeover history entries, oldest first
+        self.takeovers: List[Dict[str, Any]] = []
+        self.max_epoch = 0
+        self.max_seq = 0
+        #: deposed-leader writes rejected during replay (fencing proof)
+        self.rejected_stale = 0
+        self.entries_applied = 0
+
+    @classmethod
+    def from_entries(cls, entries: List[Dict[str, Any]]) -> "ReplayState":
+        st = cls()
+        for e in sorted(entries, key=lambda e: int(e.get("seq", 0))):
+            ep = int(e.get("epoch", 0))
+            if ep < st.max_epoch:
+                st.rejected_stale += 1
+                continue  # fenced: a deposed leader's late write
+            st.max_epoch = ep
+            st.max_seq = max(st.max_seq, int(e.get("seq", 0)))
+            st.entries_applied += 1
+            kind = e.get("kind")
+            job = e.get("job")
+            if kind == "submission" and job:
+                st.submissions[job] = e.get("config") or {}
+                # a RE-submission of a finished id is a new lifecycle
+                st.done.pop(job, None)
+            elif kind == "job_done" and job:
+                st.done[job] = e
+            elif kind == "chkp_chain" and job and e.get("chkp_id"):
+                st.chains[job] = str(e["chkp_id"])
+            elif kind == "leader_takeover":
+                st.takeovers.append(e)
+            if job and "attempt" in e:
+                try:
+                    st.attempts[job] = max(st.attempts.get(job, 0),
+                                           int(e["attempt"]))
+                except (TypeError, ValueError):
+                    pass
+        return st
+
+    def in_flight(self) -> List[str]:
+        """Submissions accepted but never completed — what a takeover
+        must re-arm (oldest-accepted first, the original order)."""
+        return [j for j in self.submissions if j not in self.done]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "submissions": len(self.submissions),
+            "in_flight": self.in_flight(),
+            "done": len(self.done),
+            "chains": len(self.chains),
+            "takeovers": len(self.takeovers),
+            "max_epoch": self.max_epoch,
+            "max_seq": self.max_seq,
+            "rejected_stale": self.rejected_stale,
+        }
+
+
+def replay_file(path: str) -> ReplayState:
+    entries, _good, _torn = scan_records(path)
+    return ReplayState.from_entries(entries)

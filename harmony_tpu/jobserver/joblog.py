@@ -31,19 +31,52 @@ _EVENTS_LOCK = threading.Lock()
 _EVENTS: Dict[str, List[Dict[str, Any]]] = {}
 _EVENTS_PER_JOB = 64
 _EVENTS_MAX_JOBS = 256
+#: durable sinks (jobserver/halog.py): every structured event tees here
+#: so control-plane transitions reach the replicated on-disk log. Sinks
+#: must never fail the recording path.
+_SINKS: List[Any] = []
 
 
 def record_event(job_id: str, kind: str, **fields: Any) -> Dict[str, Any]:
     """Append one structured event to ``job_id``'s ring. ``fields`` must
-    be JSON-serializable (they ride the status endpoint verbatim)."""
+    be JSON-serializable (they ride the status endpoint verbatim).
+
+    Eviction is least-recently-APPENDED: re-inserting the ring under its
+    key on every append keeps dict order = activity order, so the jobs
+    popped at the cap are the ones longest silent — a long-lived busy
+    job can no longer be evicted while dead jobs linger (the old loop
+    popped in plain insertion order)."""
     ev = {"ts": time.time(), "kind": kind, **fields}
     with _EVENTS_LOCK:
-        ring = _EVENTS.setdefault(job_id, [])
+        ring = _EVENTS.pop(job_id, None)
+        if ring is None:
+            ring = []
         ring.append(ev)
         del ring[:-_EVENTS_PER_JOB]
+        _EVENTS[job_id] = ring  # re-insert: now the most recently active
         while len(_EVENTS) > _EVENTS_MAX_JOBS:
             _EVENTS.pop(next(iter(_EVENTS)))
+        sinks = list(_SINKS)
+    for sink in sinks:
+        try:
+            sink(job_id, ev)
+        except Exception:
+            pass  # durability tee must never fail the event path
     return ev
+
+
+def add_sink(fn) -> None:
+    """Register a ``fn(job_id, event_dict)`` tee on every recorded
+    event (the HA durable log registers here)."""
+    with _EVENTS_LOCK:
+        if fn not in _SINKS:
+            _SINKS.append(fn)
+
+
+def remove_sink(fn) -> None:
+    with _EVENTS_LOCK:
+        if fn in _SINKS:
+            _SINKS.remove(fn)
 
 
 def job_events(job_id: Optional[str] = None,

@@ -75,7 +75,7 @@ from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver import elastic as _elastic
 from harmony_tpu.jobserver.joblog import job_logger, server_log
 from harmony_tpu.jobserver.scheduler import ProcessCarveScheduler
-from harmony_tpu.jobserver.server import JobResult, JobServer
+from harmony_tpu.jobserver.server import JobResult, JobServer, _json_sanitize
 from harmony_tpu.runtime.podunits import (
     FollowerUnits,
     PodUnitArbiter,
@@ -96,20 +96,8 @@ def _recv(f) -> Optional[Dict[str, Any]]:
     return json.loads(line)
 
 
-def _json_sanitize(obj: Any) -> Any:
-    """Best-effort JSON projection of a job result for the wire: plain
-    scalars/containers pass through, numpy scalars coerce, anything else
-    (device arrays, closures) becomes its repr — the chief-report path
-    must never fail on an exotic result value."""
-    if isinstance(obj, dict):
-        return {str(k): _json_sanitize(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_json_sanitize(v) for v in obj]
-    if isinstance(obj, (bool, int, float, str)) or obj is None:
-        return obj
-    if isinstance(obj, np.generic):
-        return obj.item()
-    return repr(obj)
+# the chief-report path shares the WAIT reply's best-effort JSON
+# projection (one implementation; server.py owns it)
 
 
 class PodJobServer(JobServer):
@@ -747,6 +735,12 @@ class PodJobServer(JobServer):
                 self._pod_cond.notify_all()
 
     def _send_to(self, pid: int, msg: Dict[str, Any]) -> None:
+        if self.leader_epoch and "leader_epoch" not in msg:
+            # HA fencing: every control-plane message carries the leader
+            # epoch; followers reject anything below the highest they
+            # have seen, so a deposed leader's late RUN_JOB/PLAN can
+            # never act after a takeover (jobserver/ha.py)
+            msg = dict(msg, leader_epoch=self.leader_epoch)
         conn, _ = self._followers[pid]
         with self._send_locks[pid]:
             _send(conn, msg)
@@ -1781,8 +1775,27 @@ class PodFollower:
     GlobalTaskUnitScheduler exactly like the leader's local jobs do."""
 
     def __init__(self, leader_host: str, pod_port: int, pid: int,
-                 num_executors: int, join_timeout: float = 300.0) -> None:
+                 num_executors: int, join_timeout: float = 300.0,
+                 reconnect: Optional[bool] = None,
+                 leader_addrs: Optional[List[Tuple[str, int]]] = None
+                 ) -> None:
         self.pid = pid
+        self._join_timeout = join_timeout
+        # Control-plane HA (jobserver/ha.py): when enabled, a lost
+        # leader connection means LEADER CHANGE, not pod death — the
+        # follower re-HELLOs the (possibly new) leader, keeping its
+        # executors, entities and running job threads alive through the
+        # takeover window.
+        if reconnect is None:
+            from harmony_tpu.jobserver import ha as _ha
+
+            reconnect = _ha.ha_enabled()
+        self._reconnect = bool(reconnect)
+        self._leader_addrs = list(leader_addrs or [(leader_host, pod_port)])
+        #: highest leader epoch observed; lower-epoch messages are a
+        #: deposed leader's late writes and are rejected (fencing)
+        self._leader_epoch = 0
+        self.stale_rejected = 0
         # The leader may still be initializing its runtime when followers
         # come up (hosts boot in any order): retry until the deadline.
         deadline = time.monotonic() + join_timeout
@@ -1871,11 +1884,87 @@ class PodFollower:
                                   if self.metrics_exporter is not None
                                   else None)})
             except OSError:
+                if self._reconnect:
+                    # leader change in progress: the main loop's rejoin
+                    # swaps the socket; the beacon must outlive the gap
+                    # (its silence would confine this healthy follower)
+                    continue
                 return  # leader gone; the main loop handles shutdown
 
     def _report(self, payload: Dict[str, Any]) -> None:
         with self._send_lock:
             _send(self._sock, payload)
+
+    def _reject_stale(self, msg: Dict[str, Any], epoch: int) -> None:
+        """A deposed leader's late message (its epoch is below the
+        highest this follower has seen). RUN_JOB gets an explicit
+        failure report keyed by ITS attempt so the stale leader's
+        report wait resolves instead of hanging; everything else is
+        dropped."""
+        self.stale_rejected += 1
+        server_log.warning(
+            "follower %d: rejected stale-epoch %d %r (current leader "
+            "epoch %d)", self.pid, epoch, msg.get("cmd"),
+            self._leader_epoch)
+        if msg.get("cmd") == "RUN_JOB":
+            rkey = _elastic.attempt_key(
+                str(msg.get("conf", {}).get("job_id", "?")),
+                int(msg.get("att", 0) or 0))
+            try:
+                self._report({
+                    "cmd": "JOB_DONE", "pid": self.pid, "job_id": rkey,
+                    "ok": False, "stale_epoch": True,
+                    "error": f"fenced: RUN_JOB from deposed leader "
+                             f"epoch {epoch} < {self._leader_epoch}",
+                })
+            except OSError:
+                pass
+
+    def _rejoin(self) -> bool:
+        """Leader-change re-HELLO: reconnect to the (possibly new)
+        leader's control port and JOIN again under the SAME pid —
+        executors, entities and running job threads all survive; the
+        new leader's late-join path reinstates this follower. False
+        when no leader answers within the join timeout (the pod is
+        gone, not just its leader)."""
+        deadline = time.monotonic() + self._join_timeout
+        delay = 0.2
+        while time.monotonic() < deadline:
+            for host, port in self._leader_addrs:
+                try:
+                    sock = socket.create_connection((host, port),
+                                                    timeout=5.0)
+                except OSError:
+                    continue
+                sock.settimeout(None)
+                f = sock.makefile("r")
+                with self._send_lock:
+                    old = self._sock
+                    try:
+                        _send(sock, {"cmd": "JOIN", "pid": self.pid})
+                    except OSError:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        continue
+                    self._sock = sock
+                    self._file = f
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                server_log.info(
+                    "follower %d re-HELLO'd leader at %s:%d after "
+                    "connection loss (running jobs kept)",
+                    self.pid, host, port)
+                return True
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+        server_log.error(
+            "follower %d: no leader answered within %.0fs; shutting "
+            "down", self.pid, self._join_timeout)
+        return False
 
     def run(self) -> None:
         """Serve RUN_JOB commands until SHUTDOWN (or leader hangup).
@@ -1892,7 +1981,24 @@ class PodFollower:
             for e in self.master.executor_ids()
         )
         while True:
-            msg = _recv(self._file)
+            try:
+                msg = _recv(self._file)
+            except (OSError, ValueError):
+                msg = None
+            if msg is None and self._reconnect and self._rejoin():
+                continue  # leader change: re-HELLO'd the (new) leader
+            if msg is not None:
+                ep = msg.get("leader_epoch")
+                if ep is not None:
+                    ep = int(ep)
+                    if ep < self._leader_epoch:
+                        # fenced BEFORE any dispatch — including
+                        # SHUTDOWN: a deposed leader's graceful exit
+                        # must not tear down a follower that now
+                        # belongs to its successor's pod
+                        self._reject_stale(msg, ep)
+                        continue
+                    self._leader_epoch = ep
             if msg is None or msg.get("cmd") == "SHUTDOWN":
                 for t in self._job_threads:
                     t.join(timeout=60.0)

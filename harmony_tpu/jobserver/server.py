@@ -24,6 +24,7 @@ import socket
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 
 from harmony_tpu.config.base import ConfigBase
@@ -50,6 +51,27 @@ from harmony_tpu.utils.statemachine import StateMachine
 class JobResult:
     def __init__(self) -> None:
         self.future: "Future[Dict[str, Any]]" = Future()
+
+
+def _json_sanitize(obj: Any) -> Any:
+    """Best-effort JSON projection of a job result for the wire: plain
+    scalars/containers pass through, numpy scalars coerce, anything else
+    (device arrays, closures) becomes its repr — the WAIT/chief-report
+    paths must never fail on an exotic result value."""
+    if isinstance(obj, dict):
+        return {str(k): _json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sanitize(v) for v in obj]
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return obj
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except Exception:
+        pass
+    return repr(obj)
 
 
 class JobServer:
@@ -150,6 +172,106 @@ class JobServer:
             ledger_fn=self.metrics.tenant_ledger,
             on_cycle=self.doctor.diagnose,
         )
+        # Control-plane HA (jobserver/ha.py): wired by enable_ha when
+        # this server is one replica of an HA control plane. leader_epoch
+        # stamps every durable log entry and pod RUN_JOB/PLAN message so
+        # a deposed leader's late writes are fenced everywhere.
+        self.ha_log = None
+        self.ha_lease = None
+        self.ha_replicator = None
+        self.ha_replica_id: Optional[str] = None
+        self.leader_epoch = 0
+        self._ha_sink = None
+
+    # -- control-plane HA ------------------------------------------------
+
+    def enable_ha(self, log, lease=None, replicator=None,
+                  replica_id: Optional[str] = None) -> None:
+        """Wire the durable replicated job log (+ lease + replicator)
+        into this server: every structured joblog event tees into the
+        log, submissions/completions get first-class durable entries,
+        and the leader epoch fences RUN_JOB/PLAN broadcasts. Call
+        BEFORE start(); jobserver/ha.py's takeover does."""
+        from harmony_tpu.jobserver import joblog
+
+        def sink(job_id: str, ev: Dict[str, Any]) -> None:
+            self._ha_append(ev.get("kind", "event"), job_id=job_id,
+                            **{k: v for k, v in ev.items()
+                               if k not in ("kind", "ts")})
+
+        with self._lock:
+            self.ha_log = log
+            self.ha_lease = lease
+            self.ha_replicator = replicator
+            self.ha_replica_id = replica_id
+            self.leader_epoch = (lease.epoch if lease is not None
+                                 else log.fence_epoch)
+            self._ha_sink = sink
+        log.set_epoch(self.leader_epoch)
+        joblog.add_sink(sink)
+        if replicator is not None:
+            replicator.start()
+
+    def _ha_leader_ok(self) -> bool:
+        """False once a held lease has lapsed — the deposed state in
+        which every mutating command answers NOT_LEADER and durable
+        appends are refused (split-brain fencing, local half)."""
+        return self.ha_lease is None or self.ha_lease.is_valid()
+
+    #: entry-envelope keys DurableJobLog.append owns; event fields that
+    #: collide (elastic fences carry their own ``epoch``, diagnoses a
+    #: ``job``) are namespaced ``ev_*`` so the tee can never clash with
+    #: the envelope — or silently corrupt seq/epoch fencing
+    _HA_RESERVED = ("seq", "epoch", "ts", "kind", "job")
+
+    def _ha_append(self, kind: str, job_id: Optional[str] = None,
+                   **fields: Any) -> None:
+        """Guarded durable append: never fails the serving path, drops
+        (loudly) once this leader is deposed."""
+        if self.ha_log is None:
+            return
+        if not self._ha_leader_ok():
+            server_log.warning(
+                "halog append %r dropped: this leader's lease lapsed "
+                "(deposed)", kind)
+            return
+        try:
+            fields = {(f"ev_{k}" if k in self._HA_RESERVED else k): v
+                      for k, v in fields.items()}
+            self.ha_log.append(kind, job_id=job_id,
+                               epoch=self.leader_epoch, **fields)
+        except Exception as e:  # noqa: BLE001 - durability is surfaced,
+            server_log.error("halog append %r failed: %s: %s",
+                             kind, type(e).__name__, e)
+
+    def _ha_record_done(self, job_id: str, fut: "Future") -> None:
+        exc = fut.exception()
+        if exc is None:
+            self._ha_append("job_done", job_id=job_id, ok=True)
+        else:
+            self._ha_append(
+                "job_done", job_id=job_id, ok=False,
+                error=f"{type(exc).__name__}: {exc}"[:300])
+
+    def _ha_status(self) -> Dict[str, Any]:
+        from harmony_tpu.jobserver import joblog
+
+        if self.ha_log is None:
+            return {"enabled": False}
+        takeovers = [ev for ev in joblog.job_events("__ha__", limit=8)
+                     if ev.get("kind") == "leader_takeover"]
+        return {
+            "enabled": True,
+            "role": ("leader" if self._ha_leader_ok() else "deposed"),
+            "replica": self.ha_replica_id,
+            "leader_epoch": self.leader_epoch,
+            "lease": (self.ha_lease.stats()
+                      if self.ha_lease is not None else None),
+            "log": self.ha_log.stats(),
+            "replication": (self.ha_replicator.stats()
+                            if self.ha_replicator is not None else None),
+            "takeovers": takeovers,
+        }
 
     def _on_metric(self, record) -> None:
         """Every job metric lands in the manager AND (when configured)
@@ -265,7 +387,29 @@ class JobServer:
                 self.metrics_exporter.stop()
                 self.metrics_exporter = None
             self._stop_input_service()
+            self._stop_ha()
             self._state.transition("CLOSED")
+
+    def _stop_ha(self) -> None:
+        """HA teardown on graceful shutdown: unhook the joblog tee,
+        stop the replication stream, release the lease (so a standby
+        takes over immediately instead of waiting out the window), and
+        close the log."""
+        from harmony_tpu.jobserver import joblog
+
+        with self._lock:
+            sink, self._ha_sink = self._ha_sink, None
+            replicator, self.ha_replicator = self.ha_replicator, None
+            lease, self.ha_lease = self.ha_lease, None
+            log, self.ha_log = self.ha_log, None
+        if sink is not None:
+            joblog.remove_sink(sink)
+        if replicator is not None:
+            replicator.stop()
+        if lease is not None:
+            lease.release()
+        if log is not None:
+            log.close()
 
     def _on_closing(self, timeout: Optional[float]) -> None:
         """Subclass hook running after the drain + deferred evals but
@@ -374,6 +518,14 @@ class JobServer:
             "submitted (app_type=%s, workers=%d)",
             config.app_type, config.num_workers,
         )
+        if self.ha_log is not None:
+            # the durable submission record carries the WHOLE config
+            # (``_trace`` included): a takeover re-arms the same
+            # submission from exactly this entry
+            self._ha_append("submission", job_id=config.job_id,
+                            config=config.to_dict())
+            jr.future.add_done_callback(
+                lambda f, j=config.job_id: self._ha_record_done(j, f))
         self._scheduler.on_job_arrival(config)
         return jr.future
 
@@ -412,6 +564,11 @@ class JobServer:
         jr = self._jobs[config.job_id]
         jlog = job_logger(config.job_id)
         jlog.info("dispatched on executors %s", executor_ids)
+        from harmony_tpu.jobserver import elastic as _el
+
+        self._ha_append("dispatch", job_id=config.job_id,
+                        executors=list(executor_ids),
+                        attempt=_el.attempt_of(config))
         t0 = time.monotonic()
         entity = None
         try:
@@ -589,17 +746,24 @@ class JobServer:
             # stats and autoscaler events — None when not running
             "input_service": (self.input_service.stats()
                               if self.input_service is not None else None),
+            # control-plane HA (jobserver/ha.py): role, leader epoch,
+            # durable-log/lease/replication shape and recent takeovers —
+            # {"enabled": False} outside an HA deployment
+            "ha": self._ha_status(),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------
 
-    def serve_tcp(self, port: int = 0) -> int:
-        """Listen on localhost; returns the bound port. Wire format: one JSON
-        object per connection: {"command": "SUBMIT", "conf": <JobConfig>} or
+    def serve_tcp(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Listen on ``host`` (default localhost — the single-machine
+        contract; an HA control plane whose clients live on other hosts
+        binds its advertised interface, cli --ha-bind); returns the
+        bound port. Wire format: one JSON object per connection:
+        {"command": "SUBMIT", "conf": <JobConfig>} or
         {"command": "SHUTDOWN"}; reply is one JSON object."""
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind(("127.0.0.1", port))
+        sock.bind((host, port))
         sock.listen(16)
         with self._lock:
             self._tcp_sock = sock
@@ -633,7 +797,25 @@ class JobServer:
                     data += chunk
                 msg = json.loads(data.decode())
                 cmd = msg.get("command")
-                if cmd == "SUBMIT":
+                if (cmd in ("SUBMIT", "POD_RESHARD", "WAIT")
+                        and not self._ha_leader_ok()):
+                    # deposed leader: every mutating/authoritative
+                    # command redirects — a client following the lease
+                    # holder's advertised address lands on the successor
+                    hint = None
+                    if self.ha_lease is not None:
+                        import os as _os
+
+                        from harmony_tpu.jobserver.lease import leader_hint
+
+                        hint = leader_hint(
+                            _os.path.dirname(self.ha_lease.path),
+                            own_holder_id=self.ha_lease.holder_id)
+                    reply = {"ok": False, "not_leader": True,
+                             "error": "NOT_LEADER: this replica's lease "
+                                      "lapsed (deposed)",
+                             "leader": hint}
+                elif cmd == "SUBMIT":
                     config = ConfigBase.from_dict(msg["conf"])
                     # the client's span context (client.py sends it beside
                     # the config): ride it inside the config so the whole
@@ -651,6 +833,31 @@ class JobServer:
                     reply = {"ok": True, "job_id": config.job_id}
                 elif cmd == "STATUS":
                     reply = self._status()
+                elif cmd == "WAIT":
+                    # bounded wait on a submission's result — the
+                    # failover client's way to follow ONE submission
+                    # across a leader change (the successor re-arms it
+                    # under the same job id and resolves a fresh future)
+                    job_id = str(msg.get("job_id"))
+                    timeout = min(float(msg.get("timeout", 30.0)), 300.0)
+                    with self._lock:
+                        jr = self._jobs.get(job_id)
+                    if jr is None:
+                        reply = {"ok": False, "known": False,
+                                 "error": f"unknown job {job_id!r}"}
+                    else:
+                        try:
+                            result = jr.future.result(timeout=timeout)
+                            reply = {"ok": True, "done": True,
+                                     "result": _json_sanitize(result)}
+                        except (TimeoutError, FuturesTimeoutError):
+                            reply = {"ok": True, "done": False,
+                                     "running": job_id in
+                                     self.running_jobs()}
+                        except BaseException as e:  # noqa: BLE001
+                            reply = {"ok": False, "known": True,
+                                     "done": True,
+                                     "error": f"{type(e).__name__}: {e}"}
                 elif cmd == "POD_RESHARD":
                     # operator-initiated live migration of a running pod
                     # job (PodJobServer.schedule_pod_reshard; plain

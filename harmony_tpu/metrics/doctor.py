@@ -11,7 +11,8 @@ instead of a wall of gauges.
 Shipped rules (the catalog table in docs/OBSERVABILITY.md §Telemetry
 history & doctor is lint-held to this file in both directions):
 ``input_bound``, ``straggler``, ``mfu_collapse``, ``compile_storm``,
-``infra_suspect``, ``comm_bound``, ``dispatch_bound``, ``slo_breach``.
+``infra_suspect``, ``comm_bound``, ``dispatch_bound``, ``leader_flap``,
+``slo_breach``.
 Rules are declared through
 :func:`doctor_rule` with LITERAL names — the ``metric-conventions``
 lint pass reads them statically.
@@ -407,6 +408,39 @@ def _dispatch_bound(ctx: DoctorContext) -> List[Diagnosis]:
                       "median": round(med, 4),
                       "points": ctx.excerpt(pts)}))
     return out
+
+
+#: leader_flap: this many leader takeovers inside one window is churn,
+#: not recovery — every takeover replays the log and re-arms in-flight
+#: submissions, so a flapping lease multiplies recovery work
+LEADER_FLAP_COUNT = 2
+
+
+@doctor_rule("leader_flap",
+             "control-plane HA churn: at least "
+             f"{LEADER_FLAP_COUNT} kind=\"leader_takeover\" joblog "
+             "events in one window — the lease is flapping between "
+             "replicas (store latency, a too-short HARMONY_HA_LEASE_S, "
+             "or a crash-looping leader) instead of settling")
+def _leader_flap(ctx: DoctorContext) -> List[Diagnosis]:
+    takeovers = [
+        e for e in ctx.events.get("__ha__", [])
+        if e.get("kind") == "leader_takeover"
+        and float(e.get("ts", 0.0)) >= ctx.since
+    ]
+    if len(takeovers) < LEADER_FLAP_COUNT:
+        return []
+    leaders = [str(e.get("new_leader")) for e in takeovers]
+    return [Diagnosis(
+        rule="leader_flap", verdict="leader_flap",
+        confidence=min(1.0, len(takeovers) / (2.0 * LEADER_FLAP_COUNT)
+                       + 0.5),
+        summary=(f"control plane flapped {len(takeovers)} times in the "
+                 f"window (leaders: {' -> '.join(leaders)})"),
+        window=(ctx.since, ctx.now),
+        target="control-plane",
+        evidence={"takeovers": [dict(e) for e in takeovers[-4:]],
+                  "count": len(takeovers)})]
 
 
 @doctor_rule("slo_breach",

@@ -1,0 +1,55 @@
+"""One HA control-plane replica process, for the leader-failover
+chaos tests (tests/test_ha_pod.py).
+
+Runs an :class:`harmony_tpu.jobserver.ha.HAController` around a plain
+JobServer: stands by on the submit port (NOT_LEADER + redirect),
+contends on the shared lease under ``ha_dir``, and on winning it
+replays the durable job log, re-arms in-flight submissions from their
+committed chains, and serves. The chaos plan rides HARMONY_FAULT_PLAN
+into this process exactly as it does into pod followers — so a
+``crash`` rule at ``worker.step`` kills the LEADER mid-epoch, for
+real, at a deterministic step.
+
+Usage: python ha_worker.py <ha_dir> <replica_id> <submit_port>
+           <lease_s> <chkp_root>
+
+Prints ``READY <port>`` once standing by and ``LEADER`` on takeover.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ha_dir, replica_id = sys.argv[1], sys.argv[2]
+    submit_port, lease_s, chkp_root = (
+        int(sys.argv[3]), float(sys.argv[4]), sys.argv[5])
+
+    from harmony_tpu.jobserver.ha import HAController
+
+    def factory():
+        from harmony_tpu.jobserver.server import JobServer
+
+        return JobServer(num_executors=2, chkp_root=chkp_root)
+
+    ctl = HAController(
+        factory, log_dir=ha_dir, replica_id=replica_id,
+        submit_port=submit_port, lease_s=lease_s,
+        advertise_addr=f"127.0.0.1:{submit_port}",
+    ).start()
+    print(f"READY {ctl.port}", flush=True)
+    announced = False
+    while True:
+        if not announced and ctl.wait_leader(timeout=0.2):
+            announced = True
+            print("LEADER", flush=True)
+        if ctl.server is not None and ctl.server.state == "CLOSED":
+            return
+        if announced:
+            time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,8 +68,10 @@ def test_indexed_job_wiring():
 
 def test_service_matches_job_subdomain_and_ports():
     docs = _manifests()
-    services = [d for _, d in docs if d.get("kind") == "Service"]
     (job,) = [d for _, d in docs if d.get("kind") == "Job"]
+    subdomain = job["spec"]["template"]["spec"]["subdomain"]
+    services = [d for _, d in docs if d.get("kind") == "Service"
+                and d["metadata"]["name"] == subdomain]
     assert services, "no headless Service for coordinator DNS"
     (svc,) = services
     assert svc["spec"].get("clusterIP") in (None, "None"), (
@@ -82,6 +84,57 @@ def test_service_matches_job_subdomain_and_ports():
     sel = svc["spec"]["selector"]
     labels = job["spec"]["template"]["metadata"]["labels"]
     assert all(labels.get(k) == v for k, v in sel.items()), (sel, labels)
+
+
+def test_controlplane_statefulset_wiring():
+    """The HA control plane (docs/DEPLOY.md §HA): N start-jobserver
+    replicas with stable identity, a shared log/lease volume, and the
+    headless Service whose per-replica DNS names back NOT_LEADER
+    redirects and HARMONY_JOBSERVER_ADDRS."""
+    docs = _manifests()
+    sets = [d for _, d in docs if d.get("kind") == "StatefulSet"]
+    assert sets, "no control-plane StatefulSet under deploy/gke"
+    (ss,) = sets
+    spec = ss["spec"]
+    assert spec["replicas"] >= 2, "HA needs at least one warm standby"
+    # stable per-replica identity = StatefulSet + headless Service
+    svc_name = spec["serviceName"]
+    (svc,) = [d for _, d in docs if d.get("kind") == "Service"
+              and d["metadata"]["name"] == svc_name]
+    assert svc["spec"].get("clusterIP") in (None, "None"), (
+        "redirects need per-replica DNS — a VIP would load-balance "
+        "submits onto standbys")
+    sel = svc["spec"]["selector"]
+    labels = spec["template"]["metadata"]["labels"]
+    assert all(labels.get(k) == v for k, v in sel.items()), (sel, labels)
+    pod = spec["template"]["spec"]
+    (container,) = pod["containers"]
+    env = {e["name"]: e for e in container.get("env", [])}
+    # the HA knobs (docs/DEPLOY.md §7) and their volume backing
+    assert "HARMONY_HA_LOG_DIR" in env
+    assert "HARMONY_HA_LEASE_S" in env
+    assert "HARMONY_POD_CHKP_ROOT" in env, (
+        "re-armed submissions restore from the shared chain root")
+    log_dir = env["HARMONY_HA_LOG_DIR"]["value"]
+    mounts = {m["mountPath"] for m in container.get("volumeMounts", [])}
+    assert log_dir in mounts, (
+        "HARMONY_HA_LOG_DIR must be a mounted (shared or local-"
+        "replicated) volume, not container scratch")
+    # either shared-volume replication (RWX claim) or peer streaming
+    claims = [d for _, d in docs
+              if d.get("kind") == "PersistentVolumeClaim"]
+    assert claims or "HARMONY_HA_REPLICAS" in env
+    # replica identity + advertised redirect address derive from the
+    # pod name, never hardcoded
+    args = " ".join(container.get("args", []) or [])
+    assert "POD_NAME" in args and "--ha-replica-id" in args
+    assert "--ha-advertise" in args and svc_name in args
+    # the client list names every replica through the headless service
+    addrs = env["HARMONY_JOBSERVER_ADDRS"]["value"].split(",")
+    assert len(addrs) == spec["replicas"]
+    assert all(svc_name in a and a.endswith(":43110") for a in addrs)
+    ports = {p["containerPort"] for p in container.get("ports", [])}
+    assert 43110 in ports
 
 
 def test_every_harmony_env_knob_is_documented():

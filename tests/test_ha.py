@@ -1,0 +1,608 @@
+"""Control-plane HA: durable replicated job log, leader lease, warm
+standby takeover, client failover (harmony_tpu/jobserver/halog.py +
+lease.py + ha.py).
+
+Fast tier. The real-process chaos acceptance (leader KILLED mid-epoch
+under a deterministic plan, loss parity through client failover) lives
+in tests/test_ha_pod.py (slow tier); this file pins the mechanisms:
+CRC-framed append/replay, torn-tail truncation, fenced epochs (log,
+replay, pod follower), standby replication catch-up, lease election,
+an in-process takeover that re-arms an in-flight submission, the
+NOT_LEADER redirect, the joblog LRU eviction regression, and the
+leader_flap doctor rule.
+"""
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from harmony_tpu.config.params import JobConfig, TrainerParams
+from harmony_tpu.jobserver import joblog
+from harmony_tpu.jobserver.halog import (
+    DurableJobLog,
+    LogReceiver,
+    LogReplicator,
+    ReplayState,
+    StaleEpochError,
+    scan_records,
+)
+from harmony_tpu.jobserver.lease import LeaseManager
+
+
+def _wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- durable log ------------------------------------------------------------
+
+
+class TestDurableLog:
+    def test_append_replay_roundtrip(self, tmp_path):
+        path = str(tmp_path / "job.walog")
+        log = DurableJobLog(path)
+        e1 = log.append("submission", job_id="j1", config={"a": 1})
+        e2 = log.append("dispatch", job_id="j1", executors=["e0", "e1"])
+        e3 = log.append("job_done", job_id="j1", ok=True)
+        log.close()
+        reopened = DurableJobLog(path)
+        assert reopened.torn_recovered == 0
+        entries = reopened.entries()
+        assert [e["seq"] for e in entries] == [1, 2, 3]
+        assert entries[0]["config"] == {"a": 1}
+        assert entries[1]["executors"] == ["e0", "e1"]
+        assert entries[2]["ok"] is True
+        assert [e["kind"] for e in entries] == [
+            e1["kind"], e2["kind"], e3["kind"]]
+        # the continuation keeps seq monotonic past the recovered tail
+        e4 = reopened.append("submission", job_id="j2", config={})
+        assert e4["seq"] == 4
+        reopened.close()
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        path = str(tmp_path / "job.walog")
+        log = DurableJobLog(path)
+        log.append("submission", job_id="j1", config={})
+        log.append("dispatch", job_id="j1")
+        log.close()
+        good_size = os.path.getsize(path)
+        # a crash mid-append: half a header + garbage
+        with open(path, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefgarbage")
+        entries, good, torn = scan_records(path)
+        assert len(entries) == 2 and good == good_size and torn > 0
+        reopened = DurableJobLog(path)  # recovery truncates the tail
+        assert reopened.torn_recovered > 0
+        assert os.path.getsize(path) == good_size
+        # and the log is APPENDABLE again, replaying cleanly
+        reopened.append("job_done", job_id="j1", ok=False, error="x")
+        reopened.close()
+        entries, _good, torn = scan_records(path)
+        assert torn == 0
+        assert [e["kind"] for e in entries] == [
+            "submission", "dispatch", "job_done"]
+        assert [e["seq"] for e in entries] == [1, 2, 3]
+
+    def test_fenced_epoch_rejects_deposed_writer(self, tmp_path):
+        log = DurableJobLog(str(tmp_path / "job.walog"))
+        log.append("submission", job_id="j1", epoch=1, config={})
+        log.set_epoch(3)  # a successor took over at epoch 3
+        with pytest.raises(StaleEpochError):
+            log.append("dispatch", job_id="j1", epoch=2)
+        with pytest.raises(StaleEpochError):
+            log.set_epoch(2)
+        log.append("dispatch", job_id="j1", epoch=3)  # the successor's ok
+        log.close()
+
+    def test_replay_fences_stale_epoch_entries(self):
+        # entries as a deposed leader's late write would leave them:
+        # epoch regresses mid-stream — replay must reject, not apply
+        entries = [
+            {"seq": 1, "epoch": 1, "kind": "submission", "job": "a",
+             "config": {"job_id": "a"}},
+            {"seq": 2, "epoch": 2, "kind": "leader_takeover", "job": None},
+            {"seq": 3, "epoch": 1, "kind": "job_done", "job": "a",
+             "ok": True},  # stale: epoch 1 after epoch 2
+            {"seq": 4, "epoch": 2, "kind": "submission", "job": "b",
+             "config": {"job_id": "b"}},
+        ]
+        st = ReplayState.from_entries(entries)
+        assert st.rejected_stale == 1
+        # the stale job_done was NOT applied: "a" is still in flight
+        assert sorted(st.in_flight()) == ["a", "b"]
+        assert st.max_epoch == 2
+        assert len(st.takeovers) == 1
+
+    def test_replay_state_lifecycle(self, tmp_path):
+        log = DurableJobLog(str(tmp_path / "job.walog"))
+        log.append("submission", job_id="a", config={"job_id": "a"})
+        log.append("dispatch", job_id="a", attempt=0)
+        log.append("chkp_chain", job_id="a", chkp_id="a:model-3-x")
+        log.append("elastic_shrink", job_id="a", attempt=2)
+        log.append("submission", job_id="b", config={"job_id": "b"})
+        log.append("job_done", job_id="b", ok=True)
+        st = ReplayState.from_entries(log.entries())
+        assert st.in_flight() == ["a"]
+        assert st.chains["a"] == "a:model-3-x"
+        assert st.attempts["a"] == 2
+        assert "b" in st.done
+        log.close()
+
+
+# -- replication ------------------------------------------------------------
+
+
+class TestReplication:
+    def test_standby_catch_up_after_gap(self, tmp_path):
+        leader = DurableJobLog(str(tmp_path / "leader.walog"))
+        standby = DurableJobLog(str(tmp_path / "standby.walog"))
+        # entries BEFORE the receiver exists: the catch-up prefix
+        for i in range(3):
+            leader.append("submission", job_id=f"j{i}", config={})
+        recv = LogReceiver(standby, port=0)
+        port = recv.start()
+        repl = LogReplicator(leader, [f"127.0.0.1:{port}"])
+        repl.start()
+        _wait_for(lambda: standby.last_seq == 3, msg="catch-up")
+        # live streaming
+        leader.append("dispatch", job_id="j0")
+        _wait_for(lambda: standby.last_seq == 4, msg="live entry")
+        # a GAP: the standby goes away, the leader keeps appending
+        repl.stop()
+        recv.stop()
+        for i in range(4):
+            leader.append("job_done", job_id=f"j{i}", ok=True)
+        assert standby.last_seq == 4
+        # reconnect: the handshake's last_seq drives gap repair
+        recv2 = LogReceiver(standby, port=0)
+        port2 = recv2.start()
+        repl2 = LogReplicator(leader, [f"127.0.0.1:{port2}"])
+        repl2.start()
+        _wait_for(lambda: standby.last_seq == leader.last_seq,
+                  msg="gap repair")
+        ours = [(e["seq"], e["kind"], e["job"]) for e in standby.entries()]
+        theirs = [(e["seq"], e["kind"], e["job"]) for e in leader.entries()]
+        assert ours == theirs
+        repl2.stop()
+        recv2.stop()
+        leader.close()
+        standby.close()
+
+
+# -- lease election ---------------------------------------------------------
+
+
+class TestLease:
+    def test_election_renewal_and_deposition(self, tmp_path):
+        lost = []
+        a = LeaseManager(str(tmp_path), "replica-a", lease_s=0.5,
+                         on_lost=lambda: lost.append("a"),
+                         addr="127.0.0.1:1001")
+        b = LeaseManager(str(tmp_path), "replica-b", lease_s=0.5,
+                         addr="127.0.0.1:1002")
+        assert a.try_acquire()
+        assert a.epoch == 1 and a.is_valid()
+        assert not b.try_acquire()  # a live peer holds it
+        assert a.renew()
+        # the holder dies (stops renewing): the lease runs out and the
+        # standby wins with a BUMPED epoch
+        time.sleep(0.6)
+        assert not a.is_valid()  # local half: self-deposed, no clock trust
+        assert b.try_acquire()
+        assert b.epoch == 2
+        assert b.previous and b.previous["holder"] == "replica-a"
+        # the old holder's next renewal observes the successor
+        assert not a.renew()
+        assert lost == ["a"]
+        b.release()
+
+    def test_release_hands_off_immediately(self, tmp_path):
+        a = LeaseManager(str(tmp_path), "a", lease_s=30.0)
+        b = LeaseManager(str(tmp_path), "b", lease_s=30.0)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        a.release()
+        assert b.try_acquire()  # no 30s wait
+        assert b.epoch == 2
+
+
+# -- joblog eviction regression ---------------------------------------------
+
+
+class TestJoblogEviction:
+    def test_busy_job_survives_eviction(self):
+        """Regression (PR 14): the _EVENTS_MAX_JOBS loop used to pop in
+        dict-insertion order regardless of activity, so a long-lived
+        BUSY job inserted first was evicted while dead jobs lingered.
+        Eviction is now least-recently-appended."""
+        joblog.clear_events()
+        try:
+            cap = joblog._EVENTS_MAX_JOBS
+            joblog.record_event("busy", "epoch", i=-1)  # inserted FIRST
+            for i in range(cap + 16):
+                joblog.record_event(f"dead-{i}", "done", i=i)
+                # the busy job keeps appending throughout
+                joblog.record_event("busy", "epoch", i=i)
+            events = joblog.job_events()
+            assert "busy" in events, "active job evicted by idle ones"
+            # the oldest IDLE jobs are the ones that went
+            assert "dead-0" not in events
+            assert len(events) <= cap
+        finally:
+            joblog.clear_events()
+
+
+# -- leader_flap doctor rule -------------------------------------------------
+
+
+class TestLeaderFlap:
+    def test_two_takeovers_in_window_diagnose_flap(self):
+        from harmony_tpu.metrics.doctor import Doctor
+        from harmony_tpu.metrics.history import HistoryStore
+
+        joblog.clear_events()
+        try:
+            joblog.record_event("__ha__", "leader_takeover",
+                                old_leader="a", new_leader="b", epoch=2)
+            joblog.record_event("__ha__", "leader_takeover",
+                                old_leader="b", new_leader="a", epoch=3)
+            doc = Doctor(HistoryStore(), window=900.0)
+            fresh = doc.diagnose()
+            flaps = [d for d in fresh if d.rule == "leader_flap"]
+            assert len(flaps) == 1
+            assert flaps[0].target == "control-plane"
+            assert flaps[0].evidence["count"] == 2
+            # one takeover is recovery, not churn: below the threshold
+            joblog.clear_events()
+            joblog.record_event("__ha__", "leader_takeover",
+                                old_leader="a", new_leader="b", epoch=4)
+            doc2 = Doctor(HistoryStore(), window=900.0)
+            assert not [d for d in doc2.diagnose()
+                        if d.rule == "leader_flap"]
+        finally:
+            joblog.clear_events()
+
+
+# -- pod follower fencing ----------------------------------------------------
+
+
+class TestFollowerFencing:
+    def test_follower_rejects_stale_epoch_run_job(self):
+        """A deposed leader's late RUN_JOB (lower leader_epoch than the
+        follower has seen) is fenced: rejected with an explicit
+        JOB_DONE so the stale leader's wait fails fast."""
+        from harmony_tpu.jobserver.pod import PodFollower
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        box = {}
+
+        def leader_side():
+            conn, _ = srv.accept()
+            f = conn.makefile("r")
+            assert json.loads(f.readline())["cmd"] == "JOIN"
+            # the CURRENT leader's epoch reaches the follower first
+            conn.sendall((json.dumps(
+                {"cmd": "PLAN", "job_id": "zz", "plan": {"epoch": 99},
+                 "leader_epoch": 5}) + "\n").encode())
+            # ...then a DEPOSED leader's late RUN_JOB (epoch 3)
+            conn.sendall((json.dumps(
+                {"cmd": "RUN_JOB", "conf": {"job_id": "stale-job"},
+                 "att": 0, "executor_ids": [], "leader_epoch": 3})
+                + "\n").encode())
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                msg = json.loads(line)
+                if msg.get("cmd") == "JOB_DONE":
+                    box["done"] = msg
+                    break
+            conn.sendall((json.dumps({"cmd": "SHUTDOWN"}) + "\n").encode())
+
+        t = threading.Thread(target=leader_side, daemon=True)
+        t.start()
+        follower = PodFollower("127.0.0.1", port, pid=1, num_executors=1,
+                               reconnect=False)
+        ft = threading.Thread(target=follower.run, daemon=True)
+        ft.start()
+        _wait_for(lambda: "done" in box, msg="stale RUN_JOB rejection")
+        done = box["done"]
+        assert done["ok"] is False and done.get("stale_epoch") is True
+        assert done["job_id"] == "stale-job"
+        assert follower.stale_rejected == 1
+        assert follower._leader_epoch == 5
+        ft.join(timeout=30)
+        t.join(timeout=10)
+        srv.close()
+
+
+class TestFollowerReHello:
+    def test_follower_reconnects_on_leader_loss(self):
+        """Leader change with HA on: a follower whose control socket
+        EOFs re-HELLOs the (new) leader under the same pid instead of
+        shutting down — executors and entities survive the window."""
+        from harmony_tpu.jobserver.pod import PodFollower
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+        joins = []
+
+        def leader_side():
+            # first leader: accept the JOIN, then DIE (close the socket
+            # AND its makefile — the file object holds the fd, and only
+            # the last close sends the FIN the follower's EOF needs)
+            conn, _ = srv.accept()
+            f = conn.makefile("r")
+            joins.append(json.loads(f.readline()))
+            f.close()
+            conn.close()
+            # successor on the SAME port: the follower must re-JOIN it
+            conn2, _ = srv.accept()
+            f2 = conn2.makefile("r")
+            joins.append(json.loads(f2.readline()))
+            conn2.sendall((json.dumps({"cmd": "SHUTDOWN"}) + "\n")
+                          .encode())
+
+        t = threading.Thread(target=leader_side, daemon=True)
+        t.start()
+        follower = PodFollower("127.0.0.1", port, pid=3, num_executors=1,
+                               reconnect=True)
+        ft = threading.Thread(target=follower.run, daemon=True)
+        ft.start()
+        ft.join(timeout=60)
+        assert not ft.is_alive(), "follower never saw the SHUTDOWN"
+        t.join(timeout=10)
+        assert [j["cmd"] for j in joins] == ["JOIN", "JOIN"]
+        assert [j["pid"] for j in joins] == [3, 3]  # SAME identity kept
+        srv.close()
+
+
+class TestRearmPolicy:
+    def test_rearm_branches(self, tmp_path, monkeypatch):
+        """Takeover re-arm policy: elastic jobs continue their attempt
+        sequence, chained jobs resume_from_chain, chainless ones re-run
+        raw — and one failing re-arm never blocks the rest."""
+        from harmony_tpu.jobserver.ha import HAController
+
+        def conf(job_id, **user):
+            cfg = _laggy_job(job_id, 1, lag=0.0)
+            cfg.user.update(user)
+            return cfg.to_dict()  # what the submission entry carries
+
+        st = ReplayState.from_entries([
+            {"seq": 1, "epoch": 1, "kind": "submission", "job": "el",
+             "config": conf("el", elastic_shrink=True)},
+            {"seq": 2, "epoch": 1, "kind": "dispatch", "job": "el",
+             "attempt": 1},
+            {"seq": 3, "epoch": 1, "kind": "submission", "job": "ch",
+             "config": conf("ch")},
+            {"seq": 4, "epoch": 1, "kind": "submission", "job": "raw",
+             "config": conf("raw")},
+            {"seq": 5, "epoch": 1, "kind": "submission", "job": "boom",
+             "config": conf("boom")},
+        ])
+
+        class FakeServer:
+            _chkp_root = str(tmp_path)
+
+            def __init__(self):
+                self.submitted = []
+
+            def submit(self, cfg):
+                if cfg.job_id == "boom":
+                    raise RuntimeError("synthetic re-arm failure")
+                self.submitted.append(cfg)
+
+        monkeypatch.setattr(
+            HAController, "_has_chain",
+            staticmethod(lambda server, job: job in ("el", "ch")))
+        ctl = HAController.__new__(HAController)  # policy only, no I/O
+        server = FakeServer()
+        rearmed = HAController._rearm(ctl, server, st)
+        assert rearmed == ["el", "ch", "raw"]  # boom failed, rest ran
+        by_id = {c.job_id: c for c in server.submitted}
+        rec = by_id["el"].user["elastic_recovery"]
+        assert rec["attempt"] == 2 and rec["kind"] == "shrink"
+        assert by_id["ch"].user.get("resume_from_chain") is True
+        assert "resume_from_chain" not in by_id["raw"].user
+        assert "elastic_recovery" not in by_id["raw"].user
+
+
+# -- in-process takeover -----------------------------------------------------
+
+
+def _laggy_job(job_id: str, epochs: int, lag: float = 0.25):
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="tests.helpers:LaggyMLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs, num_mini_batches=2,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.1,
+                        "lag_sec": lag, "lag_worker": "/w0"},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 64, "num_features": 16,
+                            "num_classes": 4, "seed": 11}},
+    )
+
+
+class TestTakeover:
+    """Deliberate default-tier sentinel (like the pod smoke): a leader
+    loss must not be able to regress green — the full real-process
+    chaos version with loss parity is tests/test_ha_pod.py."""
+
+    def test_takeover_rearms_in_flight_submission(self, tmp_path):
+        from harmony_tpu.jobserver.client import CommandSender
+        from harmony_tpu.jobserver.ha import HAController
+        from harmony_tpu.jobserver.server import JobServer
+
+        joblog.clear_events()
+        ha_dir = str(tmp_path / "ha")
+        EPOCHS = 4
+
+        def factory():
+            return JobServer(num_executors=2)
+
+        a = HAController(factory, log_dir=ha_dir, replica_id="rep-a",
+                         submit_port=0, lease_s=0.6).start()
+        assert a.wait_leader(30), "first replica must take the lease"
+        assert a.lease.epoch == 1
+        a_addr = f"127.0.0.1:{a.port}"
+        cfg = _laggy_job("ha-victim", EPOCHS)
+        sender = CommandSender(addrs=[a_addr])
+        resp = sender.send_job_submit_command(cfg)
+        assert resp.get("ok"), resp
+        # CRASH the leader mid-job: TCP gone, renewals stop, lease
+        # lapses — but the process lives on (the in-process analogue of
+        # a partitioned leader; the real kill is the slow test). Its
+        # still-running dispatch must NOT be able to write job_done:
+        # the deposed guard drops the append (split-brain fencing).
+        a.server._stop_tcp()
+        a.lease.stop()
+        b = HAController(factory, log_dir=ha_dir, replica_id="rep-b",
+                         submit_port=0, lease_s=0.6).start()
+        b_addr_early = f"127.0.0.1:{b.port}"
+        # while standing by, B answers NOT_LEADER to submits
+        with pytest.raises(Exception):
+            CommandSender(b.port).send_job_submit_command(
+                _laggy_job("other", 1))
+        assert b.wait_leader(30), "standby must take over after the lease"
+        assert b.lease.epoch == 2
+        # the submission was re-armed under the SAME job id and the
+        # client reaches the result through failover (A refuses, B is
+        # tried next; the port did not move — the standby endpoint
+        # vacated it for the real server)
+        assert f"127.0.0.1:{b.port}" == b_addr_early
+        failover = CommandSender(addrs=[a_addr, f"127.0.0.1:{b.port}"])
+        result = failover.wait_result("ha-victim", timeout=120)
+        (w,) = result["workers"].values()
+        assert len(w["losses"]) + int(w["starting_epoch"]) == EPOCHS
+        # takeover evidence: one structured leader_takeover event with
+        # the re-armed submission, riding STATUS's ha section
+        status = CommandSender(b.port).send_status_command()
+        ha = status["ha"]
+        assert ha["enabled"] and ha["role"] == "leader"
+        assert ha["leader_epoch"] == 2
+        # first election (rep-a, no predecessor) + the real takeover
+        tk = ha["takeovers"][-1]
+        assert tk["old_leader"] == "rep-a"
+        assert tk["new_leader"] == "rep-b"
+        assert tk["rearmed"] == ["ha-victim"]
+        assert tk["replay_ms"] > 0
+        # fencing held: the log's job_done for the victim (if any) was
+        # written by epoch 2, never by the deposed epoch-1 leader
+        st = ReplayState.from_entries(b.server.ha_log.entries())
+        done = st.done.get("ha-victim")
+        if done is not None:
+            assert int(done["epoch"]) >= 2
+        b.stop()
+        a.stop()
+        joblog.clear_events()
+
+
+# -- standby endpoint / client redirect -------------------------------------
+
+
+class TestDurableSink:
+    def test_event_fields_never_clash_with_envelope(self, tmp_path):
+        """Regression: joblog events carrying envelope-named fields
+        (elastic fences carry their own ``epoch``, diagnoses a ``job``)
+        must land in the durable log — namespaced ``ev_*`` — instead of
+        raising inside the sink and silently vanishing from the very
+        history a takeover replays."""
+        from harmony_tpu.jobserver.server import JobServer
+
+        server = JobServer(num_executors=1)
+        log = DurableJobLog(str(tmp_path / "job.walog"))
+        try:
+            server.enable_ha(log)
+            joblog.record_event("j1", "elastic_shrink_fence",
+                                epoch=7, attempt=2)
+            joblog.record_event("j1", "diagnosis", job="j1",
+                                rule="straggler")
+            entries = log.entries()
+            kinds = [e["kind"] for e in entries]
+            assert kinds == ["elastic_shrink_fence", "diagnosis"], kinds
+            fence = entries[0]
+            assert fence["ev_epoch"] == 7       # the event's own epoch
+            assert fence["epoch"] == 0          # the LEADER epoch
+            assert fence["attempt"] == 2        # non-reserved untouched
+            assert entries[1]["ev_job"] == "j1"
+        finally:
+            server._stop_ha()
+            joblog.clear_events()
+
+
+class TestNotLeaderRedirect:
+    def test_standby_redirects_to_leader(self):
+        from harmony_tpu.jobserver.client import (
+            CommandSender,
+            NotLeaderError,
+        )
+        from harmony_tpu.jobserver.ha import StandbyEndpoint
+        from harmony_tpu.jobserver.server import JobServer
+
+        leader = JobServer(num_executors=1)
+        leader.start()
+        lport = leader.serve_tcp(0)
+        standby = StandbyEndpoint(
+            0, info_fn=lambda: {"role": "standby"},
+            leader_hint_fn=lambda: f"127.0.0.1:{lport}")
+        sport = standby.start()
+        try:
+            # STATUS passes through on a standby (operators can look)
+            st = CommandSender(sport).send_status_command()
+            assert st["state"] == "STANDBY" and st["ok"]
+            # a raw submit against the standby is NOT_LEADER...
+            with pytest.raises(NotLeaderError) as ei:
+                CommandSender(sport).send_job_submit_command(
+                    _laggy_job("redir", 1, lag=0.0))
+            assert ei.value.leader == f"127.0.0.1:{lport}"
+            # ...and the failover client follows the redirect hint
+            sender = CommandSender(addrs=[f"127.0.0.1:{sport}"])
+            resp = sender.send_job_submit_command(
+                _laggy_job("redir", 1, lag=0.0))
+            assert resp.get("ok"), resp
+            assert sender._leader_hint == f"127.0.0.1:{lport}"
+            result = sender.wait_result("redir", timeout=60)
+            assert result["workers"]
+        finally:
+            standby.stop()
+            leader.shutdown(timeout=60)
+
+
+class TestObsEndpointResolution:
+    def test_resolve_learns_addr_list(self, monkeypatch):
+        import argparse
+
+        from harmony_tpu.cli import _resolve_obs_endpoint
+
+        ns = argparse.Namespace(what="doctor", port=None, url=None)
+        monkeypatch.setenv("HARMONY_JOBSERVER_ADDRS",
+                           "10.0.0.1:43110, 10.0.0.2:43110")
+        kind, endpoint = _resolve_obs_endpoint(ns)
+        assert kind == "addrs"
+        assert endpoint == ["10.0.0.1:43110", "10.0.0.2:43110"]
+        # the explicit flag still wins
+        ns2 = argparse.Namespace(what="doctor", port=7777, url=None)
+        assert _resolve_obs_endpoint(ns2) == ("port", 7777)
+        # without the list, the old port resolution is unchanged
+        monkeypatch.delenv("HARMONY_JOBSERVER_ADDRS")
+        monkeypatch.setenv("HARMONY_JOBSERVER_PORT", "4242")
+        assert _resolve_obs_endpoint(ns) == ("port", 4242)

@@ -1,0 +1,223 @@
+"""Control-plane HA chaos acceptance on REAL replica processes (slow).
+
+The leader-loss analogue of tests/test_elastic_pod.py's follower
+acceptance, driven by the same deterministic fault harness: the
+JobServer LEADER replica is killed (``os._exit`` via a ``crash`` rule
+at an exact ``worker.step``) mid-epoch while a chained submission
+runs. The warm standby must win the lease within the window, replay
+the durable job log, re-arm the SAME submission from its last
+committed chain entry, and complete it — with the client reaching the
+result purely through ``HARMONY_JOBSERVER_ADDRS``-style failover
+(retry across replicas + NOT_LEADER redirects), and the final loss
+bit-identical to an uninterrupted run: the exactly-once / loss-parity
+evidence PR 3 established for followers, now for the leader.
+"""
+import json
+import subprocess
+import sys
+import time
+import os
+
+import pytest
+
+from harmony_tpu import faults
+from benchmarks.common import (
+    free_port as _free_port,
+    sanitized_cpu_env as _sanitized_env,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.faults]
+
+HA_WORKER = os.path.join(os.path.dirname(__file__), "ha_worker.py")
+
+EPOCHS = 24
+
+
+def _victim_cfg(job_id: str, seed: int = 23):
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=EPOCHS, num_mini_batches=2, model_chkp_period=1,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.1},
+        ),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 64, "num_features": 16,
+                            "num_classes": 4, "seed": seed}},
+    )
+
+
+def _uninterrupted_final_loss(cfg):
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=2)
+    server.start()
+    try:
+        base = type(cfg).from_dict(cfg.to_dict())
+        base.params.model_chkp_period = 0  # no chain needed for the ref
+        res = server.submit(base).result(timeout=300)
+        (losses,) = [w["losses"] for w in res["workers"].values()]
+        assert len(losses) == EPOCHS
+        return float(losses[-1])
+    finally:
+        server.shutdown(timeout=60)
+
+
+def _wait_line(proc, prefix, timeout):
+    """Readline-on-a-helper-thread (the benchmarks/common idiom) until
+    a ``prefix`` line, EOF, or the deadline — a wedged replica hits the
+    deadline instead of blocking the test forever."""
+    import threading
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(line=proc.stdout.readline()),
+            daemon=True)
+        t.start()
+        t.join(max(0.1, deadline - time.monotonic()))
+        line = box.get("line")
+        if line is None:  # readline still blocked: deadline
+            break
+        if not line:  # EOF without the marker
+            raise AssertionError(
+                f"replica exited before {prefix!r}: "
+                f"{proc.stderr.read()[-2000:]}")
+        if line.startswith(prefix):
+            return line.strip()
+    raise AssertionError(f"no {prefix!r} line within {timeout}s")
+
+
+def test_leader_killed_mid_epoch_standby_completes_same_submission(
+        tmp_path):
+    """Acceptance: leader crashed at its 13th worker step (epoch ~6 of
+    24, chain committed every epoch) → the standby takes over within
+    the lease window, re-arms the SAME submission from the last
+    committed chain entry, the client's failover WAIT resolves with
+    the successor's result, epochs tile exactly once across the two
+    leaders' attempts, and the final loss matches an uninterrupted
+    run. The deposed replica is dead OF THE INJECTION (its exit code
+    proves the crash rule fired, not a test kill)."""
+    from harmony_tpu.jobserver.client import CommandSender
+
+    ha_dir = tmp_path / "ha"
+    chkp = tmp_path / "chkp"
+    ha_dir.mkdir()
+    chkp.mkdir()
+    # fire ONCE per plan (state_path), not once per process: the
+    # successor replays the same step indices and must not re-crash
+    plan = faults.FaultPlan(
+        [faults.FaultRule("worker.step", match={"job": "hav-victim"},
+                          after=12, count=1, action="crash",
+                          exit_code=77)],
+        state_path=str(tmp_path / "fault-state.json"),
+    )
+    env = _sanitized_env(8)
+    env[faults.ENV_VAR] = plan.to_json()
+    ports = [_free_port(), _free_port()]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    procs = []
+    try:
+        # replica 0 first: it takes the lease; replica 1 stands by
+        for i, port in enumerate(ports):
+            p = subprocess.Popen(
+                [sys.executable, HA_WORKER, str(ha_dir), f"rep-{i}",
+                 str(port), "1.0", str(chkp)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            procs.append(p)
+            _wait_line(p, "READY", 120)
+            if i == 0:
+                _wait_line(p, "LEADER", 120)
+        sender = CommandSender(addrs=addrs)
+        resp = sender.send_job_submit_command(_victim_cfg("hav-victim"))
+        assert resp.get("ok"), resp
+        # the injection kills the LEADER process mid-epoch, for real
+        assert procs[0].wait(timeout=300) == 77, (
+            procs[0].stderr.read()[-2000:])
+        # warm standby: lease (1s) expires → takeover → re-arm
+        _wait_line(procs[1], "LEADER", 60)
+        # the SAME submission completes through client failover — the
+        # dead replica is still first in the addr list
+        result = sender.wait_result("hav-victim", timeout=300)
+        (w,) = result["workers"].values()
+        # exactly-once tiling: the successor resumed from the last
+        # COMMITTED chain epoch (>0 — the crash landed mid-run, after
+        # at least one commit) and ran precisely the remaining tail
+        assert 0 < int(w["starting_epoch"]) < EPOCHS
+        assert int(w["epochs_run"]) == len(w["losses"])
+        assert int(w["starting_epoch"]) + len(w["losses"]) == EPOCHS
+        # takeover evidence on the successor: role/epoch/one structured
+        # leader_takeover event re-arming exactly this submission
+        status = CommandSender(addrs=addrs).send_status_command()
+        ha = status["ha"]
+        assert ha["enabled"] and ha["role"] == "leader"
+        assert ha["leader_epoch"] == 2
+        tk = ha["takeovers"][-1]
+        assert tk["old_leader"] == "rep-0"
+        assert tk["new_leader"] == "rep-1"
+        assert tk["rearmed"] == ["hav-victim"]
+        # loss parity with an uninterrupted run of the same config —
+        # the same numeric bar the follower chaos tests hold
+        ref = _uninterrupted_final_loss(_victim_cfg("hav-ref"))
+        assert abs(float(w["losses"][-1]) - ref) < 1e-5, (
+            w["losses"][-1], ref)
+        CommandSender(addrs=addrs).send_shutdown_command()
+        assert procs[1].wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_obs_status_answers_through_takeover(tmp_path):
+    """The observability surface keeps working across a leader change:
+    STATUS through the failover client answers from whichever replica
+    currently leads (standbys answer role=standby themselves), with
+    the ha section naming the leader epoch."""
+    from harmony_tpu.jobserver.client import CommandSender
+
+    ha_dir = tmp_path / "ha"
+    chkp = tmp_path / "chkp"
+    ha_dir.mkdir()
+    chkp.mkdir()
+    env = _sanitized_env(8)
+    ports = [_free_port(), _free_port()]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            p = subprocess.Popen(
+                [sys.executable, HA_WORKER, str(ha_dir), f"rep-{i}",
+                 str(port), "1.0", str(chkp)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            procs.append(p)
+            _wait_line(p, "READY", 120)
+            if i == 0:
+                _wait_line(p, "LEADER", 120)
+        sender = CommandSender(addrs=addrs)
+        st = sender.send_status_command()
+        assert st["ok"] and st["ha"]["leader_epoch"] == 1
+        # kill the leader outright; obs must fail over to the successor
+        procs[0].kill()
+        procs[0].wait(timeout=60)
+        _wait_line(procs[1], "LEADER", 60)
+        st2 = CommandSender(addrs=addrs).send_status_command()
+        assert st2["ok"] and st2["ha"]["leader_epoch"] == 2
+        assert st2["ha"]["role"] == "leader"
+        CommandSender(addrs=addrs).send_shutdown_command()
+        assert procs[1].wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # the flap evidence reached the structured surface exactly once:
+    # one takeover (first election is old_leader=None, not a flap)
+    out = json.dumps(st2["ha"]["takeovers"])
+    assert out.count("leader_takeover") >= 1
