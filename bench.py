@@ -608,6 +608,20 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # and the analyzer on every STATUS, so their overhead rides the
         # trajectory too (pinned sweep: CRITPATH_r13.json)
         line["critpath"] = cp
+    pol = measure_policy()
+    if pol is not None:
+        # device-policy plan-evaluation cost: the engine runs inside
+        # the jobserver at HARMONY_POLICY_PERIOD cadence, so its
+        # per-window overhead (and how many actions a loaded window
+        # plans) must be measured, not assumed (docs/SCHEDULING.md)
+        line["policy"] = pol
+    asc = measure_autoscale()
+    if asc is not None:
+        # the closed loop itself: a 1-round churning-mix A/B (policy
+        # off vs act) — --compare holds autoscale.agg_sps and
+        # autoscale.slo_attainment so a regression in the loop fails
+        # bin/bench_diff.sh (pinned capture: AUTOSCALE_r15.json)
+        line["autoscale"] = asc
     print(json.dumps(line))
 
 
@@ -792,6 +806,104 @@ def measure_ha() -> "dict | None":
         return None
 
 
+def measure_policy() -> "dict | None":
+    """Device-policy engine overhead probe (tracked round over round in
+    the BENCH json): full plan evaluations over a synthetic 16-tenant
+    contention window (queued claimant + growable/packable tenants) in
+    ``act`` mode against a null fence. Returns {eval_ms, tenants,
+    actions_planned, actions_per_window} or None — the bench line must
+    never die for its policy hook."""
+    try:
+        from harmony_tpu.jobserver.policy import ActionGate, PolicyEngine
+
+        n = 16
+        rows = {}
+        tenants = {}
+        for i in range(n):
+            jid = f"bench-pol-{i:02d}"
+            rows[jid] = {
+                "slo": {"attainment": 0.4 if i % 3 == 0 else 1.0},
+                "phase_class": ("compute-bound" if i % 3 == 0
+                                else "dispatch-bound" if i % 3 == 1
+                                else "balanced"),
+                "input_wait_frac": 0.1, "mfu": None,
+                "samples_per_sec": 1000.0 + i,
+            }
+            tenants[jid] = {"executors": [f"e{2 * i}", f"e{2 * i + 1}"],
+                            "attempt": 0, "priority": i % 2}
+
+        class _Sched:
+            def idle_executors(self):
+                return ["idle0"]
+
+            def queued_jobs(self):
+                return []
+
+            def plan_grant(self, job_id, executors, shared=False):
+                pass
+
+        import os as _os
+
+        saved = _os.environ.get("HARMONY_POLICY")
+        _os.environ["HARMONY_POLICY"] = "act"
+        try:
+            eng = PolicyEngine(
+                scheduler=_Sched(), ledger_fn=lambda: rows,
+                tenants_fn=lambda: tenants,
+                fence_fn=lambda j, k: None,  # plans, never lands
+                gate=ActionGate(cooldown_sec=0.0, confirm=1,
+                                stale_after=999.0))
+            samples = []
+            planned = 0
+            for _ in range(20):
+                t0 = time.perf_counter()
+                plan = eng.evaluate()
+                samples.append((time.perf_counter() - t0) * 1000.0)
+                planned = len(plan["actions"])
+        finally:
+            if saved is None:
+                _os.environ.pop("HARMONY_POLICY", None)
+            else:
+                _os.environ["HARMONY_POLICY"] = saved
+        return {
+            "eval_ms": round(sorted(samples)[len(samples) // 2], 3),
+            "tenants": n,
+            "actions_per_window": planned,
+        }
+    except Exception:
+        return None
+
+
+def measure_autoscale() -> "dict | None":
+    """Closed-loop autoscaling probe (tracked round over round in the
+    BENCH json, and by --compare via the dotted autoscale.* series): a
+    1-round policy-off-vs-act churning-mix A/B (the full interleaved
+    capture is benchmarks/AUTOSCALE_r15.json). Returns {agg_sps,
+    slo_attainment, agg_speedup, attainment_gain,
+    time_to_rebalance_sec, parity} or None — the bench line must never
+    die for its autoscale hook."""
+    try:
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+        from benchmarks.autoscale import run_autoscale
+
+        r = run_autoscale(rounds=1)
+        if not r.get("loss_parity"):
+            return {"error": "policy-on/off loss parity broke"}
+        return {
+            "agg_sps": r["agg_sps"],
+            "slo_attainment": r["slo_attainment"],
+            "agg_speedup": r["agg_speedup"],
+            "attainment_gain": r["attainment_gain"],
+            "time_to_rebalance_sec": r["time_to_rebalance_sec"],
+            "parity": "exact",
+        }
+    except Exception:
+        return None
+
+
 def measure_lint() -> "dict | None":
     """harmonylint-suite runtime probe (tracked round over round in the
     BENCH json): one full run over harmony_tpu/. Returns {"lint.wall_ms",
@@ -826,8 +938,12 @@ def measure_lint() -> "dict | None":
 #: rounds comparable when the accelerator transport is wedged;
 #: `input_service.svc_sps` (dotted = nested lookup) tracks the
 #: disaggregated-input-service serving rate — absent in rounds before
-#: PR 10, which --compare skips rather than fails.
-HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps")
+#: PR 10, which --compare skips rather than fails; the `autoscale.*`
+#: pair tracks the closed policy loop (aggregate samples/sec and SLO
+#: attainment of the churning-mix act arm) — absent before PR 15,
+#: skipped the same way.
+HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps",
+                   "autoscale.agg_sps", "autoscale.slo_attainment")
 COMPARE_THRESHOLD = 0.15
 
 

@@ -453,15 +453,16 @@ def main(argv: List[str] | None = None) -> int:
     )
     p.add_argument("what",
                    choices=("top", "flight", "metrics", "trace",
-                            "doctor", "critpath"))
+                            "doctor", "critpath", "plan"))
     p.add_argument("--port", type=int, default=None,
-                   help="jobserver TCP port (top/flight/doctor/critpath:"
-                        " STATUS query; default $HARMONY_JOBSERVER_PORT"
-                        " then 43110)")
+                   help="jobserver TCP port (top/flight/doctor/critpath/"
+                        "plan: STATUS query; default "
+                        "$HARMONY_JOBSERVER_PORT then 43110)")
     p.add_argument("--json", action="store_true",
                    help="top: raw ledger JSON instead of the table; "
                         "doctor: raw diagnoses + history stats; "
-                        "critpath: raw phase budgets")
+                        "critpath: raw phase budgets; plan: the raw "
+                        "policy section")
     p.add_argument("--url", default=None,
                    help="metrics: exporter base URL (default "
                         "$HARMONY_METRICS_URL); trace: dashboard URL "
@@ -813,6 +814,17 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
         for line in _render_critpath(status.get("phase_budget", {})):
             print(line)
         return 0
+    if args.what == "plan":
+        status = _obs_status_sender(kind, endpoint).send_status_command()
+        if not status.get("ok"):
+            print(json.dumps(status))
+            return 1
+        if getattr(args, "json", False):
+            print(json.dumps(status.get("policy", {}), indent=2))
+            return 0
+        for line in _render_policy(status.get("policy", {})):
+            print(line)
+        return 0
     base = endpoint
     if args.what == "metrics":
         text = urllib.request.urlopen(base + "/metrics",
@@ -906,6 +918,74 @@ def _render_doctor(diagnoses: list, history: dict) -> "List[str]":
             str(d.get("summary", "")),
         ))
     return out + _render_table(rows)
+
+
+def _render_policy(policy: dict) -> "List[str]":
+    """One-screen device-policy view from a single STATUS scrape
+    (docs/SCHEDULING.md has the action catalog): a header with the
+    engine's mode and gate state, the last computed plan (every
+    candidate with why it was or wasn't acted on), and the recent
+    actions with their outcomes. 'mode: advise' with planned actions is
+    the dry-run answer; 'mode: off' means the loop is disabled."""
+    if not policy:
+        return ["(no policy section — server predates the policy "
+                "engine?)"]
+    gate = policy.get("gate") or {}
+    out = [
+        f"policy: mode={policy.get('mode', '?')} "
+        f"period={policy.get('period_sec', '?')}s "
+        f"evaluations={policy.get('evaluations', 0)} "
+        f"actions={policy.get('actions_total', 0)} "
+        f"rejected={policy.get('rejected_total', 0)} "
+        f"eval={policy.get('eval_ms', 0.0)}ms",
+        f"gate: cooldown={gate.get('cooldown_sec', '?')}s "
+        f"confirm={gate.get('confirm', '?')} "
+        f"fired={gate.get('fired_total', 0)}"
+        + (f" cooling={','.join(gate['cooling'])}"
+           if gate.get("cooling") else "")
+        + (f" backoffs={gate['backoffs']}"
+           if gate.get("backoffs") else ""),
+    ]
+    plan = policy.get("last_plan") or {}
+    if plan:
+        out.append(
+            f"last plan: idle={len(plan.get('idle_executors') or [])} "
+            f"queued={','.join(plan.get('queued') or []) or '-'}")
+        for c in plan.get("considered") or []:
+            why = c.get("blocked")
+            if c.get("check") == "contention":
+                out.append(
+                    f"  contention: {c.get('claimant')} (priority "
+                    f"{c.get('claim_priority')}) vs victims "
+                    f"{','.join(c.get('victims') or []) or '-'}")
+            else:
+                att = c.get("attainment")
+                out.append(
+                    f"  {c.get('job')}: attainment "
+                    + ("-" if att is None else f"{att:.2f}")
+                    + f" class={c.get('class') or '-'} "
+                    + (f"-> blocked: {why}" if why else "-> grow candidate"))
+    actions = policy.get("recent_actions") or []
+    if not actions:
+        out.append("no actions recorded — the mix looks placeable "
+                   "as-is (or the engine is off/advising with nothing "
+                   "to advise)")
+        return out
+    rows = [("WHEN", "ACTION", "TENANT", "OUTCOME", "TARGET", "REASON")]
+    import time as _time
+
+    for a in actions:
+        rows.append((
+            _time.strftime("%H:%M:%S", _time.localtime(a.get("ts", 0))),
+            str(a.get("kind", "?")) + ("*" if a.get("shared") else ""),
+            str(a.get("job", "?")),
+            str(a.get("outcome", "?")),
+            ",".join(a.get("executors") or []),
+            str(a.get("reason", ""))[:60],
+        ))
+    out += _render_table(rows)
+    out.append("(* = shared/overlapping grant)")
+    return out
 
 
 #: waterfall row order + short labels (docs/OBSERVABILITY.md §9 column

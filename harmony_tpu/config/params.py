@@ -209,6 +209,12 @@ class TrainerParams(ConfigBase):
     # service failure degrades to in-process assembly after bounded
     # retry (docs/INPUT_PIPELINE.md §"Input service").
     input_service: bool = False
+    # Scheduling priority (jobserver/policy.py): under device contention
+    # the policy engine shrinks, packs or preempts strictly LOWER-
+    # priority tenants to satisfy higher-priority claimants (queued
+    # arrivals, under-SLO growers). Equal priority never preempts.
+    # Higher = more important; 0 = best-effort (the default).
+    priority: int = 0
     # Per-job throughput SLO (metrics/accounting.py): the samples/sec
     # this job is expected to sustain. 0 = no target. When a worker
     # sustains < 90% of the target across a window of epochs it records

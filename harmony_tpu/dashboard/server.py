@@ -266,6 +266,32 @@ class DashboardServer:
         return {"job_id": job_id, "field": field, "points": points,
                 "diagnoses": diags}
 
+    def policy_rows(self, job_id: Optional[str] = None,
+                    limit: int = 64) -> Dict[str, Any]:
+        """Device-policy actions the jobserver posted (kind='policy'
+        rows, jobserver/policy.py's dashboard tee) — for one tenant or
+        across the cluster, newest last. The operator's 'what did the
+        autoscaler do and why' trail beside the diagnosis history."""
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
+        if job_id is None:
+            rows = self._read_rows(
+                "SELECT ts, job_id, payload FROM metrics "
+                "WHERE kind = 'policy' ORDER BY id DESC LIMIT ?",
+                (limit,))
+        else:
+            rows = self._read_rows(
+                "SELECT ts, job_id, payload FROM metrics "
+                "WHERE kind = 'policy' AND job_id = ? "
+                "ORDER BY id DESC LIMIT ?", (job_id, limit))
+        actions = []
+        for ts, jid, payload in reversed(rows):  # oldest first
+            try:
+                p = json.loads(payload)
+            except ValueError:
+                continue  # one malformed POSTed row must not 400 the rest
+            actions.append({"ts": ts, "job_id": jid, **p})
+        return {"job_id": job_id, "actions": actions}
+
     def critpath_rows(self, job_id: str,
                       limit: int = 64) -> List[Dict[str, Any]]:
         """One job's step-phase budget history from the stored
@@ -720,6 +746,15 @@ class DashboardServer:
                         content_type=(
                             "text/plain; version=0.0.4; charset=utf-8"),
                     )
+                elif parsed.path == "/api/policy":
+                    try:
+                        result = server.policy_rows(
+                            job_id=one("job_id"),
+                            limit=_clamp_limit(one("limit"), default=64))
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
                 elif parsed.path == "/api/jobs":
                     self._json(200, server.jobs())
                 elif parsed.path == "/api/tenants":

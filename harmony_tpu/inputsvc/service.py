@@ -519,11 +519,23 @@ class InputAutoscaler:
     tenants demonstrably wait on input (wait fraction above
     ``up_frac``, or moderately waiting while a straggler ratio says one
     worker lags its peers); scale DOWN when input wait is negligible.
-    One step per tick — input supply should ramp, not slosh."""
+
+    Rate limiting is the policy engine's :class:`~harmony_tpu.jobserver.
+    policy.ActionGate` (cooldown + hysteresis) instead of the old
+    one-step-per-tick period logic: a direction must persist across
+    consecutive ticks before a step lands, and every step runs under
+    the shared ``input_wait`` SIGNAL cooldown — the jobserver passes
+    its device-policy gate in, so input-worker scaling and device
+    packing can never fight over the same stall measurement. One step
+    per firing either way — input supply should ramp, not slosh."""
 
     UP_FRAC = 0.10
     DOWN_FRAC = 0.02
     STRAGGLER_RATIO = 1.5
+    #: gate subject + the shared signal (the device policy engine's
+    #: input-bound pack actions cool the same scope)
+    SUBJECT = "input_workers"
+    SIGNAL = "input_wait"
 
     def __init__(
         self,
@@ -533,6 +545,7 @@ class InputAutoscaler:
         min_workers: Optional[int] = None,
         max_workers: Optional[int] = None,
         period: Optional[float] = None,
+        gate: Optional[Any] = None,
     ) -> None:
         self.service = service
         self._wait_frac_fn = wait_frac_fn
@@ -542,6 +555,17 @@ class InputAutoscaler:
         self.max_workers = (max_workers_from_env()
                             if max_workers is None else max(1, int(max_workers)))
         self.period = scale_period_from_env() if period is None else period
+        if gate is None:
+            # standalone default: hysteresis only (two consecutive
+            # wanting ticks), cooldown = one scale period — the old
+            # one-step-per-tick pacing, now explicit and shared-able.
+            # jax-free: jobserver/__init__ resolves lazily and policy.py
+            # is pure stdlib.
+            from harmony_tpu.jobserver.policy import ActionGate
+
+            gate = ActionGate(cooldown_sec=self.period, confirm=2,
+                              stale_after=max(3.0 * self.period, 1.0))
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -558,17 +582,26 @@ class InputAutoscaler:
             except Exception:
                 ratio = None
         w = self.service.workers
-        if frac is not None and w < self.max_workers and (
+        up_wanted = frac is not None and w < self.max_workers and (
             frac > self.UP_FRAC
             or (frac > self.DOWN_FRAC and ratio is not None
                 and ratio > self.STRAGGLER_RATIO)
-        ):
-            self.service.set_workers(
-                w + 1, reason=f"input_wait={frac:.3f}")
+        )
+        down_wanted = (frac is not None and frac < self.DOWN_FRAC
+                       and w > self.min_workers)
+        # both directions observe every tick so the streaks stay honest
+        # (a flapping signal resets the opposite direction's streak)
+        up_ready = self.gate.observe(self.SUBJECT, "up", up_wanted,
+                                     signal=self.SIGNAL)
+        down_ready = self.gate.observe(self.SUBJECT, "down", down_wanted,
+                                       signal=self.SIGNAL)
+        if up_ready:
+            self.service.set_workers(w + 1, reason=f"input_wait={frac:.3f}")
+            self.gate.fired(self.SUBJECT, "up", signal=self.SIGNAL)
             return self.service.scale_events[-1]
-        if frac is not None and frac < self.DOWN_FRAC and w > self.min_workers:
-            self.service.set_workers(
-                w - 1, reason=f"input_wait={frac:.3f}")
+        if down_ready:
+            self.service.set_workers(w - 1, reason=f"input_wait={frac:.3f}")
+            self.gate.fired(self.SUBJECT, "down", signal=self.SIGNAL)
             return self.service.scale_events[-1]
         return None
 

@@ -1,16 +1,53 @@
-from harmony_tpu.jobserver.scheduler import FifoExclusiveScheduler, JobScheduler, ShareAllScheduler
-from harmony_tpu.jobserver.entity import DolphinJobEntity, JobEntity
-from harmony_tpu.jobserver.server import JobServer
-from harmony_tpu.jobserver.client import CommandSender, submit_job, shutdown_server
+"""JobServer package.
 
-__all__ = [
-    "JobScheduler",
-    "ShareAllScheduler",
-    "FifoExclusiveScheduler",
-    "JobEntity",
-    "DolphinJobEntity",
-    "JobServer",
-    "CommandSender",
-    "submit_job",
-    "shutdown_server",
-]
+Exports resolve lazily (PEP 562, the ``dolphin``/``runtime`` precedent):
+``jobserver.policy``'s :class:`ActionGate` is consumed by the jax-free
+input-service layer (``harmony_tpu.inputsvc``), which must not pay — or
+depend on — the jax import chain ``jobserver.server`` pulls in. Eager
+``from harmony_tpu.jobserver import JobServer`` style imports behave
+exactly as before.
+"""
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "JobScheduler": "harmony_tpu.jobserver.scheduler",
+    "ShareAllScheduler": "harmony_tpu.jobserver.scheduler",
+    "FifoExclusiveScheduler": "harmony_tpu.jobserver.scheduler",
+    "JobEntity": "harmony_tpu.jobserver.entity",
+    "DolphinJobEntity": "harmony_tpu.jobserver.entity",
+    "JobServer": "harmony_tpu.jobserver.server",
+    "CommandSender": "harmony_tpu.jobserver.client",
+    "submit_job": "harmony_tpu.jobserver.client",
+    "shutdown_server": "harmony_tpu.jobserver.client",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from harmony_tpu.jobserver.client import (
+        CommandSender,
+        shutdown_server,
+        submit_job,
+    )
+    from harmony_tpu.jobserver.entity import DolphinJobEntity, JobEntity
+    from harmony_tpu.jobserver.scheduler import (
+        FifoExclusiveScheduler,
+        JobScheduler,
+        ShareAllScheduler,
+    )
+    from harmony_tpu.jobserver.server import JobServer

@@ -582,14 +582,16 @@ class PodJobServer(JobServer):
                     kind, type(e).__name__, e,
                 )
 
-    def _schedule_elastic_fence(self, job_id: str,
-                                kind: str) -> Optional[int]:
+    def _schedule_elastic_fence(self, job_id: str, kind: str,
+                                origin: str = "failure") -> Optional[int]:
         """Schedule a lockstep elastic fence on a RUNNING attempt: the
         plan broadcast rides the PLAN channel; every participating
         process's chief hook raises the fence at the same epoch (the
         multi-epoch-lead contract of schedule_pod_reshard, same horizon
         arithmetic). Returns the fence epoch, or None when the job is
-        too close to its end to be worth reconfiguring."""
+        too close to its end to be worth reconfiguring. ``origin``
+        marks who asked — the failure paths or the policy engine — in
+        the structured fence event."""
         from harmony_tpu.dolphin.worker import WorkerTasklet
         from harmony_tpu.jobserver import podplan
 
@@ -641,8 +643,32 @@ class PodJobServer(JobServer):
                 pass
         podplan.schedule(job_id, plan)
         self._record_pod_event(f"elastic_{kind}_fence", job_id=job_id,
-                               epoch=int(epoch), attempt=att)
+                               epoch=int(epoch), attempt=att,
+                               origin=origin)
         return epoch
+
+    # -- policy-engine actuator (jobserver/policy.py) ---------------------
+
+    def _policy_tenants(self) -> Dict[str, Dict[str, Any]]:
+        """The running elastic attempts, as the policy engine's
+        actuatable-tenant view: live executor grant, attempt index
+        (recovery-budget check) and the job's scheduling priority."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._pod_cond:
+            for jid, st in self._elastic_active.items():
+                cfg = st["config"]
+                out[jid] = {
+                    "executors": list(st.get("executors") or ()),
+                    "attempt": int(st.get("attempt", 0)),
+                    "priority": int(getattr(cfg.params, "priority", 0)),
+                }
+        return out
+
+    def _policy_fence(self, job_id: str, kind: str) -> Optional[int]:
+        """Policy actions land through the SAME lockstep fence the
+        failure paths use — consistent epoch cut, loss parity, exactly-
+        once tiling; the event's origin says the policy asked."""
+        return self._schedule_elastic_fence(job_id, kind, origin="policy")
 
     def _reader_loop(self, pid: int, f) -> None:
         """Owns all reads from follower ``pid``: routes JOB_DONE payloads
@@ -1000,6 +1026,9 @@ class PodJobServer(JobServer):
                         ),
                         "original_procs": original_procs,
                         "config": cfg,
+                        # the live grant — what the policy engine's
+                        # grow/shrink/pack targets are computed FROM
+                        "executors": list(execs),
                     }
                 try:
                     self._dispatch_once(cfg, execs)
@@ -1071,6 +1100,13 @@ class PodJobServer(JobServer):
             from harmony_tpu.checkpoint import manager as _chkp_mgr
 
             _chkp_mgr.drop_recovery_cache(prefix=f"{config.job_id}:")
+            # and drop any unconsumed policy-planned grant: a stale pin
+            # (possibly SHARED) must never leak to a future submission
+            # reusing this job id
+            try:
+                self._scheduler.plan_grant(config.job_id, None)
+            except Exception:
+                pass
 
     def _plan_elastic_recovery(
         self,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from harmony_tpu.config.params import JobConfig
 
@@ -28,6 +28,59 @@ class JobScheduler:
     def bind(self, executor_ids: List[str], launch: LaunchFn) -> None:
         self._executors = list(executor_ids)
         self._launch = launch
+        # eager policy-target init: plan_grant (the policy thread) and
+        # reacquire (dispatch threads) both touch the map, and the base
+        # class is lockless — creating it HERE, before any job exists,
+        # removes the lazy-init race that could silently drop a pin
+        if getattr(self, "_policy_target_map", None) is None:
+            self._policy_target_map: Dict[str, Tuple[List[str], bool]] = {}
+
+    # -- policy-engine SPI (jobserver/policy.py) -------------------------
+
+    def _policy_targets(self) -> Dict[str, Tuple[List[str], bool]]:
+        """The ``job_id -> (executors, shared)`` map of policy-planned
+        grants, created in :meth:`bind` (lazy fallback for direct-
+        constructed test doubles that never bind)."""
+        t = getattr(self, "_policy_target_map", None)
+        if t is None:
+            t = self._policy_target_map = {}
+        return t
+
+    def plan_grant(self, job_id: str, executors: Optional[List[str]],
+                   shared: bool = False) -> None:
+        """Pin the NEXT :meth:`reacquire` grant for ``job_id`` to this
+        executor set (the policy engine's actuator: the grant lands when
+        the elastic fence ends the running attempt). ``shared=True``
+        allows the grant to OVERLAP other tenants' slices (pack/preempt
+        — ShareAll-style sharing arbitrated by the TaskUnit fair
+        queue). ``executors=None`` clears the pin. One-shot: consumed by
+        whichever reacquire runs next for the job."""
+        if executors is None:
+            self._policy_targets().pop(job_id, None)
+        else:
+            self._policy_targets()[job_id] = (list(executors), bool(shared))
+
+    def planned_grant(self, job_id: str
+                      ) -> Optional[Tuple[List[str], bool]]:
+        return self._policy_targets().get(job_id)
+
+    def idle_executors(self) -> List[str]:
+        """Executors no running job holds — the policy engine's grow
+        fodder. Overlap schedulers (share-all) have no idle notion and
+        report none."""
+        return []
+
+    def idle_units(self) -> List[List[str]]:
+        """Idle capacity in GRANT units: the indivisible executor
+        groups a policy grow may take (one executor each by default;
+        whole host processes on a process-carved pod — the planner must
+        never split a process between exclusive tenants)."""
+        return [[e] for e in self.idle_executors()]
+
+    def queued_jobs(self) -> List[JobConfig]:
+        """Arrivals waiting for capacity (the policy engine's contention
+        signal). Non-queueing schedulers report none."""
+        return []
 
     def on_job_arrival(self, config: JobConfig) -> None:
         raise NotImplementedError
@@ -59,8 +112,15 @@ class JobScheduler:
         executors for its next attempt, preferring the previous grant's
         survivors (minimal data movement). Returns the granted executor
         ids ([] = nothing available; recovery fails over to a plain job
-        failure). Default (share-all semantics): the surviving preferred
+        failure). A policy-planned grant (:meth:`plan_grant`) wins when
+        one is pinned — that is how the policy engine's fenced actions
+        land. Default (share-all semantics): the surviving preferred
         set, else every live executor."""
+        tgt = self._policy_targets().pop(job_id, None)
+        if tgt is not None:
+            execs = [e for e in tgt[0] if e in self._executors]
+            if execs:
+                return execs
         alive = [e for e in preferred if e in self._executors]
         return alive or list(self._executors)
 
@@ -155,13 +215,47 @@ class CarveScheduler(JobScheduler):
         for cfg, sl in launches:
             self._launch(cfg, sl)
 
+    def _claim_target_locked(self, job_id: str,
+                             tgt: "Tuple[List[str], bool]") -> List[str]:
+        """Under the lock: land a policy-planned grant. Exclusive
+        targets take only still-free executors (a concurrent arrival
+        may have claimed some since the plan); shared targets overlap
+        live slices by design (pack/preempt). [] = plan no longer
+        satisfiable — the caller falls back to the normal grant."""
+        execs, shared = tgt
+        known = set(self._executors)
+        execs = [e for e in execs if e in known]
+        if not shared:
+            free = set(self._free)
+            execs = [e for e in execs if e in free]
+        if not execs:
+            return []
+        taken = set(execs)
+        self._free = [e for e in self._free if e not in taken]
+        self._slices[job_id] = execs
+        return execs
+
+    def idle_executors(self) -> List[str]:
+        with self._lock:
+            return list(self._free)
+
+    def queued_jobs(self) -> List[JobConfig]:
+        with self._lock:
+            return list(self._queue)
+
     def reacquire(self, job_id: str, preferred: List[str]) -> List[str]:
-        """In-place recovery grant: take the still-free survivors of the
+        """In-place recovery grant: a policy-planned target wins when
+        still satisfiable; else take the still-free survivors of the
         previous grant; if none survive, carve a fresh slice. The grant
         registers under ``job_id`` so the attempt's on_job_finish returns
         it like any slice (each attempt pairs one reacquire with one
         finish)."""
         with self._lock:
+            tgt = self._policy_targets().pop(job_id, None)
+            if tgt is not None:
+                take = self._claim_target_locked(job_id, tgt)
+                if take:
+                    return take
             free = set(self._free)
             take = [e for e in preferred if e in free]
             if not take:
@@ -199,10 +293,16 @@ class CarveScheduler(JobScheduler):
         launches = []
         with self._lock:
             known = set(self._executors)
-            # only still-provisioned executors return to the pool (some may
-            # have departed via on_resource_change while the job ran)
+            mine = self._slices.pop(job_id, [])
+            # only still-provisioned executors return to the pool (some
+            # may have departed via on_resource_change while the job
+            # ran), and never ones another live slice still holds — a
+            # shared (packed) grant overlaps slices, so the LAST tenant
+            # off an executor frees it
+            held = {e for sl in self._slices.values() for e in sl}
             self._free.extend(
-                e for e in self._slices.pop(job_id, []) if e in known
+                e for e in mine
+                if e in known and e not in held and e not in self._free
             )
             launches = self._drain_queue_locked()
         for cfg, sl in launches:
@@ -280,8 +380,16 @@ class ProcessCarveScheduler(CarveScheduler):
         COMPLETE free processes (a partial process in a recovery grant
         would break the disjoint-process concurrency guarantee every
         carved tenant relies on); otherwise a fresh whole-process slice
-        is carved."""
+        is carved. A policy-planned grant wins when satisfiable — the
+        planner composes pod targets from :meth:`idle_units` (whole
+        processes), and :meth:`_claim_target_locked` re-validates the
+        shape as the backstop."""
         with self._lock:
+            tgt = self._policy_targets().pop(job_id, None)
+            if tgt is not None:
+                take = self._claim_target_locked(job_id, tgt)
+                if take:
+                    return take
             free = set(self._free)
             wanted = set(preferred)
             members: Dict[int, List[str]] = {}
@@ -303,6 +411,35 @@ class ProcessCarveScheduler(CarveScheduler):
             if take:
                 self._slices[job_id] = take
         return take
+
+    def _claim_target_locked(self, job_id: str,
+                             tgt: "Tuple[List[str], bool]") -> List[str]:
+        """Whole-process backstop for policy grants: an EXCLUSIVE
+        target that splits any process is rejected outright (the
+        normal reacquire path then grants) — a half-claimed process is
+        exactly the shape this scheduler exists to forbid. Shared
+        (pack/preempt) targets overlap by design and pass through."""
+        execs, shared = tgt
+        if not shared:
+            members: Dict[int, List[str]] = {}
+            for e in self._executors:
+                members.setdefault(self._proc_of.get(e, 0), []).append(e)
+            want = set(execs) & set(self._executors)
+            for p, mem in members.items():
+                if want & set(mem) and not want >= set(mem):
+                    return []
+        return super()._claim_target_locked(job_id, tgt)
+
+    def idle_units(self) -> List[List[str]]:
+        """Idle capacity in whole-process units — the only grant shape
+        a policy grow may take here."""
+        with self._lock:
+            members: Dict[int, List[str]] = {}
+            for e in self._executors:
+                members.setdefault(self._proc_of.get(e, 0), []).append(e)
+            free = set(self._free)
+            return [list(mem) for _p, mem in sorted(members.items())
+                    if mem and free >= set(mem)]
 
     def _take_slice(self) -> Optional[List[str]]:
         """Under the lock: carve whole free processes or None to queue."""

@@ -170,7 +170,25 @@ class JobServer:
             self.history,
             targets_fn=self._scrape_targets,
             ledger_fn=self.metrics.tenant_ledger,
-            on_cycle=self.doctor.diagnose,
+            on_cycle=self._on_scrape_cycle,
+        )
+        # Device policy engine (jobserver/policy.py): each window it
+        # reads the ledger + diagnoses + critpath verdicts and replans
+        # placement through the elastic fences — grow under-SLO tenants
+        # onto idle executors, shrink/pack/preempt low-priority tenants
+        # under contention. HARMONY_POLICY selects off/advise/act; the
+        # plain server has no elastic actuator, so it advises; the pod
+        # server overrides the tenants/fence hooks with real ones.
+        from harmony_tpu.jobserver.policy import PolicyEngine
+
+        self.policy = PolicyEngine(
+            scheduler=self._scheduler,
+            ledger_fn=self.metrics.tenant_ledger,
+            tenants_fn=self._policy_tenants,
+            fence_fn=self._policy_fence,
+            diagnoses_fn=self.doctor.recent,
+            leader_ok_fn=self._ha_leader_ok,
+            sinks=(self._post_policy,),
         )
         # Control-plane HA (jobserver/ha.py): wired by enable_ha when
         # this server is one replica of an HA control plane. leader_epoch
@@ -611,6 +629,17 @@ class JobServer:
             with self._lock:
                 self._entities.pop(config.job_id, None)
             self._scheduler.on_job_finish(config.job_id)
+            if _el.attempt_of(config) == 0 and not config.user.get(
+                    "elastic_shrink"):
+                # non-elastic submissions consume no reacquire: drop any
+                # policy pin so it cannot leak to a reused job id (the
+                # elastic loop clears its own at submission end — a pin
+                # must survive the per-attempt finish that precedes its
+                # consuming reacquire)
+                try:
+                    self._scheduler.plan_grant(config.job_id, None)
+                except Exception:
+                    pass
 
     def _entity_extras(self, config: JobConfig,
                        executor_ids: List[str]) -> Dict[str, Any]:
@@ -629,6 +658,43 @@ class JobServer:
         targets: Dict[str, Any] = {"leader": get_registry().expose}
         targets.update(extra_targets())
         return targets
+
+    def _on_scrape_cycle(self) -> None:
+        """After every history-scraper poll: the doctor evaluates its
+        rules, then the policy engine (throttled to its own period)
+        replans off the fresh verdicts — sensor before actuator, every
+        cycle, both contained (a broken one must not stop the other)."""
+        try:
+            self.doctor.diagnose()
+        except Exception:
+            pass
+        try:
+            self.policy.maybe_evaluate()
+        except Exception:
+            pass
+
+    def _policy_tenants(self) -> Dict[str, Dict[str, Any]]:
+        """Policy-engine actuator view: the running tenants whose
+        placement CAN be replanned (elastic attempts with a fence
+        channel). The plain server has none — the pod server overrides
+        with its elastic-active bookkeeping."""
+        return {}
+
+    def _policy_fence(self, job_id: str, kind: str) -> Optional[int]:
+        """Policy-engine actuator: schedule a lockstep elastic fence on
+        a running attempt. No fence channel on the plain server —
+        actions stay advisory here."""
+        return None
+
+    def _post_policy(self, action: Dict[str, Any]) -> None:
+        """Policy sink: tee every recorded action to the dashboard as a
+        kind="policy" row (same best-effort contract as metric posts)."""
+        if self._dashboard is not None:
+            try:
+                self._dashboard.post(str(action.get("job")), "policy",
+                                     dict(action))
+            except Exception:
+                pass  # dashboard posts are best-effort by contract
 
     def _post_diagnosis(self, diag) -> None:
         """Doctor sink: tee every fresh diagnosis to the dashboard as a
@@ -671,7 +737,12 @@ class JobServer:
                 ratios = [r["ratio"] for r in reps.values()]
                 return max(ratios) if ratios else None
 
-            scaler = inputsvc.InputAutoscaler(svc, wait_frac, straggler)
+            # the autoscaler shares the POLICY engine's rate-limit gate:
+            # input-worker scaling and device packing both key off the
+            # input-wait signal, and a shared cooldown on that signal is
+            # what keeps them from fighting over it
+            scaler = inputsvc.InputAutoscaler(svc, wait_frac, straggler,
+                                              gate=self.policy.gate)
             scaler.start()
             self.input_service = svc
             self._input_autoscaler = scaler
@@ -750,6 +821,11 @@ class JobServer:
             # durable-log/lease/replication shape and recent takeovers —
             # {"enabled": False} outside an HA deployment
             "ha": self._ha_status(),
+            # device policy engine (jobserver/policy.py): mode, the last
+            # computed plan (candidates + why each was or wasn't acted
+            # on), recent actions, and the rate-limit gate's state —
+            # what `harmony-tpu obs plan` renders
+            "policy": self.policy.status(),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------

@@ -443,6 +443,84 @@ def _leader_flap(ctx: DoctorContext) -> List[Diagnosis]:
                   "count": len(takeovers)})]
 
 
+#: rebalance_ineffective: the post-action median must clear the
+#: pre-action median by this factor (or +0.05 absolute) to count as
+#: improvement — flat noise is not a win
+POLICY_GAIN_FACTOR = 1.05
+
+
+def _policy_judge_age() -> float:
+    """How old a policy action must be before its effect is judged: two
+    policy evaluation windows (jobserver/policy.py's period knob).
+    Guarded lazy import — metrics must not hard-depend on the
+    jobserver."""
+    try:
+        from harmony_tpu.jobserver.policy import policy_period
+
+        return 2.0 * policy_period()
+    except Exception:
+        return 20.0
+
+
+@doctor_rule("rebalance_ineffective",
+             "an executed GROW policy action (kind=\"policy\" joblog "
+             "event, jobserver/policy.py) whose target tenant shows no "
+             "MFU or SLO-attainment improvement within two policy "
+             "windows of the fence — the engine backs the tenant off on "
+             "this diagnosis instead of churning it (shrink/pack/"
+             "preempt victims degrade BY DESIGN and are never judged)")
+def _rebalance_ineffective(ctx: DoctorContext) -> List[Diagnosis]:
+    judge_age = _policy_judge_age()
+    out: List[Diagnosis] = []
+    for job, events in ctx.events.items():
+        # only actions meant to HELP their target are judged by the
+        # target's own series — a shrink/pack/preempt victim's numbers
+        # drop on purpose (the claimant got the capacity)
+        acts = [e for e in events
+                if e.get("kind") == "policy" and e.get("executed")
+                and e.get("action") == "grow"]
+        if not acts:
+            continue
+        ev = acts[-1]
+        ts = float(ev.get("ts", 0.0))
+        age = ctx.now - ts
+        if age < judge_age or age > ctx.window:
+            # too fresh to judge, or ancient history — the upper bound
+            # is ONE doctor window so the once-per-(rule,subject)
+            # dedup horizon fully covers it: the same action can never
+            # be re-diagnosed (and backed off) in a later window
+            continue
+        judged = False
+        improved = False
+        detail: Dict[str, Any] = {}
+        for series in ("tenant.slo_attainment", "tenant.mfu"):
+            for _labels, pts in ctx.store.range(
+                    series, labels={"job": job}, since=ts - ctx.window):
+                before = [v for t, v in pts if t < ts]
+                after = [v for t, v in pts if t >= ts]
+                if not before or not after:
+                    continue
+                judged = True
+                b, a = _median(before), _median(after)
+                detail[series] = {"before_median": round(b, 4),
+                                  "after_median": round(a, 4)}
+                if a > b * POLICY_GAIN_FACTOR or a > b + 0.05:
+                    improved = True
+        if not judged or improved:
+            continue
+        out.append(Diagnosis(
+            rule="rebalance_ineffective",
+            verdict="rebalance_ineffective",
+            confidence=0.7,
+            summary=(f"policy {ev.get('action')} on tenant {job} "
+                     "produced no MFU/SLO-attainment improvement within "
+                     "two policy windows — backing off"),
+            window=(ts, ctx.now),
+            job=job,
+            evidence={"policy_event": dict(ev), "series": detail}))
+    return out
+
+
 @doctor_rule("slo_breach",
              "a structured kind=\"slo\" joblog breach event joined to "
              "whichever rule fired in its window — the breach gets a "

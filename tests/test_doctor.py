@@ -37,7 +37,8 @@ class TestRuleCatalog:
         # order is evaluation order); the PR-13 phase rules sit before it
         assert names == ["input_bound", "straggler", "mfu_collapse",
                          "compile_storm", "infra_suspect", "comm_bound",
-                         "dispatch_bound", "leader_flap", "slo_breach"]
+                         "dispatch_bound", "leader_flap",
+                         "rebalance_ineffective", "slo_breach"]
         assert all(r.description for r in all_rules())
 
     def test_input_bound_fires_and_names_tenant(self):

@@ -589,18 +589,32 @@ class TestDeferredProvider:
             list(p.epoch_batches_at(0))
 
 
+def _fast_gate():
+    """Hysteresis-only gate (no cooldown) so ticks drive the clockless
+    fast tier: a direction must persist TWO consecutive ticks, exactly
+    the shared-gate contract the jobserver wires (policy.ActionGate)."""
+    from harmony_tpu.jobserver.policy import ActionGate
+
+    return ActionGate(cooldown_sec=0.0, confirm=2, stale_after=999.0)
+
+
 class TestAutoscaler:
     def test_scales_up_on_input_wait_and_down_when_idle(self):
         svc = InputService(workers=2)
         frac = [0.5]
         scaler = InputAutoscaler(svc, lambda: frac[0], min_workers=1,
-                                 max_workers=4, period=999)
+                                 max_workers=4, period=999,
+                                 gate=_fast_gate())
+        assert scaler.tick() is None  # hysteresis: one window never acts
         ev = scaler.tick()
         assert ev is not None and svc.workers == 3
         frac[0] = 0.0
+        assert scaler.tick() is None  # direction flip resets the streak
+        scaler.tick()
         scaler.tick()
         scaler.tick()
         assert svc.workers == 1  # floored at min
+        scaler.tick()
         scaler.tick()
         assert svc.workers == 1
         assert len(svc.scale_events) == 3
@@ -608,11 +622,42 @@ class TestAutoscaler:
     def test_straggler_tiebreak_and_none_safety(self):
         svc = InputService(workers=2)
         scaler = InputAutoscaler(svc, lambda: 0.05, lambda: 2.0,
-                                 min_workers=1, max_workers=4, period=999)
+                                 min_workers=1, max_workers=4, period=999,
+                                 gate=_fast_gate())
+        scaler.tick()
         assert scaler.tick() is not None and svc.workers == 3
         quiet = InputAutoscaler(svc, lambda: None, min_workers=1,
-                                max_workers=4, period=999)
+                                max_workers=4, period=999,
+                                gate=_fast_gate())
         assert quiet.tick() is None  # unknown wait fraction: no action
+        assert quiet.tick() is None
+
+    def test_shared_signal_cooldown_blocks_cross_scaler_fights(self):
+        """The PR-15 contract: the device policy engine and the input
+        autoscaler share ONE gate, and an action fired on the
+        input_wait signal cools BOTH — they cannot thrash the same
+        stall measurement from two loops."""
+        from harmony_tpu.jobserver.policy import ActionGate
+
+        gate = ActionGate(cooldown_sec=60.0, confirm=2, stale_after=999.0)
+        svc = InputService(workers=2)
+        scaler = InputAutoscaler(svc, lambda: 0.5, min_workers=1,
+                                 max_workers=4, period=999, gate=gate)
+        # the device engine just packed an input-bound tenant (fired on
+        # the shared signal) — the input autoscaler must hold off
+        gate.fired("some-tenant", "pack", signal=InputAutoscaler.SIGNAL)
+        assert scaler.tick() is None
+        assert scaler.tick() is None
+        assert svc.workers == 2
+        # and the reverse: an input-worker step cools the signal for
+        # the device engine's next input_wait-keyed action
+        gate2 = ActionGate(cooldown_sec=60.0, confirm=1, stale_after=999.0)
+        svc2 = InputService(workers=2)
+        s2 = InputAutoscaler(svc2, lambda: 0.5, min_workers=1,
+                             max_workers=4, period=999, gate=gate2)
+        assert s2.tick() is not None
+        assert not gate2.observe("tenant-x", "pack", wanted=True,
+                                 signal=InputAutoscaler.SIGNAL)
 
     def test_shrunk_pool_reslots_idle_tenants(self):
         svc = InputService(workers=4)
