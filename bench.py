@@ -479,6 +479,53 @@ def measure_sparse_hot_path() -> "dict | None":
         return None
 
 
+def measure_async_step() -> "dict | None":
+    """Bounded-staleness async step probe (tracked round over round in
+    the BENCH json, and by --compare via the dotted async_step.* series):
+    a small MLR WorkerTasklet under an injected worker.pull delay, sync
+    unfused vs async bound 0 (the bit-identical control) vs async bound
+    1 (the overlap arm). Returns {sync_sps, b0_sps, b1_sps, speedup_b1,
+    max_lag_b1, parity}, {"error": ...} on a parity break, or None — the
+    bench line must never die for its async-step hook (pinned capture:
+    benchmarks/ASYNC_STEP_r16.json)."""
+    try:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.async_step_bench import run_arm
+
+        # comp ~ delay is the regime where overlap shows: either side
+        # dominating caps the win at the smaller of the two
+        # full-bench shape (comp ~ delay ~ 4ms), fewer epochs
+        probe = dict(epochs=2, batches=8)
+        # two interleaved rounds, best-of per arm: round 1 pays the
+        # compile (the progcache is warm from round 2 on), so a single
+        # cold pass would mis-rank the arms
+        sync_sps = b0_sps = b1_sps = 0.0
+        b1_stats = {}
+        for _ in range(2):
+            sps, sync_losses, _ = run_arm(False, 0, **probe)
+            sync_sps = max(sync_sps, sps)
+            sps, b0_losses, _ = run_arm(True, 0, **probe)
+            b0_sps = max(b0_sps, sps)
+            if b0_losses != sync_losses:
+                return {"error": "staleness-0 loss parity broke"}
+            sps, _, st = run_arm(True, 1, **probe)
+            if sps > b1_sps:
+                b1_sps, b1_stats = sps, st
+        return {
+            "sync_sps": round(sync_sps, 1),
+            "b0_sps": round(b0_sps, 1),
+            "b1_sps": round(b1_sps, 1),
+            "speedup_b1": round(b1_sps / sync_sps, 2),
+            "max_lag_b1": b1_stats.get("max_lag", 0),
+            "parity": "bit-identical",
+        }
+    except Exception:
+        return None
+
+
 def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
          job_walls: dict | None = None, probe_log: list | None = None) -> None:
     if error:
@@ -575,6 +622,13 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # measured per-phase pull/comp/push split, tracked round over
         # round so device-hot-path regressions land in the trajectory
         line["sparse_hot_path"] = sp
+    asp = measure_async_step()
+    if asp is not None:
+        # bounded-staleness async step A/B (sync vs bound 0 control vs
+        # bound 1 overlap) under an injected comm delay — --compare
+        # holds async_step.b1_sps so an overlap regression fails
+        # bin/bench_diff.sh (pinned capture: ASYNC_STEP_r16.json)
+        line["async_step"] = asp
     isvc = measure_input_service()
     if isvc is not None:
         # disaggregated-input-service throughput A/B (small unpinned
@@ -941,9 +995,11 @@ def measure_lint() -> "dict | None":
 #: PR 10, which --compare skips rather than fails; the `autoscale.*`
 #: pair tracks the closed policy loop (aggregate samples/sec and SLO
 #: attainment of the churning-mix act arm) — absent before PR 15,
-#: skipped the same way.
+#: skipped the same way; `async_step.b1_sps` tracks the bounded-
+#: staleness overlap arm (absent before PR 16, skipped the same way).
 HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps",
-                   "autoscale.agg_sps", "autoscale.slo_attainment")
+                   "autoscale.agg_sps", "autoscale.slo_attainment",
+                   "async_step.b1_sps")
 COMPARE_THRESHOLD = 0.15
 
 

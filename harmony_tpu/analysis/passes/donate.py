@@ -17,6 +17,17 @@ wrapper's donation positions are visible:
   in that loop → finding at the call (the next iteration re-donates a
   dead buffer). ``tbl = w(tbl, batch)`` is the sanctioned shape.
 
+Ping/pong double-buffer rotation (the async step's overlap window,
+docs/DEVICE_HOT_PATH.md §Async step mode) is understood: a pure-name
+tuple assignment like ``ping, pong = pong, ping`` MOVES handles — the
+RHS names are handle copies, not device reads, so the rotation itself
+never fires a finding, and a donated name whose handle rotates onto a
+new name counts as rebound for the loop rule. The deadness follows the
+handle instead: after the rotation the ALIAS now holding the donated
+buffer is tracked, and a read of it inside the overlap window without a
+rebinding fence (``view = drv.wait_view()``-style republish) is flagged
+at the read.
+
 Reads inside nested functions are deferred calls the linear scan cannot
 order and are out of scope (the dynamic donation tests own those).
 """
@@ -55,6 +66,10 @@ def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
 
 # event kinds, in execution order within a scope
 _DONATE, _STORE, _LOAD = "donate", "store", "load"
+#: one pure-name tuple assignment (``a, b = b, a``): handles MOVE
+#: atomically (every RHS read precedes every LHS bind), so the whole
+#: rotation is ONE event carrying its dst<-src mapping
+_MOVE = "move"
 
 
 class _ScopeScanner:
@@ -64,12 +79,15 @@ class _ScopeScanner:
 
     def __init__(self, wrappers: Dict[str, Tuple[int, ...]]) -> None:
         self.wrappers = dict(wrappers)
-        #: (kind, name, node, loop-stack, branch-path); branch-path is
-        #: ((if-node-id, arm), ...) so the judge can recognize mutually
-        #: exclusive if/else arms and not order them against each other
+        #: (kind, name, node, loop-stack, branch-path, moves); branch-
+        #: path is ((if-node-id, arm), ...) so the judge can recognize
+        #: mutually exclusive if/else arms and not order them against
+        #: each other; moves is the ((dst, src), ...) mapping of a _MOVE
+        #: event (empty for every other kind)
         self.events: List[
             Tuple[str, str, ast.AST, Tuple[int, ...],
-                  Tuple[Tuple[int, int], ...]]] = []
+                  Tuple[Tuple[int, int], ...],
+                  Tuple[Tuple[str, str], ...]]] = []
         self._loops: List[int] = []
         self._branches: List[Tuple[int, int]] = []
 
@@ -77,9 +95,10 @@ class _ScopeScanner:
         for stmt in body:
             self._stmt(stmt)
 
-    def _emit(self, kind: str, name: str, node: ast.AST) -> None:
+    def _emit(self, kind: str, name: str, node: ast.AST,
+              moves: Tuple[Tuple[str, str], ...] = ()) -> None:
         self.events.append((kind, name, node, tuple(self._loops),
-                            tuple(self._branches)))
+                            tuple(self._branches), moves))
 
     def _stmt(self, node: ast.stmt) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -102,6 +121,21 @@ class _ScopeScanner:
                     pos = _donate_positions(value)
                     if pos:
                         self.wrappers[targets[0].id] = pos
+                # ping/pong rotation: a pure-name tuple assignment moves
+                # handles without touching device memory — ONE atomic
+                # _MOVE event instead of loads+stores (module docstring)
+                if (isinstance(value, ast.Tuple)
+                        and len(targets) == 1
+                        and isinstance(targets[0], ast.Tuple)
+                        and len(value.elts) == len(targets[0].elts) > 1
+                        and all(isinstance(e, ast.Name)
+                                for e in value.elts)
+                        and all(isinstance(e, ast.Name)
+                                for e in targets[0].elts)):
+                    self._emit(_MOVE, "", node, moves=tuple(
+                        (dst.id, src.id)
+                        for dst, src in zip(targets[0].elts, value.elts)))
+                    return
                 self._expr(value)
                 for t in targets:
                     self._target(t)
@@ -287,35 +321,63 @@ class UseAfterDonatePass(Pass):
 
     def _judge(self, rel: str, events) -> List[Finding]:
         out: List[Finding] = []
-        for i, (kind, name, node, loops, branches) in enumerate(events):
+        for i, (kind, name, node, loops, branches, _mv) in enumerate(events):
             if kind != _DONATE:
                 continue
-            for kind2, name2, node2, _loops2, branches2 in events[i + 1:]:
-                # tbl.sum() / tbl[k] reads are reads of tbl; only a
-                # store of the NAME itself rebinds it
-                if name2 != name and not name2.startswith(name + "."):
-                    continue
+            # `cur` tracks the NAME currently holding the donated (dead)
+            # handle — a ping/pong rotation moves the deadness to the
+            # alias instead of killing the scan
+            cur = name
+            for kind2, name2, node2, _loops2, branches2, mv2 in (
+                    events[i + 1:]):
                 if self._exclusive(branches, branches2):
                     continue  # sibling if/else arm: never both execute
-                if kind2 == _STORE and name2 == name:
+                if kind2 == _MOVE:
+                    dst_of = {src: dst for dst, src in mv2}
+                    if cur in dst_of:
+                        # the dead handle rotated: follow it. (If cur is
+                        # also a move TARGET — the swap case — the handle
+                        # still leaves; the fresh handle landing on cur
+                        # is the rebind the loop rule credits.)
+                        cur = dst_of[cur]
+                        continue
+                    if any(dst == cur for dst, _src in mv2):
+                        break  # cur rebound to some other live handle
+                    continue
+                # tbl.sum() / tbl[k] reads are reads of tbl; only a
+                # store of the NAME itself rebinds it
+                if name2 != cur and not name2.startswith(cur + "."):
+                    continue
+                if kind2 == _STORE and name2 == cur:
                     break
                 if kind2 == _STORE:
                     continue
+                alias = ("" if cur == name else
+                         f" (the handle rotated onto {cur!r} without a "
+                         "rebinding fence)")
                 # message stays line-free (Finding.key() is the baseline
                 # identity); the donate site is recoverable from the hint
                 out.append(self.finding(
                     rel, node2.lineno,
                     f"{name!r} was donated to a jitted call earlier in "
-                    "this scope and is read here without rebinding",
+                    f"this scope and is read here without rebinding"
+                    + alias,
                     hint="a donated buffer is dead after the step — "
                          "bind the call's result (`x = step(x, ...)`) "
                          "or stop donating this argument",
                     col=node2.col_offset))
                 break
             if loops:
-                in_loop = [e for e in events
-                           if e[3][:len(loops)] == loops and e[1] == name]
-                if not any(e[0] == _STORE for e in in_loop):
+                def rebinds(e) -> bool:
+                    if e[0] == _STORE and e[1] == name:
+                        return True
+                    # a move landing on the donated name gives it a new
+                    # handle — the rotation's sanctioned rebind
+                    return e[0] == _MOVE and any(
+                        dst == name for dst, _src in e[5])
+
+                in_loop = [e for e in events if e[3][:len(loops)] == loops]
+                if not any(rebinds(e) for e in in_loop):
                     out.append(self.finding(
                         rel, node.lineno,
                         f"{name!r} is donated inside a loop but never "

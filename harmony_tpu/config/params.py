@@ -236,6 +236,22 @@ class TrainerParams(ConfigBase):
     # keep the fused path regardless: the unfused host round-trip would
     # need every process to materialize cross-host shards.
     fused_step: bool = True
+    # Bounded-staleness async aggregation (dolphin/worker.py): overlap
+    # step k's PUSH+PULL with step k+1's COMP by routing the comm phases
+    # through a dedicated comm thread that applies deltas and republishes
+    # the pulled view while the device computes on the previous view.
+    # Default OFF = today's synchronous contract. staleness_bound caps
+    # the applied-update lag a compute step may observe: compute for
+    # step k hard-blocks until at least k - staleness_bound deltas have
+    # been applied. Bound 0 fully serializes and is BIT-identical to the
+    # synchronous unfused path (pinned by tests/test_async_step.py).
+    # Process-wide HARMONY_ASYNC_STEP / HARMONY_STALENESS_BOUND env
+    # knobs override for operator rollback; elastic fences drain the
+    # in-flight window before snapshotting so the (seed, epoch,
+    # step-apply-order) replay contract holds. See
+    # docs/DEVICE_HOT_PATH.md §Async step mode.
+    async_step: bool = False
+    staleness_bound: int = 0
     app_params: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from harmony_tpu import faults
 from harmony_tpu.data import devcache
+from harmony_tpu.data.loader import StageRing
 from harmony_tpu.dolphin.data import TrainingDataProvider
 from harmony_tpu.dolphin.prefetch import PrefetchPipeline, StagedBatch
 from harmony_tpu.dolphin.trainer import Trainer, TrainerContext
@@ -231,6 +232,377 @@ class _UnfusedStep:
         return new_state, metrics
 
 
+class AsyncStepDriver:
+    """Bounded-staleness async aggregation (``TrainerParams.async_step``).
+
+    Wraps the unfused per-phase programs (same traced math, same host
+    round-trip boundaries — see :class:`_UnfusedStep`) but moves the
+    PUSH+PULL comm phases onto a dedicated comm thread so they overlap
+    the NEXT step's COMP on the training thread::
+
+        train thread:  COMP(k) on view v_k -> submit delta_k -> COMP(k+1)
+        comm thread:   PUSH(delta_k) ; PULL -> publish view k+1
+
+    Deltas ride a FIFO :class:`~harmony_tpu.data.loader.StageRing` with
+    a single consumer, so the table's update sequence is a deterministic
+    function of (seed, epoch, step-apply-order) — submission order IS
+    apply order, which is the replay contract elastic recovery depends
+    on. ``staleness_bound`` caps the applied-update lag a compute step
+    may observe: COMP for step k hard-blocks until the published view
+    reflects at least ``k - bound`` applied deltas. Bound 0 fully
+    serializes the pipeline and is BIT-identical to the synchronous
+    per-phase path (identical programs, identical round-trips, identical
+    apply order — pinned by tests/test_async_step.py; the per-phase path
+    is in turn pinned bit-identical to the fused step).
+
+    ``drain()`` is the fence: it blocks until every submitted delta is
+    applied and the post-apply view is published, re-raising any
+    comm-thread failure. The worker drains at every epoch boundary
+    (before metric drains, snapshots, trainer hooks) and before program
+    rebuilds, so elastic fences always observe an empty in-flight
+    window.
+
+    Comm seconds are measured ON the comm thread (they are real wire
+    time, merely overlapped) and exposed via :meth:`mean_phase_seconds`
+    exactly like _UnfusedStep's — the phase budget attributes them to
+    pull_comm/push_comm honestly instead of hiding the overlap;
+    :meth:`staleness_stats` additionally reports the exposed
+    (compute-blocking) wait so ``obs critpath``/the dashboard can show
+    overlapped vs exposed comm time.
+    """
+
+    #: comm-thread join grace on teardown (the prefetch pipeline's bound)
+    JOIN_TIMEOUT = 10.0
+
+    def __init__(self, inner: _UnfusedStep, *, bound: int, model_table,
+                 local_table=None, mesh: Mesh, job_id: str = "",
+                 worker_id: str = "") -> None:
+        if inner._is_hash or inner._keys_push:
+            raise ValueError(
+                "async step mode drives dense pull_mode='all' tables only "
+                "(a keys-mode pull depends on the batch, and the published-"
+                "view pipeline has no batch yet when it pulls)")
+        self._pull_p = inner._pull_p
+        self._comp_p = inner._comp_p
+        self._push_p = inner._push_p
+        self._uses_local = inner._uses_local
+        self._replicated = inner._replicated
+        self._bound = max(0, int(bound))
+        self._table = model_table
+        self._local = local_table
+        self._mesh = mesh
+        self._job_id = job_id
+        self._worker_id = worker_id
+        # Publication state: _version counts deltas REFLECTED in the
+        # published (model, lmodel) view, _applied counts deltas the comm
+        # thread has pushed. One condition guards both plus the error
+        # slot — StageRing.set_error flows producer->consumer, the wrong
+        # direction for comm-thread failures.
+        self._cond = threading.Condition()
+        self._version = -1  # -1 = initial view not yet published
+        self._applied = 0
+        self._submitted = 0
+        self._view: Optional[Tuple[Any, Any]] = None
+        self._err: Optional[BaseException] = None
+        # The in-flight delta window rides the shared staging primitive
+        # (the dolphin/prefetch.py precedent). The staleness gate in
+        # submit() is the real bound; the cap just keeps the ring honest.
+        self._ring = StageRing(cap_fn=lambda: self._bound + 1)
+        self._thread: Optional[threading.Thread] = None
+        # Phase accounting, _UnfusedStep's contract: the compile-bearing
+        # first step is excluded from every accumulator.
+        self.pull_sec = 0.0
+        self.comp_sec = 0.0
+        self.push_sec = 0.0
+        self.steps = 0
+        self.timed_steps = 0
+        self._comm_steps = 0
+        # staleness telemetry (tenant ledger + dashboards)
+        self.max_lag = 0
+        self.exposed_wait_sec = 0.0
+
+    def _roundtrip(self, value):
+        """Host round-trip of one phase boundary (see
+        _UnfusedStep._roundtrip — identical placement so bound 0 stays
+        bit-identical to the per-phase path)."""
+        host = np.asarray(value)
+        return jax.device_put(host, self._replicated)
+
+    def _raise_pending(self) -> None:
+        with self._cond:
+            err = self._err
+        if err is not None:
+            raise RuntimeError(
+                "async step comm thread failed; the in-flight window is "
+                "lost — fail this attempt (elastic recovery replays with "
+                "the same apply schedule)") from err
+
+    def _publish_initial(self) -> None:
+        """View v0: one PULL of the live table — exactly where the
+        synchronous step's first pull happens. Runs on the training
+        thread (before the comm thread starts) through the same
+        apply_step lock every table access takes."""
+        from harmony_tpu.table.table import DenseTable
+
+        if self._uses_local:
+            def init_fn(arr, larr):
+                model, lmodel = hard_sync(self._pull_p(arr, larr))
+                return (arr, larr), (model, lmodel)
+
+            model, lmodel = DenseTable.apply_step_multi(
+                [self._table, self._local], init_fn)
+        else:
+            def init_fn(arr):
+                return arr, hard_sync(self._pull_p(arr))
+
+            model = self._table.apply_step(init_fn)
+            lmodel = None
+        model_d = self._roundtrip(model)
+        with self._cond:
+            self._version = 0
+            self._view = (model_d, lmodel)
+            self._cond.notify_all()
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._publish_initial()
+            self._thread = threading.Thread(
+                target=self._comm_loop,
+                name=f"async-step-{self._job_id}", daemon=True)
+            self._thread.start()
+
+    def submit(self, *operands):
+        """One training step: staleness gate, COMP against the published
+        view, enqueue the delta for the comm thread. Returns the step's
+        metrics dict (device arrays — the epoch drain stacks them)."""
+        self._raise_pending()
+        self._ensure_started()
+        k = self._submitted
+        floor = k - self._bound  # the view must reflect >= this many applies
+        t0 = time.perf_counter()
+        model_d = lmodel = None
+        with self._cond:
+            while self._err is None and self._version < max(floor, 0):
+                self._cond.wait(0.05)
+            if self._err is None:
+                lag = k - self._version
+                if lag > self.max_lag:
+                    self.max_lag = lag
+                model_d, lmodel = self._view
+        wait_t = time.perf_counter() - t0
+        self._raise_pending()
+        if k > 1:
+            # k=1's wait absorbs cycle 0's push/pull compile — excluded
+            # for the same reason _UnfusedStep drops its first call
+            self.exposed_wait_sec += wait_t
+        t0 = time.perf_counter()
+        # standalone dispatch (the probe's pattern): scope wraps the
+        # dispatch, the sync happens outside the lock
+        with dispatch_scope(self._mesh) as fin:
+            if self._uses_local:
+                out = fin(self._comp_p(model_d, lmodel, *operands))
+            else:
+                out = fin(self._comp_p(model_d, *operands))
+        out = hard_sync(out)
+        if self._uses_local:
+            delta, new_l, metrics = out
+        else:
+            (delta, metrics), new_l = out, None
+        c_t = time.perf_counter() - t0
+        if self.steps > 0:
+            self.comp_sec += c_t
+            self.timed_steps += 1
+        self.steps += 1
+        self._submitted = k + 1
+        if not self._ring.put((k, delta, new_l)):
+            self._raise_pending()
+            raise RuntimeError("async step ring closed mid-training")
+        metrics = dict(metrics)
+        if not metrics:
+            # same guarantee as _UnfusedStep's _sync: at least one
+            # step-output-dependent metric. The push lands later on the
+            # comm thread, so the sentinel reads the delta instead of
+            # the pushed array.
+            leaf = jax.tree_util.tree_leaves(delta)[0]
+            metrics = {"_sync": jnp.ravel(leaf)[0]}
+        return metrics
+
+    def _comm_loop(self) -> None:
+        from harmony_tpu.table.table import DenseTable
+
+        try:
+            while True:
+                item = self._ring.get()
+                if item is StageRing.DONE:
+                    return
+                k, delta, new_l = item
+                # The model-pull wire-time fault site rides the COMM
+                # thread here: injected comm latency lands in the
+                # overlapped window — exactly where real wire time
+                # would — which is the async bench's A/B mechanism.
+                if faults.armed():
+                    faults.site("worker.pull", job=self._job_id,
+                                worker=self._worker_id, batch=k, comm=1)
+                timings: Dict[str, float] = {}
+                delta_d = self._roundtrip(delta)
+                if self._uses_local:
+                    def cycle(arr, larr):
+                        t1 = time.perf_counter()
+                        (new_arr, new_larr), sync = hard_sync(
+                            self._push_p(arr, larr, delta_d, new_l))
+                        timings["push"] = time.perf_counter() - t1
+                        t1 = time.perf_counter()
+                        model, lm = hard_sync(
+                            self._pull_p(new_arr, new_larr))
+                        timings["pull"] = time.perf_counter() - t1
+                        return (new_arr, new_larr), (model, lm, sync)
+
+                    model, lmodel, _sync = DenseTable.apply_step_multi(
+                        [self._table, self._local], cycle)
+                else:
+                    def cycle(arr):
+                        t1 = time.perf_counter()
+                        new_arr, sync = hard_sync(
+                            self._push_p(arr, delta_d))
+                        timings["push"] = time.perf_counter() - t1
+                        t1 = time.perf_counter()
+                        model = hard_sync(self._pull_p(new_arr))
+                        timings["pull"] = time.perf_counter() - t1
+                        return new_arr, (model, sync)
+
+                    model, _sync = self._table.apply_step(cycle)
+                    lmodel = None
+                model_d = self._roundtrip(model)
+                with self._cond:
+                    self._applied = k + 1
+                    self._version = k + 1
+                    self._view = (model_d, lmodel)
+                    if k > 0:
+                        # steady-state only: cycle 0 compiles the push
+                        # program inside its timed region
+                        self.push_sec += timings.get("push", 0.0)
+                        self.pull_sec += timings.get("pull", 0.0)
+                        self._comm_steps += 1
+                    self._cond.notify_all()
+        except BaseException as e:  # noqa: BLE001 - re-raised on submit/drain
+            with self._cond:
+                self._err = e
+                self._cond.notify_all()
+            # unblock a producer parked in ring.put (its next put
+            # returns False and submit re-raises the recorded error)
+            self._ring.close()
+
+    def mean_phase_seconds(self) -> Tuple[float, float, float]:
+        """(pull, comp, push) mean seconds per steady-state step. The
+        comm means are REAL wire time measured on the comm thread (they
+        overlap compute — the budget attributes them honestly); comp is
+        the training thread's. Compile-bearing first step excluded."""
+        with self._cond:
+            n_comm = max(self._comm_steps, 1)
+            n_comp = max(self.timed_steps, 1)
+            return (self.pull_sec / n_comm, self.comp_sec / n_comp,
+                    self.push_sec / n_comm)
+
+    def staleness_stats(self) -> Dict[str, Any]:
+        """Ledger feed: bound, observed lag, exposed vs overlapped comm."""
+        with self._cond:
+            return {
+                "bound": self._bound,
+                "max_lag": int(self.max_lag),
+                "exposed_wait_sec": self.exposed_wait_sec,
+                "overlapped_comm_sec": self.pull_sec + self.push_sec,
+                "applied": int(self._applied),
+                "submitted": int(self._submitted),
+            }
+
+    def drain(self) -> None:
+        """The fence: block until every submitted delta is APPLIED and
+        the post-apply view published; re-raise any comm failure."""
+        if self._thread is None:
+            self._raise_pending()
+            return
+        with self._cond:
+            while self._err is None and self._applied < self._submitted:
+                self._cond.wait(0.05)
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain (raising on a comm failure — a rebuild must surface a
+        pending error, not drop it with the old driver), then join."""
+        self.drain()
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        """Best-effort teardown (exception/run-end path): never raises.
+        The happy path drained at the last epoch boundary, so the ring
+        is empty; an exception path is abandoning the attempt anyway."""
+        t = self._thread
+        self._thread = None
+        self._ring.finish()
+        self._ring.close()
+        if t is not None:
+            t.join(self.JOIN_TIMEOUT)
+
+
+def accessor_async_step(table, compute_fn, *, staleness_bound: int = 0,
+                        signature: Optional[Any] = None) -> AsyncStepDriver:
+    """Bounded-staleness driver for ModelAccessor users (the host-driven
+    path outside WorkerTasklet — benchmarks, apps driving a table
+    directly). Builds the dense pull_all/compute/push_all phase programs
+    for ``table`` (progcache-cached when ``signature`` names the
+    compute_fn's traced behavior, the FusedSparseStep contract) and
+    returns an :class:`AsyncStepDriver` whose ``submit(*operands)``
+    overlaps the previous step's PUSH+PULL with this step's compute
+    under ``staleness_bound``. ``compute_fn`` maps
+    ``(model, *operands) -> delta`` or ``(delta, metrics_dict)``;
+    ``drain()``/``close()`` carry the same fence contract as the worker
+    path (docs/DEVICE_HOT_PATH.md §Async step mode)."""
+    from harmony_tpu.table.hashtable import DeviceHashTable
+    from harmony_tpu.table.table import DenseTable
+
+    if isinstance(table, DeviceHashTable):
+        raise TypeError(
+            "async step drives DenseTable workloads; hash-backed tables "
+            "keep the synchronous keyed step")
+    if not isinstance(table, DenseTable):
+        raise TypeError(f"need a DenseTable, got {type(table).__name__}")
+    spec = table.spec
+    mesh = table.mesh
+    tsh = table.sharding
+    replicated = NamedSharding(mesh, P())
+
+    def pull_fn(arr):
+        return _replicated_tree(spec.pull_all(arr), mesh)
+
+    def comp_fn(model, *operands):
+        out = compute_fn(model, *operands)
+        if not (isinstance(out, tuple) and len(out) == 2
+                and isinstance(out[1], dict)):
+            out = (out, {})
+        delta, metrics = out
+        return _replicated_tree(delta, mesh), dict(metrics)
+
+    def push_fn(arr, delta):
+        new_arr = spec.push_all(arr, delta)
+        return new_arr, jnp.ravel(new_arr)[0]
+
+    def cached(tag, build):
+        key = (None if signature is None else
+               (("accessor_async", signature,
+                 progcache.table_signature(table, sharding=tsh)), tag))
+        return progcache.get_or_build(key, build)
+
+    pull_p = cached("pull", lambda: jax.jit(pull_fn))
+    comp_p = cached("comp", lambda: jax.jit(comp_fn))
+    push_p = cached("push", lambda: jax.jit(push_fn, donate_argnums=(0,),
+                                            out_shardings=(tsh, None)))
+    inner = _UnfusedStep(pull_p, comp_p, push_p, is_hash=False,
+                         uses_local=False, keys_push=False,
+                         replicated=replicated)
+    return AsyncStepDriver(inner, bound=staleness_bound, model_table=table,
+                           mesh=mesh)
+
+
 class WorkerTasklet:
     """Drives the training loop for one job over its mesh slice."""
 
@@ -369,6 +741,24 @@ class WorkerTasklet:
         if env_fused is not None:
             fused = env_fused.strip().lower() not in ("0", "false", "off")
         self._fused_on = fused
+        # Bounded-staleness async aggregation (AsyncStepDriver): overlap
+        # step k's PUSH+PULL with step k+1's COMP on a comm thread.
+        # Default OFF preserves today's synchronous contract; the env
+        # knobs are the process-wide operator override, same shape as
+        # HARMONY_FUSED_STEP above. See docs/DEVICE_HOT_PATH.md.
+        async_on = bool(getattr(ctx.params, "async_step", False))
+        env_async = os.environ.get("HARMONY_ASYNC_STEP")
+        if env_async is not None:
+            async_on = env_async.strip().lower() not in ("0", "false", "off")
+        self._async_on = async_on
+        bound = int(getattr(ctx.params, "staleness_bound", 0) or 0)
+        env_bound = os.environ.get("HARMONY_STALENESS_BOUND")
+        if env_bound is not None:
+            try:
+                bound = int(env_bound.strip())
+            except ValueError:
+                pass
+        self._staleness_bound = max(0, bound)
         self._active_pipeline: Optional[PrefetchPipeline] = None
         # (epoch, pipeline) spawned ahead of its epoch (see
         # _spawn_next_pipeline) — consumed by _epoch_batch_stream
@@ -624,7 +1014,8 @@ class WorkerTasklet:
                 # fused and unfused builds trace DIFFERENT programs from
                 # otherwise-identical signatures — the mode is part of the
                 # structural identity
-                "fused" if self._fused_mode() else "unfused")
+                ("async" if self._async_mode() else
+                 "fused" if self._fused_mode() else "unfused"))
 
     def _program_builders(self, tsh, lsh, push_route):
         """The step/epoch jit-wrapper constructors for a GIVEN layout
@@ -915,7 +1306,26 @@ class WorkerTasklet:
         self._program_cache_key = self._program_key(tsh, lsh, self._push_route)
         key = self._program_cache_key
 
-        if not self._fused_mode():
+        if isinstance(getattr(self, "_step", None), AsyncStepDriver):
+            # rebuild fence: drain the in-flight window under the OLD
+            # programs/layout before swapping them out (close re-raises a
+            # pending comm failure rather than dropping it with the old
+            # driver)
+            self._step.close()
+        if self._async_mode():
+            # bounded-staleness async driver over the per-phase programs
+            # (cached under the async-tagged key); the driver carries the
+            # phase timers and the staleness telemetry
+            inner = self._build_unfused(key, tsh, lsh, self._push_route)
+            self._step = AsyncStepDriver(
+                inner, bound=self._staleness_bound,
+                model_table=table,
+                local_table=(self.ctx.local_table
+                             if self.trainer.uses_local_table else None),
+                mesh=table.mesh, job_id=self.job_id,
+                worker_id=self.ctx.worker_id)
+            self._epoch_fn = None
+        elif not self._fused_mode():
             # host-driven per-phase fallback: the phase programs ride the
             # program cache under the same (mode-tagged) key; the wrapper
             # object is rebuilt per build (it carries phase timers)
@@ -1092,11 +1502,37 @@ class WorkerTasklet:
         through host memory), so a multi-process mesh — whose shards no
         single process can materialize — keeps the fused path regardless
         of the knob."""
+        # async mode is host-driven per-phase BY CONSTRUCTION (the comm
+        # thread dispatches push/pull standalone) — it pre-empts the
+        # fused knob, and _async_mode() checks the mesh itself so there
+        # is no recursion through here
+        if self._async_mode():
+            return False
         if self._fused_on:
             return True
         # the TABLE's mesh, not self.mesh: the decision must track the
         # live layout even between a reshard and the post-flip rebuild
         return self._mesh_spans_processes(self.ctx.model_table.mesh)
+
+    def _async_capable(self) -> bool:
+        """Whether the live (table, trainer, layout) combination can run
+        the bounded-staleness async step: dense pull_mode='all' tables
+        on a single-process mesh. Hash/keys-mode steps pull per-batch
+        rows (the published-view pipeline has no batch when it pulls),
+        and a multi-process mesh cannot materialize the host round-trip.
+        Exposed to the tenant ledger so the policy engine knows the
+        `async` lever exists before proposing it."""
+        from harmony_tpu.table.hashtable import DeviceHashTable
+
+        if isinstance(self.ctx.model_table, DeviceHashTable):
+            return False
+        if self.trainer.pull_mode != "all":
+            return False
+        return not self._mesh_spans_processes(self.ctx.model_table.mesh)
+
+    def _async_mode(self) -> bool:
+        """Whether this worker's step runs the async driver NOW."""
+        return self._async_on and self._async_capable()
 
     def _probe_comm(self, batch: Tuple[np.ndarray, ...]) -> None:
         """Time the probe programs on one batch (warmup dispatch first so
@@ -1595,8 +2031,11 @@ class WorkerTasklet:
             # model-pull wire-time site on the step path proper (the
             # probe carries its twin): a "delay" rule makes each step
             # pay the injected comm latency the probe measured, so the
-            # budget's pull_comm attribution matches the wall it splits
-            if faults.armed():
+            # budget's pull_comm attribution matches the wall it splits.
+            # The async driver fires this site on its COMM thread instead
+            # (inside the overlapped window — firing it here too would
+            # double-bill the injected latency onto the compute thread).
+            if faults.armed() and not isinstance(self._step, AsyncStepDriver):
                 faults.site("worker.pull", job=self.job_id,
                             worker=self.ctx.worker_id, batch=batch_idx)
             try:
@@ -1624,6 +2063,12 @@ class WorkerTasklet:
 
         if hyper is None:
             hyper = self._hyper()
+        if isinstance(fn, AsyncStepDriver):
+            # the driver routes its own table-lock dispatches: COMP here
+            # on the training thread (against the published view — no
+            # table lock needed), PUSH+PULL on its comm thread through
+            # apply_step
+            return fn.submit(batch_like, hyper)
         if self.trainer.uses_local_table:
             return DenseTable.apply_step_multi(
                 [self.ctx.model_table, self.ctx.local_table],
@@ -1687,6 +2132,11 @@ class WorkerTasklet:
             # a pre-spawned next-epoch producer must not outlive the run
             # (early stop / exception): join it before reporting back
             self._close_next_pipeline()
+            # async comm thread likewise: on the happy path the last
+            # epoch's fence already drained it, so this is teardown; on
+            # an exception path it is best-effort and never raises
+            if isinstance(getattr(self, "_step", None), AsyncStepDriver):
+                self._step.shutdown()
             remove = getattr(ctx.model_table, "remove_layout_listener", None)
             if remove is not None:
                 remove(self._on_layout_announcement)
@@ -1867,6 +2317,15 @@ class WorkerTasklet:
         pending, batch_sizes, epoch_examples, global_batch_idx, stop, work_t = (
             self._dispatch_epoch_batches(epoch, global_batch_idx)
         )
+        if isinstance(self._step, AsyncStepDriver):
+            # epoch fence: every submitted delta applies (in submission
+            # order) before anything host-side observes the table —
+            # metric drains, snapshots, trainer epoch hooks, elastic
+            # fences. This is what keeps the (seed, epoch,
+            # step-apply-order) replay contract exact under async.
+            t0 = time.perf_counter()
+            self._step.drain()
+            work_t += time.perf_counter() - t0
         dispatch_sec = self._take_dispatch_sec()
         if not stop:
             # next epoch's host assembly runs while the drain below blocks
@@ -2252,6 +2711,22 @@ class WorkerTasklet:
                               self._input_resident_bytes())
             acct.set_resident(self.job_id, self.attempt_key, "program",
                               self._program_resident_bytes())
+            # async lever state: availability tells the policy engine the
+            # lever EXISTS for this tenant; when enabled, the staleness
+            # telemetry shows overlapped vs exposed comm time
+            stats_fn = getattr(self._step, "staleness_stats", None)
+            stats = stats_fn() if stats_fn is not None else None
+            acct.set_async_state(
+                self.job_id, self.attempt_key,
+                available=self._async_capable(),
+                enabled=stats is not None,
+                bound=(stats["bound"] if stats is not None
+                       else self._staleness_bound),
+                max_lag=(stats or {}).get("max_lag", 0),
+                exposed_wait_sec=(stats or {}).get("exposed_wait_sec", 0.0),
+                overlapped_comm_sec=(stats or {}).get(
+                    "overlapped_comm_sec", 0.0),
+            )
         except Exception:
             pass
         # Step-phase time budget (metrics/phases.py): split this epoch's

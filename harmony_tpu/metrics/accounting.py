@@ -85,7 +85,7 @@ class _Tenant:
     __slots__ = ("job", "attempt", "workers", "devices", "samples",
                  "steps_total", "device_sec_total", "examples_total",
                  "flops_per_step", "resident", "bytes", "target_sps",
-                 "slo_events", "first_ts", "last_ts")
+                 "slo_events", "first_ts", "last_ts", "async_state")
 
     def __init__(self, job: str) -> None:
         self.job = job
@@ -104,6 +104,10 @@ class _Tenant:
         self.slo_events = 0
         self.first_ts: Optional[float] = None
         self.last_ts: Optional[float] = None
+        #: bounded-staleness async lever state (set_async_state): None
+        #: until the worker reports; availability is what the policy
+        #: engine keys its `async` proposal on
+        self.async_state: Optional[Dict[str, Any]] = None
 
 
 class LedgerStore:
@@ -178,6 +182,26 @@ class LedgerStore:
     def record_slo_event(self, job: str) -> None:
         with self._lock:
             self._tenant(job).slo_events += 1
+
+    def set_async_state(self, job: str, attempt: str, *, available: bool,
+                        enabled: bool, bound: int = 0, max_lag: int = 0,
+                        exposed_wait_sec: float = 0.0,
+                        overlapped_comm_sec: float = 0.0) -> None:
+        """Bounded-staleness async lever state (dolphin worker, once per
+        epoch drain). ``available`` says the lever EXISTS for this
+        tenant's (table, trainer, layout) — the policy engine proposes
+        `async` only for available-but-disabled comm-bound tenants;
+        the staleness telemetry shows overlapped vs exposed comm time
+        when the mode is on."""
+        with self._lock:
+            self._tenant(job, attempt).async_state = {
+                "available": bool(available),
+                "enabled": bool(enabled),
+                "staleness_bound": int(bound),
+                "max_lag": int(max_lag),
+                "exposed_wait_sec": round(float(exposed_wait_sec), 6),
+                "overlapped_comm_sec": round(float(overlapped_comm_sec), 6),
+            }
 
     def bind_table(self, table_id: str, job: str, attempt: str) -> None:
         """Name ``job`` as the owner of ``table_id`` so table-scoped byte
@@ -293,6 +317,8 @@ class LedgerStore:
                                        if attain is not None else None),
                         "events": t.slo_events,
                     },
+                    "async": (dict(t.async_state)
+                              if t.async_state is not None else None),
                 }
         total_resident = sum(r["resident_bytes"] for r in rows.values())
         for r in rows.values():
@@ -400,6 +426,21 @@ def _install_callbacks(store: LedgerStore) -> None:
                              "kind": kind}, float(n)))
         return out
 
+    def async_of(sub):
+        # not gauge_of: the "async" row is None until the worker
+        # reports, and the staleness series only mean anything with the
+        # mode actually ON — absent otherwise, never 0
+        def sample():
+            out = []
+            for r in rows().values():
+                a = r.get("async")
+                if not a or not a.get("enabled"):
+                    continue
+                out.append(({"job": r["job"], "attempt": r["attempt"]},
+                            float(a[sub])))
+            return out
+        return sample
+
     try:
         reg.register_callback(
             "harmony_tenant_mfu",
@@ -433,5 +474,15 @@ def _install_callbacks(store: LedgerStore) -> None:
             "Cumulative state-movement bytes per tenant (kind: move / "
             "chkp_write / chkp_read)",
             "counter", bytes_samples)
+        reg.register_callback(
+            "harmony_tenant_staleness_lag",
+            "Max applied-update lag observed by the tenant's async step "
+            "(absent unless bounded-staleness async mode is on)",
+            "gauge", async_of("max_lag"))
+        reg.register_callback(
+            "harmony_tenant_async_exposed_seconds",
+            "Comm seconds the async step could NOT hide: staleness-gate "
+            "wait blocking compute (absent unless async mode is on)",
+            "gauge", async_of("exposed_wait_sec"))
     except Exception:
         pass  # already registered by an earlier store in this process

@@ -344,22 +344,61 @@ def _phase_median(ctx: "DoctorContext", series: str,
     return None
 
 
+def _steady_points(ctx: "DoctorContext", series: str, labels: Dict[str, str],
+                   pts: List[Tuple[float, float]]
+                   ) -> List[Tuple[float, float]]:
+    """Windowed points of one phase series MINUS the one-time
+    compile-bearing first sample: a tenant's first epoch pays the step's
+    XLA compile inside its pull/push wall (the _UnfusedStep timers
+    established the exclusion on the worker side), so a series whose
+    first-EVER sample still sits inside the window would let capex
+    masquerade as sustained traffic. Only that first-ever point is
+    dropped — a long-lived tenant whose birth sample already aged out of
+    the retained history (or out of the window) is untouched. The
+    critpath CLASSIFIER keeps ingesting the raw sample: classification
+    labels one window honestly; this rule issues a verdict."""
+    job = labels.get("job")
+    want = {"job": job} if job else None
+    for _l, full in ctx.store.range(series, labels=want, since=0.0):
+        if full and pts and full[0][0] == pts[0][0]:
+            return pts[1:]
+        break
+    return pts
+
+
+def _steady_phase_median(ctx: "DoctorContext", series: str,
+                         job: Optional[str]) -> Optional[float]:
+    """:func:`_phase_median` over the compile-excluded steady points
+    (see _steady_points); the MIN_POINTS floor applies AFTER the
+    exclusion — one steady sample is still not a sustained verdict."""
+    want = {"job": job} if job else None
+    for labels, pts in ctx.store.range(series, labels=want,
+                                       since=ctx.since):
+        vals = [v for _, v in _steady_points(ctx, series, labels, pts)]
+        if len(vals) >= MIN_POINTS:
+            return _median(vals)
+    return None
+
+
 @doctor_rule("comm_bound",
              "tenant's windowed pull_comm + push_comm wall fraction "
              f"sustained at or above {_CP.COMM_BOUND_FRAC} (the "
              "step-phase budget, metrics/phases.py) — model traffic, "
              "not math, owns the step; packing this tenant tighter "
-             "makes it worse")
+             "makes it worse. The one-time compile-bearing first sample "
+             "is excluded from the fractions (the _UnfusedStep pattern)")
 def _comm_bound(ctx: DoctorContext) -> List[Diagnosis]:
     out: List[Diagnosis] = []
-    for labels, pts in ctx.store.range("tenant.phase.pull_comm",
+    for labels, raw in ctx.store.range("tenant.phase.pull_comm",
                                        since=ctx.since):
+        pts = _steady_points(ctx, "tenant.phase.pull_comm", labels, raw)
         vals = [v for _, v in pts]
         if len(vals) < MIN_POINTS:
             continue
         job = labels.get("job")
         pull_med = _median(vals)
-        push_med = _phase_median(ctx, "tenant.phase.push_comm", job) or 0.0
+        push_med = _steady_phase_median(
+            ctx, "tenant.phase.push_comm", job) or 0.0
         med = pull_med + push_med
         if med < _CP.COMM_BOUND_FRAC:
             continue
@@ -463,11 +502,11 @@ def _policy_judge_age() -> float:
 
 
 @doctor_rule("rebalance_ineffective",
-             "an executed GROW policy action (kind=\"policy\" joblog "
-             "event, jobserver/policy.py) whose target tenant shows no "
-             "MFU or SLO-attainment improvement within two policy "
-             "windows of the fence — the engine backs the tenant off on "
-             "this diagnosis instead of churning it (shrink/pack/"
+             "an executed GROW or ASYNC policy action (kind=\"policy\" "
+             "joblog event, jobserver/policy.py) whose target tenant "
+             "shows no MFU or SLO-attainment improvement within two "
+             "policy windows of the fence — the engine backs the tenant "
+             "off on this diagnosis instead of churning it (shrink/pack/"
              "preempt victims degrade BY DESIGN and are never judged)")
 def _rebalance_ineffective(ctx: DoctorContext) -> List[Diagnosis]:
     judge_age = _policy_judge_age()
@@ -475,10 +514,13 @@ def _rebalance_ineffective(ctx: DoctorContext) -> List[Diagnosis]:
     for job, events in ctx.events.items():
         # only actions meant to HELP their target are judged by the
         # target's own series — a shrink/pack/preempt victim's numbers
-        # drop on purpose (the claimant got the capacity)
+        # drop on purpose (the claimant got the capacity). `async` is
+        # judged exactly like grow: it promised the TARGET a speedup
+        # (overlapped comm), so flat series after the fence mean the
+        # lever did not pay and the engine should back off.
         acts = [e for e in events
                 if e.get("kind") == "policy" and e.get("executed")
-                and e.get("action") == "grow"]
+                and e.get("action") in ("grow", "async")]
         if not acts:
             continue
         ev = acts[-1]

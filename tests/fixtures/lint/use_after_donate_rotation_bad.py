@@ -1,0 +1,18 @@
+"""KNOWN-BAD fixture: ping/pong rotation leaking the dead handle.
+
+The rotation itself is sanctioned (pure-name tuple assignment moves
+handles, it never touches device memory) — but the alias now holding
+the DONATED buffer is read inside the overlap window without a
+rebinding fence. The use-after-donate pass must flag the read (and
+only the read: the rotation lines must stay clean)."""
+import jax
+
+push_step = jax.jit(lambda ping, delta: ping + delta, donate_argnums=(0,))
+
+
+def overlap_window_leak(ping, pong, deltas):
+    for delta in deltas:
+        pong = push_step(ping, delta)
+        ping, pong = pong, ping  # rotate: dead handle now rides `pong`
+        norm = pong.sum()  # BAD: reads the donated buffer, no fence
+    return ping, norm

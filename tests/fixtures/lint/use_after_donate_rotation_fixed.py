@@ -1,0 +1,30 @@
+"""FIXED fixture: ping/pong double-buffer rotation, fenced.
+
+The alternating donated names rebind on the NEXT iteration via the
+rotation (`ping, pong = pong, ping` after `pong = push_step(ping, d)`),
+which the use-after-donate pass must NOT false-positive on — the tuple
+assignment moves handles, it reads nothing from the device. Where the
+dead alias IS needed inside the overlap window, a staleness fence
+republishes it first (`pong = fence(pong)` rebinds before the read).
+The pass must come up clean on both shapes."""
+import jax
+
+push_step = jax.jit(lambda ping, delta: ping + delta, donate_argnums=(0,))
+fence = jax.jit(lambda view: view * 1.0)
+
+
+def rotate_only(ping, pong, deltas):
+    for delta in deltas:
+        pong = push_step(ping, delta)
+        ping, pong = pong, ping  # dead handle parks on `pong`, unread
+    return ping
+
+
+def rotate_with_fence(ping, pong, deltas):
+    norm = None
+    for delta in deltas:
+        pong = push_step(ping, delta)
+        ping, pong = pong, ping
+        pong = fence(ping)  # staleness fence: rebind before the read
+        norm = pong.sum()
+    return ping, norm

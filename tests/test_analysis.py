@@ -105,6 +105,22 @@ class TestPassFixtures:
         r = _lint_file("use_after_donate_fixed.py", "use-after-donate")
         assert r.ok, render_text(r)
 
+    def test_use_after_donate_tracks_rotation_alias(self):
+        r = _lint_file("use_after_donate_rotation_bad.py", "use-after-donate")
+        assert len(r.findings) == 1, render_text(r)
+        f = r.findings[0]
+        assert "rotated onto 'pong'" in f.message, f.message
+        assert "without a rebinding fence" in f.message, f.message
+        # The rotation line itself must stay clean — only the read flags.
+        assert "norm = pong.sum()" in open(
+            os.path.join(FIXTURES, "use_after_donate_rotation_bad.py")
+        ).read().splitlines()[f.line - 1]
+
+    def test_use_after_donate_accepts_rotation_and_fence(self):
+        r = _lint_file("use_after_donate_rotation_fixed.py",
+                       "use-after-donate")
+        assert r.ok, render_text(r)
+
     def test_span_hygiene_catches_positional_opens(self):
         r = _lint_file("span_hygiene_bad.py", "span-hygiene")
         assert len(r.findings) == 2, render_text(r)

@@ -1,0 +1,526 @@
+"""Bounded-staleness async aggregation (dolphin/worker.AsyncStepDriver).
+
+The contract under test (docs/DEVICE_HOT_PATH.md §Async step mode):
+
+  * staleness 0 is BIT-identical to the synchronous path (same phase
+    programs, same host round-trip boundaries, same apply order) — the
+    per-epoch losses match the fused step exactly, pinned here for MLR
+    and NMF just like the fused/unfused parity tests;
+  * the bound is ENFORCED: under an injected comm stall the observed
+    applied-update lag never exceeds ``staleness_bound``;
+  * ``drain()`` is the fence: every submitted delta applies (in
+    submission order) before anything host-side observes the table —
+    which is what keeps live re-sharding (shrink -> re-grow) exactly-
+    once with async ON;
+  * the policy engine owns the lever: a comm-bound under-SLO tenant
+    whose worker reported the lever available gets ONE gated ``async``
+    action (signal ``comm_wait``), and an executed async action is
+    judged by ``rebalance_ineffective`` exactly like a grow.
+
+Plus the doctor regression the lever depends on: ``comm_bound`` must
+not fire off the compile-bearing first phase sample.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TrainerParams
+from harmony_tpu.dolphin import (
+    TrainerContext,
+    TrainingDataProvider,
+    WorkerTasklet,
+)
+from harmony_tpu.dolphin.worker import AsyncStepDriver
+from harmony_tpu.jobserver import joblog
+from harmony_tpu.table import DenseTable, TableSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    joblog.clear_events()
+    yield
+    joblog.clear_events()
+
+
+def _run_worker(trainer, arrays, mesh, *, fused=False, async_on=False,
+                bound=0, epochs=3, batches=4):
+    spec = TableSpec(trainer.model_table_config())
+    table = DenseTable(spec, mesh)
+    ltable = (DenseTable(TableSpec(trainer.local_table_config()), mesh)
+              if trainer.uses_local_table else None)
+    params = TrainerParams(num_epochs=epochs, num_mini_batches=batches,
+                           fused_step=fused, async_step=async_on,
+                           staleness_bound=bound)
+    ctx = TrainerContext(params=params, model_table=table,
+                         local_table=ltable)
+    data = TrainingDataProvider(arrays, batches)
+    w = WorkerTasklet(f"j-async-{async_on}-{bound}", ctx, trainer, data,
+                      mesh)
+    result = w.run()
+    return result, table, w
+
+
+# ---------------------------------------------------------------------------
+# staleness 0: bit-identical to the synchronous (fused) path
+# ---------------------------------------------------------------------------
+
+
+def test_mlr_bound0_bit_identical_to_fused(mesh8):
+    from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+
+    def mk():
+        return (MLRTrainer(num_classes=4, num_features=16,
+                           features_per_partition=8),
+                make_synthetic(64, 16, 4, seed=1))
+
+    t, a = mk()
+    r1, tb1, _ = _run_worker(t, a, mesh8, fused=True)
+    t, a = mk()
+    r0, tb0, w = _run_worker(t, a, mesh8, async_on=True, bound=0)
+    assert isinstance(w._step, AsyncStepDriver)
+    assert r1["losses"] == r0["losses"]  # bit-identical
+    np.testing.assert_allclose(np.asarray(tb1.pull_array()),
+                               np.asarray(tb0.pull_array()), atol=1e-6)
+    st = w._step.staleness_stats()
+    assert st["max_lag"] == 0 and st["applied"] == st["submitted"]
+
+
+def test_nmf_bound0_bit_identical_to_fused(mesh8):
+    from harmony_tpu.apps.nmf import NMFTrainer, make_synthetic
+
+    def mk():
+        return (NMFTrainer(num_rows=32, num_cols=24, rank=4, seed=2),
+                make_synthetic(32, 24, 4, seed=2))
+
+    t, a = mk()
+    r1, tb1, _ = _run_worker(t, a, mesh8, fused=True)
+    t, a = mk()
+    r0, tb0, w = _run_worker(t, a, mesh8, async_on=True, bound=0)
+    assert isinstance(w._step, AsyncStepDriver)
+    assert r1["losses"] == r0["losses"]
+    np.testing.assert_allclose(np.asarray(tb1.pull_array()),
+                               np.asarray(tb0.pull_array()), atol=1e-6)
+
+
+def test_env_overrides_turn_the_knob(mesh8, monkeypatch):
+    """HARMONY_ASYNC_STEP / HARMONY_STALENESS_BOUND override the params
+    (the HARMONY_FUSED_STEP shape: process-wide operator knob)."""
+    from harmony_tpu.apps.mlr import MLRTrainer, make_synthetic
+
+    monkeypatch.setenv("HARMONY_ASYNC_STEP", "1")
+    monkeypatch.setenv("HARMONY_STALENESS_BOUND", "3")
+    t = MLRTrainer(num_classes=4, num_features=16, features_per_partition=8)
+    a = make_synthetic(64, 16, 4, seed=1)
+    # params say OFF — the env wins
+    r, _, w = _run_worker(t, a, mesh8, async_on=False, bound=0, epochs=1)
+    assert w._async_on and w._staleness_bound == 3
+    assert isinstance(w._step, AsyncStepDriver)
+    assert w._step.staleness_stats()["bound"] == 3
+    # and the off-override wins the other way
+    monkeypatch.setenv("HARMONY_ASYNC_STEP", "off")
+    _, _, w2 = _run_worker(t, a, mesh8, async_on=True, bound=2, epochs=1)
+    assert not w2._async_on
+    assert not isinstance(w2._step, AsyncStepDriver)
+
+
+# ---------------------------------------------------------------------------
+# the bound is enforced; drain is the fence
+# ---------------------------------------------------------------------------
+
+
+def _marks_table_and_driver(mesh, bound):
+    """A ModelAccessor.async_step driver over an add-valued table whose
+    deltas are model-independent — staleness cannot change the sum, so
+    the fence assertions are exact."""
+    import jax.numpy as jnp
+
+    from harmony_tpu.config.params import TableConfig
+    from harmony_tpu.dolphin import ModelAccessor
+
+    table = DenseTable(
+        TableSpec(TableConfig(table_id="async-fence", capacity=8,
+                              value_shape=(4,), num_blocks=8,
+                              update_fn="add")), mesh)
+
+    def compute(model, amount):
+        return jnp.ones_like(model) * amount, {"amount": amount}
+
+    acc = ModelAccessor(table)
+    return table, acc.async_step(compute, staleness_bound=bound,
+                                 signature=("async-fence-test",))
+
+
+def test_bound_enforced_under_comm_stall(mesh8):
+    """A stalled comm thread (injected worker.pull delay) must never let
+    compute run ahead more than ``staleness_bound`` applied deltas."""
+    import jax.numpy as jnp
+
+    from harmony_tpu import faults
+    from harmony_tpu.faults.plan import FaultPlan, FaultRule
+
+    table, drv = _marks_table_and_driver(mesh8, bound=2)
+    faults.arm(FaultPlan([FaultRule("worker.pull", action="delay",
+                                    delay_sec=0.05, count=-1)]))
+    try:
+        for _ in range(8):
+            drv.submit(jnp.float32(1.0))
+        drv.drain()
+    finally:
+        faults.disarm()
+        drv.shutdown()
+    st = drv.staleness_stats()
+    assert st["max_lag"] <= 2, st
+    # compute IS ahead of the stalled comm thread (the overlap window
+    # was exercised, not trivially empty)
+    assert st["max_lag"] >= 1, st
+    assert st["applied"] == st["submitted"] == 8
+    np.testing.assert_allclose(np.asarray(table.pull_array()),
+                               np.full((8, 4), 8.0), atol=0)
+
+
+def test_bound0_fully_serializes(mesh8):
+    table, drv = _marks_table_and_driver(mesh8, bound=0)
+    import jax.numpy as jnp
+
+    for _ in range(4):
+        drv.submit(jnp.float32(2.0))
+    drv.drain()
+    drv.shutdown()
+    st = drv.staleness_stats()
+    assert st["max_lag"] == 0
+    assert st["applied"] == st["submitted"] == 4
+    np.testing.assert_allclose(np.asarray(table.pull_array()),
+                               np.full((8, 4), 8.0), atol=0)
+
+
+def test_drain_is_reentrant_and_empty_window_safe(mesh8):
+    _, drv = _marks_table_and_driver(mesh8, bound=3)
+    drv.drain()  # nothing submitted, nothing started: a no-op fence
+    import jax.numpy as jnp
+
+    drv.submit(jnp.float32(1.0))
+    drv.drain()
+    drv.drain()
+    st = drv.staleness_stats()
+    assert st["applied"] == st["submitted"] == 1
+    drv.shutdown()
+
+
+def test_hash_table_rejected(mesh8):
+    from harmony_tpu.config.params import TableConfig
+    from harmony_tpu.dolphin import ModelAccessor
+    from harmony_tpu.table import DeviceHashTable, HashTableSpec
+
+    ht = DeviceHashTable(
+        HashTableSpec(TableConfig(table_id="async-hash", capacity=32,
+                                  value_shape=(4,), num_blocks=8,
+                                  sparse=True)), mesh8)
+    with pytest.raises(TypeError, match="DenseTable"):
+        ModelAccessor(ht).async_step(lambda m, x: m * 0)
+
+
+# ---------------------------------------------------------------------------
+# elastic chaos: shrink -> re-grow with async ON stays exactly-once
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_shrink_regrow_chaos_async_on(devices):
+    """Live re-sharding mid-training with the async window open: grow at
+    epoch 1, shrink back at epoch 3 (test_migration's schedule). The
+    epoch fence drains the in-flight window before each plan executes,
+    so the AddVector sum stays EXACT — no push lost or double-applied —
+    and matches a synchronous run of the same schedule."""
+    from harmony_tpu.apps.addvector import AddVectorTrainer, make_marks
+    from harmony_tpu.parallel import DevicePool
+    from harmony_tpu.plan import (
+        AllocateOp,
+        AssociateOp,
+        DeallocateOp,
+        ETPlan,
+        MoveOp,
+        PlanExecutor,
+        UnassociateOp,
+    )
+    from harmony_tpu.runtime import ETMaster
+
+    def run(async_on):
+        pool = DevicePool(devices[:4])
+        master = ETMaster(pool)
+        exs = master.add_executors(2)
+        trainer = AddVectorTrainer(num_keys=16, vector_dim=2, delta=1.0)
+        handle = master.create_table(trainer.model_table_config(),
+                                     [e.id for e in exs])
+        n, epochs, nb = 128, 6, 4
+        params = TrainerParams(num_epochs=epochs, num_mini_batches=nb,
+                               fused_step=False, async_step=async_on,
+                               staleness_bound=2)
+        ctx = TrainerContext(params=params, model_table=handle.table)
+        plan_errors = []
+
+        def on_epoch(epoch):
+            plan = None
+            if epoch == 1:
+                plan = ETPlan()
+                alloc = plan.add_op(AllocateOp("v"))
+                assoc = plan.add_op(AssociateOp(handle.table_id, "v"),
+                                    depends_on=[alloc])
+                plan.add_op(MoveOp(handle.table_id, exs[0].id, "v", 3),
+                            depends_on=[assoc])
+            elif epoch == 3:
+                new_id = next(e for e in handle.block_manager.executors
+                              if e not in {x.id for x in exs})
+                n_new = handle.block_manager.block_counts()[new_id]
+                plan = ETPlan()
+                mv = plan.add_op(MoveOp(handle.table_id, new_id,
+                                        exs[1].id, n_new))
+                un = plan.add_op(UnassociateOp(handle.table_id, new_id),
+                                 depends_on=[mv])
+                plan.add_op(DeallocateOp(new_id), depends_on=[un])
+            if plan is not None:
+                r = PlanExecutor(master).execute(plan)
+                if not r.success:
+                    plan_errors.append(r.error)
+
+        worker = WorkerTasklet(
+            f"chaos-async-{async_on}", ctx, trainer,
+            TrainingDataProvider(list(make_marks(n)), nb),
+            handle.table.mesh, epoch_callback=on_epoch)
+        result = worker.run()
+        assert not plan_errors, plan_errors
+        if async_on:
+            assert isinstance(worker._step, AsyncStepDriver)
+        expected = trainer.expected_value(n * epochs)
+        state = np.asarray(handle.table.pull_array())
+        np.testing.assert_allclose(state, np.full_like(state, expected),
+                                   atol=1e-4)
+        assert len(handle.owning_executors()) == 2  # shrunk back
+        return result, state
+
+    r_async, s_async = run(True)
+    r_sync, s_sync = run(False)
+    # same schedule, same exactly-once sums: async changed nothing the
+    # replay contract can observe
+    np.testing.assert_array_equal(s_async, s_sync)
+
+
+# ---------------------------------------------------------------------------
+# policy: the async lever
+# ---------------------------------------------------------------------------
+
+
+class _AsyncFakeScheduler:
+    def __init__(self, idle=()):
+        self.idle = list(idle)
+        self.grants = {}
+        self.async_pins = {}
+
+    def idle_executors(self):
+        return list(self.idle)
+
+    def queued_jobs(self):
+        return []
+
+    def plan_grant(self, job_id, executors, shared=False):
+        if executors is None:
+            self.grants.pop(job_id, None)
+        else:
+            self.grants[job_id] = (list(executors), bool(shared))
+
+    def plan_async(self, job_id, enabled=True):
+        self.async_pins[job_id] = bool(enabled)
+
+
+def _policy_engine(rows, tenants, sched, fences, gate=None):
+    from harmony_tpu.jobserver.policy import ActionGate, PolicyEngine
+
+    def fence(job, kind):
+        fences.append((job, kind))
+        return 7
+
+    return PolicyEngine(
+        scheduler=sched,
+        ledger_fn=lambda: rows,
+        tenants_fn=lambda: tenants,
+        fence_fn=fence,
+        diagnoses_fn=lambda: [],
+        gate=gate or ActionGate(cooldown_sec=0.0, confirm=1,
+                                stale_after=999.0),
+    )
+
+
+class TestPolicyAsyncLever:
+    def _rows(self, available=True, enabled=False):
+        return {"a": {"slo": {"attainment": 0.3},
+                      "phase_class": "comm-bound",
+                      "async": {"available": available,
+                                "enabled": enabled,
+                                "staleness_bound": 0}}}
+
+    def test_comm_bound_proposes_async_not_grow(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_POLICY", "act")
+        sched = _AsyncFakeScheduler(idle=["e1"])
+        fences = []
+        eng = _policy_engine(self._rows(),
+                             {"a": {"executors": ["e0"], "attempt": 0,
+                                    "priority": 0}},
+                             sched, fences)
+        plan = eng.evaluate()
+        (a,) = plan["actions"]
+        assert a["kind"] == "async" and a["outcome"] == "fenced"
+        assert a["signal"] == "comm_wait"
+        assert a["evidence"]["async"]["available"]
+        # same executor set, re-grow fence, knob pinned for the relaunch
+        assert fences == [("a", "regrow")]
+        assert sched.async_pins == {"a": True}
+        assert sched.grants["a"] == (["e0"], False)
+        evs = [e for e in joblog.job_events("a") if e["kind"] == "policy"]
+        assert evs and evs[-1]["action"] == "async" and evs[-1]["executed"]
+
+    def test_fires_once_through_the_gate(self, monkeypatch):
+        from harmony_tpu.jobserver.policy import ActionGate
+
+        monkeypatch.setenv("HARMONY_POLICY", "act")
+        sched = _AsyncFakeScheduler()
+        fences = []
+        gate = ActionGate(cooldown_sec=30.0, confirm=2, stale_after=999.0)
+        eng = _policy_engine(self._rows(),
+                             {"a": {"executors": ["e0"], "attempt": 0,
+                                    "priority": 0}},
+                             sched, fences, gate=gate)
+        # hysteresis: the lever rides the SAME gate discipline as grow
+        plan = eng.evaluate()
+        assert [x["outcome"] for x in plan["actions"]] == ["hysteresis"]
+        assert not fences and sched.async_pins == {}
+        plan = eng.evaluate()
+        assert [x["outcome"] for x in plan["actions"]] == ["fenced"]
+        # the fenced attempt is in flight: no re-proposal while it lands
+        plan = eng.evaluate()
+        assert plan["actions"] == []
+        assert fences == [("a", "regrow")]
+        assert sched.async_pins == {"a": True}
+
+    def test_no_action_when_lever_absent_or_already_on(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_POLICY", "act")
+        for rows in (
+            {"a": {"slo": {"attainment": 0.3},
+                   "phase_class": "comm-bound"}},  # worker never reported
+            self._rows(available=False),
+            self._rows(enabled=True),
+        ):
+            sched = _AsyncFakeScheduler(idle=["e1"])
+            fences = []
+            eng = _policy_engine(rows,
+                                 {"a": {"executors": ["e0"], "attempt": 0,
+                                        "priority": 0}},
+                                 sched, fences)
+            plan = eng.evaluate()
+            assert plan["actions"] == [] and not fences, rows
+            assert sched.async_pins == {}
+
+    def test_rebalance_ineffective_judges_async(self, monkeypatch):
+        """An EXECUTED async action that moved nothing is judged exactly
+        like a grow (same rule, same backoff path)."""
+        from harmony_tpu.metrics.doctor import Doctor
+        from harmony_tpu.metrics.history import HistoryStore
+
+        monkeypatch.setenv("HARMONY_POLICY_PERIOD", "1")
+        store = HistoryStore(window_sec=60.0, resolution_sec=1.0)
+        now = time.time()
+        act_ts = now - 10.0
+        labels = {"job": "t1", "attempt": "t1"}
+        for i, v in enumerate([0.5, 0.5, 0.5]):
+            store.ingest("tenant.slo_attainment", labels, v,
+                         ts=act_ts - 6 + i)
+        for i, v in enumerate([0.5, 0.5, 0.5]):
+            store.ingest("tenant.slo_attainment", labels, v,
+                         ts=act_ts + 2 + i * 2)
+        events = {"t1": [{"kind": "policy", "executed": True,
+                          "ts": act_ts, "action": "async",
+                          "outcome": "fenced"}]}
+        doc = Doctor(store, events_fn=lambda: events)
+        out = [d for d in doc.diagnose(now=now)
+               if d.rule == "rebalance_ineffective"]
+        assert len(out) == 1
+        assert out[0].evidence["policy_event"]["action"] == "async"
+
+
+# ---------------------------------------------------------------------------
+# scheduler SPI: the pinned knob is a one-shot
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_plan_async_is_one_shot():
+    from harmony_tpu.jobserver.scheduler import JobScheduler
+
+    s = JobScheduler()
+    assert s.planned_async("j") is None
+    s.plan_async("j", True)
+    assert s.planned_async("j") is True
+    assert s.planned_async("j") is None  # consumed
+
+
+# ---------------------------------------------------------------------------
+# ledger: the async row feeds policy and dashboards
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_async_state_row():
+    from harmony_tpu.metrics.accounting import LedgerStore
+
+    led = LedgerStore()
+    led.observe_steps("j1", "j1:0", "w0", steps=4, device_sec=0.1,
+                      examples=10)
+    snap = led.snapshot()
+    assert snap["j1"]["async"] is None  # never reported
+    led.set_async_state("j1", "j1:0", available=True, enabled=True,
+                        bound=2, max_lag=1, exposed_wait_sec=0.25,
+                        overlapped_comm_sec=1.5)
+    row = led.snapshot()["j1"]["async"]
+    assert row == {"available": True, "enabled": True,
+                   "staleness_bound": 2, "max_lag": 1,
+                   "exposed_wait_sec": 0.25, "overlapped_comm_sec": 1.5}
+
+
+# ---------------------------------------------------------------------------
+# doctor: comm_bound ignores the compile-bearing first sample
+# ---------------------------------------------------------------------------
+
+
+def _feed(store, name, job, values, now=None, spacing=5.0):
+    now = time.time() if now is None else now
+    t0 = now - spacing * len(values)
+    for i, v in enumerate(values):
+        store.ingest(name, {"job": job, "attempt": job}, v,
+                     ts=t0 + i * spacing)
+
+
+class TestDoctorCommBoundSteadyState:
+    def test_compile_bearing_first_sample_excluded(self):
+        """One compile-inflated pull sample followed by a healthy one
+        must NOT diagnose comm-bound (the pre-fix median of [0.85, 0.1]
+        is 0.475 — a false positive that would make the policy engine
+        flip tenants to async off one cold sample)."""
+        from harmony_tpu.metrics.doctor import Doctor
+        from harmony_tpu.metrics.history import HistoryStore
+
+        store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+        _feed(store, "tenant.phase.pull_comm", "cold-j", [0.85, 0.1])
+        _feed(store, "tenant.phase.push_comm", "cold-j", [0.1, 0.05])
+        doc = Doctor(store, events_fn=dict)
+        assert not [d for d in doc.diagnose()
+                    if d.rule == "comm_bound"]
+
+    def test_steady_comm_bound_still_fires(self):
+        """The exclusion must not kill the rule: a tenant whose steady
+        samples are ALSO comm-heavy still diagnoses."""
+        from harmony_tpu.metrics.doctor import Doctor
+        from harmony_tpu.metrics.history import HistoryStore
+
+        store = HistoryStore(window_sec=900.0, resolution_sec=1.0)
+        _feed(store, "tenant.phase.pull_comm", "hot-j", [0.7, 0.5, 0.5])
+        _feed(store, "tenant.phase.push_comm", "hot-j", [0.1, 0.1, 0.1])
+        doc = Doctor(store, events_fn=dict)
+        comm = [d for d in doc.diagnose() if d.rule == "comm_bound"]
+        assert len(comm) == 1 and comm[0].job == "hot-j"
