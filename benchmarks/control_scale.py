@@ -1,0 +1,525 @@
+#!/usr/bin/env python
+"""Control-plane churn benchmark (PR 17): a synthetic-tenant fleet
+against the REAL jobserver.
+
+Each arm boots a real :class:`JobServer` (real scheduler, real
+dispatch, real telemetry loops) behind its TCP command plane and
+throws a tenant fleet at it: a submit storm (every tenant submits one
+tiny-but-real MLR job through :class:`CommandSender`), a crowd of
+STATUS pollers (the dashboard herd), slow-loris connections trickling
+partial commands, and dead scrape targets wired into
+``HARMONY_OBS_SCRAPE_TARGETS``. The grid is tenants x overload-mode:
+
+- ``overload_on``  — admission control + the degradation ladder
+  (jobserver/overload.py) as deployed;
+- ``overload_off`` — ``HARMONY_OVERLOAD=0``: same bounded worker
+  pool, but no early admission, no ladder, full-fidelity telemetry.
+
+Per cell: submit-to-ack and submit-to-dispatch p50/p99, survival
+(tenants whose submission landed inside a bounded per-client retry
+budget — the herd member's patience), scrape/diagnose/plan cycle
+latency, and the overload monitor's own evidence (ladder transitions,
+shed counters). A ``chaos`` act kills the leader mid-storm (HA pair)
+and proves every acknowledged submission resolves exactly once on the
+successor — acked-then-lost is the one outcome this PR makes
+structurally impossible.
+
+Prints ONE JSON document; the committed capture is
+``benchmarks/CONTROL_SCALE_r<N>.json``. Pure CPU (tiny MLR jobs on
+virtual devices) — comparable across rounds regardless of
+accelerator health.
+
+Usage: python benchmarks/control_scale.py [--tenants 32,256,1024]
+       [--fleet 192] [--no-chaos]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import socket
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# -- harness knobs (recorded in the output's config block) ---------------
+
+ARM_ENV = {
+    # the production-shaped command plane, with a short deadline so
+    # slow-loris eviction churn is visible inside the storm window
+    "HARMONY_CMD_WORKERS": "8",
+    "HARMONY_CMD_QUEUE": "64",
+    "HARMONY_CMD_DEADLINE_MS": "2000",
+    # fast telemetry cadence so cycle overruns surface within the storm
+    "HARMONY_OBS_SCRAPE_PERIOD": "0.25",
+    "HARMONY_OVERLOAD_SUBSET": "8",
+    # the storm legitimately queues every tenant's job: the fill/ladder
+    # mechanics are under test here, not the production inflight cap
+    "HARMONY_OVERLOAD_INFLIGHT": "4096",
+    # the fleet's bounded patience: ~15s of jittered wall-clock budget
+    # (attempt COUNT must not penalize the arm whose hints pace wider)
+    "HARMONY_RETRY_BASE_DELAY": "0.05",
+    "HARMONY_RETRY_MAX_ATTEMPTS": "15",
+}
+DEAD_SCRAPE_TARGETS = 4
+LORIS_CONNS = 4          # half the worker pool pinned is pressure;
+                         # all of it pinned is a different benchmark
+STATUS_POLLERS = 8       # a few dashboards, not a second storm: the
+                         # submit herd is the pressure source under test
+POLL_PERIOD_S = 0.5      # the dashboard herd's per-client cadence
+CLIENT_TIMEOUT_S = 6.0
+DISPATCH_DRAIN_S = 60.0
+
+
+def _tiny_job(job_id: str):
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=1, num_mini_batches=1,
+            app_params={"num_classes": 2, "num_features": 4,
+                        "features_per_partition": 2, "step_size": 0.5}),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 16, "num_features": 4,
+                            "num_classes": 2, "seed": 7}},
+    )
+
+
+def _pctl(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return round(xs[idx], 4)
+
+
+def _dist(xs):
+    return {"n": len(xs), "p50": _pctl(xs, 0.50), "p99": _pctl(xs, 0.99),
+            "max": _pctl(xs, 1.0),
+            "mean": round(statistics.fmean(xs), 4) if xs else None}
+
+
+def _closed_ports(n):
+    """Ports that refuse instantly: bound once, closed before use."""
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    return ports
+
+
+class _Loris:
+    """Persistent slow-loris attackers: connect, trickle a partial
+    command, hold until the server evicts, reconnect. They exist to
+    pin command workers the way a real half-dead client does."""
+
+    def __init__(self, port: int, conns: int) -> None:
+        self.port, self.conns = port, conns
+        self.stop = threading.Event()
+        self.evictions = 0
+        self._threads = [threading.Thread(target=self._run, daemon=True)
+                         for _ in range(conns)]
+
+    def _run(self):
+        while not self.stop.is_set():
+            try:
+                s = socket.create_connection(("127.0.0.1", self.port),
+                                             timeout=2.0)
+                s.sendall(b'{"command": "SLOW')
+                s.settimeout(5.0)
+                while not self.stop.is_set():
+                    if not s.recv(4096):
+                        break           # evicted / closed: reconnect
+                self.evictions += 1
+                s.close()
+            except OSError:
+                time.sleep(0.05)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def join(self):
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=3.0)
+
+
+def _build_server(diag_ms, plan_ms, dispatch_ts):
+    """A real JobServer with pure-instrumentation wraps: stamp each
+    job's scheduler-chosen launch time and time every doctor/policy
+    evaluation the telemetry loop makes (the wrapped calls run
+    unchanged)."""
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=2)
+    orig_launch = server._launch
+
+    def launch(config, executor_ids):
+        dispatch_ts[config.job_id] = time.monotonic()
+        return orig_launch(config, executor_ids)
+
+    server._launch = launch                 # before start(): bind() sees it
+    orig_diag = server.doctor.diagnose
+
+    def diag(now=None, jobs=None):
+        t0 = time.monotonic()
+        try:
+            return orig_diag(now=now, jobs=jobs)
+        finally:
+            diag_ms.append((time.monotonic() - t0) * 1000.0)
+
+    server.doctor.diagnose = diag
+    orig_plan = server.policy.maybe_evaluate
+
+    def plan(jobs=None):
+        t0 = time.monotonic()
+        try:
+            return orig_plan(jobs=jobs)
+        finally:
+            plan_ms.append((time.monotonic() - t0) * 1000.0)
+
+    server.policy.maybe_evaluate = plan
+    return server
+
+
+def run_arm(tenants: int, overload_on: bool, fleet: int) -> dict:
+    from harmony_tpu.faults.retry import RetryError
+    from harmony_tpu.jobserver import joblog
+    from harmony_tpu.jobserver.client import CommandSender
+
+    saved = {k: os.environ.get(k) for k in
+             list(ARM_ENV) + ["HARMONY_OVERLOAD",
+                              "HARMONY_OBS_SCRAPE_TARGETS"]}
+    os.environ.update(ARM_ENV)
+    os.environ["HARMONY_OVERLOAD"] = "1" if overload_on else "0"
+    os.environ["HARMONY_OBS_SCRAPE_TARGETS"] = ",".join(
+        f"dead{i}=127.0.0.1:{p}"
+        for i, p in enumerate(_closed_ports(DEAD_SCRAPE_TARGETS)))
+    joblog.clear_events()
+    diag_ms, plan_ms, dispatch_ts = [], [], {}
+    server = _build_server(diag_ms, plan_ms, dispatch_ts)
+    try:
+        server.start()
+        port = server.serve_tcp()
+        # warm the dispatch path so the first tenant doesn't pay the
+        # one-time compile inside its measured window
+        CommandSender(port).send_job_submit_command(_tiny_job("warmup"))
+        server._jobs["warmup"].future.result(timeout=120)
+
+        loris = _Loris(port, LORIS_CONNS).start()
+        stop_pollers = threading.Event()
+
+        def poller():
+            # the dashboard herd: STATUS is the expensive read command;
+            # real dashboards poll at a cadence, they don't spin
+            sender = CommandSender(port, timeout=CLIENT_TIMEOUT_S)
+            while not stop_pollers.is_set():
+                try:
+                    sender._roundtrip({"command": "STATUS"})
+                except Exception:
+                    pass
+                stop_pollers.wait(POLL_PERIOD_S)
+
+        pollers = [threading.Thread(target=poller, daemon=True)
+                   for _ in range(STATUS_POLLERS)]
+        for t in pollers:
+            t.start()
+
+        work: "queue.Queue[str]" = queue.Queue()
+        for i in range(tenants):
+            work.put(f"t{i:04d}")
+        acks, outcomes = {}, {"ok": 0, "busy_refused": 0, "error": 0}
+        submit_t0 = {}
+        lock = threading.Lock()
+
+        def submitter():
+            sender = CommandSender(port, timeout=CLIENT_TIMEOUT_S)
+            while True:
+                try:
+                    jid = work.get_nowait()
+                except queue.Empty:
+                    return
+                t0 = time.monotonic()
+                with lock:
+                    submit_t0[jid] = t0
+                try:
+                    reply = sender.send_job_submit_command(_tiny_job(jid))
+                    ok = bool(reply.get("ok"))
+                except RetryError:
+                    ok, reply = False, {"busy": True}
+                except Exception:
+                    ok, reply = False, {}
+                with lock:
+                    if ok:
+                        outcomes["ok"] += 1
+                        acks[jid] = time.monotonic() - t0
+                    elif reply.get("busy"):
+                        outcomes["busy_refused"] += 1
+                    else:
+                        outcomes["error"] += 1
+
+        t_storm = time.monotonic()
+        threads = [threading.Thread(target=submitter, daemon=True)
+                   for _ in range(min(fleet, tenants))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=tenants * 2.0 + 120.0)
+        storm_s = time.monotonic() - t_storm
+        wedged_clients = sum(1 for t in threads if t.is_alive())
+
+        # drain: every ACKED job must reach its scheduler launch —
+        # acked-then-lost is the failure this PR forbids
+        deadline = time.monotonic() + DISPATCH_DRAIN_S
+        while time.monotonic() < deadline:
+            with lock:
+                missing = [j for j in acks if j not in dispatch_ts]
+            if not missing:
+                break
+            time.sleep(0.1)
+        with lock:
+            lost = [j for j in acks if j not in dispatch_ts]
+            d2d = [dispatch_ts[j] - submit_t0[j]
+                   for j in acks if j in dispatch_ts]
+        stop_pollers.set()
+        loris.join()
+        for t in pollers:
+            t.join(timeout=3.0)
+        if not diag_ms:
+            # no scrape cycle completed inside a short storm: drive one
+            # representative cycle directly (ledger fully populated)
+            server._on_scrape_cycle()
+        ov = server.overload.status()
+        scraper = server._history_scraper.stats()
+        return {
+            "tenants": tenants,
+            "overload": "on" if overload_on else "off",
+            "storm_s": round(storm_s, 2),
+            "survival": round(outcomes["ok"] / tenants, 4),
+            "outcomes": dict(outcomes),
+            "wedged_clients": wedged_clients,
+            "acked_jobs_lost": len(lost),
+            "submit_to_ack_s": _dist(list(acks.values())),
+            "submit_to_dispatch_s": _dist(d2d),
+            "diagnose_ms": _dist(diag_ms),
+            "plan_ms": _dist(plan_ms),
+            "scrape_cycle_ms": scraper.get("last_cycle_ms"),
+            "scrape_cycles": scraper.get("cycles"),
+            "loris_evictions": loris.evictions,
+            "ladder": {
+                "level_at_end": ov["level"],
+                "transitions": ov["transitions"],
+                "sheds": ov["sheds"],
+            },
+        }
+    finally:
+        try:
+            server.shutdown(timeout=60.0)
+        except Exception:
+            pass
+        joblog.clear_events()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_chaos(tenants: int, tmp_dir: str) -> dict:
+    """Leader killed mid-storm: the fleet keeps submitting through a
+    failover sender while the leader's command plane goes dark and a
+    standby takes the lease. Every acknowledged submission must
+    resolve exactly once on the successor."""
+    from harmony_tpu.jobserver import joblog
+    from harmony_tpu.jobserver.client import CommandSender
+    from harmony_tpu.jobserver.ha import HAController
+    from harmony_tpu.jobserver.server import JobServer
+
+    saved = {k: os.environ.get(k) for k in ARM_ENV}
+    os.environ.update(ARM_ENV)
+    os.environ["HARMONY_RETRY_MAX_ATTEMPTS"] = "10"
+    os.environ["HARMONY_RETRY_BASE_DELAY"] = "0.1"
+    joblog.clear_events()
+    try:
+        ha_dir = os.path.join(tmp_dir, "ha")
+        # A generous lease: on a CPU-saturated bench box a sub-second
+        # lease can starve the holder's own renew thread and self-depose
+        # the successor mid-resolution, which is lease tuning — not the
+        # failover behaviour this phase measures.
+        a = HAController(lambda: JobServer(num_executors=2),
+                         log_dir=ha_dir, replica_id="rep-a",
+                         submit_port=0, lease_s=2.5).start()
+        assert a.wait_leader(30)
+        a_addr = f"127.0.0.1:{a.port}"
+        b_addr = [a_addr]
+        acks, errors = {}, [0]
+        lock = threading.Lock()
+
+        def submitter(i):
+            sender = CommandSender(addrs=[a_addr, b_addr[0]],
+                                   timeout=CLIENT_TIMEOUT_S)
+            t0 = time.monotonic()
+            try:
+                r = sender.send_job_submit_command(_tiny_job(f"c{i:03d}"))
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                return
+            with lock:
+                if r.get("ok"):
+                    acks[f"c{i:03d}"] = time.monotonic() - t0
+                else:
+                    errors[0] += 1
+
+        threads = [threading.Thread(target=submitter, args=(i,),
+                                    daemon=True) for i in range(tenants)]
+        t_kill = None
+        for i, t in enumerate(threads):
+            t.start()
+            if i == tenants // 2:       # mid-storm: the leader dies
+                t_kill = time.monotonic()
+                a.server._stop_tcp()
+                a.lease.stop()
+                b = HAController(lambda: JobServer(num_executors=2),
+                                 log_dir=ha_dir, replica_id="rep-b",
+                                 submit_port=0, lease_s=2.5).start()
+                b_addr[0] = f"127.0.0.1:{b.port}"
+        assert b.wait_leader(60)
+        takeover_s = time.monotonic() - t_kill
+        print(f"# chaos: takeover_s={takeover_s:.1f}", file=sys.stderr)
+        for t in threads:
+            t.join(timeout=180)
+        wedged = sum(1 for t in threads if t.is_alive())
+        print(f"# chaos: storm joined acked={len(acks)} "
+              f"errors={errors[0]} wedged={wedged}", file=sys.stderr)
+        failover = CommandSender(addrs=[a_addr, f"127.0.0.1:{b.port}"])
+        resolved, unresolved = 0, []
+
+        def _sweep(jids, per_job, budget):
+            nonlocal resolved
+            timed_out = []
+            deadline = time.monotonic() + budget
+            for jid in jids:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    timed_out.append(jid)
+                    continue
+                try:
+                    failover.wait_result(jid, timeout=min(per_job, left))
+                except TimeoutError:
+                    timed_out.append(jid)
+                    continue
+                except RuntimeError:
+                    pass  # a definitive failure reply IS a resolution
+                resolved += 1
+            return timed_out
+
+        # two passes: the first visits early ids while the successor is
+        # still draining its re-armed backlog, so a timeout there means
+        # "not yet", not "lost" — only a job still unresolved on the
+        # second pass (after the whole drain had the first pass's wall
+        # clock to finish) counts as a lost ack
+        retry = _sweep(sorted(acks), per_job=60.0, budget=300.0)
+        if retry:
+            print(f"# chaos: first pass resolved={resolved}, "
+                  f"retrying {len(retry)}", file=sys.stderr)
+            unresolved = _sweep(retry, per_job=60.0, budget=120.0)
+        print(f"# chaos: resolved={resolved} unresolved={len(unresolved)}",
+              file=sys.stderr)
+        status = CommandSender(b.port).send_status_command()
+        out = {
+            "tenants": tenants,
+            "acked": len(acks),
+            "errors_or_refused": errors[0],
+            "wedged_clients": wedged,
+            "resolved_on_successor": resolved,
+            "acked_jobs_lost": len(acks) - resolved,
+            "unresolved": unresolved[:8],
+            "takeover_s": round(takeover_s, 2),
+            "successor_ladder": status["overload"]["ladder"],
+            "successor_epoch": status["ha"]["leader_epoch"],
+        }
+        # bounded teardown: the measurements above are already in `out`,
+        # and a teardown wedged on a drain must not discard them — the
+        # daemon thread is reaped with the process either way
+        stopper = threading.Thread(
+            target=lambda: (b.stop(), a.stop()), daemon=True)
+        stopper.start()
+        stopper.join(timeout=90)
+        if stopper.is_alive():
+            print("# chaos: teardown still draining (abandoned)",
+                  file=sys.stderr)
+        return out
+    finally:
+        joblog.clear_events()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tenants", default="32,256,1024")
+    ap.add_argument("--fleet", type=int, default=192,
+                    help="max concurrent submitting clients (the herd "
+                         "width; above the TCP backlog on purpose)")
+    ap.add_argument("--no-chaos", action="store_true")
+    args = ap.parse_args()
+    sizes = [int(x) for x in args.tenants.split(",") if x]
+
+    doc = {
+        "metric": "control_scale",
+        "unit": "seconds / fraction",
+        "mode": ("submit storm + STATUS herd + slow-loris + dead scrape "
+                 "targets against the real jobserver; overload-on vs "
+                 "overload-off arms; chaos = leader kill mid-storm"),
+        "config": {
+            "env": dict(ARM_ENV),
+            "fleet": args.fleet,
+            "dead_scrape_targets": DEAD_SCRAPE_TARGETS,
+            "loris_conns": LORIS_CONNS,
+            "client_timeout_s": CLIENT_TIMEOUT_S,
+            "job": "mlr 16x4x2, 1 epoch x 1 minibatch (real dispatch)",
+        },
+        "grid": [],
+    }
+    for n in sizes:
+        for on in (True, False):
+            label = f"{n}/{'on' if on else 'off'}"
+            print(f"# arm {label} ...", file=sys.stderr)
+            t0 = time.monotonic()
+            cell = run_arm(n, overload_on=on, fleet=args.fleet)
+            cell["arm_wall_s"] = round(time.monotonic() - t0, 1)
+            doc["grid"].append(cell)
+            print(f"# arm {label}: survival={cell['survival']} "
+                  f"ack_p99={cell['submit_to_ack_s']['p99']} "
+                  f"lost={cell['acked_jobs_lost']} "
+                  f"wall={cell['arm_wall_s']}s", file=sys.stderr)
+    if not args.no_chaos:
+        import tempfile
+
+        print("# chaos: leader kill mid-storm ...", file=sys.stderr)
+        with tempfile.TemporaryDirectory() as td:
+            try:
+                doc["chaos"] = run_chaos(128, td)
+            except Exception as exc:   # keep the grid; chaos reruns cheaply
+                doc["chaos"] = {"error": repr(exc)}
+    print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import List
 
 from harmony_tpu.analysis.core import Pass, PragmaHygienePass
+from harmony_tpu.analysis.passes.bounded import BoundedResourcePass
 from harmony_tpu.analysis.passes.donate import UseAfterDonatePass
 from harmony_tpu.analysis.passes.faultsites import FaultSiteRegistryPass
 from harmony_tpu.analysis.passes.jit import JitHygienePass
@@ -28,6 +29,7 @@ _REGISTRY = (
     PragmaHygienePass,  # framework-owned; also always-on (see its doc)
     SpmdDivergencePass,
     ThreadSharedStatePass,
+    BoundedResourcePass,
     UseAfterDonatePass,
     FaultSiteRegistryPass,
     KnobConsistencyPass,

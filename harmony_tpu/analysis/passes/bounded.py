@@ -1,0 +1,154 @@
+"""bounded-resource: server ingest paths must be capped.
+
+The ``serve_tcp`` rule (caught in PR 17): the jobserver's accept loop
+spawned one ``threading.Thread`` per connection — fine at ten tenants,
+a fork bomb at a thousand-tenant submit storm (every connection costs a
+stack, the scrape cycle starves, and the process wedges with no single
+line at fault). The fix is structural: a fixed worker pool over a
+BOUNDED queue, with admission control answering ``BUSY`` when it fills
+(jobserver/overload.py). This pass keeps the unbounded shape from
+creeping back in, in "server-shaped" code — any file that calls
+``.accept()`` on a socket:
+
+* a ``threading.Thread(...)`` constructed INSIDE a loop whose body also
+  accepts connections: per-connection spawn, unbounded thread count
+  under connection pressure;
+* a ``queue.Queue()`` (or Lifo/Priority/SimpleQueue) constructed with
+  no capacity in such a file: the pool may be fixed but its feed queue
+  still grows without bound (``maxsize=0``/``None`` count as uncapped
+  — that is what they mean);
+* an accepted connection (a name bound from ``.accept()``) appended to
+  a list/deque inside the accept loop: the hand-rolled variant of the
+  uncapped queue.
+
+Legitimately-bounded spawn sites (a replication peer set, a fixed
+worker fleet) stay allowed via the standard pragma — with a written
+reason stating WHAT bounds the connection count:
+``# lint: allow(bounded-resource) <why the peer set is bounded>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass, _dotted_name
+
+#: queue constructors with (or, for SimpleQueue, without) a maxsize
+_QUEUE_NAMES = ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue")
+
+
+def _is_accept_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "accept")
+
+
+def _uncapped_queue(node: ast.Call) -> bool:
+    """True when this queue construction has no effective capacity.
+    ``Queue(n)`` / ``Queue(maxsize=n)`` are capped unless n is the
+    literal 0 or None (stdlib semantics: both mean infinite)."""
+    last = (_dotted_name(node.func) or "").rsplit(".", 1)[-1]
+    if last not in _QUEUE_NAMES:
+        return False
+    if last == "SimpleQueue":
+        return True  # cannot be bounded at all
+    cap = None
+    if node.args:
+        cap = node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            cap = kw.value
+    if cap is None:
+        return True
+    return (isinstance(cap, ast.Constant)
+            and cap.value in (0, None))
+
+
+def _conn_names(loop: ast.AST) -> Set[str]:
+    """Names bound from an ``.accept()`` result inside the loop —
+    ``conn, addr = sock.accept()`` binds both (an address list grows
+    just as unboundedly as a connection list)."""
+    out: Set[str] = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign) and _is_accept_call(node.value):
+            for t in node.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                out.update(e.id for e in elts if isinstance(e, ast.Name))
+    return out
+
+
+class BoundedResourcePass(Pass):
+    name = "bounded-resource"
+    description = ("server accept paths cap their resources: no "
+                   "per-connection thread spawns, no uncapped ingest "
+                   "queues or connection lists")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            if not any(_is_accept_call(n) for n in ast.walk(sf.tree)):
+                continue  # not server-shaped: no accept loop here
+            seen: Set[Tuple[str, int]] = set()
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and _uncapped_queue(node):
+                    key = ("queue", node.lineno)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(self.finding(
+                            sf.rel, node.lineno,
+                            "uncapped queue in server-shaped code: this "
+                            "file accepts connections, and an ingest "
+                            "queue with no maxsize grows without bound "
+                            "under connection pressure",
+                            hint="give it a capacity (`queue.Queue("
+                                 "maxsize=cap)`) and shed work when "
+                                 "full — the jobserver answers BUSY "
+                                 "{retry_after_ms} (jobserver/"
+                                 "overload.py)",
+                            col=node.col_offset))
+                if not isinstance(node, (ast.While, ast.For)):
+                    continue
+                if not any(_is_accept_call(n) for n in ast.walk(node)):
+                    continue
+                self._check_accept_loop(out, sf.rel, node, seen)
+        return out
+
+    def _check_accept_loop(self, out: List[Finding], rel: str,
+                           loop: ast.AST,
+                           seen: Set[Tuple[str, int]]) -> None:
+        conns = _conn_names(loop)
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            last = (_dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if last == "Thread":
+                key = ("thread", node.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(self.finding(
+                        rel, node.lineno,
+                        "per-connection thread spawn inside an accept "
+                        "loop: thread count tracks connection count, "
+                        "unbounded under a submit storm",
+                        hint="use a fixed worker pool over a bounded "
+                             "queue (the serve_tcp rule from PR 17); "
+                             "if the peer set is genuinely bounded, "
+                             "say why in a pragma",
+                        col=node.col_offset))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and any(isinstance(n, ast.Name) and n.id in conns
+                            for a in node.args for n in ast.walk(a))):
+                key = ("append", node.lineno)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(self.finding(
+                        rel, node.lineno,
+                        "accepted connection appended to an uncapped "
+                        "list inside the accept loop: a hand-rolled "
+                        "unbounded ingest queue",
+                        hint="use a bounded queue.Queue and shed "
+                             "(reply BUSY) on Full",
+                        col=node.col_offset))

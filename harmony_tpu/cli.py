@@ -776,6 +776,8 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
         if getattr(args, "json", False):
             print(json.dumps(status.get("tenants", {}), indent=2))
             return 0
+        for line in _render_overload(status.get("overload") or {}):
+            print(line)
         for line in _render_tenant_top(status.get("tenants", {})):
             print(line)
         return 0
@@ -1062,6 +1064,37 @@ def _fmt_bytes(n) -> str:
             return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
         n /= 1024
     return "-"  # pragma: no cover - loop always returns
+
+
+def _render_overload(overload: dict) -> "List[str]":
+    """One line of control-plane overload state from STATUS (the
+    degradation ladder, jobserver/overload.py). Quiet when healthy:
+    nothing at level 0 with no shed history — the common case stays
+    one clean tenant table. Anything above normal (or any shed count)
+    prints ladder position, the pressure reason, queue fill/lag and
+    the per-action shed tallies so an operator sees WHAT fidelity was
+    traded before reading the doctor's control_overload card."""
+    if not overload:
+        return []
+    sheds = overload.get("sheds") or {}
+    level = int(overload.get("level") or 0)
+    if level == 0 and not sheds:
+        return []
+    q = overload.get("queue_fill")
+    lag = overload.get("queue_lag_ms")
+    parts = [f"overload: ladder={overload.get('ladder', '?')}"
+             f" level={level}"]
+    if overload.get("reason"):
+        parts.append(f"reason={overload['reason']}")
+    if q is not None:
+        parts.append(f"queue_fill={float(q):.2f}")
+    if lag is not None:
+        parts.append(f"lag={float(lag):.0f}ms")
+    out = ["  ".join(parts)]
+    if sheds:
+        out.append("  sheds: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(sheds.items())))
+    return out
 
 
 def _render_tenant_top(tenants: dict) -> "List[str]":

@@ -366,7 +366,7 @@ class InputService:
                 conn, _ = sock.accept()
             except OSError:
                 return  # closed
-            threading.Thread(
+            threading.Thread(  # lint: allow(bounded-resource) peers are this host's worker processes (long-lived conns, one per worker), bounded by pod size, not tenant count
                 target=self._serve_conn, args=(conn,),
                 name="inputsvc-conn", daemon=True,
             ).start()

@@ -51,6 +51,21 @@ class NotLeaderError(RuntimeError):
         self.leader = leader
 
 
+class ServerBusyError(RuntimeError):
+    """The leader answered a structured ``BUSY {retry_after_ms}``
+    (admission control shed the command — jobserver/overload.py). A
+    busy leader IS STILL THE LEADER: this error must never trigger
+    failover to another replica (they would answer NOT_LEADER and the
+    walk would land right back here); the client backs off for
+    ``retry_after_ms`` (jittered) and retries the same endpoint."""
+
+    def __init__(self, addr: str, retry_after_ms: int) -> None:
+        super().__init__(
+            f"{addr} is overloaded (BUSY, retry after {retry_after_ms}ms)")
+        self.addr = addr
+        self.retry_after_ms = int(retry_after_ms)
+
+
 class CommandSender:
     """One logical client over one or many replicas.
 
@@ -105,6 +120,12 @@ class CommandSender:
         reply = json.loads(data.decode())
         if isinstance(reply, dict) and reply.get("not_leader"):
             raise NotLeaderError(addr, reply.get("leader"))
+        if isinstance(reply, dict) and reply.get("busy"):
+            # the busy replica answered authoritatively — remember it
+            # as the leader so the backoff retry goes straight back
+            self._leader_hint = addr
+            raise ServerBusyError(addr,
+                                  int(reply.get("retry_after_ms", 250)))
         return reply
 
     def _candidates(self) -> List[str]:
@@ -117,12 +138,17 @@ class CommandSender:
                 out.append(a)
         return out
 
-    def _roundtrip(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+    def _roundtrip_route(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """One command against the replica set: every retry attempt
         walks the candidate list (following at most one NOT_LEADER
         redirect per walk); connection failures and standby replies
         back off under the standard bounded policy — a takeover window
-        is exactly the transient the retry idiom exists for."""
+        is exactly the transient the retry idiom exists for.
+
+        A :class:`ServerBusyError` ABORTS the walk immediately (it is
+        not retryable here): the replica that answered BUSY holds the
+        lease, so trying the others would only collect NOT_LEADERs.
+        The busy backoff lives one layer up (:meth:`_roundtrip`)."""
         from harmony_tpu.config.params import RetryPolicy
         from harmony_tpu.faults.retry import call_with_retry
 
@@ -159,6 +185,40 @@ class CommandSender:
         return call_with_retry(
             attempt, RetryPolicy.from_env(), op="client.roundtrip",
             retryable=(ConnectionError,),
+        )
+
+    def _roundtrip(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """The busy-honoring roundtrip: BUSY {retry_after_ms} replies
+        back off (the server's hint is the floor under the policy's
+        jittered schedule) and retry the SAME leader — never failover;
+        failover stays reserved for CONNECT errors inside
+        :meth:`_roundtrip_route`. Bounded by the standard retry
+        policy: a persistently-overloaded control plane surfaces as a
+        RetryError instead of an infinite client spin."""
+        import random as _random
+        import time as _time
+
+        from harmony_tpu.config.params import RetryPolicy
+        from harmony_tpu.faults.retry import call_with_retry
+
+        policy = RetryPolicy.from_env()
+        hint_ms = [0]
+
+        def once() -> Dict[str, Any]:
+            try:
+                return self._roundtrip_route(payload)
+            except ServerBusyError as e:
+                hint_ms[0] = e.retry_after_ms
+                raise
+
+        def pause(delay: float) -> None:
+            floor = (hint_ms[0] / 1000.0) * (
+                1.0 + policy.jitter * _random.random())
+            _time.sleep(max(delay, floor))
+
+        return call_with_retry(
+            once, policy, op="client.busy",
+            retryable=(ServerBusyError,), sleep=pause,
         )
 
     # -- commands --------------------------------------------------------

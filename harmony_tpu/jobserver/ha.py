@@ -123,7 +123,7 @@ class StandbyEndpoint:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
+            threading.Thread(target=self._serve, args=(conn,),  # lint: allow(bounded-resource) standby redirect stub: one-line NOT_LEADER reply under a 10s timeout, thread lifetime tracks instantaneous connect rate, not tenant count
                              daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -400,6 +400,7 @@ class HAController:
                 # BEFORE re-arming anything
                 raise RuntimeError("lease lapsed during takeover")
             rearmed = self._rearm(server, state)
+            self._seed_done(server, state)
         except BaseException:
             # a half-complete takeover must not leak a running server,
             # an open log handle, or a registered joblog sink into the
@@ -474,6 +475,33 @@ class HAController:
                     "takeover re-arm of %s failed: %s: %s",
                     job, type(e).__name__, e)
         return rearmed
+
+    @staticmethod
+    def _seed_done(server: Any, state: "ReplayState") -> None:
+        """Register every COMPLETED submission's terminal outcome from
+        the replayed log, so a WAIT on the successor answers done for
+        it instead of 'unknown job' until the client's deadline: a
+        client following its acknowledged submission across a failover
+        gets a definitive reply whether the job finished under the old
+        leader or gets re-armed here. The workers' result payload died
+        with the old leader's process — only the terminal ok/error
+        rides the log — so the seeded result says exactly that."""
+        from harmony_tpu.jobserver.server import JobResult
+
+        for job, entry in state.done.items():
+            if job not in state.submissions:
+                continue
+            jr = JobResult()
+            if entry.get("ok"):
+                jr.future.set_result({
+                    "done": True, "ok": True, "replayed": True,
+                    "epoch": entry.get("epoch")})
+            else:
+                jr.future.set_exception(RuntimeError(
+                    f"job {job} failed under a previous leader: "
+                    f"{entry.get('error')}"))
+            with server._lock:
+                server._jobs.setdefault(job, jr)
 
     @staticmethod
     def _has_chain(server: Any, job: str) -> bool:

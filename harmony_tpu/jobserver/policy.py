@@ -215,7 +215,8 @@ class ActionGate:
             self.fired_total += 1
 
     def sweep(self, observed: "set[Tuple[str, str]]",
-              among: Optional["frozenset[str]"] = None) -> None:
+              among: Optional["frozenset[str]"] = None,
+              subjects: Optional["set[str]"] = None) -> None:
         """Drop streaks for keys NOT observed this round: hysteresis
         means CONSECUTIVE windows, so a candidate the planner stopped
         surfacing restarts from zero — and a long-lived server never
@@ -223,11 +224,15 @@ class ActionGate:
         restricts the sweep to keys whose ACTION is in the set — on a
         SHARED gate each loop sweeps only its own action vocabulary
         (the policy engine must never reset the input autoscaler's
-        streaks)."""
+        streaks). ``subjects`` restricts it to keys whose SUBJECT is in
+        the set — an incremental (overload-degraded) evaluation swept
+        only the tenants it actually looked at; the rest keep their
+        streaks for their next rotation turn."""
         with self._lock:
             for key in [k for k in self._streak
                         if k not in observed
-                        and (among is None or k[1] in among)]:
+                        and (among is None or k[1] in among)
+                        and (subjects is None or k[0] in subjects)]:
                 del self._streak[key]
 
     def back_off(self, subject: str, now: Optional[float] = None) -> None:
@@ -358,10 +363,12 @@ class PolicyEngine:
 
     # -- cadence ---------------------------------------------------------
 
-    def maybe_evaluate(self) -> Optional[Dict[str, Any]]:
+    def maybe_evaluate(self, jobs: Optional["set[str]"] = None
+                       ) -> Optional[Dict[str, Any]]:
         """Evaluate if the period elapsed (the scrape-cycle hook); the
         direct :meth:`evaluate` stays available for tests and benches
-        that drive time themselves."""
+        that drive time themselves. ``jobs`` restricts the pass to a
+        tenant subset (overload degraded mode)."""
         if policy_mode() == "off":
             return None
         now = time.monotonic()
@@ -369,13 +376,18 @@ class PolicyEngine:
             if now - self._last_eval < policy_period():
                 return None
             self._last_eval = now
-        return self.evaluate()
+        return self.evaluate(jobs=jobs)
 
     # -- one evaluation --------------------------------------------------
 
-    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+    def evaluate(self, now: Optional[float] = None,
+                 jobs: Optional["set[str]"] = None) -> Dict[str, Any]:
         """One full plan-and-maybe-act pass; returns the plan (also kept
-        as ``last_plan`` for STATUS / ``obs plan``)."""
+        as ``last_plan`` for STATUS / ``obs plan``). ``jobs`` restricts
+        planning to a tenant subset — the overload ladder's incremental
+        degraded mode (jobserver/overload.py): only tenants with fresh
+        samples this cycle are considered, and the gate's sweep is
+        scoped to them so absent tenants keep their streaks."""
         mode = policy_mode()
         t0 = time.perf_counter()
         now = time.monotonic() if now is None else float(now)
@@ -385,6 +397,12 @@ class PolicyEngine:
             return self._finish(plan, t0)
         rows = self._safe(self._ledger_fn, {})
         tenants = self._safe(self._tenants_fn, {})
+        if jobs is not None:
+            scope = {str(j) for j in jobs}
+            plan["tenant_subset"] = sorted(scope)
+            rows = {k: v for k, v in rows.items() if str(k) in scope}
+            tenants = {k: v for k, v in tenants.items()
+                       if str(k) in scope}
         self._apply_backoffs()
         idle = self._safe(getattr(self._scheduler, "idle_executors",
                                   lambda: []), [])
@@ -432,7 +450,9 @@ class PolicyEngine:
         # Swept ONLY among this engine's action vocabulary — the input
         # autoscaler's streaks on the shared gate are not ours to reset
         self.gate.sweep({(a.job, a.kind) for a in actions},
-                        among=_ACTION_KINDS)
+                        among=_ACTION_KINDS,
+                        subjects=({str(j) for j in jobs}
+                                  if jobs is not None else None))
         return self._finish(plan, t0)
 
     # -- decision --------------------------------------------------------

@@ -20,6 +20,7 @@ Parity with the reference's jobserver (SURVEY.md §2.5):
 from __future__ import annotations
 
 import json
+import queue as _queue
 import socket
 import threading
 import time
@@ -31,6 +32,7 @@ from harmony_tpu.config.base import ConfigBase
 from harmony_tpu.config.params import JobConfig
 from harmony_tpu.jobserver.entity import JobEntity, build_entity
 from harmony_tpu.jobserver.joblog import job_logger, server_log
+from harmony_tpu.jobserver.overload import OverloadMonitor
 from harmony_tpu.jobserver.scheduler import JobScheduler, ShareAllScheduler, make_scheduler
 from harmony_tpu.metrics.doctor import Doctor, set_doctor
 from harmony_tpu.metrics.history import HistoryScraper, HistoryStore, extra_targets
@@ -46,6 +48,14 @@ from harmony_tpu.tracing.span import (
     wire_context,
 )
 from harmony_tpu.utils.statemachine import StateMachine
+
+
+class NotLeader(RuntimeError):
+    """Raised by submit() when the durable submission record was refused
+    because this leader's lease lapsed mid-command (deposed between the
+    TCP gate check and the append). The command plane converts it into
+    the NOT_LEADER reply so the client retries on the successor — an
+    acknowledged submission is ALWAYS in the replicated log."""
 
 
 class JobResult:
@@ -145,6 +155,15 @@ class JobServer:
         self._tcp_thread: Optional[threading.Thread] = None
         self._tcp_sock: Optional[socket.socket] = None
         self.port: Optional[int] = None
+        # Bounded command plane (jobserver/overload.py): a fixed worker
+        # pool drains a bounded accept queue; the monitor watches queue
+        # lag + telemetry-cycle overrun and steps the degradation
+        # ladder. Built unconditionally — admission questions are asked
+        # even when serve_tcp never runs (direct submit() callers).
+        self.overload = OverloadMonitor()
+        self._cmd_queue: Optional["_queue.Queue"] = None
+        self._cmd_workers: List[threading.Thread] = []
+        self._cmd_queue_cap = 0
         # Embedded input-data service (harmony_tpu/inputsvc): started on
         # demand when the first opted-in job arrives — scheduled and
         # owned by the jobserver like any other tenant resource, scaled
@@ -236,6 +255,23 @@ class JobServer:
         appends are refused (split-brain fencing, local half)."""
         return self.ha_lease is None or self.ha_lease.is_valid()
 
+    def _not_leader_reply(self) -> Dict[str, Any]:
+        """The structured NOT_LEADER redirect, with the current lease
+        holder's advertised address when the lease store knows one."""
+        hint = None
+        if self.ha_lease is not None:
+            import os as _os
+
+            from harmony_tpu.jobserver.lease import leader_hint
+
+            hint = leader_hint(
+                _os.path.dirname(self.ha_lease.path),
+                own_holder_id=self.ha_lease.holder_id)
+        return {"ok": False, "not_leader": True,
+                "error": "NOT_LEADER: this replica's lease "
+                         "lapsed (deposed)",
+                "leader": hint}
+
     #: entry-envelope keys DurableJobLog.append owns; event fields that
     #: collide (elastic fences carry their own ``epoch``, diagnoses a
     #: ``job``) are namespaced ``ev_*`` so the tee can never clash with
@@ -243,16 +279,19 @@ class JobServer:
     _HA_RESERVED = ("seq", "epoch", "ts", "kind", "job")
 
     def _ha_append(self, kind: str, job_id: Optional[str] = None,
-                   **fields: Any) -> None:
+                   **fields: Any) -> bool:
         """Guarded durable append: never fails the serving path, drops
-        (loudly) once this leader is deposed."""
+        (loudly) once this leader is deposed. Returns False only for
+        the deposed drop — the one case a caller must NOT acknowledge
+        as durable (submit() refuses the command on it); an I/O error
+        keeps the pre-HA best-effort contract and is surfaced in logs."""
         if self.ha_log is None:
-            return
+            return True
         if not self._ha_leader_ok():
             server_log.warning(
                 "halog append %r dropped: this leader's lease lapsed "
                 "(deposed)", kind)
-            return
+            return False
         try:
             fields = {(f"ev_{k}" if k in self._HA_RESERVED else k): v
                       for k, v in fields.items()}
@@ -261,6 +300,7 @@ class JobServer:
         except Exception as e:  # noqa: BLE001 - durability is surfaced,
             server_log.error("halog append %r failed: %s: %s",
                              kind, type(e).__name__, e)
+        return True
 
     def _ha_record_done(self, job_id: str, fut: "Future") -> None:
         exc = fut.exception()
@@ -317,7 +357,12 @@ class JobServer:
         if not isinstance(record, EpochMetrics):
             return
         now = _time.monotonic()
-        if now - self._last_tenant_post < self._TENANT_POST_PERIOD:
+        # under overload the dashboard tee rate-limits HARDER (the
+        # ladder's cheapest fidelity shed — it was best-effort anyway)
+        period = self._TENANT_POST_PERIOD * self.overload.dashboard_factor()
+        if now - self._last_tenant_post < period:
+            if now - self._last_tenant_post >= self._TENANT_POST_PERIOD:
+                self.overload.count_shed("dashboard_skip")
             return
         self._last_tenant_post = now
         try:
@@ -539,9 +584,17 @@ class JobServer:
         if self.ha_log is not None:
             # the durable submission record carries the WHOLE config
             # (``_trace`` included): a takeover re-arms the same
-            # submission from exactly this entry
-            self._ha_append("submission", job_id=config.job_id,
-                            config=config.to_dict())
+            # submission from exactly this entry. A drop here means the
+            # lease lapsed since the command gate — acking anyway would
+            # hand the client an acked job NO successor can ever replay
+            # (the acked-then-lost hole), so unwind and refuse instead.
+            if not self._ha_append("submission", job_id=config.job_id,
+                                   config=config.to_dict()):
+                with self._lock:
+                    self._jobs.pop(config.job_id, None)
+                raise NotLeader(
+                    f"submission {config.job_id} not durable: lease "
+                    "lapsed (deposed)")
             jr.future.add_done_callback(
                 lambda f, j=config.job_id: self._ha_record_done(j, f))
         self._scheduler.on_job_arrival(config)
@@ -657,21 +710,58 @@ class JobServer:
 
         targets: Dict[str, Any] = {"leader": get_registry().expose}
         targets.update(extra_targets())
+        if self.overload.degraded():
+            # degraded fidelity: sample a rotating subset per cycle
+            # (full coverage over a few cycles) instead of missing the
+            # scrape period on every cycle. The leader's own in-process
+            # registry is free and never rotated out.
+            keep = self.overload.plan_subset(
+                list(targets), plan="scrape", keep=("leader",))
+            targets = {k: v for k, v in targets.items() if k in keep}
         return targets
 
     def _on_scrape_cycle(self) -> None:
         """After every history-scraper poll: the doctor evaluates its
         rules, then the policy engine (throttled to its own period)
         replans off the fresh verdicts — sensor before actuator, every
-        cycle, both contained (a broken one must not stop the other)."""
+        cycle, both contained (a broken one must not stop the other).
+
+        This is also the overload detector's telemetry feed: each
+        stage's wall time is compared to the scrape period, and under
+        degradation the doctor/policy evaluate only the rotating tenant
+        subset with fresh samples (jobserver/overload.py)."""
+        ov = self.overload
+        period = self._history_scraper.period
+        st = self._history_scraper.stats()
+        ov.note_cycle("scrape",
+                      float(st.get("last_cycle_ms") or 0.0) / 1000.0,
+                      period)
+        jobs = None
+        if ov.degraded():
+            try:
+                jobs = set(ov.plan_subset(
+                    [str(j) for j in self.metrics.tenant_ledger()],
+                    plan="tenants"))
+            except Exception:
+                jobs = None
+        t0 = time.monotonic()
         try:
-            self.doctor.diagnose()
+            self.doctor.diagnose(jobs=jobs)
         except Exception:
             pass
+        ov.note_cycle("diagnose", time.monotonic() - t0, period)
+        t0 = time.monotonic()
         try:
-            self.policy.maybe_evaluate()
+            if ov.shedding():
+                # the planner is pure fidelity: at the bottom rung it
+                # sheds whole evaluations, not just tenants
+                ov.count_shed("policy_skip")
+            else:
+                self.policy.maybe_evaluate(jobs=jobs)
         except Exception:
             pass
+        ov.note_cycle("plan", time.monotonic() - t0, period)
+        ov.step()
 
     def _policy_tenants(self) -> Dict[str, Dict[str, Any]]:
         """Policy-engine actuator view: the running tenants whose
@@ -826,9 +916,18 @@ class JobServer:
             # on), recent actions, and the rate-limit gate's state —
             # what `harmony-tpu obs plan` renders
             "policy": self.policy.status(),
+            # control-plane overload (jobserver/overload.py): ladder
+            # level, queue fill/lag, shed counters and the recovery
+            # gate — the operator's "is fidelity degraded, and why"
+            "overload": self.overload.status(),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------
+
+    #: byte cap on ONE command message — the same fix class as the
+    #: scraper's bounded read (metrics/history.py _read_bounded): a
+    #: client streaming forever must cost a bounded buffer, not RSS
+    _MAX_CMD_BYTES = 16 << 20
 
     def serve_tcp(self, port: int = 0, host: str = "127.0.0.1") -> int:
         """Listen on ``host`` (default localhost — the single-machine
@@ -836,13 +935,36 @@ class JobServer:
         binds its advertised interface, cli --ha-bind); returns the
         bound port. Wire format: one JSON object per connection:
         {"command": "SUBMIT", "conf": <JobConfig>} or
-        {"command": "SHUTDOWN"}; reply is one JSON object."""
+        {"command": "SHUTDOWN"}; reply is one JSON object.
+
+        Bounded command plane (jobserver/overload.py): the accept loop
+        feeds a bounded queue drained by a FIXED worker pool — never a
+        thread per connection (that was the wedge under submit storms:
+        thousands of connections, thousands of threads, then the GIL
+        and RSS fall over together). A full queue answers BUSY
+        {retry_after_ms} right at accept; admission for SUBMIT is
+        checked again, against dispatch in-flight, before anything
+        durable happens."""
+        from harmony_tpu import faults
+        from harmony_tpu.jobserver import overload as _ov
+
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind((host, port))
-        sock.listen(16)
+        sock.listen(64)
+        cap = _ov.cmd_queue_cap()
+        q: "_queue.Queue" = _queue.Queue(maxsize=cap)
+        workers: List[threading.Thread] = []
+        for i in range(_ov.cmd_workers()):
+            t = threading.Thread(target=self._cmd_worker, args=(q, cap),
+                                 daemon=True, name=f"jobserver-cmd-{i}")
+            t.start()
+            workers.append(t)
         with self._lock:
             self._tcp_sock = sock
+            self._cmd_queue = q
+            self._cmd_workers = workers
+            self._cmd_queue_cap = cap
         self.port = sock.getsockname()[1]
 
         def loop() -> None:
@@ -851,62 +973,160 @@ class JobServer:
                     conn, _ = sock.accept()
                 except OSError:
                     return  # socket closed
-                threading.Thread(
-                    target=self._handle_conn, args=(conn,), daemon=True
-                ).start()
+                if faults.armed():
+                    try:
+                        faults.site("server.accept", depth=q.qsize())
+                    except Exception:
+                        # an injected accept fault drops THIS connection
+                        # (a flaky NIC/kernel accept path); the loop and
+                        # the queued work are untouched
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        continue
+                try:
+                    q.put_nowait((conn, time.monotonic()))
+                except _queue.Full:
+                    # shed at the door, loudly: a structured BUSY beats
+                    # an accepted-then-starved connection every time
+                    self.overload.note_queue(q.qsize(), cap)
+                    self.overload.count_shed("accept_shed")
+                    self._send_busy(conn, self.overload.retry_after_ms())
+                    self.overload.step()
 
         self._tcp_thread = threading.Thread(target=loop, daemon=True, name="jobserver-tcp")
         self._tcp_thread.start()
         return self.port
 
+    def _send_busy(self, conn: socket.socket, retry_after_ms: int) -> None:
+        """Best-effort BUSY reply on a connection being shed (bounded —
+        the accept loop must never block on a slow shed client)."""
+        reply = {"ok": False, "busy": True,
+                 "retry_after_ms": int(retry_after_ms),
+                 "error": "BUSY: control plane overloaded"}
+        try:
+            conn.settimeout(1.0)
+            conn.sendall((json.dumps(reply) + "\n").encode())
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _cmd_worker(self, q: "_queue.Queue", cap: int) -> None:
+        """One fixed-pool worker: drain the accept queue forever (a
+        None sentinel stops it). Queue lag — how long the connection
+        waited for a worker — is the overload detector's primary
+        command-plane signal."""
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            conn, enq_t = item
+            lag = time.monotonic() - enq_t
+            self.overload.note_queue(q.qsize(), cap, lag_sec=lag)
+            self.overload.step()
+            try:
+                self._handle_conn(conn)
+            except Exception:  # noqa: BLE001 - a handler bug must not
+                pass           # kill the pool worker
+
+    def _read_command(self, conn: socket.socket,
+                      deadline: float) -> bytes:
+        """Bounded read of one newline-terminated command: capped in
+        BYTES and WALL CLOCK (not per-recv — a trickling client used to
+        reset a 30s timeout on every byte and hold its thread forever;
+        same fix class as the PR-11 scraper hardening)."""
+        data = b""
+        while not data.endswith(b"\n"):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.overload.count_shed("slowloris_evict")
+                raise TimeoutError(
+                    "command read exceeded its wall-clock deadline "
+                    "(slow client evicted)")
+            conn.settimeout(min(5.0, remaining))
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                continue  # loop re-checks the WALL deadline
+            if not chunk:
+                break
+            data += chunk
+            if len(data) > self._MAX_CMD_BYTES:
+                self.overload.count_shed("oversize_evict")
+                raise ValueError(
+                    f"command exceeds {self._MAX_CMD_BYTES} byte cap")
+        return data
+
     def _handle_conn(self, conn: socket.socket) -> None:
+        from harmony_tpu import faults
+
+        from harmony_tpu.jobserver import overload as _ov
+
+        deadline = time.monotonic() + _ov.cmd_deadline_sec()
         # The error reply MUST go out before `with conn` closes the socket —
         # sending after close silently drops it and the client sees bare EOF.
         with conn:
             try:
-                data = b""
-                conn.settimeout(30)
-                while not data.endswith(b"\n"):
-                    chunk = conn.recv(65536)
-                    if not chunk:
-                        break
-                    data += chunk
+                data = self._read_command(conn, deadline)
                 msg = json.loads(data.decode())
                 cmd = msg.get("command")
+                if faults.armed():
+                    # raises = an injected command-path failure; it
+                    # surfaces to the client as a structured error reply
+                    faults.site("server.command", cmd=str(cmd))
                 if (cmd in ("SUBMIT", "POD_RESHARD", "WAIT")
                         and not self._ha_leader_ok()):
                     # deposed leader: every mutating/authoritative
                     # command redirects — a client following the lease
                     # holder's advertised address lands on the successor
-                    hint = None
-                    if self.ha_lease is not None:
-                        import os as _os
-
-                        from harmony_tpu.jobserver.lease import leader_hint
-
-                        hint = leader_hint(
-                            _os.path.dirname(self.ha_lease.path),
-                            own_holder_id=self.ha_lease.holder_id)
-                    reply = {"ok": False, "not_leader": True,
-                             "error": "NOT_LEADER: this replica's lease "
-                                      "lapsed (deposed)",
-                             "leader": hint}
+                    reply = self._not_leader_reply()
                 elif cmd == "SUBMIT":
-                    config = ConfigBase.from_dict(msg["conf"])
-                    # the client's span context (client.py sends it beside
-                    # the config): ride it inside the config so the whole
-                    # dispatch chain re-parents onto the CLI's trace
-                    wire = msg.get("trace")
-                    if wire and "_trace" not in config.user:
-                        config.user["_trace"] = dict(wire)
-                    with trace_span(
-                        "jobserver.submit",
-                        parent=SpanContext.from_wire(
-                            config.user.get("_trace")),
-                        job_id=config.job_id,
-                    ):
-                        self.submit(config)
-                    reply = {"ok": True, "job_id": config.job_id}
+                    # Admission BEFORE anything durable: a rejected
+                    # submission left no trace (no registry entry, no
+                    # joblog append), an admitted one proceeds into
+                    # submit()'s durable path — accepted-then-shed is
+                    # structurally impossible.
+                    with self._lock:
+                        q = self._cmd_queue
+                    retry_ms = self.overload.admit_submit(
+                        queue_depth=(q.qsize() if q is not None else 0),
+                        queue_cap=(self._cmd_queue_cap or 1),
+                        inflight=len(self.running_jobs()))
+                    if retry_ms is not None:
+                        reply = {"ok": False, "busy": True,
+                                 "retry_after_ms": retry_ms,
+                                 "error": "BUSY: control plane "
+                                          "overloaded; retry after "
+                                          f"{retry_ms}ms"}
+                    else:
+                        config = ConfigBase.from_dict(msg["conf"])
+                        # the client's span context (client.py sends it
+                        # beside the config): ride it inside the config
+                        # so the whole dispatch chain re-parents onto
+                        # the CLI's trace
+                        wire = msg.get("trace")
+                        if wire and "_trace" not in config.user:
+                            config.user["_trace"] = dict(wire)
+                        try:
+                            with trace_span(
+                                "jobserver.submit",
+                                parent=SpanContext.from_wire(
+                                    config.user.get("_trace")),
+                                job_id=config.job_id,
+                            ):
+                                self.submit(config)
+                            reply = {"ok": True, "job_id": config.job_id}
+                        except NotLeader:
+                            # deposed BETWEEN the gate check and the
+                            # durable append: the submission was unwound,
+                            # so redirect instead of acking a job no
+                            # successor can replay
+                            reply = self._not_leader_reply()
                 elif cmd == "STATUS":
                     reply = self._status()
                 elif cmd == "WAIT":
@@ -915,7 +1135,11 @@ class JobServer:
                     # across a leader change (the successor re-arms it
                     # under the same job id and resolves a fresh future)
                     job_id = str(msg.get("job_id"))
-                    timeout = min(float(msg.get("timeout", 30.0)), 300.0)
+                    # the future poll is also capped by the command
+                    # deadline: a WAIT occupies one fixed-pool worker,
+                    # and clients poll in a loop anyway (wait_result)
+                    timeout = min(float(msg.get("timeout", 30.0)), 300.0,
+                                  max(0.5, deadline - time.monotonic()))
                     with self._lock:
                         jr = self._jobs.get(job_id)
                     if jr is None:
@@ -966,8 +1190,33 @@ class JobServer:
         # clear sequence could close-then-read a None socket
         with self._lock:
             sock, self._tcp_sock = self._tcp_sock, None
+            q, self._cmd_queue = self._cmd_queue, None
+            workers, self._cmd_workers = self._cmd_workers, []
         if sock is not None:
             try:
                 sock.close()
             except OSError:
                 pass
+        if q is not None:
+            # drain queued (never-served) connections so their clients
+            # see EOF now, then stop the pool with one sentinel each
+            while True:
+                try:
+                    item = q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is not None:
+                    try:
+                        item[0].close()
+                    except OSError:
+                        pass
+            for _ in workers:
+                try:
+                    q.put(None, timeout=1.0)
+                except _queue.Full:
+                    break  # workers are daemons; leak rather than hang
+            # a worker mid-WAIT legitimately holds its slot up to the
+            # command deadline — don't stall shutdown on it
+            for t in workers:
+                if t is not threading.current_thread():
+                    t.join(timeout=0.5)

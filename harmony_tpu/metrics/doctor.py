@@ -12,10 +12,16 @@ Shipped rules (the catalog table in docs/OBSERVABILITY.md §Telemetry
 history & doctor is lint-held to this file in both directions):
 ``input_bound``, ``straggler``, ``mfu_collapse``, ``compile_storm``,
 ``infra_suspect``, ``comm_bound``, ``dispatch_bound``, ``leader_flap``,
-``slo_breach``.
+``rebalance_ineffective``, ``control_overload``, ``slo_breach``.
 Rules are declared through
 :func:`doctor_rule` with LITERAL names — the ``metric-conventions``
 lint pass reads them statically.
+
+Incremental evaluation: :meth:`Doctor.diagnose` takes ``jobs=`` — a
+tenant subset to evaluate (the overload ladder's degraded mode,
+jobserver/overload.py). Tenant-labeled series and per-job events
+outside the subset are invisible to that evaluation; process- and
+cluster-scoped rules still see everything.
 
 Diagnoses land as structured ``kind="diagnosis"`` joblog events (the
 future autoscaler's input), ride STATUS (``diagnoses``), are
@@ -563,6 +569,44 @@ def _rebalance_ineffective(ctx: DoctorContext) -> List[Diagnosis]:
     return out
 
 
+#: control_overload: ladder transitions inside one window at/above this
+#: (one step-down is an event; repeated stepping is sustained pressure)
+OVERLOAD_EVENT_COUNT = 1
+
+
+@doctor_rule("control_overload",
+             "the control plane shed fidelity: kind=\"overload\" joblog "
+             "events under __control__ (jobserver/overload.py) show the "
+             "degradation ladder stepped down in the window — command-"
+             "queue lag or scrape/diagnose/plan cycle overrun; scraping "
+             "rotates subsets and SUBMIT may answer BUSY until it "
+             "recovers")
+def _control_overload(ctx: DoctorContext) -> List[Diagnosis]:
+    evs = [e for e in ctx.events.get("__control__", [])
+           if e.get("kind") == "overload"
+           and float(e.get("ts", 0.0)) >= ctx.since]
+    downs = [e for e in evs if e.get("direction") == "down"]
+    if len(downs) < OVERLOAD_EVENT_COUNT:
+        return []
+    latest = evs[-1]
+    deepest = max(downs, key=lambda e: int(e.get("level", 0)))
+    recovered = (latest.get("direction") == "up"
+                 and int(latest.get("level", 0)) == 0)
+    return [Diagnosis(
+        rule="control_overload", verdict="control_overload",
+        confidence=min(1.0, 0.6 + 0.2 * len(downs)),
+        summary=("control plane overloaded: ladder stepped down to "
+                 f"{deepest.get('ladder')} ({deepest.get('reason')})"
+                 + ("; since recovered" if recovered
+                    else f"; currently {latest.get('ladder')}")),
+        window=(ctx.since, ctx.now),
+        target="control-plane",
+        evidence={"transitions": [dict(e) for e in evs[-6:]],
+                  "step_downs": len(downs),
+                  "sheds": dict(latest.get("sheds") or {}),
+                  "recovered": recovered})]
+
+
 @doctor_rule("slo_breach",
              "a structured kind=\"slo\" joblog breach event joined to "
              "whichever rule fired in its window — the breach gets a "
@@ -602,6 +646,28 @@ def _slo_breach(ctx: DoctorContext) -> List[Diagnosis]:
 # -- the engine ------------------------------------------------------------
 
 
+class _ScopedStore:
+    """Read-only tenant-scoped view of a :class:`HistoryStore` for
+    incremental (degraded-mode) evaluation: ``range`` results whose
+    labels name a tenant OUTSIDE the subset are dropped; unlabeled
+    (process/cluster) series pass through, as do the non-series
+    queries (``increase``/``rate``/``target_pid``) — they are already
+    bounded per call."""
+
+    def __init__(self, store: HistoryStore, jobs: "set[str]") -> None:
+        self._store = store
+        self._jobs = jobs
+
+    def range(self, *args: Any, **kwargs: Any):
+        return [(labels, pts)
+                for labels, pts in self._store.range(*args, **kwargs)
+                if labels.get("job") is None
+                or str(labels.get("job")) in self._jobs]
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._store, name)
+
+
 class Doctor:
     """Evaluates every shipped rule over a store; see module docstring.
 
@@ -628,10 +694,17 @@ class Doctor:
         #: (rule, subject) -> last emit ts: the once-per-window contract
         self._seen: Dict[Tuple[str, str], float] = {}
 
-    def diagnose(self, now: Optional[float] = None) -> List[Diagnosis]:
+    def diagnose(self, now: Optional[float] = None,
+                 jobs: Optional["set[str]"] = None) -> List[Diagnosis]:
         """One full rule evaluation; returns the NEWLY emitted
         diagnoses (deduped against the window). Safe to call at scrape
-        cadence — rules are pure reads over bounded rings."""
+        cadence — rules are pure reads over bounded rings.
+
+        ``jobs`` restricts the evaluation to a tenant subset (overload
+        degraded mode — jobserver/overload.py rotates the subset per
+        cycle so coverage stays complete, just slower): tenant series
+        and per-job events outside it are invisible; system subjects
+        (``__ha__``, ``__control__``) always evaluate."""
         now = time.time() if now is None else float(now)
         try:
             events = self._events_fn() or {}
@@ -643,7 +716,15 @@ class Doctor:
                 stragglers = self._stragglers_fn() or {}
             except Exception:
                 stragglers = {}
-        ctx = DoctorContext(self.store, now, self.window, events,
+        store = self.store
+        if jobs is not None:
+            scope = {str(j) for j in jobs}
+            store = _ScopedStore(self.store, scope)
+            events = {k: v for k, v in events.items()
+                      if k in scope or k.startswith("__")}
+            stragglers = {k: v for k, v in stragglers.items()
+                          if k in scope}
+        ctx = DoctorContext(store, now, self.window, events,
                             stragglers)
         for rule in all_rules():
             try:

@@ -659,6 +659,9 @@ class HistoryScraper:
         self._lock = threading.Lock()
         self._last_errors: Dict[str, str] = {}
         self._cycles = 0
+        #: wall time of the newest poll cycle — the overload detector's
+        #: scrape-overrun signal (jobserver/overload.py)
+        self._last_cycle_ms = 0.0
         #: lazily-created, REUSED scrape pool — the loop runs forever
         #: at scrape-period cadence; a fresh pool per cycle would churn
         #: OS threads inside the control plane
@@ -672,6 +675,7 @@ class HistoryScraper:
         target failures mark a gap and continue — a dead follower never
         wedges the loop or skews the other targets' series."""
         ts = time.time() if now is None else float(now)
+        t_start = time.monotonic()
         report: Dict[str, Any] = {"targets": {}, "ts": ts}
         try:
             targets = dict(self._targets_fn() or {})
@@ -749,6 +753,7 @@ class HistoryScraper:
                                       float(slo["attainment"]), ts=ts)
         with self._lock:
             self._cycles += 1
+            self._last_cycle_ms = (time.monotonic() - t_start) * 1000.0
             # vanished targets (a replaced follower's old pid) must not
             # pin their last error forever — errors clear on a later
             # success of the SAME name, which a gone name never has
@@ -803,6 +808,7 @@ class HistoryScraper:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {"period_sec": self.period, "cycles": self._cycles,
+                    "last_cycle_ms": round(self._last_cycle_ms, 3),
                     "last_errors": dict(self._last_errors)}
 
 

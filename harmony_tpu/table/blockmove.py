@@ -416,7 +416,7 @@ class _TcpReceiver:
                     continue
                 except OSError:
                     return  # listener closed
-                t = threading.Thread(target=self._drain, args=(conn,),
+                t = threading.Thread(target=self._drain, args=(conn,),  # lint: allow(bounded-resource) peers are one reshard's sending workers, bounded by pod size; joined in the finally
                                      daemon=True)
                 t.start()
                 drains.append(t)

@@ -1,0 +1,29 @@
+"""Fixture: the unbounded server shapes bounded-resource must catch.
+
+The seeded regression is the pre-PR-17 ``serve_tcp``: a thread per
+accepted connection, plus the uncapped feed queue and the hand-rolled
+connection list.
+"""
+import queue
+import socket
+import threading
+
+_BACKLOG = queue.Queue()  # line 11: uncapped ingest queue
+
+
+def _handle(conn):
+    with conn:
+        conn.recv(65536)
+
+
+def serve(port: int) -> None:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", port))
+    sock.listen(64)
+    pending = []
+    while True:
+        conn, _ = sock.accept()
+        # one thread per connection — unbounded under a storm
+        threading.Thread(target=_handle, args=(conn,),  # line 27
+                         daemon=True).start()
+        pending.append(conn)  # line 29: hand-rolled unbounded queue

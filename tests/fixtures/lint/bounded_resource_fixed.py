@@ -1,0 +1,31 @@
+"""Fixture: the bounded twin — fixed worker pool over a capped queue,
+shed (close) on Full. bounded-resource must come up clean here."""
+import queue
+import socket
+import threading
+
+_BACKLOG: "queue.Queue" = queue.Queue(maxsize=64)
+
+
+def _worker() -> None:
+    while True:
+        conn = _BACKLOG.get()
+        if conn is None:
+            return
+        with conn:
+            conn.recv(65536)
+
+
+def serve(port: int) -> None:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", port))
+    sock.listen(64)
+    # fixed pool, spawned ONCE before the accept loop
+    for _ in range(4):
+        threading.Thread(target=_worker, daemon=True).start()
+    while True:
+        conn, _ = sock.accept()
+        try:
+            _BACKLOG.put_nowait(conn)
+        except queue.Full:
+            conn.close()  # shed at admission, never accept-then-wedge

@@ -95,6 +95,23 @@ class TestPassFixtures:
         assert any("NestedCounter._n" in m and "non-thread code" in m
                    for m in msgs), render_text(r)
 
+    def test_bounded_resource_catches_serve_tcp_regression(self):
+        """The seeded regression of the pre-PR-17 ``serve_tcp`` bug:
+        per-connection thread spawn in the accept loop, plus the
+        uncapped feed queue and the hand-rolled connection list."""
+        r = _lint_file("bounded_resource_bad.py", "bounded-resource")
+        lines = {f.line for f in r.findings}
+        assert {11, 27, 29} <= lines, render_text(r)
+        msgs = [f.message for f in r.findings]
+        assert any("per-connection thread spawn" in m for m in msgs)
+        assert any("uncapped queue" in m for m in msgs)
+        assert any("hand-rolled" in m for m in msgs)
+        assert any("worker pool" in f.hint for f in r.findings)
+
+    def test_bounded_resource_accepts_pool_over_bounded_queue(self):
+        r = _lint_file("bounded_resource_fixed.py", "bounded-resource")
+        assert r.ok, render_text(r)
+
     def test_use_after_donate_catches_both_shapes(self):
         r = _lint_file("use_after_donate_bad.py", "use-after-donate")
         msgs = [f.message for f in r.findings]

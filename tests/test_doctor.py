@@ -38,7 +38,8 @@ class TestRuleCatalog:
         assert names == ["input_bound", "straggler", "mfu_collapse",
                          "compile_storm", "infra_suspect", "comm_bound",
                          "dispatch_bound", "leader_flap",
-                         "rebalance_ineffective", "slo_breach"]
+                         "rebalance_ineffective", "control_overload",
+                         "slo_breach"]
         assert all(r.description for r in all_rules())
 
     def test_input_bound_fires_and_names_tenant(self):

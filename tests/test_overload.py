@@ -1,0 +1,769 @@
+"""Overload-safe control plane (PR 17): admission control, the bounded
+command plane, the degradation ladder, and graceful recovery
+(harmony_tpu/jobserver/overload.py + the server.py command plane).
+
+Fast tier. Pins: the SUBMIT admission boundary, structured BUSY
+{retry_after_ms} and the client's honor-the-hint backoff (never
+failover — a busy leader is still the leader), ladder step-down /
+hysteretic step-up, shed accounting, accepted-job durability under
+shedding (rejected submissions leave NO trace), slow-loris and
+oversize eviction, degraded-mode scrape-subset rotation, joblog group
+commit under burst, the control_overload doctor rule, and leader
+failover under a submit storm (the in-process chaos sentinel — the
+real-process kill lives in the slow HA tier).
+"""
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from harmony_tpu.config.params import JobConfig, TrainerParams
+from harmony_tpu.jobserver import joblog
+from harmony_tpu.jobserver.client import CommandSender, ServerBusyError
+from harmony_tpu.jobserver.overload import LADDER, OverloadMonitor
+from harmony_tpu.jobserver.policy import ActionGate
+from harmony_tpu.jobserver.server import JobServer
+from harmony_tpu.parallel import DevicePool
+
+
+def _mlr_job(job_id, epochs=1):
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=epochs, num_mini_batches=2,
+            app_params={"num_classes": 4, "num_features": 16,
+                        "features_per_partition": 4, "step_size": 0.5}),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 64, "num_features": 16,
+                            "num_classes": 4, "seed": 7}},
+    )
+
+
+def _monitor(confirm=3, cooldown=5.0):
+    return OverloadMonitor(
+        gate=ActionGate(cooldown_sec=cooldown, confirm=confirm,
+                        stale_after=600.0),
+        enabled=True)
+
+
+def _recv_reply(sock):
+    data = b""
+    while not data.endswith(b"\n"):
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    return json.loads(data.decode())
+
+
+# -- admission --------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_below_thresholds_admits(self):
+        mon = _monitor()
+        assert mon.admit_submit(queue_depth=0, queue_cap=64,
+                                inflight=0) is None
+        # just under the default 0.75 fill boundary
+        assert mon.admit_submit(queue_depth=47, queue_cap=64,
+                                inflight=10) is None
+        assert mon._sheds == {}
+
+    def test_high_fill_rejects_with_bounded_hint(self):
+        mon = _monitor()
+        ms = mon.admit_submit(queue_depth=48, queue_cap=64, inflight=0)
+        assert isinstance(ms, int) and 100 <= ms <= 5000
+        assert mon.status()["sheds"]["busy_reject"] == 1
+
+    def test_inflight_cap_rejects_independently_of_fill(self):
+        mon = _monitor()
+        ms = mon.admit_submit(queue_depth=0, queue_cap=64, inflight=256)
+        assert isinstance(ms, int)
+
+    def test_shedding_level_tracks_live_queue(self):
+        """At the shedding rung, admission follows the LIVE queue: a
+        mid-band fill still rejects, but a drained queue admits — the
+        ladder's slow hysteretic recovery must not starve backed-off
+        clients whose retries land in the drained windows."""
+        mon = _monitor()
+        mon._level = len(LADDER) - 1
+        assert mon.admit_submit(queue_depth=32, queue_cap=64,
+                                inflight=0) is not None
+        assert mon.admit_submit(queue_depth=16, queue_cap=64,
+                                inflight=0) is None  # fill == low-water
+        assert mon.admit_submit(queue_depth=0, queue_cap=64,
+                                inflight=0) is None
+
+    def test_disabled_monitor_always_admits(self):
+        mon = OverloadMonitor(enabled=False)
+        assert mon.admit_submit(queue_depth=64, queue_cap=64,
+                                inflight=10_000) is None
+
+    def test_retry_hint_grows_with_depth_of_degradation(self):
+        mon = _monitor()
+        shallow = mon.retry_after_ms(fill=0.8, level=0)
+        deep = mon.retry_after_ms(fill=0.8, level=2)
+        assert deep > shallow
+
+
+# -- ladder + hysteresis ----------------------------------------------------
+
+
+class TestLadder:
+    def test_step_down_is_immediate_one_rung_per_step(self):
+        mon = _monitor()
+        mon.note_queue(depth=60, cap=64)
+        assert mon.step(now=0.0) == 1          # normal -> degraded
+        assert mon.degraded() and not mon.shedding()
+        assert mon.step(now=1.0) == 2          # degraded -> shedding
+        assert mon.shedding()
+        assert mon.step(now=2.0) == 2          # floor of the ladder
+        st = mon.status()
+        assert st["ladder"] == "shedding"
+        assert st["reason"].startswith("queue_fill=")
+        assert [t["to"] for t in st["transitions"]] == [
+            "degraded", "shedding"]
+
+    def test_step_up_needs_confirm_streak_and_cooldown(self):
+        mon = _monitor(confirm=3, cooldown=5.0)
+        mon.note_queue(depth=60, cap=64)
+        mon.step(now=0.0)
+        mon.note_queue(depth=0, cap=64)        # storm drained
+        assert mon.step(now=1.0) == 1          # calm streak 1
+        assert mon.step(now=2.0) == 1          # calm streak 2
+        assert mon.step(now=3.0) == 0          # streak 3: re-armed up
+        assert mon.status()["ladder"] == "normal"
+
+    def test_pressure_blip_resets_the_calm_streak(self):
+        mon = _monitor(confirm=3, cooldown=0.0)
+        mon.note_queue(depth=60, cap=64)
+        mon.step(now=0.0)
+        mon.note_queue(depth=0, cap=64)
+        mon.step(now=1.0)
+        mon.step(now=2.0)                      # two calm windows...
+        mon.note_queue(depth=30, cap=64)       # fill 0.47 > LOW: not calm
+        mon.step(now=3.0)                      # streak reset (no rung)
+        mon.note_queue(depth=0, cap=64)
+        mon.step(now=4.0)
+        assert mon.step(now=5.0) == 1          # only streak 2 again
+        assert mon.step(now=6.0) == 0
+
+    def test_cooldown_separates_consecutive_up_steps(self):
+        mon = _monitor(confirm=1, cooldown=10.0)
+        mon.note_queue(depth=60, cap=64)
+        mon.step(now=0.0)
+        mon.step(now=1.0)                      # down to shedding
+        mon.note_queue(depth=0, cap=64)
+        assert mon.step(now=2.0) == 1          # first up step fires
+        # confirm=1 is satisfied instantly, but the fired() cooldown
+        # must lapse before the next rung — no single-cycle snap-back
+        assert mon.step(now=3.0) == 1
+        assert mon.step(now=11.0) == 1         # 2.0 + 10.0 not yet past
+        assert mon.step(now=12.5) == 0
+
+    def test_cycle_overruns_need_consecutive_confirmation(self):
+        mon = _monitor()
+        mon.note_cycle("scrape", elapsed_sec=2.0, budget_sec=1.0)
+        assert mon.step(now=0.0) == 0          # one overrun is noise
+        mon.note_cycle("scrape", elapsed_sec=2.0, budget_sec=1.0)
+        assert mon.step(now=1.0) == 1          # a trend is load
+        assert "cycle_overrun=scrape" in mon.status()["reason"]
+        mon.note_cycle("scrape", elapsed_sec=0.1, budget_sec=1.0)
+        assert mon.status()["cycle_overruns"] == {}
+
+    def test_disabled_monitor_never_moves(self):
+        mon = OverloadMonitor(enabled=False)
+        mon.note_queue(depth=64, cap=64)
+        assert mon.step(now=0.0) == 0
+        assert mon.status()["ladder"] == "normal"
+
+
+# -- degraded-mode plans ----------------------------------------------------
+
+
+class TestPlanSubset:
+    def test_normal_level_returns_everything(self):
+        mon = _monitor()
+        keys = [f"t{i}" for i in range(10)]
+        assert mon.plan_subset(keys, plan="scrape") == keys
+
+    def test_rotation_covers_all_keys_and_keeps_pinned(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_OVERLOAD_SUBSET", "2")
+        mon = _monitor()
+        mon._level = 1
+        keys = ["leader"] + [f"t{i}" for i in range(5)]
+        seen = set()
+        for _ in range(3):
+            picked = mon.plan_subset(keys, plan="scrape",
+                                     keep=("leader",))
+            assert picked[0] == "leader" and len(picked) == 3
+            seen.update(picked[1:])
+        assert seen == {f"t{i}" for i in range(5)}
+        assert mon.status()["sheds"]["scrape_skip"] == 9  # 3 x (5-2)
+
+    def test_small_sets_are_never_rotated(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_OVERLOAD_SUBSET", "8")
+        mon = _monitor()
+        mon._level = 1
+        assert sorted(mon.plan_subset(["a", "b"], plan="tenants")) == [
+            "a", "b"]
+
+    def test_dashboard_factor_scales_with_level(self):
+        mon = _monitor()
+        assert mon.dashboard_factor() == 1.0
+        mon._level = 2
+        assert mon.dashboard_factor() == 16.0
+
+
+# -- the doctor rule --------------------------------------------------------
+
+
+class TestControlOverloadRule:
+    def test_step_down_event_diagnoses_and_recovery_annotates(self):
+        from harmony_tpu.metrics.doctor import Doctor
+        from harmony_tpu.metrics.history import HistoryStore
+
+        joblog.clear_events()
+        try:
+            joblog.record_event("__control__", "overload",
+                                ladder="degraded", level=1,
+                                direction="down",
+                                reason="queue_fill=0.81",
+                                sheds={"busy_reject": 4})
+            doc = Doctor(HistoryStore(), window=900.0)
+            hits = [d for d in doc.diagnose()
+                    if d.rule == "control_overload"]
+            assert len(hits) == 1
+            d = hits[0]
+            assert d.target == "control-plane"
+            assert d.evidence["step_downs"] == 1
+            assert d.evidence["sheds"] == {"busy_reject": 4}
+            assert not d.evidence["recovered"]
+            # full recovery annotates instead of silencing
+            joblog.record_event("__control__", "overload",
+                                ladder="normal", level=0,
+                                direction="up", reason="recovered",
+                                sheds={"busy_reject": 4})
+            doc2 = Doctor(HistoryStore(), window=900.0)
+            (d2,) = [d for d in doc2.diagnose()
+                     if d.rule == "control_overload"]
+            assert d2.evidence["recovered"]
+            assert "recovered" in d2.summary
+        finally:
+            joblog.clear_events()
+
+    def test_transition_lands_as_control_event(self):
+        joblog.clear_events()
+        try:
+            mon = _monitor()
+            mon.note_queue(depth=60, cap=64)
+            mon.step(now=0.0)
+            evs = joblog.job_events("__control__")
+            assert any(e["kind"] == "overload"
+                       and e["direction"] == "down"
+                       and e["ladder"] == "degraded" for e in evs)
+        finally:
+            joblog.clear_events()
+
+
+# -- the CLI surface --------------------------------------------------------
+
+
+class TestObsTopRender:
+    def test_quiet_when_normal_and_clean(self):
+        from harmony_tpu.cli import _render_overload
+
+        assert _render_overload({}) == []
+        assert _render_overload({"level": 0, "ladder": "normal",
+                                 "sheds": {}}) == []
+
+    def test_degraded_renders_ladder_and_sheds(self):
+        from harmony_tpu.cli import _render_overload
+
+        out = _render_overload({
+            "level": 1, "ladder": "degraded",
+            "reason": "queue_fill=0.81", "queue_fill": 0.81,
+            "queue_lag_ms": 340.0,
+            "sheds": {"busy_reject": 5, "scrape_skip": 40}})
+        text = "\n".join(out)
+        assert "ladder=degraded" in text
+        assert "queue_fill=0.81" in text
+        assert "busy_reject=5" in text and "scrape_skip=40" in text
+
+
+# -- the bounded command plane (real server, real sockets) ------------------
+
+
+class TestBoundedCommandPlane:
+    def test_status_carries_overload_section(self, devices, monkeypatch):
+        monkeypatch.setenv("HARMONY_OBS_SCRAPE_PERIOD", "3600")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        try:
+            st = server._status()
+            ov = st["overload"]
+            assert ov["enabled"] and ov["ladder"] == "normal"
+            assert "sheds" in ov and "queue_fill" in ov
+        finally:
+            server.shutdown()
+
+    def test_submit_shed_at_admission_leaves_no_trace(self, devices):
+        """The accepted-then-shed impossibility: a BUSY-rejected SUBMIT
+        must leave no registry entry and no joblog trace; an admitted
+        one runs to completion. Alternating admission decisions."""
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        calls = [0]
+
+        def flaky_admit(queue_depth, queue_cap, inflight):
+            calls[0] += 1
+            return 120 if calls[0] % 2 == 1 else None
+
+        server.overload.admit_submit = flaky_admit
+        sender = CommandSender(port)
+        accepted, rejected = [], []
+        for i in range(6):
+            jid = f"shed-{i}"
+            try:
+                reply = sender._roundtrip_one(
+                    f"127.0.0.1:{port}",
+                    {"command": "SUBMIT",
+                     "conf": _mlr_job(jid).to_dict()})
+            except ServerBusyError as e:
+                assert e.retry_after_ms == 120
+                rejected.append(jid)
+                continue
+            assert reply["ok"] and reply["job_id"] == jid
+            accepted.append(jid)
+        assert len(accepted) == 3 and len(rejected) == 3
+        for jid in rejected:
+            assert jid not in server._jobs          # no registry entry
+            assert joblog.job_events(jid) == []     # no joblog trace
+        for jid in accepted:
+            assert server._jobs[jid].future.result(timeout=120)
+        server.shutdown()
+
+    def test_deposed_mid_submit_refuses_instead_of_acking(
+            self, devices, tmp_path, monkeypatch):
+        """The acked-then-lost hole: the lease lapses BETWEEN the TCP
+        gate check and the durable submission append. The refused
+        append must turn into a NOT_LEADER reply (client retries on
+        the successor) — never an ack for a job no successor can
+        replay. The lapse is injected from inside the admission hook,
+        which runs exactly in that window."""
+        from harmony_tpu.jobserver.client import NotLeaderError
+        from harmony_tpu.jobserver.halog import DurableJobLog
+
+        monkeypatch.setenv("HARMONY_OBS_SCRAPE_PERIOD", "3600")
+
+        class FlagLease:
+            def __init__(self, path):
+                self.path = str(path)
+                self.holder_id = "rep-test"
+                self.epoch = 3
+                self.lapsed = False
+
+            def is_valid(self):
+                return not self.lapsed
+
+            def stats(self):
+                return {"holder": self.holder_id, "epoch": self.epoch}
+
+            def release(self):
+                pass
+
+        log = DurableJobLog(str(tmp_path / "halog.bin"))
+        lease = FlagLease(tmp_path / "lease")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.enable_ha(log, lease=lease, replica_id="rep-test")
+        server.start()
+        port = server.serve_tcp()
+
+        def lapse_then_admit(queue_depth, queue_cap, inflight):
+            lease.lapsed = True     # deposed between gate and append
+            return None             # ...but admission says yes
+
+        server.overload.admit_submit = lapse_then_admit
+        with pytest.raises(NotLeaderError):
+            CommandSender(port)._roundtrip_one(
+                f"127.0.0.1:{port}",
+                {"command": "SUBMIT",
+                 "conf": _mlr_job("deposed-1").to_dict()})
+        assert "deposed-1" not in server._jobs      # submission unwound
+        assert not any(e.get("kind") == "submission"
+                       for e in log.entries())      # nothing durable
+        server.shutdown()
+        log.close()
+
+    def test_client_honors_retry_after_and_retries_same_leader(
+            self, devices, monkeypatch):
+        monkeypatch.setenv("HARMONY_RETRY_BASE_DELAY", "0.01")
+        monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "4")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        calls = [0]
+
+        def busy_once(queue_depth, queue_cap, inflight):
+            calls[0] += 1
+            return 150 if calls[0] == 1 else None
+
+        server.overload.admit_submit = busy_once
+        t0 = time.monotonic()
+        reply = CommandSender(port).send_job_submit_command(
+            _mlr_job("busy-retry"))
+        assert reply["ok"] and calls[0] == 2
+        # the server's hint is the backoff FLOOR (0.15s), jittered up
+        assert time.monotonic() - t0 >= 0.15
+        server._jobs["busy-retry"].future.result(timeout=120)
+        server.shutdown()
+
+    def test_busy_never_fails_over(self, devices, monkeypatch):
+        """A busy leader IS STILL THE LEADER: the other replica must
+        never be contacted on BUSY (it would only answer NOT_LEADER),
+        and exhausted busy retries surface as RetryError."""
+        from harmony_tpu.faults.retry import RetryError
+
+        monkeypatch.setenv("HARMONY_RETRY_BASE_DELAY", "0.01")
+        monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "2")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        server.overload.admit_submit = (
+            lambda queue_depth, queue_cap, inflight: 100)
+        # decoy second replica: counts every connection it receives
+        decoy = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        decoy.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        decoy.bind(("127.0.0.1", 0))
+        decoy.listen(8)
+        decoy.settimeout(0.2)
+        decoy_port = decoy.getsockname()[1]
+        hits = [0]
+        stop = threading.Event()
+
+        def count():
+            while not stop.is_set():
+                try:
+                    c, _ = decoy.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                hits[0] += 1
+                c.close()
+
+        t = threading.Thread(target=count, daemon=True)
+        t.start()
+        sender = CommandSender(addrs=[f"127.0.0.1:{port}",
+                                      f"127.0.0.1:{decoy_port}"])
+        with pytest.raises(RetryError):
+            sender.send_job_submit_command(_mlr_job("never-lands"))
+        stop.set()
+        t.join(timeout=2.0)
+        decoy.close()
+        assert hits[0] == 0, "BUSY must not trigger failover"
+        assert "never-lands" not in server._jobs
+        server.shutdown()
+
+    def test_slow_loris_is_evicted_at_the_wall_deadline(
+            self, devices, monkeypatch):
+        monkeypatch.setenv("HARMONY_CMD_DEADLINE_MS", "400")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b'{"command": ')          # trickle, never finish
+        t0 = time.monotonic()
+        s.settimeout(10)
+        reply = _recv_reply(s)
+        elapsed = time.monotonic() - t0
+        s.close()
+        assert not reply["ok"] and "TimeoutError" in reply["error"]
+        assert elapsed < 5.0                # evicted, not 30s-per-recv
+        assert server.overload.status()["sheds"]["slowloris_evict"] >= 1
+        server.shutdown()
+
+    def test_oversize_command_is_evicted_at_the_byte_cap(self, devices):
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        server._MAX_CMD_BYTES = 4096        # instance shadow of the cap
+        port = server.serve_tcp()
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.sendall(b"x" * 8192)              # junk, no newline
+        s.settimeout(10)
+        reply = _recv_reply(s)
+        s.close()
+        assert not reply["ok"] and "byte cap" in reply["error"]
+        assert server.overload.status()["sheds"]["oversize_evict"] >= 1
+        server.shutdown()
+
+    def test_full_accept_queue_sheds_busy_at_the_door(
+            self, devices, monkeypatch):
+        """One worker pinned + a one-deep queue: the third connection
+        gets a structured BUSY straight from the accept loop."""
+        monkeypatch.setenv("HARMONY_CMD_WORKERS", "1")
+        monkeypatch.setenv("HARMONY_CMD_QUEUE", "1")
+        monkeypatch.setenv("HARMONY_CMD_DEADLINE_MS", "3000")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        pin = socket.create_connection(("127.0.0.1", port), timeout=10)
+        time.sleep(0.2)                     # worker picks it up, waits
+        queued = socket.create_connection(("127.0.0.1", port), timeout=10)
+        time.sleep(0.2)                     # sits in the bounded queue
+
+        # the accept loop is async: connections can land between its
+        # put_nowait attempts, so probe until one is shed at the door
+        deadline = time.monotonic() + 3.0
+        busy = None
+        extras = []
+        while busy is None and time.monotonic() < deadline:
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.settimeout(1.0)
+            try:
+                reply = _recv_reply(s)
+            except socket.timeout:
+                extras.append(s)            # queued instead; keep open
+                continue
+            busy = reply
+            s.close()
+        assert busy is not None and busy.get("busy")
+        assert busy["retry_after_ms"] >= 100
+        assert server.overload.status()["sheds"]["accept_shed"] >= 1
+        for s in (pin, queued, *extras):
+            s.close()
+        server.shutdown()
+
+    def test_wait_poll_is_capped_by_the_command_deadline(
+            self, devices, monkeypatch):
+        """A WAIT must not pin a fixed-pool worker past the command
+        deadline even when the client asks for a huge timeout."""
+        monkeypatch.setenv("HARMONY_CMD_DEADLINE_MS", "700")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        reply = CommandSender(port)._roundtrip(
+            {"command": "SUBMIT", "conf": _mlr_job("waity").to_dict()})
+        assert reply["ok"]
+        t0 = time.monotonic()
+        reply = CommandSender(port)._roundtrip(
+            {"command": "WAIT", "job_id": "nonexistent-other",
+             "timeout": 120.0})
+        assert not reply["ok"] and not reply["known"]
+        # unknown job answers immediately; now a known one with a huge
+        # requested timeout returns within ~the deadline either way
+        t0 = time.monotonic()
+        CommandSender(port)._roundtrip(
+            {"command": "WAIT", "job_id": "waity", "timeout": 120.0})
+        assert time.monotonic() - t0 < 5.0
+        server._jobs["waity"].future.result(timeout=120)
+        server.shutdown()
+
+
+# -- degraded-mode wiring on the real server --------------------------------
+
+
+class TestDegradedLoops:
+    def test_scrape_targets_rotate_under_degradation(self, monkeypatch):
+        monkeypatch.setenv(
+            "HARMONY_OBS_SCRAPE_TARGETS",
+            "t1=127.0.0.1:1,t2=127.0.0.1:2,t3=127.0.0.1:3,t4=127.0.0.1:4")
+        monkeypatch.setenv("HARMONY_OVERLOAD_SUBSET", "1")
+        monkeypatch.setenv("HARMONY_OBS_SCRAPE_PERIOD", "3600")
+        server = JobServer(num_executors=2)
+        server.start()
+        try:
+            full = server._scrape_targets()
+            assert set(full) == {"leader", "t1", "t2", "t3", "t4"}
+            server.overload._level = 1
+            seen = set()
+            for _ in range(4):
+                sub = server._scrape_targets()
+                assert "leader" in sub      # own registry never rotated
+                assert len(sub) == 2        # leader + the 1-wide slice
+                seen.update(k for k in sub if k != "leader")
+            assert seen == {"t1", "t2", "t3", "t4"}
+        finally:
+            server.shutdown()
+
+    def test_shedding_skips_policy_but_not_doctor(self, monkeypatch):
+        monkeypatch.setenv("HARMONY_OBS_SCRAPE_PERIOD", "3600")
+        server = JobServer(num_executors=2)
+        server.start()
+        try:
+            diag, planned = [], []
+            server.doctor.diagnose = (
+                lambda now=None, jobs=None: diag.append(jobs) or [])
+            server.policy.maybe_evaluate = (
+                lambda jobs=None: planned.append(jobs))
+            server.overload._level = 2
+            server._on_scrape_cycle()
+            assert len(diag) == 1           # sensor always runs
+            assert planned == []            # actuator shed whole
+            assert server.overload.status()["sheds"]["policy_skip"] == 1
+            server.overload._level = 0
+            server._on_scrape_cycle()
+            assert planned == [None]        # full evaluation when calm
+        finally:
+            server.shutdown()
+
+
+# -- joblog group commit under burst ----------------------------------------
+
+
+class TestGroupCommit:
+    def test_burst_appends_batch_their_fsyncs(self, tmp_path,
+                                              monkeypatch):
+        import os as _os
+
+        from harmony_tpu.jobserver import halog as _halog
+
+        real_fsync = _os.fsync
+
+        def slow_fsync(fd):
+            time.sleep(0.003)               # a realistic disk, not tmpfs
+            return real_fsync(fd)
+
+        monkeypatch.setattr(_halog.os, "fsync", slow_fsync)
+        log = _halog.DurableJobLog(str(tmp_path / "job.walog"))
+        N, THREADS = 25, 4
+
+        def burst(t):
+            for i in range(N):
+                log.append("submission", job_id=f"t{t}-{i}",
+                           config={"i": i})
+
+        threads = [threading.Thread(target=burst, args=(t,))
+                   for t in range(THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = log.stats()
+        assert st["appends"] == N * THREADS
+        assert st["last_seq"] == N * THREADS
+        # group commit: concurrent writers share fsyncs under burst
+        assert 1 <= st["group_commits"] < st["appends"]
+        log.close()
+        reopened = _halog.DurableJobLog(str(tmp_path / "job.walog"))
+        assert len(reopened.entries()) == N * THREADS  # nothing torn
+        reopened.close()
+
+    def test_single_append_still_commits_durably(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog
+
+        log = DurableJobLog(str(tmp_path / "one.walog"))
+        log.append("submission", job_id="solo", config={})
+        st = log.stats()
+        assert st["appends"] == 1 and st["group_commits"] == 1
+        log.close()
+        assert len(DurableJobLog(
+            str(tmp_path / "one.walog")).entries()) == 1
+
+
+# -- leader failover under a submit storm (chaos sentinel) ------------------
+
+
+class TestFailoverUnderStorm:
+    def test_replayed_completions_answer_wait_on_successor(
+            self, devices, monkeypatch):
+        """A job that COMPLETED under the old leader is not re-armed by
+        a takeover — but a client following its ack must still get a
+        definitive WAIT answer seeded from the replayed job_done
+        record, never 'unknown job' until its deadline."""
+        from harmony_tpu.jobserver.ha import HAController
+        from harmony_tpu.jobserver.halog import ReplayState
+
+        monkeypatch.setenv("HARMONY_OBS_SCRAPE_PERIOD", "3600")
+        server = JobServer(2, device_pool=DevicePool(devices[:2]))
+        server.start()
+        port = server.serve_tcp()
+        try:
+            state = ReplayState.from_entries([
+                {"seq": 1, "epoch": 1, "kind": "submission",
+                 "job": "old-ok", "config": {}},
+                {"seq": 2, "epoch": 1, "kind": "job_done",
+                 "job": "old-ok", "ok": True},
+                {"seq": 3, "epoch": 1, "kind": "submission",
+                 "job": "old-bad", "config": {}},
+                {"seq": 4, "epoch": 1, "kind": "job_done",
+                 "job": "old-bad", "ok": False, "error": "OOM"},
+            ])
+            HAController._seed_done(server, state)
+            sender = CommandSender(port)
+            r = sender.send_wait_command("old-ok", timeout=5)
+            assert r["ok"] and r["done"] and r["result"]["replayed"]
+            r = sender.send_wait_command("old-bad", timeout=5)
+            assert not r["ok"] and r["known"] and r["done"]
+            assert "previous leader" in r["error"]
+        finally:
+            server.shutdown()
+
+    def test_takeover_mid_storm_keeps_accepted_jobs_exactly_once(
+            self, tmp_path, monkeypatch):
+        """Kill the leader's command plane while a burst of clients is
+        submitting: every submission the OLD or NEW leader acknowledged
+        resolves exactly once on the successor; clients that were
+        answered BUSY/refused simply retried — none wedge, none lose an
+        accepted job. In-process sentinel for the slow-tier kill."""
+        from harmony_tpu.jobserver.ha import HAController
+
+        monkeypatch.setenv("HARMONY_RETRY_BASE_DELAY", "0.1")
+        monkeypatch.setenv("HARMONY_RETRY_MAX_ATTEMPTS", "10")
+        joblog.clear_events()
+        ha_dir = str(tmp_path / "ha")
+
+        a = HAController(lambda: JobServer(num_executors=2),
+                         log_dir=ha_dir, replica_id="rep-a",
+                         submit_port=0, lease_s=0.6).start()
+        assert a.wait_leader(30)
+        a_addr = f"127.0.0.1:{a.port}"
+        STORM = 6
+        oks, errs = [], []
+        lock = threading.Lock()
+
+        def submitter(i):
+            sender = CommandSender(addrs=[a_addr, b_addr[0]])
+            try:
+                r = sender.send_job_submit_command(_mlr_job(f"storm-{i}"))
+            except Exception as e:  # noqa: BLE001 - storm bookkeeping
+                with lock:
+                    errs.append((i, e))
+                return
+            with lock:
+                (oks if r.get("ok") else errs).append((i, r))
+
+        b_addr = [a_addr]  # placeholder until B exists
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(STORM)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 1:      # mid-storm: leader's plane goes dark
+                a.server._stop_tcp()
+                a.lease.stop()
+                b = HAController(lambda: JobServer(num_executors=2),
+                                 log_dir=ha_dir, replica_id="rep-b",
+                                 submit_port=0, lease_s=0.6).start()
+                b_addr[0] = f"127.0.0.1:{b.port}"
+        assert b.wait_leader(30)
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "no client wedges"
+        assert oks, f"no submission landed at all: {errs}"
+        # every acknowledged submission resolves exactly once on B
+        failover = CommandSender(addrs=[a_addr, f"127.0.0.1:{b.port}"])
+        for i, r in oks:
+            result = failover.wait_result(f"storm-{i}", timeout=120)
+            assert result["workers"], f"storm-{i} lost after ack"
+        # and B's plane reports its overload state (re-armed, normal
+        # or degraded — never wedged)
+        status = CommandSender(b.port).send_status_command()
+        assert status["overload"]["ladder"] in LADDER
+        b.stop()
+        a.stop()
+        joblog.clear_events()
