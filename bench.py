@@ -676,6 +676,13 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # autoscale.slo_attainment so a regression in the loop fails
         # bin/bench_diff.sh (pinned capture: AUTOSCALE_r15.json)
         line["autoscale"] = asc
+    cho = measure_chaos()
+    if cho is not None:
+        # seeded chaos smoke: two fast multi-fault scenarios through
+        # the orchestrator; scenarios_ok dropping below scenarios_run
+        # means an invariant went red on a pinned schedule (the full
+        # sweep is benchmarks/CHAOS_r18.json, run by bin/chaos.sh)
+        line["chaos"] = cho
     print(json.dumps(line))
 
 
@@ -958,6 +965,34 @@ def measure_autoscale() -> "dict | None":
         return None
 
 
+def measure_chaos() -> "dict | None":
+    """Seeded chaos smoke probe (tracked round over round in the BENCH
+    json, and by --compare via chaos.scenarios_ok): a fixed pair of
+    fast seeded scenarios — the ENOSPC-mid-commit checkpoint schedule
+    and the halog-ENOSPC submission schedule — through the real
+    orchestrator with the whole-system invariant checker as the
+    verdict (the full sweep is benchmarks/CHAOS_r18.json; bin/chaos.sh
+    runs it). Returns {scenarios_run, scenarios_ok,
+    invariant_violations, wall_s} or None — the bench line must never
+    die for its chaos hook."""
+    try:
+        from harmony_tpu.faults.chaos import run_scenario
+
+        runs = [run_scenario(5, intensity=0.6,
+                             scenario="chkp_enospc_commit"),
+                run_scenario(11, intensity=0.5,
+                             scenario="halog_enospc")]
+        violations = sorted({v for r in runs for v in r["violations"]})
+        return {
+            "scenarios_run": len(runs),
+            "scenarios_ok": sum(1 for r in runs if r["ok"]),
+            "invariant_violations": violations,
+            "wall_s": round(sum(r["wall_s"] for r in runs), 2),
+        }
+    except Exception:
+        return None
+
+
 def measure_lint() -> "dict | None":
     """harmonylint-suite runtime probe (tracked round over round in the
     BENCH json): one full run over harmony_tpu/. Returns {"lint.wall_ms",
@@ -996,10 +1031,13 @@ def measure_lint() -> "dict | None":
 #: pair tracks the closed policy loop (aggregate samples/sec and SLO
 #: attainment of the churning-mix act arm) — absent before PR 15,
 #: skipped the same way; `async_step.b1_sps` tracks the bounded-
-#: staleness overlap arm (absent before PR 16, skipped the same way).
+#: staleness overlap arm (absent before PR 16, skipped the same way);
+#: `chaos.scenarios_ok` tracks the seeded chaos smoke pair — any drop
+#: means an invariant went red on a pinned schedule (absent before
+#: PR 18, skipped the same way).
 HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps",
                    "autoscale.agg_sps", "autoscale.slo_attainment",
-                   "async_step.b1_sps")
+                   "async_step.b1_sps", "chaos.scenarios_ok")
 COMPARE_THRESHOLD = 0.15
 
 

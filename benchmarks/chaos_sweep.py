@@ -1,0 +1,177 @@
+"""Seeded chaos sweep: every scenario class x intensity against the
+real control plane, whole-system invariants as the verdict.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/chaos_sweep.py > benchmarks/CHAOS_r18.json
+
+Each cell is one ``(seed, scenario, intensity)`` draw through
+harmony_tpu.faults.chaos — the seed contract means any cell replays
+byte-identically from its row alone. The committed capture must show
+every invariant green at end state; a red cell is a bug (fix it and
+pin the schedule in tests/test_chaos.py, as the halog tail-poisoning
+and the acked-then-lost submit ack were).
+
+``--quick`` skips the HA takeover scenarios (leader kill + partition),
+which dominate wall time — bin/chaos.sh wires the two tiers.
+"""
+import argparse
+import json
+import logging
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from harmony_tpu.faults import chaos  # noqa: E402
+
+
+def _pctl(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return round(xs[idx], 4)
+
+
+def _dist(xs):
+    return {"n": len(xs), "p50": _pctl(xs, 0.50), "p99": _pctl(xs, 0.99),
+            "max": _pctl(xs, 1.0),
+            "mean": round(statistics.fmean(xs), 4) if xs else None}
+
+
+#: the sweep grid: (seed, scenario, intensity). Seeds picked once and
+#: committed — the capture is replayable row by row. Every scenario
+#: class appears; the REQUIRED compositions (partition during takeover,
+#: disk fault during commit) appear at two intensities each.
+GRID = [
+    (11, "halog_enospc", 0.5),
+    (12, "halog_enospc", 0.9),
+    (3, "halog_torn_write", 0.5),
+    (9, "halog_torn_write", 0.9),
+    (4, "log_slow_fsync", 0.5),
+    (11, "client_partition", 0.5),
+    (13, "client_partition", 0.9),
+    (3, "lease_disk_flap", 0.5),
+    (6, "lease_disk_flap", 0.9),
+    (5, "chkp_torn_block", 0.6),
+    (8, "chkp_bitrot_read", 0.6),
+    (5, "chkp_enospc_commit", 0.6),   # disk fault during commit
+    (7, "chkp_enospc_commit", 0.9),
+    (11, "repl_partition_heal", 0.5),
+    (21, "partition_during_takeover", 0.5),   # the capstone
+    (23, "partition_during_takeover", 0.9),
+    (22, "overload_storm_leader_kill", 0.5),
+]
+
+
+def run_cell(seed: int, scenario: str, intensity: float) -> dict:
+    with tempfile.TemporaryDirectory(prefix="harmony-chaos-") as td:
+        report = chaos.run_scenario(seed, intensity=intensity,
+                                    scenario=scenario, workdir=td)
+    acts = report["acts"]
+    cell = {
+        "seed": seed,
+        "scenario": scenario,
+        "intensity": intensity,
+        "ok": report["ok"],
+        "violations": report["violations"],
+        "invariants": {
+            f["name"]: ("skipped" if f.get("skipped")
+                        else ("ok" if f["ok"] else "VIOLATED"))
+            for a in acts
+            for f in a.get("invariants", {}).get("findings", [])},
+        "fault_fires": {k: v for a in acts
+                        for k, v in (a.get("fault_fires") or {}).items()},
+        "acked": sum(a.get("acked") or 0 for a in acts),
+        "client_errors": sum(a.get("errors") or 0 for a in acts),
+        "wall_s": report["wall_s"],
+    }
+    takeovers = [a["takeover_s"] for a in acts if a.get("takeover_s")]
+    if takeovers:
+        cell["takeover_s"] = takeovers[0]
+    resolves = [a["resolve_s"] for a in acts if a.get("resolve_s")]
+    if resolves:
+        cell["resolve_s"] = resolves[0]
+    return cell
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the HA takeover scenarios (the slow tier)")
+    ap.add_argument("--seed-shift", type=int, default=0,
+                    help="offset every grid seed (schedule-diversity "
+                         "sweeps; the committed capture uses 0)")
+    args = ap.parse_args()
+    logging.disable(logging.ERROR)  # storms are LOUD by design
+
+    grid = [(s + args.seed_shift, name, i) for s, name, i in GRID
+            if not (args.quick and name in chaos.HA_SCENARIOS)]
+
+    doc = {
+        "metric": "chaos_sweep",
+        "unit": "invariant verdicts / seconds",
+        "mode": ("seeded multi-fault schedules (partition + disk + "
+                 "crash compositions) against the real control plane; "
+                 "whole-system invariants checked at end state; every "
+                 "cell replays byte-identically from (seed, scenario, "
+                 "intensity)"),
+        "config": {
+            "scenario_catalog": sorted(chaos.SCENARIOS),
+            "invariant_catalog": [
+                "exactly_once_epochs", "acked_in_log", "loss_parity",
+                "no_orphans", "counter_monotonicity", "chain_integrity",
+                "single_leaseholder", "epoch_monotonic",
+                "leaseholder_after_heal", "acked_resolved"],
+            "job": "mlr 16x4x2, 2 epochs x 1 minibatch (real dispatch)",
+            "grid_cells": len(grid),
+        },
+        "grid": [],
+    }
+    t_sweep = time.monotonic()
+    for seed, name, intensity in grid:
+        print(f"# {name} seed={seed} i={intensity} ...", file=sys.stderr)
+        t0 = time.monotonic()
+        try:
+            cell = run_cell(seed, name, intensity)
+        except Exception as exc:  # a crashed cell is a red cell
+            cell = {"seed": seed, "scenario": name,
+                    "intensity": intensity, "ok": False,
+                    "violations": ["harness_crash"],
+                    "error": repr(exc)}
+        cell["cell_wall_s"] = round(time.monotonic() - t0, 1)
+        doc["grid"].append(cell)
+        print(f"#   ok={cell['ok']} violations={cell['violations']} "
+              f"fires={cell.get('fault_fires')} "
+              f"wall={cell['cell_wall_s']}s", file=sys.stderr)
+
+    oks = [c for c in doc["grid"] if c["ok"]]
+    doc["summary"] = {
+        "scenarios_run": len(doc["grid"]),
+        "scenarios_ok": len(oks),
+        "distinct_scenarios": len({c["scenario"] for c in doc["grid"]}),
+        "invariant_violations": sorted(
+            {v for c in doc["grid"] for v in c["violations"]}),
+        "recovery": {
+            "takeover_s": _dist([c["takeover_s"] for c in doc["grid"]
+                                 if c.get("takeover_s")]),
+            "resolve_s": _dist([c["resolve_s"] for c in doc["grid"]
+                                if c.get("resolve_s")]),
+        },
+        "sweep_wall_s": round(time.monotonic() - t_sweep, 1),
+    }
+    print(json.dumps(doc, indent=1))
+    return 0 if len(oks) == len(doc["grid"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Seeded chaos tiers (docs/FAULT_TOLERANCE.md §Chaos orchestrator).
+#
+#   bin/chaos.sh             fast tier: the tier-1 chaos marker tests
+#                            (schedule determinism, fault-class
+#                            semantics, fast end-to-end scenarios) plus
+#                            a --quick sweep (no HA takeover cells)
+#   bin/chaos.sh --runslow   full tier: slow-marked HA scenarios and
+#                            the complete sweep grid, the capture that
+#                            becomes benchmarks/CHAOS_r*.json
+#
+# Any red cell prints its (seed, scenario, intensity) row — replay it
+# byte-identically with:
+#   python -c 'from harmony_tpu.faults import chaos; \
+#              print(chaos.run_scenario(SEED, intensity=I, scenario="NAME"))'
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+SLOW=""
+SWEEP_ARGS="--quick"
+if [[ "${1:-}" == "--runslow" ]]; then
+  SLOW="--runslow"
+  SWEEP_ARGS=""
+fi
+
+echo "# chaos tests (${SLOW:-fast tier})" >&2
+python -m pytest tests/test_chaos.py -q -m chaos ${SLOW} \
+  -p no:cacheprovider -p no:randomly
+
+echo "# chaos sweep ${SWEEP_ARGS:-(full grid)}" >&2
+python benchmarks/chaos_sweep.py ${SWEEP_ARGS} > /tmp/chaos_sweep.json
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/chaos_sweep.json"))
+s = doc["summary"]
+print(f"chaos sweep: {s['scenarios_ok']}/{s['scenarios_run']} scenarios "
+      f"green, violations={s['invariant_violations']}")
+for cell in doc["grid"]:
+    if not cell["ok"]:
+        print("  RED:", {k: cell[k] for k in
+                         ("seed", "scenario", "intensity", "violations")})
+raise SystemExit(0 if s["scenarios_ok"] == s["scenarios_run"] else 1)
+EOF

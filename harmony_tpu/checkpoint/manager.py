@@ -346,6 +346,16 @@ def _write_block(d: str, bid: int, arr: np.ndarray,
     def attempt() -> None:
         if faults.armed():
             faults.site("chkp.block_write", block=bid)
+            # disk fault class: ENOSPC/EIO raise; "corrupt" is a torn
+            # block — a truncated container lands on disk (the CRC
+            # trailer / manifest checksum must catch it at read time)
+            act = faults.site("disk.write", kind="chkp.block", block=bid)
+            if act == "corrupt":
+                torn = os.path.join(
+                    d, f"{bid}.blk" if native.available() else f"{bid}.npy")
+                with open(torn, "wb") as f:
+                    f.write(b"\x93NUMPY-TORN")
+                return
         if native.available():
             native.blk_write(os.path.join(d, f"{bid}.blk"), arr)
         else:
@@ -369,6 +379,22 @@ def _read_block(d: str, bid: int,
     def attempt() -> np.ndarray:
         if faults.armed():
             faults.site("chkp.block_read", block=bid)
+            # disk fault class on the read path: "corrupt" flips bytes
+            # after a clean read (bit rot under a valid container) so
+            # the manifest-checksum arm below must fire; EIO raise
+            # rules ride the normal retry policy
+            if faults.site("disk.read", kind="chkp.block",
+                           block=bid) == "corrupt":
+                arr = attempt_clean()
+                raw = bytearray(arr.tobytes())
+                if raw:
+                    raw[0] ^= 0xFF
+                    return np.frombuffer(
+                        bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+                return arr
+        return attempt_clean()
+
+    def attempt_clean() -> np.ndarray:
         blk = os.path.join(d, f"{bid}.blk")
         try:
             if os.path.exists(blk):
@@ -931,6 +957,12 @@ class CheckpointManager:
         with trace_span("checkpoint.commit", chkp_id=chkp_id):
             if faults.armed():
                 faults.site("chkp.commit", chkp_id=chkp_id)
+                # disk fault class at the durable landing: an ENOSPC
+                # raise here is the mid-commit full disk — the temp
+                # copy must stay restorable and a commit retry must be
+                # idempotent once space returns
+                faults.site("disk.fsync", kind="chkp.commit",
+                            chkp_id=chkp_id)
             src = os.path.join(self.temp_root, chkp_id)
             if self._backend.exists(chkp_id):
                 shutil.rmtree(src, ignore_errors=True)

@@ -14,6 +14,8 @@ the recovery matrix.
 """
 from harmony_tpu.faults.plan import (
     ENV_VAR,
+    DiskFullError,
+    DiskIOError,
     FaultPlan,
     FaultRule,
     InjectedFault,
@@ -30,7 +32,9 @@ from harmony_tpu.faults.retry import (
     RetryError,
     backoff_delays,
     call_with_retry,
+    jitter_rng,
     retry_counters,
+    set_jitter_rng,
 )
 
 
@@ -43,6 +47,8 @@ def all_counters() -> dict:
 
 __all__ = [
     "ENV_VAR",
+    "DiskFullError",
+    "DiskIOError",
     "FaultPlan",
     "FaultRule",
     "InjectedFault",
@@ -56,7 +62,9 @@ __all__ = [
     "call_with_retry",
     "counters",
     "disarm",
+    "jitter_rng",
     "reset_counters",
     "retry_counters",
+    "set_jitter_rng",
     "site",
 ]

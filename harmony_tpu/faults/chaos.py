@@ -1,0 +1,816 @@
+"""Seeded chaos orchestrator: composed multi-fault schedules against a
+real control plane, with whole-system invariants checked after every
+scenario.
+
+Every recovery mechanism in this repo was proven against a single,
+hand-placed fault. Production faults arrive *composed* — a partition
+during a takeover, a full disk mid-commit, an overload storm while the
+leader dies. This module makes that composition reproducible:
+
+  * :func:`draw_schedule` — ``(seed, duration, intensity)`` -> a
+    :class:`ChaosSchedule`: a named scenario's FaultRules (drawn from
+    the full site catalog through ``random.Random(seed)``, so the same
+    seed always yields the byte-identical schedule) plus timed actions
+    (leader kill at a storm fraction). Schedules serialize through the
+    same JSON that rides ``HARMONY_FAULT_PLAN``, so they cross process
+    boundaries like any FaultPlan.
+  * :class:`ChaosOrchestrator` — runs one schedule against real acts:
+    a **control act** (real JobServer behind TCP, a tenant fleet of
+    tiny-but-real MLR jobs, optionally an HA pair with a mid-storm
+    leader kill) and a **checkpoint act** (a real table checkpointed
+    through the two-stage temp->commit path while disk rules fire).
+    After the acts drain, :mod:`harmony_tpu.faults.invariants` renders
+    the verdict; any violation carries the schedule that produced it.
+
+The orchestrator is deliberately built from the production entry
+points (CommandSender failover, HAController takeover, CheckpointManager
+commit) rather than private shims: a green scenario is evidence about
+the deployed recovery matrix, not about a test double.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from harmony_tpu.faults import invariants as _inv
+from harmony_tpu.faults.plan import FaultPlan, FaultRule
+
+#: Every registered fault site, by layer — the catalog schedules draw
+#: from (docs/FAULT_TOLERANCE.md §Fault-site registry is the prose
+#: twin; the faultsites lint keeps the two honest).
+SITE_CATALOG: Dict[str, Tuple[str, ...]] = {
+    "net": ("net.connect", "net.send"),
+    "disk": ("disk.write", "disk.fsync", "disk.read"),
+    "transport": ("blockmove.connect", "blockmove.send",
+                  "blockmove.stage_write", "blockmove.stage_read",
+                  "blockmove.exchange"),
+    "checkpoint": ("chkp.block_write", "chkp.block_read", "chkp.commit",
+                   "chkp.partial_read", "chkp.iso.serve",
+                   "chkp.iso.supervise"),
+    "pod": ("pod.heartbeat", "pod.shrink_plan", "pod.regrow",
+            "elastic.restore"),
+    "worker": ("worker.step", "worker.epoch", "worker.pull",
+               "worker.dispatch"),
+    "inputsvc": ("inputsvc.fetch", "inputsvc.worker_death"),
+    "jobserver": ("jobserver.lease_renew", "jobserver.log_append",
+                  "jobserver.takeover", "server.accept", "server.command",
+                  "server.overload"),
+}
+
+#: epochs each tenant job trains — 2 so the exactly-once tile count is
+#: non-trivial (a re-run or a skip both break it)
+JOB_EPOCHS = 2
+
+
+def tiny_job(job_id: str, num_epochs: int = JOB_EPOCHS):
+    """The tenant contract every scenario (and the unfaulted baseline)
+    shares: a 1-worker MLR job on seeded synthetic data — real
+    dispatch, deterministic loss curve."""
+    from harmony_tpu.config.params import JobConfig, TrainerParams
+
+    return JobConfig(
+        job_id=job_id, app_type="dolphin",
+        trainer="harmony_tpu.apps.mlr:MLRTrainer",
+        params=TrainerParams(
+            num_epochs=num_epochs, num_mini_batches=1,
+            app_params={"num_classes": 2, "num_features": 4,
+                        "features_per_partition": 2, "step_size": 0.5}),
+        num_workers=1,
+        user={"data_fn": "harmony_tpu.apps.mlr:make_synthetic",
+              "data_args": {"n": 16, "num_features": 4,
+                            "num_classes": 2, "seed": 7}},
+    )
+
+
+class ChaosSchedule:
+    """One reproducible fault composition: rules + timed actions."""
+
+    def __init__(self, seed: int, scenario: str, intensity: float,
+                 duration_s: float, rules: List[FaultRule],
+                 actions: Dict[str, Any]) -> None:
+        self.seed = int(seed)
+        self.scenario = scenario
+        self.intensity = float(intensity)
+        self.duration_s = float(duration_s)
+        self.rules = list(rules)
+        #: acts to run + timed events: {"acts": [...], "tenants": n,
+        #: "kill_leader_at": frac|None}
+        self.actions = dict(actions)
+
+    def plan(self, state_path: Optional[str] = None) -> FaultPlan:
+        return FaultPlan(list(self.rules), state_path=state_path)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "scenario": self.scenario,
+                "intensity": self.intensity, "duration_s": self.duration_s,
+                "rules": [r.to_dict() for r in self.rules],
+                "actions": dict(self.actions)}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ChaosSchedule":
+        return ChaosSchedule(
+            d["seed"], d["scenario"], d.get("intensity", 0.5),
+            d.get("duration_s", 10.0),
+            [FaultRule.from_dict(r) for r in d.get("rules", [])],
+            d.get("actions", {}))
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosSchedule":
+        return ChaosSchedule.from_dict(json.loads(text))
+
+
+def _n(rng: random.Random, intensity: float, lo: int, hi: int) -> int:
+    """Intensity-scaled draw in [lo, hi] — the rule-count knob."""
+    top = lo + max(0, round((hi - lo) * intensity))
+    return rng.randint(lo, max(lo, top))
+
+
+# -- the composed scenario generators ------------------------------------
+# Each takes (rng, intensity) and returns (rules, actions). Scenario
+# composition is part of the seed contract: generators must draw from
+# ``rng`` ONLY (no ambient randomness), so a seed pins the schedule.
+
+def _sc_client_partition(rng, intensity):
+    """Clients partitioned from the leader: the first k connects refuse,
+    the next j blackhole; failover/retry must land every submission."""
+    k = _n(rng, intensity, 1, 4)
+    j = _n(rng, intensity, 0, 2)
+    rules = [
+        FaultRule("net.connect", match={"role": "client"}, count=k,
+                  action="raise", exc="ConnectionRefusedError",
+                  message="partition: client->leader refused"),
+        FaultRule("net.connect", match={"role": "client"}, after=k,
+                  count=j, action="hang", delay_sec=0.3),
+    ]
+    return rules, {"acts": ["control"], "tenants": _n(rng, intensity, 3, 6)}
+
+
+def _sc_halog_torn_write(rng, intensity):
+    """A torn record lands mid-stream on the leader's log disk; the
+    append dies, the client retries, the next open truncates the tear."""
+    after = _n(rng, intensity, 1, 6)
+    rules = [
+        FaultRule("disk.write", match={"kind": "halog"}, after=after,
+                  count=1, action="corrupt"),
+    ]
+    return rules, {"acts": ["control"], "tenants": _n(rng, intensity, 3, 6)}
+
+
+def _sc_halog_enospc(rng, intensity):
+    """The log disk fills mid-storm: k submission appends raise ENOSPC.
+    An acked submission missing from the log is the violation this
+    scenario exists to catch (submit() must refuse, not swallow)."""
+    k = _n(rng, intensity, 1, 3)
+    after = _n(rng, intensity, 0, 3)
+    rules = [
+        FaultRule("jobserver.log_append", match={"kind": "submission"},
+                  after=after, count=k, action="raise",
+                  exc="DiskFullError", message="log disk full"),
+    ]
+    return rules, {"acts": ["control"], "tenants": _n(rng, intensity, 4, 8)}
+
+
+def _sc_log_slow_fsync(rng, intensity):
+    """A slow log disk: every fsync stalls. Acks slow down but nothing
+    may be lost or reordered."""
+    k = _n(rng, intensity, 2, 8)
+    rules = [
+        FaultRule("disk.fsync", match={"kind": "halog"}, count=k,
+                  action="delay", delay_sec=round(0.05 + 0.1 * intensity, 3)),
+    ]
+    return rules, {"acts": ["control"], "tenants": _n(rng, intensity, 3, 6)}
+
+
+def _sc_lease_disk_flap(rng, intensity):
+    """The shared lease store flaps EIO + slow writes under two
+    contending replicas: a holder whose renewal hits the sick store is
+    deposed (conservative, safe); the OTHER replica must take over
+    once the store heals, the file's epoch never decreasing and never
+    two valid holders at once. (Stale reads are exercised by the
+    fault-class tests, not here: an acquire-side stale read can mint a
+    second holder by design — the downstream epoch fence is the guard
+    for that, not the lease file.)"""
+    k = _n(rng, intensity, 1, 3)
+    rules = [
+        FaultRule("disk.write", match={"kind": "lease"}, count=k,
+                  action="raise", exc="DiskIOError",
+                  message="lease store EIO"),
+        FaultRule("disk.write", match={"kind": "lease"}, after=k,
+                  count=_n(rng, intensity, 0, 2), action="delay",
+                  delay_sec=0.1),
+    ]
+    return rules, {"acts": ["lease"]}
+
+
+def _sc_chkp_torn_block(rng, intensity):
+    """A block write tears on disk: the manifest checksum must catch it
+    at read time and the chain member must be unrestorable-but-loud,
+    never silently wrong."""
+    rules = [
+        FaultRule("disk.write", match={"kind": "chkp.block"},
+                  after=_n(rng, intensity, 0, 4), count=1,
+                  action="corrupt"),
+    ]
+    return rules, {"acts": ["checkpoint"], "tenants": 0}
+
+
+def _sc_chkp_bitrot_read(rng, intensity):
+    """Bit rot under a valid container: a read returns flipped bytes;
+    the manifest CRC must refuse them."""
+    rules = [
+        FaultRule("disk.read", match={"kind": "chkp.block"},
+                  after=_n(rng, intensity, 0, 4), count=1,
+                  action="corrupt"),
+    ]
+    return rules, {"acts": ["checkpoint"], "tenants": 0}
+
+
+def _sc_chkp_enospc_commit(rng, intensity):
+    """ENOSPC mid-commit (the disk-fault-during-commit case): the
+    durable landing fails, the temp copy must stay restorable, and the
+    commit retry after the disk heals must be idempotent."""
+    rules = [
+        FaultRule("disk.fsync", match={"kind": "chkp.commit"}, count=1,
+                  action="raise", exc="DiskFullError",
+                  message="commit store full"),
+    ]
+    return rules, {"acts": ["checkpoint"], "tenants": 0,
+                   "commit_retry": True}
+
+
+def _sc_partition_during_takeover(rng, intensity):
+    """The capstone composition: the leader dies mid-storm AND the
+    clients are partitioned from the survivors for the first k
+    connects, while the HA replication wire refuses j times — silence
+    detection, lease expiry and client failover all at once."""
+    k = _n(rng, intensity, 1, 4)
+    j = _n(rng, intensity, 0, 2)
+    rules = [
+        FaultRule("net.connect", match={"role": "client"}, count=k,
+                  action="raise", exc="ConnectionRefusedError",
+                  message="partition during takeover"),
+        FaultRule("net.connect", match={"role": "halog.repl"}, count=j,
+                  action="raise", exc="ConnectionRefusedError",
+                  message="replication wire partitioned"),
+    ]
+    return rules, {"acts": ["control_ha"],
+                   "tenants": _n(rng, intensity, 8, 14),
+                   "kill_leader_at": round(rng.uniform(0.3, 0.7), 2)}
+
+
+def _sc_overload_storm_leader_kill(rng, intensity):
+    """Overload storm + leader kill + slow log disk: admission control,
+    busy backoff and takeover re-arm under one schedule."""
+    rules = [
+        FaultRule("disk.fsync", match={"kind": "halog"},
+                  count=_n(rng, intensity, 1, 4), action="delay",
+                  delay_sec=round(0.05 + 0.1 * intensity, 3)),
+    ]
+    return rules, {"acts": ["control_ha"],
+                   "tenants": _n(rng, intensity, 10, 18),
+                   "kill_leader_at": round(rng.uniform(0.4, 0.6), 2)}
+
+
+def _sc_repl_partition_heal(rng, intensity):
+    """The replication stream silently drops k records mid-stream, then
+    the link RESETS and heals: the reconnect handshake's catch-up must
+    repair the gap from the leader's disk — at scenario end the standby
+    replica's own log must hold every acked submission."""
+    k = _n(rng, intensity, 1, 3)
+    rules = [
+        FaultRule("net.send", match={"role": "halog.repl"},
+                  after=_n(rng, intensity, 0, 2), count=k, action="skip"),
+        # the flapping link finally drops: the error path is what arms
+        # the reconnect catch-up that repairs the silent gap above
+        FaultRule("net.send", match={"role": "halog.repl"}, count=1,
+                  action="raise", exc="ConnectionError",
+                  message="replication link reset"),
+    ]
+    return rules, {"acts": ["control"], "replicate": True,
+                   "tenants": _n(rng, intensity, 3, 6)}
+
+
+SCENARIOS: Dict[str, Callable[[random.Random, float],
+                              Tuple[List[FaultRule], Dict[str, Any]]]] = {
+    "client_partition": _sc_client_partition,
+    "halog_torn_write": _sc_halog_torn_write,
+    "halog_enospc": _sc_halog_enospc,
+    "log_slow_fsync": _sc_log_slow_fsync,
+    "lease_disk_flap": _sc_lease_disk_flap,
+    "chkp_torn_block": _sc_chkp_torn_block,
+    "chkp_bitrot_read": _sc_chkp_bitrot_read,
+    "chkp_enospc_commit": _sc_chkp_enospc_commit,
+    "partition_during_takeover": _sc_partition_during_takeover,
+    "overload_storm_leader_kill": _sc_overload_storm_leader_kill,
+    "repl_partition_heal": _sc_repl_partition_heal,
+}
+
+#: scenarios that boot an HA pair and kill a leader (slow; the smoke
+#: tier sticks to the others)
+HA_SCENARIOS = ("partition_during_takeover", "overload_storm_leader_kill")
+
+
+def draw_schedule(seed: int, duration_s: float = 10.0,
+                  intensity: float = 0.5,
+                  scenario: Optional[str] = None) -> ChaosSchedule:
+    """The seed contract: ``draw_schedule(s, d, i)`` is a pure function
+    of its arguments — same seed, same schedule, byte for byte."""
+    rng = random.Random(int(seed))
+    names = sorted(SCENARIOS)
+    name = scenario if scenario is not None else rng.choice(names)
+    if name not in SCENARIOS:
+        raise ValueError(f"unknown chaos scenario {name!r} "
+                         f"(catalog: {names})")
+    rules, actions = SCENARIOS[name](rng, float(intensity))
+    return ChaosSchedule(seed, name, intensity, duration_s, rules, actions)
+
+
+# -- the unfaulted baseline (loss-parity reference) -----------------------
+
+_baseline_lock = threading.Lock()
+_baseline_cache: Dict[int, Dict[str, List[float]]] = {}
+
+
+def baseline_losses(num_epochs: int = JOB_EPOCHS) -> Dict[str, List[float]]:
+    """Loss curves of ONE unfaulted run of the tenant contract, keyed
+    by worker suffix ("w0"). Cached per epoch count: every scenario in
+    a sweep compares against the same reference run."""
+    with _baseline_lock:
+        cached = _baseline_cache.get(num_epochs)
+    if cached is not None:
+        return cached
+    from harmony_tpu.jobserver.server import JobServer
+
+    server = JobServer(num_executors=2)
+    try:
+        server.start()
+        fut = server.submit(tiny_job("baseline", num_epochs=num_epochs))
+        result = fut.result(timeout=300)
+    finally:
+        try:
+            server.shutdown(timeout=60.0)
+        except Exception:
+            pass
+    out = {wid.rsplit("/", 1)[-1]: losses
+           for wid, losses in _inv._job_losses(result).items()}
+    with _baseline_lock:
+        _baseline_cache[num_epochs] = out
+    return out
+
+
+# -- the orchestrator -----------------------------------------------------
+
+#: env pinned for every act: bounded client patience, small command
+#: plane — scenario wall time stays test-sized
+ACT_ENV = {
+    "HARMONY_RETRY_BASE_DELAY": "0.05",
+    "HARMONY_RETRY_MAX_ATTEMPTS": "12",
+    "HARMONY_CMD_WORKERS": "4",
+    "HARMONY_OVERLOAD_INFLIGHT": "4096",
+}
+
+
+class ChaosOrchestrator:
+    """Run one :class:`ChaosSchedule` end to end and return the report:
+    acts run, fault fires, recovery timings, and the invariant verdict
+    (violations carry the schedule)."""
+
+    def __init__(self, schedule: ChaosSchedule, workdir: str,
+                 client_timeout: float = 6.0) -> None:
+        self.schedule = schedule
+        self.workdir = workdir
+        self.client_timeout = client_timeout
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- acts -------------------------------------------------------------
+
+    def _arm(self) -> None:
+        from harmony_tpu import faults
+
+        faults.reset_counters()
+        plan = self.schedule.plan(
+            state_path=os.path.join(self.workdir, "fault_state.json"))
+        faults.arm(plan, propagate=True)
+
+    def _run_control(self, ha: bool) -> Dict[str, Any]:
+        """The control act: a real JobServer behind TCP (an HA pair when
+        ``ha``), a tenant storm through the failover client, an optional
+        mid-storm leader kill, then drain + invariants."""
+        from harmony_tpu import faults
+        from harmony_tpu.jobserver import joblog
+        from harmony_tpu.jobserver.client import CommandSender
+        from harmony_tpu.jobserver.halog import DurableJobLog
+        from harmony_tpu.jobserver.server import JobServer
+
+        sched = self.schedule
+        tenants = int(sched.actions.get("tenants") or 3)
+        kill_at = sched.actions.get("kill_leader_at")
+        log_path = os.path.join(self.workdir, "halog.log")
+        joblog.clear_events()
+        report: Dict[str, Any] = {"act": "control_ha" if ha else "control",
+                                  "tenants": tenants}
+        baseline = baseline_losses()
+
+        a = b = None
+        server = None
+        log = standby_log = receiver = replicator = None
+        t_kill = t_takeover = None
+        try:
+            if ha:
+                from harmony_tpu.jobserver.ha import HAController
+
+                ha_dir = os.path.join(self.workdir, "ha")
+                a = HAController(lambda: JobServer(num_executors=2),
+                                 log_dir=ha_dir, replica_id="rep-a",
+                                 submit_port=0, lease_s=2.5).start()
+                assert a.wait_leader(30), "no leader within 30s"
+                addrs = [f"127.0.0.1:{a.port}"]
+                log_path = a.server.ha_log.path
+            else:
+                server = JobServer(num_executors=2)
+                log = DurableJobLog(log_path)
+                server.enable_ha(log)  # durable submissions, no lease
+                server.start()
+                port = server.serve_tcp()
+                addrs = [f"127.0.0.1:{port}"]
+                if sched.actions.get("replicate"):
+                    # a real standby replica: its OWN local log fed by
+                    # the leader's stream — the partition-heal verdict
+                    # is judged against THIS copy, not the leader's
+                    from harmony_tpu.jobserver.halog import (LogReceiver,
+                                                             LogReplicator)
+
+                    standby_log = DurableJobLog(
+                        os.path.join(self.workdir, "standby.log"))
+                    receiver = LogReceiver(standby_log)
+                    rport = receiver.start()
+                    replicator = LogReplicator(log,
+                                               [f"127.0.0.1:{rport}"])
+                    replicator.start()
+
+            # the faults arm AFTER boot: scenarios fault the steady
+            # state, not the bring-up (bring-up chaos is the HA kill)
+            self._arm()
+            t0 = time.monotonic()
+            acked: Dict[str, float] = {}
+            errors: List[str] = []
+            lock = threading.Lock()
+            extra_addr: List[str] = []
+
+            def submitter(i: int) -> None:
+                jid = f"c{i:03d}"
+                sender = CommandSender(addrs=addrs + extra_addr,
+                                       timeout=self.client_timeout)
+                t_s = time.monotonic()
+                try:
+                    r = sender.send_job_submit_command(tiny_job(jid))
+                except Exception as e:
+                    with lock:
+                        errors.append(f"{jid}: {type(e).__name__}")
+                    return
+                with lock:
+                    if r.get("ok"):
+                        acked[jid] = time.monotonic() - t_s
+                    else:
+                        errors.append(f"{jid}: refused")
+
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        daemon=True)
+                       for i in range(tenants)]
+            kill_idx = (int(tenants * float(kill_at))
+                        if (ha and kill_at is not None) else None)
+            for i, t in enumerate(threads):
+                t.start()
+                if kill_idx is not None and i == kill_idx:
+                    t_kill = time.monotonic()
+                    a.server._stop_tcp()
+                    a.lease.stop()
+                    b = HAController(
+                        lambda: JobServer(num_executors=2),
+                        log_dir=os.path.join(self.workdir, "ha"),
+                        replica_id="rep-b", submit_port=0,
+                        lease_s=2.5).start()
+                    extra_addr.append(f"127.0.0.1:{b.port}")
+            if b is not None:
+                assert b.wait_leader(60), "takeover did not complete"
+                t_takeover = time.monotonic() - t_kill
+                log_path = b.server.ha_log.path
+            for t in threads:
+                t.join(timeout=120)
+            report["wedged_clients"] = sum(1 for t in threads
+                                           if t.is_alive())
+            report["acked"] = len(acked)
+            report["errors"] = len(errors)
+            report["error_sample"] = errors[:4]
+
+            # drain: every acked submission must resolve exactly once
+            results: Dict[str, Dict[str, Any]] = {}
+            unresolved: List[str] = []
+            if ha:
+                final = b if b is not None else a
+                sender = CommandSender(addrs=[f"127.0.0.1:{final.port}"],
+                                       timeout=self.client_timeout)
+                for jid in sorted(acked):
+                    try:
+                        results[jid] = sender.wait_result(jid,
+                                                          timeout=180.0)
+                    except Exception:
+                        unresolved.append(jid)
+            else:
+                for jid in sorted(acked):
+                    fut = server._jobs.get(jid)
+                    try:
+                        results[jid] = fut.future.result(timeout=180) \
+                            if fut else {}
+                        if fut is None:
+                            unresolved.append(jid)
+                    except Exception:
+                        unresolved.append(jid)
+            resolve_s = time.monotonic() - t0
+            report["unresolved"] = unresolved
+            report["resolve_s"] = round(resolve_s, 2)
+            if t_takeover is not None:
+                report["takeover_s"] = round(t_takeover, 2)
+
+            # faults must be quiet before the verdict: invariants judge
+            # the healed end state, not the storm
+            faults.disarm()
+            if replicator is not None:
+                # the healed link reconnects: the fresh handshake reads
+                # the standby's last_seq and streams the missing suffix
+                # from the leader's disk — the documented gap repair
+                from harmony_tpu.jobserver.halog import LogReplicator
+
+                replicator.stop()
+                replicator = LogReplicator(log,
+                                           list(replicator.peers))
+                replicator.start()
+                deadline = time.monotonic() + 15.0
+                while (standby_log.last_seq < log.last_seq
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                report["standby_caught_up"] = (
+                    standby_log.last_seq >= log.last_seq)
+                log_path = standby_log.path  # judge the REPLICA's copy
+            live = (b.server if b is not None else
+                    (a.server if a is not None else server))
+            history = getattr(live, "history", None)
+            verdict = _inv.check_all(
+                results={j: r for j, r in results.items()
+                         if isinstance(r, dict)},
+                num_epochs=JOB_EPOCHS,
+                acked=sorted(acked), log_path=log_path,
+                baseline=baseline, server=live, history=history,
+                schedule=self.schedule)
+            # an acked job that never resolved is itself a violation,
+            # whatever the log says
+            if unresolved:
+                verdict["ok"] = False
+                verdict["violations"].append("acked_resolved")
+                verdict["findings"].append(_inv._finding(
+                    "acked_resolved", False,
+                    {"unresolved": unresolved,
+                     "schedule": self.schedule.to_dict()}))
+            report["invariants"] = verdict
+            report["fault_fires"] = faults.counters()
+            return report
+        finally:
+            faults.disarm()
+            stop_fns = []
+            if replicator is not None:
+                stop_fns.append(replicator.stop)
+            if receiver is not None:
+                stop_fns.append(receiver.stop)
+            if b is not None:
+                stop_fns.append(lambda: b.stop(shutdown_timeout=30.0))
+            if a is not None:
+                stop_fns.append(lambda: a.stop(shutdown_timeout=30.0))
+            if server is not None:
+                stop_fns.append(lambda: server.shutdown(timeout=30.0))
+            if log is not None:
+                stop_fns.append(log.close)
+            if standby_log is not None:
+                stop_fns.append(standby_log.close)
+            stopper = threading.Thread(
+                target=lambda: [f() for f in stop_fns], daemon=True)
+            stopper.start()
+            stopper.join(timeout=90)
+            joblog.clear_events()
+
+    def _run_lease(self) -> Dict[str, Any]:
+        """The lease act: two replicas contending on one lease store
+        while the schedule's disk rules fire. Invariants: never two
+        valid holders at once, the file's epoch never decreases, and
+        once the store heals SOME replica holds a valid lease (a
+        takeover by the standby counts — a holder deposed by a sick
+        store is the safe outcome, not a violation)."""
+        from harmony_tpu import faults
+        from harmony_tpu.jobserver.lease import LeaseManager, read_lease
+
+        lease_dir = os.path.join(self.workdir, "lease")
+        os.makedirs(lease_dir, exist_ok=True)
+        report: Dict[str, Any] = {"act": "lease"}
+        a = LeaseManager(lease_dir, "rep-a", lease_s=1.0)
+        b = LeaseManager(lease_dir, "rep-b", lease_s=1.0)
+        self._arm()
+        t0 = time.monotonic()
+        double_holder = 0
+        epochs: List[int] = []
+        try:
+            acq = threading.Thread(
+                target=lambda: a.wait_acquire(timeout=10.0) and
+                a.start_renewal(), daemon=True)
+            standby = threading.Thread(
+                target=lambda: b.wait_acquire(timeout=20.0) and
+                b.start_renewal(), daemon=True)
+            acq.start()
+            standby.start()
+            storm_end = t0 + max(2.5, self.schedule.duration_s / 4.0)
+            while time.monotonic() < storm_end:
+                if a.is_valid() and b.is_valid():
+                    double_holder += 1
+                cur = read_lease(lease_dir)
+                if cur is not None:
+                    epochs.append(int(cur.get("epoch", 0)))
+                time.sleep(0.02)
+            faults.disarm()  # the store heals
+            # post-heal: within a few lease windows someone must hold
+            healed_by = None
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if a.is_valid() or b.is_valid():
+                    healed_by = "rep-a" if a.is_valid() else "rep-b"
+                    break
+                time.sleep(0.05)
+        finally:
+            faults.disarm()
+            for m in (a, b):
+                try:
+                    m.release()
+                except Exception:
+                    pass
+        mono_ok = all(x <= y for x, y in zip(epochs, epochs[1:]))
+        findings = [
+            _inv._finding("single_leaseholder", double_holder == 0,
+                          f"{double_holder} dual-holder sample(s)"),
+            _inv._finding("epoch_monotonic", mono_ok,
+                          f"observed epochs {sorted(set(epochs))}"),
+            _inv._finding("leaseholder_after_heal", healed_by is not None,
+                          healed_by or "no valid holder 5s after heal"),
+        ]
+        violations = [f for f in findings if not f["ok"]]
+        for f in violations:
+            f["schedule"] = self.schedule.to_dict()
+        report["invariants"] = {
+            "ok": not violations,
+            "checked": [f["name"] for f in findings],
+            "findings": findings,
+            "violations": [f["name"] for f in violations]}
+        report["holder_after_heal"] = healed_by
+        report["renewals"] = {"rep-a": a.renewals, "rep-b": b.renewals}
+        report["renew_failures"] = {"rep-a": a.renew_failures,
+                                    "rep-b": b.renew_failures}
+        report["resolve_s"] = round(time.monotonic() - t0, 2)
+        report["fault_fires"] = faults.counters()
+        return report
+
+    def _run_checkpoint(self) -> Dict[str, Any]:
+        """The checkpoint act: a real table through the two-stage
+        temp->commit path while the schedule's disk rules fire; chain
+        integrity (and commit idempotence after ENOSPC) is the verdict."""
+        import jax
+        import numpy as np
+
+        from harmony_tpu import faults
+        from harmony_tpu.checkpoint.manager import (CheckpointCorruptError,
+                                                    CheckpointManager)
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime import ETMaster
+
+        sched = self.schedule
+        chkp_root = os.path.join(self.workdir, "chkp")
+        report: Dict[str, Any] = {"act": "checkpoint"}
+        n_exec = min(2, len(jax.devices()))
+        master = ETMaster(DevicePool(jax.devices()[:n_exec]))
+        exs = master.add_executors(n_exec)
+        cfg = TableConfig(table_id="chaos-t", capacity=32,
+                          value_shape=(2,), num_blocks=8)
+        h = master.create_table(cfg, [e.id for e in exs])
+        vals = (np.arange(32, dtype=np.float32)[:, None]
+                * np.ones((2,), np.float32))
+        h.table.multi_update(list(range(32)), vals)
+        mgr = CheckpointManager.for_job(chkp_root, "chaos")
+        self._arm()
+        t0 = time.monotonic()
+        wrote: List[str] = []
+        caught: List[str] = []
+        try:
+            for i in range(2):
+                try:
+                    cid = mgr.checkpoint(h)
+                    wrote.append(cid)
+                except (OSError, CheckpointCorruptError) as e:
+                    caught.append(f"checkpoint[{i}]: {type(e).__name__}")
+                    continue
+                try:
+                    mgr.commit(cid)
+                except OSError as e:
+                    caught.append(f"commit[{i}]: {type(e).__name__}")
+                    if sched.actions.get("commit_retry"):
+                        # the disk healed (the rule's count ran out):
+                        # commit must be idempotent and succeed now,
+                        # with the temp copy still intact
+                        mgr.commit(cid)
+                        report["commit_retry_ok"] = True
+            # read every member back through the manifest-CRC path; a
+            # corrupt member must be LOUD (CheckpointCorruptError), and
+            # a loud member quarantines out of the restorable namespace
+            quarantined = []
+            for cid in list(mgr.list_checkpoints()):
+                try:
+                    mgr.restore(master, cid,
+                                [e.id for e in exs][:1],
+                                table_id=f"r-{cid[-6:]}")
+                except CheckpointCorruptError:
+                    mgr.quarantine(cid)
+                    quarantined.append(cid)
+                except FileNotFoundError:
+                    pass
+            report["quarantined"] = quarantined
+        finally:
+            faults.disarm()
+        report["wrote"] = wrote
+        report["faults_caught"] = caught
+        report["resolve_s"] = round(time.monotonic() - t0, 2)
+        verdict = _inv.check_all(chkp_root=chkp_root,
+                                 schedule=self.schedule)
+        report["invariants"] = verdict
+        report["fault_fires"] = faults.counters()
+        return report
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        """Run every act the schedule names; the scenario verdict is the
+        AND of the act verdicts."""
+        from harmony_tpu import faults
+        from harmony_tpu.faults.retry import set_jitter_rng
+
+        saved_env = {k: os.environ.get(k) for k in ACT_ENV}
+        os.environ.update(ACT_ENV)
+        # seeded jitter: chaos replays get identical retry timing
+        prev_rng = set_jitter_rng(random.Random(self.schedule.seed))
+        t0 = time.monotonic()
+        acts: List[Dict[str, Any]] = []
+        try:
+            for act in self.schedule.actions.get("acts", ["control"]):
+                if act == "control":
+                    acts.append(self._run_control(ha=False))
+                elif act == "control_ha":
+                    acts.append(self._run_control(ha=True))
+                elif act == "checkpoint":
+                    acts.append(self._run_checkpoint())
+                elif act == "lease":
+                    acts.append(self._run_lease())
+                else:
+                    raise ValueError(f"unknown chaos act {act!r}")
+        finally:
+            set_jitter_rng(prev_rng)
+            faults.disarm()
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        ok = all(a.get("invariants", {}).get("ok", False) for a in acts)
+        violations = sorted({v for a in acts
+                             for v in a.get("invariants", {})
+                             .get("violations", [])})
+        return {"scenario": self.schedule.scenario,
+                "seed": self.schedule.seed,
+                "intensity": self.schedule.intensity,
+                "ok": ok, "violations": violations,
+                "acts": acts,
+                "wall_s": round(time.monotonic() - t0, 2),
+                "schedule": self.schedule.to_dict()}
+
+
+def run_scenario(seed: int, duration_s: float = 10.0,
+                 intensity: float = 0.5, scenario: Optional[str] = None,
+                 workdir: Optional[str] = None) -> Dict[str, Any]:
+    """Draw + run one seeded scenario (the bin/chaos.sh entry)."""
+    import tempfile
+
+    sched = draw_schedule(seed, duration_s, intensity, scenario)
+    if workdir is not None:
+        return ChaosOrchestrator(sched, workdir).run()
+    with tempfile.TemporaryDirectory(prefix="harmony-chaos-") as td:
+        return ChaosOrchestrator(sched, td).run()

@@ -47,6 +47,24 @@ class InjectedFault(OSError):
     failures and must be caught by the same handlers."""
 
 
+class DiskFullError(OSError):
+    """Injected ENOSPC. Carries the real errno so ``e.errno ==
+    errno.ENOSPC`` checks in IO handlers behave exactly as they would
+    against a genuinely full disk."""
+
+    def __init__(self, *args: Any) -> None:
+        import errno as _errno
+        super().__init__(_errno.ENOSPC, *(args or ("injected ENOSPC",)))
+
+
+class DiskIOError(OSError):
+    """Injected EIO — a failing device/sector, with the real errno set."""
+
+    def __init__(self, *args: Any) -> None:
+        import errno as _errno
+        super().__init__(_errno.EIO, *(args or ("injected EIO",)))
+
+
 # name -> exception class for FaultRule.exc (a closed registry: the plan
 # crosses process boundaries as JSON, so arbitrary dotted paths would be
 # an eval-from-env hazard)
@@ -56,9 +74,12 @@ _EXC_TYPES: Dict[str, type] = {
     "IOError": IOError,
     "ConnectionError": ConnectionError,
     "ConnectionResetError": ConnectionResetError,
+    "ConnectionRefusedError": ConnectionRefusedError,
     "TimeoutError": TimeoutError,
     "RuntimeError": RuntimeError,
     "ValueError": ValueError,
+    "DiskFullError": DiskFullError,
+    "DiskIOError": DiskIOError,
 }
 
 _ACTIONS = ("raise", "crash", "hang", "delay", "skip", "corrupt", "spew")

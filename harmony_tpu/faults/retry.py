@@ -27,6 +27,27 @@ T = TypeVar("T")
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 
+# The jitter source for every backoff pause. Module-level and swappable
+# so chaos replays can pin it: same seed -> byte-identical retry timing
+# across a whole run (the client's busy-backoff uses this too).
+_DEFAULT_RNG: random.Random = getattr(random, "_inst", None) or random.Random()
+_jitter_rng: random.Random = _DEFAULT_RNG
+
+
+def set_jitter_rng(rng: Optional[random.Random]) -> random.Random:
+    """Install ``rng`` as the backoff-jitter source (None restores the
+    process default). Returns the previous source so tests can swap it
+    back."""
+    global _jitter_rng
+    prev = _jitter_rng
+    _jitter_rng = rng if rng is not None else _DEFAULT_RNG
+    return prev
+
+
+def jitter_rng() -> random.Random:
+    """The current backoff-jitter source (see :func:`set_jitter_rng`)."""
+    return _jitter_rng
+
 
 def _count(key: str, n: int = 1) -> None:
     with _lock:
@@ -130,7 +151,7 @@ def call_with_retry(
             if on_retry is not None:
                 on_retry(attempt, e)
             pause = min(delay, policy.max_delay_sec)
-            pause *= 1.0 + policy.jitter * random.random()
+            pause *= 1.0 + policy.jitter * _jitter_rng.random()
             if deadline is not None:
                 pause = min(pause, max(0.0, deadline - time.monotonic()))
             sleep(pause)

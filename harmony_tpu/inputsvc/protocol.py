@@ -52,7 +52,9 @@ class ProtocolError(OSError):
 
 
 def connect(addr: Tuple[str, int], timeout: float = 10.0) -> socket.socket:
-    sock = socket.create_connection(addr, timeout=timeout)
+    from harmony_tpu.faults.partition import fault_connect
+
+    sock = fault_connect(addr, role="inputsvc", timeout=timeout)
     set_nodelay(sock)
     return sock
 
@@ -64,7 +66,7 @@ def _head(header: Dict[str, Any]) -> bytes:
 
 def send_msg(sock: socket.socket, header: Dict[str, Any]) -> None:
     """One control frame (header only), one write."""
-    send_frame_parts(sock, _head(header), ())
+    send_frame_parts(sock, _head(header), (), role="inputsvc")
 
 
 def _array_meta(arr: np.ndarray) -> Tuple[Dict[str, Any], Any]:
@@ -93,7 +95,7 @@ def send_batch(sock: socket.socket, batch_idx: int,
         metas.append(meta)
         bodies.append(body)
     head = _head({"op": "batch", "b": int(batch_idx), "arrays": metas})
-    send_frame_parts(sock, head, bodies)
+    send_frame_parts(sock, head, bodies, role="inputsvc")
 
 
 def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:

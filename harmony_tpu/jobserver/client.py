@@ -103,8 +103,10 @@ class CommandSender:
 
     def _roundtrip_one(self, addr: str,
                        payload: Dict[str, Any]) -> Dict[str, Any]:
-        with socket.create_connection(_parse_addr(addr),
-                                      timeout=self.timeout) as s:
+        from harmony_tpu.faults.partition import fault_connect
+
+        with fault_connect(_parse_addr(addr), role="client",
+                           timeout=self.timeout) as s:
             s.sendall((json.dumps(payload) + "\n").encode())
             data = b""
             while not data.endswith(b"\n"):
@@ -195,11 +197,10 @@ class CommandSender:
         :meth:`_roundtrip_route`. Bounded by the standard retry
         policy: a persistently-overloaded control plane surfaces as a
         RetryError instead of an infinite client spin."""
-        import random as _random
         import time as _time
 
         from harmony_tpu.config.params import RetryPolicy
-        from harmony_tpu.faults.retry import call_with_retry
+        from harmony_tpu.faults.retry import call_with_retry, jitter_rng
 
         policy = RetryPolicy.from_env()
         hint_ms = [0]
@@ -212,8 +213,10 @@ class CommandSender:
                 raise
 
         def pause(delay: float) -> None:
+            # jitter_rng: the swappable source faults.retry uses for its
+            # own backoff, so a seeded chaos replay pins BOTH schedules
             floor = (hint_ms[0] / 1000.0) * (
-                1.0 + policy.jitter * _random.random())
+                1.0 + policy.jitter * jitter_rng().random())
             _time.sleep(max(delay, floor))
 
         return call_with_retry(

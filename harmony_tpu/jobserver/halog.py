@@ -46,6 +46,7 @@ import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from harmony_tpu import faults
 from harmony_tpu.jobserver.joblog import server_log
 from harmony_tpu.utils.framing import read_exact, send_frame_parts, set_nodelay
 
@@ -154,6 +155,18 @@ class DurableJobLog:
         #: fsync syscalls actually issued — appends/group_commits is the
         #: burst batching factor
         self.group_commits = 0
+        # HARMONY_LOG_BATCH_MS: optional coalescing window. A committer
+        # that wins ``_commit_lock`` sleeps this long BEFORE the fsync so
+        # burst writers pile into one syscall even when their appends are
+        # microseconds apart-but-serial. 0 (default) = commit immediately
+        # (contention-only batching, the original behavior).
+        try:
+            self._batch_s = max(
+                0.0, float(os.environ.get("HARMONY_LOG_BATCH_MS", "0")
+                           or 0.0)) / 1000.0
+        except ValueError:
+            self._batch_s = 0.0
+        self._closed = False
 
     # -- write side ------------------------------------------------------
 
@@ -182,19 +195,56 @@ class DurableJobLog:
             if ep < self.fence_epoch:
                 raise StaleEpochError(ep, self.fence_epoch)
             self.fence_epoch = ep
+            prev_seq = self._seq
             self._seq = int(seq) if seq is not None \
                 else self._seq + 1
             entry = {"seq": self._seq, "epoch": ep, "ts": time.time(),
                      "kind": kind, "job": job_id, **fields}
-            if faults.armed():
-                # "raise" here models a failing log disk; "delay" a slow
-                # fsync — both surface to the caller like the real fault
-                faults.site("jobserver.log_append", kind=kind,
-                            seq=self._seq)
-            payload = json.dumps(entry, sort_keys=True,
-                                 default=repr).encode()
-            rec = encode_record(payload)
-            self._f.write(rec)
+            # tail-repair bracket: flush so the buffer is empty, note
+            # the durable size, and on ANY write failure truncate back
+            # to it. Without this a torn append (partial write + EIO)
+            # leaves half a record mid-stream and every LATER append
+            # lands beyond the tear — scan_records() stops at the first
+            # bad header, so acked-and-fsynced entries behind it become
+            # unreplayable. (Found by the chaos sweep's
+            # halog_torn_write schedule: 3 acked submissions vanished
+            # from replay behind one torn record.)
+            self._f.flush()
+            good_off = os.fstat(self._f.fileno()).st_size
+            try:
+                if faults.armed():
+                    # "raise" here models a failing log disk; "delay" a
+                    # slow fsync — both surface like the real fault
+                    faults.site("jobserver.log_append", kind=kind,
+                                seq=self._seq)
+                payload = json.dumps(entry, sort_keys=True,
+                                     default=repr).encode()
+                rec = encode_record(payload)
+                if faults.armed():
+                    # disk fault class: ENOSPC/EIO raise here; "corrupt"
+                    # is a torn write — a prefix of the record reaches
+                    # the platter and the append dies
+                    act = faults.site("disk.write", kind="halog",
+                                      seq=self._seq)
+                    if act == "corrupt":
+                        self._f.write(rec[:max(1, len(rec) // 2)])
+                        self._f.flush()
+                        raise faults.DiskIOError(
+                            f"injected torn halog write [seq={self._seq}]")
+                self._f.write(rec)
+            except Exception:
+                self._seq = prev_seq
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass  # the partial bytes may not even flush — the
+                #         truncate below repairs whatever landed
+                try:
+                    os.ftruncate(self._f.fileno(), good_off)
+                except OSError:
+                    pass  # repair failed too: the reopen-time
+                #         scan_records() truncation is the backstop
+                raise
             self._wrote_n += 1
             token = self._wrote_n
             self._pending.append((token, entry, rec))
@@ -218,13 +268,27 @@ class DurableJobLog:
         without a syscall — that is the whole burst win."""
         with self._commit_lock:
             with self._lock:
-                if self._durable_n >= token:
+                if self._durable_n >= token or self._closed:
                     return  # covered (and sunk) by an earlier committer
+                batch_s = self._batch_s
+            if batch_s > 0.0:
+                # coalescing window: let trailing writers land before the
+                # one fsync covers them all
+                time.sleep(batch_s)
+            with self._lock:
+                if self._durable_n >= token or self._closed:
+                    return  # close() drained the tail while we slept
                 self._f.flush()
                 top = self._wrote_n
                 sinks = list(self._sinks)
             if self._fsync:
-                os.fsync(self._f.fileno())
+                if faults.armed():
+                    # slow fsync (delay), EIO (raise), or a lying disk
+                    # that never syncs ("skip" — the power-loss hole)
+                    if faults.site("disk.fsync", kind="halog") != "skip":
+                        os.fsync(self._f.fileno())
+                else:
+                    os.fsync(self._f.fileno())
             self.group_commits += 1
             with self._lock:
                 self._durable_n = top
@@ -283,10 +347,20 @@ class DurableJobLog:
             }
 
     def close(self) -> None:
-        # one final commit so nothing written stays un-fsynced: close
-        # may race a burst's covered writers that already returned
+        # One final commit so nothing written stays un-fsynced: close
+        # may race a burst's covered writers that already returned. The
+        # pending tail must ALSO reach the sinks — a stop() landing
+        # inside the HARMONY_LOG_BATCH_MS coalescing window used to
+        # drop the entries whose sleeping committer never woke to
+        # deliver them (the standby then missed the run's last acks).
         with self._commit_lock:
             with self._lock:
+                self._closed = True
+                sinks = list(self._sinks)
+                batch = list(self._pending)
+                self._pending.clear()
+                if batch:
+                    self._durable_n = max(self._durable_n, batch[-1][0])
                 try:
                     self._f.flush()
                     if self._fsync:
@@ -297,6 +371,14 @@ class DurableJobLog:
                     self._f.close()
                 except OSError:
                     pass
+            # sink delivery outside the write lock, same discipline as
+            # _commit (the replicator sink takes its own cond)
+            for _tok, entry, rec in batch:
+                for sink in sinks:
+                    try:
+                        sink(entry, rec)
+                    except Exception:
+                        pass
 
 
 # -- replication ------------------------------------------------------------
@@ -304,7 +386,8 @@ class DurableJobLog:
 
 def _send_record(sock: socket.socket, payload: bytes) -> None:
     send_frame_parts(
-        sock, _HEADER.pack(len(payload), zlib.crc32(payload)), [payload])
+        sock, _HEADER.pack(len(payload), zlib.crc32(payload)), [payload],
+        role="halog.repl")
 
 
 def _recv_record(sock: socket.socket) -> Optional[bytes]:
@@ -381,8 +464,10 @@ class LogReplicator:
         delay = 0.2
         while not self._stop.is_set():
             try:
-                with socket.create_connection(
-                        (host or "127.0.0.1", int(port)),
+                from harmony_tpu.faults.partition import fault_connect
+
+                with fault_connect(
+                        (host or "127.0.0.1", int(port)), role="halog.repl",
                         timeout=self._connect_timeout) as sock:
                     set_nodelay(sock)
                     sock.settimeout(30.0)
@@ -420,6 +505,12 @@ class LogReplicator:
                             batch = self._queues[peer][:]
                             self._queues[peer].clear()
                         for rec in batch:
+                            if faults.armed():
+                                from harmony_tpu.faults.partition import (
+                                    frame_dropped)
+
+                                if frame_dropped(sock, role="halog.repl"):
+                                    continue
                             sock.sendall(rec)
                         if batch:
                             # read the log's seq BEFORE taking the cond:

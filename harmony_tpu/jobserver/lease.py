@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from harmony_tpu.jobserver.joblog import server_log
+from harmony_tpu.utils.durability import fsync_dir
 
 #: operational knobs (docs/DEPLOY.md §7)
 ENV_LOG_DIR = "HARMONY_HA_LOG_DIR"
@@ -71,6 +72,17 @@ def read_lease(log_dir: str) -> Optional[Dict[str, Any]]:
     """Read the current lease file (None when absent/unparseable) —
     the shared helper behind every leader-hint lookup (standby
     NOT_LEADER replies, a deposed server's redirect)."""
+    from harmony_tpu import faults
+
+    if faults.armed():
+        # stale read: "skip" models a crashed-before-dir-fsync store
+        # where the file's directory entry never became visible; EIO
+        # raise rules land in the same except arm a sick disk would
+        try:
+            if faults.site("disk.read", kind="lease") == "skip":
+                return None
+        except OSError:
+            return None
     try:
         with open(os.path.join(log_dir, LEASE_FILENAME)) as f:
             return json.load(f)
@@ -137,11 +149,22 @@ class LeaseManager:
         return read_lease(os.path.dirname(self.path))
 
     def _write(self, lease: Dict[str, Any]) -> None:
+        from harmony_tpu import faults
+
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
+            if faults.armed():
+                # disk fault class at the lease store: ENOSPC/EIO raise
+                # (try_acquire treats the store as unreachable), "delay"
+                # is a slow shared mount, "skip" drops the fsync
+                act = faults.site("disk.write", kind="lease",
+                                  holder=self.holder_id)
+            else:
+                act = None
             json.dump(lease, f)
             f.flush()
-            os.fsync(f.fileno())
+            if act != "skip":
+                os.fsync(f.fileno())
         os.replace(tmp, self.path)
 
     # -- the protocol ----------------------------------------------------
@@ -162,6 +185,14 @@ class LeaseManager:
             self._write({"holder": self.holder_id, "epoch": epoch,
                          "addr": self.addr,
                          "expires": now + self.lease_s, "acquired": now})
+            if cur is None:
+                # first-ever acquire CREATED the lease file: the bytes
+                # are fsync'd by _write, but the directory entry is not
+                # durable until the parent dir is synced — without this
+                # a host crash can resurrect an empty HA dir and epoch 1
+                # gets minted twice (the same rename/create contract the
+                # halog's append-only stream gets for free)
+                fsync_dir(self.path)
             if not same:
                 self.previous = cur
             with self._lock:
@@ -170,7 +201,13 @@ class LeaseManager:
                 self._renewed_mono = time.monotonic()
             return True
 
-        return bool(self._locked(attempt))
+        try:
+            return bool(self._locked(attempt))
+        except OSError:
+            # the lease store is sick (ENOSPC/EIO/unreachable mount):
+            # this attempt simply fails — wait_acquire keeps polling and
+            # the election resumes when the store heals
+            return False
 
     def wait_acquire(self, timeout: Optional[float] = None,
                      poll: Optional[float] = None) -> bool:

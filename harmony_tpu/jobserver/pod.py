@@ -1835,10 +1835,12 @@ class PodFollower:
         # The leader may still be initializing its runtime when followers
         # come up (hosts boot in any order): retry until the deadline.
         deadline = time.monotonic() + join_timeout
+        from harmony_tpu.faults.partition import fault_connect
+
         while True:
             try:
-                self._sock = socket.create_connection(
-                    (leader_host, pod_port), timeout=10.0
+                self._sock = fault_connect(
+                    (leader_host, pod_port), role="pod.join", timeout=10.0
                 )
                 break
             except OSError:
@@ -1929,6 +1931,16 @@ class PodFollower:
 
     def _report(self, payload: Dict[str, Any]) -> None:
         with self._send_lock:
+            from harmony_tpu import faults
+
+            if faults.armed():
+                from harmony_tpu.faults.partition import frame_dropped
+
+                # follower->leader link rule: an asymmetric partition
+                # silences reports/heartbeats while leader->follower
+                # commands still flow (the half-open link case)
+                if frame_dropped(self._sock, role="pod.report"):
+                    return
             _send(self._sock, payload)
 
     def _reject_stale(self, msg: Dict[str, Any], epoch: int) -> None:
@@ -1968,8 +1980,10 @@ class PodFollower:
         while time.monotonic() < deadline:
             for host, port in self._leader_addrs:
                 try:
-                    sock = socket.create_connection((host, port),
-                                                    timeout=5.0)
+                    from harmony_tpu.faults.partition import fault_connect
+
+                    sock = fault_connect((host, port), role="pod.rejoin",
+                                         timeout=5.0)
                 except OSError:
                     continue
                 sock.settimeout(None)

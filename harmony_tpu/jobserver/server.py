@@ -281,10 +281,14 @@ class JobServer:
     def _ha_append(self, kind: str, job_id: Optional[str] = None,
                    **fields: Any) -> bool:
         """Guarded durable append: never fails the serving path, drops
-        (loudly) once this leader is deposed. Returns False only for
-        the deposed drop — the one case a caller must NOT acknowledge
-        as durable (submit() refuses the command on it); an I/O error
-        keeps the pre-HA best-effort contract and is surfaced in logs."""
+        (loudly) once this leader is deposed. Returns False when the
+        entry did NOT land durably — the deposed drop, or an append
+        error (ENOSPC/EIO on the log disk). A caller whose ack DEPENDS
+        on the entry (submit()'s submission record) must refuse on
+        False; the telemetry tees ignore it (best-effort as before).
+        The chaos sweep's halog-ENOSPC schedule caught the old
+        swallow-and-ack shape handing out acks no successor could ever
+        replay."""
         if self.ha_log is None:
             return True
         if not self._ha_leader_ok():
@@ -300,6 +304,7 @@ class JobServer:
         except Exception as e:  # noqa: BLE001 - durability is surfaced,
             server_log.error("halog append %r failed: %s: %s",
                              kind, type(e).__name__, e)
+            return False
         return True
 
     def _ha_record_done(self, job_id: str, fut: "Future") -> None:
@@ -592,9 +597,17 @@ class JobServer:
                                    config=config.to_dict()):
                 with self._lock:
                     self._jobs.pop(config.job_id, None)
-                raise NotLeader(
-                    f"submission {config.job_id} not durable: lease "
-                    "lapsed (deposed)")
+                if not self._ha_leader_ok():
+                    raise NotLeader(
+                        f"submission {config.job_id} not durable: lease "
+                        "lapsed (deposed)")
+                # the log disk refused the record (ENOSPC/EIO): acking
+                # anyway would be the acked-then-lost hole — refuse with
+                # a retryable error; the client's bounded retry succeeds
+                # once the store heals
+                raise RuntimeError(
+                    f"submission {config.job_id} not durable: log "
+                    "append failed (sick log store); retry")
             jr.future.add_done_callback(
                 lambda f, j=config.job_id: self._ha_record_done(j, f))
         self._scheduler.on_job_arrival(config)

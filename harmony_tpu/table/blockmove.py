@@ -360,7 +360,7 @@ def _send_frame(sock: socket.socket, block: int, arr: np.ndarray) -> None:
     """One block frame, one write (utils/framing.py holds the shared
     single-write coalesce/sendmsg discipline)."""
     head, body = _frame_parts(block, arr)
-    _framing.send_frame_parts(sock, head, (body,))
+    _framing.send_frame_parts(sock, head, (body,), role="blockmove")
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
@@ -574,8 +574,10 @@ def _tcp_exchange(plan: MovePlan, outgoing: Dict[int, np.ndarray],
                     max(1, int((deadline - time.monotonic()) * 1000)),
                 )
                 host, port = addr.rsplit(":", 1)
-                with socket.create_connection(
-                        (host, int(port)),
+                from harmony_tpu.faults.partition import fault_connect
+
+                with fault_connect(
+                        (host, int(port)), role="blockmove",
                         timeout=max(0.1, deadline - time.monotonic())) as sock:
                     try:
                         sock.setsockopt(socket.IPPROTO_TCP,

@@ -1,0 +1,35 @@
+"""Directory-entry durability for the control plane's rename/create
+paths.
+
+``fsync(file)`` makes the *bytes* durable; the *name* — a freshly
+created file, or an ``os.replace`` landing — lives in the parent
+directory and needs its own fsync, or a host crash can resurrect the
+old view (POSIX leaves directory-entry durability to an explicit fsync
+of the directory fd). The halog's record stream survives this because
+the file is created once and only ever appended; the lease file and
+checkpoint manifests are *replaced* on every write and need the parent
+pinned. jax-free on purpose: the lease/halog layers run in processes
+that never import jax.
+"""
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> bool:
+    """fsync the directory ``path`` (or the parent directory of a file
+    path). True when the sync happened; False on platforms/filesystems
+    that refuse an O_RDONLY directory fd (the write paths treat that
+    like ``fsync=False`` — best effort, never fatal)."""
+    d = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return False
+    try:
+        os.fsync(fd)
+        return True
+    except OSError:
+        return False
+    finally:
+        os.close(fd)

@@ -30,11 +30,23 @@ IO_CHUNK = 1 << 20
 
 
 def send_frame_parts(sock: socket.socket, head: bytes,
-                     bodies: Sequence[Any]) -> None:
+                     bodies: Sequence[Any], *, role: str = "wire") -> None:
     """Send ``head`` followed by each buffer of ``bodies``, in order, as
     ONE logical write (see module docstring). ``bodies`` elements are
     anything memoryview accepts (bytes / memoryview / buffer-protocol
-    exporters)."""
+    exporters).
+
+    ``role`` labels this stream for the ``net.send`` partition site: an
+    armed link rule can silently swallow the frame (the peer observes
+    silence, not an error), reset it mid-stream, or slow it down.
+    """
+    from harmony_tpu import faults
+
+    if faults.armed():
+        from harmony_tpu.faults.partition import frame_dropped
+
+        if frame_dropped(sock, role=role):
+            return
     views = [b if isinstance(b, memoryview) else memoryview(b)
              for b in bodies]
     total = sum(len(v) for v in views)

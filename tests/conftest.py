@@ -160,6 +160,13 @@ def pytest_configure(config):
         "the fast smoke set runs in tier-1, process-killing pod tests are "
         "also marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded chaos-orchestrator test (harmony_tpu.faults.chaos); "
+        "schedule determinism + fast scenarios run in tier-1, the HA "
+        "takeover scenarios are also marked slow (bin/chaos.sh runs both "
+        "tiers)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,502 @@
+"""Seeded chaos orchestrator: schedule determinism, the partition and
+disk fault classes, the whole-system invariant checker, and the
+regressions the first sweeps exposed.
+
+Tier-1 ``chaos`` smoke: the seed contract (same seed -> byte-identical
+schedule), fault-class semantics at every new site, the satellite
+durability fixes (halog close-flush inside the batch window, lease-dir
+fsync on first acquire, halog tail repair after a torn append, the
+acked-then-lost submit refusal), and one fast end-to-end scenario per
+act. The HA takeover scenarios (leader kill + partition) are also
+marked slow — ``bin/chaos.sh --runslow`` runs the full sweep."""
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu import faults
+from harmony_tpu.faults import chaos, invariants
+from harmony_tpu.faults.plan import FaultPlan, FaultRule
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends disarmed, with zeroed counters and the
+    default (unseeded) jitter RNG."""
+    from harmony_tpu.faults import retry as _retry
+
+    faults.disarm()
+    faults.reset_counters()
+    _retry.reset_counters()
+    faults.set_jitter_rng(None)
+    yield
+    faults.disarm()
+    faults.reset_counters()
+    _retry.reset_counters()
+    faults.set_jitter_rng(None)
+
+
+# -- the seed contract ----------------------------------------------------
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_schedule(self):
+        # the contract CHAOS_r18.json depends on: a violation's seed
+        # replays the byte-identical fault composition
+        for seed in (0, 1, 7, 42, 1234):
+            a = chaos.draw_schedule(seed, duration_s=10.0, intensity=0.5)
+            b = chaos.draw_schedule(seed, duration_s=10.0, intensity=0.5)
+            assert a.to_json() == b.to_json()
+
+    def test_every_scenario_is_seed_stable(self):
+        for name in chaos.SCENARIOS:
+            a = chaos.draw_schedule(3, intensity=0.7, scenario=name)
+            b = chaos.draw_schedule(3, intensity=0.7, scenario=name)
+            assert a.to_json() == b.to_json(), name
+
+    def test_schedules_roundtrip_json(self):
+        for seed in range(8):
+            s = chaos.draw_schedule(seed)
+            rt = chaos.ChaosSchedule.from_json(s.to_json())
+            assert rt.to_json() == s.to_json()
+            # and the plan they arm is env-serializable like any other
+            plan = rt.plan()
+            assert FaultPlan.from_json(plan.to_json()).to_json() \
+                == plan.to_json()
+
+    def test_seeds_cover_the_catalog(self):
+        drawn = {chaos.draw_schedule(s).scenario for s in range(64)}
+        assert drawn == set(chaos.SCENARIOS)
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos scenario"):
+            chaos.draw_schedule(1, scenario="nope")
+
+
+# -- partition fault class ------------------------------------------------
+
+
+class TestPartitionClass:
+    def test_connect_refused(self):
+        faults.arm(FaultPlan([
+            FaultRule("net.connect", match={"role": "client"}, count=1,
+                      action="raise", exc="ConnectionRefusedError",
+                      message="partitioned"),
+        ]))
+        from harmony_tpu.faults.partition import fault_connect
+
+        with pytest.raises(ConnectionRefusedError):
+            fault_connect(("127.0.0.1", 1), role="client", timeout=0.2)
+        assert faults.counters() == {"net.connect:raise": 1}
+
+    def test_connect_blackhole_times_out(self):
+        # "hang" = a blackholed SYN: the caller sees socket.timeout, the
+        # same shape a dropped packet gives a real client
+        faults.arm(FaultPlan([
+            FaultRule("net.connect", match={"role": "client"}, count=1,
+                      action="hang", delay_sec=0.05),
+        ]))
+        from harmony_tpu.faults.partition import fault_connect
+
+        with pytest.raises(socket.timeout):
+            fault_connect(("127.0.0.1", 1), role="client", timeout=0.2)
+
+    def test_partition_is_role_scoped(self):
+        # an asymmetric partition: the client role is cut, the
+        # replication role still connects (to a real listener)
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            faults.arm(FaultPlan([
+                FaultRule("net.connect", match={"role": "client"},
+                          count=-1, action="raise",
+                          exc="ConnectionRefusedError"),
+            ]))
+            from harmony_tpu.faults.partition import fault_connect
+
+            with pytest.raises(ConnectionRefusedError):
+                fault_connect(("127.0.0.1", port), role="client",
+                              timeout=1.0)
+            sock = fault_connect(("127.0.0.1", port), role="halog.repl",
+                                 timeout=1.0)
+            sock.close()
+        finally:
+            srv.close()
+
+    def test_send_silently_dropped(self):
+        # net.send "skip" = the frame vanishes on the wire: the sender
+        # proceeds, the peer sees silence (what silence-detection and
+        # reconnect catch-up are FOR)
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        cli = socket.create_connection(("127.0.0.1", port), timeout=1.0)
+        conn, _ = srv.accept()
+        try:
+            faults.arm(FaultPlan([
+                FaultRule("net.send", match={"role": "pod.report"},
+                          count=1, action="skip"),
+            ]))
+            from harmony_tpu.faults.partition import frame_dropped
+
+            assert frame_dropped(cli, role="pod.report") is True
+            assert frame_dropped(cli, role="pod.report") is False
+        finally:
+            cli.close()
+            conn.close()
+            srv.close()
+
+
+# -- disk fault class -----------------------------------------------------
+
+
+class TestDiskClass:
+    def test_injected_errnos_are_real(self):
+        import errno
+
+        assert faults.DiskFullError().errno == errno.ENOSPC
+        assert faults.DiskIOError().errno == errno.EIO
+
+    def test_halog_enospc_append_raises(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog, scan_records
+
+        log = DurableJobLog(str(tmp_path / "h.log"))
+        faults.arm(FaultPlan([
+            FaultRule("disk.write", match={"kind": "halog"}, count=1,
+                      action="raise", exc="DiskFullError"),
+        ]))
+        with pytest.raises(faults.DiskFullError):
+            log.append("submission", job_id="j1")
+        faults.disarm()
+        log.append("submission", job_id="j2")
+        log.close()
+        entries, _good, torn = scan_records(str(tmp_path / "h.log"))
+        assert torn == 0
+        assert [e["job"] for e in entries] == ["j2"]
+
+    def test_halog_torn_append_repairs_tail(self, tmp_path):
+        # the halog_torn_write sweep finding: before the tail repair, a
+        # torn record POISONED every later append — acked-and-fsynced
+        # entries behind the tear were unreplayable. Pin: append, tear,
+        # append again; both good records must scan back, zero torn.
+        from harmony_tpu.jobserver.halog import DurableJobLog, scan_records
+
+        path = str(tmp_path / "h.log")
+        log = DurableJobLog(path)
+        log.append("submission", job_id="before")
+        faults.arm(FaultPlan([
+            FaultRule("disk.write", match={"kind": "halog"}, count=1,
+                      action="corrupt"),
+        ]))
+        with pytest.raises(faults.DiskIOError):
+            log.append("submission", job_id="torn")
+        faults.disarm()
+        after = log.append("submission", job_id="after")
+        log.close()
+        entries, _good, torn = scan_records(path)
+        assert torn == 0
+        assert [e["job"] for e in entries] == ["before", "after"]
+        # the torn attempt's seq was rolled back, not burned
+        assert after["seq"] == entries[0]["seq"] + 1
+
+    def test_lease_store_eio_fails_attempt_not_process(self, tmp_path):
+        from harmony_tpu.jobserver.lease import LeaseManager
+
+        m = LeaseManager(str(tmp_path), "rep-a", lease_s=5.0)
+        faults.arm(FaultPlan([
+            FaultRule("disk.write", match={"kind": "lease"}, count=1,
+                      action="raise", exc="DiskIOError"),
+        ]))
+        assert m.try_acquire() is False  # sick store = failed attempt
+        faults.disarm()
+        assert m.try_acquire() is True  # heals without a new process
+
+    def test_lease_stale_read_returns_none(self, tmp_path):
+        from harmony_tpu.jobserver.lease import LeaseManager, read_lease
+
+        m = LeaseManager(str(tmp_path), "rep-a", lease_s=5.0)
+        assert m.try_acquire()
+        faults.arm(FaultPlan([
+            FaultRule("disk.read", match={"kind": "lease"}, count=1,
+                      action="skip"),
+        ]))
+        assert read_lease(str(tmp_path)) is None  # the stale read
+        assert read_lease(str(tmp_path))["holder"] == "rep-a"
+
+    def test_chkp_block_read_bitrot_is_loud(self, tmp_path, devices):
+        from harmony_tpu.checkpoint.manager import (CheckpointCorruptError,
+                                                    CheckpointManager)
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.parallel import DevicePool
+        from harmony_tpu.runtime import ETMaster
+
+        master = ETMaster(DevicePool(devices[:2]))
+        exs = master.add_executors(2)
+        cfg = TableConfig(table_id="t", capacity=16, value_shape=(2,),
+                          num_blocks=4)
+        h = master.create_table(cfg, [e.id for e in exs])
+        h.table.multi_update(list(range(16)),
+                             np.ones((16, 2), np.float32))
+        mgr = CheckpointManager(str(tmp_path / "t"), str(tmp_path / "c"))
+        cid = mgr.checkpoint(h, commit=True)
+        faults.arm(FaultPlan([
+            FaultRule("disk.read", match={"kind": "chkp.block"}, count=1,
+                      action="corrupt"),
+        ]))
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore(master, cid, [exs[0].id], table_id="r")
+
+
+# -- satellite pins -------------------------------------------------------
+
+
+class TestBatchWindowCloseFlush:
+    def test_close_inside_batch_window_keeps_tail(self, tmp_path,
+                                                  monkeypatch):
+        # HARMONY_LOG_BATCH_MS coalescing: a close() that lands while
+        # the committer sleeps in the window must still deliver the
+        # pending tail to the sinks (the replicator) — the pre-fix
+        # behavior dropped exactly those entries
+        monkeypatch.setenv("HARMONY_LOG_BATCH_MS", "200")
+        from harmony_tpu.jobserver.halog import DurableJobLog
+
+        log = DurableJobLog(str(tmp_path / "h.log"))
+        assert log._batch_s == pytest.approx(0.2)
+        sunk = []
+        log.add_sink(lambda entry, rec: sunk.append(entry["job"]))
+        done = []
+
+        def writer(jid):
+            log.append("submission", job_id=jid)
+            done.append(jid)
+
+        threads = [threading.Thread(target=writer, args=(f"j{i}",))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # writers are inside the coalescing sleep now
+        log.close()
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(sunk) == ["j0", "j1", "j2"]
+
+    def test_unbatched_close_still_flushes(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog, scan_records
+
+        log = DurableJobLog(str(tmp_path / "h.log"))
+        log.append("submission", job_id="a")
+        log.close()
+        entries, _g, torn = scan_records(str(tmp_path / "h.log"))
+        assert [e["job"] for e in entries] == ["a"] and torn == 0
+
+
+class TestLeaseDirDurability:
+    def test_first_acquire_fsyncs_parent_dir(self, tmp_path, monkeypatch):
+        # file CREATION is only durable once the parent directory is
+        # synced; without it a host crash can resurrect an empty HA dir
+        # and mint epoch 1 twice
+        calls = []
+        import harmony_tpu.jobserver.lease as lease_mod
+
+        monkeypatch.setattr(lease_mod, "fsync_dir",
+                            lambda p: calls.append(p) or True)
+        m = lease_mod.LeaseManager(str(tmp_path), "rep-a", lease_s=5.0)
+        assert m.try_acquire()
+        assert calls == [m.path]
+        assert m.renew()
+        assert m.try_acquire()
+        assert calls == [m.path]  # only the CREATE pays the dir fsync
+
+    def test_fsync_dir_best_effort(self, tmp_path):
+        from harmony_tpu.utils.durability import fsync_dir
+
+        assert fsync_dir(str(tmp_path / "file")) is True
+        assert fsync_dir(str(tmp_path / "missing" / "file")) is False
+
+
+class TestSeededJitter:
+    def test_backoff_sequence_reproducible(self):
+        # the decorrelated-jitter backoff under a seeded RNG: two runs
+        # with the same seed sleep the identical sequence
+        from harmony_tpu.config.params import RetryPolicy
+        from harmony_tpu.faults.retry import call_with_retry
+
+        policy = RetryPolicy(max_attempts=5, base_delay_sec=0.01,
+                             max_delay_sec=1.0, jitter=0.5)
+
+        def run_once():
+            sleeps = []
+            prev = faults.set_jitter_rng(random.Random(99))
+            try:
+                attempts = []
+
+                def flaky():
+                    attempts.append(1)
+                    if len(attempts) < 5:
+                        raise OSError("transient")
+                    return "ok"
+
+                assert call_with_retry(flaky, policy, op="chaos-test",
+                                       sleep=sleeps.append) == "ok"
+            finally:
+                faults.set_jitter_rng(prev)
+            return sleeps
+
+        a = run_once()
+        b = run_once()
+        assert a == b
+        assert len(a) == 4 and all(s > 0 for s in a)
+        # and jitter actually decorates the base (not a constant ladder)
+        assert len(set(a)) > 1
+
+    def test_set_jitter_rng_none_restores_default(self):
+        from harmony_tpu.faults import retry as _retry
+
+        seeded = random.Random(1)
+        prev = faults.set_jitter_rng(seeded)
+        assert faults.jitter_rng() is seeded
+        faults.set_jitter_rng(prev)
+        faults.set_jitter_rng(None)
+        assert faults.jitter_rng() is _retry._DEFAULT_RNG
+
+
+# -- the sweep-exposed submit regression ----------------------------------
+
+
+class TestAckedThenLostRegression:
+    # the exact schedule the halog_enospc sweep draws: a submission
+    # append hits ENOSPC. Pre-fix, _ha_append swallowed the error and
+    # submit() ACKED a job no successor could ever replay.
+    SCHEDULE = [
+        FaultRule("jobserver.log_append", match={"kind": "submission"},
+                  count=1, action="raise", exc="DiskFullError",
+                  message="log disk full"),
+    ]
+
+    def test_submit_refuses_instead_of_acking(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog, scan_records
+        from harmony_tpu.jobserver.halog import ReplayState
+        from harmony_tpu.jobserver.server import JobServer
+
+        path = str(tmp_path / "h.log")
+        server = JobServer(num_executors=2)
+        server.enable_ha(DurableJobLog(path))
+        server.start()
+        try:
+            faults.arm(FaultPlan(list(self.SCHEDULE)))
+            with pytest.raises(RuntimeError, match="not durable"):
+                server.submit(chaos.tiny_job("lost"))
+            faults.disarm()
+            assert "lost" not in server.running_jobs()
+            # the disk healed: the SAME id resubmits cleanly
+            fut = server.submit(chaos.tiny_job("lost"))
+            assert fut.result(timeout=120)["job_id"] == "lost"
+        finally:
+            faults.disarm()
+            server.shutdown(timeout=60.0)
+        state = ReplayState.from_entries(scan_records(path)[0])
+        assert "lost" in state.submissions  # the retry IS in the log
+
+
+# -- invariant checker ----------------------------------------------------
+
+
+class TestInvariants:
+    def test_exactly_once_epochs(self):
+        good = {"j": {"workers": {"j/w0": {"losses": [1.0, 0.5]}}}}
+        assert invariants.exactly_once_epochs(good, 2)["ok"]
+        dup = {"j": {"workers": {"j/w0": {"losses": [1.0, 0.5, 0.5]}}}}
+        assert not invariants.exactly_once_epochs(dup, 2)["ok"]
+
+    def test_acked_in_log_catches_the_hole(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog
+
+        path = str(tmp_path / "h.log")
+        log = DurableJobLog(path)
+        log.append("submission", job_id="a",
+                   config={"job_id": "a"})
+        log.close()
+        assert invariants.acked_in_log(["a"], path)["ok"]
+        f = invariants.acked_in_log(["a", "ghost"], path)
+        assert not f["ok"] and f["evidence"] == ["ghost"]
+
+    def test_loss_parity_exact(self):
+        res = {"j": {"workers": {"j/w0": {"losses": [1.0, 0.5]}}}}
+        assert invariants.loss_parity(res, {"w0": [1.0, 0.5]})["ok"]
+        assert not invariants.loss_parity(
+            res, {"w0": [1.0, 0.500001]})["ok"]
+
+    def test_violations_carry_the_schedule(self, tmp_path):
+        from harmony_tpu.jobserver.halog import DurableJobLog
+
+        path = str(tmp_path / "h.log")
+        DurableJobLog(path).close()
+        sched = chaos.draw_schedule(5, scenario="halog_enospc")
+        verdict = invariants.check_all(acked=["ghost"], log_path=path,
+                                       schedule=sched)
+        assert not verdict["ok"]
+        assert verdict["violations"] == ["acked_in_log"]
+        bad = [f for f in verdict["findings"] if not f["ok"]][0]
+        assert bad["schedule"] == sched.to_dict()  # the repro IS the report
+
+
+# -- end-to-end scenarios -------------------------------------------------
+
+
+class TestScenariosEndToEnd:
+    def test_chkp_enospc_commit_scenario(self, tmp_path):
+        # the required disk-fault-during-commit composition, end to end
+        r = chaos.run_scenario(5, intensity=0.6,
+                               scenario="chkp_enospc_commit",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        act = r["acts"][0]
+        assert act["commit_retry_ok"] is True
+        assert any("DiskFullError" in c for c in act["faults_caught"])
+
+    def test_halog_enospc_scenario(self, tmp_path):
+        r = chaos.run_scenario(11, intensity=0.5,
+                               scenario="halog_enospc",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        act = r["acts"][0]
+        assert act["fault_fires"].get("jobserver.log_append:raise")
+        assert "acked_in_log" in act["invariants"]["checked"]
+
+    def test_lease_disk_flap_scenario(self, tmp_path):
+        r = chaos.run_scenario(3, intensity=0.5,
+                               scenario="lease_disk_flap",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        act = r["acts"][0]
+        assert act["holder_after_heal"] is not None
+
+    @pytest.mark.slow
+    def test_partition_during_takeover_scenario(self, tmp_path):
+        # the capstone: leader kill + client partition + replication
+        # partition, judged by the full invariant battery
+        r = chaos.run_scenario(21, intensity=0.5,
+                               scenario="partition_during_takeover",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        act = r["acts"][0]
+        assert act.get("takeover_s") is not None
+        assert act["unresolved"] == []
+
+    @pytest.mark.slow
+    def test_repl_partition_heal_scenario(self, tmp_path):
+        r = chaos.run_scenario(11, intensity=0.5,
+                               scenario="repl_partition_heal",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        assert r["acts"][0]["standby_caught_up"] is True
