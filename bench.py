@@ -683,6 +683,14 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # means an invariant went red on a pinned schedule (the full
         # sweep is benchmarks/CHAOS_r18.json, run by bin/chaos.sh)
         line["chaos"] = cho
+    oin = measure_obs_incidents()
+    if oin is not None:
+        # incident-correlation probe: a synthetic fault→diagnosis→
+        # action→resolution stream through a standalone engine;
+        # obs_incidents.recall dropping below 1.0 means seeded episodes
+        # stopped correlating (the chaos-scored capture is
+        # benchmarks/OBS_INCIDENT_r19.json)
+        line["obs_incidents"] = oin
     print(json.dumps(line))
 
 
@@ -993,6 +1001,52 @@ def measure_chaos() -> "dict | None":
         return None
 
 
+def measure_obs_incidents() -> "dict | None":
+    """Incident-correlation probe (tracked round over round in the
+    BENCH json, and by --compare via obs_incidents.recall): a fixed
+    synthetic episode set — 8 tenants, each a seeded trigger→diagnosis→
+    action→resolution joblog sequence — through a standalone
+    IncidentEngine, measuring correlation wall per cycle, the open
+    count after folding, and recall (episodes that produced a resolved
+    incident / episodes injected). Synthetic on purpose: the BENCH line
+    must stay cheap; the chaos-ground-truth scorecard is
+    benchmarks/OBS_INCIDENT_r19.json (benchmarks/obs_incidents.py).
+    Returns {correlate_ms, open, recall, resolved} or None — the bench
+    line must never die for its incidents hook."""
+    try:
+        import time as _t
+
+        from harmony_tpu.jobserver import joblog
+        from harmony_tpu.metrics.incidents import IncidentEngine
+
+        n = 8
+        eng = IncidentEngine(window_sec=5.0, persist=False)
+        t0 = _t.time()
+        for i in range(n):
+            job = f"bench-inc-{i}"
+            joblog.record_event(job, "slo", attainment=0.4)
+            joblog.record_event(job, "diagnosis", rule="slo_burn",
+                                verdict="input_bound", confidence=0.9)
+            joblog.record_event(job, "policy", action="grow",
+                                outcome="advised", reason="under_slo")
+            joblog.record_event(job, "elastic_restore", recovery="regrow")
+        t1 = _t.monotonic()
+        eng.correlate()
+        correlate_ms = (_t.monotonic() - t1) * 1000.0
+        st = eng.status()
+        for i in range(n):
+            joblog.clear_events(f"bench-inc-{i}")
+        return {
+            "correlate_ms": round(correlate_ms, 3),
+            "open": st["open"],
+            "resolved": st["resolved"],
+            "recall": round(st["resolved"] / float(n), 3),
+            "setup_s": round(_t.time() - t0, 3),
+        }
+    except Exception:
+        return None
+
+
 def measure_lint() -> "dict | None":
     """harmonylint-suite runtime probe (tracked round over round in the
     BENCH json): one full run over harmony_tpu/. Returns {"lint.wall_ms",
@@ -1034,10 +1088,14 @@ def measure_lint() -> "dict | None":
 #: staleness overlap arm (absent before PR 16, skipped the same way);
 #: `chaos.scenarios_ok` tracks the seeded chaos smoke pair — any drop
 #: means an invariant went red on a pinned schedule (absent before
-#: PR 18, skipped the same way).
+#: PR 18, skipped the same way); `obs_incidents.recall` tracks the
+#: incident engine's synthetic correlation probe — a drop means seeded
+#: fault→diagnosis→action→resolution episodes stopped folding into
+#: resolved incidents (absent before PR 19, skipped the same way).
 HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps",
                    "autoscale.agg_sps", "autoscale.slo_attainment",
-                   "async_step.b1_sps", "chaos.scenarios_ok")
+                   "async_step.b1_sps", "chaos.scenarios_ok",
+                   "obs_incidents.recall")
 COMPARE_THRESHOLD = 0.15
 
 

@@ -1,0 +1,306 @@
+"""Incident-detection scorecard: PR 18's seeded chaos schedules through
+the real stack, scored against the injected-fault ground truth.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/obs_incidents.py > benchmarks/OBS_INCIDENT_r19.json
+
+Each fault cell replays one committed ``(seed, scenario, intensity)``
+schedule from the chaos sweep with a live IncidentEngine correlating
+beside it (a ticker thread stands in for the jobserver scrape cycle, so
+joblog evidence is harvested before the scenario's teardown clears it).
+The schedule's fired fault sites are the ground truth; an incident
+counts as a detection when its causal chain names an injected site.
+
+Scored per fault class (the SITE_CATALOG layer of the fired site):
+
+- ``recall_by_class`` / ``recall`` — injected class-instances whose
+  sites some incident chain named; the acceptance floor is 0.9.
+- ``precision`` — attributed incidents / all incidents over fault cells.
+- ``false_positives_control`` — incidents raised on the healthy control
+  arm (an unfaulted JobServer + tenant job); the floor is exactly 0.
+- ``mttd_s`` / ``mttr_s`` — detection and resolution latency
+  distributions over every incident the sweep produced.
+
+``--quick`` skips the HA takeover scenarios (the slow tier), mirroring
+benchmarks/chaos_sweep.py.
+"""
+import argparse
+import json
+import logging
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from harmony_tpu.faults import chaos  # noqa: E402
+from harmony_tpu.jobserver import joblog  # noqa: E402
+from harmony_tpu.metrics.incidents import IncidentEngine  # noqa: E402
+from harmony_tpu.tracing import flight  # noqa: E402
+
+#: correlation window for the sweep — short so quiescence resolution
+#: (and therefore MTTR) lands inside one cell instead of the production
+#: default 120 s
+WINDOW_SEC = 2.0
+
+#: one cell per scenario class, seeds shared with the committed
+#: chaos-sweep capture so each schedule replays byte-identically
+GRID = [
+    (11, "halog_enospc", 0.5),
+    (3, "halog_torn_write", 0.5),
+    (4, "log_slow_fsync", 0.5),
+    (11, "client_partition", 0.5),
+    (3, "lease_disk_flap", 0.5),
+    (5, "chkp_torn_block", 0.6),
+    (8, "chkp_bitrot_read", 0.6),
+    (5, "chkp_enospc_commit", 0.6),
+    (11, "repl_partition_heal", 0.5),
+    (21, "partition_during_takeover", 0.5),
+    (22, "overload_storm_leader_kill", 0.5),
+]
+
+#: fired site -> fault class, from the chaos site catalog
+_SITE_CLASS = {site: layer
+               for layer, sites in chaos.SITE_CATALOG.items()
+               for site in sites}
+
+
+def _site_class(site: str) -> str:
+    return _SITE_CLASS.get(site, site.split(".", 1)[0])
+
+
+def _pctl(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return round(xs[idx], 4)
+
+
+def _dist(xs):
+    return {"n": len(xs), "p50": _pctl(xs, 0.50), "p99": _pctl(xs, 0.99),
+            "max": _pctl(xs, 1.0),
+            "mean": round(statistics.fmean(xs), 4) if xs else None}
+
+
+class _Ticker:
+    """Background correlate loop — the scrape cycle's stand-in, so the
+    engine sees joblog evidence live (scenario teardown clears it)."""
+
+    def __init__(self, engine: IncidentEngine, period: float = 0.25) -> None:
+        self.engine = engine
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(period,), daemon=True,
+            name="obs-incidents-ticker")
+
+    def _run(self, period: float) -> None:
+        while not self._stop.wait(period):
+            try:
+                self.engine.correlate()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "_Ticker":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def _fresh_engine() -> IncidentEngine:
+    """A per-cell engine over a clean evidence plane. persist=False: the
+    scorecard's engine must not feed its own verdicts back into the
+    joblog it harvests."""
+    flight.reset_recorder()
+    flight.get_recorder()
+    joblog.clear_events()
+    return IncidentEngine(window_sec=WINDOW_SEC, persist=False)
+
+
+def _incident_sites(inc: dict) -> set:
+    sites = set()
+    if inc.get("site"):
+        sites.add(str(inc["site"]))
+    for edge in inc.get("chain") or []:
+        if edge.get("site"):
+            sites.add(str(edge["site"]))
+    return sites
+
+
+def _drain(engine: IncidentEngine) -> list:
+    """Final harvest + quiescence pass: anything still open resolves as
+    ``quiesced`` with a deterministic MTTR of one window."""
+    engine.correlate()
+    engine.correlate(now=time.time() + WINDOW_SEC + 0.5)
+    return engine.recent(limit=128)
+
+
+def run_fault_cell(seed: int, scenario: str, intensity: float) -> dict:
+    engine = _fresh_engine()
+    with tempfile.TemporaryDirectory(prefix="harmony-obsinc-") as td:
+        with _Ticker(engine):
+            report = chaos.run_scenario(seed, intensity=intensity,
+                                        scenario=scenario, workdir=td)
+    incidents = _drain(engine)
+
+    injected = sorted({k.split(":", 1)[0] for a in report["acts"]
+                       for k in (a.get("fault_fires") or {})})
+    named = {s for inc in incidents for s in _incident_sites(inc)}
+    matched = sorted(s for s in injected if s in named)
+    attributed = sum(1 for inc in incidents
+                     if _incident_sites(inc) & set(injected))
+    return {
+        "seed": seed,
+        "scenario": scenario,
+        "intensity": intensity,
+        "ok": report["ok"],
+        "injected_sites": injected,
+        "injected_classes": sorted({_site_class(s) for s in injected}),
+        "matched_sites": matched,
+        "detected_classes": sorted({_site_class(s) for s in matched}),
+        "incidents": len(incidents),
+        "attributed": attributed,
+        "mttd_s": [round(inc["mttd_sec"], 4) for inc in incidents
+                   if inc.get("mttd_sec") is not None],
+        "mttr_s": [round(inc["mttr_sec"], 4) for inc in incidents
+                   if inc.get("mttr_sec") is not None],
+        "wall_s": report["wall_s"],
+    }
+
+
+def run_control_cell() -> dict:
+    """The healthy arm: a real JobServer runs one tenant job to
+    completion with no fault plan armed. Any incident here is a false
+    positive — the acceptance floor is zero."""
+    from harmony_tpu.jobserver.server import JobServer
+
+    engine = _fresh_engine()
+    t0 = time.monotonic()
+    with _Ticker(engine):
+        server = JobServer(num_executors=2)
+        try:
+            server.start()
+            fut = server.submit(chaos.tiny_job("control-healthy"))
+            result = fut.result(timeout=300)
+        finally:
+            server.shutdown(timeout=60.0)
+    incidents = _drain(engine)
+    return {
+        "scenario": "healthy_control",
+        "ok": bool(result.get("losses")),
+        "incidents": len(incidents),
+        "false_positives": len(incidents),
+        "incident_kinds": sorted({i.get("trigger_kind") or "?"
+                                  for i in incidents}),
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the HA takeover scenarios (the slow tier)")
+    args = ap.parse_args()
+    logging.disable(logging.ERROR)  # chaos storms are LOUD by design
+
+    grid = [(s, name, i) for s, name, i in GRID
+            if not (args.quick and name in chaos.HA_SCENARIOS)]
+
+    doc = {
+        "metric": "obs_incidents",
+        "unit": "recall / precision / seconds",
+        "mode": ("seeded chaos schedules with a live incident engine "
+                 "correlating beside the run; fired fault sites are the "
+                 "ground truth, incident causal chains are the "
+                 "detections; healthy JobServer control arm for the "
+                 "false-positive floor"),
+        "config": {
+            "window_sec": WINDOW_SEC,
+            "grid_cells": len(grid),
+            "acceptance": {"recall_floor": 0.9,
+                           "control_false_positives": 0},
+        },
+        "grid": [],
+    }
+    t_sweep = time.monotonic()
+    injected_n = detected_n = 0
+    by_class: dict = {}
+    incidents_total = attributed_total = 0
+    mttd_all: list = []
+    mttr_all: list = []
+    for seed, name, intensity in grid:
+        print(f"# {name} seed={seed} i={intensity} ...", file=sys.stderr)
+        t0 = time.monotonic()
+        try:
+            cell = run_fault_cell(seed, name, intensity)
+        except Exception as exc:  # a crashed cell is a red cell
+            cell = {"seed": seed, "scenario": name, "intensity": intensity,
+                    "ok": False, "error": repr(exc),
+                    "injected_classes": [], "detected_classes": [],
+                    "incidents": 0, "attributed": 0,
+                    "mttd_s": [], "mttr_s": []}
+        cell["cell_wall_s"] = round(time.monotonic() - t0, 1)
+        doc["grid"].append(cell)
+        for cls in cell["injected_classes"]:
+            hit = cls in cell["detected_classes"]
+            injected_n += 1
+            detected_n += 1 if hit else 0
+            agg = by_class.setdefault(cls, {"injected": 0, "detected": 0})
+            agg["injected"] += 1
+            agg["detected"] += 1 if hit else 0
+        incidents_total += cell["incidents"]
+        attributed_total += cell["attributed"]
+        mttd_all.extend(cell["mttd_s"])
+        mttr_all.extend(cell["mttr_s"])
+        print(f"#   injected={cell.get('injected_sites')} "
+              f"matched={cell.get('matched_sites')} "
+              f"incidents={cell['incidents']} "
+              f"wall={cell['cell_wall_s']}s", file=sys.stderr)
+
+    print("# healthy_control ...", file=sys.stderr)
+    try:
+        control = run_control_cell()
+    except Exception as exc:
+        control = {"scenario": "healthy_control", "ok": False,
+                   "error": repr(exc), "incidents": -1,
+                   "false_positives": -1}
+    doc["control"] = control
+    print(f"#   false_positives={control['false_positives']}",
+          file=sys.stderr)
+
+    recall = round(detected_n / injected_n, 4) if injected_n else None
+    doc["summary"] = {
+        "recall": recall,
+        "recall_by_class": {
+            cls: round(agg["detected"] / agg["injected"], 4)
+            for cls, agg in sorted(by_class.items())},
+        "precision": (round(attributed_total / incidents_total, 4)
+                      if incidents_total else None),
+        "incidents_total": incidents_total,
+        "attributed_total": attributed_total,
+        "false_positives_control": control["false_positives"],
+        "mttd_s": _dist(mttd_all),
+        "mttr_s": _dist(mttr_all),
+        "sweep_wall_s": round(time.monotonic() - t_sweep, 1),
+    }
+    print(json.dumps(doc, indent=1))
+    ok = (recall is not None and recall >= 0.9
+          and control["false_positives"] == 0
+          and all(c["ok"] for c in doc["grid"]))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

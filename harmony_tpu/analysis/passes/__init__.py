@@ -17,6 +17,7 @@ from typing import List
 from harmony_tpu.analysis.core import Pass, PragmaHygienePass
 from harmony_tpu.analysis.passes.bounded import BoundedResourcePass
 from harmony_tpu.analysis.passes.donate import UseAfterDonatePass
+from harmony_tpu.analysis.passes.eventkinds import EventKindRegistryPass
 from harmony_tpu.analysis.passes.faultsites import FaultSiteRegistryPass
 from harmony_tpu.analysis.passes.jit import JitHygienePass
 from harmony_tpu.analysis.passes.knobs import KnobConsistencyPass
@@ -32,6 +33,7 @@ _REGISTRY = (
     BoundedResourcePass,
     UseAfterDonatePass,
     FaultSiteRegistryPass,
+    EventKindRegistryPass,
     KnobConsistencyPass,
     SpanHygienePass,
     JitHygienePass,

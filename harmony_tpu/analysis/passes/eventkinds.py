@@ -1,0 +1,177 @@
+"""event-kind-registry: emitted joblog kinds ⇄ catalog ⇄ docs.
+
+The structured event stream (jobserver/joblog.py) is addressed by
+``kind=`` literals declared ad hoc across ~15 modules, and two consumers
+now dispatch on those names: the incident engine's role classification
+(metrics/incidents.py) and operators grepping OBSERVABILITY.md. A typo'd
+or undeclared kind fails silently — the event records fine, correlates
+as nothing, and appears in no table. Three directions are pinned against
+the declared catalog (``EVENT_KINDS`` in jobserver/joblog.py, the
+doctor_rule precedent applied to the stream itself):
+
+* every literal kind emitted in code (``record_event(...)``, a
+  ``.event("...")`` recorder call, ``_record_pod_event("...")``) is
+  declared in the catalog,
+* every catalog entry has a row in the OBSERVABILITY.md event-kind
+  table (§Event-kind registry),
+* every table row is a catalog entry (a dead row documents events that
+  can never appear).
+
+Dynamic kinds (the ``elastic_{kind}`` f-strings in jobserver/pod.py)
+cannot be collected statically, so the catalog declares each expansion
+and the "every catalog entry is emitted somewhere" direction is
+deliberately NOT enforced — it would be unanswerable.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from harmony_tpu.analysis.core import CodebaseIndex, Finding, Pass
+
+REGISTRY_DOC = "OBSERVABILITY.md"
+_SECTION = "### Event-kind registry"
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+_KIND_SHAPE = re.compile(r"^[a-z][a-z0-9_]*$")
+_CATALOG_NAME = "EVENT_KINDS"
+
+
+def _doc_rows(text: str) -> Dict[str, int]:
+    """kind -> 1-based line number of its event-kind table row."""
+    rows: Dict[str, int] = {}
+    in_section = False
+    for lno, line in enumerate(text.splitlines(), start=1):
+        if line.strip() == _SECTION:
+            in_section = True
+            continue
+        if in_section and line.startswith(("## ", "### ")):
+            break
+        if in_section:
+            m = _ROW_RE.match(line.strip())
+            if m:
+                rows[m.group(1)] = lno
+    return rows
+
+
+def _catalog(index: CodebaseIndex) -> Dict[str, Tuple[str, int]]:
+    """kind -> (file, line) from the ``EVENT_KINDS`` dict literal."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for sf in index.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):  # EVENT_KINDS: Dict = {}
+                targets = [node.target]
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == _CATALOG_NAME
+                       for t in targets):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            for key in node.value.keys:
+                if (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    out[key.value] = (sf.rel, key.lineno)
+    return out
+
+
+def _emitted(index: CodebaseIndex) -> List[Tuple[str, str, int]]:
+    """(kind, file, line) for every literal event kind an emit call
+    names: ``record_event(job, "kind", ...)`` (positional or
+    ``kind="..."``), ``<recorder>.event("kind", ...)``, and
+    ``_record_pod_event("kind", ...)``."""
+    out: List[Tuple[str, str, int]] = []
+
+    def _const(node) -> str:
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and _KIND_SHAPE.match(node.value)):
+            return node.value
+        return ""
+
+    for sf in index.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = (f.attr if isinstance(f, ast.Attribute)
+                     else f.id if isinstance(f, ast.Name) else "")
+            kind = ""
+            if fname in ("record_event", "_record_pod_event"):
+                idx = 1 if fname == "record_event" else 0
+                if len(node.args) > idx:
+                    kind = _const(node.args[idx])
+                for kw in node.keywords:
+                    if kw.arg == "kind":
+                        kind = _const(kw.value)
+            elif fname == "event" and isinstance(f, ast.Attribute):
+                if node.args:
+                    kind = _const(node.args[0])
+            if kind:
+                out.append((kind, sf.rel, node.lineno))
+    return out
+
+
+class EventKindRegistryPass(Pass):
+    name = "event-kind-registry"
+    description = ("every emitted joblog event kind is declared in "
+                   "joblog.EVENT_KINDS and tabled in OBSERVABILITY.md")
+
+    def run(self, index: CodebaseIndex) -> List[Finding]:
+        out: List[Finding] = []
+        doc_rel = f"docs/{REGISTRY_DOC}"
+        catalog = _catalog(index)
+        emits = _emitted(index)
+        if not catalog:
+            if emits and not index.partial:
+                kind, file, line = emits[0]
+                out.append(self.finding(
+                    file, line,
+                    f"event kind {kind!r} emitted but no "
+                    f"{_CATALOG_NAME} catalog exists",
+                    hint="declare the catalog dict (jobserver/joblog.py "
+                         "precedent); undeclared kinds are invisible to "
+                         "incident correlation"))
+            return out
+        for kind, file, line in emits:
+            if kind not in catalog:
+                out.append(self.finding(
+                    file, line,
+                    f"event kind {kind!r} is not declared in "
+                    f"{_CATALOG_NAME}",
+                    hint="add a catalog entry (+ the OBSERVABILITY.md "
+                         "row) — or this is a typo no consumer will "
+                         "ever match"))
+        if index.partial:
+            return out  # a file slice cannot prove doc parity
+        rows = _doc_rows(index.doc_text(REGISTRY_DOC))
+        if not rows:
+            cat_file, cat_line = next(iter(sorted(catalog.values())))
+            out.append(self.finding(
+                cat_file, cat_line,
+                f"event-kind table not found ({_SECTION} in {doc_rel})",
+                hint="the catalog is operator API; its table is the "
+                     "documented source of truth"))
+            return out
+        for kind, (file, line) in sorted(catalog.items()):
+            if kind not in rows:
+                out.append(self.finding(
+                    file, line,
+                    f"catalog kind {kind!r} has no {doc_rel} "
+                    "event-kind row",
+                    hint="add a row (kind / emitter / meaning) to the "
+                         f"{_SECTION} table"))
+        for kind, lno in sorted(rows.items()):
+            if kind not in catalog:
+                out.append(self.finding(
+                    doc_rel, lno,
+                    f"event-kind row {kind!r} is not declared in "
+                    f"{_CATALOG_NAME}",
+                    hint="a dead row documents events that can never "
+                         "appear; drop the row or declare the kind"))
+        return out

@@ -453,16 +453,17 @@ def main(argv: List[str] | None = None) -> int:
     )
     p.add_argument("what",
                    choices=("top", "flight", "metrics", "trace",
-                            "doctor", "critpath", "plan"))
+                            "doctor", "critpath", "plan", "incidents"))
     p.add_argument("--port", type=int, default=None,
                    help="jobserver TCP port (top/flight/doctor/critpath/"
-                        "plan: STATUS query; default "
+                        "plan/incidents: STATUS query; default "
                         "$HARMONY_JOBSERVER_PORT then 43110)")
     p.add_argument("--json", action="store_true",
                    help="top: raw ledger JSON instead of the table; "
                         "doctor: raw diagnoses + history stats; "
                         "critpath: raw phase budgets; plan: the raw "
-                        "policy section")
+                        "policy section; incidents: the raw incidents "
+                        "section")
     p.add_argument("--url", default=None,
                    help="metrics: exporter base URL (default "
                         "$HARMONY_METRICS_URL); trace: dashboard URL "
@@ -828,6 +829,17 @@ def _cmd_obs_inner(args: argparse.Namespace) -> int:
         for line in _render_policy(status.get("policy", {})):
             print(line)
         return 0
+    if args.what == "incidents":
+        status = _obs_status_sender(kind, endpoint).send_status_command()
+        if not status.get("ok"):
+            print(json.dumps(status))
+            return 1
+        if getattr(args, "json", False):
+            print(json.dumps(status.get("incidents", {}), indent=2))
+            return 0
+        for line in _render_incidents(status.get("incidents", {})):
+            print(line)
+        return 0
     base = endpoint
     if args.what == "metrics":
         text = urllib.request.urlopen(base + "/metrics",
@@ -988,6 +1000,74 @@ def _render_policy(policy: dict) -> "List[str]":
         ))
     out += _render_table(rows)
     out.append("(* = shared/overlapping grant)")
+    return out
+
+
+#: causal nesting rank for the incident timeline: each evidence edge
+#: indents under the newest edge of an earlier rank, so the rendered
+#: staircase IS the causal story (trigger → diagnosis → action →
+#: resolution)
+_INCIDENT_RANK = {"trigger": 0, "diagnosis": 1, "action": 2,
+                  "resolution": 3}
+
+
+def _render_incidents(incidents: dict) -> "List[str]":
+    """One-screen incident view from a single STATUS scrape
+    (docs/OBSERVABILITY.md §10): a header with the lifecycle counts,
+    then each incident as its own causal timeline — the evidence chain
+    shaped through tracing/timeline.py, offsets relative to the
+    trigger. Unknown latencies render '-' (an open incident has no
+    MTTR yet; 0 would be a lie)."""
+    if not incidents:
+        return ["(no incidents section — server predates the incident "
+                "engine?)"]
+
+    def _sec(v) -> str:
+        return "-" if v is None else f"{v:.3f}s"
+
+    out = [
+        f"incidents: open={incidents.get('open', 0)} "
+        f"mitigating={incidents.get('mitigating', 0)} "
+        f"resolved={incidents.get('resolved', 0)} "
+        f"window={incidents.get('window_sec', '?')}s "
+        f"mean_mttr={_sec(incidents.get('mttr_mean_sec'))}"
+        + (f" adopted={incidents['adopted']}"
+           if incidents.get("adopted") else ""),
+    ]
+    rows = incidents.get("incidents") or []
+    if not rows:
+        out.append("no incidents — the evidence stream is quiet")
+        return out
+    from harmony_tpu.tracing.timeline import timeline_rows
+
+    for inc in rows:
+        verdict = inc.get("verdict")
+        out.append("")
+        out.append(
+            f"{inc.get('incident_id', '?')} "
+            f"[{inc.get('status', '?')}"
+            + (f"/{verdict}" if verdict else "") + "] "
+            f"subject={inc.get('subject', '?')} "
+            f"mttd={_sec(inc.get('mttd_sec'))} "
+            f"mitigate={_sec(inc.get('mitigate_sec'))} "
+            f"mttr={_sec(inc.get('mttr_sec'))}")
+        spans, newest_by_rank = [], {}
+        for i, edge in enumerate(inc.get("chain") or []):
+            rank = _INCIDENT_RANK.get(edge.get("role"), 0)
+            parent = max((sid for r, sid in newest_by_rank.items()
+                          if r < rank), default=None)
+            spans.append({"span_id": i + 1, "parent_id": parent,
+                          "description": str(edge.get("summary")
+                                             or edge.get("kind") or "?"),
+                          "start_sec": edge.get("ts"),
+                          "stop_sec": edge.get("ts"), "edge": edge})
+            newest_by_rank[rank] = i + 1
+        for row in timeline_rows(spans):
+            edge = row["span"]["edge"]
+            out.append(
+                f"  +{row['offset_sec']:8.3f}s {'  ' * row['depth']}"
+                f"{edge.get('role', '?'):<10} "
+                f"{row['span']['description']} [{edge.get('src', '?')}]")
     return out
 
 

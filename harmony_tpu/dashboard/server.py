@@ -292,6 +292,38 @@ class DashboardServer:
             actions.append({"ts": ts, "job_id": jid, **p})
         return {"job_id": job_id, "actions": actions}
 
+    def incident_rows(self, job_id: Optional[str] = None,
+                      limit: int = 64) -> Dict[str, Any]:
+        """Incident lifecycle transitions the jobserver posted
+        (kind='incident' rows, metrics/incidents.py's dashboard tee),
+        deduplicated to the NEWEST transition per incident_id, oldest
+        first — the operator's causal fault→diagnosis→action→resolution
+        trail (docs/OBSERVABILITY.md §10)."""
+        limit = max(1, min(int(limit), MAX_QUERY_LIMIT))
+        if job_id is None:
+            rows = self._read_rows(
+                "SELECT ts, job_id, payload FROM metrics "
+                "WHERE kind = 'incident' ORDER BY id DESC LIMIT ?",
+                (limit * 4,))
+        else:
+            rows = self._read_rows(
+                "SELECT ts, job_id, payload FROM metrics "
+                "WHERE kind = 'incident' AND job_id = ? "
+                "ORDER BY id DESC LIMIT ?", (job_id, limit * 4))
+        newest: Dict[str, Dict[str, Any]] = {}
+        for ts, jid, payload in rows:  # newest first: first one wins
+            try:
+                p = json.loads(payload)
+            except ValueError:
+                continue  # one malformed POSTed row must not 400 the rest
+            iid = p.get("incident_id")
+            if not iid or iid in newest:
+                continue
+            newest[iid] = {"ts": ts, "job_id": jid, **p}
+        incidents = sorted(newest.values(),
+                           key=lambda p: p.get("opened_ts") or 0)[-limit:]
+        return {"job_id": job_id, "incidents": incidents}
+
     def critpath_rows(self, job_id: str,
                       limit: int = 64) -> List[Dict[str, Any]]:
         """One job's step-phase budget history from the stored
@@ -524,6 +556,67 @@ class DashboardServer:
                      ("push_comm", "#28c"), ("barrier_wait", "#e55"),
                      ("residual", "#bbb"))
 
+    @staticmethod
+    def _incidents_html(data: Dict[str, Any]) -> str:
+        """Incident panel (docs/OBSERVABILITY.md §10): one block per
+        incident — header with lifecycle status and MTTD/MTTR, then the
+        causal evidence chain as an offset timeline shaped through
+        tracing/timeline.py. Every payload string is HTML-escaped
+        (incident rows are client-POSTed data); unknown latencies
+        render '-', never 0."""
+        import html as _html
+
+        from harmony_tpu.tracing.timeline import timeline_rows
+
+        incidents = data.get("incidents") or []
+        head = ("<html><head><title>incidents</title></head><body>"
+                "<h1>incidents</h1>")
+        if not incidents:
+            return head + "<p>no incidents posted</p></body></html>"
+
+        def sec(v):
+            return "-" if not isinstance(v, (int, float)) else f"{v:.3f}s"
+
+        colors = {"trigger": "#d33", "diagnosis": "#d90",
+                  "action": "#46f", "resolution": "#2a2"}
+        blocks = []
+        for inc in incidents:
+            chain = [e for e in (inc.get("chain") or [])
+                     if isinstance(e, dict)]
+            spans = [{"span_id": i + 1, "parent_id": None,
+                      "description": str(e.get("summary")
+                                         or e.get("kind") or "?"),
+                      "start_sec": e.get("ts"), "stop_sec": e.get("ts"),
+                      "edge": e}
+                     for i, e in enumerate(chain)]
+            rows = []
+            for r in timeline_rows(spans):
+                e = r["span"]["edge"]
+                left = min(99.0, 100.0 * r["offset_sec"] / r["wall_sec"])
+                color = colors.get(str(e.get("role")), "#888")
+                rows.append(
+                    f"<tr><td>{_html.escape(str(e.get('role') or '?'))}"
+                    f"</td><td>+{r['offset_sec']:.3f}s</td>"
+                    f"<td>{_html.escape(r['span']['description'])}</td>"
+                    f"<td><div style='margin-left:{left:.1f}%;width:6px;"
+                    f"background:{color};height:10px'></div></td></tr>")
+            verdict = inc.get("verdict")
+            title = (f"{inc.get('incident_id', '?')} "
+                     f"[{inc.get('status', '?')}"
+                     + (f"/{verdict}" if verdict else "") + "]")
+            blocks.append(
+                f"<h3>{_html.escape(str(title))}</h3>"
+                f"<p>subject {_html.escape(str(inc.get('subject', '?')))}"
+                f" &middot; mttd {sec(inc.get('mttd_sec'))}"
+                f" &middot; mitigate {sec(inc.get('mitigate_sec'))}"
+                f" &middot; mttr {sec(inc.get('mttr_sec'))}</p>"
+                "<table border=0 width='100%'>"
+                "<tr><th align=left>role</th><th align=left>offset</th>"
+                "<th align=left>evidence</th><th width='40%'>timeline"
+                "</th></tr>" + "".join(rows) + "</table>")
+        return (head + f"<p>{len(incidents)} incident(s)</p>"
+                + "".join(blocks) + "</body></html>")
+
     @classmethod
     def _critpath_html(cls, job_id: str,
                        rows: List[Dict[str, Any]]) -> str:
@@ -755,6 +848,24 @@ class DashboardServer:
                         self._json(400, {"error": str(e)})
                         return
                     self._json(200, result)
+                elif parsed.path == "/api/incidents":
+                    try:
+                        result = server.incident_rows(
+                            job_id=one("job_id"),
+                            limit=_clamp_limit(one("limit"), default=64))
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
+                elif parsed.path == "/incidents":
+                    try:
+                        result = server.incident_rows(
+                            job_id=one("job_id"),
+                            limit=_clamp_limit(one("limit"), default=64))
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._html(server._incidents_html(result).encode())
                 elif parsed.path == "/api/jobs":
                     self._json(200, server.jobs())
                 elif parsed.path == "/api/tenants":

@@ -401,6 +401,14 @@ class HAController:
                 raise RuntimeError("lease lapsed during takeover")
             rearmed = self._rearm(server, state)
             self._seed_done(server, state)
+            # adopt the predecessor's persisted incidents: mid-flight
+            # episodes stay OPEN on this successor, so post-takeover
+            # resolution evidence still joins them (resolved ones land
+            # in the history ring; nothing is re-appended to the log)
+            try:
+                server.incidents.adopt(state.incidents)
+            except Exception:
+                pass  # incident history must never fail a takeover
         except BaseException:
             # a half-complete takeover must not leak a running server,
             # an open log handle, or a registered joblog sink into the

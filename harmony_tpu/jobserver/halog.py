@@ -654,6 +654,10 @@ class ReplayState:
         self.chains: Dict[str, str] = {}
         #: takeover history entries, oldest first
         self.takeovers: List[Dict[str, Any]] = []
+        #: incident_id -> newest persisted incident transition
+        #: (kind="incident") — a successor leader adopts the
+        #: non-resolved ones so mid-flight episodes stay open
+        self.incidents: Dict[str, Dict[str, Any]] = {}
         self.max_epoch = 0
         self.max_seq = 0
         #: deposed-leader writes rejected during replay (fencing proof)
@@ -683,6 +687,10 @@ class ReplayState:
                 st.chains[job] = str(e["chkp_id"])
             elif kind == "leader_takeover":
                 st.takeovers.append(e)
+            elif kind == "incident" and e.get("incident_id"):
+                # newest transition wins (entries are seq-sorted); the
+                # engine's adopt() re-opens the non-resolved ones
+                st.incidents[str(e["incident_id"])] = e
             if job and "attempt" in e:
                 try:
                     st.attempts[job] = max(st.attempts.get(job, 0),
@@ -703,6 +711,7 @@ class ReplayState:
             "done": len(self.done),
             "chains": len(self.chains),
             "takeovers": len(self.takeovers),
+            "incidents": len(self.incidents),
             "max_epoch": self.max_epoch,
             "max_seq": self.max_seq,
             "rejected_stale": self.rejected_stale,

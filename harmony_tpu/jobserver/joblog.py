@@ -27,6 +27,51 @@ server_log = logging.getLogger("harmony_tpu.jobserver")
 # can surface them without log scraping. Per-process, bounded, in-memory
 # — the durable record is still the operator log.
 
+#: Declared event-kind catalog (the doctor_rule precedent, applied to
+#: the event stream itself): every ``kind=`` a production module passes
+#: to :func:`record_event` / :meth:`JobLogger.event` — including the
+#: flight-ring-only evidence kinds — is declared here with its emitter
+#: and meaning. The ``event-kind-registry`` harmonylint pass enforces
+#: two-way parity between this catalog, the literal kinds emitted in
+#: code, and the event-kind table in docs/OBSERVABILITY.md §10 — an
+#: undeclared kind is invisible to the incident engine's role
+#: classification (metrics/incidents.py) and to operators grepping the
+#: docs. Dynamic kinds (the elastic f-strings) are declared per
+#: expansion.
+EVENT_KINDS: Dict[str, str] = {
+    "slo": "dolphin/worker.py: per-epoch SLO attainment sample",
+    "process_restart": "metrics/history.py: scrape-target process "
+                       "restart detected (counter reset)",
+    "diagnosis": "metrics/doctor.py: structured doctor verdict",
+    "leader_takeover": "jobserver/ha.py: HA leader transition",
+    "overload": "jobserver/overload.py: control-plane ladder move",
+    "policy": "jobserver/policy.py: device policy action (advised or "
+              "acted)",
+    "elastic_restore": "jobserver/entity.py: elastic attempt restored "
+                       "from checkpoint",
+    "elastic_give_up": "jobserver/pod.py: elastic retry budget "
+                       "exhausted",
+    "follower_silenced": "jobserver/pod.py: flapping follower confined",
+    "follower_rehabilitated": "jobserver/pod.py: confined follower "
+                              "readmitted",
+    "elastic_shrink": "jobserver/pod.py: attempt shrunk around a death",
+    "elastic_regrow": "jobserver/pod.py: attempt regrown onto "
+                      "recovered workers",
+    "elastic_shrink_fence": "jobserver/pod.py: lockstep fence for a "
+                            "shrink scheduled",
+    "elastic_regrow_fence": "jobserver/pod.py: lockstep fence for a "
+                            "regrow scheduled",
+    "chkp_chain": "checkpoint/manager.py: chained checkpoint committed",
+    "incident": "metrics/incidents.py: incident lifecycle transition "
+                "(open/mitigating/resolved)",
+    "fault_trip": "tracing/flight.py: fault-injection site fired "
+                  "(flight ring)",
+    "follower_death": "jobserver/pod.py: follower death observed "
+                      "(flight ring)",
+    "follower_job_failed": "jobserver/pod.py: follower-side job "
+                           "failure (flight ring)",
+}
+
 _EVENTS_LOCK = threading.Lock()
 _EVENTS: Dict[str, List[Dict[str, Any]]] = {}
 _EVENTS_PER_JOB = 64

@@ -209,6 +209,17 @@ class JobServer:
             leader_ok_fn=self._ha_leader_ok,
             sinks=(self._post_policy,),
         )
+        # Incident correlation (metrics/incidents.py): folds the joblog
+        # stream + flight-ring fault evidence into open→mitigating→
+        # resolved incidents with causal chains and MTTD/MTTR. Runs on
+        # the same scrape cycle as the doctor/policy; incidents persist
+        # as kind="incident" joblog events so the HA tee makes them
+        # survive a leader takeover (ha.py adopts the replayed set).
+        from harmony_tpu.metrics.incidents import IncidentEngine, \
+            set_incidents
+
+        self.incidents = IncidentEngine(sinks=(self._post_incident,))
+        set_incidents(self.incidents)
         # Control-plane HA (jobserver/ha.py): wired by enable_ha when
         # this server is one replica of an HA control plane. leader_epoch
         # stamps every durable log entry and pod RUN_JOB/PLAN message so
@@ -451,6 +462,11 @@ class JobServer:
 
             if peek_doctor() is self.doctor:
                 set_doctor(None)
+            from harmony_tpu.metrics.incidents import peek_incidents, \
+                set_incidents as _set_incidents
+
+            if peek_incidents() is self.incidents:
+                _set_incidents(None)
             if self.metrics_exporter is not None:
                 self.metrics_exporter.stop()
                 self.metrics_exporter = None
@@ -774,6 +790,12 @@ class JobServer:
         except Exception:
             pass
         ov.note_cycle("plan", time.monotonic() - t0, period)
+        t0 = time.monotonic()
+        try:
+            self.incidents.correlate()
+        except Exception:
+            pass
+        ov.note_cycle("correlate", time.monotonic() - t0, period)
         ov.step()
 
     def _policy_tenants(self) -> Dict[str, Dict[str, Any]]:
@@ -807,6 +829,17 @@ class JobServer:
             try:
                 self._dashboard.post(diag.subject, "diagnosis",
                                      diag.to_dict())
+            except Exception:
+                pass  # dashboard posts are best-effort by contract
+
+    def _post_incident(self, incident: Dict[str, Any]) -> None:
+        """Incident-engine sink: tee every lifecycle transition to the
+        dashboard as a kind="incident" row (same best-effort contract
+        as metric posts) so the /incidents panel can render timelines."""
+        if self._dashboard is not None:
+            try:
+                self._dashboard.post(str(incident.get("subject")),
+                                     "incident", dict(incident))
             except Exception:
                 pass  # dashboard posts are best-effort by contract
 
@@ -933,6 +966,10 @@ class JobServer:
             # level, queue fill/lag, shed counters and the recovery
             # gate — the operator's "is fidelity degraded, and why"
             "overload": self.overload.status(),
+            # incident correlation (metrics/incidents.py): open/
+            # mitigating/resolved counts, MTTR, and the newest causal
+            # chains — what `harmony-tpu obs incidents` renders
+            "incidents": self.incidents.status(),
         }
 
     # -- TCP command endpoint (ref: CommandListener) ---------------------

@@ -106,6 +106,20 @@ def _diagnoses_snapshot() -> List[Dict[str, Any]]:
         return []
 
 
+def _incidents_snapshot() -> List[Dict[str, Any]]:
+    """Open incidents for a dump, or [] — same contract as the doctor
+    snapshot: peek, never create. A crash dump that carries the
+    incident narrative that was in flight answers "what episode was
+    this process in the middle of" without the leader's STATUS."""
+    try:
+        from harmony_tpu.metrics.incidents import peek_incidents
+
+        eng = peek_incidents()
+        return eng.open_incidents() if eng is not None else []
+    except Exception:
+        return []
+
+
 def _attempt_key(ctx: Dict[str, Any]) -> Optional[str]:
     """The ``job@aN`` attempt key a trigger context names, if any (same
     scheme as jobserver/elastic.attempt_key, inlined so the tracing
@@ -150,6 +164,14 @@ class FlightRecorder(SpanReceiver):
         with self._lock:
             return len(self._ring)
 
+    def ring_events(self) -> List[Dict[str, Any]]:
+        """Structured (non-span) ring records, oldest first — the fault
+        evidence (``fault_trip``, ``follower_death``, ...) the incident
+        engine correlates against the joblog stream."""
+        with self._lock:
+            return [dict(r) for r in self._ring
+                    if r.get("kind") == "event"]
+
     # -- dump ------------------------------------------------------------
 
     def dump(self, reason: str, **meta: Any) -> Optional[str]:
@@ -188,6 +210,10 @@ class FlightRecorder(SpanReceiver):
             # died (metrics/doctor.py) — a dump with "input_bound on
             # tenant X" inside answers the post-mortem's first question
             "diagnoses": _diagnoses_snapshot(),
+            # the incident narrative in flight when this process died
+            # (metrics/incidents.py): open episodes with their causal
+            # chains, beside the diagnoses that fed them
+            "incidents": _incidents_snapshot(),
             "records": records,
         }
         path = os.path.join(
@@ -226,8 +252,15 @@ class FlightRecorder(SpanReceiver):
         """Fault-site trip: always an event in the ring; ONE dump per
         site per process (repeat fires of the same site would bury the
         first — and most diagnostic — ring snapshot under copies)."""
-        fields = {k: v for k, v in ctx.items()
-                  if isinstance(v, (str, int, float, bool, type(None)))}
+        # ctx keys that collide with the ring-record envelope (fault
+        # rules match on a ``kind`` field, which would shadow the event
+        # kind) get a ctx_ prefix instead of being dropped
+        fields = {}
+        for k, v in ctx.items():
+            if not isinstance(v, (str, int, float, bool, type(None))):
+                continue
+            fields[f"ctx_{k}" if k in ("kind", "event", "ts", "site",
+                                       "action") else k] = v
         self.event("fault_trip", site=site, action=action, **fields)
         with self._lock:
             if site in self._dumped_sites:

@@ -1,0 +1,24 @@
+"""KNOWN-BAD fixture tree for the event-kind-registry pass, all three
+directions red at once:
+
+* ``mystery_kind`` is emitted but never declared in ``EVENT_KINDS`` —
+  the typo'd-kind failure: it records fine and correlates as nothing;
+* the catalog declares ``ghost_kind`` but the doc table has no row —
+  operators grepping the docs never learn it exists;
+* the doc table has a ``phantom_kind`` row the catalog never declares —
+  a dead row documenting events that can never appear.
+"""
+
+EVENT_KINDS = {
+    "recovery": "pkg/events.py: attempt recovered",
+    "ghost_kind": "pkg/events.py: declared but never tabled",
+}
+
+
+def record_event(job_id, kind, **fields):
+    return {"kind": kind, **fields}
+
+
+def on_recover(job_id):
+    record_event(job_id, "recovery", outcome="ok")
+    record_event(job_id, "mystery_kind", oops=True)  # undeclared

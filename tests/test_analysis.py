@@ -237,6 +237,35 @@ class TestPassFixtures:
         r = _lint_tree("fault_site_registry_fixed", "fault-site-registry")
         assert r.ok, render_text(r)
 
+    def test_event_kind_registry_flags_all_three_directions(self):
+        r = _lint_tree("event_kind_registry_bad", "event-kind-registry")
+        msgs = [f.message for f in r.findings]
+        assert any("mystery_kind" in m and "not declared" in m
+                   for m in msgs), msgs
+        assert any("ghost_kind" in m and "no docs/OBSERVABILITY.md" in m
+                   for m in msgs), msgs
+        assert any("phantom_kind" in m and "not declared" in m
+                   for m in msgs), msgs
+        # the doc-side finding anchors at the table row
+        doc = [f for f in r.findings if f.file.startswith("docs/")]
+        assert doc and doc[0].line > 1
+
+    def test_event_kind_registry_accepts_consistent_tree(self):
+        r = _lint_tree("event_kind_registry_fixed", "event-kind-registry")
+        assert r.ok, render_text(r)
+
+    def test_event_kind_registry_partial_run_skips_doc_parity(self):
+        # a single-file slice must only check the emit→catalog
+        # direction: it cannot prove a catalog kind is untabled
+        root = os.path.join(FIXTURES, "event_kind_registry_bad")
+        r = run_lint(files=[os.path.join(root, "pkg", "events.py")],
+                     repo_root=root,
+                     passes=[get_pass("event-kind-registry")])
+        msgs = [f.message for f in r.findings]
+        assert any("mystery_kind" in m for m in msgs), msgs
+        assert not any("ghost_kind" in m or "phantom_kind" in m
+                       for m in msgs), msgs
+
     def test_knob_consistency_flags_all_three_directions(self):
         r = _lint_tree("knob_consistency_bad", "knob-consistency")
         msgs = [f.message for f in r.findings]

@@ -224,3 +224,131 @@ def test_file_corpus_keys_pin_real_signature():
 
     assert FILE_CORPUS_KEYS == frozenset(
         inspect.signature(load_text_tokens).parameters)
+
+
+# -- obs output contracts ---------------------------------------------------
+
+#: one canned STATUS reply serving every obs subcommand, with an open
+#: incident whose latencies are still unknown (the '-' contract)
+_OBS_STATUS = {
+    "ok": True,
+    "tenants": {"t0": {"device_time_ms": 12.5}},
+    "overload": {},
+    "diagnoses": [{"tenant": "t0", "verdict": "input_bound"}],
+    "history": {"epochs": 3},
+    "phase_budget": {"t0": {"compute_ms": 9.0}},
+    "policy": {"decisions": []},
+    "incidents": {
+        "open": 1, "mitigating": 0, "resolved": 0, "adopted": 0,
+        "window_sec": 120.0, "mttr_mean_sec": None,
+        "incidents": [{
+            "incident_id": "t0:slo:1", "subject": "t0", "status": "open",
+            "trigger_kind": "slo", "opened_ts": 100.0, "last_ts": 100.5,
+            "mttd_sec": None, "mitigate_sec": None, "mttr_sec": None,
+            "verdict": None,
+            "chain": [
+                {"role": "trigger", "kind": "slo", "src": "joblog",
+                 "ts": 100.0, "summary": "slo"},
+                {"role": "diagnosis", "kind": "diagnosis", "src": "joblog",
+                 "ts": 100.5, "summary": "diagnosis verdict=input_bound",
+                 "verdict": "input_bound"},
+            ],
+        }],
+    },
+    "flight_records": [],
+    "stragglers": {},
+    "metrics_port": None,
+    "profile_capture": None,
+}
+
+
+class _FakeObsSender:
+    def __init__(self, reply):
+        self._reply = reply
+
+    def send_status_command(self):
+        reply = self._reply
+        if isinstance(reply, BaseException):
+            raise reply
+        return reply
+
+
+#: what `--json` must emit per subcommand: the named STATUS section(s),
+#: verbatim — scripts parse this shape
+_OBS_JSON_CONTRACT = {
+    "top": lambda s: s["tenants"],
+    "doctor": lambda s: {"diagnoses": s["diagnoses"],
+                         "history": s["history"]},
+    "critpath": lambda s: s["phase_budget"],
+    "plan": lambda s: s["policy"],
+    "incidents": lambda s: s["incidents"],
+}
+
+
+@pytest.mark.parametrize("what", sorted(_OBS_JSON_CONTRACT))
+def test_obs_json_contract(what, monkeypatch, capsys):
+    """Every STATUS-backed obs subcommand honors --json with the raw
+    section of the canned STATUS, parseable and verbatim."""
+    from harmony_tpu import cli
+
+    monkeypatch.setattr(cli, "_obs_status_sender",
+                        lambda kind, ep: _FakeObsSender(_OBS_STATUS))
+    rc = main(["obs", what, "--port", "1", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert json.loads(out) == _OBS_JSON_CONTRACT[what](_OBS_STATUS)
+
+
+@pytest.mark.parametrize("what", sorted(_OBS_JSON_CONTRACT))
+def test_obs_not_ok_status_is_one_json_line(what, monkeypatch, capsys):
+    from harmony_tpu import cli
+
+    refusal = {"ok": False, "error": "no leader"}
+    monkeypatch.setattr(cli, "_obs_status_sender",
+                        lambda kind, ep: _FakeObsSender(refusal))
+    rc = main(["obs", what, "--port", "1"])
+    assert rc == 1
+    assert json.loads(capsys.readouterr().out) == refusal
+
+
+def test_obs_incidents_renders_unknowns_as_dash(monkeypatch, capsys):
+    """An open incident has no MTTR/MTTD yet: the human view must say
+    '-', never 0 (a zero latency would be a lie)."""
+    from harmony_tpu import cli
+
+    monkeypatch.setattr(cli, "_obs_status_sender",
+                        lambda kind, ep: _FakeObsSender(_OBS_STATUS))
+    rc = main(["obs", "incidents", "--port", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "mttd=- mitigate=- mttr=-" in out
+    assert "mean_mttr=-" in out
+    assert "mttr=0.000" not in out
+    # the causal chain renders as a timeline, diagnosis under trigger
+    assert "trigger" in out and "verdict=input_bound" in out
+
+
+@pytest.mark.parametrize("what",
+                         sorted(_OBS_JSON_CONTRACT) + ["flight"])
+def test_obs_survives_broken_pipe(what, monkeypatch, capfd):
+    """obs output is made for `| head`: a closed pipe ends the command
+    quietly (exit 0), never a stack trace."""
+    import os
+    import sys
+
+    from harmony_tpu import cli
+
+    monkeypatch.setattr(
+        cli, "_obs_status_sender",
+        lambda kind, ep: _FakeObsSender(BrokenPipeError()))
+    # the handler points sys.stdout's REAL fd at /dev/null (that's the
+    # point); save and restore it so the test runner keeps its stdout
+    fd = sys.stdout.fileno()
+    saved = os.dup(fd)
+    try:
+        rc = main(["obs", what, "--port", "1"])
+    finally:
+        os.dup2(saved, fd)
+        os.close(saved)
+    assert rc == 0
+    assert "Traceback" not in capfd.readouterr().err

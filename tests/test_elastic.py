@@ -420,7 +420,11 @@ class TestSilenceMonitorAndReinstatement:
             fake.heartbeat()
             fake.close()  # reader EOF -> death confinement
             deadline = time.monotonic() + 15
-            while time.monotonic() < deadline and 1 not in srv._dead_followers:
+            # the reader thread adds to _dead_followers BEFORE it runs
+            # confinement + _mark_broken, so poll the broken flag too
+            while (time.monotonic() < deadline
+                   and not (1 in srv._dead_followers
+                            and srv._status()["pod"]["broken"])):
                 time.sleep(0.05)
             assert 1 in srv._dead_followers
             assert srv._status()["pod"]["broken"]

@@ -229,7 +229,15 @@ class TestServiceEndToEnd:
 
     def test_stats_over_the_wire(self, service):
         list(fetch_epoch(service.address, mlr_spec(), 0, tenant="s"))
-        st = fetch_stats(service.address)
+        # the service counts each batch AFTER the send that delivers it,
+        # so the client can observe stats before the final increment
+        # lands — poll until the counter settles
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = fetch_stats(service.address)
+            if st["tenants"].get("s", {}).get("batches", 0) >= 4:
+                break
+            time.sleep(0.02)
         assert st["batches_assembled"] >= 4
         assert st["tenants"]["s"]["batches"] == 4
 

@@ -759,7 +759,11 @@ class TestFailoverUnderStorm:
         failover = CommandSender(addrs=[a_addr, f"127.0.0.1:{b.port}"])
         for i, r in oks:
             result = failover.wait_result(f"storm-{i}", timeout=120)
-            assert result["workers"], f"storm-{i} lost after ack"
+            # resolved either by re-arming here (full worker payload) or
+            # as a replayed terminal outcome when the old leader finished
+            # it before its lease lapsed (_seed_done strips the payload)
+            assert result.get("workers") or result.get("replayed"), \
+                f"storm-{i} lost after ack: {result}"
         # and B's plane reports its overload state (re-armed, normal
         # or degraded — never wedged)
         status = CommandSender(b.port).send_status_command()
