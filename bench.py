@@ -683,6 +683,14 @@ def emit(tpu_rate: float, cpu_rate: float, error: str | None = None,
         # means an invariant went red on a pinned schedule (the full
         # sweep is benchmarks/CHAOS_r18.json, run by bin/chaos.sh)
         line["chaos"] = cho
+    srv = measure_serving()
+    if srv is not None:
+        # online-serving probe: a short closed-loop read storm against a
+        # live table through the micro-batching endpoint; --compare holds
+        # serving.qps (higher=better) and serving.p99_ms (LOWER=better)
+        # so a latency regression in the read path fails
+        # bin/bench_diff.sh (pinned A/B grid: benchmarks/SERVING_r20.json)
+        line["serving"] = srv
     oin = measure_obs_incidents()
     if oin is not None:
         # incident-correlation probe: a synthetic fault→diagnosis→
@@ -1047,6 +1055,107 @@ def measure_obs_incidents() -> "dict | None":
         return None
 
 
+def measure_serving() -> "dict | None":
+    """Online-serving probe (tracked round over round in the BENCH json,
+    and by --compare via serving.qps / serving.p99_ms): a short
+    closed-loop read storm — 4 client threads, skewed keys — against a
+    small live DenseTable through the micro-batching ServingEndpoint
+    (batch window + hot-row cache on, the production defaults).
+    Returns {qps, p50_ms, p99_ms, cache_hit_rate, batch_occupancy} or
+    None — the bench line must never die for its serving hook. The
+    pinned batching×cache×training A/B grid is
+    benchmarks/SERVING_r20.json (benchmarks/serving_bench.py)."""
+    try:
+        import threading as _th
+
+        import numpy as np
+
+        from harmony_tpu.config.params import TableConfig
+        from harmony_tpu.parallel import build_mesh
+        from harmony_tpu.serving import ServingEndpoint
+        from harmony_tpu.serving import protocol as _sp
+        from harmony_tpu.table import DenseTable, TableSpec
+
+        mesh = build_mesh(jax.devices("cpu")[:1])
+        cap, width = 1024, 32
+        table = DenseTable(
+            TableSpec(TableConfig(table_id="bench-serve", capacity=cap,
+                                  value_shape=(width,), num_blocks=8)),
+            mesh)
+        table.multi_put(np.arange(cap, dtype=np.int32),
+                        np.ones((cap, width), np.float32))
+        ep = ServingEndpoint(table_fn=lambda job: table, cache_mb=8,
+                             window_ms=2.0)
+        ep.start()
+        lat_ms: "list[float]" = []
+        lock = _th.Lock()
+        threads_n, reads_per = 4, 40
+        rng = np.random.default_rng(7)
+        # skewed key draw: a hot head so the cache has something to do
+        hot = rng.integers(0, 64, size=(threads_n, reads_per, 12))
+        cold = rng.integers(0, cap, size=(threads_n, reads_per, 4))
+
+        def client(i):
+            sock = _sp.connect(("127.0.0.1", ep.port))
+            try:
+                mine = []
+                for r in range(reads_per):
+                    keys = np.concatenate(
+                        [hot[i, r], cold[i, r]]).astype(np.int32)
+                    t0 = time.perf_counter()
+                    _sp.send_arrays(sock, {"op": "lookup", "r": r,
+                                           "job": "bench", "mode": "live"},
+                                    (keys,))
+                    frame = _sp.recv_frame(sock)
+                    dt = (time.perf_counter() - t0) * 1000.0
+                    if frame and frame.get("op") == "rows":
+                        mine.append(dt)
+                with lock:
+                    lat_ms.extend(mine)
+            finally:
+                sock.close()
+
+        def storm():
+            ths = [_th.Thread(target=client, args=(i,))
+                   for i in range(threads_n)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=120)
+
+        # warmup: a full concurrent pass, so the coalesced gather
+        # shapes the measured storm will hit are already compiled
+        storm()
+        with lock:
+            lat_ms.clear()
+        t0 = time.perf_counter()
+        storm()
+        wall = time.perf_counter() - t0
+        st = ep.stats()
+        ep.stop()
+        if not lat_ms or wall <= 0:
+            return None
+        ordered = sorted(lat_ms)
+
+        def pct(p):
+            return ordered[min(len(ordered) - 1,
+                               int(p * (len(ordered) - 1)))]
+
+        cache = st.get("cache") or {}
+        hits = cache.get("hits", 0)
+        lookups = hits + cache.get("misses", 0)
+        return {
+            "qps": round(len(lat_ms) / wall, 1),
+            "p50_ms": round(pct(0.50), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "cache_hit_rate": (round(hits / lookups, 3)
+                               if lookups else None),
+            "batch_occupancy": st.get("batch_occupancy"),
+        }
+    except Exception:
+        return None
+
+
 def measure_lint() -> "dict | None":
     """harmonylint-suite runtime probe (tracked round over round in the
     BENCH json): one full run over harmony_tpu/. Returns {"lint.wall_ms",
@@ -1091,11 +1200,19 @@ def measure_lint() -> "dict | None":
 #: PR 18, skipped the same way); `obs_incidents.recall` tracks the
 #: incident engine's synthetic correlation probe — a drop means seeded
 #: fault→diagnosis→action→resolution episodes stopped folding into
-#: resolved incidents (absent before PR 19, skipped the same way).
+#: resolved incidents (absent before PR 19, skipped the same way); the
+#: `serving.*` pair tracks the online read path (absent before PR 20,
+#: skipped the same way) — serving.qps is higher-is-better like the
+#: rest, serving.p99_ms is in LOWER_IS_BETTER so --compare fails on a
+#: latency RISE, not a drop.
 HEADLINE_SERIES = ("value", "cpu_rate", "input_service.svc_sps",
                    "autoscale.agg_sps", "autoscale.slo_attainment",
                    "async_step.b1_sps", "chaos.scenarios_ok",
-                   "obs_incidents.recall")
+                   "obs_incidents.recall", "serving.qps",
+                   "serving.p99_ms")
+#: series where a smaller number is the good direction (latencies):
+#: compare_bench inverts the regression test for these
+LOWER_IS_BETTER = frozenset({"serving.p99_ms"})
 COMPARE_THRESHOLD = 0.15
 
 
@@ -1158,11 +1275,12 @@ def compare_bench(old_path: str, new_path: str,
                   series=HEADLINE_SERIES,
                   threshold: float = COMPARE_THRESHOLD) -> dict:
     """Diff two committed rounds on the named headline series. A series
-    REGRESSES when both rounds measured it and the new value fell more
-    than ``threshold`` below the old; a series only one round measured
-    is reported as skipped (with the reason), never failed — an
-    unreachable accelerator is a transport state, not a code
-    regression."""
+    REGRESSES when both rounds measured it and the new value moved more
+    than ``threshold`` in the BAD direction — below the old for the
+    default higher-is-better series, above it for LOWER_IS_BETTER ones
+    (latencies); a series only one round measured is reported as
+    skipped (with the reason), never failed — an unreachable
+    accelerator is a transport state, not a code regression."""
     old_line, new_line = _bench_line(old_path), _bench_line(new_path)
     report = {
         "old": os.path.basename(old_path),
@@ -1183,7 +1301,12 @@ def compare_bench(old_path: str, new_path: str,
             report["series"][name] = row
             continue
         row["ratio"] = round(new_v / old_v, 4) if old_v else None
-        if old_v > 0 and new_v < old_v * (1.0 - threshold):
+        if name in LOWER_IS_BETTER:
+            row["direction"] = "lower-is-better"
+            regressed = old_v > 0 and new_v > old_v * (1.0 + threshold)
+        else:
+            regressed = old_v > 0 and new_v < old_v * (1.0 - threshold)
+        if regressed:
             row["status"] = "regression"
             report["regressions"].append(name)
         else:
@@ -1205,7 +1328,8 @@ def compare_main(argv) -> int:
                     help="where the committed BENCH_r*.json live "
                          "(default: beside bench.py)")
     ap.add_argument("--series", default=",".join(HEADLINE_SERIES),
-                    help="comma-separated headline series (higher=better)")
+                    help="comma-separated headline series (higher=better "
+                         "unless listed in LOWER_IS_BETTER)")
     ap.add_argument("--threshold", type=float, default=COMPARE_THRESHOLD,
                     help="allowed fractional drop before failing")
     ap.add_argument("files", nargs="*",

@@ -1216,7 +1216,27 @@ def _render_tenant_top(tenants: dict) -> "List[str]":
     out = _render_table(rows)
     if len(rows) == 1:
         out.append("(no tenant activity recorded)")
+    for job, r in sorted(tenants.items()):
+        srv = r.get("serving") or {}
+        if not srv.get("enabled"):
+            continue
+        out.append(
+            f"serving {r.get('job', job)}: "
+            f"qps {_srv_num(srv.get('qps'), '{:.1f}')}  "
+            f"p50 {_srv_num(srv.get('p50_ms'), '{:.1f}ms')}  "
+            f"p99 {_srv_num(srv.get('p99_ms'), '{:.1f}ms')}"
+            + (f" (slo {srv['slo_p99_ms']:.0f}ms)"
+               if srv.get("slo_p99_ms") is not None else "")
+            + f"  occupancy {_srv_num(srv.get('batch_occupancy'), '{:.1f}')}"
+            f"  cache hit "
+            f"{_srv_num(srv.get('cache_hit_rate'), '{:.1%}')}")
     return out
+
+
+def _srv_num(v, fmt: str) -> str:
+    """Serving cells follow the table's unknown-vs-zero contract: an
+    unmeasured quantity renders '-', never a fake 0."""
+    return "-" if v is None else fmt.format(float(v))
 
 
 def _cmd_start_jobserver(args: argparse.Namespace) -> int:

@@ -294,6 +294,12 @@ class CommandSender:
             "dst": dst, "num_blocks": num_blocks, "epoch": epoch,
         })
 
+    def send_serving_command(self) -> Dict[str, Any]:
+        """Resolve (and start on demand) the leader's serving endpoint
+        (harmony_tpu/serving): leader-gated server-side, so the reply's
+        ``host:port`` always names the replica that owns live tables."""
+        return self._roundtrip({"command": "SERVING"})
+
     def send_shutdown_command(self) -> Dict[str, Any]:
         return self._roundtrip({"command": "SHUTDOWN"})
 

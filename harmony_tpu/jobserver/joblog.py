@@ -40,6 +40,8 @@ server_log = logging.getLogger("harmony_tpu.jobserver")
 #: expansion.
 EVENT_KINDS: Dict[str, str] = {
     "slo": "dolphin/worker.py: per-epoch SLO attainment sample",
+    "serving_slo": "serving/service.py: windowed serving p99 over the "
+                   "tenant's latency objective",
     "process_restart": "metrics/history.py: scrape-target process "
                        "restart detected (counter reset)",
     "diagnosis": "metrics/doctor.py: structured doctor verdict",

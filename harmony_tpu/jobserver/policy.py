@@ -69,7 +69,13 @@ ENV_MAX_ACTIONS = "HARMONY_POLICY_MAX_ACTIONS"
 #: the engine's action vocabulary — gate sweeps are scoped to it so a
 #: SHARED gate's other tenants (the input autoscaler's "up"/"down"
 #: keys) keep their streaks
-_ACTION_KINDS = frozenset(("grow", "shrink", "pack", "preempt", "async"))
+_ACTION_KINDS = frozenset(
+    ("grow", "shrink", "pack", "preempt", "async", "protect"))
+
+#: a serving tenant whose windowed p99 is at/over this fraction of its
+#: registered SLO is latency-critical: the `protect` action pins its
+#: executors out of pack/preempt victim selection
+_PROTECT_RATIO = 0.8
 
 #: bound classifications under which a tenant is a PACK victim — the
 #: device sits idle beneath it, so overlapping a sibling costs little
@@ -297,8 +303,11 @@ class PolicyAction:
         ride the re-grow fence, every reduction/consolidation the
         shrink fence. `async` keeps the SAME executor set — it rides the
         re-grow fence (no survivors-only retile; the next attempt merely
-        relaunches with the async knob pinned)."""
-        return "regrow" if self.kind in ("grow", "async") else "shrink"
+        relaunches with the async knob pinned). `protect` never reaches
+        a fence at all (its actuator is planner-side victim exemption);
+        it classes with the non-reductions."""
+        return ("regrow" if self.kind in ("grow", "async", "protect")
+                else "shrink")
 
     def to_dict(self) -> Dict[str, Any]:
         return {s: getattr(self, s) for s in self.__slots__}
@@ -360,6 +369,12 @@ class PolicyEngine:
         #: advances the plan is in flight — re-fencing it would stack
         #: redundant fences on the same attempt
         self._inflight: Dict[str, int] = {}
+        #: job -> monotonic ts of its last fired `protect` action: while
+        #: fresh, the tenant's executors are exempt from pack/preempt
+        #: victim selection. TTL-scoped (protected_jobs) so a tenant
+        #: whose latency recovered — or whose serving traffic stopped —
+        #: rejoins the victim pool without an explicit release action
+        self._protected: Dict[str, float] = {}
 
     # -- cadence ---------------------------------------------------------
 
@@ -457,6 +472,18 @@ class PolicyEngine:
 
     # -- decision --------------------------------------------------------
 
+    def protected_jobs(self, now: Optional[float] = None) -> "set[str]":
+        """Tenants currently pinned by a fired `protect` action. Pins
+        age out after a few periods — protection must be re-earned from
+        live latency, exactly like every other signal-driven streak."""
+        now = time.monotonic() if now is None else float(now)
+        ttl = max(3.0 * policy_period(), policy_cooldown())
+        with self._lock:
+            for job in [j for j, ts in self._protected.items()
+                        if now - ts > ttl]:
+                del self._protected[job]
+            return set(self._protected)
+
     def _decide(self, rows: Dict[str, Any], tenants: Dict[str, Any],
                 idle: List[str], queued: List[Any],
                 considered: List[Dict[str, Any]],
@@ -521,6 +548,36 @@ class PolicyEngine:
         if units is None:
             units = [[e] for e in idle]
         actions: List[PolicyAction] = []
+        # latency-sensitive serving tenants near/over their p99 SLO earn
+        # a `protect` pin (gated and judged like every other action):
+        # while pinned, their executors are exempt from pack/preempt
+        # victim selection below
+        protected = self.protected_jobs()
+        for job in sorted(tenants):
+            srv = row(job).get("serving") or {}
+            p99 = srv.get("p99_ms")
+            slo = srv.get("slo_p99_ms")
+            if not srv.get("enabled") or p99 is None or not slo:
+                continue
+            note = {"job": job, "check": "protect", "p99_ms": p99,
+                    "slo_p99_ms": slo}
+            if p99 < float(slo) * _PROTECT_RATIO:
+                note["blocked"] = "serving latency within SLO headroom"
+            else:
+                actions.append(PolicyAction(
+                    "protect", job,
+                    list((tenants.get(job) or {}).get("executors") or ()),
+                    signal="serving_latency",
+                    reason=(f"serving p99 {p99:.1f}ms at/over "
+                            f"{_PROTECT_RATIO:.0%} of its {float(slo):.1f}ms "
+                            "SLO: exempting executors from pack/preempt "
+                            "victim selection"),
+                    evidence={"serving": dict(srv)}))
+                # the pin covers THIS cycle's victim sweep too — deciding
+                # protect and preempt for the same tenant in one plan
+                # would be self-contradictory
+                protected.add(job)
+            considered.append(note)
         if async_wants:
             # one async action per cycle (same ramp discipline as grow);
             # the executor set is UNCHANGED — the fence relaunches the
@@ -562,13 +619,17 @@ class PolicyEngine:
         claim_prio, claim_job = max(claimants)
         # strictly lower priority only — equal priority never preempts
         # (or shrinks, or packs): contention between peers is the fair
-        # queue's job, not the policy's
+        # queue's job, not the policy's. Tenants under an active
+        # `protect` pin are exempt outright: a latency-critical serving
+        # tenant's executors are not contention inventory
         victims = sorted(
-            (j for j in tenants if prio(j) < claim_prio and j != claim_job),
+            (j for j in tenants if prio(j) < claim_prio and j != claim_job
+             and j not in protected),
             key=lambda j: (prio(j), j))
         note = {"check": "contention", "claimant": claim_job,
                 "claim_priority": claim_prio,
-                "victims": list(victims)}
+                "victims": list(victims),
+                "protected": sorted(protected)}
         considered.append(note)
         for victim in victims:
             t = tenants.get(victim) or {}
@@ -648,6 +709,20 @@ class PolicyEngine:
             a.outcome = "rejected_not_leader"
             with self._lock:
                 self._rejected_total += 1
+            self._record(a)
+            return
+        if a.kind == "protect":
+            # the protect actuator is planner-side state, not a fence:
+            # the pin exempts the tenant from victim selection in every
+            # later window until it ages out. It executes in advise
+            # mode too — exempting a victim moves no executor, so the
+            # "advisory plans never reshape the pod" contract holds
+            a.executed = True
+            a.outcome = "pinned"
+            self.gate.fired(a.job, a.kind, signal=a.signal, now=now)
+            with self._lock:
+                self._actions_total += 1
+                self._protected[a.job] = now
             self._record(a)
             return
         if mode != "act" or self._fence_fn is None:
@@ -754,6 +829,7 @@ class PolicyEngine:
                 "last_plan": dict(self._last_plan),
                 "recent_actions": list(self._recent)[-16:],
                 "gate": self.gate.stats(),
+                "protected": sorted(self._protected),
             }
 
     @staticmethod

@@ -170,6 +170,11 @@ class JobServer:
         # by the ledger-fed autoscaler, surfaced via STATUS.
         self.input_service = None
         self._input_autoscaler = None
+        # Embedded serving plane (harmony_tpu/serving): started on
+        # demand by the first SERVING command — request-scale reads of
+        # live training state, micro-batched onto the sparse gather and
+        # admission-controlled by the same overload ladder as commands.
+        self.serving = None
         # Telemetry history + root-cause doctor (metrics/history.py +
         # metrics/doctor.py): a jobserver-side scraper polls every known
         # process's /metrics (the leader's own registry in-process, pod
@@ -471,6 +476,7 @@ class JobServer:
                 self.metrics_exporter.stop()
                 self.metrics_exporter = None
             self._stop_input_service()
+            self._stop_serving()
             self._stop_ha()
             self._state.transition("CLOSED")
 
@@ -897,6 +903,40 @@ class JobServer:
             inputsvc.set_default_endpoint(None)
             svc.stop()
 
+    def _ensure_serving(self):
+        """Start the embedded serving endpoint once (first SERVING
+        command) and return it. Live lookups resolve through
+        ``_entities`` — the same handle the trainers update — and
+        pinned lookups through this server's checkpoint root; admission
+        rides the shared overload monitor."""
+        with self._lock:
+            if self.serving is not None:
+                return self.serving
+            from harmony_tpu.serving import ServingEndpoint
+
+            def live_table(job_id: str):
+                with self._lock:
+                    entity = self._entities.get(job_id)
+                handle = (getattr(entity, "table_handle", None)
+                          if entity is not None else None)
+                return handle.table if handle is not None else None
+
+            svc = ServingEndpoint(
+                table_fn=live_table,
+                chkp_root=self._chkp_root,
+                overload=self.overload,
+            )
+            port = svc.start()
+            self.serving = svc
+        server_log.info("serving endpoint up on port %d", port)
+        return svc
+
+    def _stop_serving(self) -> None:
+        with self._lock:
+            svc, self.serving = self.serving, None
+        if svc is not None:
+            svc.stop()
+
     def running_jobs(self) -> List[str]:
         with self._lock:
             return [j for j, r in self._jobs.items() if not r.future.done()]
@@ -953,6 +993,11 @@ class JobServer:
             # stats and autoscaler events — None when not running
             "input_service": (self.input_service.stats()
                               if self.input_service is not None else None),
+            # serving plane (harmony_tpu/serving): port, per-tenant
+            # qps/latency, batch occupancy and cache hit/byte stats —
+            # None until the first SERVING command starts it
+            "serving": (self.serving.stats()
+                        if self.serving is not None else None),
             # control-plane HA (jobserver/ha.py): role, leader epoch,
             # durable-log/lease/replication shape and recent takeovers —
             # {"enabled": False} outside an HA deployment
@@ -1129,7 +1174,7 @@ class JobServer:
                     # raises = an injected command-path failure; it
                     # surfaces to the client as a structured error reply
                     faults.site("server.command", cmd=str(cmd))
-                if (cmd in ("SUBMIT", "POD_RESHARD", "WAIT")
+                if (cmd in ("SUBMIT", "POD_RESHARD", "WAIT", "SERVING")
                         and not self._ha_leader_ok()):
                     # deposed leader: every mutating/authoritative
                     # command redirects — a client following the lease
@@ -1222,6 +1267,17 @@ class JobServer:
                            num_blocks=int(msg["num_blocks"]),
                            epoch=int(msg["epoch"]))
                         reply = {"ok": True}
+                elif cmd == "SERVING":
+                    # serving-endpoint discovery (harmony_tpu/serving):
+                    # starts the data plane on demand and answers its
+                    # address. Leader-gated above: only the replica that
+                    # owns live tables (and re-arms the checkpoint
+                    # chains) may advertise itself to readers, so a
+                    # takeover re-routes every ServingClient through
+                    # the same NOT_LEADER walk as submissions.
+                    svc = self._ensure_serving()
+                    reply = {"ok": True, "port": svc.port,
+                             "host": svc.address[0]}
                 elif cmd == "SHUTDOWN":
                     threading.Thread(target=self.shutdown, daemon=True).start()
                     reply = {"ok": True}

@@ -85,7 +85,8 @@ class _Tenant:
     __slots__ = ("job", "attempt", "workers", "devices", "samples",
                  "steps_total", "device_sec_total", "examples_total",
                  "flops_per_step", "resident", "bytes", "target_sps",
-                 "slo_events", "first_ts", "last_ts", "async_state")
+                 "slo_events", "first_ts", "last_ts", "async_state",
+                 "serving_state")
 
     def __init__(self, job: str) -> None:
         self.job = job
@@ -108,6 +109,11 @@ class _Tenant:
         #: until the worker reports; availability is what the policy
         #: engine keys its `async` proposal on
         self.async_state: Optional[Dict[str, Any]] = None
+        #: online-serving state (set_serving_state): None until the
+        #: serving plane reports this tenant; the p99-vs-SLO pair is
+        #: what `obs top`, the doctor's serving_slo_breach rule and the
+        #: policy engine's `protect` action all key on
+        self.serving_state: Optional[Dict[str, Any]] = None
 
 
 class LedgerStore:
@@ -201,6 +207,34 @@ class LedgerStore:
                 "max_lag": int(max_lag),
                 "exposed_wait_sec": round(float(exposed_wait_sec), 6),
                 "overlapped_comm_sec": round(float(overlapped_comm_sec), 6),
+            }
+
+    def set_serving_state(self, job: str, attempt: Optional[str] = None,
+                          *, enabled: bool,
+                          qps: Optional[float] = None,
+                          p50_ms: Optional[float] = None,
+                          p99_ms: Optional[float] = None,
+                          slo_p99_ms: Optional[float] = None,
+                          batch_occupancy: Optional[float] = None,
+                          cache_hit_rate: Optional[float] = None) -> None:
+        """Online-serving telemetry for one tenant (the ServingEndpoint's
+        windowed flush — summarized, never per request). None fields are
+        UNKNOWN, kept as None all the way to `obs top`'s `-` rendering;
+        ``attempt`` is optional because the serving plane addresses jobs,
+        not attempts — omitted, the tenant's live attempt stands."""
+
+        def _f(v: Optional[float]) -> Optional[float]:
+            return None if v is None else round(float(v), 4)
+
+        with self._lock:
+            self._tenant(job, attempt).serving_state = {
+                "enabled": bool(enabled),
+                "qps": _f(qps),
+                "p50_ms": _f(p50_ms),
+                "p99_ms": _f(p99_ms),
+                "slo_p99_ms": _f(slo_p99_ms),
+                "batch_occupancy": _f(batch_occupancy),
+                "cache_hit_rate": _f(cache_hit_rate),
             }
 
     def bind_table(self, table_id: str, job: str, attempt: str) -> None:
@@ -319,6 +353,8 @@ class LedgerStore:
                     },
                     "async": (dict(t.async_state)
                               if t.async_state is not None else None),
+                    "serving": (dict(t.serving_state)
+                                if t.serving_state is not None else None),
                 }
         total_resident = sum(r["resident_bytes"] for r in rows.values())
         for r in rows.values():
@@ -441,6 +477,21 @@ def _install_callbacks(store: LedgerStore) -> None:
             return out
         return sample
 
+    def serving_of(sub):
+        # not gauge_of: the "serving" row is None until the serving
+        # plane reports, and a reported-None field (no traffic in the
+        # window) stays absent, never 0
+        def sample():
+            out = []
+            for r in rows().values():
+                s = r.get("serving")
+                if not s or not s.get("enabled") or s.get(sub) is None:
+                    continue
+                out.append(({"job": r["job"], "attempt": r["attempt"]},
+                            float(s[sub])))
+            return out
+        return sample
+
     try:
         reg.register_callback(
             "harmony_tenant_mfu",
@@ -484,5 +535,20 @@ def _install_callbacks(store: LedgerStore) -> None:
             "Comm seconds the async step could NOT hide: staleness-gate "
             "wait blocking compute (absent unless async mode is on)",
             "gauge", async_of("exposed_wait_sec"))
+        reg.register_callback(
+            "harmony_tenant_serving_qps",
+            "Windowed serving lookups/sec per tenant (absent unless the "
+            "serving plane reports this tenant)",
+            "gauge", serving_of("qps"))
+        reg.register_callback(
+            "harmony_tenant_serving_p99_ms",
+            "Windowed serving p99 lookup latency in ms (absent unless "
+            "the serving plane reports this tenant)",
+            "gauge", serving_of("p99_ms"))
+        reg.register_callback(
+            "harmony_tenant_serving_cache_hit_rate",
+            "Windowed serving hot-row cache hit rate (absent without "
+            "cache traffic)",
+            "gauge", serving_of("cache_hit_rate"))
     except Exception:
         pass  # already registered by an earlier store in this process

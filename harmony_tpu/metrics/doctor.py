@@ -607,6 +607,52 @@ def _control_overload(ctx: DoctorContext) -> List[Diagnosis]:
                   "recovered": recovered})]
 
 
+#: serving_slo_breach fires only when the windowed p99 sits this far
+#: over the tenant's target — a single tail sample is load, not a breach
+SERVING_BREACH_RATIO = 1.0
+
+
+@doctor_rule("serving_slo_breach",
+             "a serving tenant's windowed p99 lookup latency "
+             "(tenant.serving.p99_ms, the ledger fold of the serving "
+             "plane's latency summary) sits over its registered p99 SLO "
+             "(tenant.serving.slo_p99_ms) across the window — "
+             "attributed to the serving tenant with both evidence "
+             "series excerpted")
+def _serving_slo_breach(ctx: DoctorContext) -> List[Diagnosis]:
+    out: List[Diagnosis] = []
+    targets = {labels.get("job"): pts for labels, pts in
+               ctx.store.range("tenant.serving.slo_p99_ms",
+                               since=ctx.since)}
+    for labels, pts in ctx.store.range("tenant.serving.p99_ms",
+                                       since=ctx.since):
+        if len(pts) < MIN_POINTS:
+            continue
+        job = labels.get("job")
+        tpts = targets.get(job)
+        if not tpts:
+            continue  # no registered SLO: latency alone is not a breach
+        target = float(tpts[-1][1])
+        p99 = _median([v for _ts, v in pts])
+        if target <= 0 or p99 <= target * SERVING_BREACH_RATIO:
+            continue
+        over = [v for _ts, v in pts if v > target]
+        out.append(Diagnosis(
+            rule="serving_slo_breach", verdict="serving_slo_breach",
+            confidence=min(1.0, 0.5 + 0.5 * (len(over) / len(pts))),
+            summary=(f"serving tenant {job} breaching its p99 SLO: "
+                     f"windowed p99 {p99:.1f}ms vs target {target:.1f}ms "
+                     f"({len(over)}/{len(pts)} samples over)"),
+            window=(ctx.since, ctx.now),
+            job=str(job) if job is not None else None,
+            target="serving",
+            evidence={"p99_ms": ctx.excerpt(pts),
+                      "slo_p99_ms": ctx.excerpt(tpts),
+                      "samples_over": len(over),
+                      "samples": len(pts)}))
+    return out
+
+
 @doctor_rule("slo_breach",
              "a structured kind=\"slo\" joblog breach event joined to "
              "whichever rule fired in its window — the breach gets a "

@@ -751,6 +751,19 @@ class HistoryScraper:
                 if slo.get("attainment") is not None:
                     self.store.ingest("tenant.slo_attainment", labels,
                                       float(slo["attainment"]), ts=ts)
+                # serving fold (harmony_tpu/serving): the endpoint's
+                # windowed latency/traffic summary becomes first-class
+                # tenant.serving.* series — the serving_slo_breach
+                # rule's raw material. Absent until the serving plane
+                # reports this tenant; None fields stay unknown.
+                srv = row.get("serving") or {}
+                if srv.get("enabled"):
+                    for f in ("qps", "p50_ms", "p99_ms", "slo_p99_ms",
+                              "batch_occupancy", "cache_hit_rate"):
+                        if srv.get(f) is not None:
+                            self.store.ingest(f"tenant.serving.{f}",
+                                              labels, float(srv[f]),
+                                              ts=ts)
         with self._lock:
             self._cycles += 1
             self._last_cycle_ms = (time.monotonic() - t_start) * 1000.0

@@ -63,8 +63,9 @@ ENV_PERSIST = "HARMONY_INCIDENT_PERSIST"
 
 #: evidence that opens (or re-triggers) an incident
 TRIGGER_KINDS = frozenset({
-    "slo", "overload", "process_restart", "follower_silenced",
-    "fault_trip", "follower_death", "follower_job_failed",
+    "slo", "serving_slo", "overload", "process_restart",
+    "follower_silenced", "fault_trip", "follower_death",
+    "follower_job_failed",
 })
 DIAGNOSIS_KINDS = frozenset({"diagnosis"})
 #: remediation the control plane took in answer

@@ -1,0 +1,15 @@
+"""Online serving plane: request-scale reads from live training state.
+
+The parameter-server lineage treats serving reads as a first-class
+access path beside training pushes; this package is that path for the
+TPU-native table. A :class:`~harmony_tpu.serving.service.ServingEndpoint`
+rides the jobserver (started on demand like the input service) and
+answers framed lookup streams against the SAME storage the trainers
+update — micro-batched onto the FusedSparseStep gather, cached in a
+bytes-bounded hot-row tier, and readable in two consistency modes
+(``live`` and checkpoint-``pinned``). See docs/SERVING.md.
+"""
+from harmony_tpu.serving.client import ServingClient
+from harmony_tpu.serving.service import ServingEndpoint
+
+__all__ = ["ServingClient", "ServingEndpoint"]

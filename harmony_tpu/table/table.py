@@ -435,6 +435,7 @@ class DenseTable(LayoutAnnouncerMixin):
         else:
             arr = jax.device_put(arr, self._sharding)
         self._arr: jax.Array = arr
+        self._data_version = 0
         self._jit_cache: Dict[str, Callable] = {}
 
     # -- layout ----------------------------------------------------------
@@ -467,6 +468,21 @@ class DenseTable(LayoutAnnouncerMixin):
         with self._lock:
             return self._arr
 
+    @property
+    def data_version(self) -> int:
+        """Monotonic count of storage writes (commit / push / put /
+        write_all). External caches of gathered rows (the serving
+        plane's hot-row cache) key on this so a training step can never
+        leave a stale row servable — a write retires the whole cached
+        generation. Reshards bump ``layout_version`` instead; both ride
+        the cache key."""
+        with self._lock:
+            return self._data_version
+
+    def _bump_data_version(self) -> None:
+        # callers hold self._lock (RLock) at every write site
+        self._data_version += 1
+
     def commit(self, new_arr: jax.Array) -> None:
         """Install the post-step storage (the trainer fast path: a jitted
         train step returns the updated table array; committing it is the
@@ -490,6 +506,7 @@ class DenseTable(LayoutAnnouncerMixin):
                 else:
                     new_arr = reshard_array(new_arr, src_mesh, self._sharding)
             self._arr = new_arr
+            self._bump_data_version()
 
     @staticmethod
     def apply_step_multi(tables: Sequence["DenseTable"], step_fn, *extra):
@@ -602,6 +619,7 @@ class DenseTable(LayoutAnnouncerMixin):
             self._arr = self._jitted(
                 "push", partial(self.spec.push, via=self.push_via)
             )(self._arr, k, d)
+            self._bump_data_version()
 
     def update(self, key: int, delta: np.ndarray) -> None:
         self.multi_update([key], jnp.asarray(delta)[None])
@@ -624,6 +642,7 @@ class DenseTable(LayoutAnnouncerMixin):
             self._arr = self._jitted("write_all", self.spec.write_all)(
                 self._arr, v
             )
+            self._bump_data_version()
 
     def multi_put(self, keys: Sequence[int], values: np.ndarray) -> None:
         """Bulk set (no old-value return): the bulk-load insertion path
@@ -637,6 +656,7 @@ class DenseTable(LayoutAnnouncerMixin):
 
         with self._lock:
             self._arr = self._jitted("multi_put", _mput)(self._arr, k, v)
+            self._bump_data_version()
 
     def put(self, key: int, value: np.ndarray) -> np.ndarray:
         """Set, returning the previous value (ref: Table.put returns old).
@@ -652,6 +672,7 @@ class DenseTable(LayoutAnnouncerMixin):
         put_fn = self._jitted("put", _put)
         with self._lock:
             old, self._arr = put_fn(self._arr, k, v)
+            self._bump_data_version()
         return np.asarray(old)[0]
 
     def remove(self, key: int) -> np.ndarray:

@@ -500,3 +500,24 @@ class TestScenariosEndToEnd:
                                workdir=str(tmp_path))
         assert r["ok"], r["violations"]
         assert r["acts"][0]["standby_caught_up"] is True
+
+    @pytest.mark.slow
+    def test_serving_storm_leader_kill_scenario(self, tmp_path):
+        # a pinned-read storm through the leader kill: reads resume
+        # within the takeover window via client failover, zero torn
+        # pinned responses, and the incident engine correlates the dip
+        r = chaos.run_scenario(2020, intensity=0.5,
+                               scenario="serving_storm_leader_kill",
+                               workdir=str(tmp_path))
+        assert r["ok"], r["violations"]
+        act = r["acts"][0]
+        assert act["torn_count"] == 0
+        assert act["reads_after_kill"] > 0
+        assert act["wedged_readers"] == 0
+        # bounded unavailability: lease takeover + one re-resolve, with
+        # slack for the loaded CI box — never the 25s client deadline
+        assert act["takeover_s"] is not None
+        assert act["resume_gap_s"] is not None
+        assert act["resume_gap_s"] < 20.0
+        assert act["dip_correlated"] is True
+        assert "chain_integrity" in act["invariants"]["checked"]

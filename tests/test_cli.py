@@ -232,7 +232,12 @@ def test_file_corpus_keys_pin_real_signature():
 #: incident whose latencies are still unknown (the '-' contract)
 _OBS_STATUS = {
     "ok": True,
-    "tenants": {"t0": {"device_time_ms": 12.5}},
+    "tenants": {"t0": {"device_time_ms": 12.5,
+                       "serving": {"enabled": True, "qps": 120.4,
+                                   "p50_ms": None, "p99_ms": 4.9,
+                                   "slo_p99_ms": 50.0,
+                                   "batch_occupancy": None,
+                                   "cache_hit_rate": None}}},
     "overload": {},
     "diagnoses": [{"tenant": "t0", "verdict": "input_bound"}],
     "history": {"epochs": 3},
@@ -309,6 +314,24 @@ def test_obs_not_ok_status_is_one_json_line(what, monkeypatch, capsys):
     rc = main(["obs", what, "--port", "1"])
     assert rc == 1
     assert json.loads(capsys.readouterr().out) == refusal
+
+
+def test_obs_top_serving_row_renders_unknowns_as_dash(monkeypatch,
+                                                      capsys):
+    """A serving tenant gets a latency line under the table; quantities
+    the endpoint hasn't measured yet render '-', never a fake 0."""
+    from harmony_tpu import cli
+
+    monkeypatch.setattr(cli, "_obs_status_sender",
+                        lambda kind, ep: _FakeObsSender(_OBS_STATUS))
+    rc = main(["obs", "top", "--port", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serving t0:" in out
+    assert "qps 120.4" in out and "p99 4.9ms" in out
+    assert "(slo 50ms)" in out
+    assert "p50 -" in out and "occupancy -" in out and "cache hit -" in out
+    assert "p50 0" not in out and "cache hit 0" not in out
 
 
 def test_obs_incidents_renders_unknowns_as_dash(monkeypatch, capsys):

